@@ -1,0 +1,1 @@
+"""Core runtime: config, topology, lifecycle, fusion, timeline, stall."""

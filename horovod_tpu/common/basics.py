@@ -1,0 +1,149 @@
+"""Process/context lifecycle — the HorovodBasics + global-state analog.
+
+Reference: horovod/common/basics.py:22-258 (ctypes wrapper over the C ABI:
+init/shutdown/rank/size/local_rank/local_size/is_homogeneous...) backed by
+horovod/common/operations.cc:633-878 (InitializeHorovodOnce + extern "C").
+
+TPU-native: there is no background C++ thread to spin up — ``init()``
+discovers the topology (JAX devices / distributed processes), builds the
+global 1-D rank mesh (and the 2-D cross×local mesh for hierarchical paths),
+and instantiates the eager engine, timeline, and stall inspector. A subset
+``init(comm=[ranks])`` builds the context over a device subset, mirroring
+the reference's subset-communicator path (basics.py:33-65,
+operations.cc:692-700).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import List, Optional, Sequence
+
+from . import topology as topo_lib
+from .config import Config, configure
+from .exceptions import NotInitializedError
+from .stall import StallInspector
+from .timeline import Timeline
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class Context:
+    """The live runtime: topology + meshes + eager engine + profiling."""
+
+    def __init__(self, config: Config, comm: Optional[Sequence[int]] = None):
+        self.config = config
+        logging.basicConfig()
+        logger.setLevel(getattr(logging, config.log_level.upper(),
+                                logging.WARNING))
+
+        topo = topo_lib.discover(force_cpu_devices=config.force_cpu_devices)
+        if comm is not None:
+            # Subset communicator: restrict to the given global rank ids.
+            devices = [topo.devices[r] for r in comm]
+            topo = topo_lib.discover(devices=devices)
+        self.topology = topo
+        self.mesh = topo_lib.build_mesh(topo, config.rank_axis)
+        self.hier_mesh = None
+        if topo.is_homogeneous and topo.cross_size > 1:
+            self.hier_mesh = topo_lib.build_hierarchical_mesh(
+                topo, "cross", "local")
+
+        self.timeline = Timeline(config.timeline_filename,
+                                 config.timeline_mark_cycles)
+        self.stall = StallInspector(config.stall_check_time_seconds,
+                                    config.stall_shutdown_time_seconds,
+                                    config.stall_check_disable)
+        from ..ops.eager import EagerEngine
+
+        if config.hierarchical_allreduce and self.hier_mesh is None:
+            logger.warning(
+                "HIERARCHICAL_ALLREDUCE requested but topology is "
+                "single-host/non-homogeneous; using flat allreduce "
+                "(reference falls back the same way, operations.cc:470+)")
+        self.engine = EagerEngine(self.mesh, config.rank_axis, config,
+                                  timeline=self.timeline,
+                                  stall_inspector=self.stall,
+                                  hier_mesh=self.hier_mesh)
+        self._shutdown = False
+
+    # -- reference C-ABI query surface (operations.cc:690-878) -------------
+
+    def rank(self) -> int:
+        """Global rank of this controller process's first device. In
+        single-controller SPMD the Python program acts for all ranks; this
+        returns the canonical rank for rank-0-only work (checkpointing
+        etc.), i.e. the smallest global rank this process drives."""
+        ranks = self.topology.local_ranks()
+        return ranks[0] if ranks else 0
+
+    def size(self) -> int:
+        return self.topology.size
+
+    def local_rank(self) -> int:
+        return 0  # first local device; per-device code uses axis_index
+
+    def local_size(self) -> int:
+        return self.topology.local_size
+
+    def cross_rank(self) -> int:
+        return self.topology.cross_rank
+
+    def cross_size(self) -> int:
+        return self.topology.cross_size
+
+    def is_homogeneous(self) -> bool:
+        return self.topology.is_homogeneous
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self.timeline.stop()
+        self._shutdown = True
+
+
+_context: Optional[Context] = None
+_context_lock = threading.Lock()
+
+
+def init(comm: Optional[Sequence[int]] = None, **config_overrides) -> Context:
+    """Initialize the runtime (idempotent, like InitializeHorovodOnce).
+
+    ``comm``: optional list of global rank ids forming a subset communicator
+    (reference basics.py:33-65). Config overrides win over env vars.
+    """
+    global _context
+    with _context_lock:
+        if _context is not None and not _context._shutdown:
+            if comm is not None or config_overrides:
+                # Silently returning the old context would make e.g. a
+                # subset communicator request produce full-world collectives
+                # — fail loudly instead (a bare init() stays idempotent).
+                raise ValueError(
+                    "init() called with comm/config overrides but the "
+                    "runtime is already initialized; call shutdown() first "
+                    "to re-initialize with different settings")
+            return _context
+        _context = Context(configure(**config_overrides), comm=comm)
+        atexit.register(shutdown)
+        return _context
+
+
+def shutdown() -> None:
+    """Tear down (reference: horovod_shutdown, operations.cc:706-712)."""
+    global _context
+    with _context_lock:
+        if _context is not None:
+            _context.shutdown()
+            _context = None
+
+
+def is_initialized() -> bool:
+    return _context is not None and not _context._shutdown
+
+
+def context() -> Context:
+    if _context is None or _context._shutdown:
+        raise NotInitializedError()
+    return _context

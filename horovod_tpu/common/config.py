@@ -1,0 +1,139 @@
+"""Runtime configuration knobs.
+
+TPU-native analog of the reference's env-var config surface
+(reference: horovod/common/common.h:64-90 canonical HOROVOD_* list, parsed
+in horovod/common/operations.cc:441-523 and horovod/common/utils/env_parser.cc).
+
+Same three-layer convergence as the reference: (1) env vars read here,
+(2) launcher CLI flags that *set* those envs (see horovod_tpu/runner/launch.py),
+(3) programmatic overrides via :func:`configure`.
+
+We honor both a native ``HVD_TPU_*`` prefix and the reference-compatible
+``HOROVOD_*`` names so scripts written against the reference keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_MB = 1024 * 1024
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up `name` under both prefixes: HVD_TPU_X wins over HOROVOD_X."""
+    for key in ("HVD_TPU_" + name, "HOROVOD_" + name):
+        val = os.environ.get(key)
+        if val is not None:
+            return val
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    val = _env(name)
+    try:
+        return int(val) if val is not None else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    val = _env(name)
+    try:
+        return float(val) if val is not None else default
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = _env(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Config:
+    """All runtime knobs, resolved once at ``init()`` (re-resolved on re-init).
+
+    Mirrors the knob inventory of the reference (SURVEY.md §5 "Config"):
+    fusion threshold, cycle time, cache, autotune, stall, timeline, plus
+    TPU-specific additions (donation, compression dtype, mesh axis names).
+    """
+
+    # Tensor fusion: bucket small tensors into flat buffers before the
+    # collective (reference: 64 MiB default, operations.cc:442).
+    fusion_threshold_bytes: int = 64 * _MB
+    # Eager-engine cycle time in ms (reference: 5ms, operations.cc:451).
+    cycle_time_ms: float = 5.0
+    # Response-cache capacity (reference: 1024, operations.cc:476).
+    cache_capacity: int = 1024
+    # Hierarchical (ICI intra-slice + DCN cross-slice) reduction.
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    # Stall inspector (reference defaults stall_inspector.h:75-80).
+    stall_check_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    stall_check_disable: bool = False
+    # Timeline profiler (reference: HOROVOD_TIMELINE env).
+    timeline_filename: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    # Autotune (reference: HOROVOD_AUTOTUNE*).
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    # Adasum scalar precision (reference keeps fp64 scalars, adasum.h).
+    adasum_scalar_dtype: str = "float32"
+    # Compression for the wire format of eager collectives.
+    compression_dtype: Optional[str] = None  # e.g. "bfloat16"/"float16"
+    # Elastic mode (reference: HOROVOD_ELASTIC).
+    elastic: bool = False
+    # Logging level.
+    log_level: str = "warning"
+    # Mesh axis name used for the data-parallel "ranks" axis.
+    rank_axis: str = "hvd"
+    # Force a CPU mesh of this many virtual devices (testing).
+    force_cpu_devices: int = 0
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        c = cls()
+        c.fusion_threshold_bytes = _env_int(
+            "FUSION_THRESHOLD", cls.fusion_threshold_bytes)
+        c.cycle_time_ms = _env_float("CYCLE_TIME", cls.cycle_time_ms)
+        c.cache_capacity = _env_int("CACHE_CAPACITY", cls.cache_capacity)
+        c.hierarchical_allreduce = _env_bool("HIERARCHICAL_ALLREDUCE", False)
+        c.hierarchical_allgather = _env_bool("HIERARCHICAL_ALLGATHER", False)
+        c.stall_check_time_seconds = _env_float(
+            "STALL_CHECK_TIME_SECONDS", cls.stall_check_time_seconds)
+        c.stall_shutdown_time_seconds = _env_float(
+            "STALL_SHUTDOWN_TIME_SECONDS", cls.stall_shutdown_time_seconds)
+        c.stall_check_disable = _env_bool("STALL_CHECK_DISABLE", False)
+        c.timeline_filename = _env("TIMELINE")
+        c.timeline_mark_cycles = _env_bool("TIMELINE_MARK_CYCLES", False)
+        c.autotune = _env_bool("AUTOTUNE", False)
+        c.autotune_log = _env("AUTOTUNE_LOG")
+        c.autotune_warmup_samples = _env_int(
+            "AUTOTUNE_WARMUP_SAMPLES", cls.autotune_warmup_samples)
+        c.autotune_steps_per_sample = _env_int(
+            "AUTOTUNE_STEPS_PER_SAMPLE", cls.autotune_steps_per_sample)
+        c.adasum_scalar_dtype = _env(
+            "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
+        c.compression_dtype = _env("COMPRESSION_DTYPE")
+        c.elastic = _env_bool("ELASTIC", False)
+        c.log_level = _env("LOG_LEVEL", "warning") or "warning"
+        c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
+        c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)
+        return c
+
+
+def configure(**kwargs) -> Config:
+    """Build a Config from env then apply keyword overrides."""
+    c = Config.from_env()
+    for k, v in kwargs.items():
+        if not hasattr(c, k):
+            raise ValueError(f"unknown config knob: {k}")
+        setattr(c, k, v)
+    return c

@@ -1,0 +1,59 @@
+"""Framework exceptions.
+
+TPU-native analog of reference horovod/common/exceptions.py:31
+(HorovodInternalError / HostsUpdatedInterrupt) — the two exception types
+that drive the elastic retry loop (reference horovod/common/elastic.py:147-168).
+"""
+
+from __future__ import annotations
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective failed (peer died, slice preempted, runtime wedged).
+
+    Elastic training catches this and rolls back to the last committed
+    state (reference: common/elastic.py:160-163).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Topology changed (hosts added/removed); triggers graceful re-rendezvous.
+
+    Reference: common/exceptions.py HostsUpdatedInterrupt; raised from
+    state.check_host_updates().
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """API called before ``init()`` (reference: checks in mpi_ops wrappers)."""
+
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first.")
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Cross-rank shape/dtype validation failed.
+
+    Reference: coordinator-side validation in controller.cc:390-621 returning
+    Response::ERROR.
+    """
+
+
+class DuplicateTensorNameError(HorovodTpuError):
+    """Same tensor name submitted twice concurrently.
+
+    Reference: common.h:163-166 DUPLICATE_NAME_ERROR.
+    """
+
+
+class StallError(HorovodTpuError):
+    """A rank stalled past the shutdown threshold (stall_inspector.h:80)."""

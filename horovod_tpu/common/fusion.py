@@ -1,0 +1,121 @@
+"""Tensor fusion — bucketing small tensors into flat buffers.
+
+TPU-native re-design of the reference's FusionBufferManager + FuseResponses
+(horovod/common/fusion_buffer_manager.cc; controller.cc:686-809). The
+reference memcpys tensors into a persistent 64 MiB device buffer so one
+NCCL call covers many small gradients. Under XLA we express the same thing
+functionally: flatten a pytree, group leaves into ≤threshold same-dtype
+buckets, ``concatenate`` each bucket into one flat array, run ONE collective
+per bucket, then split/reshape back. Inside ``jit`` the concat/split are
+pure data-movement that XLA fuses/elides where possible, and each bucket
+becomes a single large AllReduce on the wire — the exact latency win fusion
+buys the reference, with no hand-managed buffer.
+
+Bucket *plans* are deterministic functions of (shapes, dtypes, threshold) so
+every rank computes the identical plan without negotiation — the property
+the reference's coordinator exists to enforce (controller.cc:63-358) falls
+out for free in SPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fusion bucket: indices of the leaves it covers (in flatten order),
+    their shapes, and the flat element count."""
+
+    leaf_indices: Tuple[int, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtype: Any
+    total_elems: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FusionPlan:
+    buckets: Tuple[Bucket, ...]
+    treedef: Any
+    num_leaves: int
+
+
+def plan_fusion(tree, threshold_bytes: int) -> FusionPlan:
+    """Greedy same-dtype bucketing in flatten order (reference fuses in
+    response order up to the threshold, controller.cc:686-809)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    buckets: List[Bucket] = []
+    # Group leaves by dtype, preserving order within each dtype class.
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        dt = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype
+        by_dtype.setdefault(str(dt), []).append(i)
+    for dt_key, idxs in by_dtype.items():
+        cur_idx: List[int] = []
+        cur_shapes: List[Tuple[int, ...]] = []
+        cur_elems = 0
+        dt = leaves[idxs[0]].dtype
+        itemsize = np.dtype(dt).itemsize
+        cap = max(1, threshold_bytes // itemsize)
+        for i in idxs:
+            n = int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
+            if cur_idx and cur_elems + n > cap:
+                buckets.append(Bucket(tuple(cur_idx), tuple(cur_shapes),
+                                      dt, cur_elems))
+                cur_idx, cur_shapes, cur_elems = [], [], 0
+            cur_idx.append(i)
+            cur_shapes.append(tuple(leaves[i].shape))
+            cur_elems += n
+        if cur_idx:
+            buckets.append(Bucket(tuple(cur_idx), tuple(cur_shapes),
+                                  dt, cur_elems))
+    return FusionPlan(tuple(buckets), treedef, len(leaves))
+
+
+def fuse(tree, plan: FusionPlan) -> List[jnp.ndarray]:
+    """Concatenate each bucket's leaves into one flat array
+    (the MemcpyInFusionBuffer analog, collective_operations.h:97-110)."""
+    leaves = jax.tree.leaves(tree)
+    flats = []
+    for b in plan.buckets:
+        parts = [jnp.ravel(leaves[i]) for i in b.leaf_indices]
+        flats.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return flats
+
+
+def unfuse(flats: Sequence[jnp.ndarray], plan: FusionPlan):
+    """Split flat buffers back into the original pytree
+    (the MemcpyOutFusionBuffer analog)."""
+    leaves: List[Any] = [None] * plan.num_leaves
+    for flat, b in zip(flats, plan.buckets):
+        off = 0
+        for i, shape in zip(b.leaf_indices, b.shapes):
+            n = int(np.prod(shape)) if shape else 1
+            leaves[i] = jax.lax.slice_in_dim(flat, off, off + n).reshape(shape)
+            off += n
+    return jax.tree.unflatten(plan.treedef, leaves)
+
+
+def fused_apply(tree, fn: Callable, threshold_bytes: int = 64 * 1024 * 1024):
+    """Apply ``fn`` (e.g. an allreduce lambda) to fusion buckets of ``tree``
+    and restore the tree. This is the whole fusion pipeline of the reference
+    — memcpy-in, collective, memcpy-out — as three pure functions."""
+    plan = plan_fusion(tree, threshold_bytes)
+    flats = fuse(tree, plan)
+    out = [fn(f) for f in flats]
+    return unfuse(out, plan)
+
+
+def pad_to_multiple(flat: jnp.ndarray, multiple: int):
+    """Pad a flat buffer so reduce-scatter staging divides evenly (the
+    hierarchical path needs dim0 % local_size == 0). Returns (padded, n)."""
+    n = flat.shape[0]
+    rem = (-n) % multiple
+    if rem:
+        flat = jnp.concatenate([flat, jnp.zeros((rem,), dtype=flat.dtype)])
+    return flat, n

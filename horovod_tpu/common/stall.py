@@ -1,0 +1,82 @@
+"""Stall inspector — detects collectives stuck past a threshold.
+
+Reference: horovod/common/stall_inspector.cc:28+ / stall_inspector.h:75-80 —
+the coordinator warns when some ranks have submitted a tensor but others
+haven't for >60 s, and optionally shuts the job down after
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS.
+
+Under single-controller SPMD a "missing rank" cannot happen inside one
+process — the analog failure mode is a *dispatched collective that never
+completes* (a wedged chip, a preempted slice, a DCN partition in
+multi-host). So this inspector tracks submit→complete latency of named
+collectives and (a) warns past ``check_time``, (b) raises StallError past
+``shutdown_time`` when polled.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict
+
+from .exceptions import StallError
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class StallInspector:
+    def __init__(self, check_time_seconds: float = 60.0,
+                 shutdown_time_seconds: float = 0.0,
+                 disabled: bool = False):
+        self.check_time = check_time_seconds
+        self.shutdown_time = shutdown_time_seconds
+        self.disabled = disabled
+        self._inflight: Dict[str, float] = {}
+        self._warned: set = set()
+        self._lock = threading.Lock()
+
+    def record_submit(self, name: str) -> None:
+        if self.disabled:
+            return
+        with self._lock:
+            self._inflight[name] = time.monotonic()
+
+    def record_complete(self, name: str) -> None:
+        if self.disabled:
+            return
+        with self._lock:
+            self._inflight.pop(name, None)
+            self._warned.discard(name)
+
+    def check(self) -> bool:
+        """Poll for stalls; returns True if any stalled tensor was found.
+        Raises StallError past the shutdown threshold (reference:
+        stall_inspector.h:80 shutdown behavior)."""
+        if self.disabled:
+            return False
+        now = time.monotonic()
+        stalled = False
+        with self._lock:
+            items = list(self._inflight.items())
+        for name, t0 in items:
+            age = now - t0
+            if self.shutdown_time > 0 and age > self.shutdown_time:
+                raise StallError(
+                    f"collective {name} stalled for {age:.0f}s "
+                    f"(> shutdown threshold {self.shutdown_time:.0f}s)")
+            if age > self.check_time:
+                stalled = True
+                if name not in self._warned:
+                    logger.warning(
+                        "One or more collectives submitted but not "
+                        "completed for >%.0fs: %s (reference analog: "
+                        "stall_inspector.cc CheckForStalledTensors)",
+                        self.check_time, name)
+                    with self._lock:
+                        self._warned.add(name)
+        return stalled
+
+    def inflight(self):
+        with self._lock:
+            return dict(self._inflight)

@@ -1,0 +1,123 @@
+"""Chrome-trace timeline profiler.
+
+Reference: horovod/common/timeline.cc:205-290 — a writer thread fed by a
+lock-free SPSC queue emits chrome://tracing JSON of per-tensor collective
+lifecycle events (NEGOTIATE_*, QUEUE, MEMCPY_IN_FUSION_BUFFER,
+NCCL_ALLREDUCE — activity names common.h:31-62), toggleable at runtime via
+horovod_start/stop_timeline (operations.cc:720-746).
+
+TPU-native version: the same chrome-trace JSON surface (so existing
+tooling/habits carry over) with phases named for the XLA pipeline
+(COMPILE_CACHE_MISS, DISPATCH, XLA_ALLREDUCE...), a plain worker thread +
+queue.Queue as the writer (CPython has no boost::lockfree; the queue is off
+the hot path), and an optional bridge into ``jax.profiler`` traces for
+device-side detail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Canonical activity names (subset of reference common.h:31-62, renamed for
+# the XLA pipeline).
+NEGOTIATE = "NEGOTIATE"          # eager compile-cache miss / controller round
+QUEUE = "QUEUE"
+FUSE = "MEMCPY_IN_FUSION_BUFFER"
+XLA_ALLREDUCE = "XLA_ALLREDUCE"
+XLA_ALLGATHER = "XLA_ALLGATHER"
+XLA_BROADCAST = "XLA_BROADCAST"
+XLA_ALLTOALL = "XLA_ALLTOALL"
+UNFUSE = "MEMCPY_OUT_FUSION_BUFFER"
+
+
+class Timeline:
+    """Writes chrome-trace JSON events; safe to call from any thread."""
+
+    def __init__(self, filename: Optional[str] = None,
+                 mark_cycles: bool = False):
+        self._filename = filename
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._active = False
+        self._start_ts = time.perf_counter()
+        self._pending_starts = {}
+        self._lock = threading.Lock()
+        if filename:
+            self.start(filename)
+
+    # -- runtime start/stop (reference operations.cc:720-746) -------------
+
+    def start(self, filename: str) -> None:
+        with self._lock:
+            if self._active:
+                return
+            self._filename = filename
+            self._active = True
+            self._thread = threading.Thread(target=self._writer, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._active:
+                return
+            self._active = False
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._start_ts) * 1e6
+
+    # -- event surface -----------------------------------------------------
+
+    def begin(self, tensor_name: str, activity: str) -> None:
+        if not self._active:
+            return
+        self._queue.put({"name": activity, "cat": tensor_name, "ph": "B",
+                         "ts": self._now_us(), "pid": os.getpid(),
+                         "tid": tensor_name})
+
+    def end(self, tensor_name: str, activity: Optional[str] = None) -> None:
+        if not self._active:
+            return
+        self._queue.put({"name": activity or "", "cat": tensor_name,
+                         "ph": "E", "ts": self._now_us(),
+                         "pid": os.getpid(), "tid": tensor_name})
+
+    def instant(self, name: str) -> None:
+        if not self._active:
+            return
+        self._queue.put({"name": name, "ph": "i", "ts": self._now_us(),
+                         "pid": os.getpid(), "tid": "marker", "s": "g"})
+
+    def mark_cycle(self) -> None:
+        """Cycle markers (reference HOROVOD_TIMELINE_MARK_CYCLES)."""
+        if self._mark_cycles:
+            self.instant("CYCLE")
+
+    # -- writer thread (reference timeline.cc TimelineWriter) --------------
+
+    def _writer(self) -> None:
+        events = []
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                break
+            events.append(ev)
+        try:
+            with open(self._filename, "w") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f)
+        except OSError:
+            pass

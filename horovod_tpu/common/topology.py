@@ -1,0 +1,149 @@
+"""Device/process topology discovery — the MPIContext/GlooContext analog.
+
+Reference equivalents: horovod/common/mpi/mpi_context.cc:147-156 (splitting
+global/local/cross communicators) and horovod/common/gloo/gloo_context.cc:80-232
+(rendezvous + 3-context construction). On TPU there is no MPI: the global
+"communicator" is the JAX device mesh; the LOCAL/CROSS split falls out of the
+(process, local-device) factorization of the device list; multi-host
+bootstrap is ``jax.distributed.initialize`` + the TPU pod metadata instead of
+an HTTP KV rendezvous.
+
+Rank semantics: **one rank per device** (the reference runs one process per
+GPU; under single-controller JAX the SPMD program has ``size = device_count``
+participants regardless of process layout). ``local_*`` refers to devices on
+this host/process; ``cross_*`` indexes the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable snapshot of the device topology backing a Context.
+
+    The reference's equivalent state lives in HorovodGlobalState /
+    Controller (rank_, local_rank_, cross_rank_, sizes, is_homogeneous_ —
+    horovod/common/global_state.h:42-122).
+    """
+
+    devices: tuple                 # global device list, mesh order
+    process_index: int             # this process (reference: cross_rank)
+    process_count: int             # number of processes (hosts)
+    local_device_count: int        # devices addressable by this process
+    platform: str                  # "tpu" | "cpu" | ...
+    is_homogeneous: bool           # same local size on every process
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def local_size(self) -> int:
+        return self.local_device_count
+
+    @property
+    def cross_size(self) -> int:
+        return self.process_count
+
+    @property
+    def cross_rank(self) -> int:
+        return self.process_index
+
+    def local_ranks(self) -> List[int]:
+        """Global rank ids of this process's devices."""
+        import jax
+
+        local = set(id(d) for d in jax.local_devices())
+        return [i for i, d in enumerate(self.devices) if id(d) in local]
+
+
+def _maybe_init_distributed() -> None:
+    """Initialize jax.distributed when launched multi-process.
+
+    The launcher (horovod_tpu/runner) exports HVD_TPU_COORDINATOR /
+    HVD_TPU_NUM_PROC / HVD_TPU_PROC_ID — the analog of the reference's
+    HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT + HOROVOD_RANK env wiring
+    (gloo_run.py:65-99). On Cloud TPU pods jax.distributed can also
+    self-discover from the pod metadata server.
+    """
+    import jax
+
+    coord = os.environ.get("HVD_TPU_COORDINATOR")
+    if coord and os.environ.get("HVD_TPU_NUM_PROC"):
+        nproc = int(os.environ["HVD_TPU_NUM_PROC"])
+        pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+        if nproc > 1:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nproc,
+                    process_id=pid,
+                )
+            except RuntimeError:
+                pass  # already initialized (elastic re-init path)
+
+
+def discover(force_cpu_devices: int = 0,
+             devices: Optional[Sequence] = None) -> Topology:
+    """Build a Topology from the live JAX backend.
+
+    ``force_cpu_devices > 0`` builds an N-virtual-device CPU topology (the
+    loopback/"Gloo role" backend used by the test suite — SURVEY.md §4).
+    """
+    import jax
+
+    if force_cpu_devices > 0 and devices is None:
+        os.environ.setdefault("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={force_cpu_devices}"
+        if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+            os.environ["XLA_FLAGS"] += " " + flag
+        jax.config.update("jax_platforms", "cpu")
+
+    _maybe_init_distributed()
+
+    devs = tuple(devices) if devices is not None else tuple(jax.devices())
+    local_count = len([d for d in devs if d in set(jax.local_devices())]) \
+        if jax.process_count() > 1 else len(devs)
+    # Homogeneity: all processes own the same number of devices.
+    counts = {}
+    for d in devs:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    homo = len(set(counts.values())) <= 1
+    return Topology(
+        devices=devs,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=local_count,
+        platform=devs[0].platform if devs else "cpu",
+        is_homogeneous=homo,
+    )
+
+
+def build_mesh(topology: Topology, axis_name: str):
+    """1-D mesh over all ranks — the GLOBAL communicator."""
+    import jax
+
+    return jax.sharding.Mesh(np.array(topology.devices), (axis_name,))
+
+
+def build_hierarchical_mesh(topology: Topology, cross_axis: str,
+                            local_axis: str):
+    """2-D (cross=hosts, local=per-host devices) mesh — the LOCAL/CROSS
+    communicator split (reference common.h:113-117) for hierarchical
+    allreduce (nccl_operations.cc:190+ analog: ICI within host/slice,
+    DCN across).
+    """
+    import jax
+
+    if not topology.is_homogeneous:
+        raise ValueError(
+            "hierarchical mesh requires homogeneous per-process device counts")
+    local = topology.size // topology.cross_size
+    arr = np.array(topology.devices).reshape(topology.cross_size, local)
+    return jax.sharding.Mesh(arr, (cross_axis, local_axis))

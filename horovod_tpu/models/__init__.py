@@ -1,0 +1,1 @@
+"""Model zoo used by examples, tests and benchmarks: ResNet, BERT, MLP."""

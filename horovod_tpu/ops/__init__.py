@@ -1,0 +1,2 @@
+"""Collective ops layer: axis-level primitives, eager engine, adasum,
+compression, pallas kernels."""

@@ -1,0 +1,290 @@
+"""Collective primitives over a mesh axis — the L1 "ops layer".
+
+TPU-native re-design of the reference's collective op hierarchy
+(horovod/common/ops/collective_operations.h:51-276 — abstract
+Allreduce/Allgather/Broadcast/Alltoall/Join ops; NCCL/MPI/Gloo backends in
+the sibling files). On TPU there is exactly one data plane — XLA collectives
+over ICI/DCN — so instead of an ordered backend list (operations.cc:142-249)
+this module provides *axis-name-parameterized functions* that lower to
+``xla::AllReduce / AllGather / AllToAll / CollectivePermute / ReduceScatter``.
+They are usable directly inside any ``jit``/``shard_map`` region, and the
+eager engine (horovod_tpu/ops/eager.py) wraps them in compiled per-signature
+programs — the response-cache analog.
+
+Reduce-op enum values match the reference C ABI
+(horovod/common/operations.cc:748-780 horovod_reduce_op_* accessors).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ReduceOp(enum.IntEnum):
+    """Reference: average=0, sum=1, adasum=2 (operations.cc:748-760);
+    min/max/product from later reference API kept for capability parity."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Aliases matching the reference Python surface (torch/mpi_ops.py Average/Sum).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def to_local(x, axis_name: str = "hvd"):
+    """Mark a replicated value as rank-varying (``lax.pvary``).
+
+    Under shard_map's varying-manual-axes type system, differentiating a
+    rank-varying loss with respect to a *replicated* (unvarying) parameter
+    auto-inserts a psum — the gradient arrives already globally summed. The
+    reference's model is the opposite: every rank holds an independent
+    parameter copy and gradients are LOCAL until the explicit allreduce
+    (torch/optimizer.py:103-207). Apply ``to_local`` to replicated params
+    before ``jax.grad`` inside an SPMD region to get reference semantics —
+    then DistributedOptimizer's allreduce is the one and only reduction.
+    """
+    def one(v):
+        try:
+            return lax.pcast(v, axis_name, to="varying")
+        except Exception:
+            return v  # already varying over axis_name
+    return jax.tree.map(one, x)
+
+
+def axis_rank(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def _apply_scale(x, scale: Optional[float]):
+    """Pre/post-scaling (reference: prescale_factor/postscale_factor applied
+    via ScaleBuffer, collective_operations.h:97-125). Scaling is fused by XLA
+    into the surrounding computation — no separate kernel needed."""
+    if scale is None or scale == 1.0:
+        return x
+    return x * jnp.asarray(scale, dtype=x.dtype)
+
+
+def allreduce(x,
+              op: ReduceOp = ReduceOp.AVERAGE,
+              axis_name: str = "hvd",
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              adasum_scalar_dtype=None):
+    """Allreduce of ``x`` across the mesh axis.
+
+    Reference semantics: EnqueueTensorAllreduce (operations.cc:882-942) with
+    average folded into postscale (tensorflow/__init__.py:54-154).
+    ``adasum_scalar_dtype`` controls the precision of Adasum's dot/norm
+    scalars (HOROVOD_ADASUM_SCALAR_DTYPE; reference keeps fp64 scalars).
+    """
+    x = _apply_scale(x, prescale_factor)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        y = lax.psum(x, axis_name)
+        if op == ReduceOp.AVERAGE:
+            n = lax.axis_size(axis_name)
+            y = y / jnp.asarray(n, dtype=y.dtype)
+    elif op == ReduceOp.MIN:
+        y = lax.pmin(x, axis_name)
+    elif op == ReduceOp.MAX:
+        y = lax.pmax(x, axis_name)
+    elif op == ReduceOp.PRODUCT:
+        # No native pprod; lower via log/exp would lose signs — use
+        # all_gather + reduce, which XLA turns into a small tree.
+        g = lax.all_gather(x, axis_name)
+        y = jnp.prod(g, axis=0)
+    elif op == ReduceOp.ADASUM:
+        from . import adasum as _adasum
+
+        y = _adasum.adasum_allreduce(
+            x, axis_name,
+            scalar_dtype=adasum_scalar_dtype or jnp.float32)
+    else:
+        raise ValueError(f"unsupported reduce op: {op}")
+    return _apply_scale(y, postscale_factor)
+
+
+def grouped_allreduce(xs: Sequence,
+                      op: ReduceOp = ReduceOp.AVERAGE,
+                      axis_name: str = "hvd",
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Allreduce a list of tensors as one logical step (reference:
+    EnqueueTensorAllreduces grouped path). XLA fuses the psums; callers
+    wanting explicit fusion use horovod_tpu/common/fusion.py buckets."""
+    return [allreduce(x, op, axis_name, prescale_factor, postscale_factor)
+            for x in xs]
+
+
+def allgather(x, axis_name: str = "hvd"):
+    """Concatenate each rank's tensor along dim 0 (reference:
+    EnqueueTensorAllgather operations.cc:946-989; MPIAllgather). Ranks may
+    have different dim-0 sizes only via :func:`allgatherv`."""
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def allgatherv(x, sizes: Sequence[int], axis_name: str = "hvd"):
+    """Variable-first-dim allgather.
+
+    ``x`` must be padded to ``max(sizes)`` rows; ``sizes`` is the static
+    per-rank row-count table (the controller negotiates it in eager mode —
+    the reference's tensor-shape negotiation, controller.cc:486-570).
+    Returns the concatenated (sum(sizes), ...) array.
+
+    XLA has no ragged all-gather; pad-to-max + static slice-out is the
+    standard TPU lowering and keeps shapes static for the compiler.
+    """
+    maxs = max(sizes) if len(sizes) else 0
+    assert x.shape[0] == maxs, f"input must be padded to {maxs} rows"
+    g = lax.all_gather(x, axis_name, axis=0, tiled=False)  # (n, maxs, ...)
+    parts = [lax.slice_in_dim(g[i], 0, sizes[i], axis=0)
+             for i in range(len(sizes))]
+    return jnp.concatenate(parts, axis=0)
+
+
+def broadcast(x, root_rank: int = 0, axis_name: str = "hvd"):
+    """Broadcast root's value to all ranks (reference:
+    EnqueueTensorBroadcast operations.cc:993-1016).
+
+    Lowering: zero out non-root shards and psum — XLA pattern-matches this
+    into a broadcast-like collective; avoids gathering n copies.
+    """
+    idx = lax.axis_index(axis_name)
+    zeros = jnp.zeros_like(x)
+    masked = jnp.where(idx == root_rank, x, zeros)
+    return lax.psum(masked, axis_name)
+
+
+def reducescatter(x, op: ReduceOp = ReduceOp.SUM, axis_name: str = "hvd"):
+    """Reduce-scatter along dim 0 (the building block of hierarchical
+    allreduce — reference NCCLHierarchicalAllreduce nccl_operations.cc:190+).
+    Dim 0 must be divisible by the axis size."""
+    if op == ReduceOp.AVERAGE:
+        y = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+        return y / jnp.asarray(lax.axis_size(axis_name), dtype=y.dtype)
+    if op != ReduceOp.SUM:
+        raise ValueError("reducescatter supports SUM/AVERAGE")
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+def alltoall(x, axis_name: str = "hvd"):
+    """Even all-to-all: dim 0 is split into ``n`` equal chunks, chunk ``j``
+    goes to rank ``j``; received chunks concatenate along dim 0.
+    (reference: EnqueueTensorAlltoall operations.cc:1020-1081, even case.)
+    """
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+
+
+def alltoallv(x, splits_matrix, axis_name: str = "hvd"):
+    """Uneven all-to-all with a static per-(src,dst) split table.
+
+    ``splits_matrix[s][d]`` = rows rank ``s`` sends to rank ``d`` (the
+    reference negotiates recv splits through the controller,
+    controller.h:56-58 AlltoallGetRecvSplits; here the table is static so
+    XLA keeps static shapes). ``x`` is this rank's send buffer laid out as
+    consecutive destination segments, padded so every segment occupies
+    ``max_split = max(splits_matrix)`` rows: shape (n * max_split, ...).
+
+    Returns the recv buffer of shape (n * max_split, ...): segment ``s``
+    (rows ``s*max_split : (s+1)*max_split``) holds the rows from source
+    ``s``, valid in its first ``splits_matrix[s][my_rank]`` rows (the
+    caller knows its own rank and the table, so recv sizes are column
+    ``my_rank`` of the table — no negotiation round needed).
+    """
+    n = len(splits_matrix)
+    maxs = max(max(row) for row in splits_matrix) if n else 0
+    assert x.shape[0] == n * maxs
+    y = lax.all_to_all(x.reshape((n, maxs) + x.shape[1:]), axis_name,
+                       split_axis=0, concat_axis=0, tiled=False)
+    # y: (n, maxs, ...) — y[s] = padded segment from source s.
+    return y.reshape((n * maxs,) + x.shape[1:])
+
+
+def barrier(axis_name: str = "hvd"):
+    """Synchronization barrier (reference: MPIController Barrier,
+    mpi_controller.cc:227). Returns a token-like scalar to thread into
+    downstream ops if ordering matters."""
+    return lax.psum(jnp.ones((), dtype=jnp.int32), axis_name)
+
+
+def join_allreduce(x, joined, op: ReduceOp = ReduceOp.AVERAGE,
+                   axis_name: str = "hvd"):
+    """Allreduce where ranks flagged ``joined`` contribute zeros and the
+    average divides by the number of *active* ranks — the Join op
+    (reference: JoinOp collective_operations.h:259-267: departed ranks
+    substitute zero tensors; operations.cc:1085-1109).
+
+    ``joined`` is a per-rank bool scalar (True = this rank has left).
+    """
+    active = lax.psum((1 - joined.astype(jnp.int32)), axis_name)
+    contrib = jnp.where(joined, jnp.zeros_like(x), x)
+    y = lax.psum(contrib, axis_name)
+    if op == ReduceOp.AVERAGE:
+        y = y / jnp.maximum(active, 1).astype(y.dtype)
+    elif op != ReduceOp.SUM:
+        raise ValueError("join supports SUM/AVERAGE")
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (two-level ICI/DCN) variants — reference
+# NCCLHierarchicalAllreduce (nccl_operations.cc:190+): reduce-scatter within
+# the node, allreduce across nodes, allgather within the node. On TPU the
+# "node" axis is the intra-slice ICI mesh axis and the "cross" axis spans
+# slices over DCN; XLA emits the right collectives per axis.
+# ---------------------------------------------------------------------------
+
+def hierarchical_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
+                           local_axis: str = "local",
+                           cross_axis: str = "cross"):
+    """Two-phase allreduce over a 2-D (cross, local) mesh."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("hierarchical allreduce supports SUM/AVERAGE")
+    # psum over both axes; XLA lowers to ICI reduce + DCN reduce in one
+    # fused collective schedule. Explicit RS/AG staging lives in fusion.py
+    # for the flat-bucket path where it actually saves DCN bytes.
+    y = lax.psum(x, (local_axis, cross_axis))
+    if op == ReduceOp.AVERAGE:
+        n = lax.axis_size(local_axis) * lax.axis_size(cross_axis)
+        y = y / jnp.asarray(n, dtype=y.dtype)
+    return y
+
+
+def hierarchical_allreduce_staged(x, op: ReduceOp = ReduceOp.AVERAGE,
+                                  local_axis: str = "local",
+                                  cross_axis: str = "cross"):
+    """Explicitly staged RS(local) → AR(cross) → AG(local), for flat fusion
+    buffers whose dim 0 is divisible by the local axis size. Sends 1/local of
+    the bytes over DCN — the exact win of the reference's hierarchical path.
+    """
+    nl = lax.axis_size(local_axis)
+    shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, cross_axis)
+    y = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        n = nl * lax.axis_size(cross_axis)
+        y = y / jnp.asarray(n, dtype=y.dtype)
+    elif op != ReduceOp.SUM:
+        raise ValueError("supports SUM/AVERAGE")
+    return y

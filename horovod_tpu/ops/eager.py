@@ -1,0 +1,460 @@
+"""Eager collective engine — compiled per-signature XLA collectives.
+
+This is the TPU-native replacement for the reference's background-thread
+runtime (horovod/common/operations.cc:356-629 BackgroundThreadLoop +
+controller.cc ComputeResponseList + ops dispatch). The reference needs a
+background thread and a rank-0 negotiation protocol because each process
+submits tensors asynchronously in nondeterministic order. Under
+single-controller JAX the submitting program *is* SPMD: every rank's
+collective is issued by the same Python line, so negotiation is vacuous and
+the runtime reduces to:
+
+  * a **compile cache** keyed by (collective, shape, dtype, op, scales,
+    compression) — the ResponseCache analog (response_cache.h:45-100):
+    first call with a new signature pays the XLA compile (the "negotiation");
+    repeats dispatch immediately;
+  * **async dispatch with handles** — JAX's dispatch is already async;
+    we wrap it in the reference's handle/poll/synchronize surface
+    (torch/handle_manager.h analog) so arbitrary-order host code works;
+  * **fusion** — pytree inputs are bucketed via horovod_tpu/common/fusion.py.
+
+Rank-major layout: an eager "distributed tensor" is a jax.Array of shape
+``(size, *shape)`` sharded over the rank axis — slice ``r`` is rank ``r``'s
+local tensor. ``scatter``/``gather`` convert host-stacked values. A plain
+(unstacked) array is treated as "same value on every rank" and is
+broadcast-stacked first — matching what N reference processes calling with
+identical tensors would see.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import fusion as fusion_lib
+from ..common.exceptions import (DuplicateTensorNameError,
+                                 TensorShapeMismatchError)
+from . import collectives as C
+from .compression import Compression, NoneCompressor
+
+
+class HandleManager:
+    """int handle -> pending result table (reference:
+    horovod/torch/handle_manager.cc:1-108 + mpi_ops.py synchronize)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+
+    def allocate(self, value) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = value
+            return h
+
+    def poll(self, handle: int) -> bool:
+        """True when the result is ready. A handle already synchronized (or
+        never issued) reports True — matching the reference where poll on a
+        completed handle is legal (torch/mpi_ops.py poll semantics)."""
+        with self._lock:
+            if handle not in self._results:
+                return True
+            val = self._results[handle]
+        leaves = jax.tree.leaves(val)
+        return all(l.is_ready() if hasattr(l, "is_ready") else True
+                   for l in leaves)
+
+    def synchronize(self, handle: int):
+        with self._lock:
+            if handle not in self._results:
+                raise KeyError(
+                    f"unknown or already-synchronized handle: {handle}")
+            val = self._results.pop(handle)
+        for l in jax.tree.leaves(val):
+            if hasattr(l, "block_until_ready"):
+                l.block_until_ready()
+        return val
+
+
+class EagerEngine:
+    """Compiled-collective dispatcher bound to a Context's mesh."""
+
+    # How long a re-submission of an in-flight name waits for its
+    # predecessor before raising DuplicateTensorNameError.
+    duplicate_wait_seconds = 30.0
+
+    def __init__(self, mesh: Mesh, axis_name: str, config, timeline=None,
+                 stall_inspector=None, hier_mesh: Optional[Mesh] = None):
+        self.mesh = mesh
+        self.axis = axis_name
+        self.config = config
+        self.timeline = timeline
+        self.stall = stall_inspector
+        # 2-D (cross, local) mesh for HOROVOD_HIERARCHICAL_ALLREDUCE: the
+        # NCCL-intra-node + MPI-inter-node analog (nccl_operations.cc:190+)
+        # becomes RS(local/ICI) → AR(cross/DCN) → AG(local/ICI).
+        self.hier_mesh = hier_mesh
+        self._default_compression = NoneCompressor
+        if config.compression_dtype:
+            from .compression import Compression
+
+            self._default_compression = Compression.by_name(
+                config.compression_dtype)
+        self._cache: Dict[Tuple, Any] = {}
+        self._cache_lock = threading.Lock()
+        self.handles = HandleManager()
+        self._inflight_names: set = set()
+        self._names_lock = threading.Lock()
+        self._noname_seq = 0
+        # Finalizer pool: completion (stall tracking, timeline end, name
+        # release) is tied to *buffer readiness*, not dispatch return —
+        # the reference's async-completion model, where FinalizeGPUQueue
+        # returns InProgress and a finalizer thread fires callbacks once
+        # events complete (gpu_operations.h:107-119).
+        self._finalizers = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="hvd_tpu_finalizer")
+
+    @property
+    def size(self) -> int:
+        return self.mesh.devices.size
+
+    # -- layout helpers ----------------------------------------------------
+
+    def _rank_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def scatter(self, stacked) -> jax.Array:
+        """Host-stacked (size, *shape) -> rank-sharded distributed tensor."""
+        stacked = jnp.asarray(stacked)
+        if stacked.shape[0] != self.size:
+            raise TensorShapeMismatchError(
+                f"leading dim {stacked.shape[0]} != size {self.size}")
+        return jax.device_put(stacked, self._rank_sharding())
+
+    def gather(self, dt) -> np.ndarray:
+        """Distributed tensor -> host-stacked numpy (size, *shape)."""
+        return np.asarray(jax.device_get(dt))
+
+    def replicate(self, x) -> jax.Array:
+        """Plain array -> same value on every rank (stacked)."""
+        x = jnp.asarray(x)
+        stacked = jnp.broadcast_to(x[None], (self.size,) + x.shape)
+        return jax.device_put(stacked, self._rank_sharding())
+
+    def _as_distributed(self, x):
+        """Accept either an already rank-major array or a plain value."""
+        if isinstance(x, jax.Array) and x.shape[:1] == (self.size,) and (
+                getattr(x, "sharding", None) is not None
+                and not x.sharding.is_fully_replicated):
+            return x
+        x = jnp.asarray(x)
+        if x.ndim >= 1 and x.shape[0] == self.size:
+            return self.scatter(x)
+        return self.replicate(x)
+
+    # -- compile cache -----------------------------------------------------
+
+    def _compiled(self, key: Tuple, builder):
+        with self._cache_lock:
+            fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            with self._cache_lock:
+                if len(self._cache) >= self.config.cache_capacity:
+                    # Evict oldest (dict preserves insertion order) — LRU-ish,
+                    # reference evicts by LRU bit (response_cache.cc).
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = fn
+        return fn
+
+    def _shard_mapped(self, per_rank_fn, nout: int = 1):
+        """Wrap a per-rank function into a jitted shard_map over the mesh."""
+        spec = P(self.axis)
+        out_specs = spec if nout == 1 else tuple([spec] * nout)
+        f = jax.shard_map(per_rank_fn, mesh=self.mesh, in_specs=spec,
+                          out_specs=out_specs)
+        return jax.jit(f)
+
+    # -- named-tensor tracking (duplicate detection, stall) ----------------
+
+    def _begin(self, name: Optional[str], kind: str):
+        if name is None:
+            # Auto-name unnamed tensors (reference: framework bindings name
+            # anonymous tensors "allreduce.noname.N", e.g. torch/mpi_ops.py)
+            # so timeline/stall tracking still sees them.
+            with self._names_lock:
+                self._noname_seq += 1
+                name = f"noname.{self._noname_seq}"
+        full = f"{kind}.{name}"
+        # Re-submitting a name whose previous op is still completing is the
+        # normal steady-state for a named collective in a training loop
+        # (completion is async) — serialize briefly; only a genuinely stuck
+        # predecessor is an error (reference: common.h:163-166
+        # DUPLICATE_NAME_ERROR on concurrent submission).
+        deadline = time.monotonic() + self.duplicate_wait_seconds
+        while True:
+            with self._names_lock:
+                if full not in self._inflight_names:
+                    self._inflight_names.add(full)
+                    break
+            if time.monotonic() > deadline:
+                raise DuplicateTensorNameError(
+                    f"tensor {full} re-submitted while a previous submission "
+                    "never completed (reference: common.h:163-166)")
+            time.sleep(0.001)
+        if self.stall is not None:
+            self.stall.record_submit(full)
+        if self.timeline is not None:
+            self.timeline.begin(full, kind.upper())
+        return full
+
+    def _end(self, full: Optional[str]):
+        if full is None:
+            return
+        with self._names_lock:
+            self._inflight_names.discard(full)
+        if self.stall is not None:
+            self.stall.record_complete(full)
+        if self.timeline is not None:
+            self.timeline.end(full)
+
+    def _finalize_async(self, full: Optional[str], result):
+        """Release the name / mark complete only once the result buffers are
+        actually ready on device (finalizer-thread model, see __init__)."""
+        if full is None:
+            return result
+
+        def waiter():
+            try:
+                for l in jax.tree.leaves(result):
+                    if hasattr(l, "block_until_ready"):
+                        l.block_until_ready()
+            finally:
+                self._end(full)
+
+        self._finalizers.submit(waiter)
+        return result
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, x, op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                  name: Optional[str] = None,
+                  prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0,
+                  compression=None):
+        if compression is None:
+            compression = self._default_compression
+        dt = self._as_distributed(x)
+        full = self._begin(name, "allreduce")
+        try:
+            hier = (self.config.hierarchical_allreduce
+                    and self.hier_mesh is not None
+                    and op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE))
+            key = ("ar", dt.shape, str(dt.dtype), int(op), prescale_factor,
+                   postscale_factor, compression.__name__, hier)
+
+            def build():
+                scalar_dt = jnp.dtype(self.config.adasum_scalar_dtype)
+
+                if hier:
+                    ca, la = self.hier_mesh.axis_names
+
+                    def per_rank_h(v):
+                        w, ctx = compression.compress(v)
+                        w = C._apply_scale(w, prescale_factor)
+                        w = C.hierarchical_allreduce(w, op, la, ca)
+                        w = C._apply_scale(w, postscale_factor)
+                        return compression.decompress(w, ctx)
+
+                    spec = P((ca, la))
+                    f = jax.shard_map(per_rank_h, mesh=self.hier_mesh,
+                                      in_specs=spec, out_specs=spec)
+                    return jax.jit(f)
+
+                def per_rank(v):
+                    # v: (1, *shape) block per rank
+                    w, ctx = compression.compress(v)
+                    w = C.allreduce(w, op, self.axis, prescale_factor,
+                                    postscale_factor,
+                                    adasum_scalar_dtype=scalar_dt)
+                    return compression.decompress(w, ctx)
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def allreduce_tree(self, tree, op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                       name: Optional[str] = None,
+                       compression=None):
+        """Fused allreduce of a pytree of distributed tensors (the grouped /
+        fusion path: one collective per ≤threshold bucket)."""
+        if compression is None:
+            compression = self._default_compression
+        full = self._begin(name, "grouped_allreduce")
+        try:
+            dts = jax.tree.map(self._as_distributed, tree)
+            leaves, treedef = jax.tree.flatten(dts)
+            shapes = tuple((l.shape, str(l.dtype)) for l in leaves)
+            key = ("art", shapes, int(op), compression.__name__,
+                   self.config.fusion_threshold_bytes)
+
+            def build():
+                def per_rank(*ls):
+                    def one(flat):
+                        w, ctx = compression.compress(flat)
+                        w = C.allreduce(w, op, self.axis)
+                        return compression.decompress(w, ctx)
+                    squeezed = [l.reshape(l.shape[1:]) for l in ls]
+                    out = fusion_lib.fused_apply(
+                        list(squeezed), one,
+                        self.config.fusion_threshold_bytes)
+                    return tuple(o[None] for o in out)
+
+                spec = P(self.axis)
+                f = jax.shard_map(
+                    per_rank, mesh=self.mesh,
+                    in_specs=tuple([spec] * len(leaves)),
+                    out_specs=tuple([spec] * len(leaves)))
+                return jax.jit(lambda ls: f(*ls))
+
+            out_leaves = self._compiled(key, build)(leaves)
+            out = jax.tree.unflatten(treedef, list(out_leaves))
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def allgather(self, x, name: Optional[str] = None):
+        """Each rank's (m_r, ...) tensor -> concatenated (sum m_r, ...) on
+        every rank. Input is rank-major with possibly ragged rows expressed
+        as a list of per-rank arrays, or an even (size, m, ...) array."""
+        full = self._begin(name, "allgather")
+        try:
+            if isinstance(x, (list, tuple)):
+                sizes = tuple(int(v.shape[0]) for v in x)
+                rest = x[0].shape[1:]
+                maxs = max(sizes)
+                padded = np.zeros((self.size, maxs) + tuple(rest),
+                                  dtype=np.asarray(x[0]).dtype)
+                for r, v in enumerate(x):
+                    padded[r, :sizes[r]] = np.asarray(v)
+                dt = self.scatter(padded)
+                key = ("agv", dt.shape, str(dt.dtype), sizes)
+
+                def build():
+                    def per_rank(v):
+                        out = C.allgatherv(v.reshape(v.shape[1:]), sizes,
+                                           self.axis)
+                        return out[None]
+                    return self._shard_mapped(per_rank)
+            else:
+                dt = self._as_distributed(x)
+                key = ("ag", dt.shape, str(dt.dtype))
+
+                def build():
+                    def per_rank(v):
+                        return C.allgather(v.reshape(v.shape[1:]),
+                                           self.axis)[None]
+                    return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def broadcast(self, x, root_rank: int = 0, name: Optional[str] = None):
+        dt = self._as_distributed(x)
+        full = self._begin(name, "broadcast")
+        try:
+            key = ("bc", dt.shape, str(dt.dtype), root_rank)
+
+            def build():
+                def per_rank(v):
+                    return C.broadcast(v, root_rank, self.axis)
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def alltoall(self, x, name: Optional[str] = None):
+        """Even all-to-all on a rank-major (size, m, ...) array where each
+        rank's m rows are split into `size` equal chunks."""
+        dt = self._as_distributed(x)
+        full = self._begin(name, "alltoall")
+        try:
+            key = ("a2a", dt.shape, str(dt.dtype))
+
+            def build():
+                def per_rank(v):
+                    return C.alltoall(v.reshape(v.shape[1:]), self.axis)[None]
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def reducescatter(self, x, op: C.ReduceOp = C.ReduceOp.SUM,
+                      name: Optional[str] = None):
+        dt = self._as_distributed(x)
+        full = self._begin(name, "reducescatter")
+        try:
+            key = ("rs", dt.shape, str(dt.dtype), int(op))
+
+            def build():
+                def per_rank(v):
+                    return C.reducescatter(v.reshape(v.shape[1:]), op,
+                                           self.axis)[None]
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
+    def barrier(self):
+        key = ("barrier",)
+
+        def build():
+            def per_rank(v):
+                return C.barrier(self.axis) * v
+            return self._shard_mapped(per_rank)
+
+        ones = self.replicate(jnp.ones((), dtype=jnp.int32))
+        self._compiled(key, build)(ones).block_until_ready()
+
+    # -- async handle surface (reference torch/mpi_ops.py:85-646) ----------
+
+    def async_call(self, fn, *args, **kwargs) -> int:
+        out = fn(*args, **kwargs)  # dispatch is async under JAX
+        return self.handles.allocate(out)
+
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int):
+        return self.handles.synchronize(handle)
+
+    def cache_info(self):
+        with self._cache_lock:
+            return {"entries": len(self._cache),
+                    "capacity": self.config.cache_capacity}

@@ -1,0 +1,193 @@
+"""DistributedOptimizer — the gradient-averaging wrapper.
+
+Reference equivalents: horovod/tensorflow/__init__.py:465-561
+(DistributedOptimizer), :564-629 (DistributedGradientTape),
+horovod/torch/optimizer.py:103-207 (per-grad async allreduce hooks), and the
+local-gradient-aggregation helpers (tensorflow/gradient_aggregation.py:16)
+for ``backward_passes_per_step > 1``.
+
+TPU-native design: the optimizer is an ``optax.GradientTransformation``
+wrapper meant to run *inside* the jitted SPMD step function, where the
+reference's whole async machinery (hooks, handles, background thread) is
+unnecessary — the gradients of every rank are produced by the same traced
+program, so the wrapper simply inserts fused allreduces between ``grad()``
+and ``update()`` and lets XLA overlap them with remaining backprop compute
+(XLA's latency-hiding scheduler plays the role of Horovod's
+background-thread overlap).
+
+Also provides ``DistributedGradFn`` (the DistributedGradientTape analog):
+wraps ``jax.grad``/``jax.value_and_grad`` results with the same reduction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .common import fusion as fusion_lib
+from .ops import collectives as C
+from .ops.compression import NoneCompressor
+
+
+def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
+                 fusion_threshold: int, prescale: float = 1.0,
+                 postscale: float = 1.0, hierarchical: bool = False,
+                 local_axis: str = "local", cross_axis: str = "cross"):
+    """Fused (bucketed) allreduce of a gradient pytree over the mesh axis."""
+
+    def one(flat):
+        w, ctx = compression.compress(flat)
+        if op == C.ReduceOp.ADASUM:
+            from .ops import adasum as adasum_lib
+
+            if hierarchical:
+                w = adasum_lib.adasum_hierarchical(w, local_axis, cross_axis)
+            else:
+                w = adasum_lib.adasum_allreduce(w, axis_name)
+            w = C._apply_scale(w, postscale)
+        elif hierarchical:
+            w = C._apply_scale(w, prescale)
+            nl = jax.lax.axis_size(local_axis)
+            w, n = fusion_lib.pad_to_multiple(w, nl)
+            w = C.hierarchical_allreduce_staged(w, op, local_axis, cross_axis)
+            w = jax.lax.slice_in_dim(w, 0, n)
+            w = C._apply_scale(w, postscale)
+        else:
+            w = C.allreduce(w, op, axis_name, prescale, postscale)
+        return compression.decompress(w, ctx)
+
+    return fusion_lib.fused_apply(grads, one, fusion_threshold)
+
+
+class _AggState(NamedTuple):
+    inner: Any
+    acc: Any          # local gradient accumulator
+    counter: jnp.ndarray
+
+
+def DistributedOptimizer(optimizer,
+                         op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                         axis_name: str = "hvd",
+                         compression=NoneCompressor,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0,
+                         fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                         hierarchical: bool = False,
+                         local_axis: str = "local",
+                         cross_axis: str = "cross"):
+    """Wrap an optax optimizer so ``update()`` allreduces gradients first.
+
+    Use inside the jitted step function running under
+    shard_map/pjit over the rank axis::
+
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd")
+
+    ``backward_passes_per_step`` accumulates k local microbatch gradients
+    before one fused allreduce + inner update (reference
+    gradient_aggregation.py semantics: allreduce every k-th call, identity
+    updates in between).
+    """
+    try:
+        import optax
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("DistributedOptimizer requires optax") from e
+
+    k = int(backward_passes_per_step)
+
+    def reduce_grads(grads):
+        return _reduce_tree(grads, op, axis_name, compression,
+                            fusion_threshold_bytes, prescale_factor,
+                            postscale_factor, hierarchical, local_axis,
+                            cross_axis)
+
+    if k <= 1:
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            reduced = reduce_grads(grads)
+            return optimizer.update(reduced, state, params, **extra)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    def init_fn(params):
+        acc = jax.tree.map(jnp.zeros_like, params)
+        return _AggState(inner=optimizer.init(params), acc=acc,
+                         counter=jnp.zeros((), jnp.int32))
+
+    def update_fn(grads, state, params=None, **extra):
+        acc = jax.tree.map(jnp.add, state.acc, grads)
+        counter = state.counter + 1
+        do_step = counter >= k
+
+        def take_step(args):
+            acc, inner = args
+            scale = (1.0 / k) if average_aggregated_gradients else 1.0
+            scaled = jax.tree.map(lambda g: g * scale, acc) \
+                if scale != 1.0 else acc
+            reduced = reduce_grads(scaled)
+            updates, new_inner = optimizer.update(reduced, inner, params,
+                                                  **extra)
+            zeroed = jax.tree.map(jnp.zeros_like, acc)
+            return updates, new_inner, zeroed
+
+        def skip_step(args):
+            acc, inner = args
+            updates = jax.tree.map(jnp.zeros_like, acc)
+            return updates, inner, acc
+
+        updates, new_inner, new_acc = jax.lax.cond(
+            do_step, take_step, skip_step, (acc, state.inner))
+        new_counter = jnp.where(do_step, 0, counter)
+        return updates, _AggState(new_inner, new_acc, new_counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedGradFn(grad_fn: Callable,
+                      op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                      axis_name: str = "hvd",
+                      compression=NoneCompressor,
+                      fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                      has_value: bool = False,
+                      reduce_value: bool = True):
+    """DistributedGradientTape analog (reference
+    tensorflow/__init__.py:564-629): wraps a function returning gradients
+    (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
+
+    ``has_value=True`` declares the wrapped function follows the
+    ``jax.value_and_grad`` convention ``(value, grads)``; the value is
+    additionally averaged across ranks when ``reduce_value``. Explicit flag
+    instead of tuple-sniffing so ``jax.grad(loss, argnums=(0, 1))`` (a
+    tuple of gradients) is never misclassified.
+    """
+
+    def wrapped(*args, **kwargs):
+        out = grad_fn(*args, **kwargs)
+        if has_value:
+            val, grads = out
+            grads = _reduce_tree(grads, op, axis_name, compression,
+                                 fusion_threshold_bytes)
+            if reduce_value:
+                val = jax.tree.map(
+                    lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
+                    val)
+            return val, grads
+        return _reduce_tree(out, op, axis_name, compression,
+                            fusion_threshold_bytes)
+
+    return wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         axis_name: str = "hvd"):
+    """Broadcast a parameter pytree from root to all ranks — for use inside
+    the jitted init path (reference: torch/functions.py:30
+    broadcast_parameters / tensorflow broadcast_variables)."""
+    return jax.tree.map(
+        lambda p: C.broadcast(p, root_rank, axis_name), params)

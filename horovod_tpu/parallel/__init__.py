@@ -1,0 +1,2 @@
+"""Parallelism strategies over the collective primitive set: mesh builders,
+sequence parallelism (ring attention, Ulysses), expert parallel, pipeline."""

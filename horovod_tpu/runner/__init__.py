@@ -1,0 +1,2 @@
+"""Launcher: hvdtpurun CLI, rendezvous KV server, host assignment, elastic
+driver."""

@@ -1,0 +1,34 @@
+"""Test harness: force an 8-virtual-device CPU mesh before JAX backend init.
+
+This is the "loopback backend" tier of the reference's test pyramid
+(SURVEY.md §4): multi-rank correctness on one machine, here as 8 XLA CPU
+devices standing in for 8 TPU chips. Must run before any jax backend
+initialization — pytest imports conftest before test modules.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("HVD_TPU_FORCE_CPU_DEVICES", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.size() == 8, f"expected 8 virtual ranks, got {hvd.size()}"
+    return hvd
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,62 @@
+"""Adasum numerics — checked against a NumPy model of the recursion, the
+same strategy the reference uses (test/parallel/test_adasum_pytorch.py
+checks VHDD against a NumPy implementation of the formula)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import adasum
+
+
+def _run_adasum(hvd, stacked):
+    ctx = hvd.init()
+    f = jax.jit(jax.shard_map(
+        lambda v: adasum.adasum_allreduce(v, ctx.config.rank_axis),
+        mesh=ctx.mesh, in_specs=P(ctx.config.rank_axis),
+        out_specs=P(ctx.config.rank_axis)))
+    return np.asarray(f(hvd.scatter(stacked)))
+
+
+def test_adasum_matches_numpy_reference(hvd, rng):
+    x = rng.standard_normal((8, 1, 50)).astype(np.float32)
+    out = _run_adasum(hvd, x)
+    expected = adasum.adasum_allreduce_reference([x[r, 0] for r in range(8)])
+    for r in range(8):
+        np.testing.assert_allclose(out[r, 0], expected, rtol=1e-4, atol=1e-4)
+
+
+def test_adasum_identical_inputs_average(hvd):
+    # Parallel gradients -> adasum degenerates to average (the defining
+    # property: a==b gives coef 1-1/2 each, sum = a).
+    x = np.tile(np.linspace(1, 2, 16, dtype=np.float32), (8, 1, 1))
+    out = _run_adasum(hvd, x)
+    np.testing.assert_allclose(out[0], x[0], rtol=1e-5)
+
+
+def test_adasum_orthogonal_inputs_sum(hvd):
+    # Orthogonal gradients -> plain sum (dot = 0 -> coefs 1).
+    x = np.zeros((8, 1, 8), dtype=np.float32)
+    for r in range(8):
+        x[r, 0, r] = float(r + 1)
+    out = _run_adasum(hvd, x)
+    np.testing.assert_allclose(out[0, 0], x.sum(axis=0)[0], rtol=1e-5)
+
+
+def test_adasum_via_reduce_op(hvd, rng):
+    x = rng.standard_normal((8, 24)).astype(np.float32)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Adasum))
+    expected = adasum.adasum_allreduce_reference([x[r] for r in range(8)])
+    np.testing.assert_allclose(out[0], expected, rtol=1e-4, atol=1e-4)
+
+
+def test_adasum_reference_power_of_two_only():
+    with pytest.raises(AssertionError):
+        adasum.adasum_allreduce_reference([np.ones(3)] * 3)
+
+
+def test_adasum_zero_inputs(hvd):
+    out = _run_adasum(hvd, np.zeros((8, 4), dtype=np.float32))
+    np.testing.assert_array_equal(out, np.zeros((8, 4), dtype=np.float32))

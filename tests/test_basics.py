@@ -1,0 +1,47 @@
+"""Lifecycle + topology tests (reference analog: init/rank/size checks at
+the top of test/parallel/test_tensorflow.py and common/basics.py)."""
+
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    ctx1 = hvd.init()
+    ctx2 = hvd.init()
+    assert ctx1 is ctx2
+
+
+def test_rank_size(hvd):
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.is_homogeneous()
+
+
+def test_mesh(hvd):
+    m = hvd.mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == (hvd.rank_axis(),)
+
+
+def test_scatter_gather_roundtrip(hvd, rng):
+    x = rng.standard_normal((8, 3, 5)).astype(np.float32)
+    dt = hvd.scatter(x)
+    assert dt.shape == (8, 3, 5)
+    back = hvd.gather(dt)
+    np.testing.assert_array_equal(back, x)
+
+
+def test_scatter_wrong_size(hvd):
+    with pytest.raises(Exception):
+        hvd.scatter(np.zeros((5, 2), dtype=np.float32))
+
+
+def test_not_initialized_error():
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    if not hvd.is_initialized():
+        with pytest.raises(hvd.NotInitializedError):
+            basics.context()

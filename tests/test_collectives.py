@@ -1,0 +1,220 @@
+"""Eager collective correctness — the core suite.
+
+Modeled on the reference's test/parallel/test_tensorflow.py (2706 LoC):
+every collective × dtype × op × prescale/postscale, grouped/fused paths,
+error cases. Ranks are the 8 virtual CPU devices.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+
+DTYPES = [np.float32, np.float64, np.int32]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum(hvd, rng, dtype):
+    x = (rng.standard_normal((8, 4, 7)) * 10).astype(dtype)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Sum))
+    expected = x.sum(axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_average(hvd, rng):
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Average))
+    expected = x.mean(axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_allreduce_min_max_product(hvd, rng):
+    x = rng.standard_normal((8, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Min))[0],
+        x.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(
+        hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Max))[3],
+        x.max(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(
+        hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Product))[7],
+        np.prod(x, axis=0), rtol=1e-4)
+
+
+def test_allreduce_prescale_postscale(hvd, rng):
+    # Reference: prescale/postscale factors applied around the sum
+    # (test_tensorflow.py prescale/postscale cases).
+    x = rng.standard_normal((8, 6)).astype(np.float32)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Sum,
+                                   prescale_factor=0.5,
+                                   postscale_factor=2.0))
+    np.testing.assert_allclose(out[0], (0.5 * x).sum(axis=0) * 2.0,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_replicated_input(hvd):
+    # Plain array == every rank holds the same tensor.
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = hvd.gather(hvd.allreduce(x, op=hvd.Sum))
+    np.testing.assert_allclose(out[0], x * 8)
+
+
+def test_allreduce_fp16_compression(hvd, rng):
+    x = rng.standard_normal((8, 32)).astype(np.float32)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Average,
+                                   compression=hvd.Compression.fp16))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out[0], x.mean(axis=0), rtol=1e-2, atol=1e-2)
+
+
+def test_grouped_allreduce_fusion(hvd, rng):
+    # Fusion path: tree of mixed-size tensors reduced in buckets
+    # (reference: grouped allreduce + FuseResponses).
+    tree = {
+        "a": rng.standard_normal((8, 3)).astype(np.float32),
+        "b": rng.standard_normal((8, 100)).astype(np.float32),
+        "c": rng.standard_normal((8, 2, 5)).astype(np.float32),
+    }
+    dts = {k: hvd.scatter(v) for k, v in tree.items()}
+    out = hvd.grouped_allreduce(dts, op=hvd.Average)
+    for k in tree:
+        np.testing.assert_allclose(hvd.gather(out[k])[0],
+                                   tree[k].mean(axis=0),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_allgather_even(hvd, rng):
+    x = rng.standard_normal((8, 2, 3)).astype(np.float32)
+    out = hvd.gather(hvd.allgather(hvd.scatter(x)))
+    # Every rank receives concat of all ranks' (2,3) slices -> (16,3).
+    expected = x.reshape(16, 3)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-6)
+
+
+def test_allgather_variable_sizes(hvd, rng):
+    # Reference: allgather with different dim-0 across ranks
+    # (test_tensorflow.py test_horovod_allgather_variable_size).
+    sizes = [1, 3, 2, 5, 4, 1, 2, 3]
+    parts = [rng.standard_normal((s, 4)).astype(np.float32) for s in sizes]
+    out = hvd.gather(hvd.allgather(parts))
+    expected = np.concatenate(parts, axis=0)
+    assert out.shape[1:] == expected.shape
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(hvd, rng, root):
+    x = rng.standard_normal((8, 5, 2)).astype(np.float32)
+    out = hvd.gather(hvd.broadcast(hvd.scatter(x), root_rank=root))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], x[root], rtol=1e-6)
+
+
+def test_broadcast_int(hvd):
+    x = np.arange(64, dtype=np.int32).reshape(8, 8)
+    out = hvd.gather(hvd.broadcast(hvd.scatter(x), root_rank=5))
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], x[5])
+
+
+def test_alltoall_even(hvd):
+    # rank r sends chunk d to rank d; received chunk s came from rank s.
+    # x[r] has 8 chunks of 2 rows each, value = 100*r + dest.
+    n, chunk = 8, 2
+    x = np.zeros((n, n * chunk, 3), dtype=np.float32)
+    for r in range(n):
+        for d in range(n):
+            x[r, d * chunk:(d + 1) * chunk] = 100 * r + d
+    out = hvd.gather(hvd.alltoall(hvd.scatter(x)))
+    for r in range(n):
+        for s in range(n):
+            np.testing.assert_allclose(out[r, s * chunk:(s + 1) * chunk],
+                                       100 * s + r)
+
+
+def test_reducescatter(hvd, rng):
+    x = rng.standard_normal((8, 16, 3)).astype(np.float32)
+    out = hvd.gather(hvd.reducescatter(hvd.scatter(x), op=hvd.Sum))
+    total = x.sum(axis=0)  # (16, 3)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], total[r * 2:(r + 1) * 2],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_barrier(hvd):
+    hvd.barrier()  # must not deadlock or raise
+
+
+def test_async_handles(hvd, rng):
+    # Reference: torch/mpi_ops.py allreduce_async_ + poll + synchronize.
+    x = rng.standard_normal((8, 10)).astype(np.float32)
+    h = hvd.allreduce_async(hvd.scatter(x), op=hvd.Average)
+    assert isinstance(h, int)
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(hvd.gather(out)[0], x.mean(axis=0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compile_cache_reuse(hvd, rng):
+    e = hvd.init().engine
+    before = e.cache_info()["entries"]
+    shape = (8, 123)
+    for _ in range(3):
+        hvd.allreduce(hvd.scatter(
+            rng.standard_normal(shape).astype(np.float32)), op=hvd.Sum)
+    after = e.cache_info()["entries"]
+    assert after <= before + 1  # one signature -> one cache entry
+
+
+def test_duplicate_name_rejected(hvd, rng):
+    # Reference: DUPLICATE_NAME_ERROR (common.h:163-166). A name whose
+    # previous submission never completes must eventually be rejected.
+    from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+    e = hvd.init().engine
+    e._inflight_names.add("allreduce.dup")
+    old_wait = e.duplicate_wait_seconds
+    e.duplicate_wait_seconds = 0.05
+    try:
+        with pytest.raises(DuplicateTensorNameError):
+            x = hvd.scatter(rng.standard_normal((8, 2)).astype(np.float32))
+            e.allreduce(x, name="dup")
+    finally:
+        e.duplicate_wait_seconds = old_wait
+        e._inflight_names.discard("allreduce.dup")
+
+
+def test_named_reuse_across_steps(hvd, rng):
+    # The steady-state pattern: same name every training step must NOT
+    # raise (completion is async; _begin serializes on the finalizer).
+    for _ in range(5):
+        x = hvd.scatter(rng.standard_normal((8, 4)).astype(np.float32))
+        hvd.allreduce(x, name="grad_bucket_0")
+
+
+def test_join_allreduce(hvd, rng):
+    # Join semantics: departed ranks contribute zeros, average divides by
+    # active count (reference JoinOp).
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops import collectives as C
+
+    ctx = hvd.init()
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    joined = np.array([0, 0, 1, 0, 0, 1, 0, 0], dtype=np.int32)
+
+    f = jax.jit(jax.shard_map(
+        lambda v, j: C.join_allreduce(v, j.reshape(()), C.ReduceOp.AVERAGE,
+                                      ctx.config.rank_axis),
+        mesh=ctx.mesh, in_specs=P(ctx.config.rank_axis),
+        out_specs=P(ctx.config.rank_axis)))
+    out = np.asarray(f(hvd.scatter(x), hvd.scatter(joined)))
+    active = joined == 0
+    expected = x[active].sum(axis=0) / active.sum()
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,73 @@
+"""Fusion planner/bucketing unit tests (reference analog: FuseResponses
+threshold behavior, controller.cc:686-809)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from horovod_tpu.common import fusion
+
+
+def _tree(rng):
+    return {
+        "w1": jnp.asarray(rng.standard_normal((4, 4)).astype(np.float32)),
+        "b1": jnp.asarray(rng.standard_normal((4,)).astype(np.float32)),
+        "w2": jnp.asarray(rng.standard_normal((10, 10)).astype(np.float32)),
+        "i": jnp.arange(6, dtype=jnp.int32),
+    }
+
+
+def test_plan_respects_threshold(rng):
+    tree = _tree(rng)
+    # 4 bytes/elem; threshold of 64 bytes = 16 f32 elems per bucket.
+    plan = fusion.plan_fusion(tree, threshold_bytes=64)
+    for b in plan.buckets:
+        if str(b.dtype) == "float32":
+            # w2 alone (100 elems) must exceed but still occupy one bucket.
+            assert b.total_elems <= 16 or len(b.leaf_indices) == 1
+
+
+def test_plan_groups_by_dtype(rng):
+    plan = fusion.plan_fusion(_tree(rng), threshold_bytes=1 << 20)
+    dtypes = [str(b.dtype) for b in plan.buckets]
+    assert "int32" in dtypes and "float32" in dtypes
+    # Big threshold: all f32 leaves fuse into one bucket.
+    f32 = [b for b in plan.buckets if str(b.dtype) == "float32"]
+    assert len(f32) == 1 and len(f32[0].leaf_indices) == 3
+
+
+def test_fuse_unfuse_roundtrip(rng):
+    tree = _tree(rng)
+    plan = fusion.plan_fusion(tree, threshold_bytes=128)
+    flats = fusion.fuse(tree, plan)
+    back = fusion.unfuse(flats, plan)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_apply_identity(rng):
+    tree = _tree(rng)
+    out = fusion.fused_apply(tree, lambda f: f, threshold_bytes=64)
+    import jax
+
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_apply_scale(rng):
+    tree = _tree(rng)
+    out = fusion.fused_apply(
+        {k: v for k, v in tree.items() if v.dtype == jnp.float32},
+        lambda f: f * 2.0, threshold_bytes=64)
+    for k, v in out.items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(tree[k]) * 2.0,
+                                   rtol=1e-6)
+
+
+def test_pad_to_multiple():
+    flat = jnp.arange(10, dtype=jnp.float32)
+    padded, n = fusion.pad_to_multiple(flat, 8)
+    assert padded.shape[0] == 16 and n == 10
+    padded2, n2 = fusion.pad_to_multiple(jnp.arange(16.0), 8)
+    assert padded2.shape[0] == 16 and n2 == 16
