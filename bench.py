@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark — the reference's
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py re-built for TPU
+(same methodology: synthetic ImageNet-shaped data, timed batches after
+warmup, img/sec; reference prints "Img/sec per GPU", :121-131).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "img/s", "vs_baseline": N}
+
+Baseline: the reference's published tf_cnn_benchmarks ResNet-101 example
+(docs/benchmarks.rst:32-43) runs 1656.82 img/s on 16 P100s = 103.55
+img/s/GPU; we use that per-device number as vs_baseline denominator.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--batches-per-iter", type=int, default=5)
+    p.add_argument("--model", default="resnet50")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50, ResNet101
+
+    hvd.init()
+    n = hvd.size()
+
+    model = {"resnet50": ResNet50, "resnet101": ResNet101}[args.model](
+        num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(
+        rng, (args.batch_size, args.image_size, args.image_size, 3),
+        dtype=jnp.bfloat16)
+    labels = jax.random.randint(rng, (args.batch_size,), 0, 1000)
+
+    variables = model.init(rng, images, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Reference benchmark uses plain SGD lr=0.01 wrapped in
+    # DistributedOptimizer; same here (fused allreduce over the rank axis).
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                  axis_name=hvd.rank_axis())
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs, x, y):
+        logits, new_model_state = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, new_model_state["batch_stats"]
+
+    if n > 1:
+        from jax.sharding import PartitionSpec as P
+
+        ax = hvd.rank_axis()
+
+        @hvd.spmd_step(in_specs=(P(), P(), P(), P(ax), P(ax)),
+                       out_specs=(P(), P(), P(), P()))
+        def train_step(p, bs, st, x, y):
+            # x/y blocks: the per-rank slice of the global batch.
+            (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                p, bs, x, y)
+            # BatchNorm stats averaged across ranks (SyncBatchNorm-lite).
+            new_bs = jax.tree.map(
+                lambda v: jax.lax.pmean(v, ax), new_bs)
+            updates, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, updates)
+            return p, new_bs, st, jax.lax.pmean(l, ax)
+    else:
+        @jax.jit
+        def train_step(p, bs, st, x, y):
+            (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                p, bs, x, y)
+            updates, st = tx.update(g, st, p)
+            p = optax.apply_updates(p, updates)
+            return p, new_bs, st, l
+
+    def run_batch():
+        nonlocal params, batch_stats, opt_state
+        params, batch_stats, opt_state, l = train_step(
+            params, batch_stats, opt_state, images, labels)
+        return l
+
+    # Warmup (includes compile).
+    for _ in range(args.num_warmup):
+        run_batch().block_until_ready()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.batches_per_iter):
+            l = run_batch()
+        l.block_until_ready()
+        dt = time.perf_counter() - t0
+        img_secs.append(args.batch_size * args.batches_per_iter / dt)
+
+    val = float(np.mean(img_secs))
+    baseline_per_device = 1656.82 / 16.0
+    print(json.dumps({
+        "metric": f"{args.model}_images_per_sec_per_chip",
+        "value": round(val, 2),
+        "unit": "img/s",
+        "vs_baseline": round(val / baseline_per_device, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import threading
 from typing import List, Optional, Sequence
 
@@ -66,7 +67,40 @@ class Context:
                                   timeline=self.timeline,
                                   stall_inspector=self.stall,
                                   hier_mesh=self.hier_mesh)
+        # Elastic host-update channel: poll the driver's rendezvous KV
+        # topology version (reference: WorkerNotificationClient,
+        # elastic/worker.py). Consumed by State.check_host_updates().
+        self.host_update_notifier = None
+        rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
+        if config.elastic and rdv:
+            self.host_update_notifier = self._make_host_update_notifier(rdv)
         self._shutdown = False
+
+    @staticmethod
+    def _make_host_update_notifier(rdv_addr: str):
+        from ..runner.rendezvous import RendezvousClient
+
+        host, port = rdv_addr.rsplit(":", 1)
+        client = RendezvousClient(host, int(port), timeout_s=5.0)
+        last_seen = {"v": None}
+
+        def notifier() -> bool:
+            try:
+                raw = client.get("elastic", "topology_version")
+            except OSError:
+                return False
+            if raw is None:
+                return False
+            v = raw.decode()
+            if last_seen["v"] is None:
+                last_seen["v"] = v
+                return False
+            if v != last_seen["v"]:
+                last_seen["v"] = v
+                return True
+            return False
+
+        return notifier
 
     # -- reference C-ABI query surface (operations.cc:690-878) -------------
 

@@ -1,0 +1,201 @@
+"""Controller — cross-rank coordination & validation for eager collectives.
+
+Reference: horovod/common/controller.cc:63-358 (ComputeResponseList) — a
+rank-0 coordinator gathers per-rank Requests, waits until every rank has
+submitted a tensor, validates shape/dtype/op consistency, fuses, and
+broadcasts Responses. It exists because TF/PyTorch processes issue
+gradients asynchronously in nondeterministic order.
+
+TPU-native role: under single-controller JAX the submitting program is
+SPMD, so ordering is deterministic and negotiation is vacuous — the
+compile cache (eager.py) plays the ResponseCache role. In *multi-process*
+mode (one Python process per host), XLA collectives still require every
+process to issue the same program in the same order; a mismatch deadlocks
+the ICI/DCN collective with no diagnostics. This controller is the guard
+rail: before dispatching a new eager collective signature, ranks publish a
+Request to the coordination KV store, rank 0 validates that all ranks
+submitted a *matching* signature (same op, shape, dtype — the reference's
+ConstructResponse checks, controller.cc:380-657) and publishes a Response;
+mismatches produce a clear error on every rank instead of a hang. Repeat
+signatures skip the round entirely (the ResponseCache fast path,
+response_cache.h:45-100).
+
+The transport is pluggable so the protocol is unit-testable with an
+in-memory store (the reference tests Controller with mocked comms the same
+way — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .exceptions import HorovodInternalError, TensorShapeMismatchError
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """Reference: message.h:48-113 (Request: rank, type, dtype, shape,
+    name, root_rank, ...)."""
+
+    rank: int
+    op_type: str          # "allreduce" | "allgather" | ...
+    tensor_name: str
+    dtype: str
+    shape: Tuple[int, ...]
+    reduce_op: int = 0
+    root_rank: int = -1
+
+    def signature(self) -> str:
+        return json.dumps([self.op_type, self.tensor_name, self.dtype,
+                           list(self.shape), self.reduce_op, self.root_rank])
+
+
+@dataclasses.dataclass
+class Response:
+    """Reference: message.h:145-244 (Response: type, names, error)."""
+
+    ok: bool
+    tensor_name: str
+    error: str = ""
+
+
+class KVTransport:
+    """Abstract blocking KV store used for the negotiation round."""
+
+    def set(self, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, timeout_s: float) -> Optional[str]:
+        raise NotImplementedError
+
+
+class InMemoryTransport(KVTransport):
+    """Single-process/loopback transport for tests: all ranks share a dict
+    (the Gloo-rendezvous role in the reference test tier)."""
+
+    def __init__(self):
+        self._data: Dict[str, str] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: str) -> None:
+        with self._cond:
+            self._data[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout_s: float) -> Optional[str]:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            return self._data[key]
+
+
+class JaxKVTransport(KVTransport):
+    """Production transport over the JAX coordination-service KV store
+    (the HTTP-KV/gloo-rendezvous replacement — SURVEY.md §5 'Distributed
+    communication backend')."""
+
+    def set(self, key: str, value: str) -> None:
+        from jax._src import distributed as jdist
+
+        jdist.global_state.client.key_value_set(key, value)
+
+    def get(self, key: str, timeout_s: float) -> Optional[str]:
+        from jax._src import distributed as jdist
+
+        try:
+            return jdist.global_state.client.blocking_key_value_get(
+                key, int(timeout_s * 1000))
+        except Exception as e:
+            # Only a KV timeout means "rank didn't submit"; any other
+            # failure (dead coordinator, connection loss) must surface as
+            # itself, not masquerade as a program-order divergence.
+            msg = str(e).upper()
+            if "DEADLINE" in msg or "TIMEOUT" in msg or "NOT_FOUND" in msg:
+                return None
+            raise HorovodInternalError(
+                f"coordination-service KV failure for {key}: {e}") from e
+
+
+class Controller:
+    """Negotiates one eager-collective signature across processes."""
+
+    def __init__(self, rank: int, size: int, transport: KVTransport,
+                 timeout_s: float = 60.0, namespace: str = "hvd_tpu/ctl"):
+        self.rank = rank
+        self.size = size
+        self.transport = transport
+        self.timeout_s = timeout_s
+        self.ns = namespace
+        self._round = 0
+        self._cache: set = set()
+        self._lock = threading.Lock()
+
+    def negotiate(self, req: Request) -> Response:
+        """Validate that every rank submitted a matching request.
+
+        Fast path: a signature seen before returns immediately (cache hit —
+        no KV round; reference response_cache fast path controller.cc:133-203).
+        """
+        sig = req.signature()
+        with self._lock:
+            if sig in self._cache:
+                return Response(True, req.tensor_name)
+            rnd = self._round
+            self._round += 1
+
+        if self.size == 1:
+            with self._lock:
+                self._cache.add(sig)
+            return Response(True, req.tensor_name)
+
+        key_base = f"{self.ns}/{rnd}"
+        self.transport.set(f"{key_base}/req/{self.rank}", sig)
+
+        if self.rank == 0:
+            # Coordinator: gather all requests (MPI_Gatherv analog,
+            # mpi_controller.cc:134), validate, publish the response
+            # (MPI_Bcast analog, :158).
+            error = ""
+            for r in range(self.size):
+                other = self.transport.get(f"{key_base}/req/{r}",
+                                           self.timeout_s)
+                if other is None:
+                    error = (f"rank {r} did not submit a collective within "
+                             f"{self.timeout_s}s (stalled or diverged "
+                             "program order)")
+                    break
+                if other != sig:
+                    error = (f"rank {r} submitted a mismatched collective: "
+                             f"expected {sig}, got {other} (reference: "
+                             "controller.cc:390-621 validation)")
+                    break
+            resp = Response(not error, req.tensor_name, error)
+            self.transport.set(f"{key_base}/resp",
+                               json.dumps(dataclasses.asdict(resp)))
+        else:
+            raw = self.transport.get(f"{key_base}/resp", self.timeout_s)
+            if raw is None:
+                raise HorovodInternalError(
+                    f"controller response timeout after {self.timeout_s}s "
+                    f"for {req.tensor_name}")
+            d = json.loads(raw)
+            resp = Response(d["ok"], d["tensor_name"], d.get("error", ""))
+
+        if resp.ok:
+            with self._lock:
+                self._cache.add(sig)
+        else:
+            raise TensorShapeMismatchError(resp.error)
+        return resp
+
+    def cache_size(self) -> int:
+        with self._lock:
+            return len(self._cache)

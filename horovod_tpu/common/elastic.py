@@ -1,0 +1,184 @@
+"""Elastic training — fault-tolerant retry loop + state commit/restore.
+
+Reference: horovod/common/elastic.py (framework-agnostic State with
+save/restore/sync/commit + the ``run_fn`` retry loop :147-168) and the
+per-framework states (torch/elastic/state.py:27,
+tensorflow/elastic.py:91-213).
+
+TPU-native shape of the problem: a preempted TPU-VM / resized slice means
+the device mesh changes, which under XLA means the step function must be
+**re-compiled against the new mesh** — so a reset tears down the whole
+Context (mirroring the reference's full C++ core re-init on reset,
+torch/elastic/__init__.py:46) and user code re-enters the train function
+with restored state. JaxState holds pytrees (params/opt state) in host
+memory; commit() snapshots, restore() rolls back after a collective
+failure, sync() broadcasts rank-0's state after a topology change.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class State:
+    """Base state object (reference common/elastic.py State)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages: list = []
+        self._reset_callbacks: list = []
+        self._saved: Optional[Dict[str, Any]] = None
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        """Snapshot + check for host updates (reference elastic.py:60-93:
+        commit = save + check_host_updates)."""
+        self.save()
+        self.check_host_updates()
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if the driver reported new/removed
+        hosts (reference elastic.py:60-93)."""
+        from . import basics
+
+        if not basics.is_initialized():
+            return
+        notifier = getattr(basics.context(), "host_update_notifier", None)
+        if notifier is not None and notifier():
+            raise HostsUpdatedInterrupt()
+
+
+class ObjectState(State):
+    """State holding arbitrary picklable attributes (reference:
+    common/elastic.py ObjectState)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._attrs = dict(kwargs)
+        for k, v in kwargs.items():
+            object.__setattr__(self, k, v)
+        self.save()
+
+    def __setattr__(self, k, v):
+        if not k.startswith("_") and hasattr(self, "_attrs"):
+            self._attrs[k] = v
+        object.__setattr__(self, k, v)
+
+    def save(self) -> None:
+        self._saved = copy.deepcopy(
+            {k: getattr(self, k) for k in self._attrs})
+
+    def restore(self) -> None:
+        assert self._saved is not None
+        for k, v in copy.deepcopy(self._saved).items():
+            object.__setattr__(self, k, v)
+            self._attrs[k] = v
+
+    def sync(self) -> None:
+        from ..functions import broadcast_object
+
+        synced = broadcast_object(
+            {k: getattr(self, k) for k in self._attrs}, root_rank=0,
+            name="elastic_state")
+        for k, v in synced.items():
+            object.__setattr__(self, k, v)
+            self._attrs[k] = v
+        self.save()
+
+
+class JaxState(ObjectState):
+    """State for JAX pytrees (params / opt_state / step ...). Device arrays
+    are snapshotted to host numpy so restore survives a mesh teardown —
+    the torch TorchState.save analog (torch/elastic/state.py:50-64) where
+    tensors are cloned out of the training graph."""
+
+    def _to_host(self, tree):
+        import jax
+
+        # copy=True: np.asarray would alias numpy-backed leaves, letting
+        # later in-place mutation corrupt the committed snapshot.
+        return jax.tree.map(lambda v: np.array(v, copy=True), tree)
+
+    def save(self) -> None:
+        self._saved = {k: self._to_host(getattr(self, k))
+                       for k in self._attrs}
+
+    def restore(self) -> None:
+        assert self._saved is not None
+        for k, v in self._saved.items():
+            restored = self._to_host(v)  # copy: keep the snapshot pristine
+            object.__setattr__(self, k, restored)
+            self._attrs[k] = restored
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: elastic retry loop (reference common/elastic.py:147-168).
+
+    while True:
+        state.sync()
+        try: return func(state, ...)
+        except HorovodInternalError: state.restore()   # peer died
+        except HostsUpdatedInterrupt: pass             # topology changed
+        reset(); state.on_reset()
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        from . import basics
+
+        reset_limit = int(__import__("os").environ.get(
+            "HVD_TPU_ELASTIC_RESET_LIMIT", "100"))
+        resets = 0
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                logger.warning("elastic: collective failure (%s); rolling "
+                               "back to last commit", e)
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                logger.info("elastic: hosts updated; re-initializing")
+                skip_sync = e.skip_sync
+            resets += 1
+            if resets > reset_limit:
+                raise RuntimeError(
+                    f"elastic reset limit ({reset_limit}) exceeded")
+            _reset(basics)
+            state.on_reset()
+
+    return wrapper
+
+
+def _reset(basics_mod) -> None:
+    """Tear down and re-init the runtime against the (possibly changed)
+    topology — the full-reinit-on-reset semantics of the reference
+    (torch/elastic/__init__.py:46)."""
+    if basics_mod.is_initialized():
+        basics_mod.shutdown()
+    basics_mod.init()

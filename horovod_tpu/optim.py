@@ -32,11 +32,34 @@ from .ops import collectives as C
 from .ops.compression import NoneCompressor
 
 
+def _axes_bound(*axes) -> bool:
+    """True iff all mesh axis names are bound in the current trace (i.e. we
+    are inside shard_map/pmap over them). Probed once, narrowly, so a
+    genuine NameError inside user compressors/optimizers still raises."""
+    try:
+        for a in axes:
+            jax.lax.axis_size(a)
+        return True
+    except NameError:
+        return False
+
+
 def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
                  fusion_threshold: int, prescale: float = 1.0,
                  postscale: float = 1.0, hierarchical: bool = False,
                  local_axis: str = "local", cross_axis: str = "cross"):
-    """Fused (bucketed) allreduce of a gradient pytree over the mesh axis."""
+    """Fused (bucketed) allreduce of a gradient pytree over the mesh axis.
+
+    Outside an SPMD region (axis names unbound) the reduction degenerates
+    to size-1 reference semantics: no cross-rank sum, but pre/post scaling
+    still applies (the reference applies ScaleBuffer regardless of world
+    size). Under jit/pjit auto-sharding XLA already inserts the
+    cross-device reduction itself — a manual psum there would
+    double-reduce.
+    """
+    needed_axes = ((local_axis, cross_axis) if hierarchical
+                   else (axis_name,))
+    bound = _axes_bound(*needed_axes)
 
     def one(flat):
         w, ctx = compression.compress(flat)
@@ -59,7 +82,14 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
             w = C.allreduce(w, op, axis_name, prescale, postscale)
         return compression.decompress(w, ctx)
 
-    return fusion_lib.fused_apply(grads, one, fusion_threshold)
+    def identity_with_scales(flat):
+        w, ctx = compression.compress(flat)
+        w = C._apply_scale(w, prescale)
+        w = C._apply_scale(w, postscale)
+        return compression.decompress(w, ctx)
+
+    fn = one if bound else identity_with_scales
+    return fusion_lib.fused_apply(grads, fn, fusion_threshold)
 
 
 class _AggState(NamedTuple):
@@ -173,7 +203,7 @@ def DistributedGradFn(grad_fn: Callable,
             val, grads = out
             grads = _reduce_tree(grads, op, axis_name, compression,
                                  fusion_threshold_bytes)
-            if reduce_value:
+            if reduce_value and _axes_bound(axis_name):
                 val = jax.tree.map(
                     lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
                     val)

@@ -1,0 +1,302 @@
+"""Elastic driver — host discovery, worker lifecycle, rank stability.
+
+Reference: horovod/runner/elastic/driver.py:68-309 (ElasticDriver),
+discovery.py:25-164 (HostManager + pluggable HostDiscovery / discovery
+script), registration.py (WorkerStateRegistry). Semantics preserved:
+
+* a discovery source is polled every ``discovery_interval`` seconds;
+* on host set changes, workers are notified (HostsUpdatedInterrupt on
+  their side at the next commit());
+* rank assignment keeps surviving workers' ranks stable, filling gaps
+  with new hosts (driver.py _update_host_assignments);
+* hosts whose workers fail are blacklisted (driver.py blacklist logic);
+* the job continues while >= min_np slots are available.
+
+On TPU the "hosts" are TPU-VM workers; preemption looks like a host
+disappearing from the discovery source (e.g. the GCE instance list or a
+queued-resource status probe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from . import hosts as hosts_lib
+from .launch import build_env_for_slot, run_local
+from .rendezvous import RendezvousServer
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class HostDiscovery:
+    """Pluggable discovery source (reference discovery.py:25-60)."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class FixedHostDiscovery(HostDiscovery):
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class ScriptHostDiscovery(HostDiscovery):
+    """Discovery via a user script printing 'hostname:slots' lines
+    (reference discovery.py HostDiscoveryScript; the integration tests
+    mutate the script's output to simulate host churn — elastic_common.py).
+    """
+
+    def __init__(self, script: str, timeout_s: float = 30.0):
+        self._script = script
+        self._timeout_s = timeout_s
+        self._last: Dict[str, int] = {}
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        # A hung or transiently failing script must not kill the discovery
+        # thread or wipe the host set — fall back to the last good answer
+        # (the reference's HostManager likewise only applies *successful*
+        # discovery results).
+        try:
+            out = subprocess.run([self._script], capture_output=True,
+                                 text=True, timeout=self._timeout_s)
+        except (subprocess.TimeoutExpired, OSError) as e:
+            logger.warning("elastic: discovery script failed (%s); keeping "
+                           "last known hosts", e)
+            return dict(self._last)
+        if out.returncode != 0:
+            logger.warning("elastic: discovery script exited %d; keeping "
+                           "last known hosts", out.returncode)
+            return dict(self._last)
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                name, slots = line.rsplit(":", 1)
+                hosts[name] = int(slots)
+            else:
+                hosts[line] = 1
+        self._last = dict(hosts)
+        return hosts
+
+
+@dataclasses.dataclass
+class HostState:
+    slots: int
+    blacklisted: bool = False
+
+
+class HostManager:
+    """Tracks current/blacklisted hosts (reference discovery.py:61-164).
+
+    The blacklist is a persistent, separate set: a failed host that drops
+    out of discovery and later reappears stays blacklisted (the reference
+    excludes blacklisted hosts permanently)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._hosts: Dict[str, HostState] = {}
+        self._blacklist: Set[str] = set()
+        self._lock = threading.Lock()
+
+    def update_available_hosts(self) -> bool:
+        """Poll discovery; returns True if the usable host set changed."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            changed = False
+            for name, slots in found.items():
+                usable = name not in self._blacklist
+                if name not in self._hosts:
+                    self._hosts[name] = HostState(slots)
+                    changed = changed or usable
+                elif self._hosts[name].slots != slots:
+                    self._hosts[name].slots = slots
+                    changed = changed or usable
+            for name in list(self._hosts):
+                if name not in found:
+                    del self._hosts[name]
+                    changed = changed or name not in self._blacklist
+            return changed
+
+    def blacklist(self, hostname: str) -> None:
+        with self._lock:
+            self._blacklist.add(hostname)
+        logger.warning("elastic: blacklisted host %s", hostname)
+
+    def current_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: h.slots for n, h in self._hosts.items()
+                    if n not in self._blacklist}
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+
+class ElasticDriver:
+    """Discovery loop + stable rank assignment (reference driver.py:68-309).
+    """
+
+    def __init__(self, discovery: HostDiscovery, min_np: int, max_np: int,
+                 discovery_interval: float = 1.0):
+        self.host_manager = HostManager(discovery)
+        self.min_np = min_np
+        self.max_np = max_np
+        self.discovery_interval = discovery_interval
+        self._assignments: Dict[str, List[hosts_lib.SlotInfo]] = {}
+        self._shutdown = threading.Event()
+        self._host_change = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- discovery loop (reference driver.py:90-92, 1 s poll) -------------
+
+    def start_discovery(self) -> None:
+        self.host_manager.update_available_hosts()
+
+        def loop():
+            while not self._shutdown.is_set():
+                if self.host_manager.update_available_hosts():
+                    self._host_change.set()
+                self._shutdown.wait(self.discovery_interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def hosts_updated(self) -> bool:
+        """Consumed by workers' check_host_updates()."""
+        if self._host_change.is_set():
+            self._host_change.clear()
+            return True
+        return False
+
+    def wait_for_available_slots(self, min_np: Optional[int] = None,
+                                 timeout_s: float = 600.0) -> Dict[str, int]:
+        """Block until >= min_np slots exist (reference driver.py:139-160).
+        """
+        need = min_np if min_np is not None else self.min_np
+        deadline = time.monotonic() + timeout_s
+        while True:
+            hosts = self.host_manager.current_hosts()
+            if sum(hosts.values()) >= need:
+                return hosts
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fewer than min_np={need} slots available after "
+                    f"{timeout_s}s")
+            self.host_manager.update_available_hosts()
+            time.sleep(self.discovery_interval)
+
+    # -- rank assignment (reference driver.py _update_host_assignments) ---
+
+    def update_assignments(self) -> List[hosts_lib.SlotInfo]:
+        """Re-assign ranks, keeping existing hosts' ranks stable."""
+        hosts = self.host_manager.current_hosts()
+        with self._lock:
+            prev_order = [h for h in self._assignments if h in hosts]
+            new_hosts = [h for h in hosts if h not in self._assignments]
+            ordered = prev_order + sorted(new_hosts)
+            np_total = min(self.max_np,
+                           sum(hosts[h] for h in ordered))
+            infos = hosts_lib.get_host_assignments(
+                [hosts_lib.HostInfo(h, hosts[h]) for h in ordered], np_total)
+            self._assignments = {}
+            for s in infos:
+                self._assignments.setdefault(s.hostname, []).append(s)
+            return infos
+
+    def record_failure(self, hostname: str) -> None:
+        self.host_manager.blacklist(hostname)
+        self._host_change.set()
+
+
+def run_elastic(args, command: List[str],
+                env_extra: Dict[str, str]) -> int:
+    """Driver-side elastic launch (reference gloo_run_elastic
+    gloo_run.py:326 + launch.py:616): workers restart with fresh topology
+    env until success or the reset limit / min-np floor is hit.
+
+    The driver runs a rendezvous KV server and publishes a monotonically
+    increasing ``topology/version`` on every host-set change; workers poll
+    it at commit() points (Context.host_update_notifier) and raise
+    HostsUpdatedInterrupt for graceful re-rendezvous — the reference's
+    WorkerNotificationClient channel (elastic/worker.py).
+
+    Local-process implementation: the worker set is re-forked on every
+    topology change; real multi-host ssh fan-out reuses the same loop with
+    run_ssh per epoch.
+    """
+    min_np = args.min_np or args.num_proc
+    max_np = args.max_np or args.num_proc
+    if args.host_discovery_script:
+        discovery: HostDiscovery = ScriptHostDiscovery(
+            args.host_discovery_script)
+    else:
+        host_infos = (hosts_lib.parse_hosts(args.hosts) if args.hosts
+                      else [hosts_lib.HostInfo("localhost", max_np)])
+        discovery = FixedHostDiscovery(
+            {h.hostname: h.slots for h in host_infos})
+
+    driver = ElasticDriver(discovery, min_np, max_np)
+    driver.start_discovery()
+    rdv = RendezvousServer("127.0.0.1")
+    rdv_port = rdv.start()
+    topo_version = 0
+    rdv.put("elastic", "topology_version", str(topo_version).encode())
+    env_extra = dict(env_extra)
+    env_extra["HVD_TPU_RENDEZVOUS"] = f"127.0.0.1:{rdv_port}"
+
+    def bump_version():
+        nonlocal topo_version
+        topo_version += 1
+        rdv.put("elastic", "topology_version", str(topo_version).encode())
+
+    try:
+        attempts = 0
+        while True:
+            hosts = driver.wait_for_available_slots(min_np)
+            np_now = min(max_np, sum(hosts.values()))
+            logger.info("elastic launch attempt %d with np=%d", attempts,
+                        np_now)
+
+            # Publish topology changes while workers run.
+            stop_pub = threading.Event()
+
+            def publisher():
+                while not stop_pub.is_set():
+                    if driver.hosts_updated():
+                        bump_version()
+                    stop_pub.wait(driver.discovery_interval)
+
+            pub = threading.Thread(target=publisher, daemon=True)
+            pub.start()
+            try:
+                rc = run_local(np_now, command, env_extra)
+            finally:
+                stop_pub.set()
+                pub.join(timeout=2)
+            if rc == 0:
+                return 0
+            bump_version()
+            attempts += 1
+            if attempts > int(os.environ.get(
+                    "HVD_TPU_ELASTIC_RESET_LIMIT", "100")):
+                return rc
+    finally:
+        rdv.stop()
+        driver.stop()

@@ -1,0 +1,282 @@
+"""hvdtpurun — the launcher CLI (horovodrun equivalent).
+
+Reference: horovod/runner/launch.py:239-523 (argparse surface), :524-614
+(_run_static), gloo_run.py:65-99 (per-slot env wiring), :226-284 (fan-out,
+fail-fast). TPU-native differences:
+
+* no MPI/gloo choice — workers bootstrap through ``jax.distributed`` whose
+  coordinator runs in rank-0's process; the launcher only wires env vars
+  (HVD_TPU_COORDINATOR / NUM_PROC / PROC_ID — the HOROVOD_RANK/... analog);
+* one process **per host** (each process drives all local TPU chips; ranks
+  are per-chip inside the SPMD program), not one per GPU;
+* local mode forks subprocesses (the test/dev path — the reference's
+  localhost gloo launch); multi-host mode fans out over ssh.
+
+Config flags export the same knobs as the reference CLI
+(--fusion-threshold-mb, --cycle-time-ms, --timeline-filename, ...,
+launch.py:392-523 + config_parser.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import hosts as hosts_lib
+
+
+def build_env_for_slot(base_env: Dict[str, str], coordinator: str,
+                       num_proc: int, proc_id: int,
+                       extra: Optional[Dict[str, str]] = None
+                       ) -> Dict[str, str]:
+    """Reference: gloo_run.py:65-99 slot env construction."""
+    env = dict(base_env)
+    env["HVD_TPU_COORDINATOR"] = coordinator
+    env["HVD_TPU_NUM_PROC"] = str(num_proc)
+    env["HVD_TPU_PROC_ID"] = str(proc_id)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stream_output(proc: subprocess.Popen, tag: str) -> None:
+    """Prefix worker output with its rank tag (reference
+    safe_shell_exec.py output prefixing)."""
+    assert proc.stdout is not None
+    for line in iter(proc.stdout.readline, b""):
+        sys.stdout.write(f"[{tag}]: {line.decode(errors='replace')}")
+        sys.stdout.flush()
+
+
+def _wait_fail_fast(procs: List[subprocess.Popen],
+                    threads: List[threading.Thread],
+                    poll_interval: float = 0.1) -> int:
+    """Wait for all workers; on the FIRST non-zero exit kill the rest
+    (reference fail-fast: gloo_run.py:226-284 kills the job when any slot
+    exits non-zero). Polls all processes so a late-indexed crash is acted
+    on while earlier workers still block on their peers."""
+    rc = 0
+    try:
+        while True:
+            running = False
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    running = True
+                elif code != 0 and rc == 0:
+                    rc = code
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            if not running:
+                break
+            time.sleep(poll_interval)
+        for t in threads:
+            t.join(timeout=2)
+        return rc
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+        return 1
+
+
+def run_local(np: int, command: List[str], env_extra: Dict[str, str],
+              verbose: bool = False) -> int:
+    """Fork np local worker processes (the localhost-gloo analog)."""
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+    for i in range(np):
+        env = build_env_for_slot(dict(os.environ), coordinator, np, i,
+                                 env_extra)
+        p = subprocess.Popen(command, env=env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_stream_output, args=(p, str(i)),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    return _wait_fail_fast(procs, threads)
+
+
+def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
+            env_extra: Dict[str, str], np: int,
+            verbose: bool = False,
+            ssh_port: Optional[int] = None) -> int:
+    """One process per *used* host over ssh (reference gloo_run ssh
+    fan-out). TPU model: ``-np`` requests total slots (chips); a host's
+    process drives all of that host's assigned chips, so the process count
+    is the number of hosts covering ``np`` slots — unlike local mode which
+    forks one process per slot. Rank-0 host runs the jax.distributed
+    coordinator."""
+    slots = hosts_lib.get_host_assignments(host_infos, np)
+    used_hosts: List[str] = []
+    for s in slots:
+        if s.hostname not in used_hosts:
+            used_hosts.append(s.hostname)
+    num_proc = len(used_hosts)
+    coord = f"{used_hosts[0]}:{_free_port()}"
+    procs = []
+    threads = []
+    for i, hostname in enumerate(used_hosts):
+        env = build_env_for_slot({}, coord, num_proc, i, env_extra)
+        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \
+            " ".join(shlex.quote(c) for c in command)
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh_cmd += ["-p", str(ssh_port)]
+        ssh_cmd += [hostname, remote_cmd]
+        p = subprocess.Popen(ssh_cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_stream_output,
+                             args=(p, hostname), daemon=True)
+        t.start()
+        threads.append(t)
+    return _wait_fail_fast(procs, threads)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdtpurun",
+        description="Launch a horovod_tpu training job "
+                    "(horovodrun equivalent for TPU).")
+    p.add_argument("-np", "--num-proc", type=int, default=1,
+                   help="number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="host list, e.g. host1:4,host2:4")
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile with 'hostname slots=N' lines")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("--version", action="store_true")
+    # Knob flags -> env (reference launch.py:392-523 / config_parser.py).
+    p.add_argument("--fusion-threshold-mb", type=float, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--stall-check-time-seconds", type=float, default=None)
+    p.add_argument("--stall-shutdown-time-seconds", type=float, default=None)
+    p.add_argument("--no-stall-check", action="store_true")
+    p.add_argument("--compression", default=None,
+                   choices=["none", "fp16", "bf16"])
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--log-level", default=None)
+    # Elastic (reference launch.py elastic flags).
+    p.add_argument("--elastic", action="store_true")
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p.parse_args(argv)
+
+
+def knob_env(args: argparse.Namespace) -> Dict[str, str]:
+    env = {}
+    if args.fusion_threshold_mb is not None:
+        env["HVD_TPU_FUSION_THRESHOLD"] = str(
+            int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.cycle_time_ms is not None:
+        env["HVD_TPU_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HVD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.hierarchical_allreduce:
+        env["HVD_TPU_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.timeline_filename:
+        env["HVD_TPU_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HVD_TPU_TIMELINE_MARK_CYCLES"] = "1"
+    if args.stall_check_time_seconds is not None:
+        env["HVD_TPU_STALL_CHECK_TIME_SECONDS"] = str(
+            args.stall_check_time_seconds)
+    if args.stall_shutdown_time_seconds is not None:
+        env["HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS"] = str(
+            args.stall_shutdown_time_seconds)
+    if args.no_stall_check:
+        env["HVD_TPU_STALL_CHECK_DISABLE"] = "1"
+    if args.compression:
+        env["HVD_TPU_COMPRESSION_DTYPE"] = args.compression
+    if args.autotune:
+        env["HVD_TPU_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HVD_TPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.log_level:
+        env["HVD_TPU_LOG_LEVEL"] = args.log_level
+    if args.elastic:
+        env["HVD_TPU_ELASTIC"] = "1"
+    return env
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return 0
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdtpurun: no command given", file=sys.stderr)
+        return 2
+
+    env_extra = knob_env(args)
+
+    if args.elastic:
+        from .elastic_driver import run_elastic
+
+        return run_elastic(args, command, env_extra)
+
+    if args.hostfile:
+        host_infos = hosts_lib.parse_host_files(args.hostfile)
+    elif args.hosts:
+        host_infos = hosts_lib.parse_hosts(args.hosts)
+    else:
+        host_infos = None
+
+    if host_infos is not None:
+        # Validate np against available slots (reference: horovodrun errors
+        # on -np > slots rather than oversubscribing, hosts.py:100).
+        hosts_lib.get_host_assignments(host_infos, args.num_proc)
+
+    if host_infos is None or all(
+            h.hostname in ("localhost", "127.0.0.1", socket.gethostname())
+            for h in host_infos):
+        return run_local(args.num_proc, command, env_extra, args.verbose)
+    return run_ssh(host_infos, command, env_extra, args.num_proc,
+                   args.verbose, args.ssh_port)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""Rendezvous HTTP KV server.
+
+Reference: horovod/runner/http/http_server.py:35-234 — a threading HTTP
+server exposing a scoped GET/PUT/DELETE KV store, used for gloo rendezvous
+and elastic coordination. The TPU runtime's *data plane* does not need it
+(jax.distributed has its own coordination service), but the launcher and
+elastic driver do: slot handout, worker heartbeats, host-update
+notification — so the same minimal KV protocol is provided.
+
+Protocol: PUT /kv/<scope>/<key> (body = value bytes), GET returns 200+body
+or 404, DELETE removes. GET /kv/<scope>?list=1 returns JSON key list.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlparse, parse_qs
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "HvdTpuRendezvous/0.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _store(self) -> Dict[str, bytes]:
+        return self.server.kv_store  # type: ignore[attr-defined]
+
+    def _lock(self) -> threading.Lock:
+        return self.server.kv_lock  # type: ignore[attr-defined]
+
+    def do_PUT(self):
+        path = urlparse(self.path).path
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self._lock():
+            self._store()[path] = body
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        qs = parse_qs(parsed.query)
+        with self._lock():
+            if qs.get("list"):
+                prefix = parsed.path.rstrip("/") + "/"
+                keys = [k[len(prefix):] for k in self._store()
+                        if k.startswith(prefix)]
+                data = json.dumps(sorted(keys)).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            val = self._store().get(parsed.path)
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_DELETE(self):
+        path = urlparse(self.path).path
+        with self._lock():
+            existed = self._store().pop(path, None) is not None
+        self.send_response(200 if existed else 404)
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Reference: http/http_server.py RendezvousServer (start/stop,
+    ephemeral port)."""
+
+    def __init__(self, host: str = "0.0.0.0"):
+        self._host = host
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, port: int = 0) -> int:
+        self._server = ThreadingHTTPServer((self._host, port), _Handler)
+        self._server.kv_store = {}          # type: ignore[attr-defined]
+        self._server.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # Direct (in-process) access for the driver side.
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        assert self._server is not None
+        with self._server.kv_lock:  # type: ignore[attr-defined]
+            self._server.kv_store[f"/kv/{scope}/{key}"] = value  # type: ignore
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        assert self._server is not None
+        with self._server.kv_lock:  # type: ignore[attr-defined]
+            return self._server.kv_store.get(f"/kv/{scope}/{key}")  # type: ignore
+
+
+class RendezvousClient:
+    """Worker-side client (reference: http/http_client.py)."""
+
+    def __init__(self, addr: str, port: int, timeout_s: float = 30.0):
+        self.base = f"http://{addr}:{port}"
+        self.timeout_s = timeout_s
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base}/kv/{scope}/{key}", data=value, method="PUT")
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        import urllib.error
+        import urllib.request
+
+        try:
+            return urllib.request.urlopen(
+                f"{self.base}/kv/{scope}/{key}",
+                timeout=self.timeout_s).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def wait(self, scope: str, key: str,
+             timeout_s: float = 60.0) -> bytes:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            val = self.get(scope, key)
+            if val is not None:
+                return val
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"rendezvous key {scope}/{key} not set "
+                                   f"within {timeout_s}s")
+            time.sleep(0.05)
+
+    def list(self, scope: str) -> list:
+        import urllib.request
+
+        data = urllib.request.urlopen(
+            f"{self.base}/kv/{scope}?list=1", timeout=self.timeout_s).read()
+        return json.loads(data)
+
+    def delete(self, scope: str, key: str) -> None:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base}/kv/{scope}/{key}", method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        except urllib.error.HTTPError:
+            pass

@@ -1,0 +1,101 @@
+"""Controller protocol unit tests with the in-memory transport —
+the mocked-comms tier of the reference test strategy (Controller tested
+without a real cluster; SURVEY.md §4)."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.common.controller import (Controller, InMemoryTransport,
+                                           Request)
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           TensorShapeMismatchError)
+
+
+def _req(rank, name="t", shape=(4,), dtype="float32", op=0):
+    return Request(rank=rank, op_type="allreduce", tensor_name=name,
+                   dtype=dtype, shape=tuple(shape), reduce_op=op)
+
+
+def _run_ranks(n, make_req, timeout=5.0):
+    """Run n controller ranks on threads; returns per-rank result/exc."""
+    transport = InMemoryTransport()
+    ctls = [Controller(r, n, transport, timeout_s=timeout) for r in range(n)]
+    results = [None] * n
+    errors = [None] * n
+
+    def work(r):
+        try:
+            results[r] = ctls[r].negotiate(make_req(r))
+        except Exception as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=work, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 5)
+    return ctls, results, errors
+
+
+def test_matching_requests_succeed():
+    ctls, results, errors = _run_ranks(4, lambda r: _req(r))
+    assert all(e is None for e in errors)
+    assert all(r is not None and r.ok for r in results)
+
+
+def test_cache_fast_path():
+    transport = InMemoryTransport()
+    c = Controller(0, 1, transport)
+    c.negotiate(_req(0))
+    assert c.cache_size() == 1
+    # Second negotiation of the same signature: no new round.
+    rnd_before = c._round
+    c.negotiate(_req(0))
+    assert c._round == rnd_before
+
+
+def test_shape_mismatch_detected():
+    def make(r):
+        return _req(r, shape=(4,) if r != 2 else (5,))
+
+    ctls, results, errors = _run_ranks(4, make)
+    # Rank 0 (coordinator) raises; others receive the error response.
+    assert any(isinstance(e, TensorShapeMismatchError) for e in errors)
+
+
+def test_dtype_mismatch_detected():
+    def make(r):
+        return _req(r, dtype="float32" if r != 1 else "bfloat16")
+
+    _, _, errors = _run_ranks(2, make)
+    assert any(isinstance(e, TensorShapeMismatchError) for e in errors)
+
+
+def test_op_mismatch_detected():
+    def make(r):
+        return _req(r, op=0 if r != 3 else 1)
+
+    _, _, errors = _run_ranks(4, make)
+    assert any(isinstance(e, TensorShapeMismatchError) for e in errors)
+
+
+def test_missing_rank_times_out():
+    transport = InMemoryTransport()
+    n = 2
+    c0 = Controller(0, n, transport, timeout_s=0.2)
+    # Rank 1 never submits; coordinator must error, not hang.
+    with pytest.raises(TensorShapeMismatchError):
+        c0.negotiate(_req(0))
+
+
+def test_non_coordinator_timeout():
+    transport = InMemoryTransport()
+    c1 = Controller(1, 2, transport, timeout_s=0.2)
+    with pytest.raises(HorovodInternalError):
+        c1.negotiate(_req(1))
+
+
+def test_size_one_trivial():
+    c = Controller(0, 1, InMemoryTransport())
+    assert c.negotiate(_req(0)).ok

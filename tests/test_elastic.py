@@ -1,0 +1,191 @@
+"""Elastic tests — mocked HostDiscovery with simulated host churn
+(reference: test/single/test_elastic_driver.py:488 — rank stability,
+blacklist, min_np waits) and State save/restore without a cluster
+(test_torch_elastic.py analog)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.common import elastic as elastic_lib
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               FixedHostDiscovery,
+                                               HostDiscovery, HostManager)
+
+
+class MutableDiscovery(HostDiscovery):
+    """Mock discovery whose host set tests mutate mid-run (reference
+    test_elastic_driver mock discovery objects)."""
+
+    def __init__(self, hosts):
+        self.hosts = dict(hosts)
+        self.lock = threading.Lock()
+
+    def find_available_hosts_and_slots(self):
+        with self.lock:
+            return dict(self.hosts)
+
+    def set_hosts(self, hosts):
+        with self.lock:
+            self.hosts = dict(hosts)
+
+
+# -- HostManager -----------------------------------------------------------
+
+def test_host_manager_change_detection():
+    d = MutableDiscovery({"a": 2})
+    hm = HostManager(d)
+    assert hm.update_available_hosts()          # first poll = change
+    assert not hm.update_available_hosts()      # steady state
+    d.set_hosts({"a": 2, "b": 2})
+    assert hm.update_available_hosts()
+    assert hm.current_hosts() == {"a": 2, "b": 2}
+    d.set_hosts({"b": 2})
+    assert hm.update_available_hosts()
+    assert hm.current_hosts() == {"b": 2}
+
+
+def test_host_manager_blacklist():
+    hm = HostManager(FixedHostDiscovery({"a": 2, "b": 2}))
+    hm.update_available_hosts()
+    hm.blacklist("a")
+    assert hm.current_hosts() == {"b": 2}
+    assert hm.is_blacklisted("a")
+
+
+# -- ElasticDriver rank stability (reference test_elastic_driver.py) -------
+
+def test_rank_stability_on_host_join():
+    d = MutableDiscovery({"a": 2, "b": 2})
+    drv = ElasticDriver(d, min_np=2, max_np=8, discovery_interval=0.05)
+    drv.host_manager.update_available_hosts()
+    first = drv.update_assignments()
+    ranks_a = [s.rank for s in first if s.hostname == "a"]
+    d.set_hosts({"a": 2, "b": 2, "c": 2})
+    drv.host_manager.update_available_hosts()
+    second = drv.update_assignments()
+    # a and b keep their ranks; c fills the new ones.
+    assert [s.rank for s in second if s.hostname == "a"] == ranks_a
+    assert [s.rank for s in second if s.hostname == "c"] == [4, 5]
+
+
+def test_rank_stability_on_host_loss():
+    d = MutableDiscovery({"a": 2, "b": 2, "c": 2})
+    drv = ElasticDriver(d, min_np=2, max_np=6, discovery_interval=0.05)
+    drv.host_manager.update_available_hosts()
+    drv.update_assignments()
+    d.set_hosts({"a": 2, "c": 2})
+    drv.host_manager.update_available_hosts()
+    second = drv.update_assignments()
+    # Surviving hosts keep relative order; ranks re-pack to 0..3.
+    assert sorted(s.rank for s in second) == [0, 1, 2, 3]
+    a_ranks = [s.rank for s in second if s.hostname == "a"]
+    assert a_ranks == [0, 1]  # 'a' was first before, stays first
+
+
+def test_blacklisted_host_excluded_from_assignment():
+    d = MutableDiscovery({"a": 2, "b": 2})
+    drv = ElasticDriver(d, min_np=2, max_np=4, discovery_interval=0.05)
+    drv.host_manager.update_available_hosts()
+    drv.update_assignments()
+    drv.record_failure("b")
+    infos = drv.update_assignments()
+    assert all(s.hostname == "a" for s in infos)
+
+
+def test_wait_for_available_slots_timeout():
+    drv = ElasticDriver(FixedHostDiscovery({"a": 1}), min_np=4, max_np=4,
+                        discovery_interval=0.01)
+    with pytest.raises(TimeoutError):
+        drv.wait_for_available_slots(timeout_s=0.2)
+
+
+def test_wait_for_available_slots_unblocks():
+    d = MutableDiscovery({})
+    drv = ElasticDriver(d, min_np=2, max_np=4, discovery_interval=0.01)
+
+    def add_later():
+        time.sleep(0.1)
+        d.set_hosts({"a": 2})
+
+    threading.Thread(target=add_later, daemon=True).start()
+    hosts = drv.wait_for_available_slots(timeout_s=5.0)
+    assert hosts == {"a": 2}
+
+
+def test_discovery_loop_sets_change_flag():
+    d = MutableDiscovery({"a": 2})
+    drv = ElasticDriver(d, min_np=1, max_np=4, discovery_interval=0.02)
+    drv.start_discovery()
+    try:
+        assert not drv.hosts_updated()
+        d.set_hosts({"a": 2, "b": 2})
+        deadline = time.monotonic() + 2.0
+        while not drv.hosts_updated():
+            assert time.monotonic() < deadline, "change never detected"
+            time.sleep(0.01)
+    finally:
+        drv.stop()
+
+
+# -- State commit/restore/sync (reference test_torch_elastic.py analog) ----
+
+def test_object_state_save_restore():
+    s = elastic_lib.ObjectState(step=0, lr=0.1)
+    s.step = 5
+    s.commit()
+    s.step = 9
+    s.restore()
+    assert s.step == 5 and s.lr == 0.1
+
+
+def test_jax_state_snapshots_to_host(hvd):
+    import jax.numpy as jnp
+
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
+    s = elastic_lib.JaxState(params=params, step=0)
+    s.params = {"w": jnp.arange(4.0) * 2, "b": jnp.ones(2)}
+    s.commit()
+    s.params = {"w": jnp.zeros(4), "b": jnp.zeros(2)}
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]),
+                               np.arange(4.0) * 2)
+
+
+def test_elastic_run_retry_loop(hvd):
+    """The @hvd.elastic.run retry semantics: internal error -> restore;
+    hosts updated -> re-init; then success (reference
+    common/elastic.py:147-168)."""
+    calls = {"n": 0}
+    state = elastic_lib.ObjectState(step=0)
+
+    @elastic_lib.run
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            st.step = 99  # uncommitted progress, must roll back
+            raise HorovodInternalError("peer died")
+        if calls["n"] == 2:
+            assert st.step == 0, "rollback failed"
+            raise HostsUpdatedInterrupt()
+        return st.step
+
+    assert train(state) == 0
+    assert calls["n"] == 3
+
+
+def test_elastic_reset_limit(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_ELASTIC_RESET_LIMIT", "2")
+    state = elastic_lib.ObjectState(step=0)
+
+    @elastic_lib.run
+    def always_fail(st):
+        raise HorovodInternalError("forever broken")
+
+    with pytest.raises(RuntimeError, match="reset limit"):
+        always_fail(state)

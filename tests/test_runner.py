@@ -1,0 +1,131 @@
+"""Launcher-tier tests (reference: test/single/test_run.py — CLI parsing,
+host parsing, slot assignment; test_service.py — services over localhost).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_lib
+from horovod_tpu.runner import launch as launch_lib
+from horovod_tpu.runner.rendezvous import RendezvousClient, RendezvousServer
+
+
+# -- hosts (reference hosts.py tests in test_run.py) -----------------------
+
+def test_parse_hosts():
+    hs = hosts_lib.parse_hosts("a:4,b:2,c")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 4), ("b", 2),
+                                                  ("c", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("node1 slots=4\n# comment\nnode2 slots=2\nnode3\n")
+    hs = hosts_lib.parse_host_files(str(f))
+    assert [(h.hostname, h.slots) for h in hs] == [("node1", 4),
+                                                  ("node2", 2), ("node3", 1)]
+
+
+def test_host_assignments():
+    hs = hosts_lib.parse_hosts("a:4,b:4")
+    slots = hosts_lib.get_host_assignments(hs, 6)
+    assert len(slots) == 6
+    assert [s.rank for s in slots] == list(range(6))
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3, 0, 1]
+    assert [s.cross_rank for s in slots] == [0, 0, 0, 0, 1, 1]
+    assert all(s.size == 6 for s in slots)
+    assert slots[0].local_size == 4 and slots[5].local_size == 2
+    assert all(s.cross_size == 2 for s in slots)
+
+
+def test_host_assignments_too_many():
+    with pytest.raises(ValueError):
+        hosts_lib.get_host_assignments(hosts_lib.parse_hosts("a:2"), 5)
+
+
+# -- CLI parsing (reference launch.py parse_args tests) --------------------
+
+def test_cli_parse_knobs():
+    args = launch_lib.parse_args(
+        ["-np", "4", "--fusion-threshold-mb", "32", "--cycle-time-ms", "2",
+         "--timeline-filename", "/tmp/t.json", "--compression", "bf16",
+         "--no-stall-check", "--", "python", "train.py"])
+    env = launch_lib.knob_env(args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_TPU_CYCLE_TIME"] == "2.0"
+    assert env["HVD_TPU_TIMELINE"] == "/tmp/t.json"
+    assert env["HVD_TPU_COMPRESSION_DTYPE"] == "bf16"
+    assert env["HVD_TPU_STALL_CHECK_DISABLE"] == "1"
+    assert args.num_proc == 4
+    assert args.command[-2:] == ["python", "train.py"]
+
+
+def test_slot_env():
+    env = launch_lib.build_env_for_slot({}, "1.2.3.4:999", 8, 3)
+    assert env["HVD_TPU_COORDINATOR"] == "1.2.3.4:999"
+    assert env["HVD_TPU_NUM_PROC"] == "8"
+    assert env["HVD_TPU_PROC_ID"] == "3"
+
+
+# -- rendezvous KV server (reference test_service.py analog) ---------------
+
+def test_rendezvous_put_get_delete():
+    srv = RendezvousServer("127.0.0.1")
+    port = srv.start()
+    try:
+        cli = RendezvousClient("127.0.0.1", port)
+        assert cli.get("scope", "k") is None
+        cli.put("scope", "k", b"value")
+        assert cli.get("scope", "k") == b"value"
+        assert cli.list("scope") == ["k"]
+        cli.put("scope", "k2", b"v2")
+        assert sorted(cli.list("scope")) == ["k", "k2"]
+        cli.delete("scope", "k")
+        assert cli.get("scope", "k") is None
+        # driver-side direct access
+        srv.put("scope", "k3", b"v3")
+        assert cli.get("scope", "k3") == b"v3"
+    finally:
+        srv.stop()
+
+
+def test_rendezvous_wait_timeout():
+    srv = RendezvousServer("127.0.0.1")
+    port = srv.start()
+    try:
+        cli = RendezvousClient("127.0.0.1", port)
+        with pytest.raises(TimeoutError):
+            cli.wait("s", "missing", timeout_s=0.3)
+    finally:
+        srv.stop()
+
+
+# -- local multi-process launch (reference test_static_run.py analog) ------
+
+@pytest.mark.slow
+def test_run_local_multiprocess(tmp_path):
+    """Real 2-process launch: workers check their env wiring and exit."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        assert os.environ["HVD_TPU_NUM_PROC"] == "2"
+        pid = int(os.environ["HVD_TPU_PROC_ID"])
+        assert os.environ["HVD_TPU_COORDINATOR"].startswith("127.0.0.1:")
+        print(f"worker {pid} ok")
+    """))
+    rc = launch_lib.run_local(2, [sys.executable, str(script)], {})
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_run_local_failure_propagates(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys; sys.exit(3 if os.environ['HVD_TPU_PROC_ID'] == '1' "
+        "else 0)")
+    rc = launch_lib.run_local(2, [sys.executable, str(script)], {})
+    assert rc != 0
