@@ -1,0 +1,179 @@
+"""Autotuning — Bayesian optimization of runtime knobs.
+
+Reference: horovod/common/parameter_manager.cc/h (+ optim/
+bayesian_optimization.cc, optim/gaussian_process.cc): tunes fusion
+threshold, cycle time, cache/hierarchical toggles by maximizing a
+bytes-per-second score with a Gaussian-process surrogate and
+expected-improvement acquisition, logging samples to HOROVOD_AUTOTUNE_LOG
+as CSV.
+
+TPU-native version: the tunables that matter under XLA are the fusion
+bucket threshold (collective launch count vs overlap granularity) and the
+hierarchical toggle; cycle time has no analog (no background thread). The
+same GP+EI machinery is implemented in NumPy over a log-spaced candidate
+grid — no LBFGS needed since the candidate space is small and discrete.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+_MB = 1024 * 1024
+DEFAULT_CANDIDATES = tuple(int(x * _MB) for x in
+                           (1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+
+class GaussianProcess:
+    """Minimal RBF-kernel GP regressor (reference gaussian_process.cc)."""
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-4):
+        self.length_scale = length_scale
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._k_inv: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d = a[:, None, :] - b[None, :, :]
+        return np.exp(-0.5 * (d ** 2).sum(-1) / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.atleast_2d(x)
+        self._y = np.asarray(y, dtype=float)
+        k = self._kernel(self._x, self._x)
+        k += self.noise * np.eye(len(self._x))
+        self._k_inv = np.linalg.inv(k)
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        assert self._x is not None
+        x = np.atleast_2d(x)
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._k_inv @ self._y
+        kss = self._kernel(x, x).diagonal()
+        var = kss - (ks @ self._k_inv * ks).sum(-1)
+        return mu, np.maximum(var, 1e-12)
+
+
+def expected_improvement(mu: np.ndarray, var: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    """EI acquisition (reference bayesian_optimization.cc)."""
+    from math import erf, sqrt
+
+    sigma = np.sqrt(var)
+    imp = mu - best - xi
+    z = np.where(sigma > 0, imp / sigma, 0.0)
+    cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+    pdf = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+    ei = imp * cdf + sigma * pdf
+    return np.where(sigma > 0, ei, 0.0)
+
+
+class Autotuner:
+    """Tunes the fusion threshold online from observed step throughput.
+
+    Usage (wired into DistributedOptimizer via config.autotune, or driven
+    manually)::
+
+        tuner = Autotuner(candidates_bytes=...)
+        while training:
+            t0 = time.perf_counter()
+            step()
+            tuner.record(bytes_reduced, time.perf_counter() - t0)
+            if tuner.ready():
+                new_threshold = tuner.suggest()
+
+    Scoring = bytes/sec, matching the reference (parameter_manager.h:42).
+    """
+
+    def __init__(self,
+                 candidates_bytes: Sequence[int] = DEFAULT_CANDIDATES,
+                 warmup_samples: int = 3,
+                 steps_per_sample: int = 10,
+                 log_file: Optional[str] = None):
+        self.candidates = list(candidates_bytes)
+        self.warmup = warmup_samples
+        self.steps_per_sample = steps_per_sample
+        self.log_file = log_file
+        self._steps = 0
+        self._warmed = 0
+        self._bytes = 0.0
+        self._secs = 0.0
+        self._samples: Dict[int, List[float]] = {}
+        self._current = self.candidates[len(self.candidates) // 2]
+        self._done = False
+        if log_file:
+            with open(log_file, "w") as f:
+                f.write("threshold_bytes,score_bytes_per_sec\n")
+
+    @property
+    def current(self) -> int:
+        return self._current
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def record(self, nbytes: float, seconds: float) -> None:
+        if self._done:
+            return
+        if self._warmed < self.warmup:
+            self._warmed += 1          # discard warmup (compile) samples
+            return
+        self._bytes += nbytes
+        self._secs += seconds
+        self._steps += 1
+
+    def ready(self) -> bool:
+        return not self._done and self._steps >= self.steps_per_sample
+
+    def _log(self, threshold: int, score: float) -> None:
+        if self.log_file:
+            with open(self.log_file, "a") as f:
+                f.write(f"{threshold},{score:.1f}\n")
+
+    def suggest(self) -> int:
+        """Finalize the current sample and pick the next threshold via
+        GP+EI; converges when EI is negligible everywhere."""
+        score = self._bytes / max(self._secs, 1e-9)
+        self._samples.setdefault(self._current, []).append(score)
+        self._log(self._current, score)
+        self._bytes = self._secs = 0.0
+        self._steps = 0
+        self._warmed = 0  # re-warm after changing threshold (recompile)
+
+        xs = np.array([[math.log2(t)] for t in self._samples])
+        ys = np.array([float(np.mean(v)) for v in self._samples.values()])
+        y_mean, y_std = ys.mean(), max(ys.std(), 1e-9)
+        gp = GaussianProcess(length_scale=1.0)
+        gp.fit(xs, (ys - y_mean) / y_std)
+
+        grid = np.array([[math.log2(t)] for t in self.candidates])
+        mu, var = gp.predict(grid)
+        best = ((ys - y_mean) / y_std).max()
+        ei = expected_improvement(mu, var, best)
+
+        untried = [i for i, t in enumerate(self.candidates)
+                   if t not in self._samples]
+        if untried:
+            # Explore the untried candidate with max EI first.
+            i = max(untried, key=lambda j: ei[j])
+        else:
+            i = int(np.argmax(ei))
+            if ei[i] < 1e-3:
+                # Converged: lock in the empirically best threshold.
+                best_t = max(self._samples,
+                             key=lambda t: float(np.mean(self._samples[t])))
+                self._current = best_t
+                self._done = True
+                logger.info("autotune converged: fusion threshold %d MiB",
+                            best_t // _MB)
+                return best_t
+        self._current = self.candidates[i]
+        return self._current

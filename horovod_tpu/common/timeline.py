@@ -36,10 +36,14 @@ UNFUSE = "MEMCPY_OUT_FUSION_BUFFER"
 
 
 class Timeline:
-    """Writes chrome-trace JSON events; safe to call from any thread."""
+    """Writes chrome-trace JSON events; safe to call from any thread.
+
+    Uses the native ring-buffer writer (horovod_tpu/native/timeline.cc —
+    the reference's lock-free-queue + writer-thread design) when the
+    native library is available; falls back to a Python queue+thread."""
 
     def __init__(self, filename: Optional[str] = None,
-                 mark_cycles: bool = False):
+                 mark_cycles: bool = False, use_native: bool = True):
         self._filename = filename
         self._mark_cycles = mark_cycles
         self._queue: "queue.Queue" = queue.Queue()
@@ -48,8 +52,24 @@ class Timeline:
         self._start_ts = time.perf_counter()
         self._pending_starts = {}
         self._lock = threading.Lock()
+        self._native = None
+        self._use_native = (use_native and
+                            os.environ.get("HVD_TPU_DISABLE_NATIVE") != "1")
         if filename:
             self.start(filename)
+
+    def _load_native(self):
+        # Deferred to start(): loading may trigger a one-time C++ build,
+        # which must not tax every hvd.init() that never enables tracing.
+        if not self._use_native:
+            return None
+        try:
+            from ..native import NativeTimelineWriter
+
+            w = NativeTimelineWriter()
+            return w if w.available else None
+        except Exception:  # pragma: no cover - native is optional
+            return None
 
     # -- runtime start/stop (reference operations.cc:720-746) -------------
 
@@ -58,6 +78,11 @@ class Timeline:
             if self._active:
                 return
             self._filename = filename
+            self._native = self._load_native()
+            if self._native is not None and self._native.start(filename):
+                self._active = True
+                return
+            self._native = None
             self._active = True
             self._thread = threading.Thread(target=self._writer, daemon=True)
             self._thread.start()
@@ -67,6 +92,9 @@ class Timeline:
             if not self._active:
                 return
             self._active = False
+            if self._native is not None:
+                self._native.stop()
+                return
         self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=5)
@@ -84,6 +112,9 @@ class Timeline:
     def begin(self, tensor_name: str, activity: str) -> None:
         if not self._active:
             return
+        if self._native is not None:
+            self._native.event(tensor_name, activity, "B", self._now_us())
+            return
         self._queue.put({"name": activity, "cat": tensor_name, "ph": "B",
                          "ts": self._now_us(), "pid": os.getpid(),
                          "tid": tensor_name})
@@ -91,12 +122,19 @@ class Timeline:
     def end(self, tensor_name: str, activity: Optional[str] = None) -> None:
         if not self._active:
             return
+        if self._native is not None:
+            self._native.event(tensor_name, activity or "", "E",
+                               self._now_us())
+            return
         self._queue.put({"name": activity or "", "cat": tensor_name,
                          "ph": "E", "ts": self._now_us(),
                          "pid": os.getpid(), "tid": tensor_name})
 
     def instant(self, name: str) -> None:
         if not self._active:
+            return
+        if self._native is not None:
+            self._native.event("marker", name, "i", self._now_us())
             return
         self._queue.put({"name": name, "ph": "i", "ts": self._now_us(),
                          "pid": os.getpid(), "tid": "marker", "s": "g"})

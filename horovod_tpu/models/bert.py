@@ -84,14 +84,22 @@ class Bert(nn.Module):
     attend_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, mask=None):
+    def __call__(self, input_ids, mask=None, positions=None):
+        """``positions``: optional (B, S) global position ids — REQUIRED
+        under sequence parallelism, where each device holds a seq shard
+        and local indices 0..S_local-1 would select the wrong embeddings
+        (pass ``idx*S_local + arange(S_local)``)."""
         emb = nn.Embed(self.vocab_size, self.hidden_size,
                        param_dtype=jnp.float32, dtype=self.dtype,
                        name="tok_emb")
         x = emb(input_ids)
         pos = self.param("pos_emb", nn.initializers.normal(0.02),
                          (self.max_len, self.hidden_size), jnp.float32)
-        x = x + pos[None, :x.shape[1]].astype(self.dtype)
+        if positions is None:
+            pe = pos[None, :x.shape[1]]
+        else:
+            pe = jnp.take(pos, positions, axis=0)
+        x = x + pe.astype(self.dtype)
         for i in range(self.num_layers):
             x = TransformerLayer(self.num_heads, self.mlp_dim, self.dtype,
                                  self.attend_fn, name=f"layer_{i}")(x, mask)

@@ -1,0 +1,233 @@
+"""Native runtime bindings (ctypes over libhvdtpu_native.so).
+
+The reference keeps its runtime core in C++ (SURVEY.md §2.1: operations,
+timeline, wire format, fusion — ~18.5k LoC); this package is the
+TPU-native counterpart for the pieces that remain host-side under XLA:
+the timeline writer (lock-free ring + writer thread), the controller wire
+format, and the fusion planner. Built on first import with the system
+toolchain; every consumer has a pure-Python fallback, so the framework
+works (slower) without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("horovod_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhvdtpu_native.so")
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _DIR, "-s"],
+                           capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            logger.warning("native build failed:\n%s", r.stderr[-2000:])
+            return False
+        return os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_attempted
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            if _build_attempted:
+                return None
+            _build_attempted = True
+            if os.environ.get("HVD_TPU_DISABLE_NATIVE") == "1":
+                return None
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native library load failed: %s", e)
+            return None
+        # Signatures.
+        lib.hvt_timeline_start.argtypes = [ctypes.c_char_p]
+        lib.hvt_timeline_start.restype = ctypes.c_int
+        lib.hvt_timeline_event.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                           ctypes.c_char, ctypes.c_double]
+        lib.hvt_timeline_event.restype = None
+        lib.hvt_timeline_stop.restype = ctypes.c_int
+        lib.hvt_timeline_dropped.restype = ctypes.c_uint64
+        lib.hvt_plan_fusion.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+        lib.hvt_plan_fusion.restype = ctypes.c_int64
+        lib.hvt_encode_request.restype = ctypes.c_int64
+        lib.hvt_encode_request.argtypes = [
+            ctypes.c_int32, ctypes.c_uint8, ctypes.c_uint8, ctypes.c_int32,
+            ctypes.c_uint8, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint8,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvt_decode_request.restype = ctypes.c_int64
+        lib.hvt_decode_request.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_char_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint8]
+        lib.hvt_encode_response.restype = ctypes.c_int64
+        lib.hvt_encode_response.argtypes = [
+            ctypes.c_uint8, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvt_decode_response.restype = ctypes.c_int64
+        lib.hvt_decode_response.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- fusion planner --------------------------------------------------------
+
+def plan_fusion_native(elem_counts: Sequence[int],
+                       dtype_codes: Sequence[int],
+                       itemsizes: Sequence[int],
+                       threshold_bytes: int) -> Optional[List[int]]:
+    """Bucket ids per leaf, or None if native is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(elem_counts)
+    ec = (ctypes.c_int64 * n)(*elem_counts)
+    dc = (ctypes.c_int32 * n)(*dtype_codes)
+    it = (ctypes.c_int32 * n)(*itemsizes)
+    out = (ctypes.c_int32 * n)()
+    lib.hvt_plan_fusion(n, ec, dc, it, threshold_bytes, out)
+    return list(out)
+
+
+# -- wire format -----------------------------------------------------------
+
+OP_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
+            "reducescatter": 4, "barrier": 5, "join": 6}
+DTYPE_CODES = {"float32": 0, "bfloat16": 1, "float16": 2, "float64": 3,
+               "int32": 4, "int64": 5, "int8": 6, "uint8": 7, "bool": 8}
+
+
+def encode_request(rank: int, op_type: str, reduce_op: int, root_rank: int,
+                   dtype: str, name: str,
+                   shape: Sequence[int]) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    ndim = len(shape)
+    shp = (ctypes.c_int64 * max(ndim, 1))(*shape) if ndim else \
+        (ctypes.c_int64 * 1)()
+    cap = 64 + len(name) + 8 * ndim
+    buf = (ctypes.c_uint8 * cap)()
+    n = lib.hvt_encode_request(
+        rank, OP_CODES[op_type], reduce_op, root_rank,
+        DTYPE_CODES.get(dtype, 0), name.encode(), shp, ndim, buf, cap)
+    if n < 0:
+        return None
+    return bytes(buf[:n])
+
+
+def decode_request(data: bytes) -> Optional[Tuple]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    rank = ctypes.c_int32()
+    op = ctypes.c_uint8()
+    rop = ctypes.c_uint8()
+    root = ctypes.c_int32()
+    dt = ctypes.c_uint8()
+    name = ctypes.create_string_buffer(65536)
+    shape = (ctypes.c_int64 * 32)()
+    ndim = ctypes.c_uint8()
+    rc = lib.hvt_decode_request(
+        buf, len(data), ctypes.byref(rank), ctypes.byref(op),
+        ctypes.byref(rop), ctypes.byref(root), ctypes.byref(dt),
+        name, 65536, shape, ctypes.byref(ndim), 32)
+    if rc != 0:
+        return None
+    op_names = {v: k for k, v in OP_CODES.items()}
+    dt_names = {v: k for k, v in DTYPE_CODES.items()}
+    op_name = op_names.get(op.value)
+    dt_name = dt_names.get(dt.value)
+    if op_name is None or dt_name is None:
+        return None  # unknown code = malformed/version-skewed message
+    return (rank.value, op_name, rop.value, root.value,
+            dt_name, name.value.decode(),
+            tuple(shape[i] for i in range(ndim.value)))
+
+
+def encode_response(ok: bool, name: str, error: str) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    cap = 16 + len(name) + len(error)
+    buf = (ctypes.c_uint8 * cap)()
+    n = lib.hvt_encode_response(1 if ok else 0, name.encode(),
+                                error.encode(), buf, cap)
+    return bytes(buf[:n]) if n >= 0 else None
+
+
+def decode_response(data: bytes) -> Optional[Tuple[bool, str, str]]:
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    ok = ctypes.c_uint8()
+    name = ctypes.create_string_buffer(65536)
+    err = ctypes.create_string_buffer(65536)
+    rc = lib.hvt_decode_response(buf, len(data), ctypes.byref(ok),
+                                 name, 65536, err, 65536)
+    if rc != 0:
+        return None
+    return bool(ok.value), name.value.decode(), err.value.decode()
+
+
+# -- timeline --------------------------------------------------------------
+
+class NativeTimelineWriter:
+    """Thin wrapper used by horovod_tpu.common.timeline.Timeline."""
+
+    def __init__(self):
+        self._lib = load()
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def start(self, path: str) -> bool:
+        return self._lib is not None and \
+            self._lib.hvt_timeline_start(path.encode()) == 0
+
+    def event(self, tid: str, name: str, phase: str, ts_us: float) -> None:
+        self._lib.hvt_timeline_event(tid.encode(), name.encode(),
+                                     phase.encode()[0], ts_us)
+
+    def stop(self) -> None:
+        if self._lib is not None:
+            self._lib.hvt_timeline_stop()
+
+    def dropped(self) -> int:
+        return int(self._lib.hvt_timeline_dropped()) if self._lib else 0

@@ -1,0 +1,43 @@
+"""SyncBatchNorm — cross-rank synchronized batch statistics.
+
+Reference: horovod/torch/sync_batch_norm.py (199 LoC: allgathers per-rank
+sum/sqsum/count and reduces) and horovod/tensorflow/sync_batch_norm.py.
+
+TPU-native: Flax's ``nn.BatchNorm`` already synchronizes moments across a
+named mesh axis via psum when ``axis_name`` is set — exactly the fused
+lowering the reference implements by hand. This wrapper pins the framework
+semantics (stats over global batch = concat of all ranks' local batches)
+and keeps the reference-parity name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose batch statistics span all ranks of
+    ``axis_name`` (use inside shard_map/pjit over that axis)."""
+
+    axis_name: str = "hvd"
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        return nn.BatchNorm(
+            use_running_average=nn.merge_param(
+                "use_running_average", self.use_running_average,
+                use_running_average),
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            axis_name=self.axis_name,
+            name="bn")(x)

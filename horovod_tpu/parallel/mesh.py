@@ -1,0 +1,72 @@
+"""Multi-axis mesh construction for composed parallelism strategies.
+
+The reference is DP-only (SURVEY.md §2.7) with alltoall as the primitive
+SP/EP would build on; this module is where the TPU rebuild makes those
+strategies first-class: one ``jax.sharding.Mesh`` whose named axes carry
+data (dp), fully-sharded-data (fsdp), tensor (tp), sequence (sp), expert
+(ep) and pipeline (pp) parallelism. XLA lowers collectives per axis onto
+ICI neighbors when the mesh axis order matches the physical topology —
+keep fast axes (tp/sp) innermost (contiguous chips) and dp outermost
+(can span DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: slowest/outermost first. dp may span DCN; the
+# rightmost axes must ride ICI (tp does neighbor-heavy collectives).
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def build_mesh(axes: Dict[str, int],
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from {axis_name: size}. Product must equal the device
+    count. Axes are laid out in AXIS_ORDER so tp/sp land on contiguous
+    (ICI-adjacent) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    sizes = []
+    names = []
+    for name in AXIS_ORDER:
+        if name in axes:
+            # Size-1 axes are kept: code written against P('dp', ...) and
+            # lax.axis_index('dp') must keep working when a degree is
+            # tuned down to 1.
+            names.append(name)
+            sizes.append(axes[name])
+    unknown = set(axes) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(f"unknown mesh axes: {unknown}; "
+                         f"known: {AXIS_ORDER}")
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != len(devs):
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} product {total} != "
+            f"device count {len(devs)}")
+    if not names:
+        names, sizes = ["dp"], [len(devs)]
+    arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_spec(mesh: Mesh, batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+              seq_axis: Optional[str] = "sp") -> P:
+    """PartitionSpec for (batch, seq, ...) activations on this mesh."""
+    present = [a for a in batch_axes if a in mesh.axis_names]
+    parts = [tuple(present) if present else None]
+    if seq_axis and seq_axis in mesh.axis_names:
+        parts.append(seq_axis)
+    return P(*parts)
+
+
+def param_spec(mesh: Mesh, shard_axis: Optional[str] = "fsdp") -> P:
+    """PartitionSpec for parameters: fully replicated unless fsdp is
+    present (then dim 0 sharded, ZeRO-3 style)."""
+    if shard_axis and shard_axis in mesh.axis_names:
+        return P(shard_axis)
+    return P()

@@ -1,0 +1,122 @@
+"""Expert parallelism — top-k gated MoE with all-to-all dispatch.
+
+The reference exposes alltoall with negotiated uneven splits
+(operations.cc:1020-1081) as the primitive "added for such use cases"
+(SURVEY.md §2.7 EP); this module provides the actual capability: GShard
+style top-2 gating with capacity, einsum-based dispatch/combine (one-hot
+matmuls — MXU-friendly, no scatters), and ``lax.all_to_all`` to route
+token blocks to the devices holding each expert along the ``ep`` axis.
+Static capacity keeps every shape compile-time constant (the XLA analog
+of the reference's recv-split negotiation: instead of negotiating sizes at
+runtime, overflow tokens are dropped and weighted by the combine tensor).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top2_gating(logits, capacity: int):
+    """GShard top-2 gating.
+
+    logits: (T, E) router outputs for T local tokens.
+    Returns (dispatch (T, E, C) bool-ish, combine (T, E, C) weights,
+    aux_loss scalar).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)                       # (T,)
+    g1 = jnp.take_along_axis(probs, g1_idx[:, None], -1)[:, 0]
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(g1_idx, e))
+    g2_idx = jnp.argmax(probs_wo1, axis=-1)
+    g2 = jnp.take_along_axis(probs_wo1, g2_idx[:, None], -1)[:, 0]
+
+    # Load-balancing auxiliary loss (GShard eq. 4 style).
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(g1_idx, e).mean(axis=0)
+    aux = (me * ce).sum() * e
+
+    def positions(idx):
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - 1                  # pos in expert
+        return onehot, (pos * onehot).sum(axis=-1)            # (T,E),(T,)
+
+    oh1, pos1 = positions(g1_idx)
+    # Second choice queues behind all first choices.
+    count1 = oh1.sum(axis=0)                                  # (E,)
+    oh2, pos2_raw = positions(g2_idx)
+    pos2 = pos2_raw + jnp.take(count1, g2_idx)
+
+    keep1 = pos1 < capacity
+    keep2 = pos2 < capacity
+    g1 = g1 * keep1
+    g2 = g2 * keep2
+    # Renormalize the surviving pair weights to sum to 1 (tokens whose
+    # expert overflowed lose that share — the static-capacity analog of
+    # dropped sends).
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def one_dispatch(gate, idx, pos, keep):
+        oh_e = jax.nn.one_hot(idx, e)                         # (T, E)
+        oh_c = jax.nn.one_hot(pos, capacity)                  # (T, C)
+        d = oh_e[:, :, None] * oh_c[:, None, :] * keep[:, None, None]
+        return d, d * gate[:, None, None]
+
+    d1, c1 = one_dispatch(g1, g1_idx, pos1, keep1)
+    d2, c2 = one_dispatch(g2, g2_idx, pos2, keep2)
+    dispatch = jnp.clip(d1 + d2, 0.0, 1.0)
+    combine = c1 + c2
+    return dispatch, combine, aux
+
+
+def moe_layer(x, gate_w, expert_fn: Callable, num_experts: int,
+              capacity_factor: float = 1.25,
+              axis_name: str = "ep"):
+    """One MoE layer with experts sharded over the ``ep`` axis.
+
+    x: (T, D) local tokens on each ep device; gate_w: (D, E) router;
+    expert_fn(e_idx, tokens (C_local_total, D)) -> same shape, applied to
+    the LOCAL experts' token slabs (num_experts/n experts per device).
+
+    Flow (GShard): gate -> dispatch einsum -> all_to_all (tokens to the
+    device owning the expert) -> expert MLP -> all_to_all back -> combine.
+    """
+    n = lax.axis_size(axis_name)
+    if num_experts % n != 0:
+        raise ValueError(f"{num_experts} experts not divisible by ep={n}")
+    e_local = num_experts // n
+    t, d = x.shape
+    capacity = int(capacity_factor * t * 2 / num_experts) or 1
+
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    dispatch, combine, aux = top2_gating(logits, capacity)
+
+    # (T,D),(T,E,C) -> (E,C,D): expert-major slabs of dispatched tokens.
+    slabs = jnp.einsum("td,tec->ecd", x.astype(jnp.float32),
+                       dispatch).astype(x.dtype)
+    # Route: each device keeps slabs for its local experts, receives the
+    # matching slabs from every peer: (E,C,D) -> (E/n, n*C, D).
+    slabs = slabs.reshape(n, e_local, capacity, d)
+    routed = lax.all_to_all(slabs, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)                  # (n, e_l, C, D)
+    routed = routed.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d)
+
+    outs = []
+    for le in range(e_local):
+        outs.append(expert_fn(le, routed[le]))
+    expert_out = jnp.stack(outs)                           # (e_l, n*C, D)
+
+    # Inverse route back to the token owners.
+    back = expert_out.reshape(e_local, n, capacity, d).transpose(1, 0, 2, 3)
+    back = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)                     # (n, e_l, C, D)
+    back = back.reshape(num_experts, capacity, d)
+
+    y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32), combine)
+    return y.astype(x.dtype), aux

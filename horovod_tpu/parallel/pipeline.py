@@ -1,0 +1,79 @@
+"""Pipeline parallelism — GPipe-style microbatching over a ``pp`` axis.
+
+The reference has no PP (SURVEY.md §2.7); the TPU-native implementation
+uses the SPMD trick: every device holds ONE stage's parameters (stacked
+stage-major and sharded over ``pp``), activations advance one stage per
+tick via ``lax.ppermute``, and a ``lax.fori_loop`` runs
+``n_micro + n_stages - 1`` ticks so the pipeline fills and drains. Autodiff
+through the loop gives the backward pipeline for free (at GPipe-style
+activation memory; pair with ``jax.checkpoint`` on the stage fn to trade
+FLOPs for memory).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
+                   axis_name: str = "pp"):
+    """Run microbatches through the stage pipeline.
+
+    Args:
+      stage_fn: (params, activation (B, ...)) -> activation — the SAME
+        function on every device (stages must share a signature; stack
+        heterogeneous stages as homogeneous blocks, the standard SPMD
+        pipelining restriction).
+      stage_params: this device's stage parameters (already sharded over
+        ``axis_name`` outside, e.g. in_specs=P("pp")).
+      x_micro: (n_micro, B, ...) microbatches; only stage 0's copy is
+        consumed (other devices may pass zeros of the same shape).
+
+    Returns (n_micro, B, ...) outputs of the LAST stage (valid on stage
+    n-1; other devices return garbage — select with axis_index outside).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    state_shape = x_micro.shape[1:]
+    total = n_micro + n - 1
+
+    # j sends to j+1 (stage order); stage 0 receives nothing meaningful.
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    outs0 = jnp.zeros((n_micro,) + state_shape, x_micro.dtype)
+    carry0 = jnp.zeros(state_shape, x_micro.dtype)
+
+    def body(t, loop):
+        carry, outs = loop
+        # Stage 0 injects microbatch t (while available); others use the
+        # activation received on the previous tick.
+        mb = x_micro[jnp.minimum(t, n_micro - 1)]
+        inp = jnp.where(idx == 0, mb, carry)
+        out = stage_fn(stage_params, inp)
+        # Last stage records its output for microbatch (t - (n-1)).
+        w = t - (n - 1)
+        valid = (w >= 0) & (w < n_micro)
+        outs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out, jnp.maximum(w, 0), 0),
+            lambda o: o, outs)
+        nxt = lax.ppermute(out, axis_name, perm)
+        return nxt, outs
+
+    _, outs = lax.fori_loop(0, total, body, (carry0, outs0))
+    return outs
+
+
+def select_last_stage(outs, axis_name: str = "pp"):
+    """Broadcast the final-stage outputs to every pp device (psum of the
+    masked value — same lowering as collectives.broadcast)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
+    return lax.psum(masked, axis_name)

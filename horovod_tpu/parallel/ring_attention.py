@@ -1,0 +1,104 @@
+"""Ring attention — sequence parallelism over the collective-permute ring.
+
+The reference has NO sequence parallelism (SURVEY.md §5 "Long-context:
+absent"); its closest primitive is the LOCAL/CROSS split + alltoall. This
+module adds the capability the TPU-native way: Q/K/V are sharded along the
+sequence dimension across the ``sp`` mesh axis; each device attends its
+local Q block against K/V blocks that rotate around the ring via
+``lax.ppermute`` (one ICI neighbor hop per step — bandwidth-optimal, and
+XLA overlaps the permute with the attention math of the current block).
+Softmax is computed online (flash-attention style running max/denominator
+in fp32), so the full S×S score matrix never materializes.
+
+Matches the blockwise/ring formulation of Liu et al. (Ring Attention,
+2023) — see PAPERS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, mask=None):
+    """One online-softmax accumulation step.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D); m,l: (B, H, Sq) fp32 running
+    max / denominator; o: (B, Sq, H, D) fp32 running numerator.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + \
+        pv.astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str = "sp",
+                   causal: bool = False):
+    """Attention over sequence-sharded q/k/v.
+
+    Args:
+      q, k, v: (B, S_local, H, D) — the local sequence shard on each
+        device of the ``axis_name`` ring.
+      causal: apply a causal mask over *global* positions.
+
+    Returns (B, S_local, H, D) attention output for the local Q block.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+
+    m = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    o = jnp.zeros((b, s, h, d), jnp.float32)
+
+    q_pos = idx * s + jnp.arange(s)
+
+    # Ring: each step, device j hands its current K/V block to j+1, so
+    # after i steps device idx holds block (idx - i) mod n.
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - i) % n
+        mask = None
+        if causal:
+            k_pos = src * s + jnp.arange(s)
+            mask = q_pos[:, None] >= k_pos[None, :]      # (Sq, Sk)
+            mask = mask[None, None]                       # (1,1,Sq,Sk)
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
+    denom = l.transpose(0, 2, 1)[..., None]               # (B,S,H,1)
+    out = o / jnp.maximum(denom, 1e-30)
+    return out.astype(q.dtype)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Single-device reference for tests: q/k/v (B, S, H, D) full sequence.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)

@@ -1,0 +1,70 @@
+"""Ulysses-style sequence parallelism — all-to-all head scatter.
+
+The reference's ``alltoall`` was added precisely for this class of use
+(SURVEY.md §2.7: "the building block Ulysses-style SP would use"); here it
+becomes a real capability. With sequence sharded over the ``sp`` axis and
+H heads:
+
+  1. all-to-all converts (B, S/n, H, D) -> (B, S, H/n, D): every device
+     gathers the FULL sequence for a 1/n subset of heads;
+  2. plain (or flash) attention runs per head subset with no masking
+     complications — any attend fn works unchanged;
+  3. the inverse all-to-all restores (B, S/n, H, D).
+
+Two alltoalls per attention vs ring's n permute hops: Ulysses wins when
+H >= n and ICI all-to-all bandwidth is good (intra-slice); ring wins for
+very long S or when H < n. Both are provided; models select via
+``attend_fn`` (models/bert.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _a2a(x, axis_name, split_axis, concat_axis):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp",
+                      attend_fn: Optional[Callable] = None,
+                      mask=None):
+    """Attention over sequence-sharded q/k/v via head scatter.
+
+    q/k/v: (B, S_local, H, D); H must be divisible by the axis size.
+    attend_fn(q, k, v, mask) operates on full-sequence inputs
+    (B, S, H/n, D) — defaults to models.bert.default_attend.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"num heads {h} not divisible by sp size {n}")
+    if attend_fn is None:
+        from ..models.bert import default_attend
+
+        attend_fn = default_attend
+
+    # (B, S/n, H, D) -> (B, S, H/n, D): split heads, gather sequence.
+    qg = _a2a(q, axis_name, split_axis=2, concat_axis=1)
+    kg = _a2a(k, axis_name, split_axis=2, concat_axis=1)
+    vg = _a2a(v, axis_name, split_axis=2, concat_axis=1)
+
+    og = attend_fn(qg, kg, vg, mask)
+
+    # Inverse: (B, S, H/n, D) -> (B, S/n, H, D).
+    return _a2a(og, axis_name, split_axis=1, concat_axis=2)
+
+
+def ulysses_attend_fn(axis_name: str = "sp",
+                      inner: Optional[Callable] = None) -> Callable:
+    """Adapter producing an ``attend_fn`` for models.bert.Bert: drop-in
+    sequence parallelism for any model that accepts attend_fn."""
+
+    def attend(q, k, v, mask=None):
+        return ulysses_attention(q, k, v, axis_name, inner, mask)
+
+    return attend

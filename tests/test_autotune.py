@@ -1,0 +1,113 @@
+"""Autotuner tests (reference analog: ParameterManager scoring/update
+behavior, parameter_manager.cc — tested host-side with synthetic scores).
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.autotune import (Autotuner, GaussianProcess,
+                                         expected_improvement)
+
+
+def test_gp_fits_and_interpolates():
+    gp = GaussianProcess(length_scale=1.0)
+    x = np.array([[0.0], [1.0], [2.0], [3.0]])
+    y = np.array([0.0, 1.0, 0.0, -1.0])
+    gp.fit(x, y)
+    mu, var = gp.predict(np.array([[1.0]]))
+    assert abs(mu[0] - 1.0) < 0.05          # near-interpolation at a sample
+    assert var[0] < 0.01
+    mu2, var2 = gp.predict(np.array([[10.0]]))
+    assert var2[0] > 0.5                    # high uncertainty far away
+
+
+def test_expected_improvement_prefers_unknown():
+    gp = GaussianProcess()
+    gp.fit(np.array([[0.0], [1.0]]), np.array([0.0, 0.5]))
+    mu, var = gp.predict(np.array([[0.5], [5.0]]))
+    ei = expected_improvement(mu, var, best=0.5)
+    assert ei[1] > ei[0]                    # exploration beats known region
+
+
+def _simulate(tuner, score_fn, max_rounds=40):
+    """Feed synthetic throughput samples until convergence."""
+    for _ in range(max_rounds):
+        for _ in range(tuner.warmup):
+            tuner.record(1.0, 1.0)          # warmup discarded
+        for _ in range(tuner.steps_per_sample):
+            score = score_fn(tuner.current)
+            tuner.record(score, 1.0)        # bytes=score, 1s -> score B/s
+        if tuner.ready():
+            tuner.suggest()
+        if tuner.done:
+            break
+    return tuner
+
+
+def test_autotuner_finds_best_threshold():
+    mb = 1024 * 1024
+    candidates = [mb, 4 * mb, 16 * mb, 64 * mb, 256 * mb]
+    # Synthetic objective peaked at 16 MiB.
+    peak = {mb: 100.0, 4 * mb: 300.0, 16 * mb: 1000.0, 64 * mb: 500.0,
+            256 * mb: 200.0}
+    t = Autotuner(candidates_bytes=candidates, warmup_samples=1,
+                  steps_per_sample=2)
+    t = _simulate(t, lambda cur: peak[cur])
+    assert t.done
+    assert t.current == 16 * mb
+
+
+def test_autotuner_logs_csv(tmp_path):
+    log = str(tmp_path / "autotune.csv")
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=0,
+                  steps_per_sample=1, log_file=log)
+    t.record(100.0, 1.0)
+    t.suggest()
+    lines = open(log).read().strip().splitlines()
+    assert lines[0] == "threshold_bytes,score_bytes_per_sec"
+    assert len(lines) == 2
+
+
+def test_autotuner_warmup_discarded():
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=2,
+                  steps_per_sample=1)
+    t.record(1e9, 1.0)   # compile step — discarded
+    t.record(1e9, 1.0)   # compile step — discarded
+    assert not t.ready()
+    t.record(100.0, 1.0)
+    assert t.ready()
+
+
+def test_sync_batch_norm(hvd, rng):
+    """SyncBatchNorm statistics span ranks: per-rank outputs must match a
+    single-device BatchNorm over the concatenated batch (reference:
+    torch/sync_batch_norm.py test strategy)."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm
+
+    ctx = hvd.init()
+    gx = rng.standard_normal((16, 6)).astype(np.float32) * 3 + 1
+
+    sbn = SyncBatchNorm(axis_name=ctx.config.rank_axis,
+                        use_running_average=False)
+    ref_bn = nn.BatchNorm(use_running_average=False)
+    ref_params = ref_bn.init(jax.random.PRNGKey(0), jnp.asarray(gx))
+    expected, _ = ref_bn.apply(ref_params, jnp.asarray(gx),
+                               mutable=["batch_stats"])
+
+    params = sbn.init(jax.random.PRNGKey(0), jnp.asarray(gx[:2]))
+
+    def fwd(x):
+        out, _ = sbn.apply(params, x, mutable=["batch_stats"])
+        return out
+
+    f = jax.jit(jax.shard_map(fwd, mesh=ctx.mesh,
+                              in_specs=P(ctx.config.rank_axis),
+                              out_specs=P(ctx.config.rank_axis),
+                              check_vma=False))
+    out = np.asarray(f(jnp.asarray(gx)))
+    np.testing.assert_allclose(out, np.asarray(expected), rtol=1e-4,
+                               atol=1e-4)
