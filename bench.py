@@ -24,11 +24,13 @@ import numpy as np
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=128)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=0,
+                   help="0 = model's native size (224; 299 for inception3)")
     p.add_argument("--num-warmup", type=int, default=3)
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--batches-per-iter", type=int, default=5)
-    p.add_argument("--model", default="resnet50")
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "resnet101", "vgg16", "inception3"])
     args = p.parse_args()
 
     import jax
@@ -36,21 +38,28 @@ def main():
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50, ResNet101
+    from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
 
     hvd.init()
     n = hvd.size()
 
-    model = {"resnet50": ResNet50, "resnet101": ResNet101}[args.model](
+    model = {"resnet50": ResNet50, "resnet101": ResNet101,
+             "vgg16": VGG16, "inception3": InceptionV3}[args.model](
         num_classes=1000)
+    image_size = args.image_size or (
+        299 if args.model == "inception3" else 224)
     rng = jax.random.PRNGKey(0)
     images = jax.random.normal(
-        rng, (args.batch_size, args.image_size, args.image_size, 3),
+        rng, (args.batch_size, image_size, image_size, 3),
         dtype=jnp.bfloat16)
     labels = jax.random.randint(rng, (args.batch_size,), 0, 1000)
 
-    variables = model.init(rng, images, train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    init_rngs = {"params": rng, "dropout": jax.random.PRNGKey(1)}
+    variables = model.init(init_rngs, images, train=True)
+    params = variables["params"]
+    # VGG (no BatchNorm by default) carries no batch_stats collection.
+    batch_stats = variables.get("batch_stats", {})
+    dropout_rng = jax.random.PRNGKey(2)
 
     # Reference benchmark uses plain SGD lr=0.01 wrapped in
     # DistributedOptimizer; same here (fused allreduce over the rank axis).
@@ -61,10 +70,10 @@ def main():
     def loss_fn(p, bs, x, y):
         logits, new_model_state = model.apply(
             {"params": p, "batch_stats": bs}, x, train=True,
-            mutable=["batch_stats"])
+            mutable=["batch_stats"], rngs={"dropout": dropout_rng})
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
-        return loss, new_model_state["batch_stats"]
+        return loss, new_model_state.get("batch_stats", {})
 
     if n > 1:
         from jax.sharding import PartitionSpec as P

@@ -1,1 +1,10 @@
-"""Model zoo used by examples, tests and benchmarks: ResNet, BERT, MLP."""
+"""Model zoo used by examples, tests and benchmarks: ResNet, BERT, MLP,
+VGG, Inception V3 — the reference's benchmark families
+(reference: docs/benchmarks.rst:13-14 benchmarks Inception V3 / ResNet-101
+/ VGG-16)."""
+
+from .bert import bert_base, bert_large, bert_tiny  # noqa: F401
+from .inception import InceptionV3  # noqa: F401
+from .mlp import MLP, ConvNet  # noqa: F401
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401

@@ -1,0 +1,163 @@
+"""Inception V3 in Flax — benchmark workload #2.
+
+The reference's headline scaling number is Inception V3 at 512 GPUs (~90%
+scaling efficiency, reference: docs/benchmarks.rst:13-14). From-scratch
+TPU-first implementation of the Szegedy et al. v3 architecture (299x299
+input): NHWC, bfloat16 compute / fp32 params+stats, BatchNorm after every
+conv, factorised 7x7 and asymmetric 1xN/Nx1 convolutions — all shapes are
+static and MXU-friendly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm + ReLU, the basic Inception cell."""
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(64, (5, 5))(cbn(48, (1, 1))(x, train), train)
+        b3 = cbn(96, (3, 3))(
+            cbn(96, (3, 3))(cbn(64, (1, 1))(x, train), train), train)
+        b4 = cbn(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = cbn(96, (3, 3), (2, 2), padding="VALID")(
+            cbn(96, (3, 3))(cbn(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 blocks with factorised 7x7 (1x7 then 7x1) convolutions."""
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        y = cbn(c, (1, 1))(x, train)
+        y = cbn(c, (1, 7))(y, train)
+        b2 = cbn(192, (7, 1))(y, train)
+        y = cbn(c, (1, 1))(x, train)
+        y = cbn(c, (7, 1))(y, train)
+        y = cbn(c, (1, 7))(y, train)
+        y = cbn(c, (7, 1))(y, train)
+        b3 = cbn(192, (1, 7))(y, train)
+        b4 = cbn(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(
+            cbn(192, (1, 1))(x, train), train)
+        y = cbn(192, (1, 1))(x, train)
+        y = cbn(192, (1, 7))(y, train)
+        y = cbn(192, (7, 1))(y, train)
+        b2 = cbn(192, (3, 3), (2, 2), padding="VALID")(y, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 blocks with split 1x3/3x1 branches."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        y = cbn(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([cbn(384, (1, 3))(y, train),
+                              cbn(384, (3, 1))(y, train)], axis=-1)
+        y = cbn(448, (1, 1))(x, train)
+        y = cbn(384, (3, 3))(y, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(y, train),
+                              cbn(384, (3, 1))(y, train)], axis=-1)
+        b4 = cbn(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 3x InceptionA (35x35)
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        # Reduction + 4x InceptionC (17x17)
+        x = InceptionB(self.dtype)(x, train)
+        x = InceptionC(128, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(160, self.dtype)(x, train)
+        x = InceptionC(192, self.dtype)(x, train)
+        # Reduction + 2x InceptionE (8x8)
+        x = InceptionD(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = InceptionE(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,66 @@
+"""VGG family in Flax — benchmark workload #3.
+
+The reference's published scaling table benchmarks VGG-16 at 512 GPUs
+(~68% scaling, reference: docs/benchmarks.rst:13-14) — it is the
+bandwidth-bound outlier (138M params, mostly in the FC head) that stresses
+gradient-fusion and allreduce throughput. TPU-first choices: NHWC layout,
+bfloat16 compute with fp32 params, optional BatchNorm (the benchmark
+classic is the plain-conv variant; BN stabilises large-batch training).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Each entry: number of 3x3 convs in the stage; channel width doubles per
+# stage up to 512. VGG-16 = [2, 2, 3, 3, 3] (13 convs + 3 dense).
+_CFG = {
+    11: [1, 1, 2, 2, 2],
+    13: [2, 2, 2, 2, 2],
+    16: [2, 2, 3, 3, 3],
+    19: [2, 2, 4, 4, 4],
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    batch_norm: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for stage, n_convs in enumerate(_CFG[self.depth]):
+            width = min(64 * 2 ** stage, 512)
+            for i in range(n_convs):
+                x = conv(width, name=f"conv{stage}_{i}")(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype,
+                                     param_dtype=jnp.float32,
+                                     name=f"bn{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i, width in enumerate((4096, 4096)):
+            x = nn.Dense(width, dtype=self.dtype, param_dtype=jnp.float32,
+                         name=f"fc{i}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, depth=11)
+VGG13 = partial(VGG, depth=13)
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

@@ -33,13 +33,25 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30):
+def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30,
+                      use_pallas=None):
     """The adaptive combine of two same-shaped tensors (adasum.h:371-390).
 
     When the gradients are orthogonal (dot=0) this is a plain sum; when they
     are parallel it averages — interpolating smoothly in between, which is
     what makes Adasum scale-insensitive.
+
+    On TPU both passes run as Pallas kernels: one fused dot/norm reduction
+    (each operand streamed from HBM once) and one fused combine with the
+    coefficients derived in-kernel — the VPU equivalent of the reference's
+    AVX loops (adasum.h:427-530). Zero-norm sides degenerate to a plain sum
+    (coef 1.0), matching reference behavior (adasum.h:380-388).
     """
+    if scalar_dtype == jnp.float32:
+        from . import pallas_kernels as pk
+
+        dn = pk.adasum_dot_norms(a, b, use_pallas=use_pallas)
+        return pk.adasum_combine(a, b, dn, use_pallas=use_pallas, eps=eps)
     af = a.astype(scalar_dtype).ravel()
     bf = b.astype(scalar_dtype).ravel()
     dot = jnp.dot(af, bf)
@@ -47,9 +59,6 @@ def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30):
     nb2 = jnp.dot(bf, bf)
     a_coef = 1.0 - dot / jnp.maximum(2.0 * na2, eps)
     b_coef = 1.0 - dot / jnp.maximum(2.0 * nb2, eps)
-    # Zero-norm guards: if either side is all-zero the combine degenerates
-    # to a plain sum (coef 1.0) — matches reference behavior where
-    # normsq==0 keeps coefficients at 1 (adasum.h:380-388).
     a_coef = jnp.where(na2 > 0, a_coef, 1.0)
     b_coef = jnp.where(nb2 > 0, b_coef, 1.0)
     return (a_coef.astype(a.dtype) * a + b_coef.astype(b.dtype) * b)

@@ -15,6 +15,11 @@ import jax.numpy as jnp
 class Compressor:
     """Interface matching the reference's Compressor static methods."""
 
+    # Whether compressed values may ride a sum/avg collective directly
+    # (cast-style compressors: yes; quantizers with per-block scales: no —
+    # those are wire formats for broadcast/allgather/object sync).
+    reduce_safe = True
+
     @staticmethod
     def compress(tensor):
         raise NotImplementedError
@@ -60,12 +65,38 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Block-scaled int8 wire compression (4x over fp32) via the Pallas
+    quantization kernel (ops/pallas_kernels.py). Capability extension over
+    the reference's cast-only compressors for DCN-bound traffic
+    (broadcast/allgather/parameter sync); NOT reduce-safe — per-block
+    scales don't commute with summation."""
+
+    reduce_safe = False
+
+    @staticmethod
+    def compress(tensor):
+        from .pallas_kernels import quantize_int8
+
+        q, scales, n = quantize_int8(tensor)
+        return (q, scales), (n, tensor.shape, tensor.dtype)
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        from .pallas_kernels import dequantize_int8
+
+        q, scales = tensor
+        n, shape, dtype = ctx
+        return dequantize_int8(q, scales, n, shape, dtype)
+
+
 class Compression:
     """Namespace mirroring reference ``hvd.Compression`` usage."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
 
     @staticmethod
     def by_name(name):
@@ -75,4 +106,6 @@ class Compression:
             return FP16Compressor
         if name in ("bf16", "bfloat16"):
             return BF16Compressor
+        if name in ("int8",):
+            return Int8Compressor
         raise ValueError(f"unknown compression: {name}")

@@ -108,8 +108,14 @@ class EagerEngine:
         if config.compression_dtype:
             from .compression import Compression
 
-            self._default_compression = Compression.by_name(
-                config.compression_dtype)
+            comp = Compression.by_name(config.compression_dtype)
+            if not getattr(comp, "reduce_safe", True):
+                raise ValueError(
+                    f"HVD_TPU_COMPRESSION_DTYPE={config.compression_dtype} "
+                    "is a wire-format compressor (per-block scales don't "
+                    "commute with summation) and cannot be the default "
+                    "reduction compression; use fp16/bf16")
+            self._default_compression = comp
         self._cache: Dict[Tuple, Any] = {}
         self._cache_lock = threading.Lock()
         self.handles = HandleManager()

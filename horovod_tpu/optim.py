@@ -32,6 +32,14 @@ from .ops import collectives as C
 from .ops.compression import NoneCompressor
 
 
+def _check_reduce_safe(compression) -> None:
+    if not getattr(compression, "reduce_safe", True):
+        raise ValueError(
+            f"{compression.__name__} is a wire-format compressor (per-block "
+            "scales don't commute with summation); use Compression.fp16 / "
+            "bf16 for gradient reduction")
+
+
 def _axes_bound(*axes) -> bool:
     """True iff all mesh axis names are bound in the current trace (i.e. we
     are inside shard_map/pmap over them). Probed once, narrowly, so a
@@ -127,6 +135,8 @@ def DistributedOptimizer(optimizer,
     except ImportError as e:  # pragma: no cover
         raise ImportError("DistributedOptimizer requires optax") from e
 
+    _check_reduce_safe(compression)
+
     k = int(backward_passes_per_step)
 
     def reduce_grads(grads):
@@ -196,6 +206,7 @@ def DistributedGradFn(grad_fn: Callable,
     instead of tuple-sniffing so ``jax.grad(loss, argnums=(0, 1))`` (a
     tuple of gradients) is never misclassified.
     """
+    _check_reduce_safe(compression)
 
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)

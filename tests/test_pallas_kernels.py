@@ -1,0 +1,128 @@
+"""Pallas kernel tests — run the real kernel bodies in interpret mode on
+CPU (use_pallas=True off-TPU => interpret) and check numerics against the
+pure-jnp fallbacks / NumPy.
+
+Reference analogs being covered: ScaleBuffer (collective_operations.h:
+97-125), Adasum's fused dot/norm + combine loops (adasum/adasum.h:195-400),
+and the quantization capability extension.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from horovod_tpu.ops import pallas_kernels as pk
+
+
+@pytest.mark.parametrize("n", [7, 1024, 5000])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_scale_buffer_matches_jnp(rng, n, dtype):
+    x = jnp.asarray(rng.standard_normal(n), dtype)
+    got = pk.scale_buffer(x, 2.5, use_pallas=True)
+    want = pk.scale_buffer(x, 2.5, use_pallas=False)
+    assert got.shape == x.shape and got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), rtol=1e-2)
+
+
+def test_scale_buffer_cast(rng):
+    x = jnp.asarray(rng.standard_normal(100), jnp.float32)
+    got = pk.scale_buffer(x, 0.5, out_dtype=jnp.bfloat16, use_pallas=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(x) * 0.5, rtol=1e-2)
+
+
+@pytest.mark.parametrize("n", [64, 2048, 3333])
+def test_adasum_dot_norms(rng, n):
+    a = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    got = np.asarray(pk.adasum_dot_norms(a, b, use_pallas=True))
+    an, bn = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    want = np.array([(an * bn).sum(), (an * an).sum(), (bn * bn).sum()])
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_adasum_dot_norms_multiblock(rng):
+    # > _BLOCK_ROWS rows forces multi-step grid accumulation.
+    n = (pk._BLOCK_ROWS + 17) * pk._LANES
+    a = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    got = np.asarray(pk.adasum_dot_norms(a, b, use_pallas=True))
+    want = np.asarray(pk.adasum_dot_norms(a, b, use_pallas=False))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_adasum_combine_matches_formula(rng):
+    a = jnp.asarray(rng.standard_normal(500), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(500), jnp.float32)
+    dn = pk.adasum_dot_norms(a, b, use_pallas=False)
+    got = np.asarray(pk.adasum_combine(a, b, dn, use_pallas=True))
+    an, bn = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    dot, na2, nb2 = (an * bn).sum(), (an * an).sum(), (bn * bn).sum()
+    want = an * (1 - dot / (2 * na2)) + bn * (1 - dot / (2 * nb2))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_adasum_combine_zero_side(rng):
+    # All-zero operand => plain sum (coef 1.0), adasum.h:380-388 parity.
+    a = jnp.zeros(128, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    dn = pk.adasum_dot_norms(a, b, use_pallas=True)
+    got = np.asarray(pk.adasum_combine(a, b, dn, use_pallas=True))
+    np.testing.assert_allclose(got, np.asarray(b), rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", [100, 4096, 9001])
+def test_quantize_roundtrip(rng, n):
+    x = jnp.asarray(rng.standard_normal(n) * 10, jnp.float32)
+    q, scales, cnt = pk.quantize_int8(x, use_pallas=True)
+    assert q.dtype == jnp.int8 and cnt == n
+    out = pk.dequantize_int8(q, scales, cnt, x.shape,
+                             use_pallas=True)
+    # absmax/127 per 4096-block => error bounded by scale/2 per element.
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    bound = np.asarray(scales).max() / 2 + 1e-6
+    assert err.max() <= bound
+
+
+def test_quantize_pallas_matches_fallback(rng):
+    x = jnp.asarray(rng.standard_normal(8192), jnp.float32)
+    q1, s1, _ = pk.quantize_int8(x, use_pallas=True)
+    q0, s0, _ = pk.quantize_int8(x, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q0))
+
+
+def test_int8_compressor_roundtrip(rng):
+    from horovod_tpu.ops.compression import Compression
+
+    x = jnp.asarray(rng.standard_normal((33, 17)), jnp.float32)
+    wire, ctx = Compression.int8.compress(x)
+    out = Compression.int8.decompress(wire, ctx)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    assert np.abs(np.asarray(out) - np.asarray(x)).max() < 0.05
+
+
+def test_int8_rejected_for_reduction():
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.compression import Compression
+
+    with pytest.raises(ValueError, match="wire-format"):
+        hvd.DistributedOptimizer(optax.sgd(0.1),
+                                 compression=Compression.int8)
+
+
+def test_pairwise_combine_uses_kernels(rng):
+    from horovod_tpu.ops.adasum import _pairwise_combine
+
+    a = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)
+    got = np.asarray(_pairwise_combine(a, b))
+    an = np.asarray(a, np.float64).ravel()
+    bn = np.asarray(b, np.float64).ravel()
+    dot, na2, nb2 = (an * bn).sum(), (an * an).sum(), (bn * bn).sum()
+    want = (an * (1 - dot / (2 * na2)) +
+            bn * (1 - dot / (2 * nb2))).reshape(a.shape)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
