@@ -1,16 +1,18 @@
 #!/usr/bin/env python
-"""Synthetic ResNet-50 training benchmark — the reference's
+"""Synthetic training benchmark — the reference's
 examples/tensorflow2/tensorflow2_synthetic_benchmark.py re-built for TPU
-(same methodology: synthetic ImageNet-shaped data, timed batches after
-warmup, img/sec; reference prints "Img/sec per GPU", :121-131).
+(same methodology: synthetic data, timed batches after warmup; reference
+prints "Img/sec per GPU", :121-131), extended with the BERT-large
+pretraining config from BASELINE.json configs[2].
 
-Prints ONE JSON line:
+Prints ONE JSON line, e.g.:
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
    "unit": "img/s", "vs_baseline": N}
 
-Baseline: the reference's published tf_cnn_benchmarks ResNet-101 example
-(docs/benchmarks.rst:32-43) runs 1656.82 img/s on 16 P100s = 103.55
-img/s/GPU; we use that per-device number as vs_baseline denominator.
+Baselines: CNNs — the reference's published tf_cnn_benchmarks ResNet-101
+example (docs/benchmarks.rst:32-43) 1656.82 img/s on 16 P100s = 103.55
+img/s/GPU. BERT-large — no number is published in the reference repo;
+we use 10 samples/s/chip as the nominal P100-era per-device denominator.
 """
 
 import argparse
@@ -20,17 +22,23 @@ import time
 
 import numpy as np
 
+CNN_BASELINE_PER_DEVICE = 1656.82 / 16.0
+BERT_BASELINE_PER_DEVICE = 10.0
+
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=0,
+                   help="0 = per-model default (128 CNN, 8 BERT)")
     p.add_argument("--image-size", type=int, default=0,
                    help="0 = model's native size (224; 299 for inception3)")
+    p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--num-warmup", type=int, default=3)
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--batches-per-iter", type=int, default=5)
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101", "vgg16", "inception3"])
+                   choices=["resnet50", "resnet101", "vgg16", "inception3",
+                            "bert_large", "bert_base"])
     args = p.parse_args()
 
     import jax
@@ -38,10 +46,83 @@ def main():
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
 
     hvd.init()
     n = hvd.size()
+    is_bert = args.model.startswith("bert")
+    batch_size = args.batch_size or (8 if is_bert else 128)
+
+    if is_bert:
+        run_batch, unit, baseline = _setup_bert(args, batch_size, n)
+    else:
+        run_batch, unit, baseline = _setup_cnn(args, batch_size, n)
+
+    # Warmup (includes compile).
+    for _ in range(args.num_warmup):
+        run_batch().block_until_ready()
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.batches_per_iter):
+            l = run_batch()
+        l.block_until_ready()
+        dt = time.perf_counter() - t0
+        rates.append(batch_size * args.batches_per_iter / dt)
+
+    val = float(np.mean(rates))
+    print(json.dumps({
+        "metric": f"{args.model}_{'samples' if is_bert else 'images'}"
+                  f"_per_sec_per_chip",
+        "value": round(val, 2),
+        "unit": "samples/s" if is_bert else "img/s",
+        "vs_baseline": round(val / baseline, 3),
+    }))
+
+
+def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
+    """Shared step-loop builder: jit (n=1) or spmd_step shard_map (n>1)."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    if n > 1:
+        from jax.sharding import PartitionSpec as P
+
+        ax = hvd.rank_axis()
+        nstate = len(params_and_state)
+        in_specs = tuple([P()] * nstate) + tuple([P(ax)] * len(extra_args))
+        out_specs = tuple([P()] * nstate) + (P(),)
+
+        @hvd.spmd_step(in_specs=in_specs, out_specs=out_specs)
+        def train_step(*all_args):
+            state, data = all_args[:nstate], all_args[nstate:]
+            out = model_apply_loss(state, data, pmean_axis=ax)
+            return out
+    else:
+        @jax.jit
+        def train_step(*all_args):
+            nstate = len(params_and_state)
+            state, data = all_args[:nstate], all_args[nstate:]
+            return model_apply_loss(state, data, pmean_axis=None)
+
+    carry = list(params_and_state)
+
+    def run_batch():
+        out = train_step(*carry, *extra_args)
+        carry[:] = out[:-1]
+        return out[-1]
+
+    return run_batch
+
+
+def _setup_cnn(args, batch_size, n):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
 
     model = {"resnet50": ResNet50, "resnet101": ResNet101,
              "vgg16": VGG16, "inception3": InceptionV3}[args.model](
@@ -50,15 +131,13 @@ def main():
         299 if args.model == "inception3" else 224)
     rng = jax.random.PRNGKey(0)
     images = jax.random.normal(
-        rng, (args.batch_size, image_size, image_size, 3),
-        dtype=jnp.bfloat16)
-    labels = jax.random.randint(rng, (args.batch_size,), 0, 1000)
+        rng, (batch_size, image_size, image_size, 3), dtype=jnp.bfloat16)
+    labels = jax.random.randint(rng, (batch_size,), 0, 1000)
 
     init_rngs = {"params": rng, "dropout": jax.random.PRNGKey(1)}
     variables = model.init(init_rngs, images, train=True)
     params = variables["params"]
-    # VGG (no BatchNorm by default) carries no batch_stats collection.
-    batch_stats = variables.get("batch_stats", {})
+    batch_stats = variables.get("batch_stats", {})  # VGG has none
     dropout_rng = jax.random.PRNGKey(2)
 
     # Reference benchmark uses plain SGD lr=0.01 wrapped in
@@ -67,67 +146,78 @@ def main():
                                   axis_name=hvd.rank_axis())
     opt_state = tx.init(params)
 
-    def loss_fn(p, bs, x, y):
-        logits, new_model_state = model.apply(
-            {"params": p, "batch_stats": bs}, x, train=True,
-            mutable=["batch_stats"], rngs={"dropout": dropout_rng})
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
-        return loss, new_model_state.get("batch_stats", {})
+    def apply_loss(state, data, pmean_axis):
+        p, bs, st = state
+        x, y = data
 
-    if n > 1:
-        from jax.sharding import PartitionSpec as P
+        def loss_fn(p, bs):
+            logits, new_state = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"], rngs={"dropout": dropout_rng})
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, new_state.get("batch_stats", {})
 
-        ax = hvd.rank_axis()
-
-        @hvd.spmd_step(in_specs=(P(), P(), P(), P(ax), P(ax)),
-                       out_specs=(P(), P(), P(), P()))
-        def train_step(p, bs, st, x, y):
-            # x/y blocks: the per-rank slice of the global batch.
-            (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                p, bs, x, y)
+        (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(p, bs)
+        if pmean_axis is not None:
             # BatchNorm stats averaged across ranks (SyncBatchNorm-lite).
             new_bs = jax.tree.map(
-                lambda v: jax.lax.pmean(v, ax), new_bs)
-            updates, st = tx.update(g, st, p)
-            p = optax.apply_updates(p, updates)
-            return p, new_bs, st, jax.lax.pmean(l, ax)
-    else:
-        @jax.jit
-        def train_step(p, bs, st, x, y):
-            (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
-                p, bs, x, y)
-            updates, st = tx.update(g, st, p)
-            p = optax.apply_updates(p, updates)
-            return p, new_bs, st, l
+                lambda v: jax.lax.pmean(v, pmean_axis), new_bs)
+            l = jax.lax.pmean(l, pmean_axis)
+        updates, st = tx.update(g, st, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, st, l
 
-    def run_batch():
-        nonlocal params, batch_stats, opt_state
-        params, batch_stats, opt_state, l = train_step(
-            params, batch_stats, opt_state, images, labels)
-        return l
+    run = _make_stepper(apply_loss, (params, batch_stats, opt_state),
+                        n, (images, labels))
+    return run, "img/s", CNN_BASELINE_PER_DEVICE
 
-    # Warmup (includes compile).
-    for _ in range(args.num_warmup):
-        run_batch().block_until_ready()
 
-    img_secs = []
-    for _ in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.batches_per_iter):
-            l = run_batch()
-        l.block_until_ready()
-        dt = time.perf_counter() - t0
-        img_secs.append(args.batch_size * args.batches_per_iter / dt)
+def _setup_bert(args, batch_size, n):
+    """BERT-large MLM pretraining step (BASELINE.json configs[2] —
+    'examples/pytorch BERT-large pretraining' re-built for TPU: bf16
+    compute, Adam, 15% random masked positions on synthetic tokens)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
 
-    val = float(np.mean(img_secs))
-    baseline_per_device = 1656.82 / 16.0
-    print(json.dumps({
-        "metric": f"{args.model}_images_per_sec_per_chip",
-        "value": round(val, 2),
-        "unit": "img/s",
-        "vs_baseline": round(val / baseline_per_device, 3),
-    }))
+    import horovod_tpu as hvd
+    from horovod_tpu.models import bert_base, bert_large
+
+    model = (bert_large if args.model == "bert_large" else bert_base)(
+        max_len=args.seq_len)
+    rng = jax.random.PRNGKey(0)
+    S = args.seq_len
+    tokens = jax.random.randint(rng, (batch_size, S), 0, model.vocab_size)
+    mask_positions = jax.random.bernoulli(rng, 0.15, (batch_size, S))
+    labels = tokens  # predict the original token at masked positions
+
+    params = model.init(rng, tokens)["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4),
+                                  axis_name=hvd.rank_axis())
+    opt_state = tx.init(params)
+
+    def apply_loss(state, data, pmean_axis):
+        p, st = state
+        toks, mask_pos, y = data
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, toks)
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y)
+            return (per_tok * mask_pos).sum() / jnp.maximum(
+                mask_pos.sum(), 1.0)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        if pmean_axis is not None:
+            l = jax.lax.pmean(l, pmean_axis)
+        updates, st = tx.update(g, st, p)
+        p = optax.apply_updates(p, updates)
+        return p, st, l
+
+    run = _make_stepper(apply_loss, (params, opt_state), n,
+                        (tokens, mask_positions.astype(jnp.float32), labels))
+    return run, "samples/s", BERT_BASELINE_PER_DEVICE
 
 
 if __name__ == "__main__":

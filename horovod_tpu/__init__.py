@@ -50,6 +50,17 @@ __version__ = "0.1.0"
 _ctx = _basics.context
 
 
+def __getattr__(name):
+    # Lazy submodules with heavy deps (orbax, TF) — imported on first use.
+    if name in ("checkpoint", "callbacks", "elastic", "executor"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")
+
+
 # -- basics (reference common/basics.py surface) ---------------------------
 
 def rank() -> int:

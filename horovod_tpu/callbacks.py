@@ -1,0 +1,270 @@
+"""Training-loop callbacks — the Keras callback suite rebuilt for JAX.
+
+Reference: horovod/keras/callbacks.py + horovod/_keras/callbacks.py:22-192
+(BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateScheduleCallback, LearningRateWarmupCallback,
+BestModelCheckpoint) and the elastic Commit/UpdateState callbacks
+(horovod/_keras/elastic.py:86).
+
+TPU-first design: instead of monkey-patching a Keras optimizer's ``lr``
+variable, callbacks drive a host-side *trainer* protocol — any object with
+``params`` / ``opt_state`` pytrees and a scalar ``lr`` attribute that the
+user feeds into the jitted step each batch (a host scalar argument costs no
+recompile under jit; this is the idiomatic way to steer a compiled step).
+
+Trainer protocol (duck-typed, all optional except what a callback uses):
+    trainer.params      pytree of model parameters
+    trainer.opt_state   pytree of optimizer state
+    trainer.lr          float, consumed by the step function
+    trainer.state       hvd.elastic State (for elastic callbacks)
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+
+class Callback:
+    """Hook surface (mirrors the Keras contract the reference plugs into)."""
+
+    trainer = None
+
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    def on_train_begin(self, logs: Optional[Dict] = None) -> None: ...
+
+    def on_train_end(self, logs: Optional[Dict] = None) -> None: ...
+
+    def on_epoch_begin(self, epoch: int,
+                       logs: Optional[Dict] = None) -> None: ...
+
+    def on_epoch_end(self, epoch: int,
+                     logs: Optional[Dict] = None) -> None: ...
+
+    def on_batch_begin(self, batch: int,
+                       logs: Optional[Dict] = None) -> None: ...
+
+    def on_batch_end(self, batch: int,
+                     logs: Optional[Dict] = None) -> None: ...
+
+
+class CallbackList:
+    """Dispatches hooks to a list of callbacks bound to one trainer."""
+
+    def __init__(self, callbacks: List[Callback], trainer) -> None:
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_trainer(trainer)
+
+    def __getattr__(self, hook: str):
+        if not hook.startswith("on_"):
+            raise AttributeError(hook)
+
+        def fire(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, hook)(*args, **kwargs)
+
+        return fire
+
+
+class BroadcastVariablesCallback(Callback):
+    """Broadcast params + opt_state from ``root_rank`` at train start so
+    all ranks begin identical (reference
+    _keras/callbacks.py BroadcastGlobalVariablesCallback; under
+    single-controller SPMD replicated arrays are already identical, and
+    the broadcast is a cheap no-op-shaped collective in eager mode)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        from .functions import broadcast_variables
+
+        t = self.trainer
+        t.params = broadcast_variables(t.params, self.root_rank)
+        if getattr(t, "opt_state", None) is not None:
+            t.opt_state = broadcast_variables(t.opt_state, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metrics over ranks before they are logged
+    (reference _keras/callbacks.py MetricAverageCallback)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        import horovod_tpu as hvd
+
+        for k, v in list(logs.items()):
+            if isinstance(v, numbers.Number):
+                out = hvd.allreduce(np.full((hvd.size(),), float(v),
+                                            np.float32), op=hvd.Average)
+                logs[k] = float(np.asarray(hvd.gather(out))[0])
+
+
+class LearningRateScheduleCallback(Callback):
+    """Epoch-driven LR multiplier (reference _keras/callbacks.py
+    LearningRateScheduleCallback): within [start_epoch, end_epoch) set
+    ``trainer.lr = initial_lr * multiplier(epoch)``; ``staircase=False``
+    interpolates smoothly per batch using ``steps_per_epoch``."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda _e: multiplier))
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._epoch: float = 0.0
+
+    def _in_range(self) -> bool:
+        return (self._epoch >= self.start_epoch
+                and (self.end_epoch is None or self._epoch < self.end_epoch))
+
+    def _apply(self):
+        if self._in_range():
+            self.trainer.lr = self.initial_lr * self.multiplier(self._epoch)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = float(epoch)
+        # Without steps_per_epoch there is no sub-epoch position to
+        # interpolate on, so a smooth schedule degrades to per-epoch
+        # application rather than silently never firing.
+        if self.staircase or not self.steps_per_epoch:
+            self._apply()
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase and self.steps_per_epoch:
+            self._epoch = math.floor(self._epoch) + batch / \
+                self.steps_per_epoch
+            self._apply()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from ``initial_lr / size`` to ``initial_lr`` over
+    ``warmup_epochs`` (reference _keras/callbacks.py
+    LearningRateWarmupCallback, implementing Goyal et al. linear-scaling
+    warmup: lr = initial_lr * (1 + progress * (size - 1)) / size)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        import horovod_tpu as hvd
+
+        size = hvd.size()
+
+        def multiplier(epoch: float) -> float:
+            progress = min(epoch / warmup_epochs, 1.0)
+            return (1.0 + progress * (size - 1)) / size
+
+        # end_epoch=None: the multiplier clamps at 1, so past warmup the
+        # callback keeps trainer.lr pinned at exactly initial_lr (trainer.lr
+        # persists between batches, unlike the reference's Keras lr
+        # variable which the base optimizer owns after warmup).
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=None, staircase=False,
+                         steps_per_epoch=steps_per_epoch)
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose and epoch + 1 == self.warmup_epochs:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self.trainer.lr}.")
+
+
+class BestModelCheckpoint(Callback):
+    """Save params (+opt_state) when the monitored metric improves; rank-0
+    writer (reference keras/callbacks.py:157 BestModelCheckpoint —
+    save_best_only, rank-0-only). Backed by the async orbax manager."""
+
+    def __init__(self, directory: str, monitor: str = "val_loss",
+                 mode: str = "min", save_optimizer: bool = False,
+                 max_to_keep: int = 1):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.directory = directory
+        self.monitor = monitor
+        self.mode = mode
+        self.save_optimizer = save_optimizer
+        self.max_to_keep = max_to_keep
+        self.best: Optional[float] = None
+        self._mgr = None
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        return value < self.best if self.mode == "min" else value > self.best
+
+    def on_train_begin(self, logs=None):
+        import jax
+
+        if jax.process_index() == 0:
+            from .checkpoint import CheckpointManager
+
+            self._mgr = CheckpointManager(self.directory,
+                                          max_to_keep=self.max_to_keep)
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None or not self._improved(float(value)):
+            return
+        self.best = float(value)
+        if self._mgr is not None:
+            tree = {"params": self.trainer.params}
+            if self.save_optimizer:
+                tree["opt_state"] = self.trainer.opt_state
+            self._mgr.save(epoch, tree, force=True)
+
+    def on_train_end(self, logs=None):
+        if self._mgr is not None:
+            self._mgr.wait()
+            self._mgr.close()
+            self._mgr = None
+
+
+# -- elastic callbacks (reference _keras/elastic.py:86) ---------------------
+
+class CommitStateCallback(Callback):
+    """``state.commit()`` every ``batches_per_commit`` batches."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+
+    def on_batch_end(self, batch, logs=None):
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback(Callback):
+    """Track current batch in elastic state so a restored worker resumes
+    mid-epoch."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def on_batch_end(self, batch, logs=None):
+        self.state.batch = batch + 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.state.batch = 0
+
+
+class UpdateEpochStateCallback(Callback):
+    """Track current epoch in elastic state."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.state.epoch = epoch

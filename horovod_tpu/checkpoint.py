@@ -1,0 +1,205 @@
+"""Async checkpoint/resume subsystem.
+
+The reference has no monolithic checkpoint layer — it composes elastic
+``State.save/restore/sync`` held in host memory (common/elastic.py:95-110),
+``broadcast_object`` for restart consistency (tensorflow/functions.py:47-135)
+and rank-0-only Keras ``BestModelCheckpoint`` (keras/callbacks.py:157), with
+Spark's Store persisting to HDFS/S3 (spark/common/store.py). SURVEY.md §5
+calls for a real async checkpoint layer to reach capability parity on TPU —
+this module provides it over orbax (async device→host→disk with the step
+function still running), plus a pure-pickle fallback store for objects.
+
+Design notes (TPU-first):
+- Saves are asynchronous: the device→host copy happens immediately, the
+  disk write on a background thread (orbax AsyncCheckpointer), so the
+  training step is blocked only for the HBM readout, not the filesystem.
+- In multi-process jobs every process participates (orbax coordinates
+  per-shard writes); the ``rank0_only`` flag exists for the reference's
+  single-writer semantics when saving replicated trees.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    """Versioned, async, garbage-collected checkpoint directory.
+
+    Capability analog of elastic State persistence + Spark Store
+    (reference spark/common/store.py:1-504) re-built on orbax.
+
+    Usage::
+
+        mgr = hvd.checkpoint.CheckpointManager("/ckpts", max_to_keep=3)
+        mgr.save(step, {"params": params, "opt_state": opt_state})
+        tree = mgr.restore()            # latest, original structure
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 save_interval_steps: int = 1,
+                 rank0_only: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.rank0_only = rank0_only
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    # -- write side --------------------------------------------------------
+
+    def save(self, step: int, tree: Any, force: bool = False) -> bool:
+        """Async-save ``tree`` at ``step``; returns False if the manager's
+        save-interval policy skipped it."""
+        if self.rank0_only and jax.process_index() != 0:
+            return False
+        return self._mgr.save(
+            step, args=self._ocp.args.StandardSave(tree), force=force)
+
+    def wait(self) -> None:
+        """Block until all in-flight async saves hit disk."""
+        self._mgr.wait_until_finished()
+
+    # -- read side ---------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, step: Optional[int] = None,
+                target: Any = None) -> Any:
+        """Restore ``step`` (default: latest). ``target`` — an example tree
+        (or abstract tree of jax.ShapeDtypeStruct) used to restore with
+        matching shardings/dtypes; without it, arrays come back as numpy.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        if target is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=getattr(
+                                                   x, "sharding", None))
+                if hasattr(x, "shape") else x, target)
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()
+
+
+class ObjectStore:
+    """Pickle store for small host objects (rng state, epoch counters,
+    dataloader cursors) alongside array checkpoints — the analog of the
+    reference's Store metadata files (spark/common/store.py) and
+    ObjectState host-memory snapshots (common/elastic.py:95-110)."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}.pkl")
+
+    def put(self, name: str, obj: Any) -> None:
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, self._path(name))
+
+    def get(self, name: str, default: Any = None) -> Any:
+        try:
+            with open(self._path(name), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return default
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+
+def save_state(state, directory: str, step: int,
+               max_to_keep: int = 5) -> None:
+    """One-shot: persist an elastic ``JaxState``'s committed snapshot to
+    disk so a job can resume across full restarts (capability the
+    reference reaches via Spark Store; common/elastic.py State only
+    survives within a process). Persists the last *committed* snapshot —
+    host-side copies that are valid even if live attributes are mid-step
+    device arrays or the mesh is already gone."""
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+    arrays = {}
+    objects = {}
+    for k, v in state.committed_items():
+        # Only pure numeric-array pytrees go to orbax; anything with
+        # non-numeric leaves (e.g. a metadata dict of strings — which the
+        # JaxState snapshot turns into numpy <U arrays that do have
+        # .shape) goes to the pickle store — tensorstore rejects str/object
+        # dtypes.
+        if _is_numeric_array(v) or _is_tree(v):
+            arrays[k] = v
+        else:
+            objects[k] = v
+    try:
+        mgr.save(step, {"arrays": arrays}, force=True)
+        mgr.wait()
+    finally:
+        mgr.close()
+    ObjectStore(directory).put("state_objects", {"step": step, **objects})
+
+
+def restore_state(state, directory: str) -> int:
+    """Inverse of :func:`save_state`; loads the latest step into ``state``
+    attributes and returns the step number."""
+    mgr = CheckpointManager(directory)
+    try:
+        restored = mgr.restore()
+    finally:
+        mgr.close()
+    for k, v in restored["arrays"].items():
+        setattr(state, k, v)
+    objs = ObjectStore(directory).get("state_objects", {})
+    step = objs.pop("step", 0)
+    for k, v in objs.items():
+        setattr(state, k, v)
+    state.save()  # committed snapshot = what we just restored
+    return step
+
+
+def _is_numeric_array(x) -> bool:
+    if not (hasattr(x, "shape") and hasattr(x, "dtype")):
+        return False
+    import numpy as np
+
+    # kind: 'U'nicode / byte'S'tring / 'O'bject are unserializable by
+    # tensorstore; everything else (incl. ml_dtypes like bfloat16, kind
+    # 'V'/'f') is fine.
+    return np.dtype(x.dtype).kind not in ("U", "S", "O")
+
+
+def _is_tree(v) -> bool:
+    leaves = jax.tree.leaves(v)
+    return bool(leaves) and all(_is_numeric_array(x) for x in leaves)

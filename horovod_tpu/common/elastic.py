@@ -87,6 +87,17 @@ class ObjectState(State):
             self._attrs[k] = v
         object.__setattr__(self, k, v)
 
+    def items(self):
+        """Live (name, value) view of the tracked attributes."""
+        return [(k, getattr(self, k)) for k in self._attrs]
+
+    def committed_items(self):
+        """(name, value) pairs of the last committed snapshot — host-side
+        copies safe to persist even mid-step or after a mesh teardown
+        (consumed by horovod_tpu.checkpoint.save_state)."""
+        assert self._saved is not None
+        return list(self._saved.items())
+
     def save(self) -> None:
         self._saved = copy.deepcopy(
             {k: getattr(self, k) for k in self._attrs})

@@ -1,0 +1,7 @@
+"""``hvd.elastic`` namespace — reference horovod/torch/elastic,
+horovod/tensorflow/elastic.py public surface (State/ObjectState + run
+wrapper), re-exported from the framework-agnostic core."""
+
+from .common.elastic import (  # noqa: F401
+    JaxState, ObjectState, State, run)
+from .checkpoint import restore_state, save_state  # noqa: F401

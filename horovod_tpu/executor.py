@@ -1,0 +1,222 @@
+"""Persistent worker-pool executor — the cluster-integration layer.
+
+Reference: horovod/ray/runner.py:90-482 (``RayExecutor``: placement-group
+workers that stay alive across ``run()`` calls, a ``Coordinator`` that
+collects hostnames and builds the rendezvous env) and horovod/spark's
+run-fn-in-executors model (spark/runner.py:132-417).
+
+TPU-native: workers are OS processes wired into one ``jax.distributed``
+world by the same env bootstrap the launcher uses; the driver talks to them
+over length-prefixed pickle frames on loopback/DCN TCP sockets (the role
+Ray's actor channel / Spark's task service plays). Because workers persist,
+JAX backends and compiled step caches survive across ``run()`` calls —
+the property that makes RayExecutor useful for interactive work.
+
+No Ray/Spark dependency: the scheduling substrate here is plain processes;
+on a managed cluster the same Executor protocol runs over ssh fan-out.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+# -- framing ----------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class Executor:
+    """Pool of ``np`` persistent workers; ``run()`` executes a function on
+    every worker and returns per-rank results (RayExecutor.run contract).
+
+    Usage::
+
+        with hvd.executor.Executor(np=4) as ex:
+            ex.run(setup_fn)          # hvd.init() once, stays warm
+            for epoch in range(10):
+                losses = ex.run(train_epoch, args=(epoch,))
+    """
+
+    def __init__(self, np: int = 2, env: Optional[Dict[str, str]] = None,
+                 start_timeout_s: float = 60.0):
+        self.np = np
+        self.env = dict(env or {})
+        self.start_timeout_s = start_timeout_s
+        self._procs: List[subprocess.Popen] = []
+        self._socks: Dict[int, socket.socket] = {}
+        self._server: Optional[socket.socket] = None
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Executor":
+        from .runner import launch as launch_lib
+
+        if self._started:
+            return self
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("127.0.0.1", 0))
+        self._server.listen(self.np)
+        self._server.settimeout(self.start_timeout_s)
+        driver_addr = "127.0.0.1:%d" % self._server.getsockname()[1]
+
+        coordinator = "127.0.0.1:%d" % launch_lib._free_port()
+        try:
+            for i in range(self.np):
+                env = launch_lib.build_env_for_slot(
+                    dict(os.environ), coordinator, self.np, i, self.env)
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "horovod_tpu.executor",
+                     driver_addr], env=env)
+                self._procs.append(p)
+            for _ in range(self.np):
+                sock, _ = self._server.accept()
+                pid = pickle.loads(_recv_frame(sock))
+                self._socks[pid] = sock
+        except BaseException:
+            # A worker died before connecting (or accept timed out):
+            # reap everything — a failed start must not leak processes
+            # or the server socket (start() raising skips __exit__).
+            for p in self._procs:
+                p.kill()
+            for p in self._procs:
+                p.wait()
+            for sock in self._socks.values():
+                sock.close()
+            self._server.close()
+            self._procs.clear()
+            self._socks.clear()
+            self._server = None
+            raise
+        self._started = True
+        return self
+
+    def shutdown(self) -> None:
+        for sock in self._socks.values():
+            try:
+                _send_frame(sock, pickle.dumps(("stop", None)))
+                sock.close()
+            except OSError:
+                pass
+        for p in self._procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self._server is not None:
+            self._server.close()
+        self._socks.clear()
+        self._procs.clear()
+        self._started = False
+
+    def __enter__(self) -> "Executor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[Dict[str, Any]] = None) -> List[Any]:
+        """Run ``fn(*args, **kwargs)`` on all workers; returns results in
+        rank order. A worker exception raises RuntimeError with the remote
+        traceback (all workers still complete the round — SPMD programs
+        must not be torn down mid-collective)."""
+        import cloudpickle
+
+        if not self._started:
+            raise RuntimeError("Executor not started (use .start() or with)")
+        payload = cloudpickle.dumps(("run", (fn, args, kwargs or {})))
+        results: Dict[int, Any] = {}
+        errors: Dict[int, str] = {}
+        lock = threading.Lock()
+
+        def one(pid: int, sock: socket.socket) -> None:
+            try:
+                _send_frame(sock, payload)
+                status, value = pickle.loads(_recv_frame(sock))
+                with lock:
+                    (results if status == "ok" else errors)[pid] = value
+            except (OSError, ConnectionError, EOFError) as e:
+                with lock:
+                    errors[pid] = f"transport error: {e!r}"
+
+        threads = [threading.Thread(target=one, args=item)
+                   for item in self._socks.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            detail = "\n".join(f"worker {pid}:\n{tb}"
+                               for pid, tb in sorted(errors.items()))
+            raise RuntimeError(f"Executor.run failed:\n{detail}")
+        return [results[pid] for pid in sorted(results)]
+
+    def execute_single(self, fn: Callable, args: tuple = (),
+                       kwargs: Optional[Dict[str, Any]] = None,
+                       rank: int = 0) -> Any:
+        """Run on one worker only (RayExecutor.execute_single analog).
+        Note: ``fn`` must not issue collectives — the other ranks are not
+        participating in this call."""
+        import cloudpickle
+
+        sock = self._socks[rank]
+        _send_frame(sock, cloudpickle.dumps(("run", (fn, args,
+                                                     kwargs or {}))))
+        status, value = pickle.loads(_recv_frame(sock))
+        if status != "ok":
+            raise RuntimeError(f"worker {rank}:\n{value}")
+        return value
+
+
+# -- worker side ------------------------------------------------------------
+
+def _worker_main(driver_addr: str) -> int:
+    import traceback
+
+    host, port = driver_addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)))
+    pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    _send_frame(sock, pickle.dumps(pid))
+    while True:
+        cmd, payload = pickle.loads(_recv_frame(sock))
+        if cmd == "stop":
+            return 0
+        fn, args, kwargs = payload
+        try:
+            reply = ("ok", fn(*args, **kwargs))
+        except BaseException as e:
+            reply = ("error", "".join(traceback.format_exception(
+                type(e), e, e.__traceback__)))
+        _send_frame(sock, pickle.dumps(reply))
+
+
+if __name__ == "__main__":
+    sys.exit(_worker_main(sys.argv[1]))

@@ -91,11 +91,25 @@ def allgather_object(obj: Any, name: str = "obj") -> List[Any]:
 
 
 def broadcast_variables(tree, root_rank: int = 0):
-    """Eager broadcast of a pytree of arrays via the engine (reference:
-    tensorflow/functions.py:47 broadcast_variables). For the in-jit path use
+    """Eager broadcast of a pytree of arrays via the engine; every leaf
+    comes back with its original shape holding root's value (reference:
+    tensorflow/functions.py:47 broadcast_variables — in-place same-shape
+    assignment). For the in-jit path use
     horovod_tpu.optim.broadcast_parameters."""
     ctx = basics.context()
     import jax
 
-    return jax.tree.map(
-        lambda v: ctx.engine.broadcast(v, root_rank), tree)
+    def one(v):
+        arr = np.asarray(v)
+        # Replicate explicitly: _as_distributed would mis-read a leaf whose
+        # leading dim happens to equal world size as an already rank-major
+        # stack and scatter it, corrupting e.g. an (8, d) weight on an
+        # 8-rank mesh.
+        out = ctx.engine.broadcast(ctx.engine.replicate(arr), root_rank)
+        # Rows are identical post-broadcast; fetch only this process's
+        # first addressable shard row instead of device_get'ing the full
+        # (size, *shape) stack (a size× overfetch on big param trees).
+        shard = np.asarray(out.addressable_shards[0].data)
+        return shard[0].astype(arr.dtype, copy=False)
+
+    return jax.tree.map(one, tree)

@@ -1,2 +1,86 @@
 """Launcher: hvdtpurun CLI, rendezvous KV server, host assignment, elastic
-driver."""
+driver, and the programmatic ``run()`` API.
+
+Reference: horovod/runner/__init__.py:91-206 (``horovod.run`` "interactive
+run" — cloudpickles the user function and launches it through the same
+machinery as the CLI). Same contract here: ``run(func, np=N)`` returns the
+per-rank results as a list ordered by process id.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+
+def run(func: Callable,
+        args: tuple = (),
+        kwargs: Optional[Dict[str, Any]] = None,
+        np: int = 2,
+        hosts: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        use_ssh: bool = False,
+        verbose: bool = False) -> List[Any]:
+    """Launch ``func(*args, **kwargs)`` on ``np`` workers; return results.
+
+    Local mode (default) forks ``np`` processes on this machine wired
+    through the same env bootstrap as the ``hvdtpurun`` CLI — inside each
+    worker ``hvd.init()`` joins the multi-process world. ``hosts``
+    ("h1:4,h2:4") with ``use_ssh=True`` fans out over ssh instead
+    (reference gloo_run ssh path).
+
+    Returns ``[result_rank0, result_rank1, ...]`` (reference
+    runner/__init__.py returns the same list shape). A worker exception
+    re-raises here as RuntimeError carrying the remote traceback.
+    """
+    import cloudpickle
+
+    from . import hosts as hosts_lib
+    from . import launch as launch_lib
+
+    kwargs = kwargs or {}
+    # ssh mode: payload/results travel via the filesystem, so the exchange
+    # dir must live on a path shared with the workers (run_ssh cd's them
+    # into our cwd — assumed shared, e.g. NFS/GCS-fuse). Local mode can use
+    # the faster node-local TMPDIR.
+    exchange_root = os.getcwd() if use_ssh else None
+    with tempfile.TemporaryDirectory(prefix=".hvd_tpu_run_",
+                                     dir=exchange_root) as tmp:
+        payload = os.path.join(tmp, "payload.pkl")
+        with open(payload, "wb") as f:
+            cloudpickle.dump((func, args, kwargs), f)
+        command = [sys.executable, "-m", "horovod_tpu.runner.task_fn",
+                   payload, tmp]
+        env_extra = dict(env or {})
+        if use_ssh:
+            if not hosts:
+                raise ValueError("use_ssh=True requires hosts=")
+            host_infos = hosts_lib.parse_hosts(hosts)
+            rc = launch_lib.run_ssh(host_infos, command, env_extra, np,
+                                    verbose=verbose)
+            num_proc = len(launch_lib.used_hosts(host_infos, np))
+        else:
+            rc = launch_lib.run_local(np, command, env_extra,
+                                      verbose=verbose)
+            num_proc = np
+
+        results: List[Any] = []
+        errors: List[str] = []
+        for pid in range(num_proc):
+            path = os.path.join(tmp, f"result_{pid}.pkl")
+            if not os.path.exists(path):
+                errors.append(f"worker {pid}: no result (crashed?)")
+                continue
+            with open(path, "rb") as f:
+                status, value = pickle.load(f)
+            if status == "error":
+                errors.append(f"worker {pid}:\n{value}")
+            else:
+                results.append(value)
+        if rc != 0 or errors:
+            raise RuntimeError(
+                "run() failed (exit code %d):\n%s" % (rc, "\n".join(errors)))
+        return results

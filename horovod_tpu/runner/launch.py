@@ -120,6 +120,18 @@ def run_local(np: int, command: List[str], env_extra: Dict[str, str],
     return _wait_fail_fast(procs, threads)
 
 
+def used_hosts(host_infos: List[hosts_lib.HostInfo], np: int) -> List[str]:
+    """Ordered dedup of the hosts covering ``np`` slots — the single source
+    of truth for the ssh process count (shared with runner.run so the
+    driver polls for exactly the result files run_ssh spawns)."""
+    slots = hosts_lib.get_host_assignments(host_infos, np)
+    ordered: List[str] = []
+    for s in slots:
+        if s.hostname not in ordered:
+            ordered.append(s.hostname)
+    return ordered
+
+
 def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
             env_extra: Dict[str, str], np: int,
             verbose: bool = False,
@@ -130,16 +142,12 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     is the number of hosts covering ``np`` slots — unlike local mode which
     forks one process per slot. Rank-0 host runs the jax.distributed
     coordinator."""
-    slots = hosts_lib.get_host_assignments(host_infos, np)
-    used_hosts: List[str] = []
-    for s in slots:
-        if s.hostname not in used_hosts:
-            used_hosts.append(s.hostname)
-    num_proc = len(used_hosts)
-    coord = f"{used_hosts[0]}:{_free_port()}"
+    hosts = used_hosts(host_infos, np)
+    num_proc = len(hosts)
+    coord = f"{hosts[0]}:{_free_port()}"
     procs = []
     threads = []
-    for i, hostname in enumerate(used_hosts):
+    for i, hostname in enumerate(hosts):
         env = build_env_for_slot({}, coord, num_proc, i, env_extra)
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         remote_cmd = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \

@@ -1,0 +1,43 @@
+"""Worker entry point for the programmatic ``run()`` API.
+
+Reference: horovod/runner/task_fn.py (66 LoC) — each launched worker
+deserializes the cloudpickled user function, executes it, and reports the
+result back to the driver. Here results travel over the shared filesystem
+(one pickle per process id) instead of the reference's network service;
+the launcher already wired HVD_TPU_PROC_ID/NUM_PROC/COORDINATOR env so the
+function can ``hvd.init()`` into the multi-process world.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main(payload_path: str, out_dir: str) -> int:
+    import cloudpickle
+
+    pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    try:
+        with open(payload_path, "rb") as f:
+            func, args, kwargs = cloudpickle.load(f)
+        result = func(*args, **kwargs)
+        status = "ok"
+    except BaseException as e:  # report, then re-raise for the exit code
+        result = "".join(traceback.format_exception(
+            type(e), e, e.__traceback__))
+        status = "error"
+    tmp = os.path.join(out_dir, f".result_{pid}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump((status, result), f)
+    os.replace(tmp, os.path.join(out_dir, f"result_{pid}.pkl"))
+    if status == "error":
+        sys.stderr.write(result)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

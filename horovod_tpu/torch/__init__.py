@@ -1,0 +1,264 @@
+"""PyTorch binding shim — the reference ``horovod.torch`` API surface
+hosted on the TPU-native collective engine.
+
+Reference: horovod/torch/mpi_ops.py:85-646 (handle model:
+allreduce_async_/poll/synchronize), horovod/torch/optimizer.py:103-207
+(DistributedOptimizer hooking each parameter's grad accumulator),
+horovod/torch/functions.py:30-108 (broadcast_parameters /
+broadcast_optimizer_state).
+
+Role in the TPU framework: training *compute* belongs on TPU via JAX — but
+the reference's users arrive with torch data pipelines, torch metrics, and
+host-side torch models (evaluation, RL actors, teachers). This shim gives
+those host-side torch components the same five collectives, backed by the
+same engine/controller/fusion machinery as the JAX path, so a migration can
+move one piece at a time. Tensors cross at the numpy boundary (torch CPU
+tensors share memory with numpy, so the copy in is free; TPU execution
+happens inside the engine).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+import torch
+
+import horovod_tpu as _hvd
+from horovod_tpu.ops.collectives import ReduceOp
+
+# re-exported basics (reference torch/__init__.py surface)
+init = _hvd.init
+shutdown = _hvd.shutdown
+is_initialized = _hvd.is_initialized
+rank = _hvd.rank
+size = _hvd.size
+local_rank = _hvd.local_rank
+local_size = _hvd.local_size
+Average, Sum, Adasum, Min, Max, Product = (
+    _hvd.Average, _hvd.Sum, _hvd.Adasum, _hvd.Min, _hvd.Max, _hvd.Product)
+
+
+def _engine():
+    from horovod_tpu.common import basics
+
+    return basics.context().engine
+
+
+def _replicated(tensor: torch.Tensor):
+    """Torch tensor -> explicitly replicated distributed tensor. Explicit
+    replicate (not _as_distributed) so a tensor whose leading dim happens
+    to equal world size is not mis-read as an already rank-major stack
+    and scattered (same hazard fixed in functions.broadcast_variables)."""
+    return _engine().replicate(tensor.detach().cpu().numpy())
+
+
+def _to_host(dt) -> np.ndarray:
+    """Distributed (size, *shape) result -> this rank's row on host.
+    Reads only the first addressable shard instead of device_get'ing the
+    full stack (a size x overfetch on large tensors)."""
+    return np.asarray(dt.addressable_shards[0].data)[0]
+
+
+# -- collectives (reference torch/mpi_ops.py) -------------------------------
+
+def allreduce(tensor: torch.Tensor, op: ReduceOp = Average,
+              name: Optional[str] = None,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0) -> torch.Tensor:
+    e = _engine()
+    out = e.allreduce(_replicated(tensor), op, name,
+                      prescale_factor, postscale_factor)
+    return torch.from_numpy(_to_host(out).copy()).to(tensor.dtype)
+
+
+def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
+               name: Optional[str] = None) -> torch.Tensor:
+    tensor.copy_(allreduce(tensor, op, name))
+    return tensor
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Concatenate along dim 0 over ranks (reference allgather contract).
+    Under single-controller SPMD every rank holds this tensor, so the
+    result is ``size`` stacked copies reshaped to (size*n, ...)."""
+    e = _engine()
+    out = _to_host(e.allgather(_replicated(tensor), name))
+    return torch.from_numpy(out.reshape((-1,) + tuple(tensor.shape[1:]))
+                            .copy()).to(tensor.dtype)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int = 0,
+              name: Optional[str] = None) -> torch.Tensor:
+    e = _engine()
+    out = e.broadcast(_replicated(tensor), root_rank, name)
+    return torch.from_numpy(_to_host(out).copy()).to(tensor.dtype)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int = 0,
+               name: Optional[str] = None) -> torch.Tensor:
+    tensor.copy_(broadcast(tensor, root_rank, name))
+    return tensor
+
+
+def alltoall(tensor: torch.Tensor,
+             name: Optional[str] = None) -> torch.Tensor:
+    e = _engine()
+    out = _to_host(e.alltoall(_replicated(tensor), name))
+    return torch.from_numpy(out.copy()).to(tensor.dtype)
+
+
+# -- async handle model (reference torch/mpi_ops.py:223-646) ----------------
+
+def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
+                    name: Optional[str] = None) -> int:
+    """Launches the collective (XLA dispatch is async — the reference's
+    background-thread asynchrony maps onto the XLA stream) and returns an
+    int handle; the device→host copy happens in synchronize()."""
+    e = _engine()
+    out = e.allreduce(_replicated(tensor), op, name)
+    return e.handles.allocate(out)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    e = _engine()
+    out = e.broadcast(_replicated(tensor), root_rank, name)
+    return e.handles.allocate(out)
+
+
+def poll(handle: int) -> bool:
+    return _engine().poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    val = _engine().synchronize(handle)
+    if isinstance(val, torch.Tensor):
+        return val
+    return torch.from_numpy(_to_host(val).copy())
+
+
+# -- parameter/optimizer broadcast (reference torch/functions.py:30-108) ----
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or iterable of (name, tensor)."""
+    if hasattr(params, "items"):
+        items: Iterable[Tuple[str, torch.Tensor]] = params.items()
+    else:
+        items = params
+    for name, p in items:
+        if isinstance(p, torch.Tensor):
+            broadcast_(p.data if p.requires_grad else p, root_rank,
+                       name=f"bcast.{name}")
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer hyper/state tensors + scalars from root
+    (reference torch/functions.py broadcast_optimizer_state: state tensors
+    via collectives, scalars via the object channel)."""
+    from horovod_tpu.functions import broadcast_object
+
+    state_dict = optimizer.state_dict()
+    tensors = {}
+    for gi, group_state in state_dict["state"].items():
+        for k, v in group_state.items():
+            if isinstance(v, torch.Tensor):
+                tensors[f"opt.{gi}.{k}"] = v
+            else:
+                group_state[k] = broadcast_object(
+                    v, root_rank, name=f"opt.{gi}.{k}")
+    for name, t in tensors.items():
+        broadcast_(t, root_rank, name=name)
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for k in list(group.keys()):
+            if k != "params":
+                group[k] = broadcast_object(group[k], root_rank,
+                                            name=f"grp.{gi}.{k}")
+    optimizer.load_state_dict(state_dict)
+
+
+# -- DistributedOptimizer (reference torch/optimizer.py:103-207) ------------
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: grad-accumulator hooks launch one async
+    allreduce per parameter; ``step()`` synchronizes all handles then runs
+    the wrapped optimizer on the averaged gradients — the reference's
+    overlap model (torch/optimizer.py:103-207), with the engine's
+    controller/fusion doing the bucketing the C++ core did."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None, op: ReduceOp = Average,
+                 backward_passes_per_step: int = 1):
+        self._inner = optimizer
+        self.op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        self._passes = 0
+        self._handles = {}
+        self._names = {}
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+        self._hooks = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._hooks.append(p.register_post_accumulate_grad_hook(
+                        self._make_hook()))
+
+    # expose the wrapped optimizer's surface
+    @property
+    def param_groups(self):
+        return self._inner.param_groups
+
+    @param_groups.setter
+    def param_groups(self, v):
+        self._inner.param_groups = v
+
+    @property
+    def state(self):
+        return self._inner.state
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, sd):
+        self._inner.load_state_dict(sd)
+
+    def zero_grad(self, set_to_none: bool = True):
+        self._inner.zero_grad(set_to_none=set_to_none)
+
+    def _make_hook(self):
+        def hook(p: torch.Tensor) -> None:
+            if self._passes + 1 < self.backward_passes_per_step:
+                return  # local aggregation round: don't reduce yet
+            name = self._names.get(id(p), f"grad.{id(p)}")
+            self._handles[id(p)] = (p, allreduce_async(
+                p.grad, op=self.op, name=name))
+
+        return hook
+
+    def synchronize(self) -> None:
+        for p, handle in self._handles.values():
+            reduced = synchronize(handle)
+            p.grad.copy_(reduced)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self._passes += 1
+        if self._passes < self.backward_passes_per_step:
+            # Local aggregation: skip the global step (the reference
+            # divides lr instead; callers here just don't step).
+            return None
+        self.synchronize()
+        self._passes = 0
+        return self._inner.step(closure)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         op: ReduceOp = Average,
+                         backward_passes_per_step: int = 1
+                         ) -> _DistributedOptimizer:
+    return _DistributedOptimizer(optimizer, named_parameters, op,
+                                 backward_passes_per_step)

@@ -1,0 +1,131 @@
+"""Callback suite (reference horovod/_keras/callbacks.py semantics)."""
+
+import types
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import callbacks as cb
+
+
+def _trainer(**kw):
+    t = types.SimpleNamespace(params={"w": jnp.ones(3)},
+                              opt_state={"m": jnp.zeros(3)}, lr=0.0,
+                              state=None)
+    for k, v in kw.items():
+        setattr(t, k, v)
+    return t
+
+
+def test_callback_list_dispatch_and_binding(hvd):
+    seen = []
+
+    class Probe(cb.Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            seen.append(epoch)
+
+    t = _trainer()
+    cl = cb.CallbackList([Probe(), Probe()], t)
+    cl.on_epoch_begin(3)
+    assert seen == [3, 3]
+    assert all(c.trainer is t for c in cl.callbacks)
+
+
+def test_broadcast_variables_callback(hvd):
+    t = _trainer()
+    cl = cb.CallbackList([cb.BroadcastVariablesCallback(0)], t)
+    cl.on_train_begin()
+    np.testing.assert_allclose(np.asarray(t.params["w"]), np.ones(3))
+    np.testing.assert_allclose(np.asarray(t.opt_state["m"]), np.zeros(3))
+
+
+def test_metric_average_callback(hvd):
+    logs = {"loss": 2.0, "name": "not-a-number"}
+    cl = cb.CallbackList([cb.MetricAverageCallback()], _trainer())
+    cl.on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(2.0)  # identical across ranks
+    assert logs["name"] == "not-a-number"
+
+
+def test_lr_schedule_staircase(hvd):
+    t = _trainer()
+    sched = cb.LearningRateScheduleCallback(
+        initial_lr=0.1, multiplier=lambda e: 0.5 ** e,
+        start_epoch=1, end_epoch=3)
+    cl = cb.CallbackList([sched], t)
+    cl.on_epoch_begin(0)
+    assert t.lr == 0.0                      # before start_epoch: untouched
+    cl.on_epoch_begin(1)
+    assert t.lr == pytest.approx(0.05)
+    cl.on_epoch_begin(2)
+    assert t.lr == pytest.approx(0.025)
+    cl.on_epoch_begin(5)
+    assert t.lr == pytest.approx(0.025)     # past end_epoch: untouched
+
+
+def test_lr_warmup_ramps_to_full(hvd):
+    t = _trainer()
+    warm = cb.LearningRateWarmupCallback(initial_lr=0.8, warmup_epochs=2,
+                                         steps_per_epoch=4)
+    cl = cb.CallbackList([warm], t)
+    size = 8
+    cl.on_epoch_begin(0)
+    cl.on_batch_begin(0)
+    assert t.lr == pytest.approx(0.8 / size)          # cold start: lr/size
+    cl.on_epoch_begin(1)
+    cl.on_batch_begin(4)                               # end of warmup
+    assert t.lr == pytest.approx(0.8)
+
+
+def test_lr_warmup_without_steps_per_epoch_applies_per_epoch(hvd):
+    """steps_per_epoch=None must degrade to epoch-granularity warmup, not
+    silently never fire."""
+    t = _trainer()
+    warm = cb.LearningRateWarmupCallback(initial_lr=0.8, warmup_epochs=2)
+    cl = cb.CallbackList([warm], t)
+    cl.on_epoch_begin(0)
+    assert t.lr == pytest.approx(0.8 / 8)
+    cl.on_epoch_begin(2)
+    assert t.lr == pytest.approx(0.8)
+
+
+def test_best_model_checkpoint(tmp_path, hvd):
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    t = _trainer()
+    best = cb.BestModelCheckpoint(str(tmp_path / "best"), monitor="val_loss",
+                                  mode="min")
+    cl = cb.CallbackList([best], t)
+    cl.on_train_begin()
+    t.params = {"w": jnp.full(3, 1.0)}
+    cl.on_epoch_end(0, {"val_loss": 1.0})
+    t.params = {"w": jnp.full(3, 2.0)}
+    cl.on_epoch_end(1, {"val_loss": 2.0})   # worse: not saved
+    t.params = {"w": jnp.full(3, 3.0)}
+    cl.on_epoch_end(2, {"val_loss": 0.5})   # better: saved
+    cl.on_train_end()
+
+    with CheckpointManager(str(tmp_path / "best")) as mgr:
+        assert mgr.latest_step() == 2
+        out = mgr.restore()
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), 3.0)
+
+
+def test_elastic_state_callbacks(hvd):
+    from horovod_tpu.common.elastic import ObjectState
+
+    state = ObjectState(batch=0, epoch=0)
+    t = _trainer(state=state)
+    commits = []
+    state.commit = lambda: commits.append(True)
+    cl = cb.CallbackList([cb.CommitStateCallback(state, 2),
+                          cb.UpdateBatchStateCallback(state),
+                          cb.UpdateEpochStateCallback(state)], t)
+    cl.on_epoch_begin(4)
+    assert state.epoch == 4
+    cl.on_batch_end(0)
+    cl.on_batch_end(1)
+    assert state.batch == 2 and len(commits) == 1
+    cl.on_epoch_end(4)
+    assert state.batch == 0
