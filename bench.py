@@ -16,6 +16,7 @@ we use 10 samples/s/chip as the nominal P100-era per-device denominator.
 """
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -86,23 +87,24 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
 
     import horovod_tpu as hvd
 
+    nstate = len(params_and_state)
+    donate = tuple(range(nstate))  # update state in place in HBM
     if n > 1:
         from jax.sharding import PartitionSpec as P
 
         ax = hvd.rank_axis()
-        nstate = len(params_and_state)
         in_specs = tuple([P()] * nstate) + tuple([P(ax)] * len(extra_args))
         out_specs = tuple([P()] * nstate) + (P(),)
 
-        @hvd.spmd_step(in_specs=in_specs, out_specs=out_specs)
+        @hvd.spmd_step(in_specs=in_specs, out_specs=out_specs,
+                       donate_argnums=donate)
         def train_step(*all_args):
             state, data = all_args[:nstate], all_args[nstate:]
             out = model_apply_loss(state, data, pmean_axis=ax)
             return out
     else:
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=donate)
         def train_step(*all_args):
-            nstate = len(params_and_state)
             state, data = all_args[:nstate], all_args[nstate:]
             return model_apply_loss(state, data, pmean_axis=None)
 

@@ -193,7 +193,8 @@ def stop_timeline() -> None:
 
 # -- SPMD helpers ----------------------------------------------------------
 
-def spmd_step(fn=None, *, in_specs=None, out_specs=None, check_vma=False):
+def spmd_step(fn=None, *, in_specs=None, out_specs=None, check_vma=False,
+              donate_argnums=()):
     """Decorator: run ``fn`` as a jitted shard_map over the rank mesh with
     per-rank collectives available under ``rank_axis()``. Default specs
     shard the leading axis of every argument over ranks.
@@ -206,6 +207,12 @@ def spmd_step(fn=None, *, in_specs=None, out_specs=None, check_vma=False):
     model). With ``check_vma=True`` JAX's varying-manual-axes type system
     is enforced instead; use ``collective_ops.to_local`` on replicated
     params before ``jax.grad`` in that mode.
+
+    ``donate_argnums``: positions of carry-state arguments (params,
+    opt_state, ...) whose HBM buffers may be reused for the outputs —
+    halves peak memory for the update and avoids a copy. Donated inputs
+    are invalidated; only pass state you immediately overwrite with the
+    step's outputs.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -215,7 +222,8 @@ def spmd_step(fn=None, *, in_specs=None, out_specs=None, check_vma=False):
         ins = in_specs if in_specs is not None else spec
         outs = out_specs if out_specs is not None else spec
         return jax.jit(jax.shard_map(f, mesh=ctx.mesh, in_specs=ins,
-                                     out_specs=outs, check_vma=check_vma))
+                                     out_specs=outs, check_vma=check_vma),
+                       donate_argnums=donate_argnums)
     return deco(fn) if fn is not None else deco
 
 

@@ -121,6 +121,8 @@ def bert_base(**kw) -> Bert:
 
 
 def bert_tiny(**kw) -> Bert:
-    """For tests/dry-runs."""
-    return Bert(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
-                mlp_dim=128, max_len=128, **kw)
+    """For tests/dry-runs. Any field (incl. max_len) is overridable."""
+    cfg = dict(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+               mlp_dim=128, max_len=128)
+    cfg.update(kw)
+    return Bert(**cfg)

@@ -90,6 +90,21 @@ def ring_attention(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
+def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
+    """Adapter producing an ``attend_fn`` for models.bert.Bert (the same
+    drop-in hook ulysses_attend_fn provides): sequence-sharded ring
+    attention for any model accepting attend_fn."""
+
+    def attend(q, k, v, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "ring_attend_fn does not support padding masks; mask "
+                "handling requires rotating the key mask with K/V")
+        return ring_attention(q, k, v, axis_name, causal=causal)
+
+    return attend
+
+
 def reference_attention(q, k, v, causal: bool = False):
     """Single-device reference for tests: q/k/v (B, S, H, D) full sequence.
     """
