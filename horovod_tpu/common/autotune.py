@@ -151,13 +151,22 @@ class Autotuner:
         xs = np.array([[math.log2(t)] for t in self._samples])
         ys = np.array([float(np.mean(v)) for v in self._samples.values()])
         y_mean, y_std = ys.mean(), max(ys.std(), 1e-9)
-        gp = GaussianProcess(length_scale=1.0)
-        gp.fit(xs, (ys - y_mean) / y_std)
-
+        ys_n = (ys - y_mean) / y_std
         grid = np.array([[math.log2(t)] for t in self.candidates])
-        mu, var = gp.predict(grid)
-        best = ((ys - y_mean) / y_std).max()
-        ei = expected_improvement(mu, var, best)
+
+        # Native GP+EI core (native/gp_core.cc — the reference's
+        # gaussian_process.cc+bayesian_optimization.cc analog); numpy
+        # fallback below computes the identical quantities.
+        from .. import native
+
+        native_out = native.gp_ei_native(xs, ys_n, grid, length_scale=1.0)
+        if native_out is not None:
+            ei = np.asarray(native_out[1])
+        else:
+            gp = GaussianProcess(length_scale=1.0)
+            gp.fit(xs, ys_n)
+            mu, var = gp.predict(grid)
+            ei = expected_improvement(mu, var, ys_n.max())
 
         untried = [i for i, t in enumerate(self.candidates)
                    if t not in self._samples]

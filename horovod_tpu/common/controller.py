@@ -134,7 +134,15 @@ class Controller:
         self.transport = transport
         self.timeout_s = timeout_s
         self.ns = namespace
-        self._round = 0
+        # Unbounded, order-independent membership set — deliberately NOT
+        # the bounded LRU (native ResponseCacheNative): every rank must
+        # agree on cache membership or fast paths desynchronize (rank A
+        # hits, rank B posts a request nobody answers). The reference
+        # keeps its bounded cache coherent with per-cycle cross-rank
+        # bitwise AND/OR sync (response_cache.cc CacheCoordinator); with
+        # signatures being ~100-byte strings, unbounded is the simpler
+        # safe choice here. The native LRU serves single-process caches
+        # (e.g. compiled-fn eviction), where coherence is not a concern.
         self._cache: set = set()
         self._lock = threading.Lock()
 
@@ -148,15 +156,21 @@ class Controller:
         with self._lock:
             if sig in self._cache:
                 return Response(True, req.tensor_name)
-            rnd = self._round
-            self._round += 1
 
         if self.size == 1:
             with self._lock:
                 self._cache.add(sig)
             return Response(True, req.tensor_name)
 
-        key_base = f"{self.ns}/{rnd}"
+        # Round key derived from the signature, not a shared counter:
+        # concurrent negotiations from different threads may interleave
+        # differently per process, and a global counter would then pair
+        # mismatched KV keys across ranks (deadlock). Each signature
+        # negotiates at most once (set cache), so the sig itself is a
+        # unique, rank-agreed key.
+        import hashlib
+
+        key_base = f"{self.ns}/{hashlib.sha1(sig.encode()).hexdigest()[:16]}"
         self.transport.set(f"{key_base}/req/{self.rank}", sig)
 
         if self.rank == 0:
@@ -168,9 +182,17 @@ class Controller:
                 other = self.transport.get(f"{key_base}/req/{r}",
                                            self.timeout_s)
                 if other is None:
-                    error = (f"rank {r} did not submit a collective within "
-                             f"{self.timeout_s}s (stalled or diverged "
-                             "program order)")
+                    # Zero-timeout poll of the not-yet-gathered ranks so
+                    # the report names only genuinely missing ranks
+                    # (reference stall_inspector.cc report style), not
+                    # every rank after the first straggler.
+                    missing = [r] + [
+                        r2 for r2 in range(r + 1, self.size)
+                        if self.transport.get(f"{key_base}/req/{r2}",
+                                              0.0) is None]
+                    error = (f"ranks {missing} did not submit a collective "
+                             f"within {self.timeout_s}s (stalled or "
+                             "diverged program order)")
                     break
                 if other != sig:
                     error = (f"rank {r} submitted a mismatched collective: "

@@ -59,6 +59,30 @@ def load() -> Optional[ctypes.CDLL]:
         except OSError as e:
             logger.warning("native library load failed: %s", e)
             return None
+        try:
+            _bind_signatures(lib)
+        except AttributeError:
+            # Stale .so from an older source tree (missing new symbols):
+            # rebuild once, then either bind or fall back to pure Python.
+            if _build_attempted:
+                logger.warning("native library is stale and rebuild "
+                               "already failed; using Python fallbacks")
+                return None
+            _build_attempted = True
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _bind_signatures(lib)
+            except (OSError, AttributeError) as e:
+                logger.warning("native library unusable after rebuild: %s",
+                               e)
+                return None
+        _lib = lib
+        return _lib
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
         # Signatures.
         lib.hvt_timeline_start.argtypes = [ctypes.c_char_p]
         lib.hvt_timeline_start.restype = ctypes.c_int
@@ -95,8 +119,38 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_char_p,
             ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
-        _lib = lib
-        return _lib
+        # controller core
+        lib.hvd_nt_new.argtypes = [ctypes.c_int]
+        lib.hvd_nt_new.restype = ctypes.c_void_p
+        lib.hvd_nt_free.argtypes = [ctypes.c_void_p]
+        lib.hvd_nt_increment.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        lib.hvd_nt_increment.restype = ctypes.c_int
+        lib.hvd_nt_pending.argtypes = [ctypes.c_void_p]
+        lib.hvd_nt_pending.restype = ctypes.c_int64
+        lib.hvd_nt_missing.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_uint8),
+                                       ctypes.c_int]
+        lib.hvd_nt_missing.restype = ctypes.c_int
+        lib.hvd_lru_new.argtypes = [ctypes.c_int64]
+        lib.hvd_lru_new.restype = ctypes.c_void_p
+        lib.hvd_lru_free.argtypes = [ctypes.c_void_p]
+        lib.hvd_lru_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hvd_lru_lookup.restype = ctypes.c_int
+        lib.hvd_lru_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_lru_put.restype = ctypes.c_int
+        lib.hvd_lru_size.argtypes = [ctypes.c_void_p]
+        lib.hvd_lru_size.restype = ctypes.c_int64
+        lib.hvd_lru_erase.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        # GP/EI autotuner core
+        lib.hvd_gp_ei.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+        lib.hvd_gp_ei.restype = ctypes.c_int64
 
 
 def available() -> bool:
@@ -203,6 +257,168 @@ def decode_response(data: bytes) -> Optional[Tuple[bool, str, str]]:
     if rc != 0:
         return None
     return bool(ok.value), name.value.decode(), err.value.decode()
+
+
+# -- controller negotiation core -------------------------------------------
+
+class NegotiationTable:
+    """Native tensor-readiness table (reference IncrementTensorCount,
+    controller.cc:837-860). Falls back to a dict when the native library
+    is unavailable."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lib = load()
+        if self._lib is not None:
+            self._h = self._lib.hvd_nt_new(world_size)
+        else:
+            self._h = None
+            self._pending = {}
+            self._py_lock = threading.Lock()
+
+    def increment(self, name: str, rank: int) -> int:
+        """1 = just became ready (all ranks in), 0 = pending,
+        -1 = duplicate/invalid."""
+        if self._h is not None:
+            return self._lib.hvd_nt_increment(self._h, name.encode(), rank)
+        with self._py_lock:
+            if not 0 <= rank < self.world_size:
+                return -1
+            ranks = self._pending.setdefault(name, set())
+            if rank in ranks:
+                return -1
+            ranks.add(rank)
+            if len(ranks) == self.world_size:
+                del self._pending[name]
+                return 1
+            return 0
+
+    def pending_count(self) -> int:
+        if self._h is not None:
+            return int(self._lib.hvd_nt_pending(self._h))
+        with self._py_lock:
+            return len(self._pending)
+
+    def missing_ranks(self, name: str) -> Optional[List[int]]:
+        """Ranks that have not yet reported `name` (StallInspector input);
+        None if the name is unknown/complete."""
+        if self._h is not None:
+            out = (ctypes.c_uint8 * self.world_size)()
+            n = self._lib.hvd_nt_missing(self._h, name.encode(), out,
+                                         self.world_size)
+            if n < 0:
+                return None
+            return [i for i in range(self.world_size) if out[i]]
+        with self._py_lock:
+            if name not in self._pending:
+                return None
+            got = self._pending[name]
+            return [r for r in range(self.world_size) if r not in got]
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.hvd_nt_free(self._h)
+            self._h = None
+
+
+class ResponseCacheNative:
+    """Bounded LRU signature cache (reference response_cache.cc LRU bits).
+    Falls back to an ordered-dict LRU without the native library."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(int(capacity), 1)
+        self._lib = load()
+        if self._lib is not None:
+            self._h = self._lib.hvd_lru_new(self.capacity)
+            # One reusable out-buffer per cache (not per put call).
+            self._evict_buf = ctypes.create_string_buffer(65536)
+        else:
+            self._h = None
+            import collections
+
+            self._od = collections.OrderedDict()
+            self._py_lock = threading.Lock()
+
+    def lookup(self, key: str) -> bool:
+        if self._h is not None:
+            return bool(self._lib.hvd_lru_lookup(self._h, key.encode()))
+        with self._py_lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                return True
+            return False
+
+    def put(self, key: str, want_evicted: bool = True) -> Optional[str]:
+        """Insert; returns the evicted key if capacity forced one out.
+        Pass ``want_evicted=False`` on hot paths to skip the out-buffer
+        (the native side accepts NULL)."""
+        if self._h is not None:
+            if not want_evicted:
+                self._lib.hvd_lru_put(self._h, key.encode(), None, 0)
+                return None
+            buf = self._evict_buf
+            if self._lib.hvd_lru_put(self._h, key.encode(), buf,
+                                     len(buf)):
+                return buf.value.decode()
+            return None
+        with self._py_lock:
+            if key in self._od:
+                self._od.move_to_end(key)
+                return None
+            self._od[key] = True
+            if len(self._od) > self.capacity:
+                victim, _ = self._od.popitem(last=False)
+                return victim
+            return None
+
+    def erase(self, key: str) -> None:
+        if self._h is not None:
+            self._lib.hvd_lru_erase(self._h, key.encode())
+            return
+        with self._py_lock:
+            self._od.pop(key, None)
+
+    def __len__(self) -> int:
+        if self._h is not None:
+            return int(self._lib.hvd_lru_size(self._h))
+        with self._py_lock:
+            return len(self._od)
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.hvd_lru_free(self._h)
+            self._h = None
+
+
+# -- GP / expected-improvement core ----------------------------------------
+
+def gp_ei_native(x, y, candidates, length_scale: float = 1.0,
+                 noise: float = 1e-4, xi: float = 0.01
+                 ) -> Optional[Tuple[int, List[float]]]:
+    """(argmax index, EI per candidate) via the native GP core, or None if
+    unavailable/numerically failed (caller uses the numpy path)."""
+    lib = load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    x = np.ascontiguousarray(np.atleast_2d(np.asarray(x, dtype=np.float64)))
+    y = np.ascontiguousarray(np.asarray(y, dtype=np.float64))
+    c = np.ascontiguousarray(np.atleast_2d(
+        np.asarray(candidates, dtype=np.float64)))
+    if x.shape[0] != y.shape[0] or x.shape[1] != c.shape[1]:
+        return None
+    n, d = x.shape
+    m = c.shape[0]
+    ei = np.empty(m, dtype=np.float64)
+    dp = ctypes.POINTER(ctypes.c_double)
+    idx = lib.hvd_gp_ei(
+        x.ctypes.data_as(dp), y.ctypes.data_as(dp), n, d,
+        c.ctypes.data_as(dp), m, length_scale, noise, xi,
+        ei.ctypes.data_as(dp), None)
+    if idx < 0:
+        return None
+    return int(idx), ei.tolist()
 
 
 # -- timeline --------------------------------------------------------------

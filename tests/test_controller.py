@@ -49,10 +49,12 @@ def test_cache_fast_path():
     c = Controller(0, 1, transport)
     c.negotiate(_req(0))
     assert c.cache_size() == 1
-    # Second negotiation of the same signature: no new round.
-    rnd_before = c._round
+    # Second negotiation of the same signature: cache hit, no KV traffic
+    # and no cache growth (the reference response-cache fast path).
+    kv_before = dict(transport._data)
     c.negotiate(_req(0))
-    assert c._round == rnd_before
+    assert c.cache_size() == 1
+    assert transport._data == kv_before
 
 
 def test_shape_mismatch_detected():

@@ -147,3 +147,100 @@ def test_native_fusion_threshold_respected():
                                     threshold_bytes=4000 * 3)
     # 3 leaves per bucket (12000 bytes > threshold at 4th).
     assert ids == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3]
+
+# -- controller core (controller_core.cc) -----------------------------------
+
+def test_negotiation_table_lifecycle():
+    nt = native.NegotiationTable(3)
+    assert nt.increment("t", 0) == 0
+    assert nt.increment("t", 0) == -1           # duplicate rank
+    assert nt.increment("t", 5) == -1           # out of range
+    assert nt.missing_ranks("t") == [1, 2]
+    assert nt.pending_count() == 1
+    assert nt.increment("t", 1) == 0
+    assert nt.increment("t", 2) == 1            # all in -> ready + cleared
+    assert nt.pending_count() == 0
+    assert nt.missing_ranks("t") is None
+    # Entry resets: a new round renegotiates from scratch.
+    assert nt.increment("t", 0) == 0
+
+
+def test_negotiation_table_many_tensors():
+    nt = native.NegotiationTable(2)
+    for i in range(100):
+        assert nt.increment(f"g{i}", 0) == 0
+    assert nt.pending_count() == 100
+    for i in range(100):
+        assert nt.increment(f"g{i}", 1) == 1
+    assert nt.pending_count() == 0
+
+
+def test_lru_cache_eviction_order():
+    c = native.ResponseCacheNative(2)
+    assert not c.lookup("a")
+    assert c.put("a") is None
+    assert c.put("b") is None
+    assert c.lookup("a")                        # refresh: b becomes LRU
+    assert c.put("c") == "b"
+    assert len(c) == 2
+    assert c.lookup("a") and c.lookup("c") and not c.lookup("b")
+    c.erase("a")
+    assert not c.lookup("a") and len(c) == 1
+    assert c.put("a") is None                   # reinsert after erase
+
+
+def test_lru_cache_repeat_put_no_eviction():
+    c = native.ResponseCacheNative(2)
+    c.put("a")
+    c.put("b")
+    assert c.put("a") is None                   # refresh, not insert
+    assert len(c) == 2
+
+
+# -- GP/EI core (gp_core.cc) ------------------------------------------------
+
+def test_gp_ei_native_matches_python():
+    import math
+
+    from horovod_tpu.common.autotune import (GaussianProcess,
+                                             expected_improvement)
+
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(0, 4, size=(6, 1))
+    ys = -(xs[:, 0] - 2.0) ** 2 + rng.normal(0, 0.01, 6)
+    ys_n = (ys - ys.mean()) / max(ys.std(), 1e-9)
+    cand = np.linspace(0, 4, 9)[:, None]
+
+    out = native.gp_ei_native(xs, ys_n, cand)
+    assert out is not None
+    idx, ei_native = out
+
+    gp = GaussianProcess(length_scale=1.0)
+    gp.fit(xs, ys_n)
+    mu, var = gp.predict(cand)
+    ei_py = expected_improvement(mu, var, ys_n.max())
+    np.testing.assert_allclose(ei_native, ei_py, rtol=1e-5, atol=1e-7)
+    assert idx == int(np.argmax(ei_py))
+
+
+def test_gp_ei_native_prefers_peak_region():
+    xs = np.array([[0.0], [1.0], [3.0], [4.0]])
+    ys = -(xs[:, 0] - 2.0) ** 2
+    cand = np.array([[0.5], [2.0], [3.5]])
+    out = native.gp_ei_native(xs, ys, cand)
+    assert out is not None and out[0] == 1
+
+
+def test_negotiation_table_invalid_rank_no_phantom_entry():
+    nt = native.NegotiationTable(2)
+    assert nt.increment("x", -1) == -1
+    assert nt.increment("x", 7) == -1
+    assert nt.pending_count() == 0
+    assert nt.missing_ranks("x") is None
+
+
+def test_lru_put_without_evicted_key():
+    c = native.ResponseCacheNative(1)
+    assert c.put("a", want_evicted=False) is None
+    c.put("b", want_evicted=False)          # evicts a silently
+    assert len(c) == 1 and c.lookup("b") and not c.lookup("a")
