@@ -52,7 +52,8 @@ _ctx = _basics.context
 
 def __getattr__(name):
     # Lazy submodules with heavy deps (orbax, TF) — imported on first use.
-    if name in ("checkpoint", "callbacks", "elastic", "executor"):
+    if name in ("checkpoint", "callbacks", "elastic", "executor",
+                "tensorflow", "torch"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

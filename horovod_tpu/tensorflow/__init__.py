@@ -1,0 +1,289 @@
+"""TensorFlow binding shim — the reference ``horovod.tensorflow`` API
+surface hosted on the TPU-native collective engine.
+
+Reference: horovod/tensorflow/__init__.py (allreduce :54-154,
+DistributedOptimizer :465-561, DistributedGradientTape :564-629),
+horovod/tensorflow/functions.py:47-135 (broadcast_variables),
+horovod/keras + horovod/_keras (callbacks, create_distributed_optimizer).
+
+Role: like the torch shim (horovod_tpu/torch), this serves host-side TF
+components during migration — tf.data pipelines, Keras-on-CPU evaluation,
+legacy TF training scripts. Tensors cross at the numpy boundary; the
+collectives run on the engine's XLA path. TPU *training* belongs on the
+JAX surface (hvd.DistributedOptimizer / spmd_step).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+import horovod_tpu as _hvd
+from horovod_tpu.ops.collectives import ReduceOp
+
+# re-exported basics (reference tensorflow/__init__.py surface)
+init = _hvd.init
+shutdown = _hvd.shutdown
+is_initialized = _hvd.is_initialized
+rank = _hvd.rank
+size = _hvd.size
+local_rank = _hvd.local_rank
+local_size = _hvd.local_size
+cross_rank = _hvd.cross_rank
+cross_size = _hvd.cross_size
+Average, Sum, Adasum, Min, Max, Product = (
+    _hvd.Average, _hvd.Sum, _hvd.Adasum, _hvd.Min, _hvd.Max, _hvd.Product)
+Compression = _hvd.Compression
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _engine():
+    from horovod_tpu.common import basics
+
+    return basics.context().engine
+
+
+def _replicated(tensor):
+    """TF tensor -> explicitly replicated distributed tensor (same
+    leading-dim==size hazard note as the torch shim's _replicated)."""
+    return _engine().replicate(np.asarray(tensor))
+
+
+def _to_host(dt) -> np.ndarray:
+    """Distributed (size, *shape) result -> this rank's row, via the
+    first addressable shard only (no full-stack device_get)."""
+    return np.asarray(dt.addressable_shards[0].data)[0]
+
+
+# -- collectives (reference tensorflow/__init__.py:54-208) ------------------
+
+def _bridge(np_fn, tensor, out_shape=None):
+    """Run ``np_fn(numpy_array) -> numpy_array`` against a TF tensor in
+    either eager or graph context. Inside a tf.function the call bridges
+    through py_function so the engine collective runs at execution time —
+    the role the reference's registered TF ops play
+    (tensorflow/mpi_ops.cc HorovodAllreduceOp)."""
+    tf = _tf()
+    if tf.is_tensor(tensor) and not tf.executing_eagerly():
+        out = tf.py_function(lambda t: np_fn(t.numpy()), [tensor],
+                             tensor.dtype)
+        out.set_shape(out_shape if out_shape is not None else tensor.shape)
+        return out
+    return tf.convert_to_tensor(np_fn(np.asarray(tensor)))
+
+
+def _allreduce_np(arr: np.ndarray, op: ReduceOp, name: Optional[str],
+                  prescale_factor: float, postscale_factor: float,
+                  compression=None) -> np.ndarray:
+    out = _engine().allreduce(_engine().replicate(arr), op, name,
+                              prescale_factor, postscale_factor,
+                              compression)
+    return _to_host(out).astype(arr.dtype, copy=False)
+
+
+def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=None):
+    return _bridge(
+        lambda a: _allreduce_np(a, op, name, prescale_factor,
+                                postscale_factor, compression), tensor)
+
+
+def _grouped_allreduce_np(arrs, op: ReduceOp, name: Optional[str],
+                          compression=None):
+    """Fused grouped reduction via the engine's bucketed allreduce_tree
+    (one collective per fusion bucket, not one per tensor)."""
+    e = _engine()
+    dts = [e.replicate(a) for a in arrs]
+    outs = e.allreduce_tree(dts, op, name, compression)
+    return [_to_host(o).astype(a.dtype, copy=False)
+            for o, a in zip(outs, arrs)]
+
+
+def grouped_allreduce(tensors, op: ReduceOp = Average,
+                      name: Optional[str] = None, compression=None):
+    tf = _tf()
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    if any(tf.is_tensor(t) for t in tensors) and not tf.executing_eagerly():
+        outs = tf.py_function(
+            lambda *ts: _grouped_allreduce_np(
+                [t.numpy() for t in ts], op, name, compression),
+            tensors, [t.dtype for t in tensors])
+        for o, t in zip(outs, tensors):
+            o.set_shape(t.shape)
+        return list(outs)
+    return [tf.convert_to_tensor(o) for o in _grouped_allreduce_np(
+        [np.asarray(t) for t in tensors], op, name, compression)]
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate along dim 0 over ranks (reference allgather)."""
+    tf = _tf()
+    e = _engine()
+
+    def np_fn(arr):
+        out = _to_host(e.allgather(e.replicate(arr), name))
+        return out.reshape((-1,) + arr.shape[1:]).astype(arr.dtype,
+                                                         copy=False)
+
+    out_shape = None
+    if tf.is_tensor(tensor) and tensor.shape.rank and \
+            tensor.shape[0] is not None:
+        out_shape = tf.TensorShape([tensor.shape[0] * size()]).concatenate(
+            tensor.shape[1:])
+    return _bridge(np_fn, tensor, out_shape)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    e = _engine()
+    return _bridge(
+        lambda arr: _to_host(e.broadcast(e.replicate(arr), root_rank,
+                                         name)).astype(arr.dtype,
+                                                       copy=False),
+        tensor)
+
+
+def alltoall(tensor, name: Optional[str] = None):
+    e = _engine()
+    return _bridge(
+        lambda arr: _to_host(e.alltoall(e.replicate(arr), name)).astype(
+            arr.dtype, copy=False),
+        tensor)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """In-place assign of root's values onto tf.Variables (reference
+    tensorflow/functions.py:47 broadcast_variables)."""
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v.value(), root_rank,
+                           name=f"bcast.{getattr(v, 'name', i)}"))
+
+
+broadcast_object = _hvd.broadcast_object
+allgather_object = _hvd.allgather_object
+
+
+# -- DistributedGradientTape (reference tensorflow/__init__.py:564-629) -----
+
+class _DistributedGradientTape:
+    def __init__(self, tape, op: ReduceOp = Average,
+                 compression=None):
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        """Same contract as tf.GradientTape.gradient (structure of the
+        result mirrors ``sources``; extra kwargs like
+        unconnected_gradients pass through), with every gradient
+        allreduced via the fused grouped path."""
+        tf = _tf()
+        grads = self._tape.gradient(target, sources, output_gradients,
+                                    **kwargs)
+        flat = tf.nest.flatten(grads)
+        present = [(i, g) for i, g in enumerate(flat) if g is not None]
+        if present:
+            reduced = grouped_allreduce([g for _, g in present],
+                                        op=self._op, name="tape.grads",
+                                        compression=self._compression)
+            for (i, _), r in zip(present, reduced):
+                flat[i] = r
+        return tf.nest.pack_sequence_as(grads, flat)
+
+
+def DistributedGradientTape(tape, op: ReduceOp = Average,
+                            compression=None) -> _DistributedGradientTape:
+    return _DistributedGradientTape(tape, op, compression)
+
+
+# -- Keras optimizer wrapper (reference _keras/__init__.py:28-135) ----------
+
+def DistributedOptimizer(optimizer, op: ReduceOp = Average,
+                         name: Optional[str] = None):
+    """Wrap a keras optimizer so apply_gradients allreduces first. Like
+    the reference (_keras/__init__.py:28-135 create_distributed_optimizer)
+    this dynamically subclasses the optimizer's own class and rebuilds it
+    from config — keras requires a genuine Optimizer instance in
+    compile()."""
+    cls = optimizer.__class__
+    reduce_op = op
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        gv = list(grads_and_vars)
+        present = [(i, g) for i, (g, _) in enumerate(gv) if g is not None]
+        if present:
+            reduced = grouped_allreduce([g for _, g in present],
+                                        op=reduce_op, name="opt.grads")
+            gv = [list(x) for x in gv]
+            for (i, _), r in zip(present, reduced):
+                gv[i][0] = r
+            gv = [tuple(x) for x in gv]
+        return super(dist_cls, self).apply_gradients(gv, *args, **kwargs)
+
+    dist_cls = type(f"Distributed{cls.__name__}", (cls,),
+                    {"apply_gradients": apply_gradients})
+    return dist_cls.from_config(optimizer.get_config())
+
+
+# -- Keras callbacks (reference keras/callbacks.py) -------------------------
+
+def _keras_callback_base():
+    import tensorflow as tf
+
+    return tf.keras.callbacks.Callback
+
+
+def BroadcastGlobalVariablesCallback(root_rank: int = 0):
+    """Keras callback: broadcast all model/optimizer variables from root
+    at train start (reference _keras/callbacks.py
+    BroadcastGlobalVariablesCallbackImpl)."""
+    Base = _keras_callback_base()
+
+    class _Cb(Base):
+        def __init__(self):
+            super().__init__()
+            self._done = False
+
+        def on_train_begin(self, logs=None):
+            if self._done:
+                return
+            broadcast_variables(self.model.variables, root_rank)
+            self._done = True
+
+    return _Cb()
+
+
+def MetricAverageCallback():
+    """Keras callback: allreduce-average epoch metrics (reference
+    _keras/callbacks.py MetricAverageCallbackImpl)."""
+    Base = _keras_callback_base()
+
+    class _Cb(Base):
+        def on_epoch_end(self, epoch, logs=None):
+            if not logs:
+                return
+            for k, v in list(logs.items()):
+                if isinstance(v, (int, float, np.floating)):
+                    out = allreduce(np.full((), float(v), np.float32),
+                                    op=Average, name=f"metric.{k}")
+                    logs[k] = float(np.asarray(out))
+
+    return _Cb()
