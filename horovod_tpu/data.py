@@ -1,0 +1,221 @@
+"""Data layer: rank sharding, elastic sampling, device prefetch.
+
+Reference equivalents:
+- ``ElasticSampler`` — horovod/torch/elastic/sampler.py:24 (rank
+  partitioning with processed-index tracking so an elastic reset
+  repartitions only the *unprocessed* remainder of the epoch).
+- The Spark data path (petastorm readers feeding per-rank shards).
+
+TPU-native additions: ``prefetch_to_device`` keeps a small queue of
+batches already resident in HBM so the input pipeline overlaps the step
+(the host→HBM transfer is the TPU analog of the reference's GPU
+DataLoader pinned-memory prefetch), and ``shard_batch`` lays a global
+batch out rank-major for ``hvd.spmd_step``'s ``P(rank_axis)`` specs.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class ElasticSampler:
+    """Partitions dataset indices across ranks; repartitions the
+    unprocessed remainder after elastic resets.
+
+    Framework-agnostic (index-based) version of the reference sampler.
+    Include it in a ``JaxState``/``ObjectState`` (its state is plain
+    picklable attributes), call :meth:`record_batch` after each step and
+    :meth:`set_epoch` at epoch end; after a topology change call
+    :meth:`reset` (the elastic State's on_reset hook).
+    """
+
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0):
+        self.dataset_size = int(dataset_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self.rank = 0
+        self.num_replicas = 1
+        self.remaining_indices: List[int] = []
+        self.num_samples = 0
+        self.total_size = 0
+        self.reset()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset(self) -> None:
+        """Re-read world topology and repartition the unprocessed indices
+        (called at construction and after elastic resets)."""
+        import horovod_tpu as hvd
+
+        if hvd.is_initialized():
+            self.rank = hvd.rank()
+            self.num_replicas = hvd.size()
+        else:
+            self.rank, self.num_replicas = 0, 1
+        self._repartition()
+
+    def set_epoch(self, epoch: int) -> None:
+        """New epoch: clear processed tracking, reshuffle deterministically
+        from (seed, epoch) — identical ordering on every rank."""
+        self.epoch = epoch
+        self.processed_indices = set()
+        self._repartition()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark the batch's indices processed (reference record_batch)."""
+        start = batch_idx * batch_size
+        self.record_indices(self.local_indices()[start:start + batch_size])
+
+    def record_indices(self, indices: Sequence[int]) -> None:
+        self.processed_indices.update(int(i) for i in indices)
+
+    # -- sampling ----------------------------------------------------------
+
+    def _repartition(self) -> None:
+        indices = [i for i in range(self.dataset_size)
+                   if i not in self.processed_indices]
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = list(rng.permutation(indices))
+        self.remaining_indices = [int(i) for i in indices]
+        # Pad to a multiple of num_replicas so every rank sees the same
+        # number of samples (same trick as the reference / TF
+        # DistributedSampler).
+        n = len(self.remaining_indices)
+        self.num_samples = -(-n // self.num_replicas) if n else 0
+        self.total_size = self.num_samples * self.num_replicas
+        if n and self.total_size > n:
+            self.remaining_indices += self.remaining_indices[
+                :self.total_size - n]
+
+    def local_indices(self) -> List[int]:
+        """This rank's shard (strided, reference-style)."""
+        return self.remaining_indices[self.rank:self.total_size:
+                                      self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    # -- pickling (lives inside elastic State objects) ---------------------
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["processed_indices"] = sorted(self.processed_indices)
+        return d
+
+    def __setstate__(self, d):
+        d = dict(d)
+        d["processed_indices"] = set(d["processed_indices"])
+        self.__dict__.update(d)
+
+
+def shard_batch(batch, rank: Optional[int] = None,
+                size: Optional[int] = None):
+    """Slice this rank's rows out of a global batch pytree (for
+    multi-process mode; under single-controller SPMD pass the global
+    batch straight to spmd_step with ``P(rank_axis)`` specs instead)."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    r = hvd.rank() if rank is None else rank
+    n = hvd.size() if size is None else size
+
+    def one(x):
+        b = x.shape[0]
+        if b % n:
+            raise ValueError(f"batch dim {b} not divisible by size {n}")
+        per = b // n
+        return x[r * per:(r + 1) * per]
+
+    return jax.tree.map(one, batch)
+
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Wrap a host batch iterator so up to ``size`` batches are already
+    transferred to device (HBM) ahead of consumption. The transfer of
+    batch N+1..N+size overlaps the step on batch N — the TPU analog of
+    pinned-memory prefetch. ``sharding`` (optional jax.sharding.Sharding)
+    places each batch; default = committed to the default device.
+    """
+    import jax
+
+    def place(batch):
+        if sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+
+    def fill():
+        while len(queue) < size:
+            try:
+                queue.append(place(next(it)))
+            except StopIteration:
+                return False
+        return True
+
+    fill()
+    while queue:
+        out = queue.popleft()
+        fill()
+        yield out
+
+
+class BackgroundPrefetcher:
+    """Thread-backed variant of :func:`prefetch_to_device` for input
+    pipelines whose host-side cost (decode, augment) is non-trivial: a
+    worker thread stays ``size`` batches ahead, so host preprocessing
+    overlaps both the transfer and the step."""
+
+    _DONE = object()
+
+    def __init__(self, iterator: Iterable, size: int = 2, sharding=None):
+        import queue as queue_mod
+
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=size)
+        self._sharding = sharding
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(iterator),), daemon=True)
+        self._thread.start()
+
+    def _run(self, it):
+        import jax
+
+        try:
+            for batch in it:
+                if self._sharding is not None:
+                    batch = jax.tree.map(
+                        lambda x: jax.device_put(x, self._sharding), batch)
+                else:
+                    batch = jax.tree.map(jax.device_put, batch)
+                self._q.put(batch)
+        except BaseException as e:  # surfaced on next()
+            self._error = e
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item

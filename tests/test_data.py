@@ -1,0 +1,106 @@
+"""Data layer: ElasticSampler (reference torch/elastic/sampler.py
+semantics), rank sharding, device prefetch."""
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import data as data_lib
+
+
+class TestElasticSampler:
+    def test_partitions_cover_dataset(self, hvd):
+        s = data_lib.ElasticSampler(64, shuffle=False)
+        assert s.num_replicas == 8
+        # All ranks' shards together cover the dataset exactly.
+        all_idx = []
+        for r in range(8):
+            s.rank = r
+            shard = s.local_indices()
+            assert len(shard) == s.num_samples == 8
+            all_idx += shard
+        assert sorted(all_idx) == list(range(64))
+
+    def test_shuffle_deterministic_per_epoch(self, hvd):
+        a = data_lib.ElasticSampler(32, shuffle=True, seed=5)
+        b = data_lib.ElasticSampler(32, shuffle=True, seed=5)
+        assert a.local_indices() == b.local_indices()
+        a.set_epoch(1)
+        b.set_epoch(1)
+        assert a.local_indices() == b.local_indices()
+        e0 = data_lib.ElasticSampler(32, shuffle=True, seed=5)
+        assert a.local_indices() != e0.local_indices()  # epoch reshuffles
+
+    def test_processed_indices_excluded_after_reset(self, hvd):
+        s = data_lib.ElasticSampler(40, shuffle=False)
+        first_batch = s.local_indices()[:3]
+        s.record_indices(first_batch)
+        s.reset()  # elastic topology change mid-epoch
+        rest = set(s.remaining_indices)
+        assert rest.isdisjoint(first_batch)
+        assert len(rest) == 40 - 3
+
+    def test_record_batch_maps_to_local_shard(self, hvd):
+        s = data_lib.ElasticSampler(64, shuffle=False)
+        local = s.local_indices()
+        s.record_batch(batch_idx=1, batch_size=2)
+        assert set(local[2:4]) <= s.processed_indices
+
+    def test_set_epoch_clears_processed(self, hvd):
+        s = data_lib.ElasticSampler(16, shuffle=False)
+        s.record_indices(s.local_indices())
+        s.set_epoch(1)
+        assert s.processed_indices == set()
+        assert len(s.remaining_indices) == 16
+
+    def test_padding_when_not_divisible(self, hvd):
+        s = data_lib.ElasticSampler(10, shuffle=False)  # 10 over 8 ranks
+        assert s.num_samples == 2 and s.total_size == 16
+        counts = []
+        for r in range(8):
+            s.rank = r
+            counts.append(len(s.local_indices()))
+        assert counts == [2] * 8  # equal shards via padding
+
+    def test_pickles_inside_state(self, hvd):
+        s = data_lib.ElasticSampler(8)
+        s.record_indices([1, 2])
+        s2 = pickle.loads(pickle.dumps(s))
+        assert s2.processed_indices == {1, 2}
+        assert s2.local_indices() == s.local_indices()
+
+
+def test_shard_batch(hvd):
+    x = np.arange(16).reshape(16, 1)
+    out = data_lib.shard_batch({"x": x}, rank=2, size=8)
+    np.testing.assert_array_equal(np.asarray(out["x"]), [[4], [5]])
+    with pytest.raises(ValueError, match="not divisible"):
+        data_lib.shard_batch(np.ones((10, 2)), rank=0, size=8)
+
+
+def test_prefetch_to_device_order_and_device(hvd):
+    batches = [{"x": np.full((2,), i, np.float32)} for i in range(5)]
+    out = list(data_lib.prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jnp.ndarray)
+        np.testing.assert_allclose(np.asarray(b["x"]), i)
+
+
+def test_background_prefetcher(hvd):
+    batches = [np.full((2,), i, np.float32) for i in range(6)]
+    out = list(data_lib.BackgroundPrefetcher(batches, size=3))
+    assert [int(np.asarray(b)[0]) for b in out] == list(range(6))
+
+
+def test_background_prefetcher_propagates_error(hvd):
+    def gen():
+        yield np.ones(2)
+        raise RuntimeError("decode failed")
+
+    it = data_lib.BackgroundPrefetcher(gen(), size=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(it)
