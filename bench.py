@@ -18,13 +18,82 @@ we use 10 samples/s/chip as the nominal P100-era per-device denominator.
 import argparse
 import functools
 import json
+import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
 CNN_BASELINE_PER_DEVICE = 1656.82 / 16.0
 BERT_BASELINE_PER_DEVICE = 10.0
+
+def _log(msg):
+    print(f"bench: {msg}", file=sys.stderr, flush=True)
+
+
+def _emit(payload):
+    """The ONE JSON line the driver parses — always the last stdout line."""
+    print(json.dumps(payload), flush=True)
+
+
+# --- supervisor -----------------------------------------------------------
+#
+# Round-1 lesson: TPU backend init can fail fast (UNAVAILABLE) *or hang
+# for many minutes inside jax.devices(); neither is recoverable in-process
+# (the backend-init call is uninterruptible, and the axon registration
+# overrides a JAX_PLATFORMS=cpu env var). So the benchmark runs in a child
+# process per attempt with a hard wall-clock timeout, escalating:
+#   TPU full → TPU full (backoff) → CPU shrunk → CPU smoke.
+# The supervisor re-prints the winning child's JSON line, guaranteeing
+# rc=0 with a real number whenever *any* platform works.
+
+ATTEMPTS = (
+    # (platform, extra flags, timeout_s, backoff_before_s)
+    ("tpu", [], 700, 0),
+    ("tpu", [], 500, 30),
+    ("cpu", [], 400, 0),
+    ("cpu", ["--smoke"], 300, 0),
+)
+
+
+def _supervise(argv):
+    import subprocess
+
+    user_forced = [a for a in argv if a in ("--smoke",)]
+    last_tail = ""
+    for i, (platform, extra, timeout_s, backoff) in enumerate(ATTEMPTS):
+        if backoff:
+            _log(f"backing off {backoff}s before attempt {i + 1}")
+            time.sleep(backoff)
+        cmd = ([sys.executable, os.path.abspath(__file__), "--_worker",
+                f"--_platform={platform}"] + argv
+               + [f for f in extra if f not in user_forced])
+        _log(f"attempt {i + 1}/{len(ATTEMPTS)}: platform={platform} "
+             f"extra={extra} timeout={timeout_s}s")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            _log(f"attempt {i + 1} timed out after {timeout_s}s")
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        if proc.returncode == 0 and lines:
+            try:
+                payload = json.loads(lines[-1])
+            except json.JSONDecodeError:
+                _log(f"attempt {i + 1}: rc=0 but unparseable stdout tail: "
+                     f"{lines[-1][:200]}")
+                continue
+            if i > 0:
+                payload["attempt"] = i + 1
+            _emit(payload)
+            return 0
+        last_tail = (proc.stderr or proc.stdout)[-2000:]
+        _log(f"attempt {i + 1} failed rc={proc.returncode}")
+    _log(f"all attempts failed; last output tail:\n{last_tail}")
+    return 1
 
 
 def main():
@@ -40,16 +109,70 @@ def main():
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3",
                             "bert_large", "bert_base"])
-    args = p.parse_args()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny-model fallback config (always records "
+                        "*some* number)")
+    p.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--_platform", default="", help=argparse.SUPPRESS)
+    args, _ = p.parse_known_args()
+
+    if not args._worker:
+        return _supervise(sys.argv[1:])
 
     import jax
-    import jax.numpy as jnp
-    import optax
+    if args._platform == "cpu":
+        # Must happen before any backend init; overrides axon's
+        # jax_platforms="axon,cpu" registration.
+        jax.config.update("jax_platforms", "cpu")
 
     import horovod_tpu as hvd
 
     hvd.init()
+    platform = jax.devices()[0].platform
     n = hvd.size()
+    _log(f"worker initialized: platform={platform} n={n}")
+
+    # Global batch must divide over the n chips (spmd_step shards it).
+    if platform == "cpu" and not args.smoke and args.batch_size == 0:
+        # A full ResNet-50 batch-128 step on host CPU takes minutes;
+        # shrink so the fallback path still finishes inside the driver's
+        # patience while keeping the same model + methodology.
+        args.batch_size = 8 * n
+        args.num_iters = min(args.num_iters, 3)
+        args.batches_per_iter = min(args.batches_per_iter, 2)
+        _log(f"cpu fallback: shrinking to batch={args.batch_size}, "
+             f"iters=3x2")
+    if args.smoke:
+        args.batch_size = args.batch_size or 4 * n
+        args.image_size = args.image_size or 64
+        args.seq_len = min(args.seq_len, 128)
+        args.num_iters = min(args.num_iters, 3)
+        args.batches_per_iter = min(args.batches_per_iter, 2)
+
+    note = None
+    try:
+        result = _run_benchmark(args, n)
+    except Exception as e:  # noqa: BLE001 — fail soft to a smoke number
+        _log("full benchmark failed; retrying with --smoke config:\n"
+             + traceback.format_exc())
+        note = f"smoke fallback after: {str(e).splitlines()[0][:160]}"
+        args.smoke = True
+        args.batch_size = 4 * n
+        args.image_size = 64
+        args.seq_len = 128
+        args.num_iters = 3
+        args.batches_per_iter = 2
+        result = _run_benchmark(args, n)
+
+    result["platform"] = platform
+    if args.smoke:
+        result["smoke"] = True
+    if note:
+        result["note"] = note
+    _emit(result)
+
+
+def _run_benchmark(args, n):
     is_bert = args.model.startswith("bert")
     batch_size = args.batch_size or (8 if is_bert else 128)
 
@@ -59,8 +182,10 @@ def main():
         run_batch, unit, baseline = _setup_cnn(args, batch_size, n)
 
     # Warmup (includes compile).
+    t0 = time.perf_counter()
     for _ in range(args.num_warmup):
         run_batch().block_until_ready()
+    _log(f"warmup+compile done in {time.perf_counter() - t0:.1f}s")
 
     rates = []
     for _ in range(args.num_iters):
@@ -71,14 +196,16 @@ def main():
         dt = time.perf_counter() - t0
         rates.append(batch_size * args.batches_per_iter / dt)
 
-    val = float(np.mean(rates))
-    print(json.dumps({
+    # batch_size is the GLOBAL batch (sharded over n chips in spmd mode);
+    # the metric is per-chip, so divide the measured global rate by n.
+    val = float(np.mean(rates)) / n
+    return {
         "metric": f"{args.model}_{'samples' if is_bert else 'images'}"
                   f"_per_sec_per_chip",
         "value": round(val, 2),
         "unit": "samples/s" if is_bert else "img/s",
         "vs_baseline": round(val / baseline, 3),
-    }))
+    }
 
 
 def _make_stepper(model_apply_loss, params_and_state, n, extra_args):

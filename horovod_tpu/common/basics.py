@@ -63,10 +63,25 @@ class Context:
                 "HIERARCHICAL_ALLREDUCE requested but topology is "
                 "single-host/non-homogeneous; using flat allreduce "
                 "(reference falls back the same way, operations.cc:470+)")
+        # Multi-process guard rail: in one-process-per-host worlds a
+        # program-order divergence would deadlock the XLA collective with
+        # no diagnostics; the Controller validates each new eager
+        # signature across processes first (reference controller.cc:63-358;
+        # vacuous — and skipped — under single-controller SPMD).
+        self.controller = None
+        if topo.process_count > 1:
+            from .controller import Controller, JaxKVTransport
+
+            global _init_count
+            self.controller = Controller(
+                topo.process_index, topo.process_count, JaxKVTransport(),
+                timeout_s=config.stall_check_time_seconds,
+                incarnation=_init_count)
         self.engine = EagerEngine(self.mesh, config.rank_axis, config,
                                   timeline=self.timeline,
                                   stall_inspector=self.stall,
-                                  hier_mesh=self.hier_mesh)
+                                  hier_mesh=self.hier_mesh,
+                                  controller=self.controller)
         # Elastic host-update channel: poll the driver's rendezvous KV
         # topology version (reference: WorkerNotificationClient,
         # elastic/worker.py). Consumed by State.check_host_updates().
@@ -139,6 +154,9 @@ class Context:
 
 _context: Optional[Context] = None
 _context_lock = threading.Lock()
+# Count of Context constructions in this process — the controller's KV
+# incarnation (identical across ranks when program order is identical).
+_init_count = 0
 
 
 def init(comm: Optional[Sequence[int]] = None, **config_overrides) -> Context:
@@ -159,6 +177,8 @@ def init(comm: Optional[Sequence[int]] = None, **config_overrides) -> Context:
                     "runtime is already initialized; call shutdown() first "
                     "to re-initialize with different settings")
             return _context
+        global _init_count
+        _init_count += 1
         _context = Context(configure(**config_overrides), comm=comm)
         atexit.register(shutdown)
         return _context

@@ -27,6 +27,7 @@ way — SURVEY.md §4).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import json
 import threading
@@ -53,6 +54,47 @@ class Request:
         return json.dumps([self.op_type, self.tensor_name, self.dtype,
                            list(self.shape), self.reduce_op, self.root_rank])
 
+    def encode(self) -> str:
+        """Wire format for the KV round: the native codec (wire.cc) when
+        built and the dtype/op are in its tables, else JSON. A one-char
+        prefix tags the format so mixed availability across ranks still
+        interops (the decoder dispatches on it)."""
+        import os
+
+        from .. import native
+
+        if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+                and native.available() and self.op_type in native.OP_CODES
+                and self.dtype in native.DTYPE_CODES):
+            data = native.encode_request(
+                self.rank, self.op_type, self.reduce_op, self.root_rank,
+                self.dtype, self.tensor_name, self.shape)
+            if data is not None:
+                return "w:" + base64.b64encode(data).decode()
+        return "j:" + json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def decode(cls, raw: str) -> "Request":
+        from .. import native
+
+        if raw.startswith("w:"):
+            if not native.available():
+                raise HorovodInternalError(
+                    "peer encoded its request with the native wire codec "
+                    "but this rank's libhvdtpu_native.so failed to "
+                    "build/load — check the native build log, or set "
+                    "HVD_TPU_WIRE_FORMAT=json on ALL ranks")
+            tup = native.decode_request(base64.b64decode(raw[2:]))
+            if tup is None:
+                raise HorovodInternalError(
+                    f"undecodable wire request: {raw[:80]!r}")
+            rank, op_type, reduce_op, root_rank, dtype, name, shape = tup
+            return cls(rank, op_type, name, dtype, tuple(shape),
+                       reduce_op, root_rank)
+        d = json.loads(raw[2:])
+        d["shape"] = tuple(d["shape"])
+        return cls(**d)
+
 
 @dataclasses.dataclass
 class Response:
@@ -61,6 +103,38 @@ class Response:
     ok: bool
     tensor_name: str
     error: str = ""
+
+    def encode(self) -> str:
+        import os
+
+        from .. import native
+
+        if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+                and native.available()):
+            data = native.encode_response(self.ok, self.tensor_name,
+                                          self.error)
+            if data is not None:
+                return "w:" + base64.b64encode(data).decode()
+        return "j:" + json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def decode(cls, raw: str) -> "Response":
+        from .. import native
+
+        if raw.startswith("w:"):
+            if not native.available():
+                raise HorovodInternalError(
+                    "peer encoded its response with the native wire codec "
+                    "but this rank's libhvdtpu_native.so failed to "
+                    "build/load — check the native build log, or set "
+                    "HVD_TPU_WIRE_FORMAT=json on ALL ranks")
+            tup = native.decode_response(base64.b64decode(raw[2:]))
+            if tup is None:
+                raise HorovodInternalError(
+                    f"undecodable wire response: {raw[:80]!r}")
+            return cls(*tup)
+        d = json.loads(raw[2:])
+        return cls(d["ok"], d["tensor_name"], d.get("error", ""))
 
 
 class KVTransport:
@@ -105,7 +179,11 @@ class JaxKVTransport(KVTransport):
     def set(self, key: str, value: str) -> None:
         from jax._src import distributed as jdist
 
-        jdist.global_state.client.key_value_set(key, value)
+        client = jdist.global_state.client
+        try:
+            client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:  # older jaxlib without the kwarg
+            client.key_value_set(key, value)
 
     def get(self, key: str, timeout_s: float) -> Optional[str]:
         from jax._src import distributed as jdist
@@ -128,12 +206,21 @@ class Controller:
     """Negotiates one eager-collective signature across processes."""
 
     def __init__(self, rank: int, size: int, transport: KVTransport,
-                 timeout_s: float = 60.0, namespace: str = "hvd_tpu/ctl"):
+                 timeout_s: float = 60.0, namespace: str = "hvd_tpu/ctl",
+                 incarnation: int = 0):
+        """``incarnation`` scopes the KV namespace per init()-cycle: the
+        JAX coordination KV outlives shutdown()/re-init (elastic restarts,
+        tests), and a fresh controller must not read a prior incarnation's
+        rounds — a stale ok=True response would wave a now-mismatched
+        collective straight into the deadlock this class exists to
+        prevent. Every rank of a world must pass the same value (the
+        per-process Context counter in basics.py); if ranks disagree —
+        itself a divergence — rounds simply time out."""
         self.rank = rank
         self.size = size
         self.transport = transport
         self.timeout_s = timeout_s
-        self.ns = namespace
+        self.ns = f"{namespace}/i{incarnation}"
         # Unbounded, order-independent membership set — deliberately NOT
         # the bounded LRU (native ResponseCacheNative): every rank must
         # agree on cache membership or fast paths desynchronize (rank A
@@ -144,7 +231,14 @@ class Controller:
         # safe choice here. The native LRU serves single-process caches
         # (e.g. compiled-fn eviction), where coherence is not a concern.
         self._cache: set = set()
+        self._name_seq: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # Rank 0's gather bookkeeping rides the native NegotiationTable
+        # (controller_core.cc, the IncrementTensorCount analog —
+        # reference controller.cc:837-860); Python dict fallback inside.
+        from .. import native
+
+        self._table = native.NegotiationTable(size) if rank == 0 else None
 
     def negotiate(self, req: Request) -> Response:
         """Validate that every rank submitted a matching request.
@@ -162,54 +256,68 @@ class Controller:
                 self._cache.add(sig)
             return Response(True, req.tensor_name)
 
-        # Round key derived from the signature, not a shared counter:
-        # concurrent negotiations from different threads may interleave
-        # differently per process, and a global counter would then pair
-        # mismatched KV keys across ranks (deadlock). Each signature
-        # negotiates at most once (set cache), so the sig itself is a
-        # unique, rank-agreed key.
+        # Round key: (tensor name, per-name sequence) — NOT the full
+        # signature. The reference negotiates by name (controller.cc
+        # IncrementTensorCount keys on tensor name), which is what lets
+        # the coordinator *see* a mismatched shape/dtype for the same
+        # tensor and report it; signature-keyed rounds would send diverged
+        # ranks to different keys and reduce every mismatch to a timeout.
+        # Not a shared global counter either: concurrent negotiations of
+        # different names may interleave differently per process, and a
+        # global counter would then pair mismatched KV keys across ranks.
+        # The per-name sequence keeps a renegotiated name (cache eviction)
+        # from reading a stale prior response out of the KV store.
         import hashlib
 
-        key_base = f"{self.ns}/{hashlib.sha1(sig.encode()).hexdigest()[:16]}"
-        self.transport.set(f"{key_base}/req/{self.rank}", sig)
+        with self._lock:
+            seq = self._name_seq.get(req.tensor_name, 0)
+            self._name_seq[req.tensor_name] = seq + 1
+        name_h = hashlib.sha1(req.tensor_name.encode()).hexdigest()[:16]
+        key_base = f"{self.ns}/{name_h}/{seq}"
+        self.transport.set(f"{key_base}/req/{self.rank}", req.encode())
 
         if self.rank == 0:
             # Coordinator: gather all requests (MPI_Gatherv analog,
-            # mpi_controller.cc:134), validate, publish the response
-            # (MPI_Bcast analog, :158).
+            # mpi_controller.cc:134), track arrivals in the NegotiationTable
+            # (IncrementTensorCount analog), validate field-by-field,
+            # publish the response (MPI_Bcast analog, :158).
+            mine = dataclasses.replace(req, rank=0)
             error = ""
             for r in range(self.size):
-                other = self.transport.get(f"{key_base}/req/{r}",
-                                           self.timeout_s)
-                if other is None:
+                raw = self.transport.get(f"{key_base}/req/{r}",
+                                         self.timeout_s)
+                if raw is None:
                     # Zero-timeout poll of the not-yet-gathered ranks so
                     # the report names only genuinely missing ranks
                     # (reference stall_inspector.cc report style), not
                     # every rank after the first straggler.
-                    missing = [r] + [
-                        r2 for r2 in range(r + 1, self.size)
+                    for r2 in range(r + 1, self.size):
                         if self.transport.get(f"{key_base}/req/{r2}",
-                                              0.0) is None]
+                                              0.0) is not None:
+                            self._table.increment(key_base, r2)
+                    missing = self._table.missing_ranks(key_base)
+                    if missing is None:
+                        missing = [r]
                     error = (f"ranks {missing} did not submit a collective "
                              f"within {self.timeout_s}s (stalled or "
                              "diverged program order)")
                     break
-                if other != sig:
+                self._table.increment(key_base, r)
+                other = Request.decode(raw)
+                if dataclasses.replace(other, rank=0) != mine:
                     error = (f"rank {r} submitted a mismatched collective: "
-                             f"expected {sig}, got {other} (reference: "
+                             f"expected {mine}, got {other} (reference: "
                              "controller.cc:390-621 validation)")
                     break
             resp = Response(not error, req.tensor_name, error)
-            self.transport.set(f"{key_base}/resp",
-                               json.dumps(dataclasses.asdict(resp)))
+            self.transport.set(f"{key_base}/resp", resp.encode())
         else:
             raw = self.transport.get(f"{key_base}/resp", self.timeout_s)
             if raw is None:
                 raise HorovodInternalError(
                     f"controller response timeout after {self.timeout_s}s "
                     f"for {req.tensor_name}")
-            d = json.loads(raw)
-            resp = Response(d["ok"], d["tensor_name"], d.get("error", ""))
+            resp = Response.decode(raw)
 
         if resp.ok:
             with self._lock:

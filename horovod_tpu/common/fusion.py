@@ -47,33 +47,59 @@ class FusionPlan:
 
 def plan_fusion(tree, threshold_bytes: int) -> FusionPlan:
     """Greedy same-dtype bucketing in flatten order (reference fuses in
-    response order up to the threshold, controller.cc:686-809)."""
+    response order up to the threshold, controller.cc:686-809).
+
+    The bucket-id assignment runs in the native planner
+    (native/fusion_planner.cc hvt_plan_fusion) when the library is built —
+    for 100k-leaf LLM trees the O(n) pass stays off the Python profile.
+    The Python fallback implements byte-identical semantics (same
+    per-dtype running bucket, same byte threshold) so plans never diverge
+    across ranks with mixed availability.
+    """
     leaves, treedef = jax.tree.flatten(tree)
-    buckets: List[Bucket] = []
-    # Group leaves by dtype, preserving order within each dtype class.
-    by_dtype = {}
-    for i, leaf in enumerate(leaves):
-        dt = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype
-        by_dtype.setdefault(str(dt), []).append(i)
-    for dt_key, idxs in by_dtype.items():
-        cur_idx: List[int] = []
-        cur_shapes: List[Tuple[int, ...]] = []
-        cur_elems = 0
-        dt = leaves[idxs[0]].dtype
-        itemsize = np.dtype(dt).itemsize
-        cap = max(1, threshold_bytes // itemsize)
-        for i in idxs:
-            n = int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
-            if cur_idx and cur_elems + n > cap:
-                buckets.append(Bucket(tuple(cur_idx), tuple(cur_shapes),
-                                      dt, cur_elems))
-                cur_idx, cur_shapes, cur_elems = [], [], 0
-            cur_idx.append(i)
-            cur_shapes.append(tuple(leaves[i].shape))
-            cur_elems += n
-        if cur_idx:
-            buckets.append(Bucket(tuple(cur_idx), tuple(cur_shapes),
-                                  dt, cur_elems))
+    leaves = [l if hasattr(l, "dtype") else jnp.asarray(l) for l in leaves]
+    elem_counts = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+    itemsizes = [np.dtype(l.dtype).itemsize for l in leaves]
+    dtype_strs = [str(l.dtype) for l in leaves]
+    dtype_codes = {}
+    for s in dtype_strs:
+        dtype_codes.setdefault(s, len(dtype_codes))
+
+    from ..native import plan_fusion_native
+
+    bucket_ids = plan_fusion_native(
+        elem_counts, [dtype_codes[s] for s in dtype_strs], itemsizes,
+        threshold_bytes)
+    if bucket_ids is None:
+        # Python fallback — mirror of fusion_planner.cc.
+        open_buckets = {}  # dtype -> [bucket_id, bytes_used]
+        next_bucket = 0
+        bucket_ids = []
+        for i in range(len(leaves)):
+            nbytes = elem_counts[i] * itemsizes[i]
+            o = open_buckets.get(dtype_strs[i])
+            if o is None:
+                open_buckets[dtype_strs[i]] = [next_bucket, nbytes]
+                bucket_ids.append(next_bucket)
+                next_bucket += 1
+                continue
+            if o[1] > 0 and o[1] + nbytes > threshold_bytes:
+                o[0] = next_bucket
+                next_bucket += 1
+                o[1] = 0
+            o[1] += nbytes
+            bucket_ids.append(o[0])
+
+    by_bucket = {}
+    for i, b in enumerate(bucket_ids):
+        by_bucket.setdefault(b, []).append(i)
+    buckets = [
+        Bucket(tuple(idxs),
+               tuple(tuple(leaves[i].shape) for i in idxs),
+               leaves[idxs[0]].dtype,
+               sum(elem_counts[i] for i in idxs))
+        for _, idxs in sorted(by_bucket.items())
+    ]
     return FusionPlan(tuple(buckets), treedef, len(leaves))
 
 

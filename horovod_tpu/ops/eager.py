@@ -94,7 +94,8 @@ class EagerEngine:
     duplicate_wait_seconds = 30.0
 
     def __init__(self, mesh: Mesh, axis_name: str, config, timeline=None,
-                 stall_inspector=None, hier_mesh: Optional[Mesh] = None):
+                 stall_inspector=None, hier_mesh: Optional[Mesh] = None,
+                 controller=None):
         self.mesh = mesh
         self.axis = axis_name
         self.config = config
@@ -116,8 +117,19 @@ class EagerEngine:
                     "commute with summation) and cannot be the default "
                     "reduction compression; use fp16/bf16")
             self._default_compression = comp
-        self._cache: Dict[Tuple, Any] = {}
+        # Multi-process guard rail (reference controller.cc:63-358): set in
+        # multi-process worlds; negotiate() runs on every compile-cache
+        # miss so a diverged rank errors instead of deadlocking the XLA
+        # collective.
+        self.controller = controller
+        self._cache: Dict[str, Any] = {}
         self._cache_lock = threading.Lock()
+        # LRU eviction order for the compile cache rides the native LRU
+        # (controller_core.cc hvd_lru_*; reference response_cache.cc) —
+        # Python OrderedDict fallback inside.
+        from ..native import ResponseCacheNative
+
+        self._lru = ResponseCacheNative(config.cache_capacity)
         self.handles = HandleManager()
         self._inflight_names: set = set()
         self._names_lock = threading.Lock()
@@ -171,17 +183,57 @@ class EagerEngine:
     # -- compile cache -----------------------------------------------------
 
     def _compiled(self, key: Tuple, builder):
+        skey = repr(key)
         with self._cache_lock:
-            fn = self._cache.get(key)
+            fn = self._cache.get(skey)
+            if fn is not None:
+                self._lru.lookup(skey)  # touch
         if fn is None:
             fn = builder()
             with self._cache_lock:
-                if len(self._cache) >= self.config.cache_capacity:
-                    # Evict oldest (dict preserves insertion order) — LRU-ish,
-                    # reference evicts by LRU bit (response_cache.cc).
-                    self._cache.pop(next(iter(self._cache)))
-                self._cache[key] = fn
+                if skey not in self._cache:
+                    evicted = self._lru.put(skey)
+                    if evicted is not None:
+                        self._cache.pop(evicted, None)
+                self._cache[skey] = fn
         return fn
+
+    def _negotiate(self, op_type: str, name: str, x, reduce_op: int = 0,
+                   root_rank: int = -1, shape=None, dtype=None):
+        """Multi-process guard rail: validate that every process submitted
+        the same collective BEFORE any device placement or dispatch — a
+        mismatch raises TensorShapeMismatchError naming the diverged rank
+        instead of deadlocking (or aborting) the cross-process transfer
+        (reference controller.cc:390-621). Runs on the *raw input*
+        signature because even jax.device_put of a diverged global shape
+        crashes the multi-process runtime. No-op in single-process worlds;
+        repeats of a seen signature return via the controller's cache
+        without KV traffic.
+
+        Auto-named ("noname.N") tensors are renamed to a digest of their
+        signature: a per-call-unique name would make every unnamed op a
+        fresh signature — one blocking KV round per op per step and
+        unbounded controller-cache growth. With the signature-derived name
+        repeats are cache hits; a divergence shows up as a name mismatch
+        (timeout diagnosis) rather than a field-level report — the price
+        of not naming your tensors."""
+        if self.controller is None:
+            return
+        from ..common.controller import Request
+
+        if shape is None:
+            shape = tuple(getattr(x, "shape", None) or np.shape(x))
+        if dtype is None:
+            dtype = str(getattr(x, "dtype", None) or np.asarray(x).dtype)
+        if ".noname." in name:
+            import hashlib
+
+            sig = repr((op_type, shape, dtype, reduce_op, root_rank))
+            name = (f"{op_type}.auto."
+                    f"{hashlib.sha1(sig.encode()).hexdigest()[:16]}")
+        self.controller.negotiate(Request(
+            self.controller.rank, op_type, name, dtype, tuple(shape),
+            reduce_op, root_rank))
 
     def _shard_mapped(self, per_rank_fn, nout: int = 1):
         """Wrap a per-rank function into a jitted shard_map over the mesh."""
@@ -260,9 +312,10 @@ class EagerEngine:
                   compression=None):
         if compression is None:
             compression = self._default_compression
-        dt = self._as_distributed(x)
         full = self._begin(name, "allreduce")
         try:
+            self._negotiate("allreduce", full, x, reduce_op=int(op))
+            dt = self._as_distributed(x)
             hier = (self.config.hierarchical_allreduce
                     and self.hier_mesh is not None
                     and op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE))
@@ -311,6 +364,25 @@ class EagerEngine:
             compression = self._default_compression
         full = self._begin(name, "grouped_allreduce")
         try:
+            if self.controller is not None:
+                # Grouped op: one Request carries one shape, so encode the
+                # whole leaf signature into the shape field as
+                # (num_leaves, total_elems, crc32(per-leaf shapes+dtypes)).
+                # The name stays plain — diverged ranks land in the SAME
+                # negotiation round and get a field-level mismatch report,
+                # not a timeout.
+                import zlib
+
+                raw_leaves = jax.tree.leaves(tree)
+                meta = repr([(tuple(np.shape(l)),
+                              str(getattr(l, "dtype", "?")))
+                             for l in raw_leaves])
+                total = sum(int(np.prod(np.shape(l)) or 1)
+                            for l in raw_leaves)
+                self._negotiate(
+                    "allreduce", full, raw_leaves[0], reduce_op=int(op),
+                    shape=(len(raw_leaves), total,
+                           zlib.crc32(meta.encode())))
             dts = jax.tree.map(self._as_distributed, tree)
             leaves, treedef = jax.tree.flatten(dts)
             shapes = tuple((l.shape, str(l.dtype)) for l in leaves)
@@ -350,6 +422,18 @@ class EagerEngine:
         full = self._begin(name, "allgather")
         try:
             if isinstance(x, (list, tuple)):
+                # Ragged variant: per-rank sizes become part of the shape
+                # field (same round key — see the grouped-op note above).
+                import zlib
+
+                sizes_sig = zlib.crc32(repr(
+                    [int(v.shape[0]) for v in x]).encode())
+                self._negotiate("allgather", full, x[0],
+                                shape=(len(x), sizes_sig)
+                                + tuple(x[0].shape[1:]))
+            else:
+                self._negotiate("allgather", full, x)
+            if isinstance(x, (list, tuple)):
                 sizes = tuple(int(v.shape[0]) for v in x)
                 rest = x[0].shape[1:]
                 maxs = max(sizes)
@@ -383,9 +467,10 @@ class EagerEngine:
         return self._finalize_async(full, out)
 
     def broadcast(self, x, root_rank: int = 0, name: Optional[str] = None):
-        dt = self._as_distributed(x)
         full = self._begin(name, "broadcast")
         try:
+            self._negotiate("broadcast", full, x, root_rank=root_rank)
+            dt = self._as_distributed(x)
             key = ("bc", dt.shape, str(dt.dtype), root_rank)
 
             def build():
@@ -402,9 +487,10 @@ class EagerEngine:
     def alltoall(self, x, name: Optional[str] = None):
         """Even all-to-all on a rank-major (size, m, ...) array where each
         rank's m rows are split into `size` equal chunks."""
-        dt = self._as_distributed(x)
         full = self._begin(name, "alltoall")
         try:
+            self._negotiate("alltoall", full, x)
+            dt = self._as_distributed(x)
             key = ("a2a", dt.shape, str(dt.dtype))
 
             def build():
@@ -420,9 +506,10 @@ class EagerEngine:
 
     def reducescatter(self, x, op: C.ReduceOp = C.ReduceOp.SUM,
                       name: Optional[str] = None):
-        dt = self._as_distributed(x)
         full = self._begin(name, "reducescatter")
         try:
+            self._negotiate("reducescatter", full, x, reduce_op=int(op))
+            dt = self._as_distributed(x)
             key = ("rs", dt.shape, str(dt.dtype), int(op))
 
             def build():

@@ -101,3 +101,93 @@ def test_non_coordinator_timeout():
 def test_size_one_trivial():
     c = Controller(0, 1, InMemoryTransport())
     assert c.negotiate(_req(0)).ok
+
+
+def test_wire_codec_roundtrip():
+    """Request/Response travel in the native wire format (wire.cc) when the
+    library is built, JSON otherwise — either way decode(encode(x)) == x."""
+    req = _req(3, name="layer.0/kernel", shape=(128, 256), dtype="bfloat16",
+               op=1)
+    raw = req.encode()
+    assert raw[:2] in ("w:", "j:")
+    assert Request.decode(raw) == req
+
+    from horovod_tpu.common.controller import Response
+
+    for resp in (Response(True, "t"), Response(False, "t", "rank 1 boom")):
+        assert Response.decode(resp.encode()) == resp
+
+
+def test_wire_codec_json_fallback_interop():
+    """A JSON-encoded request (rank without the native lib) decodes on a
+    rank that has it — the format tag dispatches."""
+    import dataclasses
+    import json as json_lib
+
+    req = _req(0, shape=(7, 7))
+    raw = "j:" + json_lib.dumps(dataclasses.asdict(req))
+    assert Request.decode(raw) == req
+
+
+def test_negotiation_uses_native_table():
+    """Coordinator gather-tracking goes through NegotiationTable (native
+    controller_core.cc when built)."""
+    transport = InMemoryTransport()
+    c0 = Controller(0, 2, transport, timeout_s=0.2)
+    assert c0._table is not None
+    c1 = Controller(1, 2, transport, timeout_s=0.2)
+    assert c1._table is None  # only the coordinator tracks gathers
+
+
+def test_engine_negotiates_on_cache_miss(hvd):
+    """Two 'processes' (engines sharing a KV transport) submitting
+    mismatched shapes both error instead of deadlocking — the VERDICT #2
+    guard-rail behavior, unit-tier (threads-as-processes; the real
+    2-process version lives in test_run_api.py)."""
+    import threading as th
+
+    import numpy as np
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops.eager import EagerEngine
+
+    ctx = basics.context()
+    transport = InMemoryTransport()
+    engines = []
+    for r in range(2):
+        ctl = Controller(r, 2, transport, timeout_s=2.0)
+        engines.append(EagerEngine(ctx.mesh, ctx.config.rank_axis,
+                                   ctx.config, controller=ctl))
+
+    errors = [None, None]
+
+    def work(r):
+        try:
+            # Shapes diverge across the two "processes".
+            engines[r].allreduce(np.ones(4 + r, np.float32), name="g")
+        except Exception as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [th.Thread(target=work, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert isinstance(errors[0], TensorShapeMismatchError), errors
+    assert isinstance(errors[1], TensorShapeMismatchError), errors
+    # And a matching submission from both negotiates clean.
+    oks = [None, None]
+
+    def work_ok(r):
+        try:
+            oks[r] = engines[r].allreduce(np.ones(4, np.float32), name="h")
+        except Exception as e:  # noqa: BLE001
+            oks[r] = e
+
+    threads = [th.Thread(target=work_ok, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not isinstance(oks[0], Exception), oks[0]
+    assert not isinstance(oks[1], Exception), oks[1]

@@ -68,3 +68,40 @@ def test_run_kwargs_roundtrip():
         return a + b
 
     assert runner.run(echo, args=(1,), kwargs={"b": 41}, np=2) == [42, 42]
+
+
+@pytest.mark.slow
+def test_run_diverged_shape_errors_not_hangs():
+    """VERDICT #2 done-check: a REAL 2-process world where rank 1 submits a
+    mismatched shape — both ranks must raise TensorShapeMismatchError
+    naming the divergence within the timeout, instead of deadlocking the
+    XLA collective (reference: controller.cc:390-621 validation)."""
+
+    def work():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1, stall_check_time_seconds=20.0)
+        assert hvd.size() == 2
+        rank = int(os.environ["HVD_TPU_PROC_ID"])
+        shape = 4 if rank == 0 else 5  # rank 1 diverges
+        try:
+            hvd.allreduce(np.ones(shape, np.float32), name="diverged")
+        except TensorShapeMismatchError as e:
+            return ("mismatch", "mismatched collective" in str(e)
+                    or "did not submit" in str(e))
+        except Exception as e:  # noqa: BLE001
+            return ("other", repr(e))
+        return ("no-error", None)
+
+    results = runner.run(work, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    assert [r[0] for r in results] == ["mismatch", "mismatch"], results
+    assert all(r[1] for r in results), results
