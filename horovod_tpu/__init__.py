@@ -41,8 +41,8 @@ from .ops import collectives as collective_ops
 from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
                               Sum)
 from .ops.compression import Compression
-from .optim import (DistributedGradFn, DistributedOptimizer,
-                    broadcast_parameters)
+from .optim import (AutotunedStepper, DistributedGradFn,
+                    DistributedOptimizer, broadcast_parameters)
 from .functions import allgather_object, broadcast_object, broadcast_variables
 
 __version__ = "0.1.0"
@@ -154,6 +154,21 @@ def barrier():
     _ctx().engine.barrier()
 
 
+def join() -> int:
+    """Mark this process as done; block until every process has joined,
+    meanwhile participating in the remaining processes' allreduces with
+    zero tensors. Returns the last-joined rank.
+
+    Reference: operations.cc:1085-1109 EnqueueJoin + JoinOp
+    (collective_operations.h:259-267) + torch/mpi_ops.py:631-644.
+    Multi-process worlds must ``init(join_mode=True)`` (or set
+    HVD_TPU_JOIN_MODE=1) so every collective runs a coordination round —
+    the cost the reference pays on every background cycle. In
+    single-controller SPMD every rank reaches join() at the same program
+    point, so the call is vacuous and returns ``size - 1``."""
+    return _ctx().engine.join()
+
+
 # -- async handle surface (reference torch/mpi_ops.py) ---------------------
 
 def allreduce_async(x, op: ReduceOp = ReduceOp.AVERAGE,
@@ -233,11 +248,13 @@ __all__ = [
     "local_size", "cross_rank", "cross_size", "is_homogeneous", "mesh",
     "hierarchical_mesh", "rank_axis", "scatter", "gather", "allreduce",
     "grouped_allreduce", "allgather", "broadcast", "alltoall",
-    "reducescatter", "barrier", "allreduce_async", "allgather_async",
+    "reducescatter", "barrier", "join", "allreduce_async",
+    "allgather_async",
     "broadcast_async", "poll", "synchronize", "start_timeline",
     "stop_timeline", "spmd_step", "ReduceOp", "Average", "Sum", "Adasum",
     "Min", "Max", "Product", "Compression", "DistributedOptimizer",
-    "DistributedGradFn", "broadcast_parameters", "broadcast_object",
+    "DistributedGradFn", "AutotunedStepper",
+    "broadcast_parameters", "broadcast_object",
     "allgather_object", "broadcast_variables", "collective_ops",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",
     "StallError", "TensorShapeMismatchError", "__version__",

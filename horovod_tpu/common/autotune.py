@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import math
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -108,30 +109,48 @@ class Autotuner:
         self._samples: Dict[int, List[float]] = {}
         self._current = self.candidates[len(self.candidates) // 2]
         self._done = False
+        # Samples arrive from finalizer-pool threads (eager engine) and
+        # the training loop (AutotunedStepper) concurrently; all state
+        # transitions are serialized here.
+        self._tlock = threading.RLock()
         if log_file:
             with open(log_file, "w") as f:
                 f.write("threshold_bytes,score_bytes_per_sec\n")
 
     @property
     def current(self) -> int:
-        return self._current
+        with self._tlock:
+            return self._current
 
     @property
     def done(self) -> bool:
-        return self._done
+        with self._tlock:
+            return self._done
 
     def record(self, nbytes: float, seconds: float) -> None:
-        if self._done:
-            return
-        if self._warmed < self.warmup:
-            self._warmed += 1          # discard warmup (compile) samples
-            return
-        self._bytes += nbytes
-        self._secs += seconds
-        self._steps += 1
+        with self._tlock:
+            if self._done:
+                return
+            if self._warmed < self.warmup:
+                self._warmed += 1      # discard warmup (compile) samples
+                return
+            self._bytes += nbytes
+            self._secs += seconds
+            self._steps += 1
 
     def ready(self) -> bool:
-        return not self._done and self._steps >= self.steps_per_sample
+        with self._tlock:
+            return not self._done and self._steps >= self.steps_per_sample
+
+    def feed(self, nbytes: float, seconds: float) -> int:
+        """Atomic record + (if a sample completed) suggest — the one call
+        sites should use when multiple threads feed the tuner. Returns the
+        (possibly updated) current threshold."""
+        with self._tlock:
+            self.record(nbytes, seconds)
+            if self.ready():
+                self.suggest()
+            return self._current
 
     def _log(self, threshold: int, score: float) -> None:
         if self.log_file:
@@ -141,6 +160,10 @@ class Autotuner:
     def suggest(self) -> int:
         """Finalize the current sample and pick the next threshold via
         GP+EI; converges when EI is negligible everywhere."""
+        with self._tlock:
+            return self._suggest_locked()
+
+    def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
         self._samples.setdefault(self._current, []).append(score)
         self._log(self._current, score)

@@ -56,6 +56,21 @@ class Context:
         self.stall = StallInspector(config.stall_check_time_seconds,
                                     config.stall_shutdown_time_seconds,
                                     config.stall_check_disable)
+        # Reference polls CheckForStalledTensors each background cycle
+        # (stall_inspector.cc:28+); here a daemon watchdog thread polls.
+        self.stall.start_watchdog()
+        # Autotuner (reference ParameterManager, parameter_manager.cc):
+        # constructed when HOROVOD_AUTOTUNE is set; the eager engine feeds
+        # it grouped-allreduce timings and reads the live fusion threshold
+        # from it; jitted step loops drive it via optim.AutotunedStepper.
+        self.autotuner = None
+        if config.autotune:
+            from .autotune import Autotuner
+
+            self.autotuner = Autotuner(
+                warmup_samples=config.autotune_warmup_samples,
+                steps_per_sample=config.autotune_steps_per_sample,
+                log_file=config.autotune_log)
         from ..ops.eager import EagerEngine
 
         if config.hierarchical_allreduce and self.hier_mesh is None:
@@ -81,7 +96,8 @@ class Context:
                                   timeline=self.timeline,
                                   stall_inspector=self.stall,
                                   hier_mesh=self.hier_mesh,
-                                  controller=self.controller)
+                                  controller=self.controller,
+                                  autotuner=self.autotuner)
         # Elastic host-update channel: poll the driver's rendezvous KV
         # topology version (reference: WorkerNotificationClient,
         # elastic/worker.py). Consumed by State.check_host_updates().
@@ -131,9 +147,23 @@ class Context:
         return self.topology.size
 
     def local_rank(self) -> int:
-        return 0  # first local device; per-device code uses axis_index
+        """Local rank of this controller process on its host. One process
+        per host (the launcher's model) → 0. In one-process-per-chip
+        layouts the launcher exports HVD_TPU_LOCAL_RANK (the reference's
+        HOROVOD_LOCAL_RANK, gloo_run.py:65-99); per-device code inside jit
+        uses axis_index instead."""
+        env = os.environ.get("HVD_TPU_LOCAL_RANK")
+        if env is not None:
+            return int(env)
+        return 0
 
     def local_size(self) -> int:
+        """Paired with local_rank(): the launcher's HVD_TPU_LOCAL_SIZE
+        wins in one-process-per-chip layouts so 0 <= local_rank <
+        local_size always holds."""
+        env = os.environ.get("HVD_TPU_LOCAL_SIZE")
+        if env is not None:
+            return int(env)
         return self.topology.local_size
 
     def cross_rank(self) -> int:
@@ -145,9 +175,16 @@ class Context:
     def is_homogeneous(self) -> bool:
         return self.topology.is_homogeneous
 
+    def fusion_threshold(self) -> int:
+        """Live fusion threshold (reference: ParameterManager owns the
+        live value, parameter_manager.h:42). Single source of truth is
+        the engine's resolver."""
+        return self.engine.fusion_threshold()
+
     def shutdown(self) -> None:
         if self._shutdown:
             return
+        self.stall.stop_watchdog()
         self.timeline.stop()
         self._shutdown = True
 

@@ -64,9 +64,11 @@ class Config:
 
     # Tensor fusion: bucket small tensors into flat buffers before the
     # collective (reference: 64 MiB default, operations.cc:442).
+    # NOTE: the reference's HOROVOD_CYCLE_TIME (5 ms background-thread
+    # cycle, operations.cc:451) has no TPU analog — there is no background
+    # negotiation loop; eager dispatch rides XLA's async stream directly —
+    # so that knob intentionally does not exist here.
     fusion_threshold_bytes: int = 64 * _MB
-    # Eager-engine cycle time in ms (reference: 5ms, operations.cc:451).
-    cycle_time_ms: float = 5.0
     # Response-cache capacity (reference: 1024, operations.cc:476).
     cache_capacity: int = 1024
     # Hierarchical (ICI intra-slice + DCN cross-slice) reduction.
@@ -90,6 +92,13 @@ class Config:
     compression_dtype: Optional[str] = None  # e.g. "bfloat16"/"float16"
     # Elastic mode (reference: HOROVOD_ELASTIC).
     elastic: bool = False
+    # Join mode: multi-process programs that call hvd.join() must enable
+    # this so every eager collective runs a coordination round in which a
+    # joined process can answer "JOIN" (the reference is ALWAYS in this
+    # mode — every tensor negotiates every background cycle,
+    # controller.cc:63-358; here it is opt-in because the negotiation-free
+    # cached fast path is the default). Single-process SPMD needs no knob.
+    join_mode: bool = False
     # Logging level.
     log_level: str = "warning"
     # Mesh axis name used for the data-parallel "ranks" axis.
@@ -102,7 +111,6 @@ class Config:
         c = cls()
         c.fusion_threshold_bytes = _env_int(
             "FUSION_THRESHOLD", cls.fusion_threshold_bytes)
-        c.cycle_time_ms = _env_float("CYCLE_TIME", cls.cycle_time_ms)
         c.cache_capacity = _env_int("CACHE_CAPACITY", cls.cache_capacity)
         c.hierarchical_allreduce = _env_bool("HIERARCHICAL_ALLREDUCE", False)
         c.hierarchical_allgather = _env_bool("HIERARCHICAL_ALLGATHER", False)
@@ -123,6 +131,7 @@ class Config:
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")
         c.elastic = _env_bool("ELASTIC", False)
+        c.join_mode = _env_bool("JOIN_MODE", False)
         c.log_level = _env("LOG_LEVEL", "warning") or "warning"
         c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
         c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)

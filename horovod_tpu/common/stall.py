@@ -18,7 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from .exceptions import StallError
 
@@ -32,13 +32,17 @@ class StallInspector:
         self.check_time = check_time_seconds
         self.shutdown_time = shutdown_time_seconds
         self.disabled = disabled
+        self.fatal: Optional[StallError] = None
         self._inflight: Dict[str, float] = {}
         self._warned: set = set()
         self._lock = threading.Lock()
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def record_submit(self, name: str) -> None:
         if self.disabled:
             return
+        self.raise_if_fatal()
         with self._lock:
             self._inflight[name] = time.monotonic()
 
@@ -55,6 +59,7 @@ class StallInspector:
         stall_inspector.h:80 shutdown behavior)."""
         if self.disabled:
             return False
+        self.raise_if_fatal()
         now = time.monotonic()
         stalled = False
         with self._lock:
@@ -80,3 +85,46 @@ class StallInspector:
     def inflight(self):
         with self._lock:
             return dict(self._inflight)
+
+    # -- watchdog ----------------------------------------------------------
+    #
+    # The reference polls CheckForStalledTensors from the background thread
+    # every coordination cycle (operations.cc RunLoopOnce); with no
+    # background loop here, a daemon thread polls instead. A tripped
+    # shutdown threshold cannot raise into the main thread, so the error is
+    # latched in ``fatal`` and re-raised by the next collective submit (or
+    # any explicit check()).
+
+    def raise_if_fatal(self) -> None:
+        if self.fatal is not None:
+            raise self.fatal
+
+    def start_watchdog(self, poll_interval: Optional[float] = None) -> None:
+        if self.disabled or self._watchdog is not None:
+            return
+        interval = poll_interval if poll_interval is not None else \
+            min(max(self.check_time / 4.0, 0.05), 10.0)
+        self._stop.clear()
+
+        def _loop() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    self.check()
+                except StallError as e:
+                    self.fatal = e
+                    logger.critical(
+                        "stall watchdog: %s — failing subsequent "
+                        "collectives (reference: "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS shutdown, "
+                        "stall_inspector.h:80)", e)
+                    return
+
+        self._watchdog = threading.Thread(
+            target=_loop, daemon=True, name="hvd-tpu-stall-watchdog")
+        self._watchdog.start()
+
+    def stop_watchdog(self) -> None:
+        self._stop.set()
+        t, self._watchdog = self._watchdog, None
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)

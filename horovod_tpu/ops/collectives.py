@@ -162,6 +162,21 @@ def allgatherv(x, sizes: Sequence[int], axis_name: str = "hvd"):
     return jnp.concatenate(parts, axis=0)
 
 
+def hierarchical_allgather(x, local_axis: str = "local",
+                           cross_axis: str = "cross"):
+    """Two-stage allgather: within-host over ICI, then across hosts over
+    DCN (reference: MPIHierarchicalAllgather, mpi_operations.cc — gathers
+    into a shared-memory window per node before the cross-node exchange;
+    activated by HOROVOD_HIERARCHICAL_ALLGATHER).
+
+    Global rank order is host-major on the (cross, local) mesh, so the
+    local-then-cross concatenation reproduces the flat allgather's row
+    order exactly.
+    """
+    g = lax.all_gather(x, local_axis, axis=0, tiled=True)
+    return lax.all_gather(g, cross_axis, axis=0, tiled=True)
+
+
 def broadcast(x, root_rank: int = 0, axis_name: str = "hvd"):
     """Broadcast root's value to all ranks (reference:
     EnqueueTensorBroadcast operations.cc:993-1016).

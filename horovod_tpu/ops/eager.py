@@ -95,7 +95,7 @@ class EagerEngine:
 
     def __init__(self, mesh: Mesh, axis_name: str, config, timeline=None,
                  stall_inspector=None, hier_mesh: Optional[Mesh] = None,
-                 controller=None):
+                 controller=None, autotuner=None):
         self.mesh = mesh
         self.axis = axis_name
         self.config = config
@@ -122,6 +122,10 @@ class EagerEngine:
         # miss so a diverged rank errors instead of deadlocking the XLA
         # collective.
         self.controller = controller
+        # Live fusion-threshold source (reference: ParameterManager tunes
+        # during training, parameter_manager.cc; the grouped-allreduce
+        # path feeds it bytes/sec samples and re-plans on change).
+        self.autotuner = autotuner
         self._cache: Dict[str, Any] = {}
         self._cache_lock = threading.Lock()
         # LRU eviction order for the compile cache rides the native LRU
@@ -141,6 +145,12 @@ class EagerEngine:
         # events complete (gpu_operations.h:107-119).
         self._finalizers = ThreadPoolExecutor(
             max_workers=2, thread_name_prefix="hvd_tpu_finalizer")
+        # Join protocol state (reference: HorovodGlobalState.joined /
+        # joined_size, controller.cc:82,221): a lockstep round counter —
+        # identical across processes because every round gathers from ALL
+        # processes — plus rank-0's join-order bookkeeping.
+        self._join_seq = 0
+        self._coord_joined: List[int] = []
 
     @property
     def size(self) -> int:
@@ -231,9 +241,192 @@ class EagerEngine:
             sig = repr((op_type, shape, dtype, reduce_op, root_rank))
             name = (f"{op_type}.auto."
                     f"{hashlib.sha1(sig.encode()).hexdigest()[:16]}")
-        self.controller.negotiate(Request(
-            self.controller.rank, op_type, name, dtype, tuple(shape),
-            reduce_op, root_rank))
+        req = Request(self.controller.rank, op_type, name, dtype,
+                      tuple(shape), reduce_op, root_rank)
+        if self.join_active():
+            # Join mode: every collective is a lockstep round so joined
+            # processes stay in sync; the round also enforces the
+            # reference's "only allreduce composes with Join" rule.
+            self._join_round(req)
+        else:
+            self.controller.negotiate(req)
+
+    # -- join protocol (reference: EnqueueJoin operations.cc:1085-1109,
+    # JoinOp collective_operations.h:259-267, coordinator join tracking
+    # controller.cc:82,221-307) ------------------------------------------
+    #
+    # In join mode every eager collective is a lockstep *round*: each
+    # process submits either its collective Request or the JOIN sentinel,
+    # rank 0 validates and publishes the round outcome (the
+    # ComputeResponseList analog). A joined process loops rounds from
+    # inside join(), answering JOIN and re-dispatching the active
+    # processes' allreduces with zero tensors, until every process has
+    # joined. This is exactly why the reference negotiates every tensor
+    # every cycle; here the always-negotiate cost is opt-in via
+    # config.join_mode because the cached negotiation-free path is the
+    # default.
+
+    _JOIN_SENTINEL = "JOIN"
+
+    def join_active(self) -> bool:
+        return (self.config.join_mode and self.controller is not None
+                and self.controller.size > 1)
+
+    def _join_round(self, req) -> dict:
+        """Run one coordination round; ``req=None`` submits JOIN."""
+        import json
+
+        from ..common.controller import Request
+        from ..common.exceptions import HorovodInternalError
+
+        c = self.controller
+        seq = self._join_seq
+        self._join_seq += 1
+        base = f"{c.ns}/jr/{seq}"
+        is_join = req is None
+        payload = self._JOIN_SENTINEL if is_join else req.encode()
+        c.transport.set(f"{base}/req/{c.rank}", payload)
+
+        if c.rank == 0:
+            reqs: Dict[int, str] = {}
+            error, error_kind = "", ""
+            for r in range(c.size):
+                while True:
+                    raw = c.transport.get(f"{base}/req/{r}", c.timeout_s)
+                    if raw is not None:
+                        reqs[r] = raw
+                        break
+                    if not is_join:
+                        error = (f"rank {r} did not participate in "
+                                 f"collective round {seq} within "
+                                 f"{c.timeout_s}s (stalled or diverged "
+                                 "program order)")
+                        error_kind = "timeout"
+                        break
+                    # A joined coordinator waits patiently — active peers
+                    # may compute for a long time between collectives
+                    # (reference: the joined rank's background thread
+                    # spins forever).
+                if error:
+                    break
+            decoded = {}
+            if not error:
+                for r in sorted(reqs):
+                    if reqs[r] == self._JOIN_SENTINEL:
+                        if r not in self._coord_joined:
+                            self._coord_joined.append(r)
+                    else:
+                        decoded[r] = Request.decode(reqs[r])
+                if decoded:
+                    import dataclasses
+
+                    first = min(decoded)
+                    base_req = dataclasses.replace(decoded[first], rank=0)
+                    for r, d in decoded.items():
+                        if dataclasses.replace(d, rank=0) != base_req:
+                            error = (f"rank {r} submitted a mismatched "
+                                     f"collective: expected {base_req}, "
+                                     f"got {d} (reference: "
+                                     "controller.cc:390-621)")
+                            error_kind = "mismatch"
+                            break
+                    if (not error and self._coord_joined
+                            and base_req.op_type != "allreduce"):
+                        # Reference parity: controller.cc:487-495.
+                        error = (f"{base_req.op_type} is not supported "
+                                 "with Join at this time")
+                        error_kind = "mismatch"
+            desc = reqs[min(decoded)] if (not error and decoded) else None
+            resp = {"ok": not error, "error": error, "kind": error_kind,
+                    "desc": desc, "joined": list(self._coord_joined),
+                    "all_joined": len(self._coord_joined) == c.size,
+                    "last": (self._coord_joined[-1]
+                             if self._coord_joined else -1)}
+            c.transport.set(f"{base}/resp", json.dumps(resp))
+        else:
+            while True:
+                raw = c.transport.get(f"{base}/resp", c.timeout_s)
+                if raw is not None:
+                    break
+                if not is_join:
+                    raise HorovodInternalError(
+                        f"no response for collective round {seq} within "
+                        f"{c.timeout_s}s")
+            resp = json.loads(raw)
+
+        if not resp["ok"]:
+            # Same failure → same exception type on every rank: shape/op
+            # divergence is a user bug (TensorShapeMismatchError); a
+            # missing rank is a runtime failure (HorovodInternalError,
+            # which elastic recovery catches).
+            if resp.get("kind") == "timeout":
+                raise HorovodInternalError(resp["error"])
+            raise TensorShapeMismatchError(resp["error"])
+        return resp
+
+    def _join_dispatch(self, req, joined_ranks, x=None,
+                       prescale: float = 1.0, postscale: float = 1.0):
+        """Dispatch one join-aware allreduce: active processes contribute
+        their tensor, joined processes zeros; AVERAGE divides by the
+        number of active devices (the JoinOp zero-tensor stand-in)."""
+        shape = tuple(req.shape)
+        dtype = req.dtype
+        op = C.ReduceOp(req.reduce_op)
+        if x is None:
+            x = np.zeros(shape, dtype)
+        # Each process contributes its OWN local value on its rows —
+        # device_put would reject differing per-process values, so build
+        # the global array from per-shard callbacks instead.
+        local = np.broadcast_to(np.asarray(x)[None],
+                                (self.size,) + tuple(shape))
+        dt = jax.make_array_from_callback(
+            local.shape, self._rank_sharding(),
+            lambda idx: np.ascontiguousarray(local[idx]))
+        joined_t = tuple(sorted(joined_ranks))
+        compression = self._default_compression  # engine-wide, every rank
+        key = ("join_ar", shape, dtype, int(op), joined_t, prescale,
+               postscale, compression.__name__)
+
+        def build():
+            flags = np.array(
+                [1.0 if d.process_index in joined_ranks else 0.0
+                 for d in self.mesh.devices.flat], np.float32)
+
+            def per_rank(v):
+                idx = jax.lax.axis_index(self.axis)
+                joined = jnp.asarray(flags)[idx] > 0.5
+                w, ctx = compression.compress(v)
+                w = C._apply_scale(w, prescale)
+                w = C.join_allreduce(w, joined, op, self.axis)
+                w = C._apply_scale(w, postscale)
+                return compression.decompress(w, ctx)
+
+            return self._shard_mapped(per_rank)
+
+        return self._compiled(key, build)(dt)
+
+    def join(self) -> int:
+        """Mark this process joined; keep participating in the remaining
+        processes' allreduces with zero tensors until every process has
+        joined. Returns the last-joined rank (reference:
+        torch/mpi_ops.py:631-644 join semantics).
+
+        Single-controller SPMD: every rank reaches join() at the same
+        program point, so the call is vacuous and returns size-1."""
+        if not self.join_active():
+            return self.size - 1
+        while True:
+            resp = self._join_round(None)
+            if resp.get("desc"):
+                from ..common.controller import Request
+
+                req = Request.decode(resp["desc"])
+                out = self._join_dispatch(req, set(resp["joined"]))
+                for l in jax.tree.leaves(out):
+                    if hasattr(l, "block_until_ready"):
+                        l.block_until_ready()
+            if resp["all_joined"]:
+                return int(resp["last"])
 
     def _shard_mapped(self, per_rank_fn, nout: int = 1):
         """Wrap a per-rank function into a jitted shard_map over the mesh."""
@@ -286,7 +479,8 @@ class EagerEngine:
         if self.timeline is not None:
             self.timeline.end(full)
 
-    def _finalize_async(self, full: Optional[str], result):
+    def _finalize_async(self, full: Optional[str], result,
+                        on_complete=None):
         """Release the name / mark complete only once the result buffers are
         actually ready on device (finalizer-thread model, see __init__)."""
         if full is None:
@@ -299,9 +493,21 @@ class EagerEngine:
                         l.block_until_ready()
             finally:
                 self._end(full)
+                if on_complete is not None:
+                    try:
+                        on_complete()
+                    except Exception:  # noqa: BLE001 — never kill finalizer
+                        pass
 
         self._finalizers.submit(waiter)
         return result
+
+    def fusion_threshold(self) -> int:
+        """Live threshold: autotuner's current value when tuning, else the
+        configured knob (reference: ParameterManager owns the live value)."""
+        if self.autotuner is not None:
+            return self.autotuner.current
+        return self.config.fusion_threshold_bytes
 
     # -- collectives -------------------------------------------------------
 
@@ -312,6 +518,9 @@ class EagerEngine:
                   compression=None):
         if compression is None:
             compression = self._default_compression
+        if self.join_active():
+            return self._allreduce_join_mode(x, op, name, prescale_factor,
+                                             postscale_factor, compression)
         full = self._begin(name, "allreduce")
         try:
             self._negotiate("allreduce", full, x, reduce_op=int(op))
@@ -355,6 +564,33 @@ class EagerEngine:
             raise
         return self._finalize_async(full, out)
 
+    def _allreduce_join_mode(self, x, op, name, prescale, postscale,
+                             compression=None):
+        """Allreduce via a join-mode round: negotiate participation, then
+        dispatch with zero contributions for joined processes."""
+        from ..common.controller import Request
+
+        if (compression is not None
+                and compression is not self._default_compression):
+            # A joined process replays this collective knowing only the
+            # engine-wide default compressor; a per-call override would
+            # desynchronize the compiled programs across processes.
+            raise ValueError(
+                "per-call compression is not supported in join mode; "
+                "configure it engine-wide via compression_dtype")
+        full = self._begin(name, "allreduce")
+        try:
+            xa = jnp.asarray(x)
+            req = Request(self.controller.rank, "allreduce", full,
+                          str(xa.dtype), tuple(xa.shape), int(op))
+            resp = self._join_round(req)
+            out = self._join_dispatch(req, set(resp["joined"]), xa,
+                                      prescale, postscale)
+        except Exception:
+            self._end(full)
+            raise
+        return self._finalize_async(full, out)
+
     def allreduce_tree(self, tree, op: C.ReduceOp = C.ReduceOp.AVERAGE,
                        name: Optional[str] = None,
                        compression=None):
@@ -362,6 +598,17 @@ class EagerEngine:
         fusion path: one collective per ≤threshold bucket)."""
         if compression is None:
             compression = self._default_compression
+        if self.join_active():
+            # Join mode: decompose into per-leaf join-aware allreduces so
+            # a joined process can replay each one with zero tensors (the
+            # reference reduces per-tensor through the coordinator anyway;
+            # fusion is a no-join-mode optimization here).
+            leaves, treedef = jax.tree.flatten(tree)
+            outs = [self._allreduce_join_mode(
+                        l, op, f"{name or 'grouped'}.leaf{i}", 1.0, 1.0,
+                        compression)
+                    for i, l in enumerate(leaves)]
+            return jax.tree.unflatten(treedef, outs)
         full = self._begin(name, "grouped_allreduce")
         try:
             if self.controller is not None:
@@ -386,8 +633,11 @@ class EagerEngine:
             dts = jax.tree.map(self._as_distributed, tree)
             leaves, treedef = jax.tree.flatten(dts)
             shapes = tuple((l.shape, str(l.dtype)) for l in leaves)
-            key = ("art", shapes, int(op), compression.__name__,
-                   self.config.fusion_threshold_bytes)
+            # Threshold captured per-call: when the autotuner moves it, the
+            # cache key changes and the bucket plan recompiles (the
+            # reference re-fuses each cycle with the tuned threshold).
+            threshold = self.fusion_threshold()
+            key = ("art", shapes, int(op), compression.__name__, threshold)
 
             def build():
                 def per_rank(*ls):
@@ -397,8 +647,7 @@ class EagerEngine:
                         return compression.decompress(w, ctx)
                     squeezed = [l.reshape(l.shape[1:]) for l in ls]
                     out = fusion_lib.fused_apply(
-                        list(squeezed), one,
-                        self.config.fusion_threshold_bytes)
+                        list(squeezed), one, threshold)
                     return tuple(o[None] for o in out)
 
                 spec = P(self.axis)
@@ -408,12 +657,21 @@ class EagerEngine:
                     out_specs=tuple([spec] * len(leaves)))
                 return jax.jit(lambda ls: f(*ls))
 
+            on_complete = None
+            if self.autotuner is not None and not self.autotuner.done:
+                nbytes = sum(int(np.prod(l.shape[1:]) or 1)
+                             * l.dtype.itemsize for l in leaves)
+                t0 = time.perf_counter()
+
+                def on_complete():
+                    self.autotuner.feed(nbytes, time.perf_counter() - t0)
+
             out_leaves = self._compiled(key, build)(leaves)
             out = jax.tree.unflatten(treedef, list(out_leaves))
         except Exception:
             self._end(full)
             raise
-        return self._finalize_async(full, out)
+        return self._finalize_async(full, out, on_complete)
 
     def allgather(self, x, name: Optional[str] = None):
         """Each rank's (m_r, ...) tensor -> concatenated (sum m_r, ...) on
@@ -452,13 +710,31 @@ class EagerEngine:
                     return self._shard_mapped(per_rank)
             else:
                 dt = self._as_distributed(x)
-                key = ("ag", dt.shape, str(dt.dtype))
+                hier = (self.config.hierarchical_allgather
+                        and self.hier_mesh is not None)
+                key = ("ag", dt.shape, str(dt.dtype), hier)
 
-                def build():
-                    def per_rank(v):
-                        return C.allgather(v.reshape(v.shape[1:]),
-                                           self.axis)[None]
-                    return self._shard_mapped(per_rank)
+                if hier:
+                    # HOROVOD_HIERARCHICAL_ALLGATHER: gather over the
+                    # local/ICI axis first, then cross/DCN (reference
+                    # MPIHierarchicalAllgather, mpi_operations.cc).
+                    def build():
+                        ca, la = self.hier_mesh.axis_names
+
+                        def per_rank(v):
+                            return C.hierarchical_allgather(
+                                v.reshape(v.shape[1:]), la, ca)[None]
+
+                        spec = P((ca, la))
+                        f = jax.shard_map(per_rank, mesh=self.hier_mesh,
+                                          in_specs=spec, out_specs=spec)
+                        return jax.jit(f)
+                else:
+                    def build():
+                        def per_rank(v):
+                            return C.allgather(v.reshape(v.shape[1:]),
+                                               self.axis)[None]
+                        return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
         except Exception:

@@ -106,6 +106,18 @@ class _AggState(NamedTuple):
     counter: jnp.ndarray
 
 
+def _resolve_fusion_threshold(explicit: Optional[int]) -> int:
+    """None → the live runtime value (autotuner's current suggestion when
+    tuning, else the configured knob); an explicit value always wins."""
+    if explicit is not None:
+        return explicit
+    from .common import basics
+
+    if basics.is_initialized():
+        return basics.context().fusion_threshold()
+    return 64 * 1024 * 1024
+
+
 def DistributedOptimizer(optimizer,
                          op: C.ReduceOp = C.ReduceOp.AVERAGE,
                          axis_name: str = "hvd",
@@ -114,7 +126,7 @@ def DistributedOptimizer(optimizer,
                          average_aggregated_gradients: bool = True,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                         fusion_threshold_bytes: Optional[int] = None,
                          hierarchical: bool = False,
                          local_axis: str = "local",
                          cross_axis: str = "cross"):
@@ -138,6 +150,7 @@ def DistributedOptimizer(optimizer,
     _check_reduce_safe(compression)
 
     k = int(backward_passes_per_step)
+    fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
@@ -193,7 +206,7 @@ def DistributedGradFn(grad_fn: Callable,
                       op: C.ReduceOp = C.ReduceOp.AVERAGE,
                       axis_name: str = "hvd",
                       compression=NoneCompressor,
-                      fusion_threshold_bytes: int = 64 * 1024 * 1024,
+                      fusion_threshold_bytes: Optional[int] = None,
                       has_value: bool = False,
                       reduce_value: bool = True):
     """DistributedGradientTape analog (reference
@@ -207,6 +220,7 @@ def DistributedGradFn(grad_fn: Callable,
     tuple of gradients) is never misclassified.
     """
     _check_reduce_safe(compression)
+    fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
 
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
@@ -223,6 +237,65 @@ def DistributedGradFn(grad_fn: Callable,
                             fusion_threshold_bytes)
 
     return wrapped
+
+
+class AutotunedStepper:
+    """Drives the runtime Autotuner from real step timings and rebuilds the
+    jitted step function whenever the suggested fusion threshold moves.
+
+    This is the in-jit analog of the reference's live ParameterManager
+    tuning (parameter_manager.cc: each cycle scores bytes/sec and may
+    change the fusion threshold; subsequent cycles fuse differently).
+    Under XLA a threshold change means a different bucket plan, i.e. a
+    retrace — so the stepper owns the (re)build::
+
+        def build(threshold_bytes):
+            tx = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                          fusion_threshold_bytes=threshold_bytes)
+            ... return jitted_step               # closes over tx
+        stepper = hvd.AutotunedStepper(build, grad_bytes=nbytes)
+        while training:
+            out = stepper(*step_args)
+
+    ``grad_bytes`` is the bytes reduced per step (the score numerator,
+    matching the reference's bytes/sec score, parameter_manager.h:42).
+    """
+
+    def __init__(self, build_step: Callable[[int], Callable],
+                 grad_bytes: int, tuner=None, block: bool = True):
+        if tuner is None:
+            from .common import basics
+
+            tuner = basics.context().autotuner
+            if tuner is None:
+                raise ValueError(
+                    "runtime autotuner not enabled — init(autotune=True) "
+                    "or set HVD_TPU_AUTOTUNE=1, or pass tuner= explicitly")
+        self.tuner = tuner
+        self.grad_bytes = int(grad_bytes)
+        self.block = block
+        self._build = build_step
+        self._threshold = tuner.current
+        self._step = build_step(self._threshold)
+        self.rebuilds = 0
+
+    @property
+    def fusion_threshold(self) -> int:
+        return self._threshold
+
+    def __call__(self, *args, **kwargs):
+        import time
+
+        t0 = time.perf_counter()
+        out = self._step(*args, **kwargs)
+        if self.block:
+            jax.block_until_ready(out)
+        new = self.tuner.feed(self.grad_bytes, time.perf_counter() - t0)
+        if new != self._threshold:
+            self._threshold = new
+            self._step = self._build(new)
+            self.rebuilds += 1
+        return out
 
 
 def broadcast_parameters(params, root_rank: int = 0,

@@ -47,6 +47,13 @@ def build_env_for_slot(base_env: Dict[str, str], coordinator: str,
     return env
 
 
+def _slot_local_env(local_rank: int, local_size: int) -> Dict[str, str]:
+    """Per-slot local topology (reference HOROVOD_LOCAL_RANK/LOCAL_SIZE,
+    gloo_run.py:65-99)."""
+    return {"HVD_TPU_LOCAL_RANK": str(local_rank),
+            "HVD_TPU_LOCAL_SIZE": str(local_size)}
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -108,7 +115,7 @@ def run_local(np: int, command: List[str], env_extra: Dict[str, str],
     threads: List[threading.Thread] = []
     for i in range(np):
         env = build_env_for_slot(dict(os.environ), coordinator, np, i,
-                                 env_extra)
+                                 {**env_extra, **_slot_local_env(i, np)})
         p = subprocess.Popen(command, env=env,
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
@@ -148,7 +155,8 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     procs = []
     threads = []
     for i, hostname in enumerate(hosts):
-        env = build_env_for_slot({}, coord, num_proc, i, env_extra)
+        env = build_env_for_slot({}, coord, num_proc, i,
+                                 {**env_extra, **_slot_local_env(0, 1)})
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         remote_cmd = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \
             " ".join(shlex.quote(c) for c in command)
@@ -182,7 +190,6 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--version", action="store_true")
     # Knob flags -> env (reference launch.py:392-523 / config_parser.py).
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
-    p.add_argument("--cycle-time-ms", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
     p.add_argument("--hierarchical-allreduce", action="store_true")
     p.add_argument("--timeline-filename", default=None)
@@ -210,8 +217,6 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
     if args.fusion_threshold_mb is not None:
         env["HVD_TPU_FUSION_THRESHOLD"] = str(
             int(args.fusion_threshold_mb * 1024 * 1024))
-    if args.cycle_time_ms is not None:
-        env["HVD_TPU_CYCLE_TIME"] = str(args.cycle_time_ms)
     if args.cache_capacity is not None:
         env["HVD_TPU_CACHE_CAPACITY"] = str(args.cache_capacity)
     if args.hierarchical_allreduce:

@@ -84,6 +84,40 @@ def test_engine_hierarchical_config(rng):
                                    atol=1e-6)
 
 
+def test_hierarchical_allgather_matches_flat(mesh2d, rng):
+    # MPIHierarchicalAllgather analog: AG(local/ICI) → AG(cross/DCN) must
+    # reproduce the flat allgather's global row order exactly.
+    x = rng.standard_normal((8, 3, 5)).astype(np.float32)
+
+    f = jax.jit(jax.shard_map(
+        lambda v: C.hierarchical_allgather(
+            v.reshape(v.shape[1:]), "local", "cross")[None],
+        mesh=mesh2d, in_specs=P(("cross", "local")),
+        out_specs=P(("cross", "local"))))
+    out = np.asarray(f(x))
+    expected = x.reshape(24, 5)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], expected)
+
+
+def test_engine_hierarchical_allgather_config(rng):
+    # HVD_TPU_HIERARCHICAL_ALLGATHER knob wired through the engine.
+    import horovod_tpu as hvd
+    from horovod_tpu.common.config import configure
+    from horovod_tpu.ops.eager import EagerEngine
+
+    ctx = hvd.init()
+    cfg = configure(hierarchical_allgather=True)
+    devs = np.array(jax.devices()).reshape(2, 4)
+    hier = Mesh(devs, ("cross", "local"))
+    eng = EagerEngine(ctx.mesh, cfg.rank_axis, cfg, hier_mesh=hier)
+    x = rng.standard_normal((8, 2, 3)).astype(np.float32)
+    out = eng.gather(eng.allgather(eng.scatter(x)))
+    expected = x.reshape(16, 3)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], expected)
+
+
 def test_adasum_hierarchical(mesh2d, rng):
     # AdasumGpuAllreduceOp analog: average within local, adasum across.
     from horovod_tpu.ops import adasum

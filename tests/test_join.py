@@ -1,0 +1,96 @@
+"""Public hvd.join() — the fifth core collective (reference:
+operations.cc:1085-1109 EnqueueJoin, JoinOp collective_operations.h:259-267,
+torch/mpi_ops.py:631-644).
+
+Single-process: vacuous (all ranks join at the same program point).
+Multi-process: a joined process answers JOIN in every collective round and
+re-dispatches the active processes' allreduces with zero tensors; AVERAGE
+divides by the number of active ranks.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import runner
+
+
+def test_join_single_process_vacuous(hvd):
+    # All 8 virtual ranks reach join() at once; returns the last rank id.
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_join_allreduce_primitive(hvd):
+    """In-jit join_allreduce: joined ranks contribute zeros, AVERAGE
+    divides by active count."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import collectives as C
+
+    x = np.arange(8, dtype=np.float32).reshape(8, 1) + 1.0  # rank r -> r+1
+    joined = np.array([0, 0, 0, 0, 1, 1, 1, 1], np.float32).reshape(8, 1)
+
+    def per_rank(v, j):
+        return C.join_allreduce(v, j[0, 0] > 0.5, C.ReduceOp.AVERAGE,
+                                "hvd")
+
+    mesh = hvd._ctx().mesh
+    f = jax.jit(jax.shard_map(per_rank, mesh=mesh,
+                              in_specs=(P("hvd"), P("hvd")),
+                              out_specs=P("hvd")))
+    out = np.asarray(f(x, joined))
+    # Active ranks 0-3 hold 1,2,3,4 -> average 2.5 over 4 active ranks.
+    np.testing.assert_allclose(out.reshape(-1), np.full(8, 2.5), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_join_two_process_early_exit():
+    """VERDICT r1 #7 done-check: REAL 2-process world where rank 1 joins an
+    epoch early; rank 0 keeps allreducing and its averages stay correct
+    (divided by the active count); join returns the last-joined rank."""
+
+    def work():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1, join_mode=True,
+                 stall_check_time_seconds=30.0)
+        assert hvd.size() == 2
+        rank = int(os.environ["HVD_TPU_PROC_ID"])
+
+        def val(out):
+            return float(np.asarray(
+                out.addressable_data(0)).reshape(-1)[0])
+
+        results = []
+        for i in range(2):  # both ranks train together
+            out = hvd.allreduce(np.full(3, float(rank + 1), np.float32),
+                                name=f"step{i}")
+            results.append(val(out))
+        if rank == 1:
+            last = hvd.join()
+            return ("joined", results, last)
+        for i in range(2, 4):  # rank 0 trains alone
+            out = hvd.allreduce(np.full(3, 7.0, np.float32),
+                                name=f"step{i}")
+            results.append(val(out))
+        last = hvd.join()
+        return ("active", results, last)
+
+    results = runner.run(work, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    r0, r1 = results
+    assert r0[0] == "active" and r1[0] == "joined"
+    # Joint epoch: average of (1, 2) over both ranks.
+    assert r0[1][:2] == [1.5, 1.5] and r1[1] == [1.5, 1.5]
+    # Solo epoch: rank 1 contributes zeros and is excluded from the
+    # divisor — rank 0's average is its own value, not value/2.
+    assert r0[1][2:] == [7.0, 7.0]
+    # Rank 0 joined last.
+    assert r0[2] == 0 and r1[2] == 0

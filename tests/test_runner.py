@@ -51,12 +51,11 @@ def test_host_assignments_too_many():
 
 def test_cli_parse_knobs():
     args = launch_lib.parse_args(
-        ["-np", "4", "--fusion-threshold-mb", "32", "--cycle-time-ms", "2",
+        ["-np", "4", "--fusion-threshold-mb", "32",
          "--timeline-filename", "/tmp/t.json", "--compression", "bf16",
          "--no-stall-check", "--", "python", "train.py"])
     env = launch_lib.knob_env(args)
     assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
-    assert env["HVD_TPU_CYCLE_TIME"] == "2.0"
     assert env["HVD_TPU_TIMELINE"] == "/tmp/t.json"
     assert env["HVD_TPU_COMPRESSION_DTYPE"] == "bf16"
     assert env["HVD_TPU_STALL_CHECK_DISABLE"] == "1"

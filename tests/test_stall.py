@@ -1,0 +1,91 @@
+"""Stall inspector + watchdog tests.
+
+Reference behavior: horovod/common/stall_inspector.cc:28+ warns when a
+collective is pending past HOROVOD_STALL_CHECK_TIME_SECONDS and shuts the
+job down past HOROVOD_STALL_SHUTDOWN_TIME_SECONDS (stall_inspector.h:75-80);
+the background thread polls it every cycle. Here a daemon watchdog thread
+polls, latches the fatal error, and the next collective submit raises it.
+"""
+
+import logging
+import time
+
+import pytest
+
+from horovod_tpu.common.exceptions import StallError
+from horovod_tpu.common.stall import StallInspector
+
+
+def test_warns_past_check_time(caplog):
+    insp = StallInspector(check_time_seconds=0.05)
+    insp.record_submit("allreduce.grad_0")
+    time.sleep(0.1)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        assert insp.check() is True
+    assert any("allreduce.grad_0" in r.message for r in caplog.records)
+    # Completion clears the stall.
+    insp.record_complete("allreduce.grad_0")
+    assert insp.check() is False
+
+
+def test_shutdown_time_raises():
+    insp = StallInspector(check_time_seconds=0.01,
+                          shutdown_time_seconds=0.05)
+    insp.record_submit("wedged")
+    time.sleep(0.1)
+    with pytest.raises(StallError):
+        insp.check()
+
+
+def test_watchdog_latches_fatal_and_fails_next_submit(caplog):
+    insp = StallInspector(check_time_seconds=0.05,
+                          shutdown_time_seconds=0.15)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        insp.start_watchdog(poll_interval=0.02)
+        insp.record_submit("never_completes")
+        deadline = time.monotonic() + 5.0
+        while insp.fatal is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert insp.fatal is not None
+    # The warning fired before the shutdown threshold tripped.
+    assert any("never_completes" in r.message
+               for r in caplog.records if r.levelno == logging.WARNING)
+    with pytest.raises(StallError):
+        insp.record_submit("next_collective")
+    insp.stop_watchdog()
+
+
+def test_watchdog_quiet_when_collectives_complete():
+    insp = StallInspector(check_time_seconds=0.05,
+                          shutdown_time_seconds=0.2)
+    insp.start_watchdog(poll_interval=0.02)
+    for i in range(5):
+        insp.record_submit(f"t{i}")
+        insp.record_complete(f"t{i}")
+        time.sleep(0.01)
+    time.sleep(0.3)
+    assert insp.fatal is None
+    insp.stop_watchdog()
+
+
+def test_disabled_inspector_is_inert():
+    insp = StallInspector(check_time_seconds=0.0, disabled=True)
+    insp.start_watchdog()
+    assert insp._watchdog is None
+    insp.record_submit("x")
+    assert insp.check() is False
+
+
+def test_context_starts_and_stops_watchdog():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    try:
+        ctx = hvd.init()
+        assert ctx.stall.disabled or ctx.stall._watchdog is not None
+        hvd.shutdown()
+        assert ctx.stall._watchdog is None
+    finally:
+        # Leave the session-scoped runtime initialized for later tests.
+        hvd.shutdown()
+        hvd.init()
