@@ -222,7 +222,11 @@ def _run_benchmark(args, n):
         peak = _peak_flops()
         result["step_tflop"] = round(flops / 1e12, 3)
         if peak:
-            mfu = (val * n / batch_size) * flops / peak
+            # flops is the GLOBAL step program (lowering precedes SPMD
+            # partitioning), so the denominator is the n-chip aggregate
+            # peak: (global steps/s × global flops) / (n × per-chip peak)
+            # — the n cancels against the per-chip rate.
+            mfu = (val / batch_size) * flops / peak
             result["mfu_pct"] = round(100.0 * mfu, 1)
     return result
 

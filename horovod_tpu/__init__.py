@@ -141,8 +141,12 @@ def broadcast(x, root_rank: int = 0, name: Optional[str] = None):
     return _ctx().engine.broadcast(x, root_rank, name)
 
 
-def alltoall(x, name: Optional[str] = None):
-    return _ctx().engine.alltoall(x, name)
+def alltoall(x, name: Optional[str] = None, splits=None):
+    """Even all-to-all, or — with ``splits`` — the dynamic uneven variant
+    where recv splits are negotiated through the controller (reference:
+    operations.cc:1020-1081, controller.h:56-58 AlltoallGetRecvSplits).
+    See EagerEngine.alltoallv for the two call conventions."""
+    return _ctx().engine.alltoall(x, name, splits=splits)
 
 
 def reducescatter(x, op: ReduceOp = ReduceOp.SUM,

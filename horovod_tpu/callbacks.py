@@ -128,8 +128,14 @@ class LearningRateScheduleCallback(Callback):
         self._epoch: float = 0.0
 
     def _in_range(self) -> bool:
+        # end_epoch is INCLUSIVE at the exact boundary so a warmup ramp
+        # lands on precisely initial_lr at end_epoch before going inert
+        # (any position strictly past it is out of range). When composing
+        # warmup(end=N) with a schedule(start=N), list the warmup callback
+        # first — at the shared boundary the later callback wins.
         return (self._epoch >= self.start_epoch
-                and (self.end_epoch is None or self._epoch < self.end_epoch))
+                and (self.end_epoch is None
+                     or self._epoch <= self.end_epoch))
 
     def _apply(self):
         if self._in_range():
@@ -166,12 +172,13 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
             progress = min(epoch / warmup_epochs, 1.0)
             return (1.0 + progress * (size - 1)) / size
 
-        # end_epoch=None: the multiplier clamps at 1, so past warmup the
-        # callback keeps trainer.lr pinned at exactly initial_lr (trainer.lr
-        # persists between batches, unlike the reference's Keras lr
-        # variable which the base optimizer owns after warmup).
+        # end_epoch=warmup_epochs: past warmup the callback goes inert
+        # (reference _keras/callbacks.py LearningRateWarmupCallbackImpl
+        # sets the same), so a composed LearningRateScheduleCallback —
+        # the Goyal warmup+decay recipe — owns the lr afterwards instead
+        # of being overwritten every batch.
         super().__init__(initial_lr, multiplier, start_epoch=0,
-                         end_epoch=None, staircase=False,
+                         end_epoch=warmup_epochs, staircase=False,
                          steps_per_epoch=steps_per_epoch)
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
@@ -206,16 +213,32 @@ class BestModelCheckpoint(Callback):
         return value < self.best if self.mode == "min" else value > self.best
 
     def on_train_begin(self, logs=None):
-        import jax
+        # Every process constructs the manager and calls save(): orbax's
+        # save/finalize runs cross-process barriers in multi-process jobs
+        # (a rank-0-only manager would deadlock process 0) and writes each
+        # shard exactly once — the reference's rank-0-only semantics are
+        # preserved at the storage layer, not by skipping the call.
+        from .checkpoint import CheckpointManager
 
-        if jax.process_index() == 0:
-            from .checkpoint import CheckpointManager
-
-            self._mgr = CheckpointManager(self.directory,
-                                          max_to_keep=self.max_to_keep)
+        self._mgr = CheckpointManager(self.directory,
+                                      max_to_keep=self.max_to_keep)
 
     def on_epoch_end(self, epoch, logs=None):
+        import jax
+
         value = (logs or {}).get(self.monitor)
+        if jax.process_count() > 1:
+            # The save() below is a cross-process barrier (orbax), so the
+            # save/skip decision must be IDENTICAL on every process —
+            # rank 0's metric (including its absence) is authoritative; a
+            # locally computed monitor value can diverge across
+            # processes. Every process participates in the broadcast
+            # unconditionally, else the broadcast itself would hang.
+            from .functions import broadcast_object
+
+            value = broadcast_object(
+                None if value is None else float(value), root_rank=0,
+                name=f"best_ckpt.{self.monitor}")
         if value is None or not self._improved(float(value)):
             return
         self.best = float(value)

@@ -62,9 +62,16 @@ class CheckpointManager:
 
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
         """Async-save ``tree`` at ``step``; returns False if the manager's
-        save-interval policy skipped it."""
-        if self.rank0_only and jax.process_index() != 0:
-            return False
+        save-interval policy skipped it.
+
+        ``rank0_only`` is single-WRITER semantics, not single-CALLER: in a
+        multi-process job every process must still call save() — orbax's
+        save/finalize runs cross-process barriers, so skipping the call on
+        non-zero ranks would deadlock process 0 — while orbax itself
+        guarantees each shard is written exactly once (and replicated
+        trees are written by their primary replica only). Restore is
+        symmetric: every process calls restore() and receives the data,
+        covering the reference's broadcast-after-rank0-restore pattern."""
         return self._mgr.save(
             step, args=self._ocp.args.StandardSave(tree), force=force)
 

@@ -326,6 +326,32 @@ class Controller:
             raise TensorShapeMismatchError(resp.error)
         return resp
 
+    def exchange(self, tag: str, value: str) -> List[str]:
+        """Symmetric all-gather of small per-rank strings through the KV
+        store — the AlltoallGetRecvSplits transport (reference:
+        controller.h:56-58 gathers every rank's send-split vector so each
+        rank learns its recv splits). Returns the values rank-ordered.
+
+        Unlike negotiate(), the payload is data, not a signature, so
+        every call is a fresh round (per-tag sequence key)."""
+        import hashlib
+
+        with self._lock:
+            seq = self._name_seq.get("exch:" + tag, 0)
+            self._name_seq["exch:" + tag] = seq + 1
+        tag_h = hashlib.sha1(tag.encode()).hexdigest()[:16]
+        base = f"{self.ns}/exch/{tag_h}/{seq}"
+        self.transport.set(f"{base}/{self.rank}", value)
+        out: List[str] = []
+        for r in range(self.size):
+            raw = self.transport.get(f"{base}/{r}", self.timeout_s)
+            if raw is None:
+                raise HorovodInternalError(
+                    f"rank {r} did not publish its value for exchange "
+                    f"{tag!r} within {self.timeout_s}s")
+            out.append(raw)
+        return out
+
     def cache_size(self) -> int:
         with self._lock:
             return len(self._cache)

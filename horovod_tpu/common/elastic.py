@@ -145,6 +145,41 @@ class JaxState(ObjectState):
             self._attrs[k] = restored
 
 
+# Exit code a driver-managed worker uses to say "a PEER failed, not me" —
+# the elastic driver restarts the epoch without blacklisting this host
+# (the reference keeps such workers alive inside the retry loop; with the
+# full-reinit-on-reset restart model the clean exit IS the retry).
+PEER_FAILURE_EXIT_CODE = 79
+# Exit code for "topology changed; restart me with fresh assignments" —
+# raised from HostsUpdatedInterrupt at a commit() point, so state is
+# clean (the reference's graceful re-rendezvous, elastic/worker.py).
+HOSTS_UPDATED_EXIT_CODE = 80
+
+_COMM_FAILURE_MARKERS = (
+    "unavailable", "deadline", "connection", "socket", "closed",
+    "heartbeat", "preempt", "coordination", "peer", "barrier", "aborted",
+    "internal")
+
+
+def _is_comm_failure(e: BaseException) -> bool:
+    """Classify an exception as a distributed-RUNTIME failure (the events
+    the reference surfaces as HorovodInternalError: a dead peer, a torn
+    connection, a coordination-service timeout). Deliberately narrow:
+    the exception must originate from the jax/XLA/grpc runtime AND carry
+    a comm-failure marker — a user's requests.ConnectionError or
+    ValueError('closed file') must surface, not be retried 100 times."""
+    if isinstance(e, HorovodInternalError):
+        return True
+    mod = type(e).__module__ or ""
+    runtime_origin = (type(e).__name__ in ("XlaRuntimeError",
+                                           "JaxRuntimeError")
+                      or mod.startswith(("jaxlib", "grpc")))
+    if not runtime_origin:
+        return False
+    msg = str(e).lower()
+    return any(m in msg for m in _COMM_FAILURE_MARKERS)
+
+
 def run(func: Callable) -> Callable:
     """Decorator: elastic retry loop (reference common/elastic.py:147-168).
 
@@ -154,12 +189,23 @@ def run(func: Callable) -> Callable:
         except HorovodInternalError: state.restore()   # peer died
         except HostsUpdatedInterrupt: pass             # topology changed
         reset(); state.on_reset()
+
+    Under a driver-managed launch (hvdtpurun --elastic exports
+    HVD_TPU_RENDEZVOUS) a peer failure cannot be retried in-process — the
+    world membership changed, so the mesh must be rebuilt — and the worker
+    instead exits with PEER_FAILURE_EXIT_CODE; the driver restarts the
+    epoch with fresh assignments and the worker resumes from its
+    committed state.
     """
 
     def wrapper(state: State, *args, **kwargs):
+        import os
+        import sys
+
         from . import basics
 
-        reset_limit = int(__import__("os").environ.get(
+        driver_managed = bool(os.environ.get("HVD_TPU_RENDEZVOUS"))
+        reset_limit = int(os.environ.get(
             "HVD_TPU_ELASTIC_RESET_LIMIT", "100"))
         resets = 0
         skip_sync = False
@@ -168,14 +214,27 @@ def run(func: Callable) -> Callable:
                 state.sync()
             try:
                 return func(state, *args, **kwargs)
-            except HorovodInternalError as e:
+            except HostsUpdatedInterrupt as e:
+                logger.info("elastic: hosts updated; re-initializing")
+                skip_sync = e.skip_sync
+                if driver_managed:
+                    # The world membership is changing: exit cleanly at
+                    # this commit point so the driver restarts us with
+                    # fresh assignments (graceful re-rendezvous).
+                    sys.exit(HOSTS_UPDATED_EXIT_CODE)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not _is_comm_failure(e):
+                    raise
                 logger.warning("elastic: collective failure (%s); rolling "
                                "back to last commit", e)
                 state.restore()
                 skip_sync = False
-            except HostsUpdatedInterrupt as e:
-                logger.info("elastic: hosts updated; re-initializing")
-                skip_sync = e.skip_sync
+                if driver_managed:
+                    logger.warning(
+                        "elastic: exiting for driver-managed restart "
+                        "(peer failure, exit code %d)",
+                        PEER_FAILURE_EXIT_CODE)
+                    sys.exit(PEER_FAILURE_EXIT_CODE)
             resets += 1
             if resets > reset_limit:
                 raise RuntimeError(

@@ -387,7 +387,23 @@ class EagerEngine:
         key = ("join_ar", shape, dtype, int(op), joined_t, prescale,
                postscale, compression.__name__)
 
+        if joined_ranks and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+            raise TensorShapeMismatchError(
+                f"allreduce op {op.name} is not supported while a rank "
+                "has joined (JoinOp substitutes zeros, which only "
+                "composes with SUM/AVERAGE — reference JoinOp semantics)")
+
         def build():
+            if not joined_ranks:
+                # Nobody has joined: ordinary allreduce — every ReduceOp
+                # (MIN/MAX/PRODUCT/Adasum) keeps working under join_mode.
+                def per_rank(v):
+                    w, ctx = compression.compress(v)
+                    w = C.allreduce(w, op, self.axis, prescale, postscale)
+                    return compression.decompress(w, ctx)
+
+                return self._shard_mapped(per_rank)
+
             flags = np.array(
                 [1.0 if d.process_index in joined_ranks else 0.0
                  for d in self.mesh.devices.flat], np.float32)
@@ -658,7 +674,14 @@ class EagerEngine:
                 return jax.jit(lambda ls: f(*ls))
 
             on_complete = None
-            if self.autotuner is not None and not self.autotuner.done:
+            # Single-controller only: per-process timing samples would
+            # move each process's threshold independently → diverged
+            # bucket plans → deadlocked cross-process collectives. In
+            # multi-process mode decisions are made by rank 0 and synced
+            # through AutotunedStepper's exchange (the reference's
+            # SynchronizeParameters, controller.cc:34-48).
+            if (self.autotuner is not None and not self.autotuner.done
+                    and self.controller is None):
                 nbytes = sum(int(np.prod(l.shape[1:]) or 1)
                              * l.dtype.itemsize for l in leaves)
                 t0 = time.perf_counter()
@@ -760,9 +783,12 @@ class EagerEngine:
             raise
         return self._finalize_async(full, out)
 
-    def alltoall(self, x, name: Optional[str] = None):
+    def alltoall(self, x, name: Optional[str] = None, splits=None):
         """Even all-to-all on a rank-major (size, m, ...) array where each
-        rank's m rows are split into `size` equal chunks."""
+        rank's m rows are split into `size` equal chunks. With ``splits``,
+        the dynamic uneven variant (see :meth:`alltoallv`)."""
+        if splits is not None:
+            return self.alltoallv(x, splits, name)
         full = self._begin(name, "alltoall")
         try:
             self._negotiate("alltoall", full, x)
@@ -779,6 +805,119 @@ class EagerEngine:
             self._end(full)
             raise
         return self._finalize_async(full, out)
+
+    def alltoallv(self, x, splits, name: Optional[str] = None):
+        """Dynamic uneven all-to-all: callers pass only their LOCAL split
+        sizes; recv splits are negotiated through the controller (the
+        reference's AlltoallGetRecvSplits path, controller.h:56-58 +
+        operations.cc:1020-1081), then buffers are padded to the
+        negotiated max, exchanged with a static-shape XLA all_to_all, and
+        sliced back out.
+
+        Two call conventions, mirroring the engine's layout model:
+
+        * single-controller: ``x`` = list of per-rank arrays, ``splits`` =
+          full n×n matrix (``splits[s][d]`` = rows rank ``s`` sends to
+          ``d``); returns the list of per-rank received numpy arrays.
+        * multi-process (one rank per process): ``x`` = this rank's send
+          buffer, ``splits`` = this rank's length-n split vector; returns
+          this rank's received numpy array.
+        """
+        import json
+
+        full = self._begin(name, "alltoall")
+        try:
+            multiproc = self.controller is not None and \
+                self.controller.size > 1
+            if multiproc:
+                if self.controller.size != self.size:
+                    raise NotImplementedError(
+                        "dynamic alltoallv in multi-process mode assumes "
+                        "one rank per process")
+                xs_local = np.asarray(x)
+                my_splits = [int(s) for s in splits]
+                if len(my_splits) != self.size:
+                    raise TensorShapeMismatchError(
+                        f"splits must have length {self.size}, got "
+                        f"{len(my_splits)}")
+                if sum(my_splits) != xs_local.shape[0]:
+                    raise TensorShapeMismatchError(
+                        f"sum(splits)={sum(my_splits)} != send rows "
+                        f"{xs_local.shape[0]}")
+                # The negotiation: every rank publishes its send splits,
+                # learns everyone's — column r is rank r's recv splits.
+                rows = self.controller.exchange(
+                    full, json.dumps(my_splits))
+                matrix = [json.loads(r) for r in rows]
+                rest = tuple(xs_local.shape[1:])
+                dtype = xs_local.dtype
+            else:
+                xs = [np.asarray(v) for v in x]
+                if len(xs) != self.size or len(splits) != self.size:
+                    raise TensorShapeMismatchError(
+                        f"need {self.size} per-rank inputs/split rows")
+                matrix = [[int(c) for c in row] for row in splits]
+                for r, (v, row) in enumerate(zip(xs, matrix)):
+                    if sum(row) != v.shape[0]:
+                        raise TensorShapeMismatchError(
+                            f"rank {r}: sum(splits)={sum(row)} != send "
+                            f"rows {v.shape[0]}")
+                rest = tuple(xs[0].shape[1:])
+                dtype = xs[0].dtype
+
+            n = self.size
+            maxs = max(max(row) for row in matrix) if n else 0
+            # Pad each (src, dst) segment to maxs rows: rank s's send
+            # buffer becomes (n * maxs, ...) destination-major.
+            def padded_send(v, row):
+                buf = np.zeros((n * maxs,) + rest, dtype)
+                off = 0
+                for d in range(n):
+                    buf[d * maxs:d * maxs + row[d]] = v[off:off + row[d]]
+                    off += row[d]
+                return buf
+
+            if multiproc:
+                local = padded_send(xs_local, my_splits)
+                stacked = np.broadcast_to(
+                    local[None], (n,) + local.shape)
+                dt = jax.make_array_from_callback(
+                    stacked.shape, self._rank_sharding(),
+                    lambda idx: np.ascontiguousarray(stacked[idx]))
+            else:
+                dt = self.scatter(np.stack(
+                    [padded_send(v, row) for v, row in zip(xs, matrix)]))
+
+            mkey = tuple(tuple(row) for row in matrix)
+            key = ("a2av", dt.shape, str(dt.dtype), mkey)
+
+            def build():
+                def per_rank(v):
+                    return C.alltoallv(v.reshape(v.shape[1:]), matrix,
+                                       self.axis)[None]
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+            # Slice the ragged results back out host-side (the reference
+            # returns each rank's recv buffer; recv splits are column r).
+            if multiproc:
+                y = np.asarray(out.addressable_data(0)).reshape(
+                    (n * maxs,) + rest)
+                r = self.controller.rank
+                res = np.concatenate(
+                    [y[s * maxs:s * maxs + matrix[s][r]]
+                     for s in range(n)], axis=0)
+            else:
+                ys = self.gather(out)
+                res = [np.concatenate(
+                           [ys[d, s * maxs:s * maxs + matrix[s][d]]
+                            for s in range(n)], axis=0)
+                       for d in range(n)]
+        except Exception:
+            self._end(full)
+            raise
+        self._end(full)
+        return res
 
     def reducescatter(self, x, op: C.ReduceOp = C.ReduceOp.SUM,
                       name: Optional[str] = None):
@@ -801,6 +940,14 @@ class EagerEngine:
         return self._finalize_async(full, out)
 
     def barrier(self):
+        if self.join_active():
+            # Lockstep round so a joined process stays in sync; the
+            # coordinator errors if any rank has joined (a barrier cannot
+            # be satisfied by a zero-tensor stand-in).
+            from ..common.controller import Request
+
+            self._join_round(Request(self.controller.rank, "barrier",
+                                     "barrier", "int32", (), 0, -1))
         key = ("barrier",)
 
         def build():

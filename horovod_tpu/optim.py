@@ -262,19 +262,31 @@ class AutotunedStepper:
     """
 
     def __init__(self, build_step: Callable[[int], Callable],
-                 grad_bytes: int, tuner=None, block: bool = True):
-        if tuner is None:
-            from .common import basics
+                 grad_bytes: int, tuner=None, block: bool = True,
+                 controller=None):
+        from .common import basics
 
+        if tuner is None:
             tuner = basics.context().autotuner
             if tuner is None:
                 raise ValueError(
                     "runtime autotuner not enabled — init(autotune=True) "
                     "or set HVD_TPU_AUTOTUNE=1, or pass tuner= explicitly")
+        if controller is None and basics.is_initialized():
+            controller = basics.context().controller
         self.tuner = tuner
         self.grad_bytes = int(grad_bytes)
         self.block = block
         self._build = build_step
+        # Multi-process: rank 0 alone scores samples and decides; every
+        # process adopts the decision at the SAME call index via a
+        # synchronous controller exchange — per-process decisions would
+        # compile diverged bucket plans and deadlock the collectives
+        # (reference: SynchronizeParameters broadcasts rank-0's
+        # ParameterManager state, controller.cc:34-48).
+        self._controller = controller
+        self._period = tuner.warmup + tuner.steps_per_sample
+        self._calls = 0
         self._threshold = tuner.current
         self._step = build_step(self._threshold)
         self.rebuilds = 0
@@ -290,7 +302,23 @@ class AutotunedStepper:
         out = self._step(*args, **kwargs)
         if self.block:
             jax.block_until_ready(out)
-        new = self.tuner.feed(self.grad_bytes, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        c = self._controller
+        if c is None or c.size == 1:
+            new = self.tuner.feed(self.grad_bytes, dt)
+        else:
+            if c.rank == 0:
+                self.tuner.record(self.grad_bytes, dt)
+            self._calls += 1
+            new = self._threshold
+            if self._calls % self._period == 0:
+                # Sample boundary — same call index on every process
+                # (SPMD lockstep), so the exchange is synchronous.
+                if c.rank == 0 and self.tuner.ready():
+                    self.tuner.suggest()
+                vals = c.exchange("autotune_threshold",
+                                  str(self.tuner.current))
+                new = int(vals[0])  # rank 0's decision wins
         if new != self._threshold:
             self._threshold = new
             self._step = self._build(new)

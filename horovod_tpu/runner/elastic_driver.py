@@ -28,7 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from . import hosts as hosts_lib
-from .launch import build_env_for_slot, run_local
+from .launch import build_env_for_slot
 from .rendezvous import RendezvousServer
 
 logger = logging.getLogger("horovod_tpu")
@@ -225,22 +225,185 @@ class ElasticDriver:
         self._host_change.set()
 
 
+_LOCAL_NAMES = ("localhost", "127.0.0.1")
+
+
+def _is_local_epoch(slots: List[hosts_lib.SlotInfo]) -> bool:
+    import socket
+
+    if os.environ.get("HVD_TPU_ELASTIC_FORCE_LOCAL"):
+        # Test/dev path: treat hostnames as virtual and fork everything
+        # locally (the reference's integration tests alias localhost the
+        # same way, elastic_common.py) — blacklist semantics stay
+        # per-virtual-host.
+        return True
+    return all(s.hostname in _LOCAL_NAMES
+               or s.hostname == socket.gethostname() for s in slots)
+
+
+def _stream(proc: subprocess.Popen, tag: str) -> threading.Thread:
+    import sys
+
+    def pump():
+        assert proc.stdout is not None
+        for line in iter(proc.stdout.readline, b""):
+            sys.stdout.write(f"[{tag}]: {line.decode(errors='replace')}")
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
+               command: List[str], env_extra: Dict[str, str],
+               ssh_port=None, poll_interval: float = 0.1,
+               on_hosts_updated=None):
+    """Run one elastic epoch with per-worker exit tracking.
+
+    Returns ``(rc, failed_hosts, interrupted)``: ``failed_hosts`` are
+    hosts whose worker exited non-zero ON ITS OWN (candidates for the
+    blacklist — reference registration.py _action); ``interrupted`` means
+    the epoch ended because discovery reported a host-set change (never
+    blacklisted). On a host-set change ``on_hosts_updated`` fires FIRST
+    (bumping the rendezvous topology_version), then workers get
+    HVD_TPU_ELASTIC_GRACE_SECS to exit gracefully at a commit() point
+    (HOSTS_UPDATED_EXIT_CODE) before being terminated.
+    """
+    import shlex
+    import signal
+    from .launch import _free_port, _slot_local_env
+
+    local = _is_local_epoch(slots)
+    procs: List = []  # (hostname, Popen)
+    threads: List[threading.Thread] = []
+    if local:
+        port = _free_port()
+        coordinator = f"127.0.0.1:{port}"
+        for s in slots:
+            env = build_env_for_slot(
+                dict(os.environ), coordinator, len(slots), s.rank,
+                {**env_extra,
+                 **_slot_local_env(s.local_rank, s.local_size),
+                 "HVD_TPU_HOSTNAME": s.hostname})
+            p = subprocess.Popen(command, env=env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+            procs.append((s.hostname, p))
+            threads.append(_stream(p, f"{s.hostname}[{s.rank}]"))
+    else:
+        # One process per host over ssh; the process drives all of the
+        # host's chips (launch.py run_ssh model).
+        host_order: List[str] = []
+        for s in slots:
+            if s.hostname not in host_order:
+                host_order.append(s.hostname)
+        coordinator = f"{host_order[0]}:{_free_port()}"
+        for i, hostname in enumerate(host_order):
+            env = build_env_for_slot({}, coordinator, len(host_order), i,
+                                     {**env_extra,
+                                      **_slot_local_env(0, 1),
+                                      "HVD_TPU_HOSTNAME": hostname})
+            env_str = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            remote = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \
+                " ".join(shlex.quote(c) for c in command)
+            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh_cmd += ["-p", str(ssh_port)]
+            p = subprocess.Popen(ssh_cmd + [hostname, remote],
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+            procs.append((hostname, p))
+            threads.append(_stream(p, hostname))
+
+    from ..common.elastic import (HOSTS_UPDATED_EXIT_CODE,
+                                  PEER_FAILURE_EXIT_CODE)
+
+    rc = 0
+    failed: Set[str] = set()
+    interrupted = False
+    terminated = False
+    epoch_ending = False
+    grace_deadline = None
+    grace = float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30"))
+
+    def terminate_all():
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    try:
+        while True:
+            running = False
+            for hostname, p in procs:
+                code = p.poll()
+                if code is None:
+                    running = True
+                elif code != 0 and not terminated:
+                    rc = rc or code
+                    if code == HOSTS_UPDATED_EXIT_CODE:
+                        interrupted = True
+                        epoch_ending = True
+                    elif code == PEER_FAILURE_EXIT_CODE:
+                        # "My peer failed, not me" — restart this host's
+                        # worker, don't blacklist it; but the epoch is
+                        # over, so stop waiting on wedged survivors.
+                        epoch_ending = True
+                    else:
+                        # Worker died on its own → candidate for blacklist
+                        # (reference: WorkerStateRegistry FAILURE →
+                        # HostManager.blacklist, registration.py:150-153).
+                        failed.add(hostname)
+            if failed and not terminated:
+                terminate_all()
+                terminated = True
+            if not terminated and not interrupted and \
+                    driver.hosts_updated():
+                # Topology changed mid-epoch: publish the new version
+                # FIRST so workers see it at their next commit() and exit
+                # gracefully (HostsUpdatedInterrupt channel), then give
+                # them a grace window before terminating.
+                interrupted = True
+                if on_hosts_updated is not None:
+                    on_hosts_updated()
+                grace_deadline = time.monotonic() + grace
+            if epoch_ending and not terminated and grace_deadline is None:
+                grace_deadline = time.monotonic() + grace
+            if (grace_deadline is not None and not terminated
+                    and time.monotonic() > grace_deadline):
+                terminate_all()
+                terminated = True
+            if not running:
+                break
+            time.sleep(poll_interval)
+        for t in threads:
+            t.join(timeout=2)
+        return rc, failed, interrupted
+    except KeyboardInterrupt:
+        for _, p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        for _, p in procs:
+            p.wait()
+        return 1, failed, interrupted
+
+
 def run_elastic(args, command: List[str],
                 env_extra: Dict[str, str]) -> int:
     """Driver-side elastic launch (reference gloo_run_elastic
-    gloo_run.py:326 + launch.py:616): workers restart with fresh topology
-    env until success or the reset limit / min-np floor is hit.
+    gloo_run.py:326 + launch.py:616 + elastic/driver.py:68-309).
 
-    The driver runs a rendezvous KV server and publishes a monotonically
-    increasing ``topology/version`` on every host-set change; workers poll
-    it at commit() points (Context.host_update_notifier) and raise
-    HostsUpdatedInterrupt for graceful re-rendezvous — the reference's
-    WorkerNotificationClient channel (elastic/worker.py).
-
-    Local-process implementation: the worker set is re-forked on every
-    topology change; real multi-host ssh fan-out reuses the same loop with
-    run_ssh per epoch.
-    """
+    Per epoch: wait for >= min_np slots among non-blacklisted hosts,
+    compute RANK-STABLE assignments (surviving hosts keep their ranks),
+    spawn one worker per slot (local) or per host (ssh), and watch
+    per-worker exits. A worker that dies on its own blacklists its host;
+    a discovery change restarts the epoch with new assignments. The
+    rendezvous KV publishes a monotonically increasing topology_version
+    workers poll at commit() (HostsUpdatedInterrupt channel — reference
+    elastic/worker.py). Workers resume from their committed state
+    (full-reinit-on-reset: a changed device mesh requires recompilation,
+    so the restart IS the reset)."""
     min_np = args.min_np or args.num_proc
     max_np = args.max_np or args.num_proc
     if args.host_discovery_script:
@@ -269,34 +432,35 @@ def run_elastic(args, command: List[str],
     try:
         attempts = 0
         while True:
-            hosts = driver.wait_for_available_slots(min_np)
-            np_now = min(max_np, sum(hosts.values()))
-            logger.info("elastic launch attempt %d with np=%d", attempts,
-                        np_now)
-
-            # Publish topology changes while workers run.
-            stop_pub = threading.Event()
-
-            def publisher():
-                while not stop_pub.is_set():
-                    if driver.hosts_updated():
-                        bump_version()
-                    stop_pub.wait(driver.discovery_interval)
-
-            pub = threading.Thread(target=publisher, daemon=True)
-            pub.start()
             try:
-                rc = run_local(np_now, command, env_extra)
-            finally:
-                stop_pub.set()
-                pub.join(timeout=2)
-            if rc == 0:
+                driver.wait_for_available_slots(min_np)
+            except TimeoutError as e:
+                logger.error("elastic: %s", e)
+                return 1
+            slots = driver.update_assignments()
+            logger.info(
+                "elastic launch attempt %d with np=%d over hosts %s",
+                attempts, len(slots),
+                sorted({s.hostname for s in slots}))
+            rc, failed_hosts, interrupted = _run_epoch(
+                driver, slots, command, env_extra,
+                ssh_port=getattr(args, "ssh_port", None),
+                on_hosts_updated=bump_version)
+            if rc == 0 and not failed_hosts and not interrupted:
                 return 0
+            for h in failed_hosts:
+                driver.record_failure(h)
             bump_version()
             attempts += 1
             if attempts > int(os.environ.get(
                     "HVD_TPU_ELASTIC_RESET_LIMIT", "100")):
-                return rc
+                logger.error("elastic: reset limit exceeded")
+                return rc or 1
+            if not driver.host_manager.current_hosts():
+                logger.error(
+                    "elastic: every host is blacklisted or gone — "
+                    "job failed (reference registration.py:156)")
+                return rc or 1
     finally:
         rdv.stop()
         driver.stop()

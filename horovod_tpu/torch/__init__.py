@@ -181,17 +181,16 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 
 # -- DistributedOptimizer (reference torch/optimizer.py:103-207) ------------
 
-class _DistributedOptimizer(torch.optim.Optimizer):
-    """Wraps a torch optimizer: grad-accumulator hooks launch one async
-    allreduce per parameter; ``step()`` synchronizes all handles then runs
-    the wrapped optimizer on the averaged gradients — the reference's
-    overlap model (torch/optimizer.py:103-207), with the engine's
-    controller/fusion doing the bucketing the C++ core did."""
+class _DistributedOptimizerMixin:
+    """Method set grafted onto the USER's optimizer class: grad-accumulator
+    hooks launch one async allreduce per parameter; ``step()`` synchronizes
+    all handles then runs the base optimizer on the averaged gradients —
+    the reference's overlap model (torch/optimizer.py:103-207), with the
+    engine's controller/fusion doing the bucketing the C++ core did."""
 
-    def __init__(self, optimizer: torch.optim.Optimizer,
-                 named_parameters=None, op: ReduceOp = Average,
-                 backward_passes_per_step: int = 1):
-        self._inner = optimizer
+    def _dist_init(self, base_cls, named_parameters, op,
+                   backward_passes_per_step):
+        self._base_cls = base_cls
         self.op = op
         self.backward_passes_per_step = backward_passes_per_step
         self._passes = 0
@@ -200,33 +199,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
         self._hooks = []
-        for group in optimizer.param_groups:
+        for group in self.param_groups:
             for p in group["params"]:
                 if p.requires_grad:
                     self._hooks.append(p.register_post_accumulate_grad_hook(
                         self._make_hook()))
-
-    # expose the wrapped optimizer's surface
-    @property
-    def param_groups(self):
-        return self._inner.param_groups
-
-    @param_groups.setter
-    def param_groups(self, v):
-        self._inner.param_groups = v
-
-    @property
-    def state(self):
-        return self._inner.state
-
-    def state_dict(self):
-        return self._inner.state_dict()
-
-    def load_state_dict(self, sd):
-        self._inner.load_state_dict(sd)
-
-    def zero_grad(self, set_to_none: bool = True):
-        self._inner.zero_grad(set_to_none=set_to_none)
 
     def _make_hook(self):
         def hook(p: torch.Tensor) -> None:
@@ -252,13 +229,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             return None
         self.synchronize()
         self._passes = 0
-        return self._inner.step(closure)
+        return self._base_cls.step(self, closure)
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          op: ReduceOp = Average,
-                         backward_passes_per_step: int = 1
-                         ) -> _DistributedOptimizer:
-    return _DistributedOptimizer(optimizer, named_parameters, op,
-                                 backward_passes_per_step)
+                         backward_passes_per_step: int = 1):
+    """Returns an instance of a dynamic subclass of the USER's optimizer
+    class with the mixin's step/synchronize grafted on — the reference's
+    own architecture (torch/optimizer.py:381: ``cls = type(...,
+    (optimizer.__class__,), dict(_DistributedOptimizer.__dict__))``).
+    Unlike a delegation wrapper, every torch.optim.Optimizer internal
+    (defaults, step pre/post hook registries, lr_scheduler's isinstance
+    and step-patching machinery) is genuinely present, because the
+    instance shares the fully-initialized __dict__ of the wrapped
+    optimizer."""
+    cls = type(optimizer.__class__.__name__,
+               (optimizer.__class__,),
+               {k: v for k, v in _DistributedOptimizerMixin.__dict__.items()
+                if not k.startswith("__")})
+    obj = cls.__new__(cls)
+    obj.__dict__.update(optimizer.__dict__)  # share param_groups + state
+    obj._dist_init(optimizer.__class__, named_parameters, op,
+                   backward_passes_per_step)
+    return obj

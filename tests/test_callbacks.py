@@ -90,6 +90,24 @@ def test_lr_warmup_without_steps_per_epoch_applies_per_epoch(hvd):
     assert t.lr == pytest.approx(0.8)
 
 
+def test_lr_warmup_composes_with_schedule(hvd):
+    """Advice r1: warmup must go inert after warmup_epochs so a composed
+    schedule callback (the Goyal warmup+decay recipe) owns lr afterwards
+    instead of being overwritten every batch."""
+    t = _trainer()
+    warm = cb.LearningRateWarmupCallback(initial_lr=0.8, warmup_epochs=2,
+                                         steps_per_epoch=4)
+    decay = cb.LearningRateScheduleCallback(
+        initial_lr=0.8, multiplier=lambda e: 0.1, start_epoch=3,
+        steps_per_epoch=4)
+    cl = cb.CallbackList([warm, decay], t)
+    cl.on_epoch_begin(3)
+    cl.on_batch_begin(1)
+    # Post-warmup: the decay schedule's value must survive the batch —
+    # the broken behavior re-pinned lr to initial_lr here.
+    assert t.lr == pytest.approx(0.08)
+
+
 def test_best_model_checkpoint(tmp_path, hvd):
     from horovod_tpu.checkpoint import CheckpointManager
 

@@ -137,6 +137,42 @@ def test_alltoall_even(hvd):
                                        100 * s + r)
 
 
+def test_alltoallv_uneven_splits(hvd):
+    """VERDICT r1 #8 done-check: eager alltoall with UNEVEN splits across
+    8 ranks — callers pass split sizes, engine pads/exchanges/slices
+    (reference: operations.cc:1020-1081 uneven case)."""
+    n = 8
+    rng_ = np.random.default_rng(7)
+    # splits[s][d]: rows s sends to d — deliberately ragged incl. zeros.
+    splits = [[(s + d) % 4 for d in range(n)] for s in range(n)]
+    xs, tagged = [], {}
+    for s in range(n):
+        rows = sum(splits[s])
+        v = rng_.standard_normal((rows, 2)).astype(np.float32)
+        xs.append(v)
+        off = 0
+        for d in range(n):
+            tagged[(s, d)] = v[off:off + splits[s][d]]
+            off += splits[s][d]
+
+    out = hvd.alltoall(xs, splits=splits)
+    assert len(out) == n
+    for d in range(n):
+        expected = np.concatenate([tagged[(s, d)] for s in range(n)],
+                                  axis=0)
+        assert out[d].shape[0] == sum(splits[s][d] for s in range(n))
+        np.testing.assert_allclose(out[d], expected, rtol=1e-6)
+
+
+def test_alltoallv_split_sum_validated(hvd):
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+    xs = [np.zeros((3, 2), np.float32) for _ in range(8)]
+    bad = [[1] * 8 for _ in range(8)]  # sums to 8, buffers have 3 rows
+    with pytest.raises(TensorShapeMismatchError):
+        hvd.alltoall(xs, splits=bad)
+
+
 def test_reducescatter(hvd, rng):
     x = rng.standard_normal((8, 16, 3)).astype(np.float32)
     out = hvd.gather(hvd.reducescatter(hvd.scatter(x), op=hvd.Sum))

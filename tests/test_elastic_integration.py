@@ -1,0 +1,141 @@
+"""Elastic end-to-end with REAL fault injection (reference:
+test/integration/elastic_common.py — launches actual elastic jobs with a
+discovery script whose output the test mutates, and kills workers
+mid-training).
+
+The job runs under ``hvdtpurun --elastic --host-discovery-script`` with
+virtual hosts forked locally (HVD_TPU_ELASTIC_FORCE_LOCAL — the
+reference's localhost aliasing). Flow under test:
+
+1. epoch 0: hostA+hostB train together, committing state each step;
+2. at step 5 hostB's worker kills itself (hard exit) — the driver must
+   blacklist hostB and restart survivors with stable ranks;
+3. discovery (keyed off the kill marker) then offers hostA+hostB+hostC —
+   hostB stays excluded (blacklist), hostC joins as rank 1;
+4. training resumes from the last committed step and completes.
+"""
+
+import os
+import stat
+import sys
+
+import pytest
+
+from horovod_tpu.runner import launch as launch_lib
+
+TRAIN_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import ObjectStore
+from horovod_tpu.common.elastic import JaxState
+
+workdir = sys.argv[1]
+TOTAL = 12
+hvd.init(force_cpu_devices=1)
+rank = int(os.environ["HVD_TPU_PROC_ID"])
+host = os.environ.get("HVD_TPU_HOSTNAME", "?")
+store = ObjectStore(os.path.join(workdir, "ckpt"))
+
+state = JaxState(w=np.zeros(2, np.float32), step=0)
+saved = store.get("state")
+if saved is not None:
+    for k, v in saved.items():
+        setattr(state, k, v)
+    state.save()
+
+log = open(os.path.join(workdir, "progress.log"), "a")
+
+
+@hvd.elastic.run
+def train(state):
+    while state.step < TOTAL:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="grad")
+        w = np.asarray(out.addressable_data(0)).reshape(-1)
+        state.w = state.w + w
+        state.step += 1
+        kill_marker = os.path.join(workdir, "killed")
+        if (state.step == 5 and host == "hostB"
+                and not os.path.exists(kill_marker)):
+            open(kill_marker, "w").write("1")
+            os._exit(1)  # hard failure mid-training, before commit
+        state.commit()
+        if rank == 0:
+            store.put("state", dict(state.committed_items()))
+        print(f"PROGRESS {host} rank={rank} step={state.step} "
+              f"size={hvd.size()}", file=log, flush=True)
+
+
+train(state)
+"""
+
+DISCOVERY_SCRIPT = """#!/bin/bash
+if [ -f {workdir}/killed ]; then
+  echo "hostA:1"
+  echo "hostB:1"
+  echo "hostC:1"
+else
+  echo "hostA:1"
+  echo "hostB:1"
+fi
+"""
+
+
+@pytest.mark.slow
+def test_elastic_blacklist_and_resume(tmp_path, monkeypatch):
+    workdir = str(tmp_path)
+    train_py = os.path.join(workdir, "train.py")
+    with open(train_py, "w") as f:
+        f.write(TRAIN_SCRIPT)
+    disco = os.path.join(workdir, "discovery.sh")
+    with open(disco, "w") as f:
+        f.write(DISCOVERY_SCRIPT.format(workdir=workdir))
+    os.chmod(disco, os.stat(disco).st_mode | stat.S_IEXEC)
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_FORCE_LOCAL", "1")
+    monkeypatch.setenv("HVD_TPU_ELASTIC_RESET_LIMIT", "10")
+    # Workers run `python /tmp/.../train.py` whose sys.path[0] is the tmp
+    # dir — append (never replace) the repo root so horovod_tpu imports.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    rc = launch_lib.run_commandline(
+        ["-np", "2", "--elastic", "--min-np", "1", "--max-np", "3",
+         "--host-discovery-script", disco, "--",
+         sys.executable, train_py, workdir])
+    assert rc == 0
+
+    assert os.path.exists(os.path.join(workdir, "killed")), \
+        "fault injection never fired"
+    lines = open(os.path.join(workdir, "progress.log")).read().splitlines()
+    recs = []
+    for l in lines:
+        if not l.startswith("PROGRESS"):
+            continue
+        parts = l.split()
+        kv = dict(p.split("=") for p in parts[2:])
+        recs.append((parts[1], int(kv["rank"]), int(kv["step"]),
+                     int(kv["size"])))
+    assert recs, "no progress recorded"
+
+    # Training completed all steps.
+    assert max(step for _, _, step, _ in recs) == 12
+    # Phase 1 ran on hostB; after the failure hostB NEVER reappears
+    # (blacklisted even though discovery kept listing it) and hostC joins.
+    hostb_steps = [step for h, _, step, _ in recs if h == "hostB"]
+    assert hostb_steps and max(hostb_steps) <= 5
+    assert any(h == "hostC" for h, _, _, _ in recs), \
+        "new host never joined after the topology change"
+    # Rollback-to-commit: hostC's first step resumes from no later than
+    # the last committed step + 1 (commits ran through step 4 before the
+    # kill at step 5).
+    first_c = min(step for h, _, step, _ in recs if h == "hostC")
+    assert first_c <= 6
+    # hostA kept rank 0 across the restart (rank stability).
+    assert all(rank == 0 for h, rank, _, _ in recs if h == "hostA")

@@ -5,6 +5,7 @@ Worker functions are defined inside the tests so cloudpickle serializes
 them by value (the workers cannot import the test module).
 """
 
+import numpy as np
 import pytest
 
 from horovod_tpu import runner
@@ -68,6 +69,45 @@ def test_run_kwargs_roundtrip():
         return a + b
 
     assert runner.run(echo, args=(1,), kwargs={"b": 41}, np=2) == [42, 42]
+
+
+@pytest.mark.slow
+def test_run_alltoallv_negotiated_splits():
+    """Dynamic alltoallv across a REAL 2-process world: each rank passes
+    only its LOCAL split vector; recv splits arrive via the controller
+    exchange (reference: AlltoallGetRecvSplits, controller.h:56-58)."""
+
+    def work():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1)
+        assert hvd.size() == 2
+        rank = int(os.environ["HVD_TPU_PROC_ID"])
+        # rank 0 sends [1 row -> r0, 3 rows -> r1]; rank 1 [2 -> r0, 1 -> r1]
+        splits = [[1, 3], [2, 1]][rank]
+        rows = sum(splits)
+        x = np.full((rows, 2), 10.0 * (rank + 1), np.float32)
+        x[:, 1] = np.arange(rows)  # row ids for order checking
+        out = hvd.alltoall(x, splits=splits, name="a2av")
+        return out.tolist()
+
+    results = runner.run(work, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    r0 = np.asarray(results[0], np.float32)
+    r1 = np.asarray(results[1], np.float32)
+    # rank 0 receives: 1 row from itself (rows 0), 2 rows from rank 1.
+    np.testing.assert_allclose(r0[:, 0], [10.0, 20.0, 20.0])
+    np.testing.assert_allclose(r0[:, 1], [0.0, 0.0, 1.0])
+    # rank 1 receives: 3 rows from rank 0 (rows 1-3), 1 from itself (row 2).
+    np.testing.assert_allclose(r1[:, 0], [10.0, 10.0, 10.0, 20.0])
+    np.testing.assert_allclose(r1[:, 1], [1.0, 2.0, 3.0, 2.0])
 
 
 @pytest.mark.slow
