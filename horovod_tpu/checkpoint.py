@@ -47,7 +47,18 @@ class CheckpointManager:
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
-        self.rank0_only = rank0_only
+        if rank0_only:
+            import warnings
+
+            # Kept for API compatibility only: single-writer semantics
+            # are provided by orbax's storage layer (each shard written
+            # exactly once); skipping save() calls on non-zero ranks
+            # would deadlock orbax's cross-process barriers.
+            warnings.warn(
+                "rank0_only is a no-op: every process must call save() "
+                "(orbax runs cross-process barriers) and orbax already "
+                "writes each shard exactly once", DeprecationWarning,
+                stacklevel=2)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,

@@ -147,15 +147,33 @@ class Timeline:
     # -- writer thread (reference timeline.cc TimelineWriter) --------------
 
     def _writer(self) -> None:
-        events = []
-        while True:
-            ev = self._queue.get()
-            if ev is None:
-                break
-            events.append(ev)
+        # STREAMS each event to disk as it arrives (the native writer and
+        # the reference's TimelineWriter both do) — buffering everything
+        # until stop() would grow without bound on a long traced run.
         try:
-            with open(self._filename, "w") as f:
-                json.dump({"traceEvents": events,
-                           "displayTimeUnit": "ms"}, f)
+            f = open(self._filename, "w")
+        except OSError:
+            while self._queue.get() is not None:
+                pass
+            return
+        try:
+            f.write('{"displayTimeUnit": "ms", "traceEvents": [\n')
+            first = True
+            while True:
+                ev = self._queue.get()
+                if ev is None:
+                    break
+                if not first:
+                    f.write(",\n")
+                json.dump(ev, f)
+                first = False
+                if self._queue.empty():
+                    f.flush()
+            f.write("\n]}\n")
         except OSError:
             pass
+        finally:
+            try:
+                f.close()
+            except OSError:
+                pass

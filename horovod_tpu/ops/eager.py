@@ -174,10 +174,17 @@ class EagerEngine:
         return np.asarray(jax.device_get(dt))
 
     def replicate(self, x) -> jax.Array:
-        """Plain array -> same value on every rank (stacked)."""
-        x = jnp.asarray(x)
-        stacked = jnp.broadcast_to(x[None], (self.size,) + x.shape)
-        return jax.device_put(stacked, self._rank_sharding())
+        """Plain array -> rank-major stack where THIS process's rows hold
+        its local value. Single-controller: same value on every rank.
+        Multi-process: each process's value lands on its own devices (the
+        per-rank convention N reference processes would produce) — built
+        from per-shard callbacks because device_put requires identical
+        values across processes."""
+        x = np.asarray(x)
+        stacked = np.broadcast_to(x[None], (self.size,) + x.shape)
+        return jax.make_array_from_callback(
+            stacked.shape, self._rank_sharding(),
+            lambda idx: np.ascontiguousarray(stacked[idx]))
 
     def _as_distributed(self, x):
         """Accept either an already rank-major array or a plain value."""
@@ -374,14 +381,7 @@ class EagerEngine:
         op = C.ReduceOp(req.reduce_op)
         if x is None:
             x = np.zeros(shape, dtype)
-        # Each process contributes its OWN local value on its rows —
-        # device_put would reject differing per-process values, so build
-        # the global array from per-shard callbacks instead.
-        local = np.broadcast_to(np.asarray(x)[None],
-                                (self.size,) + tuple(shape))
-        dt = jax.make_array_from_callback(
-            local.shape, self._rank_sharding(),
-            lambda idx: np.ascontiguousarray(local[idx]))
+        dt = self.replicate(x)  # local rows = this process's value
         joined_t = tuple(sorted(joined_ranks))
         compression = self._default_compression  # engine-wide, every rank
         key = ("join_ar", shape, dtype, int(op), joined_t, prescale,
@@ -765,6 +765,56 @@ class EagerEngine:
             raise
         return self._finalize_async(full, out)
 
+    def allgather_local(self, x, name: Optional[str] = None) -> np.ndarray:
+        """Gather each PROCESS's local array along dim 0, where row
+        counts may differ per process — the ragged allgather the sparse
+        gradient path needs (reference: allgather negotiates per-rank
+        first-dim sizes through the controller, controller.cc:486-570).
+        Row counts are exchanged through the controller, buffers padded
+        to the max, gathered with a static-shape collective, and sliced
+        back out. Returns host numpy of shape (sum rows, ...)."""
+        import json
+
+        x = np.asarray(x)
+        full = self._begin(name, "allgather")
+        try:
+            c = self.controller
+            if c is not None and c.size > 1:
+                if c.size != self.size:
+                    raise NotImplementedError(
+                        "ragged local allgather assumes one rank per "
+                        "process")
+                self._negotiate("allgatherv", full, x,
+                                shape=tuple(x.shape[1:]),
+                                dtype=str(x.dtype))
+                counts = [int(json.loads(v)) for v in c.exchange(
+                    full, json.dumps(int(x.shape[0])))]
+            else:
+                counts = [int(x.shape[0])] * self.size
+            maxn = max(counts) if counts else 0
+            padded = np.zeros((maxn,) + x.shape[1:], x.dtype)
+            padded[:x.shape[0]] = x
+            dt = self.replicate(padded)
+            key = ("agl", dt.shape, str(dt.dtype))
+
+            def build():
+                def per_rank(v):
+                    return C.allgather(v.reshape(v.shape[1:]),
+                                       self.axis)[None]
+                return self._shard_mapped(per_rank)
+
+            out = self._compiled(key, build)(dt)
+            y = np.asarray(out.addressable_data(0)).reshape(
+                (self.size * maxn,) + tuple(x.shape[1:]))
+            res = np.concatenate(
+                [y[r * maxn:r * maxn + counts[r]]
+                 for r in range(self.size)], axis=0)
+        except Exception:
+            self._end(full)
+            raise
+        self._end(full)
+        return res
+
     def broadcast(self, x, root_rank: int = 0, name: Optional[str] = None):
         full = self._begin(name, "broadcast")
         try:
@@ -844,6 +894,13 @@ class EagerEngine:
                     raise TensorShapeMismatchError(
                         f"sum(splits)={sum(my_splits)} != send rows "
                         f"{xs_local.shape[0]}")
+                # Validate dtype/trailing shape across ranks FIRST (the
+                # split vectors legitimately differ, so they are excluded
+                # from the signature) — a divergence must error, not
+                # compile mismatched programs that deadlock.
+                self._negotiate("alltoallv", full, xs_local,
+                                shape=tuple(xs_local.shape[1:]),
+                                dtype=str(xs_local.dtype))
                 # The negotiation: every rank publishes its send splits,
                 # learns everyone's — column r is rank r's recv splits.
                 rows = self.controller.exchange(

@@ -287,6 +287,7 @@ class AutotunedStepper:
         self._controller = controller
         self._period = tuner.warmup + tuner.steps_per_sample
         self._calls = 0
+        self._tuner_done = False  # set when rank 0 broadcasts :done
         self._threshold = tuner.current
         self._step = build_step(self._threshold)
         self.rebuilds = 0
@@ -311,14 +312,21 @@ class AutotunedStepper:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
             new = self._threshold
-            if self._calls % self._period == 0:
+            if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
-                # (SPMD lockstep), so the exchange is synchronous.
+                # (SPMD lockstep), so the exchange is synchronous. After
+                # rank 0 broadcasts convergence (:done) the rounds stop —
+                # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                vals = c.exchange("autotune_threshold",
-                                  str(self.tuner.current))
-                new = int(vals[0])  # rank 0's decision wins
+                mine = str(self.tuner.current) + (
+                    ":done" if c.rank == 0 and self.tuner.done else "")
+                vals = c.exchange("autotune_threshold", mine)
+                v0 = vals[0]  # rank 0's decision wins
+                if v0.endswith(":done"):
+                    self._tuner_done = True
+                    v0 = v0[:-5]
+                new = int(v0)
         if new != self._threshold:
             self._threshold = new
             self._step = self._build(new)

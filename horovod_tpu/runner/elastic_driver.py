@@ -221,8 +221,16 @@ class ElasticDriver:
             return infos
 
     def record_failure(self, hostname: str) -> None:
+        # Blacklist only — no _host_change signal: the caller restarts
+        # the epoch itself, and a latched event would make the NEXT
+        # epoch's first poll read a phantom topology change and throw
+        # away freshly spawned workers.
         self.host_manager.blacklist(hostname)
-        self._host_change.set()
+
+    def clear_host_updates(self) -> None:
+        """Drop any pending host-change signal (called at epoch start so
+        changes already folded into the new assignments don't re-fire)."""
+        self._host_change.clear()
 
 
 _LOCAL_NAMES = ("localhost", "127.0.0.1")
@@ -437,6 +445,10 @@ def run_elastic(args, command: List[str],
             except TimeoutError as e:
                 logger.error("elastic: %s", e)
                 return 1
+            # Clear BEFORE computing assignments: a change landing after
+            # the clear re-fires and interrupts the epoch; anything
+            # earlier is folded into the assignments below.
+            driver.clear_host_updates()
             slots = driver.update_assignments()
             logger.info(
                 "elastic launch attempt %d with np=%d over hosts %s",

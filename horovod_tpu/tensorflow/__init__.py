@@ -89,7 +89,36 @@ def _allreduce_np(arr: np.ndarray, op: ReduceOp, name: Optional[str],
 
 def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=None):
+              compression=None, sparse_as_dense: bool = False):
+    """Dense allreduce; a tf.IndexedSlices input takes the
+    SPARSE-AS-ALLGATHER path (reference tensorflow/__init__.py:92-108):
+    values and indices are allgathered — the mathematical equivalent of
+    summing the sparse gradients — with AVERAGE dividing the gathered
+    values by size. ``sparse_as_dense=True`` densifies first instead
+    (the reference's DistributedOptimizer knob)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        if sparse_as_dense:
+            return allreduce(tf.convert_to_tensor(tensor), op, name,
+                             prescale_factor, postscale_factor,
+                             compression)
+        if op not in (Average, Sum):
+            raise NotImplementedError(
+                "sparse allreduce supports Average/Sum (reference "
+                "tensorflow/__init__.py:101)")
+        # Ragged gather: ranks may hold different numbers of slices (the
+        # normal case for embedding gradients) — allgather_local
+        # negotiates per-rank row counts through the controller.
+        e = _engine()
+        values = tf.convert_to_tensor(e.allgather_local(
+            np.asarray(tensor.values), name=f"{name or 'sparse'}.values"))
+        indices = tf.convert_to_tensor(e.allgather_local(
+            np.asarray(tensor.indices),
+            name=f"{name or 'sparse'}.indices"))
+        if op == Average:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
     return _bridge(
         lambda a: _allreduce_np(a, op, name, prescale_factor,
                                 postscale_factor, compression), tensor)
@@ -216,31 +245,128 @@ def DistributedGradientTape(tape, op: ReduceOp = Average,
 
 # -- Keras optimizer wrapper (reference _keras/__init__.py:28-135) ----------
 
+def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
+                           sparse_as_dense=False):
+    """Reduce a grads_and_vars list: dense grads through ONE fused
+    grouped allreduce, IndexedSlices through the sparse-as-allgather
+    path (reference _make_allreduce_grads_fn semantics)."""
+    tf = _tf()
+    gv = [list(x) for x in gv]
+    dense = [(i, g) for i, (g, _) in enumerate(gv)
+             if g is not None and not isinstance(g, tf.IndexedSlices)]
+    sparse = [(i, g) for i, (g, _) in enumerate(gv)
+              if isinstance(g, tf.IndexedSlices)]
+    if dense:
+        reduced = grouped_allreduce([g for _, g in dense],
+                                    op=reduce_op,
+                                    name=f"{name_prefix}.grads")
+    else:
+        reduced = []
+    for (i, _), r in zip(dense, reduced):
+        gv[i][0] = r
+    for i, g in sparse:
+        gv[i][0] = allreduce(g, op=reduce_op,
+                             name=f"{name_prefix}.sparse{i}",
+                             sparse_as_dense=sparse_as_dense)
+    return [tuple(x) for x in gv]
+
+
 def DistributedOptimizer(optimizer, op: ReduceOp = Average,
-                         name: Optional[str] = None):
+                         name: Optional[str] = None,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         sparse_as_dense: bool = False):
     """Wrap a keras optimizer so apply_gradients allreduces first. Like
     the reference (_keras/__init__.py:28-135 create_distributed_optimizer)
     this dynamically subclasses the optimizer's own class and rebuilds it
     from config — keras requires a genuine Optimizer instance in
-    compile()."""
+    compile().
+
+    ``backward_passes_per_step > 1`` aggregates that many local
+    apply_gradients calls before one fused allreduce + global apply (the
+    LocalGradientAggregationHelper, reference
+    tensorflow/gradient_aggregation.py:16 /
+    gradient_aggregation_eager.py); ``average_aggregated_gradients``
+    divides the aggregate by the pass count."""
     cls = optimizer.__class__
     reduce_op = op
+    k = int(backward_passes_per_step)
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        tf = _tf()
         gv = list(grads_and_vars)
-        present = [(i, g) for i, (g, _) in enumerate(gv) if g is not None]
-        if present:
-            reduced = grouped_allreduce([g for _, g in present],
-                                        op=reduce_op, name="opt.grads")
+        if k > 1:
+            if not tf.executing_eagerly():
+                # Python-side counters only advance at TRACE time inside
+                # a tf.function — the traced graph would permanently bake
+                # the "banked" branch and the model would silently never
+                # update. The reference's graph-mode path needs
+                # tf.Variable counters + tf.cond
+                # (gradient_aggregation.py); this shim supports the
+                # eager helper only.
+                raise NotImplementedError(
+                    "backward_passes_per_step > 1 requires eager "
+                    "execution on this shim (compile with "
+                    "run_eagerly=True, or aggregate on the JAX surface "
+                    "via hvd.DistributedOptimizer)")
+            # Local aggregation round (eager helper semantics): bank the
+            # grads; the global reduce+apply happens on the k-th call.
+            if not hasattr(self, "_hvd_agg"):
+                self._hvd_agg = {}
+                self._hvd_agg_count = 0
+            for i, (g, _) in enumerate(gv):
+                if g is None:
+                    continue
+                if isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                acc = self._hvd_agg.get(i)
+                self._hvd_agg[i] = g if acc is None else acc + g
+            self._hvd_agg_count += 1
+            if self._hvd_agg_count < k:
+                return None
+            scale = 1.0 / k if average_aggregated_gradients else 1.0
             gv = [list(x) for x in gv]
-            for (i, _), r in zip(present, reduced):
-                gv[i][0] = r
+            for i, acc in self._hvd_agg.items():
+                gv[i][0] = acc * scale
             gv = [tuple(x) for x in gv]
-        return super(dist_cls, self).apply_gradients(gv, *args, **kwargs)
+            self._hvd_agg = {}
+            self._hvd_agg_count = 0
+        reduced = _reduce_grads_and_vars(gv, reduce_op, "opt",
+                                         sparse_as_dense)
+        return super(dist_cls, self).apply_gradients(reduced, *args,
+                                                     **kwargs)
 
     dist_cls = type(f"Distributed{cls.__name__}", (cls,),
                     {"apply_gradients": apply_gradients})
     return dist_cls.from_config(optimizer.get_config())
+
+
+def _DistributedAdasumOptimizer(optimizer, name: Optional[str] = None):
+    """Delta-based Adasum optimizer (reference
+    tensorflow/__init__.py:368-462 _DistributedAdasumOptimizer): each
+    rank applies the inner optimizer LOCALLY, extracts the resulting
+    weight delta, rolls the weights back, Adasum-reduces the delta, and
+    applies the reduced delta — so the adaptive-summation math sees
+    optimizer-shaped steps, not raw gradients."""
+    cls = optimizer.__class__
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        tf = _tf()
+        gv = list(grads_and_vars)
+        variables = [v for _, v in gv]
+        before = [tf.identity(v) for v in variables]
+        result = super(adasum_cls, self).apply_gradients(gv, *args,
+                                                         **kwargs)
+        deltas = [v - b for v, b in zip(variables, before)]
+        reduced = grouped_allreduce(deltas, op=Adasum,
+                                    name="adasum.delta")
+        for v, b, d in zip(variables, before, reduced):
+            v.assign(b + d)
+        return result
+
+    adasum_cls = type(f"DistributedAdasum{cls.__name__}", (cls,),
+                      {"apply_gradients": apply_gradients})
+    return adasum_cls.from_config(optimizer.get_config())
 
 
 # -- Keras callbacks (reference keras/callbacks.py) -------------------------
@@ -287,3 +413,103 @@ def MetricAverageCallback():
                     logs[k] = float(np.asarray(out))
 
     return _Cb()
+
+
+def _set_keras_lr(optimizer, lr: float) -> None:
+    # keras 3 uses .learning_rate; tf.keras 2 accepts either name.
+    attr = ("learning_rate" if hasattr(optimizer, "learning_rate")
+            else "lr")
+    setattr(optimizer, attr, lr)
+
+
+def LearningRateScheduleCallback(initial_lr: float, multiplier,
+                                 start_epoch: int = 0,
+                                 end_epoch: Optional[int] = None,
+                                 staircase: bool = True,
+                                 steps_per_epoch: Optional[int] = None):
+    """Keras callback: lr = initial_lr * multiplier(epoch) within
+    [start_epoch, end_epoch] (reference _keras/callbacks.py
+    LearningRateScheduleCallbackImpl — same smooth/staircase contract as
+    the JAX-surface callback, horovod_tpu/callbacks.py)."""
+    import math
+
+    Base = _keras_callback_base()
+    mult = multiplier if callable(multiplier) else (lambda _e: multiplier)
+
+    class _Cb(Base):
+        def __init__(self):
+            super().__init__()
+            self._epoch = 0.0
+
+        def _in_range(self):
+            return (self._epoch >= start_epoch
+                    and (end_epoch is None or self._epoch <= end_epoch))
+
+        def _apply(self):
+            if self._in_range():
+                _set_keras_lr(self.model.optimizer,
+                              initial_lr * mult(self._epoch))
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self._epoch = float(epoch)
+            if staircase or not steps_per_epoch:
+                self._apply()
+
+        def on_batch_begin(self, batch, logs=None):
+            if not staircase and steps_per_epoch:
+                self._epoch = math.floor(self._epoch) + \
+                    batch / steps_per_epoch
+                self._apply()
+
+    return _Cb()
+
+
+def LearningRateWarmupCallback(initial_lr: float, warmup_epochs: int = 5,
+                               steps_per_epoch: Optional[int] = None,
+                               verbose: int = 0):
+    """Keras callback: Goyal et al. gradual warmup from initial_lr/size
+    to initial_lr over warmup_epochs, inert afterwards (reference
+    _keras/callbacks.py LearningRateWarmupCallbackImpl)."""
+    n = size()
+
+    def mult(epoch: float) -> float:
+        progress = min(epoch / warmup_epochs, 1.0)
+        return (1.0 + progress * (n - 1)) / n
+
+    cb = LearningRateScheduleCallback(
+        initial_lr, mult, start_epoch=0, end_epoch=warmup_epochs,
+        staircase=False, steps_per_epoch=steps_per_epoch)
+
+    if verbose:
+        orig = cb.on_epoch_begin
+
+        def on_epoch_begin(epoch, logs=None):
+            orig(epoch, logs)
+            if epoch == warmup_epochs:
+                print(f"Epoch {epoch}: finished gradual learning rate "
+                      f"warmup to {initial_lr}.")
+
+        cb.on_epoch_begin = on_epoch_begin
+    return cb
+
+
+def BestModelCheckpoint(filepath: str, monitor: str = "val_loss",
+                        mode: str = "auto", save_best_only: bool = True,
+                        **kwargs):
+    """Keras ModelCheckpoint that only rank 0 writes (reference
+    keras/callbacks.py:157 BestModelCheckpoint: save_best_only rank-0
+    writer). The decision metric must already be rank-consistent (use
+    MetricAverageCallback before it)."""
+    tf = _tf()
+    if not save_best_only:
+        raise ValueError(
+            "BestModelCheckpoint requires save_best_only=True "
+            "(reference keras/callbacks.py BestModelCheckpoint)")
+
+    class _Cb(tf.keras.callbacks.ModelCheckpoint):
+        def _save_model(self, *args, **kw):
+            if rank() == 0:
+                super()._save_model(*args, **kw)
+
+    return _Cb(filepath=filepath, monitor=monitor, mode=mode,
+               save_best_only=True, **kwargs)

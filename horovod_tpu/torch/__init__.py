@@ -193,43 +193,130 @@ class _DistributedOptimizerMixin:
         self._base_cls = base_cls
         self.op = op
         self.backward_passes_per_step = backward_passes_per_step
-        self._passes = 0
-        self._handles = {}
+        self._handles = {}          # id(p) -> (p, handle-or-None)
+        self._allreduce_delay = {}  # id(p) -> remaining local passes
+        self._requires_update = []
         self._names = {}
+        self._should_synchronize = True
+        self._synchronized = False
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
         self._hooks = []
         for group in self.param_groups:
             for p in group["params"]:
                 if p.requires_grad:
+                    self._requires_update.append(p)
+                    self._allreduce_delay[id(p)] = \
+                        self.backward_passes_per_step
                     self._hooks.append(p.register_post_accumulate_grad_hook(
                         self._make_hook()))
 
+    def _launch(self, p: torch.Tensor) -> int:
+        if p.grad is None:
+            # Reference zeroes grads at hook registration
+            # (optimizer.py:107); a force-sync before any backward
+            # contributes zeros.
+            p.grad = torch.zeros_like(p)
+        name = self._names.get(id(p), f"grad.{id(p)}")
+        return allreduce_async(p.grad, op=self.op, name=name)
+
     def _make_hook(self):
         def hook(p: torch.Tensor) -> None:
-            if self._passes + 1 < self.backward_passes_per_step:
-                return  # local aggregation round: don't reduce yet
-            name = self._names.get(id(p), f"grad.{id(p)}")
-            self._handles[id(p)] = (p, allreduce_async(
-                p.grad, op=self.op, name=name))
+            # Reference torch/optimizer.py:134-149: count down the local
+            # aggregation delay; the allreduce fires on the k-th backward
+            # (p.grad accumulated the k local passes in the meantime).
+            if (id(p) in self._handles
+                    and self._handles[id(p)][1] is not None):
+                if self._allreduce_delay[id(p)] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally.")
+            assert self._allreduce_delay[id(p)] > 0
+            self._allreduce_delay[id(p)] -= 1
+            handle = None
+            if self._allreduce_delay[id(p)] == 0:
+                handle = self._launch(p)
+            self._handles[id(p)] = (p, handle)
 
         return hook
 
     def synchronize(self) -> None:
-        for p, handle in self._handles.values():
+        """Wait for all in-flight reductions; force-reduce any parameter
+        still mid-aggregation (reference torch/optimizer.py:152-167 —
+        step() never skips: an early step() flushes the aggregate)."""
+        for p in self._requires_update:
+            if id(p) not in self._handles:
+                self._handles[id(p)] = (p, self._launch(p))
+        for pid, (p, handle) in list(self._handles.items()):
+            if handle is None:
+                self._handles[pid] = (p, self._launch(p))
+        for pid, (p, handle) in self._handles.items():
             reduced = synchronize(handle)
+            self._allreduce_delay[pid] = self.backward_passes_per_step
             p.grad.copy_(reduced)
         self._handles.clear()
+        self._synchronized = True
+
+    def skip_synchronize(self):
+        """Context manager: step() without re-synchronizing (reference
+        torch/optimizer.py:170-186)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._should_synchronize = False
+            try:
+                yield
+            finally:
+                self._should_synchronize = True
+
+        return ctx()
 
     def step(self, closure=None):
-        self._passes += 1
-        if self._passes < self.backward_passes_per_step:
-            # Local aggregation: skip the global step (the reference
-            # divides lr instead; callers here just don't step).
-            return None
-        self.synchronize()
-        self._passes = 0
+        if self._should_synchronize:
+            self.synchronize()
+        self._synchronized = False
         return self._base_cls.step(self, closure)
+
+    def zero_grad(self, set_to_none: bool = True):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition.")
+        return self._base_cls.zero_grad(self, set_to_none=set_to_none)
+
+
+class _DistributedAdasumMixin:
+    """Delta-based Adasum optimizer methods, grafted onto the USER's
+    optimizer class like the main mixin (reference
+    torch/optimizer.py:210-378 _DistributedAdasumOptimizer): step()
+    applies the base optimizer LOCALLY, extracts the resulting weight
+    delta, rolls the weights back, Adasum-reduces the delta across
+    ranks, and applies the reduced delta — adaptive summation over
+    optimizer-shaped steps, not raw grads."""
+
+    def _dist_init(self, base_cls, named_parameters):
+        self._base_cls = base_cls
+        self._names = {}
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+
+    def step(self, closure=None):
+        params = [p for group in self.param_groups
+                  for p in group["params"]]
+        before = {id(p): p.detach().clone() for p in params}
+        result = self._base_cls.step(self, closure)
+        for p in params:
+            b = before[id(p)]
+            delta = p.detach() - b
+            name = self._names.get(id(p), f"adasum.delta.{id(p)}")
+            reduced = allreduce(delta, op=Adasum, name=name)
+            with torch.no_grad():
+                p.copy_(b + reduced)
+        return result
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -244,7 +331,26 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     (defaults, step pre/post hook registries, lr_scheduler's isinstance
     and step-patching machinery) is genuinely present, because the
     instance shares the fully-initialized __dict__ of the wrapped
-    optimizer."""
+    optimizer.
+
+    ``op=Adasum`` grafts the delta-based mixin instead (the reference
+    routes Adasum the same way, torch/optimizer.py:440+: adaptive
+    summation operates on optimizer deltas, not gradients)."""
+    if op == Adasum:
+        if backward_passes_per_step != 1:
+            raise NotImplementedError(
+                "backward_passes_per_step > 1 is not supported with "
+                "op=Adasum (accumulate locally by skipping zero_grad "
+                "between backwards instead)")
+        cls = type(optimizer.__class__.__name__,
+                   (optimizer.__class__,),
+                   {k: v for k, v in
+                    _DistributedAdasumMixin.__dict__.items()
+                    if not k.startswith("__")})
+        obj = cls.__new__(cls)
+        obj.__dict__.update(optimizer.__dict__)
+        obj._dist_init(optimizer.__class__, named_parameters)
+        return obj
     cls = type(optimizer.__class__.__name__,
                (optimizer.__class__,),
                {k: v for k, v in _DistributedOptimizerMixin.__dict__.items()
@@ -254,3 +360,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     obj._dist_init(optimizer.__class__, named_parameters, op,
                    backward_passes_per_step)
     return obj
+
+
+# Imported last: sync_batch_norm pulls collectives from this namespace
+# (reference exposes it as horovod.torch.SyncBatchNorm).
+from .sync_batch_norm import SyncBatchNorm  # noqa: E402

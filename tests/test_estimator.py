@@ -1,0 +1,81 @@
+"""Store + Estimator (the Spark-shaped L7 capability — reference
+spark/common/store.py + spark/keras/estimator.py:106-390 — without the
+Spark dependency): fit/transform over the executor pool with artifacts in
+the Store."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.store import GCSStore, LocalStore, Store
+
+
+def test_store_create_dispatch(tmp_path):
+    s = Store.create(str(tmp_path / "artifacts"))
+    assert isinstance(s, LocalStore)
+    try:
+        import gcsfs  # noqa: F401
+
+        assert isinstance(Store.create("gs://bucket/prefix"), GCSStore)
+    except ImportError:
+        with pytest.raises(ImportError):
+            Store.create("gs://bucket/prefix")
+
+
+def test_local_store_roundtrip(tmp_path):
+    s = LocalStore(str(tmp_path / "root"))
+    p = s.path_join(s.prefix(), "a", "b.pkl")
+    assert not s.exists(p)
+    s.write_obj(p, {"x": 1})
+    assert s.exists(p)
+    assert s.read_obj(p) == {"x": 1}
+    assert list(s.listdir(s.path_join(s.prefix(), "a"))) == ["b.pkl"]
+
+
+def test_store_run_layout(tmp_path):
+    s = LocalStore(str(tmp_path))
+    ckpt = s.get_checkpoint_path("r1")
+    logs = s.get_logs_path("r1")
+    assert "runs" in ckpt and "r1" in ckpt and ckpt != logs
+
+
+@pytest.mark.slow
+def test_estimator_fit_transform_over_executor_pool(tmp_path):
+    """VERDICT r1 #9 done-check: estimator fit/transform over the
+    executor pool — 2 real worker processes, data sharded by rank, grads
+    averaged through the engine, checkpoints in the Store."""
+    import optax
+
+    from horovod_tpu.estimator import Estimator, TrainedModel
+    from horovod_tpu.models import MLP
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (X @ true_w).astype(np.float32)
+
+    store = Store.create(str(tmp_path / "store"))
+    model = MLP(features=(16,), num_classes=1)
+    est = Estimator(model=model, optimizer=optax.adam(3e-2), loss="mse",
+                    store=store, num_proc=2, epochs=30, batch_size=16,
+                    run_id="fit1", seed=0,
+                    worker_env={
+                        "XLA_FLAGS":
+                            "--xla_force_host_platform_device_count=1",
+                        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+                    })
+    trained = est.fit(X, y)
+
+    # Loss went down and the history was persisted through the Store.
+    assert trained.history[-1] < trained.history[0] * 0.2
+    # transform(): host-side batched inference approximating the target.
+    pred = trained.transform(X)
+    assert pred.shape == (64, 1)
+    mse = float(((pred - y) ** 2).mean())
+    assert mse < float((y ** 2).mean()) * 0.2
+
+    # The transformer is loadable from the Store alone (model + run_id).
+    again = TrainedModel.load(store, "fit1", model)
+    np.testing.assert_allclose(again.transform(X), pred, rtol=1e-6)
+    # Per-epoch checkpoints exist.
+    assert store.exists(store.path_join(
+        store.get_checkpoint_path("fit1"), "epoch_0.pkl"))

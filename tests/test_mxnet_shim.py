@@ -1,0 +1,186 @@
+"""MXNet binding shim (reference horovod/mxnet API surface:
+mxnet/__init__.py:39-196 + mpi_ops.py collectives, re-hosted on the TPU
+engine).
+
+mxnet is not installed in this image; the shim is duck-typed against the
+NDArray protocol (``asnumpy()`` / ``t[:] = v``), so numpy arrays and the
+small fakes below exercise the same code paths the real NDArrays would.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hvdm
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    yield
+
+
+def test_allreduce_average_identity():
+    t = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = hvdm.allreduce(t, average=True)
+    np.testing.assert_allclose(out, t, rtol=1e-6)
+
+
+def test_allreduce_sum_scales_by_size():
+    out = hvdm.allreduce(np.ones(4, np.float32), average=False)
+    np.testing.assert_allclose(out, np.full(4, 8.0), rtol=1e-6)
+
+
+def test_allreduce_inplace_and_prescale():
+    t = np.full(3, 2.0, np.float32)
+    ret = hvdm.allreduce_(t, average=False, prescale_factor=0.5)
+    assert ret is t
+    np.testing.assert_allclose(t, np.full(3, 8.0), rtol=1e-6)  # 2*0.5*8
+
+
+def test_broadcast_and_inplace():
+    t = np.full((2, 2), 5.0, np.float32)
+    np.testing.assert_allclose(hvdm.broadcast(t, root_rank=3), t)
+    u = np.zeros((2, 2), np.float32)
+    # Single-controller: every rank holds the same replicated value.
+    hvdm.broadcast_(u, root_rank=0, name="u")
+    np.testing.assert_allclose(u, 0.0)
+
+
+def test_allgather_stacks_ranks():
+    t = np.ones((2, 3), np.float32)
+    out = hvdm.allgather(t)
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_alltoall_with_splits_delegates_to_alltoallv():
+    n = 8
+    xs = [np.full((n, 1), float(s), np.float32) for s in range(n)]
+    splits = [[1] * n for _ in range(n)]
+    out = hvdm.alltoall(xs, splits=splits)
+    # rank d receives one row from each source s with value s.
+    np.testing.assert_allclose(out[3].reshape(-1), np.arange(n))
+
+
+class _FakeOptimizer:
+    """Duck-typed mx.optimizer.Optimizer: rescale_grad + update."""
+
+    def __init__(self, lr=0.1):
+        self.lr = lr
+        self.rescale_grad = 1.0
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        idxs = index if isinstance(index, (list, tuple)) else [index]
+        ws = weight if isinstance(weight, list) else [weight]
+        gs = grad if isinstance(grad, list) else [grad]
+        for i, w_, g_ in zip(idxs, ws, gs):
+            self.updates.append(i)
+            w_ -= self.lr * self.rescale_grad * g_
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+def test_distributed_optimizer_rescale_folds_average():
+    """Reference trick (mxnet/__init__.py:44-48): rescale_grad /= size so
+    SUM-allreduce + rescale == average."""
+    inner = _FakeOptimizer(lr=1.0)
+    opt = hvdm.DistributedOptimizer(inner)
+    assert inner.rescale_grad == pytest.approx(1.0 / hvdm.size())
+
+    w = np.full(4, 10.0, np.float32)
+    g = np.full(4, 2.0, np.float32)
+    opt.update(0, w, g, None)
+    # Allreduce(SUM) makes g -> 2*size; rescale 1/size -> effective 2.0.
+    np.testing.assert_allclose(w, np.full(4, 8.0), rtol=1e-6)
+    assert inner.updates == [0]
+    # Delegation surface.
+    opt.set_learning_rate(0.5)
+    assert opt.lr == 0.5
+
+
+def test_distributed_optimizer_update_multi_precision_and_lists():
+    inner = _FakeOptimizer(lr=1.0)
+    opt = hvdm.DistributedOptimizer(inner)
+    ws = [np.full(2, 1.0, np.float32), np.full(2, 2.0, np.float32)]
+    gs = [np.full(2, 1.0, np.float32), np.full(2, 1.0, np.float32)]
+    for i in (0, 1):
+        opt.update_multi_precision([i], [ws[i]], [gs[i]], None)
+    np.testing.assert_allclose(ws[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(ws[1], 1.0, atol=1e-6)
+
+
+class _FakeParam:
+    def __init__(self, grad, grad_req="write"):
+        self.grad_req = grad_req
+        self._grad = grad
+
+    def list_grad(self):
+        return [self._grad]
+
+
+def test_allreduce_grads_inplace_trainer_flow():
+    """The DistributedTrainer._allreduce_grads body (reference
+    mxnet/__init__.py:128-139): SUM over ranks, skipping grad_req='null'.
+    """
+    g0 = np.full(3, 1.0, np.float32)
+    g1 = np.full(3, 2.0, np.float32)
+    frozen = np.full(3, 7.0, np.float32)
+    params = [_FakeParam(g0), _FakeParam(frozen, grad_req="null"),
+              _FakeParam(g1)]
+    hvdm.allreduce_grads_inplace(params, prefix="t1.")
+    np.testing.assert_allclose(g0, 8.0, rtol=1e-6)
+    np.testing.assert_allclose(g1, 16.0, rtol=1e-6)
+    np.testing.assert_allclose(frozen, 7.0)  # untouched
+
+
+class _FakeGluonParam:
+    def __init__(self, value):
+        self._value = value
+
+    def data(self):
+        return self._value
+
+
+def test_broadcast_parameters_dict():
+    params = {"w0": _FakeGluonParam(np.full(2, 3.0, np.float32)),
+              "w1": np.full(2, 4.0, np.float32)}
+    hvdm.broadcast_parameters(params, root_rank=0, prefix="bp.")
+    np.testing.assert_allclose(params["w0"].data(), 3.0)
+    np.testing.assert_allclose(params["w1"], 4.0)
+
+    with pytest.raises(ValueError):
+        hvdm.broadcast_parameters([1, 2, 3])
+
+
+def test_distributed_trainer_gated_without_mxnet():
+    if hvdm._HAS_MXNET:
+        pytest.skip("mxnet installed; gate not applicable")
+    with pytest.raises(ImportError):
+        hvdm.DistributedTrainer({}, object())
+
+
+def test_small_gluon_style_train_loop_converges():
+    """A minimal gluon-Trainer-shaped loop (reference parity target: the
+    small gluon train test) over the shim's collectives: forward/backward
+    on host numpy, grads summed via allreduce_grads_inplace, SGD with the
+    averaging folded into rescale_grad."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((32, 4)).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = X @ true_w
+    w = np.zeros(4, np.float32)
+    inner = _FakeOptimizer(lr=0.1)
+    opt = hvdm.DistributedOptimizer(inner)
+
+    losses = []
+    for step in range(60):
+        pred = X @ w
+        err = pred - y
+        losses.append(float((err ** 2).mean()))
+        grad = 2.0 * X.T @ err / len(X)
+        opt.update(step, w, grad, None)
+    assert losses[-1] < losses[0] * 1e-3

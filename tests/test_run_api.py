@@ -111,6 +111,35 @@ def test_run_alltoallv_negotiated_splits():
 
 
 @pytest.mark.slow
+def test_run_ragged_allgather_local():
+    """allgather_local across a REAL 2-process world with DIFFERENT row
+    counts per rank (the sparse-gradient shape): row counts negotiate
+    through the controller exchange, buffers pad/gather/slice."""
+
+    def work():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1)
+        rank = int(os.environ["HVD_TPU_PROC_ID"])
+        rows = 2 if rank == 0 else 3
+        x = np.full((rows, 2), float(rank + 1), np.float32)
+        out = hvd._ctx().engine.allgather_local(x, name="ragged")
+        return out.tolist()
+
+    results = runner.run(work, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    expected = [[1.0, 1.0]] * 2 + [[2.0, 2.0]] * 3
+    assert results[0] == expected and results[1] == expected
+
+
+@pytest.mark.slow
 def test_run_diverged_shape_errors_not_hangs():
     """VERDICT #2 done-check: a REAL 2-process world where rank 1 submits a
     mismatched shape — both ranks must raise TensorShapeMismatchError

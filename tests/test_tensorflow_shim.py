@@ -120,3 +120,85 @@ def test_keras_fit_with_callbacks():
                    hvdtf.MetricAverageCallback()])
     losses = hist.history["loss"]
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_sparse_allreduce_as_allgather():
+    """IndexedSlices → allgather path (reference
+    tensorflow/__init__.py:92-108): gathered values/indices sum to the
+    dense equivalent; AVERAGE divides values by size."""
+    import tensorflow as tf
+
+    values = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    indices = tf.constant([0, 2], dtype=tf.int64)
+    slices = tf.IndexedSlices(values, indices, dense_shape=(4, 2))
+
+    out = hvdtf.allreduce(slices, op=hvdtf.Average, name="sp")
+    assert isinstance(out, tf.IndexedSlices)
+    n = hvdtf.size()
+    assert out.values.shape == (2 * n, 2)
+    # Densify: every rank contributed the same slices; the average must
+    # equal the original dense tensor.
+    dense = tf.math.unsorted_segment_sum(out.values, out.indices, 4)
+    expected = tf.math.unsorted_segment_sum(values, indices, 4)
+    np.testing.assert_allclose(dense.numpy(), expected.numpy(),
+                               rtol=1e-6)
+
+    # sparse_as_dense densifies before reducing → a dense tensor back.
+    out_d = hvdtf.allreduce(slices, op=hvdtf.Average, name="spd",
+                            sparse_as_dense=True)
+    assert not isinstance(out_d, tf.IndexedSlices)
+
+
+def test_optimizer_backward_passes_aggregation():
+    """LocalGradientAggregationHelper semantics (reference
+    gradient_aggregation.py:16): k local calls bank grads; the k-th call
+    averages, reduces, applies."""
+    import tensorflow as tf
+
+    v = tf.Variable([2.0, 2.0])
+    opt = hvdtf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    g = tf.constant([1.0, 1.0])
+    assert opt.apply_gradients([(g, v)]) is None   # banked, no apply
+    np.testing.assert_allclose(v.numpy(), [2.0, 2.0])
+    opt.apply_gradients([(3.0 * g, v)])            # (1+3)/2 = 2 applied
+    np.testing.assert_allclose(v.numpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_adasum_delta_optimizer():
+    """_DistributedAdasumOptimizer (reference
+    tensorflow/__init__.py:368-462): identical ranks → adasum of
+    identical deltas = the delta itself, so the result equals the plain
+    local update."""
+    import tensorflow as tf
+
+    v = tf.Variable([1.0, 2.0])
+    opt = hvdtf._DistributedAdasumOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5))
+    opt.apply_gradients([(tf.constant([2.0, 2.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [0.0, 1.0], atol=1e-5)
+
+
+def test_keras_lr_warmup_callback():
+    import tensorflow as tf
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, input_shape=(2,))])
+    opt = tf.keras.optimizers.SGD(learning_rate=0.8)
+    model.compile(optimizer=opt, loss="mse")
+    cb = hvdtf.LearningRateWarmupCallback(initial_lr=0.8,
+                                          warmup_epochs=2,
+                                          steps_per_epoch=4)
+    cb.set_model(model)
+    cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
+    assert float(opt.learning_rate) == pytest.approx(0.8 / hvdtf.size())
+    cb.on_epoch_begin(1)
+    cb.on_batch_begin(4)
+    assert float(opt.learning_rate) == pytest.approx(0.8)
+    # Inert after warmup: a schedule owns the lr now.
+    opt.learning_rate = 0.123
+    cb.on_epoch_begin(3)
+    cb.on_batch_begin(1)
+    assert float(opt.learning_rate) == pytest.approx(0.123)

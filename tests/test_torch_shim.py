@@ -96,15 +96,104 @@ def test_distributed_optimizer_trains():
 
 
 def test_distributed_optimizer_backward_passes_per_step():
+    """Reference semantics (torch/optimizer.py:134-167): the allreduce
+    fires on the k-th backward (locally accumulated grads), and step()
+    NEVER skips — the user calls it once per k backwards; an early step()
+    force-flushes the aggregate."""
     model = torch.nn.Linear(2, 1, bias=False)
     opt = hvdt.DistributedOptimizer(
         torch.optim.SGD(model.parameters(), lr=1.0),
         backward_passes_per_step=2)
     w0 = model.weight.detach().clone()
     x = torch.ones(1, 2)
-    (model(x)).sum().backward()
-    assert opt.step() is None          # pass 1 of 2: no global step
-    torch.testing.assert_close(model.weight, w0)
-    (model(x)).sum().backward()        # grads accumulate locally
-    opt.step()                         # pass 2: reduce + apply
-    assert not torch.allclose(model.weight, w0)
+    (model(x)).sum().backward()        # pass 1: delay 2 -> 1, no launch
+    (model(x)).sum().backward()        # pass 2: launch on accumulated grad
+    opt.step()                         # reduce + apply
+    # grad accumulated two passes of all-ones input: dw = 2 * [1,1]
+    expected = w0 - 2.0 * torch.ones(1, 2)
+    torch.testing.assert_close(model.weight.detach(), expected)
+
+    # Early step() mid-aggregation force-flushes (never a silent no-op).
+    opt.zero_grad()
+    w1 = model.weight.detach().clone()
+    (model(x)).sum().backward()        # only 1 of 2 passes
+    opt.step()
+    torch.testing.assert_close(model.weight.detach(),
+                               w1 - torch.ones(1, 2))
+
+
+def test_distributed_optimizer_zero_grad_guard():
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0))
+    (model(torch.ones(1, 2))).sum().backward()
+    with pytest.raises(AssertionError):
+        opt.zero_grad()                # pending reduction: prohibited
+    opt.synchronize()
+    opt.zero_grad()                    # fine after synchronize
+
+    # skip_synchronize: synchronize() then step() without re-reducing.
+    (model(torch.ones(1, 2))).sum().backward()
+    opt.synchronize()
+    with opt.skip_synchronize():
+        opt.step()
+
+
+def test_sync_batch_norm_matches_local_bn():
+    """Single-controller: every rank holds the same batch, so synced
+    global stats equal local stats — SyncBatchNorm must match plain
+    BatchNorm in forward AND backward (the reference's math check,
+    torch/sync_batch_norm.py)."""
+    torch.manual_seed(0)
+    x = torch.randn(6, 4, requires_grad=True)
+    x2 = x.detach().clone().requires_grad_(True)
+
+    sbn = hvdt.SyncBatchNorm(4, momentum=0.1)
+    bn = torch.nn.BatchNorm1d(4, momentum=0.1)
+    bn.load_state_dict({k: v.clone() for k, v in sbn.state_dict().items()})
+
+    sbn.train(), bn.train()
+    out_s = sbn(x)
+    out_b = bn(x2)
+    torch.testing.assert_close(out_s, out_b, rtol=1e-4, atol=1e-5)
+
+    out_s.sum().backward()
+    out_b.sum().backward()
+    torch.testing.assert_close(x.grad, x2.grad, rtol=1e-4, atol=1e-5)
+    torch.testing.assert_close(sbn.weight.grad, bn.weight.grad,
+                               rtol=1e-4, atol=1e-5)
+    torch.testing.assert_close(sbn.running_mean, bn.running_mean,
+                               rtol=1e-4, atol=1e-5)
+    # running_var's unbiased correction uses the GLOBAL count (8 ranks ×
+    # 6 rows = 48 → n/(n-1) = 48/47), not the local 6/5 — that IS the
+    # sync semantics (reference batch_norm_gather_stats_with_counts).
+    biased = bn.running_var.sub(0.9).div(0.1).mul(5.0 / 6.0)  # undo local
+    expected_rv = biased.mul(48.0 / 47.0).mul(0.1).add(0.9)
+    torch.testing.assert_close(sbn.running_var, expected_rv,
+                               rtol=1e-4, atol=1e-5)
+
+    # Eval mode uses running stats (no collectives).
+    sbn.eval()
+    xd = x.detach()
+    expected_eval = ((xd - sbn.running_mean)
+                     / torch.sqrt(sbn.running_var + sbn.eps)
+                     * sbn.weight + sbn.bias)
+    torch.testing.assert_close(sbn(xd), expected_eval,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_delta_optimizer():
+    """op=Adasum routes to the delta model (reference
+    torch/optimizer.py:210-378): identical ranks → adasum of identical
+    deltas is the delta itself, so the step equals the local update."""
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.5), op=hvdt.Adasum,
+        named_parameters=list(model.named_parameters()))
+    (model(torch.ones(1, 2))).sum().backward()
+    opt.step()
+    torch.testing.assert_close(model.weight.detach(),
+                               torch.full((1, 2), 0.5))
+    opt.zero_grad()
