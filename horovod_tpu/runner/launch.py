@@ -62,16 +62,7 @@ def _free_port() -> int:
     return port
 
 
-def _stream_output(proc: subprocess.Popen, tag: str) -> None:
-    """Prefix worker output with its rank tag (reference
-    safe_shell_exec.py output prefixing)."""
-    assert proc.stdout is not None
-    for line in iter(proc.stdout.readline, b""):
-        sys.stdout.write(f"[{tag}]: {line.decode(errors='replace')}")
-        sys.stdout.flush()
-
-
-def _wait_fail_fast(procs: List[subprocess.Popen],
+def _wait_fail_fast(procs,
                     threads: List[threading.Thread],
                     poll_interval: float = 0.1) -> int:
     """Wait for all workers; on the FIRST non-zero exit kill the rest
@@ -108,23 +99,19 @@ def _wait_fail_fast(procs: List[subprocess.Popen],
 
 def run_local(np: int, command: List[str], env_extra: Dict[str, str],
               verbose: bool = False) -> int:
-    """Fork np local worker processes (the localhost-gloo analog)."""
+    """Fork np local worker processes (the localhost-gloo analog).
+    Workers run under a pty (safe_shell_exec: children see a tty, output
+    line-buffered + prefixed, group-signal termination)."""
+    from . import safe_shell_exec as sse
+
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
-    procs: List[subprocess.Popen] = []
-    threads: List[threading.Thread] = []
+    handles: List[sse.SpawnedProcess] = []
     for i in range(np):
         env = build_env_for_slot(dict(os.environ), coordinator, np, i,
                                  {**env_extra, **_slot_local_env(i, np)})
-        p = subprocess.Popen(command, env=env,
-                             stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT)
-        procs.append(p)
-        t = threading.Thread(target=_stream_output, args=(p, str(i)),
-                             daemon=True)
-        t.start()
-        threads.append(t)
-    return _wait_fail_fast(procs, threads)
+        handles.append(sse.spawn(command, env=env, prefix=str(i)))
+    return _wait_fail_fast(handles, [h.thread for h in handles])
 
 
 def used_hosts(host_infos: List[hosts_lib.HostInfo], np: int) -> List[str]:
@@ -149,11 +136,17 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     is the number of hosts covering ``np`` slots — unlike local mode which
     forks one process per slot. Rank-0 host runs the jax.distributed
     coordinator."""
+    from . import safe_shell_exec as sse
+
     hosts = used_hosts(host_infos, np)
     num_proc = len(hosts)
-    coord = f"{hosts[0]}:{_free_port()}"
-    procs = []
-    threads = []
+    coord_host = hosts[0]
+    if os.environ.get("HVD_TPU_NIC_DISCOVERY") == "1" and num_proc > 1:
+        picked = _nic_discovery_coordinator(hosts, ssh_port)
+        if picked:
+            coord_host = picked
+    coord = f"{coord_host}:{_free_port()}"
+    handles = []
     for i, hostname in enumerate(hosts):
         env = build_env_for_slot({}, coord, num_proc, i,
                                  {**env_extra, **_slot_local_env(0, 1)})
@@ -164,17 +157,54 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
         if ssh_port:
             ssh_cmd += ["-p", str(ssh_port)]
         ssh_cmd += [hostname, remote_cmd]
-        p = subprocess.Popen(ssh_cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.STDOUT)
-        procs.append(p)
-        t = threading.Thread(target=_stream_output,
-                             args=(p, hostname), daemon=True)
-        t.start()
-        threads.append(t)
-    return _wait_fail_fast(procs, threads)
+        handles.append(sse.spawn(ssh_cmd, prefix=hostname))
+    return _wait_fail_fast(handles, [h.thread for h in handles])
+
+
+def _nic_discovery_coordinator(hosts: List[str],
+                               ssh_port: Optional[int]) -> Optional[str]:
+    """Routable-NIC discovery before the fan-out (HVD_TPU_NIC_DISCOVERY=1
+    — reference driver_service.py:49-257): start a task server on every
+    host over ssh, intersect the registered interface sets, and return
+    the rank-0 host's IP on the first common interface. Returns None
+    (fall back to the hostname) on any failure — discovery must never
+    make a working launch fail."""
+    from . import driver_service as ds
+
+    servers: List[subprocess.Popen] = []
+    try:
+        task_addrs = {}
+        for hostname in hosts:
+            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh_cmd += ["-p", str(ssh_port)]
+            ssh_cmd += [hostname, sys.executable, "-m",
+                        "horovod_tpu.runner.driver_service", "--serve"]
+            p = subprocess.Popen(ssh_cmd, stdout=subprocess.PIPE,
+                                 stderr=subprocess.DEVNULL, text=True)
+            servers.append(p)
+            line = (p.stdout.readline() or "").strip()
+            if not line.startswith("TASKSERVER "):
+                return None
+            task_addrs[hostname] = (hostname, int(line.split()[1]))
+        common = ds.discover_routable_interfaces(task_addrs)
+        if not common:
+            return None
+        ifaces = ds.query_interfaces(task_addrs[hosts[0]])
+        return ifaces.get(common[0])
+    except (OSError, RuntimeError, ValueError):
+        return None
+    finally:
+        for p in servers:
+            if p.poll() is None:
+                p.terminate()
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    return _build_parser().parse_args(argv)
+
+
+def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdtpurun",
         description="Launch a horovod_tpu training job "
@@ -185,6 +215,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="host list, e.g. host1:4,host2:4")
     p.add_argument("--hostfile", default=None,
                    help="hostfile with 'hostname slots=N' lines")
+    p.add_argument("--config-file", default=None,
+                   help="YAML config supplying any of these flags "
+                        "(explicit CLI flags win — reference "
+                        "launch.py:290 --config-file)")
     p.add_argument("--ssh-port", type=int, default=None)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--version", action="store_true")
@@ -209,7 +243,68 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--host-discovery-script", default=None)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
-    return p.parse_args(argv)
+    return p
+
+
+def _coerce_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def apply_config_file(args: argparse.Namespace,
+                      argv: Optional[List[str]] = None
+                      ) -> argparse.Namespace:
+    """Fill unset args from a YAML config (reference launch.py:510-523 +
+    config_parser.py set_args_from_config). Keys may be flat or nested
+    under sections; dashes and underscores are interchangeable.
+
+    Explicit CLI flags win — "explicit" is determined by re-parsing
+    ``argv`` with SUPPRESS defaults (so ``--cache-capacity 0`` counts as
+    set even though 0 is falsy, and the config CAN supply flags with
+    non-None defaults like -np). Config values are coerced/validated
+    through the same argparse type/choices as the CLI path.
+    """
+    if not getattr(args, "config_file", None):
+        return args
+    import yaml
+
+    probe = _build_parser()
+    actions = {}
+    for a in probe._actions:
+        actions[a.dest] = a
+        a.default = argparse.SUPPRESS
+    explicit = set(vars(probe.parse_args(argv if argv is not None
+                                         else sys.argv[1:])))
+
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    flat: Dict[str, object] = {}
+
+    def walk(d):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                walk(v)
+            else:
+                flat[str(k).replace("-", "_")] = v
+
+    walk(cfg)
+    for k, v in flat.items():
+        if k in explicit or not hasattr(args, k) or k == "config_file":
+            continue
+        action = actions.get(k)
+        if action is not None:
+            if isinstance(action, argparse._StoreTrueAction):
+                v = _coerce_bool(v)
+            elif action.type is not None and v is not None:
+                v = action.type(v)
+            if action.choices is not None and v not in action.choices:
+                raise ValueError(
+                    f"config file: {k}={v!r} not in {action.choices}")
+        setattr(args, k, v)
+    return args
 
 
 def knob_env(args: argparse.Namespace) -> Dict[str, str]:
@@ -253,6 +348,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
         print(__version__)
         return 0
+    args = apply_config_file(args, argv)
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -273,6 +369,12 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         host_infos = hosts_lib.parse_hosts(args.hosts)
     else:
         host_infos = None
+        # Inside an LSF allocation the scheduler already owns the host
+        # set (reference js_run/LSFUtils detection, launch.py:672-707).
+        from . import lsf as lsf_lib
+
+        if lsf_lib.in_lsf():
+            host_infos = lsf_lib.lsf_hosts()
 
     if host_infos is not None:
         # Validate np against available slots (reference: horovodrun errors

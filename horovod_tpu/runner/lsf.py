@@ -1,0 +1,57 @@
+"""LSF cluster detection — the js_run/jsrun analog.
+
+Reference: horovod/runner/util/lsf.py:1-103 (LSFUtils reading
+LSB_DJOB_HOSTFILE / LSB_HOSTS / LSB_MCPU_HOSTS to derive the host list)
++ horovod/runner/js_run.py (jsrun command synthesis). On TPU there is no
+jsrun to exec — the useful capability is deriving the host set from the
+scheduler's environment so ``hvdtpurun`` inside an LSF allocation needs
+no -H flag; the ssh fan-out then rides the allocation."""
+
+from __future__ import annotations
+
+import collections
+import os
+from typing import List
+
+from . import hosts as hosts_lib
+
+
+def in_lsf() -> bool:
+    """True inside an LSF job allocation (reference lsf.py using
+    LSB_JOBID presence)."""
+    return "LSB_JOBID" in os.environ
+
+
+def lsf_hosts() -> List[hosts_lib.HostInfo]:
+    """Host list with slot counts from the LSF environment.
+
+    Precedence mirrors the reference: LSB_DJOB_HOSTFILE (one hostname
+    per slot, one per line) > LSB_MCPU_HOSTS ("h1 n1 h2 n2 ...") >
+    LSB_HOSTS ("h1 h1 h2 ...")."""
+    hostfile = os.environ.get("LSB_DJOB_HOSTFILE")
+    if hostfile and os.path.exists(hostfile):
+        counts: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        with open(hostfile) as f:
+            for line in f:
+                name = line.strip()
+                if name:
+                    counts[name] = counts.get(name, 0) + 1
+        return [hosts_lib.HostInfo(h, n) for h, n in counts.items()]
+
+    mcpu = os.environ.get("LSB_MCPU_HOSTS")
+    if mcpu:
+        parts = mcpu.split()
+        return [hosts_lib.HostInfo(parts[i], int(parts[i + 1]))
+                for i in range(0, len(parts) - 1, 2)]
+
+    hosts = os.environ.get("LSB_HOSTS")
+    if hosts:
+        counts = collections.OrderedDict()
+        for name in hosts.split():
+            counts[name] = counts.get(name, 0) + 1
+        return [hosts_lib.HostInfo(h, n) for h, n in counts.items()]
+
+    raise RuntimeError(
+        "inside an LSF job (LSB_JOBID set) but no host environment "
+        "found (LSB_DJOB_HOSTFILE / LSB_MCPU_HOSTS / LSB_HOSTS)")

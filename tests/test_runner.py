@@ -128,3 +128,167 @@ def test_run_local_failure_propagates(tmp_path):
         "else 0)")
     rc = launch_lib.run_local(2, [sys.executable, str(script)], {})
     assert rc != 0
+
+
+# -- config file (reference launch.py:510-523) -----------------------------
+
+def test_config_file_fills_unset_flags(tmp_path):
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text(
+        "params:\n  fusion-threshold-mb: 16\n"
+        "timeline:\n  timeline-filename: /tmp/tl.json\n"
+        "autotune: {autotune: true}\n")
+    argv = ["-np", "2", "--config-file", str(cfg),
+            "--fusion-threshold-mb", "32",  # explicit flag wins
+            "--", "python", "x.py"]
+    args = launch_lib.parse_args(argv)
+    args = launch_lib.apply_config_file(args, argv)
+    assert args.fusion_threshold_mb == 32.0
+    assert args.timeline_filename == "/tmp/tl.json"
+    assert args.autotune is True
+    env = launch_lib.knob_env(args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+
+
+# -- NIC discovery (reference driver_service.py:49-257) --------------------
+
+def test_task_server_interface_discovery():
+    from horovod_tpu.runner import driver_service as ds
+
+    srv_a = ds.TaskServer("127.0.0.1").start()
+    srv_b = ds.TaskServer("127.0.0.1").start()
+    try:
+        addrs = {"hostA": ("127.0.0.1", srv_a.port),
+                 "hostB": ("127.0.0.1", srv_b.port)}
+        assert ds.probe_reachable(addrs["hostA"])
+        ifaces = ds.query_interfaces(addrs["hostA"])
+        assert ifaces  # at least loopback/fallback reported
+        common = ds.discover_routable_interfaces(addrs)
+        # Same machine twice -> identical sets; loopback excluded for
+        # the multi-host case.
+        assert all(not i.startswith("lo") for i in common)
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_common_interfaces_intersection():
+    from horovod_tpu.runner import driver_service as ds
+
+    host_ifaces = {
+        "h1": {"eth0": "10.0.0.1", "ib0": "192.168.0.1", "lo": "127.0.0.1"},
+        "h2": {"eth0": "10.0.0.2", "lo": "127.0.0.1"},
+    }
+    assert ds.common_interfaces(host_ifaces) == ["eth0"]
+    # Single host keeps loopback (local launches rendezvous over it).
+    assert "lo" in ds.common_interfaces({"h1": host_ifaces["h1"]})
+
+
+# -- pty exec (reference safe_shell_exec.py) -------------------------------
+
+def test_safe_shell_exec_pty_and_prefix():
+    import io
+    import sys
+
+    from horovod_tpu.runner import safe_shell_exec as sse
+
+    sink = io.StringIO()
+    rc = sse.execute(
+        [sys.executable, "-c",
+         "import sys; print('tty', sys.stdout.isatty())"],
+        prefix="0", sink=sink)
+    assert rc == 0
+    out = sink.getvalue()
+    assert "[0]: tty True" in out  # children see a terminal under pty
+
+    sink = io.StringIO()
+    rc = sse.execute([sys.executable, "-c", "raise SystemExit(3)"],
+                     prefix="1", sink=sink)
+    assert rc == 3
+
+
+# -- LSF detection (reference util/lsf.py + js_run) ------------------------
+
+def test_lsf_hosts_from_hostfile(tmp_path, monkeypatch):
+    from horovod_tpu.runner import lsf as lsf_lib
+
+    monkeypatch.delenv("LSB_JOBID", raising=False)
+    assert not lsf_lib.in_lsf()
+
+    hf = tmp_path / "djob"
+    hf.write_text("nodeA\nnodeA\nnodeB\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_DJOB_HOSTFILE", str(hf))
+    assert lsf_lib.in_lsf()
+    hosts = lsf_lib.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("nodeA", 2), ("nodeB", 1)]
+
+
+def test_lsf_hosts_from_mcpu(monkeypatch):
+    from horovod_tpu.runner import lsf as lsf_lib
+
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_DJOB_HOSTFILE", raising=False)
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeA 4 nodeB 4")
+    hosts = lsf_lib.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == \
+        [("nodeA", 4), ("nodeB", 4)]
+
+
+def test_driver_service_serve_mode():
+    """The ssh-launched task-server entry point: prints its port, then
+    answers interface queries (the reference's task-service lifecycle)."""
+    from horovod_tpu.runner import driver_service as ds
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.driver_service",
+         "--serve"], stdout=subprocess.PIPE, text=True)
+    try:
+        line = p.stdout.readline().strip()
+        assert line.startswith("TASKSERVER ")
+        port = int(line.split()[1])
+        assert ds.probe_reachable(("127.0.0.1", port))
+        assert ds.query_interfaces(("127.0.0.1", port))
+    finally:
+        p.terminate()
+        p.wait(timeout=5)
+
+
+def test_discover_requires_all_hosts():
+    from horovod_tpu.runner import driver_service as ds
+
+    srv = ds.TaskServer("127.0.0.1").start()
+    try:
+        addrs = {"up": ("127.0.0.1", srv.port),
+                 "down": ("127.0.0.1", 1)}  # nothing listens on port 1
+        with pytest.raises(RuntimeError, match="down"):
+            ds.discover_routable_interfaces(addrs, wait_timeout_s=1.0)
+    finally:
+        srv.stop()
+
+
+def test_config_file_zero_and_np(tmp_path):
+    """Explicit 0 on the CLI must survive the config file, and the
+    config CAN supply flags whose argparse default is non-None (-np);
+    values are coerced/validated through the argparse types."""
+    cfg = tmp_path / "hvd.yaml"
+    cfg.write_text("cache-capacity: 1024\nnum-proc: 8\n"
+                   "fusion-threshold-mb: '16'\n")
+    args = launch_lib.parse_args(
+        ["--cache-capacity", "0", "--config-file", str(cfg), "--",
+         "python", "x.py"])
+    args = launch_lib.apply_config_file(
+        args, ["--cache-capacity", "0", "--config-file", str(cfg), "--",
+               "python", "x.py"])
+    assert args.cache_capacity == 0          # explicit CLI zero wins
+    assert args.num_proc == 8                # config fills non-None default
+    assert args.fusion_threshold_mb == 16.0  # string coerced via type
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("compression: fp32\n")
+    args2 = launch_lib.parse_args(["--config-file", str(bad), "--", "x"])
+    with pytest.raises(ValueError, match="compression"):
+        launch_lib.apply_config_file(args2,
+                                     ["--config-file", str(bad), "--", "x"])

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Perf-hardening evidence (VERDICT r1 #10): measured numbers, not prose.
+
+Runs on the 8-virtual-device CPU mesh (the dryrun topology; the driver's
+BENCH runs on real TPU) and reports:
+
+1. DONATION coverage of the flagship train step: compiled memory stats
+   with and without donate_argnums — donated steps must not double-buffer
+   the parameter/optimizer state.
+2. Staged hierarchical allreduce (RS-local -> AR-cross -> AG-local) vs
+   flat psum on the 2x4 (cross, local) mesh: per-step wall time and the
+   DCN-bytes argument (staged moves 1/local_size of the buffer over the
+   cross axis).
+3. Eager fusion: grouped allreduce of many small tensors vs per-tensor
+   dispatch.
+
+Usage: XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+       python tools/perf_evidence.py
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collectives as C
+
+
+def mib(nbytes):
+    return round(nbytes / (1024 * 1024), 2)
+
+
+def donation_evidence():
+    """Memory-analysis proof that donated state is reused in place."""
+    hvd.init()
+    from horovod_tpu.models import MLP
+
+    model = MLP(features=(512, 512), num_classes=10)
+    rng = jax.random.PRNGKey(0)
+    x = np.zeros((64, 32 * 32), np.float32)
+    y = np.zeros((64,), np.int64)
+    params = model.init(rng, x)["params"]
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                  axis_name=hvd.rank_axis())
+    st = tx.init(params)
+
+    def step(params, st, xb, yb):
+        def loss(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply({"params": p}, xb), yb).mean()
+
+        l, g = jax.value_and_grad(loss)(params)
+        up, st2 = tx.update(g, st, params)
+        return optax.apply_updates(params, up), st2, l
+
+    out = {}
+    for tag, donate in (("no_donation", ()), ("donated", (0, 1))):
+        jf = jax.jit(step, donate_argnums=donate)
+        lowered = jf.lower(params, st, x, y)
+        compiled = lowered.compile()
+        ma = compiled.memory_analysis()
+        out[tag] = {
+            "output_bytes": mib(getattr(ma, "output_size_in_bytes", 0)),
+            "temp_bytes": mib(getattr(ma, "temp_size_in_bytes", 0)),
+            "argument_bytes": mib(getattr(ma, "argument_size_in_bytes", 0)),
+            "alias_bytes": mib(getattr(ma, "alias_size_in_bytes", 0)),
+        }
+    return out
+
+
+def hierarchical_evidence():
+    """Staged RS->AR->AG vs flat psum on the 2x4 dryrun mesh."""
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("cross", "local"))
+    n = 1 << 20  # 4 MiB fp32 per rank
+
+    flat_f = jax.jit(jax.shard_map(
+        lambda v: C.hierarchical_allreduce(v, C.ReduceOp.SUM,
+                                           "local", "cross"),
+        mesh=mesh, in_specs=P(("cross", "local")),
+        out_specs=P(("cross", "local"))))
+    staged_f = jax.jit(jax.shard_map(
+        lambda v: C.hierarchical_allreduce_staged(
+            v.reshape(n), C.ReduceOp.SUM, "local", "cross")[None],
+        mesh=mesh, in_specs=P(("cross", "local")),
+        out_specs=P(("cross", "local"))))
+
+    x = np.ones((8, n), np.float32)
+
+    def bench(f, iters=20):
+        f(x).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(x)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1000
+
+    return {
+        "buffer_mib_per_rank": mib(n * 4),
+        "flat_ms": round(bench(flat_f), 2),
+        "staged_ms": round(bench(staged_f), 2),
+        "cross_axis_bytes_flat": mib(n * 4),
+        "cross_axis_bytes_staged": mib(n * 4 // 4),
+        "note": ("staged moves 1/local_size of the buffer over the "
+                 "cross (DCN) axis — the reference's hierarchical win; "
+                 "on CPU loopback the wall-clock difference is noise, "
+                 "the bytes ratio is the structural claim"),
+    }
+
+
+def fusion_evidence():
+    """Grouped (fused-bucket) vs per-tensor eager allreduce."""
+    hvd.init()
+    tensors = {f"g{i}": np.ones((256,), np.float32) for i in range(64)}
+
+    def grouped():
+        out = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="fuse")
+        jax.block_until_ready(jax.tree.leaves(out))
+
+    def per_tensor():
+        outs = [hvd.allreduce(v, op=hvd.Sum, name=f"pt{i}")
+                for i, v in enumerate(tensors.values())]
+        jax.block_until_ready(outs)
+
+    grouped(), per_tensor()  # compile both
+    t0 = time.perf_counter()
+    for _ in range(10):
+        grouped()
+    tg = (time.perf_counter() - t0) / 10 * 1000
+    t0 = time.perf_counter()
+    for _ in range(10):
+        per_tensor()
+    tp = (time.perf_counter() - t0) / 10 * 1000
+    return {"tensors": 64, "grouped_ms": round(tg, 2),
+            "per_tensor_ms": round(tp, 2),
+            "speedup": round(tp / tg, 1)}
+
+
+if __name__ == "__main__":
+    evidence = {
+        "donation": donation_evidence(),
+        "hierarchical": hierarchical_evidence(),
+        "fusion": fusion_evidence(),
+    }
+    print(json.dumps(evidence, indent=2))
