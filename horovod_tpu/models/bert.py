@@ -19,15 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def default_attend(q, k, v, mask=None):
-    """Plain softmax attention: q,k,v (B, S, H, D) -> (B, S, H, D)."""
-    d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d).astype(q.dtype)
-    if mask is not None:
-        logits = jnp.where(mask[:, None, None, :], logits,
-                           jnp.asarray(-1e9, logits.dtype))
-    probs = nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+# Default attention: the Pallas flash kernel on TPU (O(S) memory,
+# MXU-blocked), the numerically identical jnp reference elsewhere.
+from ..ops.flash_attention import attend as default_attend  # noqa: E402
 
 
 class SelfAttention(nn.Module):

@@ -124,14 +124,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve", action="store_true")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--ttl", type=float, default=0.0,
+                    help="self-terminate after this many seconds "
+                         "(0 = serve forever); launcher-started servers "
+                         "use a TTL so a dropped ssh channel cannot "
+                         "strand listeners")
     a = ap.parse_args(argv)
     if not a.serve:
         ap.error("--serve required")
     srv = TaskServer(port=a.port).start()
     print(f"TASKSERVER {srv.port}", flush=True)
+    deadline = time.monotonic() + a.ttl if a.ttl > 0 else None
     try:
-        while True:
-            time.sleep(3600)
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(min(3600.0, a.ttl or 3600.0))
     except KeyboardInterrupt:
         pass
     finally:

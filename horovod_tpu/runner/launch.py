@@ -169,35 +169,57 @@ def _nic_discovery_coordinator(hosts: List[str],
     the rank-0 host's IP on the first common interface. Returns None
     (fall back to the hostname) on any failure — discovery must never
     make a working launch fail."""
+    import select
+
     from . import driver_service as ds
 
     servers: List[subprocess.Popen] = []
     try:
         task_addrs = {}
         for hostname in hosts:
-            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+                       "-o", "BatchMode=yes"]
             if ssh_port:
                 ssh_cmd += ["-p", str(ssh_port)]
+            # --ttl: servers self-terminate, so a dropped ssh control
+            # channel cannot strand listeners on the remote host.
             ssh_cmd += [hostname, sys.executable, "-m",
-                        "horovod_tpu.runner.driver_service", "--serve"]
+                        "horovod_tpu.runner.driver_service", "--serve",
+                        "--ttl", "120"]
             p = subprocess.Popen(ssh_cmd, stdout=subprocess.PIPE,
                                  stderr=subprocess.DEVNULL, text=True)
             servers.append(p)
-            line = (p.stdout.readline() or "").strip()
+            # Bounded banner wait — a hung host must degrade discovery,
+            # not hang the launch.
+            ready, _, _ = select.select([p.stdout], [], [], 20.0)
+            line = (p.stdout.readline() or "").strip() if ready else ""
             if not line.startswith("TASKSERVER "):
                 return None
             task_addrs[hostname] = (hostname, int(line.split()[1]))
         common = ds.discover_routable_interfaces(task_addrs)
-        if not common:
-            return None
         ifaces = ds.query_interfaces(task_addrs[hosts[0]])
-        return ifaces.get(common[0])
+        port0 = task_addrs[hosts[0]][1]
+        for iface in common:
+            ip = ifaces.get(iface)
+            # Verify the candidate actually routes to rank 0's server
+            # from here — a host-local bridge (docker0, virbr0) exists
+            # everywhere but answers with the WRONG machine's stack, so
+            # its probe fails and it is skipped.
+            if ip and ds.probe_reachable((ip, port0)):
+                return ip
+        return None
     except (OSError, RuntimeError, ValueError):
         return None
     finally:
         for p in servers:
             if p.poll() is None:
                 p.terminate()
+        for p in servers:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -374,7 +396,13 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         from . import lsf as lsf_lib
 
         if lsf_lib.in_lsf():
-            host_infos = lsf_lib.lsf_hosts()
+            try:
+                host_infos = lsf_lib.lsf_hosts()
+            except RuntimeError as e:
+                # A stale LSB_JOBID without host variables must not turn
+                # a working local launch into a crash.
+                print(f"hvdtpurun: ignoring LSF environment ({e}); "
+                      "launching locally", file=sys.stderr)
 
     if host_infos is not None:
         # Validate np against available slots (reference: horovodrun errors

@@ -1,0 +1,113 @@
+"""Flash-attention Pallas kernel vs the jnp reference — forward AND
+backward (custom-VJP kernels), run in interpret mode on CPU so the real
+kernel bodies execute (same tier as tests/test_pallas_kernels.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.flash_attention import (flash_attention,
+                                             reference_attention)
+
+B, S, H, D = 2, 256, 2, 128
+
+
+def _qkv(rng, d=D, s=S, dtype=np.float32):
+    return (rng.standard_normal((B, s, H, d)).astype(dtype),
+            rng.standard_normal((B, s, H, d)).astype(dtype),
+            rng.standard_normal((B, s, H, d)).astype(dtype))
+
+
+def test_forward_matches_reference(rng):
+    q, k, v = _qkv(rng)
+    out = flash_attention(q, k, v, use_pallas=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_forward_causal(rng):
+    q, k, v = _qkv(rng)
+    out = flash_attention(q, k, v, causal=True, use_pallas=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_forward_key_mask(rng):
+    q, k, v = _qkv(rng)
+    mask = (rng.random((B, S)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1.0  # at least one visible key per batch
+    out = flash_attention(q, k, v, mask=mask, use_pallas=True)
+    ref = reference_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_forward_padded_head_dim(rng):
+    # D=64 (BERT-large) pads to the 128-lane width inside the wrapper.
+    q, k, v = _qkv(rng, d=64)
+    out = flash_attention(q, k, v, use_pallas=True)
+    ref = reference_attention(q, k, v)
+    assert out.shape == (B, S, H, 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_reference(rng, causal):
+    q, k, v = _qkv(rng)
+    mask = (rng.random((B, S)) > 0.2).astype(np.float32)
+    mask[:, 0] = 1.0
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, mask=mask, causal=causal,
+                                use_pallas=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, mask=mask,
+                                    causal=causal) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_backward_padded_head_dim(rng):
+    q, k, v = _qkv(rng, d=64)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, use_pallas=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(reference_attention),
+                     argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_bf16_inputs(rng):
+    q, k, v = _qkv(rng, dtype=np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, use_pallas=True)
+    assert out.dtype == jnp.bfloat16
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_fallback_off_tpu_and_odd_seq(rng):
+    # use_pallas=None off-TPU and an un-tileable sequence both fall back
+    # to the reference path — identical result, no error.
+    q, k, v = _qkv(rng, s=130)  # 130 has no multiple-of-8 divisor <= 128
+    out = flash_attention(q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
