@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""hvd.join() with uneven per-rank data — the reference's join example
+(operations.cc:1085-1109 / torch mpi_ops.join): ranks with less data
+finish early and keep serving zero tensors until everyone is done;
+averages divide by the ACTIVE rank count.
+
+Run as a REAL 2-process world on CPU:
+  python examples/join_uneven_data.py
+(forks itself through the programmatic runner; join_mode makes every
+collective a coordination round so a joined process stays in sync.)
+"""
+
+import os
+import sys
+
+try:
+    import horovod_tpu  # noqa: F401
+except ModuleNotFoundError:
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def worker():
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(force_cpu_devices=1, join_mode=True)
+    rank = int(os.environ["HVD_TPU_PROC_ID"])
+    n_batches = 3 if rank == 0 else 5   # rank 0 runs out of data early
+
+    log = []
+    for step in range(n_batches):
+        out = hvd.allreduce(np.full(2, float(rank + 1), np.float32),
+                            name=f"grad.{step}")
+        log.append(float(np.asarray(
+            out.addressable_data(0)).reshape(-1)[0]))
+    last = hvd.join()
+    return rank, log, last
+
+
+def main():
+    from horovod_tpu import runner
+
+    results = runner.run(worker, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    for rank, log, last in results:
+        print(f"rank {rank}: averages={log} last_joined={last}")
+    # Steps 0-2: avg(1, 2) = 1.5 on both ranks.
+    # Steps 3-4: rank 0 joined -> average over the ACTIVE rank = 2.0.
+    assert results[1][1] == [1.5, 1.5, 1.5, 2.0, 2.0]
+    assert all(r[2] == 1 for r in results)  # rank 1 joined last
+
+
+if __name__ == "__main__":
+    main()
