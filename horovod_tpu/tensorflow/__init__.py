@@ -297,20 +297,14 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
         gv = list(grads_and_vars)
         if k > 1:
             if not tf.executing_eagerly():
-                # Python-side counters only advance at TRACE time inside
-                # a tf.function — the traced graph would permanently bake
-                # the "banked" branch and the model would silently never
-                # update. The reference's graph-mode path needs
-                # tf.Variable counters + tf.cond
-                # (gradient_aggregation.py); this shim supports the
-                # eager helper only.
-                raise NotImplementedError(
-                    "backward_passes_per_step > 1 requires eager "
-                    "execution on this shim (compile with "
-                    "run_eagerly=True, or aggregate on the JAX surface "
-                    "via hvd.DistributedOptimizer)")
-            # Local aggregation round (eager helper semantics): bank the
-            # grads; the global reduce+apply happens on the k-th call.
+                # Graph mode: Python counters would only advance at TRACE
+                # time, baking one branch into the graph — so the state
+                # lives in tf.Variables and the flush is a tf.cond (the
+                # reference's LocalGradientAggregationHelper,
+                # gradient_aggregation.py:16).
+                return self._hvd_graph_aggregate(gv, args, kwargs)
+            # Eager helper semantics (gradient_aggregation_eager.py):
+            # bank the grads; reduce+apply on the k-th call.
             if not hasattr(self, "_hvd_agg"):
                 self._hvd_agg = {}
                 self._hvd_agg_count = 0
@@ -336,8 +330,72 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
         return super(dist_cls, self).apply_gradients(reduced, *args,
                                                      **kwargs)
 
+    def _hvd_graph_aggregate(self, gv, fwd_args, fwd_kwargs):
+        """tf.Variable-backed local aggregation for traced (tf.function)
+        apply_gradients — accumulate every call, tf.cond-flush through
+        the fused reduce on the k-th (reference
+        gradient_aggregation.py)."""
+        tf = _tf()
+        variables = [v for _, v in gv]
+        if not hasattr(self, "_hvd_agg_vars"):
+            with tf.init_scope():
+                self._hvd_counter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False,
+                    name="hvd_agg_counter")
+                # Accumulators only for connected (non-None) gradients —
+                # a None gradient stays None through the flush, matching
+                # the eager path (an all-zeros stand-in would still move
+                # momentum/weight-decay state on untouched variables).
+                self._hvd_agg_idx = [i for i, (g, _) in enumerate(gv)
+                                     if g is not None]
+                self._hvd_agg_vars = [
+                    tf.Variable(tf.zeros(gv[i][1].shape,
+                                         dtype=gv[i][0].dtype),
+                                trainable=False, name="hvd_agg")
+                    for i in self._hvd_agg_idx]
+                self._hvd_agg_var_ids = [id(v) for v in variables]
+        if [id(v) for v in variables] != self._hvd_agg_var_ids:
+            raise ValueError(
+                "apply_gradients called with a different variable list "
+                "than the first call; local gradient aggregation keys "
+                "its accumulators to a stable grads_and_vars order")
+        assigns = []
+        for acc, i in zip(self._hvd_agg_vars, self._hvd_agg_idx):
+            g = gv[i][0]
+            if g is None:
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            assigns.append(acc.assign_add(tf.cast(g, acc.dtype)))
+        with tf.control_dependencies(assigns):
+            count = self._hvd_counter.assign_add(1)
+
+        def _flush():
+            scale = (1.0 / k) if average_aggregated_gradients else 1.0
+            grads = [None] * len(gv)
+            for acc, i in zip(self._hvd_agg_vars, self._hvd_agg_idx):
+                grads[i] = tf.convert_to_tensor(acc) * scale
+            reduced = _reduce_grads_and_vars(
+                list(zip(grads, variables)), reduce_op, "opt",
+                sparse_as_dense)
+            result = super(dist_cls, self).apply_gradients(
+                reduced, *fwd_args, **fwd_kwargs)
+            # Order the zeroing after the apply for v1-graph fetches
+            # too: control_dependencies accepts Operations as well as
+            # Tensors, so gate only on None.
+            deps = [result] if result is not None else []
+            with tf.control_dependencies(deps):
+                zeros = [acc.assign(tf.zeros_like(acc))
+                         for acc in self._hvd_agg_vars]
+            with tf.control_dependencies(zeros):
+                return tf.constant(True)
+
+        return tf.cond(tf.equal(count % k, 0), _flush,
+                       lambda: tf.constant(False))
+
     dist_cls = type(f"Distributed{cls.__name__}", (cls,),
-                    {"apply_gradients": apply_gradients})
+                    {"apply_gradients": apply_gradients,
+                     "_hvd_graph_aggregate": _hvd_graph_aggregate})
     return dist_cls.from_config(optimizer.get_config())
 
 

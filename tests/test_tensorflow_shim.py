@@ -202,3 +202,29 @@ def test_keras_lr_warmup_callback():
     cb.on_epoch_begin(3)
     cb.on_batch_begin(1)
     assert float(opt.learning_rate) == pytest.approx(0.123)
+
+
+def test_optimizer_graph_mode_aggregation():
+    """Graph-mode (tf.function) local aggregation: tf.Variable counters +
+    tf.cond flush (reference gradient_aggregation.py:16) — the traced
+    step must accumulate across calls and apply on the k-th, not bake a
+    single branch at trace time."""
+    import tensorflow as tf
+
+    v = tf.Variable([2.0, 2.0])
+    opt = hvdtf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+
+    @tf.function
+    def step(g):
+        return opt.apply_gradients([(g, v)])
+
+    assert not bool(step(tf.constant([1.0, 1.0])))  # banked
+    np.testing.assert_allclose(v.numpy(), [2.0, 2.0])
+    assert bool(step(tf.constant([3.0, 3.0])))      # flush: (1+3)/2 = 2
+    np.testing.assert_allclose(v.numpy(), [0.0, 0.0], atol=1e-6)
+    # Next cycle accumulates cleanly after the zeroing.
+    assert not bool(step(tf.constant([2.0, 2.0])))
+    assert bool(step(tf.constant([2.0, 2.0])))
+    np.testing.assert_allclose(v.numpy(), [-2.0, -2.0], atol=1e-6)
