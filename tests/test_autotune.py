@@ -152,6 +152,48 @@ def test_autotuned_stepper_rebuilds_on_threshold_change():
     assert stepper.rebuilds == 1 and seen[-1] == 1024
 
 
+def test_autotuned_stepper_multiprocess_sync():
+    """Multi-process mode: rank 0 decides, every rank adopts the SAME
+    threshold at the SAME call index via the controller exchange —
+    per-process decisions would compile diverged bucket plans (reference
+    SynchronizeParameters, controller.cc:34-48)."""
+    import threading
+
+    from horovod_tpu.common.controller import Controller, InMemoryTransport
+    from horovod_tpu.optim import AutotunedStepper
+
+    transport = InMemoryTransport()
+    candidates = [1024, 2048, 4096]
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def run_rank(rank):
+        c = Controller(rank, 2, transport, timeout_s=10.0)
+        tuner = Autotuner(candidates_bytes=candidates, warmup_samples=0,
+                          steps_per_sample=2)
+        thresholds = []
+
+        def build(t):
+            thresholds.append(t)
+            return lambda x: x + 1
+
+        stepper = AutotunedStepper(build, grad_bytes=1000, tuner=tuner,
+                                   block=False, controller=c)
+        barrier.wait()
+        for i in range(6):  # 3 sample periods of 2 calls
+            stepper(i)
+        results[rank] = thresholds
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results[0] == results[1], results
+    assert len(results[0]) >= 2  # the threshold moved at least once
+
+
 def test_knob_observably_alters_bucket_plans():
     """Fusion threshold changes must change the bucket plan — the thing the
     reference's tuner actually tunes (FuseResponses ≤threshold bins,
