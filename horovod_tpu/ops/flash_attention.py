@@ -110,11 +110,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
 # -- backward kernels -------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, block_q, block_k, seq_len, causal, scale):
+               dlse_ref, dq_ref, *, block_q, block_k, seq_len, causal,
+               scale):
     q = q_ref[0, :, 0, :].astype(jnp.float32)
     do = do_ref[0, :, 0, :].astype(jnp.float32)
     lse = lse_ref[0, 0, :][:, None]                         # (bq, 1)
     delta = delta_ref[0, 0, :][:, None]
+    # Cotangent of the lse OUTPUT (nonzero when callers combine blocks —
+    # ring attention): lse = logsumexp(s) and dlse/ds = p, so the term
+    # folds into ds as p * dlse.
+    dlse = dlse_ref[0, 0, :][:, None]
     qi = pl.program_id(2)
     nk = seq_len // block_k
     if causal:
@@ -142,7 +147,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)                                # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + dlse)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -153,8 +158,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, block_q, block_k, seq_len, causal,
-                scale):
+                dlse_ref, dk_ref, dv_ref, *, block_q, block_k, seq_len,
+                causal, scale):
     ki = pl.program_id(2)
     k = k_ref[0, :, 0, :].astype(jnp.float32)               # (bk, D)
     v = v_ref[0, :, 0, :].astype(jnp.float32)
@@ -172,6 +177,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
             jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        dlse = dlse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = jnp.where(kmask[None, :], s, _NEG)
@@ -187,7 +193,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)             # (bk, D)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)                               # (bq, bk)
+        ds = p * (dp - delta + dlse)                        # (bq, bk)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -214,8 +220,10 @@ def _specs(b, s, h, d, bq, bk):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, mask, causal, bq, bk, interpret):
-    o, _ = _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret)
-    return o
+    """Returns (o, lse). lse (B, H, S) is a first-class differentiable
+    output so blockwise callers (ring attention) can combine partial
+    results; its cotangent folds into the backward kernels' ds."""
+    return _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret)
 
 
 def _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret):
@@ -238,17 +246,19 @@ def _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret):
 
 def _flash_fwd(q, k, v, mask, causal, bq, bk, interpret):
     o, lse = _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret)
-    return o, (q, k, v, mask, o, lse)
+    return (o, lse), (q, k, v, mask, o, lse)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, do):
+def _flash_bwd(causal, bq, bk, interpret, res, cotangents):
+    do, dlse = cotangents
     q, k, v, mask, o, lse = res
     b, s, h, d = q.shape
     scale = 1.0 / np.sqrt(d)
     # delta_i = rowsum(do_i * o_i) — cheap elementwise, computed in-graph.
     delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
                        o.astype(jnp.float32))
-    q_spec, kv_spec, m_spec, _, lse_full, kv_block = _specs(
+    dlse = dlse.astype(jnp.float32)
+    q_spec, kv_spec, m_spec, lse_blk, lse_full, kv_block = _specs(
         b, s, h, d, bq, bk)
 
     dq = pl.pallas_call(
@@ -256,28 +266,77 @@ def _flash_bwd(causal, bq, bk, interpret, res, do):
                           causal=causal, scale=scale),
         grid=(b, h, s // bq),
         in_specs=[q_spec, kv_spec, kv_spec, m_spec, q_spec,
-                  pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i)),
-                  pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))],
+                  lse_blk, lse_blk, lse_blk],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, mask, do, lse, delta)
+    )(q, k, v, mask, do, lse, delta, dlse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=bq, block_k=bk, seq_len=s,
                           causal=causal, scale=scale),
         grid=(b, h, s // bk),
         in_specs=[kv_spec, kv_block, kv_block, m_spec, kv_spec,
-                  lse_full, lse_full],
+                  lse_full, lse_full, lse_full],
         out_specs=[kv_block, kv_block],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         interpret=interpret,
-    )(q, k, v, mask, do, lse, delta)
+    )(q, k, v, mask, do, lse, delta, dlse)
     return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_available(seq_len: int, use_pallas: Optional[bool] = None,
+                    block_q: int = 128, block_k: int = 128) -> bool:
+    """THE availability predicate — single source of truth for every
+    reason the kernel path can decline (off-TPU without forcing,
+    HVD_TPU_FLASH_ATTENTION=0 escape hatch, un-tileable sequence).
+    flash_attention_with_lse consults exactly this, so callers (ring
+    attention) pre-checking it can rely on a non-None result."""
+    import os
+
+    use, _ = _decide(use_pallas)
+    if os.environ.get("HVD_TPU_FLASH_ATTENTION", "1") == "0":
+        return False
+    return bool(use) and _pick_block(seq_len, block_q) is not None \
+        and _pick_block(seq_len, block_k) is not None
+
+
+def flash_attention_with_lse(q, k, v, mask=None, causal: bool = False,
+                             use_pallas: Optional[bool] = None,
+                             block_q: int = 128, block_k: int = 128):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp (B, H, S) — the blockwise-combination interface ring
+    attention stitches partial results with. Both outputs are
+    differentiable (the lse cotangent folds into the backward kernels).
+    Returns None when :func:`flash_available` declines, so callers use
+    their own reference path."""
+    b, s, h, d = q.shape
+    if not flash_available(s, use_pallas, block_q, block_k):
+        return None
+    _, interpret = _decide(use_pallas)
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    if d % _LANE != 0:
+        # Pad head_dim to the lane width; zero columns contribute zero
+        # to every dot product and are sliced off the output. The
+        # kernel derives its scale from the PADDED d, so fold the
+        # correction into q.
+        pad = _LANE - d % _LANE
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        corr = np.sqrt((d + pad) / d).astype(np.float32)
+        o, lse = _flash(qp * corr, kp, vp, mask, causal, bq, bk,
+                        interpret)
+        return o[..., :d], lse
+    return _flash(q, k, v, mask, causal, bq, bk, interpret)
 
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,
@@ -289,33 +348,11 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     auto-selects the Pallas kernel on TPU with a jnp fallback elsewhere;
     ``True`` forces the kernel (interpret mode off-TPU — the test path).
     Differentiable via the standard flash backward kernels."""
-    import os
-
-    use, interpret = _decide(use_pallas)
-    if os.environ.get("HVD_TPU_FLASH_ATTENTION", "1") == "0":
-        use = False  # escape hatch: force the jnp reference path
-    b, s, h, d = q.shape
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(s, block_k)
-    if not use or bq is None or bk is None:
+    out = flash_attention_with_lse(q, k, v, mask, causal, use_pallas,
+                                   block_q, block_k)
+    if out is None:
         return reference_attention(q, k, v, mask, causal)
-    if mask is None:
-        mask = jnp.ones((b, s), jnp.float32)
-    mask = mask.astype(jnp.float32)
-    if d % _LANE != 0:
-        # Pad head_dim to the lane width; zero columns contribute zero
-        # to every dot product and are sliced off the output. The
-        # softmax scale uses the ORIGINAL d (set inside from q.shape
-        # AFTER padding would be wrong) — so pad after capturing shapes.
-        pad = _LANE - d % _LANE
-        qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, pad)))
-        kp = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, pad)))
-        vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
-        # Correct the scale: kernel derives it from the padded d.
-        corr = np.sqrt((d + pad) / d).astype(np.float32)
-        out = _flash(qp * corr, kp, vp, mask, causal, bq, bk, interpret)
-        return out[..., :d]
-    return _flash(q, k, v, mask, causal, bq, bk, interpret)
+    return out[0]
 
 
 def attend(q, k, v, mask=None):

@@ -47,19 +47,27 @@ def _block_attend(q, k, v, m, l, o, mask=None):
 
 
 def ring_attention(q, k, v, axis_name: str = "sp",
-                   causal: bool = False):
+                   causal: bool = False,
+                   use_flash: Optional[bool] = None):
     """Attention over sequence-sharded q/k/v.
 
     Args:
       q, k, v: (B, S_local, H, D) — the local sequence shard on each
         device of the ``axis_name`` ring.
       causal: apply a causal mask over *global* positions.
+      use_flash: run each ring step's block attention through the Pallas
+        flash kernel (ops/flash_attention.py) and combine blocks via
+        their logsumexp — auto on TPU, jnp blockwise math elsewhere.
 
     Returns (B, S_local, H, D) attention output for the local Q block.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
+
+    if use_flash is not False and _ring_flash_available(q, use_flash):
+        return _ring_attention_flash(q, k, v, axis_name, causal,
+                                     use_flash)
 
     m = jnp.full((b, h, s), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s), jnp.float32)
@@ -88,6 +96,68 @@ def ring_attention(q, k, v, axis_name: str = "sp",
     denom = l.transpose(0, 2, 1)[..., None]               # (B,S,H,1)
     out = o / jnp.maximum(denom, 1e-30)
     return out.astype(q.dtype)
+
+
+def _ring_flash_available(q, use_flash: Optional[bool]) -> bool:
+    from ..ops.flash_attention import flash_available
+
+    return flash_available(q.shape[1], use_flash)
+
+
+def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
+                          use_flash: Optional[bool]):
+    """Ring steps through the Pallas flash kernel: each block yields a
+    normalized partial (o_i, lse_i); blocks combine with
+    logaddexp-weighted averaging (both outputs differentiable, so the
+    whole ring backprops through the kernels)."""
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def block(k_cur, v_cur, block_causal: bool):
+        out = flash_attention_with_lse(q, k_cur, v_cur,
+                                       causal=block_causal,
+                                       use_pallas=use_flash)
+        if out is None:  # flash_available() said yes — must not decline
+            raise RuntimeError(
+                "flash_attention_with_lse declined after "
+                "flash_available() approved — the availability "
+                "predicate and the kernel wrapper are out of sync")
+        o_i, lse_i = out
+        return o_i.astype(jnp.float32), lse_i
+
+    def body(i, carry):
+        o, lse, k_cur, v_cur = carry
+        src = (idx - i) % n
+        if causal:
+            # Global causality at block granularity: earlier source
+            # blocks are fully visible, the diagonal block is causal,
+            # later blocks contribute nothing.
+            o_i, lse_i = lax.cond(
+                src == idx,
+                lambda: block(k_cur, v_cur, True),
+                lambda: lax.cond(
+                    src < idx,
+                    lambda: block(k_cur, v_cur, False),
+                    lambda: (jnp.zeros((b, s, h, d), jnp.float32),
+                             jnp.full((b, h, s), NEG_INF, jnp.float32))))
+        else:
+            o_i, lse_i = block(k_cur, v_cur, False)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+        w_new = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
+        o = o * w_old + o_i * w_new
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, lse_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
 
 
 def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
