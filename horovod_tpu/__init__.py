@@ -53,7 +53,8 @@ _ctx = _basics.context
 def __getattr__(name):
     # Lazy submodules with heavy deps (orbax, TF) — imported on first use.
     if name in ("checkpoint", "callbacks", "elastic", "executor",
-                "tensorflow", "torch", "mxnet", "store", "estimator"):
+                "tensorflow", "torch", "mxnet", "store", "estimator",
+                "spark"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

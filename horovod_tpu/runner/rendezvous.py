@@ -33,10 +33,22 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.kv_lock  # type: ignore[attr-defined]
 
     def do_PUT(self):
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
+        nx = bool(parse_qs(parsed.query).get("nx"))
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         with self._lock():
+            if nx and path in self._store():
+                # Atomic put-if-absent: first writer wins; the loser gets
+                # the stored value back (409) so concurrent publishers
+                # converge on one value (the retried-task-0 case).
+                val = self._store()[path]
+                self.send_response(409)
+                self.send_header("Content-Length", str(len(val)))
+                self.end_headers()
+                self.wfile.write(val)
+                return
             self._store()[path] = body
         self.send_response(200)
         self.end_headers()
@@ -127,6 +139,23 @@ class RendezvousClient:
         req = urllib.request.Request(
             f"{self.base}/kv/{scope}/{key}", data=value, method="PUT")
         urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+    def put_if_absent(self, scope: str, key: str, value: bytes) -> bytes:
+        """Atomic first-writer-wins PUT; returns the WINNING value (the
+        caller's on success, the already-stored one on conflict)."""
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base}/kv/{scope}/{key}?nx=1", data=value,
+            method="PUT")
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            return value
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                return e.read()
+            raise
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         import urllib.error

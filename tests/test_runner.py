@@ -292,3 +292,19 @@ def test_config_file_zero_and_np(tmp_path):
     with pytest.raises(ValueError, match="compression"):
         launch_lib.apply_config_file(args2,
                                      ["--config-file", str(bad), "--", "x"])
+
+
+def test_rendezvous_put_if_absent():
+    """Atomic first-writer-wins PUT (?nx=1) — concurrent publishers
+    (e.g. a retried Spark task 0) converge on one value."""
+    srv = RendezvousServer("127.0.0.1")
+    port = srv.start()
+    try:
+        cli = RendezvousClient("127.0.0.1", port)
+        won = cli.put_if_absent("s", "coord", b"host-a:1")
+        assert won == b"host-a:1"
+        lost = cli.put_if_absent("s", "coord", b"host-b:2")
+        assert lost == b"host-a:1"          # returns the stored winner
+        assert cli.get("s", "coord") == b"host-a:1"
+    finally:
+        srv.stop()
