@@ -189,3 +189,57 @@ def test_elastic_reset_limit(hvd, monkeypatch):
 
     with pytest.raises(RuntimeError, match="reset limit"):
         always_fail(state)
+
+
+@pytest.mark.slow
+def test_elastic_ssh_epoch(tmp_path, monkeypatch):
+    """The elastic driver's ssh fan-out branch (one process per host),
+    exercised through a PATH-shadowing ssh that executes locally."""
+    import os
+    import sys
+    import textwrap
+
+    from horovod_tpu.runner import hosts as hosts_lib
+    from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                                   FixedHostDiscovery,
+                                                   _run_epoch)
+
+    fake = tmp_path / "ssh"
+    fake.write_text(
+        "#!/bin/bash\n"
+        "args=()\n"
+        "while [[ $# -gt 0 ]]; do\n"
+        "  case $1 in\n"
+        "    -o|-p) shift 2;;\n"
+        "    *) args+=(\"$1\"); shift;;\n"
+        "  esac\n"
+        "done\n"
+        "exec bash -c \"${args[*]:1}\"\n")
+    fake.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.delenv("HVD_TPU_ELASTIC_FORCE_LOCAL", raising=False)
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        pid = os.environ["HVD_TPU_PROC_ID"]
+        host = os.environ["HVD_TPU_HOSTNAME"]
+        with open(r"{out_dir}/" + pid, "w") as f:
+            f.write(host)
+    """))
+
+    driver = ElasticDriver(
+        FixedHostDiscovery({"nodeA": 1, "nodeB": 1}), min_np=2, max_np=2)
+    driver.host_manager.update_available_hosts()
+    slots = driver.update_assignments()
+    assert sorted({s.hostname for s in slots}) == ["nodeA", "nodeB"]
+
+    rc, failed, interrupted = _run_epoch(
+        driver, slots, [sys.executable, str(script)], {})
+    assert (rc, failed, interrupted) == (0, set(), False)
+    hosts_seen = sorted((out_dir / p).read_text()
+                        for p in os.listdir(out_dir))
+    assert hosts_seen == ["nodeA", "nodeB"]
+    driver.stop()

@@ -308,3 +308,53 @@ def test_rendezvous_put_if_absent():
         assert cli.get("s", "coord") == b"host-a:1"
     finally:
         srv.stop()
+
+
+# -- ssh fan-out exercised via a fake ssh on PATH ---------------------------
+
+@pytest.fixture()
+def fake_ssh(tmp_path, monkeypatch):
+    """A PATH-shadowing `ssh` that runs the remote command locally —
+    exercises the real fan-out code (reference tests alias localhost
+    similarly)."""
+    fake = tmp_path / "ssh"
+    fake.write_text(
+        "#!/bin/bash\n"
+        "# drop ssh options (-o v / -p v), take <host> <command...>\n"
+        "args=()\n"
+        "while [[ $# -gt 0 ]]; do\n"
+        "  case $1 in\n"
+        "    -o|-p) shift 2;;\n"
+        "    *) args+=(\"$1\"); shift;;\n"
+        "  esac\n"
+        "done\n"
+        "host=${args[0]}\n"
+        "exec bash -c \"${args[*]:1}\"\n")
+    fake.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    return fake
+
+
+@pytest.mark.slow
+def test_run_ssh_fans_out(tmp_path, fake_ssh):
+    """run_ssh: one process per used host, PROC_ID per host order, env
+    quoting survives the remote shell."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        pid = os.environ["HVD_TPU_PROC_ID"]
+        with open(r"{out_dir}/" + pid, "w") as f:
+            f.write(os.environ["HVD_TPU_NUM_PROC"] + " "
+                    + os.environ["HVD_TPU_COORDINATOR"])
+    """))
+    hosts = hosts_lib.parse_hosts("hostA:2,hostB:2")
+    rc = launch_lib.run_ssh(hosts, [sys.executable, str(script)], {},
+                            np=4)
+    assert rc == 0
+    # 2 hosts -> 2 processes (each drives its host's 2 slots).
+    assert sorted(os.listdir(out_dir)) == ["0", "1"]
+    for pid in ("0", "1"):
+        n, coord = (out_dir / pid).read_text().split()
+        assert n == "2" and coord.startswith("hostA:")
