@@ -202,10 +202,16 @@ def synchronize(handle: int):
 
 # -- timeline (reference operations.cc:720-746) ----------------------------
 
-def start_timeline(filename: str, mark_cycles: bool = False) -> None:
+def start_timeline(filename: str, mark_cycles: bool = False,
+                   xprof_dir: Optional[str] = None) -> None:
+    """Start the chrome-trace collective timeline; ``xprof_dir``
+    additionally starts a ``jax.profiler`` trace there for device-side
+    detail (view with TensorBoard/xprof). Both lifecycles live on the
+    Timeline, so every stop path — including shutdown() — flushes the
+    device trace."""
     t = _ctx().timeline
     t._mark_cycles = mark_cycles
-    t.start(filename)
+    t.start(filename, xprof_dir=xprof_dir)
 
 
 def stop_timeline() -> None:

@@ -53,6 +53,7 @@ class Timeline:
         self._pending_starts = {}
         self._lock = threading.Lock()
         self._native = None
+        self._xprof_active = False
         self._use_native = (use_native and
                             os.environ.get("HVD_TPU_DISABLE_NATIVE") != "1")
         if filename:
@@ -73,11 +74,21 @@ class Timeline:
 
     # -- runtime start/stop (reference operations.cc:720-746) -------------
 
-    def start(self, filename: str) -> None:
+    def start(self, filename: str,
+              xprof_dir: Optional[str] = None) -> None:
+        """``xprof_dir`` additionally starts a jax.profiler trace there
+        for device-side detail (the GPU-event layer the reference gets
+        from CUDA events, gpu_operations.h:110-118) — owned HERE so
+        every stop path (incl. Context.shutdown) flushes it."""
         with self._lock:
             if self._active:
                 return
             self._filename = filename
+            if xprof_dir and not self._xprof_active:
+                import jax
+
+                jax.profiler.start_trace(xprof_dir)
+                self._xprof_active = True
             self._native = self._load_native()
             if self._native is not None and self._native.start(filename):
                 self._active = True
@@ -88,17 +99,24 @@ class Timeline:
             self._thread.start()
 
     def stop(self) -> None:
-        with self._lock:
-            if not self._active:
-                return
-            self._active = False
-            if self._native is not None:
-                self._native.stop()
-                return
-        self._queue.put(None)
-        if self._thread:
-            self._thread.join(timeout=5)
-            self._thread = None
+        try:
+            if self._xprof_active:
+                self._xprof_active = False
+                import jax
+
+                jax.profiler.stop_trace()
+        finally:
+            with self._lock:
+                if not self._active:
+                    return
+                self._active = False
+                if self._native is not None:
+                    self._native.stop()
+                    return
+            self._queue.put(None)
+            if self._thread:
+                self._thread.join(timeout=5)
+                self._thread = None
 
     @property
     def active(self) -> bool:

@@ -45,3 +45,24 @@ def test_not_initialized_error():
     if not hvd.is_initialized():
         with pytest.raises(hvd.NotInitializedError):
             basics.context()
+
+
+def test_timeline_with_xprof_trace(hvd, tmp_path):
+    """start_timeline(xprof_dir=...) bridges into jax.profiler so the
+    device-side trace accompanies the collective lifecycle JSON."""
+    import numpy as np
+
+    tl = str(tmp_path / "tl.json")
+    xprof = str(tmp_path / "xprof")
+    hvd.start_timeline(tl, xprof_dir=xprof)
+    out = hvd.allreduce(np.ones(4, np.float32), name="xp")
+    import jax
+
+    jax.block_until_ready(jax.tree.leaves(out))
+    hvd.stop_timeline()
+    import json
+    import os
+
+    events = json.load(open(tl))["traceEvents"]
+    assert events
+    assert os.listdir(xprof)  # jax.profiler wrote its trace directory
