@@ -81,14 +81,16 @@ class Timeline:
         from CUDA events, gpu_operations.h:110-118) — owned HERE so
         every stop path (incl. Context.shutdown) flushes it."""
         with self._lock:
-            if self._active:
-                return
-            self._filename = filename
             if xprof_dir and not self._xprof_active:
                 import jax
 
                 jax.profiler.start_trace(xprof_dir)
                 self._xprof_active = True
+            if self._active:
+                # Timeline already running (e.g. HVD_TPU_TIMELINE env
+                # auto-start): the xprof request above still took effect.
+                return
+            self._filename = filename
             self._native = self._load_native()
             if self._native is not None and self._native.start(filename):
                 self._active = True
@@ -99,9 +101,13 @@ class Timeline:
             self._thread.start()
 
     def stop(self) -> None:
+        with self._lock:
+            # Claim the flag atomically so concurrent stop() calls (user
+            # thread + Context.shutdown) can't double-stop the profiler.
+            flush_xprof = self._xprof_active
+            self._xprof_active = False
         try:
-            if self._xprof_active:
-                self._xprof_active = False
+            if flush_xprof:
                 import jax
 
                 jax.profiler.stop_trace()

@@ -48,6 +48,7 @@ def _block_attend(q, k, v, m, l, o, mask=None):
 
 def ring_attention(q, k, v, axis_name: str = "sp",
                    causal: bool = False,
+                   mask=None,
                    use_flash: Optional[bool] = None):
     """Attention over sequence-sharded q/k/v.
 
@@ -55,6 +56,8 @@ def ring_attention(q, k, v, axis_name: str = "sp",
       q, k, v: (B, S_local, H, D) — the local sequence shard on each
         device of the ``axis_name`` ring.
       causal: apply a causal mask over *global* positions.
+      mask: optional (B, S_local) key mask for the LOCAL shard (1 =
+        attend); it rotates around the ring alongside its K/V block.
       use_flash: run each ring step's block attention through the Pallas
         flash kernel (ops/flash_attention.py) and combine blocks via
         their logsumexp — auto on TPU, jnp blockwise math elsewhere.
@@ -66,7 +69,7 @@ def ring_attention(q, k, v, axis_name: str = "sp",
     b, s, h, d = q.shape
 
     if use_flash is not False and _ring_flash_available(q, use_flash):
-        return _ring_attention_flash(q, k, v, axis_name, causal,
+        return _ring_attention_flash(q, k, v, axis_name, causal, mask,
                                      use_flash)
 
     m = jnp.full((b, h, s), NEG_INF, jnp.float32)
@@ -74,25 +77,36 @@ def ring_attention(q, k, v, axis_name: str = "sp",
     o = jnp.zeros((b, s, h, d), jnp.float32)
 
     q_pos = idx * s + jnp.arange(s)
+    # mask is a TRACE-TIME value: the no-mask path carries no extra ring
+    # traffic and skips the where entirely (same zero-cost property the
+    # flash path keeps).
+    has_mask = mask is not None
+    key_mask = (mask.astype(jnp.float32) if has_mask
+                else jnp.zeros((b, 0), jnp.float32))
 
     # Ring: each step, device j hands its current K/V block to j+1, so
     # after i steps device idx holds block (idx - i) mod n.
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
-        m, l, o, k_cur, v_cur = carry
+        m, l, o, k_cur, v_cur, m_cur = carry
         src = (idx - i) % n
-        mask = None
+        blk = None
+        if has_mask:
+            blk = m_cur[:, None, None, :] > 0            # (B,1,1,Sk)
         if causal:
             k_pos = src * s + jnp.arange(s)
-            mask = q_pos[:, None] >= k_pos[None, :]      # (Sq, Sk)
-            mask = mask[None, None]                       # (1,1,Sq,Sk)
-        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, mask)
+            cmask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            blk = cmask if blk is None else blk & cmask
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, blk)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return m, l, o, k_nxt, v_nxt
+        m_nxt = (lax.ppermute(m_cur, axis_name, perm) if has_mask
+                 else m_cur)
+        return m, l, o, k_nxt, v_nxt, m_nxt
 
-    m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
+    m, l, o, _, _, _ = lax.fori_loop(0, n, body,
+                                     (m, l, o, k, v, key_mask))
     denom = l.transpose(0, 2, 1)[..., None]               # (B,S,H,1)
     out = o / jnp.maximum(denom, 1e-30)
     return out.astype(q.dtype)
@@ -104,21 +118,26 @@ def _ring_flash_available(q, use_flash: Optional[bool]) -> bool:
     return flash_available(q.shape[1], use_flash)
 
 
-def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
+def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
                           use_flash: Optional[bool]):
     """Ring steps through the Pallas flash kernel: each block yields a
     normalized partial (o_i, lse_i); blocks combine with
     logaddexp-weighted averaging (both outputs differentiable, so the
-    whole ring backprops through the kernels)."""
+    whole ring backprops through the kernels). The key-mask shard
+    rotates with its K/V block."""
     from ..ops.flash_attention import flash_attention_with_lse
 
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
+    has_mask = mask is not None
+    key_mask = (mask.astype(jnp.float32) if has_mask
+                else jnp.zeros((b, 0), jnp.float32))
 
-    def block(k_cur, v_cur, block_causal: bool):
+    def block(k_cur, v_cur, m_cur, block_causal: bool):
         out = flash_attention_with_lse(q, k_cur, v_cur,
+                                       mask=m_cur if has_mask else None,
                                        causal=block_causal,
                                        use_pallas=use_flash)
         if out is None:  # flash_available() said yes — must not decline
@@ -130,7 +149,7 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
         return o_i.astype(jnp.float32), lse_i
 
     def body(i, carry):
-        o, lse, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur, m_cur = carry
         src = (idx - i) % n
         if causal:
             # Global causality at block granularity: earlier source
@@ -138,25 +157,27 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
             # later blocks contribute nothing.
             o_i, lse_i = lax.cond(
                 src == idx,
-                lambda: block(k_cur, v_cur, True),
+                lambda: block(k_cur, v_cur, m_cur, True),
                 lambda: lax.cond(
                     src < idx,
-                    lambda: block(k_cur, v_cur, False),
+                    lambda: block(k_cur, v_cur, m_cur, False),
                     lambda: (jnp.zeros((b, s, h, d), jnp.float32),
                              jnp.full((b, h, s), NEG_INF, jnp.float32))))
         else:
-            o_i, lse_i = block(k_cur, v_cur, False)
+            o_i, lse_i = block(k_cur, v_cur, m_cur, False)
         lse_new = jnp.logaddexp(lse, lse_i)
         w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
         w_new = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
         o = o * w_old + o_i * w_new
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o, lse_new, k_nxt, v_nxt
+        m_nxt = (lax.ppermute(m_cur, axis_name, perm) if has_mask
+                 else m_cur)
+        return o, lse_new, k_nxt, v_nxt, m_nxt
 
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
     lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    o, _, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v, key_mask))
     return o.astype(q.dtype)
 
 
@@ -166,11 +187,10 @@ def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
     attention for any model accepting attend_fn."""
 
     def attend(q, k, v, mask=None):
-        if mask is not None:
-            raise NotImplementedError(
-                "ring_attend_fn does not support padding masks; mask "
-                "handling requires rotating the key mask with K/V")
-        return ring_attention(q, k, v, axis_name, causal=causal)
+        # mask: (B, S_local) key mask for this shard; it rotates around
+        # the ring with its K/V block.
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              mask=mask)
 
     return attend
 

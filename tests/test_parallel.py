@@ -259,3 +259,32 @@ def test_ring_attention_flash_grads(sp_mesh, rng, causal):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(ge),
                                    rtol=5e-3, atol=5e-3,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_key_mask(sp_mesh, rng, use_flash, causal):
+    """Padding masks rotate around the ring with their K/V shard — both
+    the jnp blockwise path and the flash-kernel path must match the full
+    masked reference, including combined with global causality (the
+    lax.cond block decomposition must route the mask)."""
+    from horovod_tpu.ops.flash_attention import (
+        reference_attention as flash_ref)
+
+    s = 128 if use_flash else 32
+    d = 128 if use_flash else 16
+    q, k, v = _qkv(rng, b=1, s=s, h=2, d=d)
+    mask = (np.random.default_rng(5).random((1, s)) > 0.3)
+    mask[:, 0] = True
+    maskf = jnp.asarray(mask.astype(np.float32))
+    expected = flash_ref(q, k, v, mask=maskf, causal=causal)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v, m: ring_attention(q, k, v, "sp", causal=causal,
+                                          mask=m, use_flash=use_flash),
+        mesh=sp_mesh, in_specs=(P(None, "sp"), P(None, "sp"),
+                                P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))
+    out = f(q, k, v, maskf)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=5e-4, atol=5e-4)
