@@ -190,9 +190,12 @@ def alltoall(tensor, name: Optional[str] = None):
 
 def broadcast_variables(variables, root_rank: int = 0) -> None:
     """In-place assign of root's values onto tf.Variables (reference
-    tensorflow/functions.py:47 broadcast_variables)."""
+    tensorflow/functions.py:47 broadcast_variables). Handles both
+    tf.Variable (.value() method) and keras-3 Variable (.value
+    property) via convert_to_tensor."""
+    tf = _tf()
     for i, v in enumerate(variables):
-        v.assign(broadcast(v.value(), root_rank,
+        v.assign(broadcast(tf.convert_to_tensor(v), root_rank,
                            name=f"bcast.{getattr(v, 'name', i)}"))
 
 

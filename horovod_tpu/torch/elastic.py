@@ -1,0 +1,141 @@
+"""TorchState — elastic state for the torch shim.
+
+Reference: horovod/torch/elastic/state.py:27-130 (TorchState over
+ObjectState with per-type handlers: model state_dict snapshot/restore,
+optimizer state_dict, plain objects via broadcast_object) +
+elastic/sampler.py (covered framework-agnostically by
+horovod_tpu.data.ElasticSampler).
+
+Usage mirrors the reference::
+
+    state = TorchState(model=model, optimizer=optimizer, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        for epoch in range(state.epoch, epochs):
+            ...
+            state.epoch = epoch
+            state.commit()
+"""
+
+from __future__ import annotations
+
+import copy
+
+import torch
+
+from ..common.elastic import ObjectState
+from . import broadcast_optimizer_state, broadcast_parameters
+
+
+def _clone_state_dict(sd):
+    return {k: (v.detach().clone() if isinstance(v, torch.Tensor)
+                else copy.deepcopy(v)) for k, v in sd.items()}
+
+
+class _ModelHandler:
+    """Snapshot/restore/sync a torch nn.Module (reference
+    state.py ModelStateHandler)."""
+
+    def __init__(self, model):
+        self.value = model
+        self._saved = _clone_state_dict(model.state_dict())
+
+    def save(self):
+        self._saved = _clone_state_dict(self.value.state_dict())
+
+    def restore(self):
+        # load_state_dict copies values into the parameters (copy_), so
+        # the snapshot cannot be aliased — no defensive clone needed.
+        self.value.load_state_dict(self._saved)
+
+    def sync(self):
+        broadcast_parameters(self.value.state_dict(), root_rank=0)
+
+    def set_value(self, model):
+        self.value = model
+        self.save()
+
+
+class _OptimizerHandler:
+    """Reference state.py OptimizerStateHandler: optimizer state_dict
+    snapshot + cross-rank broadcast."""
+
+    def __init__(self, optimizer):
+        self.value = optimizer
+        self._saved = copy.deepcopy(optimizer.state_dict())
+
+    def save(self):
+        self._saved = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        # Optimizer.load_state_dict deepcopies its input internally.
+        self.value.load_state_dict(self._saved)
+
+    def sync(self):
+        broadcast_optimizer_state(self.value, root_rank=0)
+
+    def set_value(self, optimizer):
+        self.value = optimizer
+        self.save()
+
+
+def _make_handler(value):
+    if isinstance(value, torch.nn.Module):
+        return _ModelHandler(value)
+    if isinstance(value, torch.optim.Optimizer) or (
+            hasattr(value, "state_dict")
+            and hasattr(value, "load_state_dict")
+            and hasattr(value, "param_groups")):
+        # Duck-typed so the shim's dynamic-subclass DistributedOptimizer
+        # (and its Adasum variant) route here too.
+        return _OptimizerHandler(value)
+    return None
+
+
+class TorchState(ObjectState):
+    """Elastic state for torch training: models/optimizers get typed
+    handlers (state_dict snapshot/restore, collective sync), everything
+    else rides ObjectState's pickle snapshot + broadcast_object."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        if model is not None:
+            kwargs.setdefault("model", model)
+        if optimizer is not None:
+            kwargs.setdefault("optimizer", optimizer)
+        handlers = {}
+        plain = {}
+        for name, value in kwargs.items():
+            h = _make_handler(value)
+            if h is not None:
+                handlers[name] = h
+            else:
+                plain[name] = value
+        object.__setattr__(self, "_handlers", handlers)
+        super().__init__(**plain)
+        for name, h in handlers.items():
+            object.__setattr__(self, name, h.value)
+
+    def save(self):
+        for h in self._handlers.values():
+            h.save()
+        super().save()
+
+    def restore(self):
+        for h in self._handlers.values():
+            h.restore()
+        super().restore()
+
+    def sync(self):
+        for h in self._handlers.values():
+            h.sync()
+        super().sync()  # ObjectState.sync ends with self.save() → one
+        # full snapshot (incl. every handler) after the broadcasts
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_") and hasattr(self, "_handlers") \
+                and name in self._handlers:
+            self._handlers[name].set_value(value)
+            object.__setattr__(self, name, value)
+            return
+        super().__setattr__(name, value)

@@ -228,3 +228,57 @@ def test_optimizer_graph_mode_aggregation():
     assert not bool(step(tf.constant([2.0, 2.0])))
     assert bool(step(tf.constant([2.0, 2.0])))
     np.testing.assert_allclose(v.numpy(), [-2.0, -2.0], atol=1e-6)
+
+
+def test_tensorflow_keras_state_commit_restore_sync():
+    """TensorFlowKerasState (reference tensorflow/elastic.py:91-155):
+    weights snapshot to host on commit, roll back on restore, broadcast
+    on sync; plain attrs ride ObjectState."""
+    import tensorflow as tf
+
+    from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, use_bias=False)])
+    model.build((None, 2))
+    opt = tf.keras.optimizers.SGD(learning_rate=1.0, momentum=0.9)
+    model.compile(optimizer=opt, loss="mse")
+
+    state = TensorFlowKerasState(model, optimizer=opt, epoch=0)
+    x = tf.ones((4, 2))
+    y = tf.zeros((4, 1))
+    model.train_on_batch(x, y)
+    state.epoch = 1
+    state.commit()
+    w_committed = [w.copy() for w in model.get_weights()]
+
+    model.train_on_batch(x, y)
+    state.epoch = 2
+    assert not np.allclose(model.get_weights()[0], w_committed[0])
+
+    state.restore()
+    np.testing.assert_allclose(model.get_weights()[0], w_committed[0],
+                               rtol=1e-6)
+    assert state.epoch == 1
+
+    state.sync()  # rank-0 broadcast; identity on single controller
+    np.testing.assert_allclose(model.get_weights()[0], w_committed[0],
+                               rtol=1e-6)
+
+
+def test_tensorflow_state_variables():
+    import tensorflow as tf
+
+    from horovod_tpu.tensorflow.elastic import TensorFlowState
+
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    state = TensorFlowState([v1, v2], step=5)
+    v1.assign([9.0, 9.0])
+    state.restore()
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    assert state.step == 5
+    v2.assign([[7.0]])
+    state.commit()
+    v2.assign([[8.0]])
+    state.restore()
+    np.testing.assert_allclose(v2.numpy(), [[7.0]])

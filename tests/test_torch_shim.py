@@ -197,3 +197,42 @@ def test_adasum_delta_optimizer():
     torch.testing.assert_close(model.weight.detach(),
                                torch.full((1, 2), 0.5))
     opt.zero_grad()
+
+
+def test_torch_state_commit_restore_sync():
+    """TorchState (reference torch/elastic/state.py:27-130): model and
+    optimizer get state_dict snapshot/restore handlers, plain attrs ride
+    ObjectState; restore() rolls back to the last commit."""
+    from horovod_tpu.torch.elastic import TorchState
+
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = torch.optim.SGD(model.parameters(), lr=1.0, momentum=0.9)
+    state = TorchState(model=model, optimizer=opt, epoch=0, batch=0)
+
+    w0 = model.weight.detach().clone()
+    # Train a step, commit, train another, then roll back.
+    (model(torch.ones(1, 2))).sum().backward()
+    opt.step()
+    state.epoch = 1
+    state.commit()
+    w_committed = model.weight.detach().clone()
+    m_committed = {
+        k: v["momentum_buffer"].clone()
+        for k, v in opt.state_dict()["state"].items()}
+
+    (model(torch.ones(1, 2))).sum().backward()
+    opt.step()
+    state.epoch = 2
+    assert not torch.allclose(model.weight.detach(), w_committed)
+
+    state.restore()
+    torch.testing.assert_close(model.weight.detach(), w_committed)
+    assert state.epoch == 1
+    for k, v in opt.state_dict()["state"].items():
+        torch.testing.assert_close(v["momentum_buffer"], m_committed[k])
+    assert not torch.allclose(model.weight.detach(), w0)
+
+    # sync(): broadcast from rank 0 — identity under single controller,
+    # but exercises the full collective path.
+    state.sync()
+    torch.testing.assert_close(model.weight.detach(), w_committed)
