@@ -38,7 +38,7 @@ def _resolve_loss(loss):
 
 def _train_worker(store: Store, run_id: str, model, optimizer, loss,
                   epochs: int, batch_size: int, seed: int,
-                  shuffle: bool) -> Dict[str, Any]:
+                  shuffle: bool, has_val: bool = False) -> Dict[str, Any]:
     """Per-worker training loop (the reference's RemoteTrainer fn,
     spark/keras/remote.py): shard by rank, grads averaged across the
     world via the engine's grouped allreduce, rank 0 checkpoints."""
@@ -52,6 +52,13 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
     multiproc = nproc > 1
 
     X, y = store.read_obj(store.get_data_path(run_id, "train"))
+    # Validation presence travels as an explicit flag (NOT file
+    # existence — a reused run_id must not resurrect a previous fit's
+    # stale val set), and only rank 0 evaluates it: the other ranks'
+    # val_history is never consumed.
+    val = None
+    if has_val and rank == 0:
+        val = store.read_obj(store.get_data_path(run_id, "val"))
     # Rank shard (the reference trains each worker on its data partition).
     Xs, ys = (X[rank::nproc], y[rank::nproc]) if multiproc else (X, y)
 
@@ -76,9 +83,14 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
         updates, new_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_state
 
+    @jax.jit
+    def eval_loss(params, xb, yb):
+        return loss_fn(model.apply(params, xb), yb)
+
     nrows = len(Xs)
     steps = max(nrows // batch_size, 1)
     history: List[float] = []
+    val_history: List[float] = []
     shuffle_rng = np.random.default_rng(seed)
     for epoch in range(epochs):
         order = (shuffle_rng.permutation(nrows) if shuffle
@@ -100,19 +112,25 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
             params, opt_state = apply_updates(params, opt_state, reduced)
             epoch_loss += float(l)
         history.append(epoch_loss / steps)
+        if val is not None:
+            # Full validation set, identical on every rank (reference
+            # estimators report per-epoch val metrics).
+            val_history.append(float(eval_loss(params, val[0], val[1])))
         if rank == 0:
             ckpt = store.path_join(store.get_checkpoint_path(run_id),
                                    f"epoch_{epoch}.pkl")
             store.write_obj(ckpt, jax.tree.map(np.asarray, params))
             store.write_obj(
                 store.path_join(store.get_logs_path(run_id),
-                                "history.pkl"), history)
+                                "history.pkl"),
+                {"train": history, "val": val_history})
     if rank == 0:
         store.write_obj(
             store.path_join(store.get_checkpoint_path(run_id),
                             "final.pkl"),
             jax.tree.map(np.asarray, params))
-    return {"rank": rank, "history": history}
+    return {"rank": rank, "history": history,
+            "val_history": val_history}
 
 
 class TrainedModel:
@@ -121,23 +139,31 @@ class TrainedModel:
     inference over the trained params, loadable from the Store."""
 
     def __init__(self, model, params, store: Store, run_id: str,
-                 history: Optional[List[float]] = None):
+                 history: Optional[List[float]] = None,
+                 val_history: Optional[List[float]] = None):
         self.model = model
         self.params = params
         self.store = store
         self.run_id = run_id
         self.history = history or []
+        self.val_history = val_history or []
 
     @classmethod
     def load(cls, store: Store, run_id: str, model) -> "TrainedModel":
         params = store.read_obj(store.path_join(
             store.get_checkpoint_path(run_id), "final.pkl"))
-        history = []
+        history: List[float] = []
+        val_history: List[float] = []
         hist_path = store.path_join(store.get_logs_path(run_id),
                                     "history.pkl")
         if store.exists(hist_path):
-            history = store.read_obj(hist_path)
-        return cls(model, params, store, run_id, history)
+            logged = store.read_obj(hist_path)
+            if isinstance(logged, dict):
+                history = logged.get("train", [])
+                val_history = logged.get("val", [])
+            else:  # pre-validation log format
+                history = logged
+        return cls(model, params, store, run_id, history, val_history)
 
     def transform(self, X, batch_size: int = 1024) -> np.ndarray:
         """Batched inference (the Transformer.transform contract)."""
@@ -181,11 +207,16 @@ class Estimator:
         self.seed = seed
         self.worker_env = worker_env
 
-    def fit(self, X, y, executor=None) -> TrainedModel:
+    def fit(self, X, y, validation=None, executor=None) -> TrainedModel:
         """Train over the executor pool; returns the fitted transformer.
-        Pass ``executor`` to reuse a warm pool across fits (the
-        RayExecutor interactive pattern); otherwise a pool of
-        ``num_proc`` workers is started for this fit."""
+
+        ``validation``: a ``(Xv, yv)`` tuple, or a float fraction of the
+        training rows to hold out (the reference estimators' validation
+        col/fraction contract) — per-epoch val loss lands in
+        ``TrainedModel.val_history``. Pass ``executor`` to reuse a warm
+        pool across fits (the RayExecutor interactive pattern);
+        otherwise a pool of ``num_proc`` workers is started for this
+        fit."""
         import time
 
         from .executor import Executor
@@ -196,11 +227,27 @@ class Estimator:
         run_id = self.run_id or f"run_{int(time.time() * 1000):x}"
         X = np.asarray(X)
         y = np.asarray(y)
+        if isinstance(validation, float):
+            if not 0.0 < validation < 1.0:
+                raise ValueError("validation fraction must be in (0, 1)")
+            # Seeded random split — a head-slice of ordered data would
+            # hold out a biased sample (the reference estimators split
+            # randomly too).
+            idx = np.random.default_rng(self.seed).permutation(len(X))
+            n_val = max(int(len(X) * validation), 1)
+            val_idx, train_idx = idx[:n_val], idx[n_val:]
+            validation = (X[val_idx], y[val_idx])
+            X, y = X[train_idx], y[train_idx]
+        if validation is not None:
+            self.store.write_obj(
+                self.store.get_data_path(run_id, "val"),
+                (np.asarray(validation[0]), np.asarray(validation[1])))
         self.store.write_obj(self.store.get_data_path(run_id, "train"),
                              (X, y))
 
         args = (self.store, run_id, self.model, self.optimizer, self.loss,
-                self.epochs, self.batch_size, self.seed, self.shuffle)
+                self.epochs, self.batch_size, self.seed, self.shuffle,
+                validation is not None)
         if executor is not None:
             results = executor.run(_train_worker, args=args)
         else:
@@ -210,4 +257,5 @@ class Estimator:
 
         trained = TrainedModel.load(self.store, run_id, self.model)
         trained.history = results[0]["history"]
+        trained.val_history = results[0]["val_history"]
         return trained

@@ -63,10 +63,14 @@ def test_estimator_fit_transform_over_executor_pool(tmp_path):
                             "--xla_force_host_platform_device_count=1",
                         "HVD_TPU_FORCE_CPU_DEVICES": "1",
                     })
-    trained = est.fit(X, y)
+    trained = est.fit(X, y, validation=0.125)
 
     # Loss went down and the history was persisted through the Store.
     assert trained.history[-1] < trained.history[0] * 0.2
+    # Held-out fraction tracked per epoch (reference estimators report
+    # validation metrics) and improved too.
+    assert len(trained.val_history) == 30
+    assert trained.val_history[-1] < trained.val_history[0] * 0.5
     # transform(): host-side batched inference approximating the target.
     pred = trained.transform(X)
     assert pred.shape == (64, 1)
