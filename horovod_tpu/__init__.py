@@ -130,8 +130,13 @@ def allreduce(x, op: ReduceOp = ReduceOp.AVERAGE, name: Optional[str] = None,
 
 def grouped_allreduce(tensors, op: ReduceOp = ReduceOp.AVERAGE,
                       name: Optional[str] = None,
-                      compression=None):
-    return _ctx().engine.allreduce_tree(tensors, op, name, compression)
+                      compression=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    return _ctx().engine.allreduce_tree(
+        tensors, op, name, compression,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
 
 
 def allgather(x, name: Optional[str] = None):

@@ -609,9 +609,13 @@ class EagerEngine:
 
     def allreduce_tree(self, tree, op: C.ReduceOp = C.ReduceOp.AVERAGE,
                        name: Optional[str] = None,
-                       compression=None):
+                       compression=None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0):
         """Fused allreduce of a pytree of distributed tensors (the grouped /
-        fusion path: one collective per ≤threshold bucket)."""
+        fusion path: one collective per ≤threshold bucket). Pre/postscale
+        apply per leaf around the reduction (reference grouped allreduce
+        carries the same factors, EnqueueTensorAllreduces)."""
         if compression is None:
             compression = self._default_compression
         if self.join_active():
@@ -621,8 +625,8 @@ class EagerEngine:
             # fusion is a no-join-mode optimization here).
             leaves, treedef = jax.tree.flatten(tree)
             outs = [self._allreduce_join_mode(
-                        l, op, f"{name or 'grouped'}.leaf{i}", 1.0, 1.0,
-                        compression)
+                        l, op, f"{name or 'grouped'}.leaf{i}",
+                        prescale_factor, postscale_factor, compression)
                     for i, l in enumerate(leaves)]
             return jax.tree.unflatten(treedef, outs)
         full = self._begin(name, "grouped_allreduce")
@@ -653,13 +657,15 @@ class EagerEngine:
             # cache key changes and the bucket plan recompiles (the
             # reference re-fuses each cycle with the tuned threshold).
             threshold = self.fusion_threshold()
-            key = ("art", shapes, int(op), compression.__name__, threshold)
+            key = ("art", shapes, int(op), compression.__name__, threshold,
+                   prescale_factor, postscale_factor)
 
             def build():
                 def per_rank(*ls):
                     def one(flat):
                         w, ctx = compression.compress(flat)
-                        w = C.allreduce(w, op, self.axis)
+                        w = C.allreduce(w, op, self.axis,
+                                        prescale_factor, postscale_factor)
                         return compression.decompress(w, ctx)
                     squeezed = [l.reshape(l.shape[1:]) for l in ls]
                     out = fusion_lib.fused_apply(

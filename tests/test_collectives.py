@@ -254,3 +254,17 @@ def test_join_allreduce(hvd, rng):
     expected = x[active].sum(axis=0) / active.sum()
     for r in range(8):
         np.testing.assert_allclose(out[r], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_allreduce_pre_postscale(hvd):
+    """Grouped path carries pre/postscale factors per leaf (reference
+    EnqueueTensorAllreduces signature parity)."""
+    tree = {"a": np.full(4, 2.0, np.float32),
+            "b": np.full(2, 3.0, np.float32)}
+    out = hvd.grouped_allreduce(tree, op=hvd.Sum, name="gps",
+                                prescale_factor=0.5, postscale_factor=2.0)
+    a = np.asarray(out["a"].addressable_data(0)).reshape(-1)
+    b = np.asarray(out["b"].addressable_data(0)).reshape(-1)
+    # 2*0.5 summed over 8 ranks = 8, then *2 = 16; 3*0.5*8*2 = 24.
+    np.testing.assert_allclose(a, 16.0, rtol=1e-6)
+    np.testing.assert_allclose(b, 24.0, rtol=1e-6)
