@@ -1,0 +1,44 @@
+"""Examples must stay runnable — each runs as a subprocess on the
+8-virtual-device CPU mesh with tiny configs (the reference CI runs its
+examples the same way, docker-compose.test.yml)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.run([sys.executable] + args, env=env, cwd=_REPO,
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_mnist_example(tmp_path):
+    out = _run(["examples/mnist_train.py", "--epochs", "1",
+                "--batch-size", "64",
+                "--ckpt-dir", str(tmp_path / "ckpt")])
+    assert "loss" in out.lower()
+
+
+def test_join_example():
+    _run(["examples/join_uneven_data.py"])
+
+
+def test_estimator_example():
+    _run(["examples/estimator_fit.py", "--epochs", "3"])
+
+
+def test_adasum_example():
+    _run(["examples/adasum_resnet.py", "--tiny", "--steps", "2",
+          "--batch-size", "16"])
