@@ -49,9 +49,13 @@ def _emit(payload):
 # rc=0 with a real number whenever *any* platform works.
 
 ATTEMPTS = (
-    # (platform, extra flags, timeout_s, backoff_before_s)
+    # (platform, extra flags, timeout_s, backoff_before_s). The retry
+    # backoff is generous: a SIGKILLed predecessor can leave a stale
+    # device lease that takes a couple of minutes to expire (observed:
+    # a 30s backoff left attempt 2 hanging in backend init until its
+    # own timeout).
     ("tpu", [], 700, 0),
-    ("tpu", [], 500, 30),
+    ("tpu", [], 600, 150),
     ("cpu", [], 400, 0),
     ("cpu", ["--smoke"], 300, 0),
 )
@@ -215,7 +219,7 @@ def _run_benchmark(args, n):
         "unit": "samples/s" if is_bert else "img/s",
         "vs_baseline": round(val / baseline, 3),
     }
-    flops = _step_flops()
+    flops = _step_flops(n)
     if flops:
         # MFU against the chip's peak (bf16); evidence the number is
         # physically plausible, not a timing artifact.
@@ -231,7 +235,7 @@ def _run_benchmark(args, n):
     return result
 
 
-_LAST_LOWERED = {"lowered": None}
+_LAST_LOWERED = {"lowered": None, "compiled": None}
 
 _PEAK_BF16_FLOPS = {
     # Published peak dense bf16 FLOP/s per chip.
@@ -251,22 +255,26 @@ def _peak_flops():
     return None
 
 
-def _step_flops():
-    """FLOPs of one train step from XLA cost analysis of the compiled
-    step (captured at trace time by _make_stepper)."""
-    lowered = _LAST_LOWERED["lowered"]
-    if lowered is None:
-        return None
-    try:
-        # Pre-compile HLO cost — no second XLA compilation. Algebraic
-        # flops match the optimized program closely enough for MFU.
-        ca = lowered.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        return float(ca.get("flops", 0.0)) or None
-    except Exception as e:  # noqa: BLE001 — diagnostics only
-        _log(f"cost analysis unavailable: {e}")
-        return None
+def _step_flops(n):
+    """GLOBAL-step FLOPs from XLA cost analysis. The pre-compile
+    (lowered) analysis sees the program before SPMD partitioning, so its
+    count is already global; it returns None on the TPU backend, where
+    we instead read the compiled PER-DEVICE executable and scale by n."""
+    for key, scale in (("lowered", 1.0), ("compiled", float(n))):
+        obj = _LAST_LOWERED[key]
+        if obj is None:
+            continue
+        try:
+            ca = obj.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0] if ca else None
+            if ca:
+                flops = float(ca.get("flops", 0.0))
+                if flops:
+                    return flops * scale
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            _log(f"cost analysis ({key}) unavailable: {e}")
+    return None
 
 
 def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
@@ -298,14 +306,26 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
 
     carry = list(params_and_state)
 
+    # Fresh slate: a failed full-config run must not leak its executable
+    # into the smoke retry's MFU math.
+    _LAST_LOWERED["lowered"] = _LAST_LOWERED["compiled"] = None
+
+    # AOT-compile the step so MFU reads the REAL executable's cost
+    # analysis (pre-compile HLO analysis returns None on the TPU
+    # backend) — one compile total, same as calling the jit directly.
+    fn = train_step
     try:
-        # Trace-only (no XLA compile yet); feeds MFU reporting.
-        _LAST_LOWERED["lowered"] = train_step.lower(*carry, *extra_args)
+        lowered = train_step.lower(*carry, *extra_args)
+        _LAST_LOWERED["lowered"] = lowered
+        compiled = lowered.compile()
+        _LAST_LOWERED["compiled"] = compiled
+        fn = compiled
     except Exception as e:  # noqa: BLE001 — diagnostics only
-        _log(f"lowering for cost analysis failed: {e}")
+        _log(f"AOT compile for cost analysis failed ({e}); "
+             f"falling back to jit dispatch")
 
     def run_batch():
-        out = train_step(*carry, *extra_args)
+        out = fn(*carry, *extra_args)
         carry[:] = out[:-1]
         return out[-1]
 

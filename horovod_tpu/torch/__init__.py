@@ -37,6 +37,10 @@ local_rank = _hvd.local_rank
 local_size = _hvd.local_size
 Average, Sum, Adasum, Min, Max, Product = (
     _hvd.Average, _hvd.Sum, _hvd.Adasum, _hvd.Min, _hvd.Max, _hvd.Product)
+# object helpers (reference torch/functions.py broadcast_object /
+# allgather_object — cloudpickle over the engine's byte collectives)
+broadcast_object = _hvd.broadcast_object
+allgather_object = _hvd.allgather_object
 
 
 def _engine():

@@ -31,6 +31,13 @@ def test_mnist_example(tmp_path):
     assert "loss" in out.lower()
 
 
+def test_keras_mnist_example(tmp_path):
+    pytest.importorskip("keras")
+    out = _run(["examples/keras_mnist.py", "--epochs", "1",
+                "--ckpt", str(tmp_path / "m.keras")])
+    assert "checkpoint reloaded with DistributedAdam" in out
+
+
 def test_join_example():
     _run(["examples/join_uneven_data.py"])
 

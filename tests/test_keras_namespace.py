@@ -1,0 +1,125 @@
+"""Standalone keras namespace (reference horovod/keras: __init__.py
+surface, callbacks, elastic, load_model round-trip — test model follows
+reference test/parallel/test_keras.py in spirit, on the loopback tier)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import horovod_tpu.keras as hvdk  # noqa: E402
+
+pytestmark = pytest.mark.slow  # keras model build/fit is heavy
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    yield
+
+
+def _model():
+    m = keras.Sequential([keras.layers.Input((4,)),
+                          keras.layers.Dense(3, name="d")])
+    return m
+
+
+def test_basics_surface():
+    assert hvdk.is_initialized()
+    assert hvdk.size() == 8
+    assert 0 <= hvdk.rank() < hvdk.size()
+
+
+def test_allreduce_average_flag():
+    t = tf.constant([2.0, 4.0])
+    np.testing.assert_allclose(hvdk.allreduce(t).numpy(), [2.0, 4.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        hvdk.allreduce(t, average=False).numpy(), [16.0, 32.0], rtol=1e-6)
+
+
+def test_broadcast_global_variables_requires_model():
+    with pytest.raises(ValueError, match="BroadcastGlobalVariablesCallback"):
+        hvdk.broadcast_global_variables(0)
+
+
+def test_broadcast_global_variables_with_model():
+    m = _model()
+    m.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    before = [w.copy() for w in m.get_weights()]
+    hvdk.broadcast_global_variables(0, model=m)
+    for b, a in zip(before, m.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_distributed_optimizer_fit_and_callbacks(tmp_path):
+    m = _model()
+    opt = hvdk.DistributedOptimizer(keras.optimizers.Adam(0.01))
+    assert opt.__class__.__name__ == "DistributedAdam"
+    m.compile(optimizer=opt, loss="mse")
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    y = np.random.default_rng(1).normal(size=(16, 3)).astype(np.float32)
+    hist = m.fit(
+        x, y, epochs=2, batch_size=8, verbose=0,
+        callbacks=[hvdk.callbacks.BroadcastGlobalVariablesCallback(0),
+                   hvdk.callbacks.MetricAverageCallback()])
+    assert len(hist.history["loss"]) == 2
+
+
+def test_load_model_roundtrip(tmp_path):
+    m = _model()
+    m.compile(optimizer=hvdk.DistributedOptimizer(keras.optimizers.Adam(
+        learning_rate=0.025)), loss="mse")
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 3), np.float32)
+    m.fit(x, y, epochs=1, verbose=0)
+    path = str(tmp_path / "model.keras")
+    m.save(path)
+
+    m2 = hvdk.load_model(path)
+    assert m2.optimizer.__class__.__name__ == "DistributedAdam"
+    np.testing.assert_allclose(float(np.asarray(m2.optimizer.learning_rate)),
+                               0.025, rtol=1e-6)
+    for a, b in zip(m.get_weights(), m2.get_weights()):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    m2.fit(x, y, epochs=1, verbose=0)  # retrainable: allreduce still wired
+
+
+def test_load_model_wraps_plain_optimizer(tmp_path):
+    """A model saved BEFORE distributed wrapping must come back wrapped
+    (reference keras/__init__.py:176 registers every keras optimizer)."""
+    m = _model()
+    m.compile(optimizer=keras.optimizers.Adam(0.01), loss="mse")
+    m.fit(np.zeros((8, 4), np.float32), np.zeros((8, 3), np.float32),
+          epochs=1, verbose=0)
+    path = str(tmp_path / "plain.keras")
+    m.save(path)
+    m2 = hvdk.load_model(path)
+    assert m2.optimizer.__class__.__name__ == "DistributedAdam"
+
+
+def test_elastic_keras_state_and_callbacks():
+    m = _model()
+    m.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+    # Build optimizer slots so the state snapshots them (default
+    # optimizer comes from the compiled model, reference keras/elastic).
+    m.fit(np.zeros((4, 4), np.float32), np.zeros((4, 3), np.float32),
+          epochs=1, verbose=0)
+    state = hvdk.elastic.KerasState(m, batch=0, epoch=0)
+    assert state.optimizer is m.optimizer
+    assert state._saved_opt  # optimizer slots snapshotted
+    w0 = [w.copy() for w in m.get_weights()]
+
+    m.set_weights([w + 1.0 for w in w0])
+    state.restore()  # rollback to the committed snapshot
+    for a, b in zip(m.get_weights(), w0):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 3), np.float32)
+    m.fit(x, y, epochs=2, batch_size=4, verbose=0,
+          callbacks=[hvdk.elastic.CommitStateCallback(state, 2),
+                     hvdk.elastic.UpdateBatchStateCallback(state),
+                     hvdk.elastic.UpdateEpochStateCallback(state)])
+    assert state.epoch == 2
+    assert state.batch == 0  # reset at epoch end
