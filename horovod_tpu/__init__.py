@@ -33,7 +33,10 @@ from typing import Optional, Sequence
 import jax
 
 from .common import basics as _basics
-from .common.basics import init, is_initialized, shutdown
+from .common.basics import (ccl_built, cuda_built, ddl_built, gloo_built,
+                            gloo_enabled, init, is_initialized, mpi_built,
+                            mpi_enabled, mpi_threads_supported, nccl_built,
+                            rocm_built, shutdown, tpu_available, xla_built)
 from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
                                 NotInitializedError, StallError,
                                 TensorShapeMismatchError)
@@ -44,6 +47,7 @@ from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, broadcast_parameters)
 from .functions import allgather_object, broadcast_object, broadcast_variables
+from .process_set import ProcessSet
 
 __version__ = "0.1.0"
 
@@ -107,61 +111,94 @@ def rank_axis() -> str:
     return _ctx().config.rank_axis
 
 
+def add_process_set(process_set) -> ProcessSet:
+    """Register a ProcessSet (or rank list) and build its sub-mesh
+    engine. See process_set.py."""
+    return _ctx().add_process_set(process_set)
+
+
+def remove_process_set(process_set) -> None:
+    _ctx().remove_process_set(process_set)
+
+
 # -- eager collectives (rank-major distributed tensors) --------------------
 
-def scatter(stacked):
+def _engine(process_set=None):
+    """Route to the world engine or a registered process set's sub-mesh
+    engine; non-member processes fail loudly (the set's XLA program
+    spans member devices only — see process_set.py)."""
+    if process_set is None:
+        return _ctx().engine
+    if not process_set.included():
+        raise ValueError(
+            f"this process drives none of {process_set!r}; only member "
+            f"processes may call set-scoped collectives")
+    return process_set.engine
+
+
+def scatter(stacked, process_set=None):
     """Host-stacked (size, *shape) -> rank-sharded distributed tensor."""
-    return _ctx().engine.scatter(stacked)
+    return _engine(process_set).scatter(stacked)
 
 
-def gather(dt):
+def gather(dt, process_set=None):
     """Distributed tensor -> host numpy (size, *shape)."""
-    return _ctx().engine.gather(dt)
+    return _engine(process_set).gather(dt)
 
 
 def allreduce(x, op: ReduceOp = ReduceOp.AVERAGE, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=None):
+              compression=None, process_set=None):
     """``compression=None`` uses the configured default
     (HOROVOD_COMPRESSION_DTYPE env / compression_dtype knob)."""
-    return _ctx().engine.allreduce(x, op, name, prescale_factor,
-                                   postscale_factor, compression)
+    return _engine(process_set).allreduce(x, op, name, prescale_factor,
+                                          postscale_factor, compression)
 
 
 def grouped_allreduce(tensors, op: ReduceOp = ReduceOp.AVERAGE,
                       name: Optional[str] = None,
                       compression=None,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0):
-    return _ctx().engine.allreduce_tree(
+                      postscale_factor: float = 1.0,
+                      process_set=None):
+    return _engine(process_set).allreduce_tree(
         tensors, op, name, compression,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor)
 
 
-def allgather(x, name: Optional[str] = None):
-    return _ctx().engine.allgather(x, name)
+def allgather(x, name: Optional[str] = None, process_set=None):
+    return _engine(process_set).allgather(x, name)
 
 
-def broadcast(x, root_rank: int = 0, name: Optional[str] = None):
-    return _ctx().engine.broadcast(x, root_rank, name)
+def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    """With ``process_set``, ``root_rank`` is the GLOBAL rank of the
+    root (it must be a member); position within the set is resolved
+    here."""
+    if process_set is not None:
+        if root_rank not in process_set.ranks:
+            raise ValueError(f"root_rank {root_rank} is not a member of "
+                             f"{process_set!r}")
+        root_rank = process_set.ranks.index(root_rank)
+    return _engine(process_set).broadcast(x, root_rank, name)
 
 
-def alltoall(x, name: Optional[str] = None, splits=None):
+def alltoall(x, name: Optional[str] = None, splits=None, process_set=None):
     """Even all-to-all, or — with ``splits`` — the dynamic uneven variant
     where recv splits are negotiated through the controller (reference:
     operations.cc:1020-1081, controller.h:56-58 AlltoallGetRecvSplits).
     See EagerEngine.alltoallv for the two call conventions."""
-    return _ctx().engine.alltoall(x, name, splits=splits)
+    return _engine(process_set).alltoall(x, name, splits=splits)
 
 
 def reducescatter(x, op: ReduceOp = ReduceOp.SUM,
-                  name: Optional[str] = None):
-    return _ctx().engine.reducescatter(x, op, name)
+                  name: Optional[str] = None, process_set=None):
+    return _engine(process_set).reducescatter(x, op, name)
 
 
-def barrier():
-    _ctx().engine.barrier()
+def barrier(process_set=None):
+    _engine(process_set).barrier()
 
 
 def join() -> int:
@@ -274,4 +311,8 @@ __all__ = [
     "allgather_object", "broadcast_variables", "collective_ops",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",
     "StallError", "TensorShapeMismatchError", "__version__",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported", "gloo_built",
+    "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built", "tpu_available",
+    "ProcessSet", "add_process_set", "remove_process_set",
 ]

@@ -105,6 +105,7 @@ class Context:
         rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
         if config.elastic and rdv:
             self.host_update_notifier = self._make_host_update_notifier(rdv)
+        self._process_sets = []
         self._shutdown = False
 
     @staticmethod
@@ -181,9 +182,30 @@ class Context:
         the engine's resolver."""
         return self.engine.fusion_threshold()
 
+    def add_process_set(self, process_set):
+        """Register a ProcessSet (or plain rank list): builds its
+        sub-mesh eager engine over the member ranks' devices. Beyond the
+        reference era (general process sets arrived in later Horovod);
+        see process_set.py for the TPU-native design."""
+        from ..process_set import ProcessSet, _build_engine
+
+        if not isinstance(process_set, ProcessSet):
+            process_set = ProcessSet(process_set)
+        _build_engine(self, process_set)
+        self._process_sets.append(process_set)
+        return process_set
+
+    def remove_process_set(self, process_set) -> None:
+        process_set._engine = None
+        self._process_sets = [ps for ps in self._process_sets
+                              if ps is not process_set]
+
     def shutdown(self) -> None:
         if self._shutdown:
             return
+        for ps in self._process_sets:
+            ps._engine = None
+        self._process_sets = []
         self.stall.stop_watchdog()
         self.timeline.stop()
         self._shutdown = True
@@ -196,16 +218,19 @@ _context_lock = threading.Lock()
 _init_count = 0
 
 
-def init(comm: Optional[Sequence[int]] = None, **config_overrides) -> Context:
+def init(comm: Optional[Sequence[int]] = None, process_sets=None,
+         **config_overrides) -> Context:
     """Initialize the runtime (idempotent, like InitializeHorovodOnce).
 
     ``comm``: optional list of global rank ids forming a subset communicator
-    (reference basics.py:33-65). Config overrides win over env vars.
+    (reference basics.py:33-65). ``process_sets``: optional list of
+    ProcessSet objects (or rank lists) to register at startup. Config
+    overrides win over env vars.
     """
     global _context
     with _context_lock:
         if _context is not None and not _context._shutdown:
-            if comm is not None or config_overrides:
+            if comm is not None or process_sets or config_overrides:
                 # Silently returning the old context would make e.g. a
                 # subset communicator request produce full-world collectives
                 # — fail loudly instead (a bare init() stays idempotent).
@@ -217,6 +242,8 @@ def init(comm: Optional[Sequence[int]] = None, **config_overrides) -> Context:
         global _init_count
         _init_count += 1
         _context = Context(configure(**config_overrides), comm=comm)
+        for ps in process_sets or ():
+            _context.add_process_set(ps)
         atexit.register(shutdown)
         return _context
 
@@ -238,3 +265,101 @@ def context() -> Context:
     if _context is None or _context._shutdown:
         raise NotInitializedError()
     return _context
+
+
+# -- capability queries (reference basics.py:160-258) -----------------------
+#
+# The reference answers "what was compiled in" so scripts can pick code
+# paths (mpi_built/gloo_built/nccl_built/...). This framework has exactly
+# one data plane — XLA collectives over ICI/DCN — so the vendor-backend
+# queries honestly return False/0 and two TPU-native queries answer the
+# question migrating scripts are actually asking. All callable pre-init,
+# like the reference's.
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """Reference basics.py:160-178 raises when MPI isn't enabled — same
+    contract here, where it never is."""
+    raise ValueError("MPI is not part of the TPU data plane; collectives "
+                     "run on XLA over ICI/DCN (xla_built() == True)")
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> int:
+    return 0  # reference returns NCCL_VERSION_CODE or 0 (basics.py:218)
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """Always True: XLA collectives are the (only) data plane."""
+    return True
+
+
+def tpu_available() -> bool:
+    """True when a TPU backend is reachable right now. Pre-init this
+    probes in a SUBPROCESS: initializing the in-process JAX backend as a
+    side effect would silently pin the device count/platform before a
+    later init() could configure them (XLA_FLAGS forcing, jax_platforms)."""
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge._backends:  # already initialized: answer directly
+        try:
+            return any(d.platform == "tpu" for d in jax.devices())
+        except RuntimeError:
+            return False
+    import subprocess
+    import sys
+
+    code = ("import jax, sys; "
+            "sys.exit(0 if any(d.platform == 'tpu' for d in jax.devices())"
+            " else 1)")
+    try:
+        return subprocess.run([sys.executable, "-c", code], timeout=120,
+                              capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+# Single source of truth for the query surface the framework shims
+# re-export (tensorflow/torch/mxnet/keras all loop over this).
+CAPABILITY_QUERY_NAMES = (
+    "mpi_built", "mpi_enabled", "mpi_threads_supported", "gloo_built",
+    "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built", "xla_built", "tpu_available",
+)
+
+
+def export_capability_queries(namespace: dict) -> None:
+    """Copy every capability query into a shim's module globals."""
+    for _name in CAPABILITY_QUERY_NAMES:
+        namespace[_name] = globals()[_name]

@@ -35,6 +35,14 @@ Compression = _hvd.Compression
 allgather = _tf_shim.allgather
 broadcast = _tf_shim.broadcast
 broadcast_variables = _tf_shim.broadcast_variables
+join = _tf_shim.join
+# capability queries (reference keras re-exports of basics.py:160-258)
+from horovod_tpu.common.basics import (  # noqa: E402
+    CAPABILITY_QUERY_NAMES as _CQN,
+    export_capability_queries as _ecq,
+)
+
+_ecq(globals())
 
 
 def allreduce(value, name: Optional[str] = None, average: bool = True,
@@ -50,19 +58,28 @@ def allreduce(value, name: Optional[str] = None, average: bool = True,
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          device_dense: str = "", device_sparse: str = "",
                          compression=None, sparse_as_dense: bool = False,
+                         gradient_predivide_factor: float = 1.0,
+                         op: ReduceOp = Average,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = True):
-    """Reference keras/__init__.py:36-85 signature. ``device_dense`` /
-    ``device_sparse`` / ``compression`` are accepted for drop-in
-    compatibility but ignored: device placement is XLA's job on TPU, and
-    the host-boundary shim does not compress (docs/performance.md §5 —
-    compressed collectives live on the JAX surface)."""
+                         average_aggregated_gradients: bool = False):
+    """Reference keras/__init__.py:36-86 signature (op restricted to
+    Average/Sum there too; predivide splits averaging around the sum).
+    ``device_dense`` / ``device_sparse`` / ``compression`` are accepted
+    for drop-in compatibility but ignored: device placement is XLA's job
+    on TPU, and the host-boundary shim does not compress
+    (docs/performance.md §5 — compressed collectives live on the JAX
+    surface). The aggregation kwargs are this framework's extension with
+    the reference TF-surface defaults."""
     del name, device_dense, device_sparse, compression
+    if op not in (Average, Sum):
+        raise ValueError("op currently only supports Average and Sum "
+                         "(reference keras/__init__.py:73)")
     return _tf_shim.DistributedOptimizer(
-        optimizer, op=Average,
+        optimizer, op=op,
         backward_passes_per_step=backward_passes_per_step,
         average_aggregated_gradients=average_aggregated_gradients,
-        sparse_as_dense=sparse_as_dense)
+        sparse_as_dense=sparse_as_dense,
+        gradient_predivide_factor=gradient_predivide_factor)
 
 
 def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
@@ -116,6 +133,10 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
             seen[cls.__name__] = cls
     for cls in custom_optimizers or ():
         seen[cls.__name__] = cls
+        # Custom classes aren't in keras' registry, so deserialization
+        # DOES consult custom_objects for the plain name — register the
+        # wrap there so an unwrapped-save reloads wrapped.
+        mapping.setdefault(cls.__name__, _wrap_optimizer_class(cls))
     for cls_name, cls in seen.items():
         # Covers models saved AFTER wrapping: "DistributedAdam" is not a
         # keras-module name, so deserialization consults custom_objects.
@@ -139,5 +160,6 @@ __all__ = [
     "local_size", "cross_rank", "cross_size", "Average", "Sum", "Adasum",
     "Min", "Max", "Product", "Compression", "allreduce", "allgather",
     "broadcast", "broadcast_variables", "broadcast_global_variables",
-    "DistributedOptimizer", "load_model", "callbacks", "elastic",
+    "DistributedOptimizer", "load_model", "callbacks", "elastic", "join",
+    *_CQN,
 ]

@@ -1,0 +1,98 @@
+"""Named subset communicators — process sets.
+
+The reference era pinned by SURVEY.md has exactly one subset mechanism:
+``hvd.init(comm=[ranks])`` re-scopes the WHOLE world (basics.py:33-65,
+operations.cc:692-700); general process sets arrived in later Horovod.
+This framework provides them TPU-natively because the machinery is
+nearly free here: a process set is a sub-``Mesh`` over the member ranks'
+devices carrying its own eager engine (compile cache, fusion, handles),
+sharing the context's timeline/stall instrumentation.
+
+Every collective accepts ``process_set=``:
+
+    evens = hvd.add_process_set(hvd.ProcessSet([0, 2, 4, 6]))
+    out = hvd.allreduce(x, process_set=evens)   # reduces over 4 ranks
+
+Multi-process caveat (same as Horovod's): only member processes may call
+a set-scoped collective — the XLA program spans member devices only.
+Non-member calls raise ``ValueError`` up front. Set-scoped collectives
+skip the cross-process controller negotiation (the guard rail assumes
+the full world participates); program-order divergence *within a set*
+is the caller's responsibility, as it is in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+
+class ProcessSet:
+    """An ordered, de-duplicated set of global ranks. Inert until
+    registered via ``hvd.add_process_set`` (or ``init(process_sets=)``),
+    which attaches the sub-mesh engine."""
+
+    def __init__(self, ranks: Sequence[int]):
+        rs: Tuple[int, ...] = tuple(sorted({int(r) for r in ranks}))
+        if not rs:
+            raise ValueError("a ProcessSet needs at least one rank")
+        self.ranks = rs
+        self._engine = None
+
+    # -- registry-backed surface -------------------------------------------
+
+    @property
+    def engine(self):
+        if self._engine is None:
+            raise ValueError(
+                f"{self!r} is not registered; call hvd.add_process_set "
+                f"(after hvd.init) first")
+        return self._engine
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank WITHIN the set (reference ProcessSet.rank
+        semantics), or -1 when not a member. Single-controller SPMD
+        drives every rank, so the canonical (smallest-member) position
+        is 0."""
+        for pos, r in enumerate(self.ranks):
+            if r in self._driven_ranks():
+                return pos
+        return -1
+
+    def included(self) -> bool:
+        return self.rank() >= 0
+
+    def _driven_ranks(self):
+        from .common import basics
+
+        return set(basics.context().topology.local_ranks())
+
+    def __repr__(self) -> str:
+        state = "registered" if self._engine is not None else "unregistered"
+        return f"ProcessSet(ranks={list(self.ranks)}, {state})"
+
+
+def _build_engine(ctx, ps: ProcessSet):
+    """Attach a sub-mesh eager engine for the member ranks' devices."""
+    from .common import topology as topo_lib
+    from .ops.eager import EagerEngine
+
+    world = ctx.topology.size
+    bad = [r for r in ps.ranks if not 0 <= r < world]
+    if bad:
+        raise ValueError(f"process set ranks {bad} outside world size "
+                         f"{world}")
+    devices = [ctx.topology.devices[r] for r in ps.ranks]
+    missing = [r for r, d in zip(ps.ranks, devices)
+               if d.process_index != ctx.topology.process_index]
+    sub_topo = topo_lib.discover(devices=devices)
+    mesh = topo_lib.build_mesh(sub_topo, ctx.config.rank_axis)
+    ps._engine = EagerEngine(mesh, ctx.config.rank_axis, ctx.config,
+                             timeline=ctx.timeline,
+                             stall_inspector=ctx.stall,
+                             hier_mesh=None, controller=None,
+                             autotuner=None)
+    ps._remote_members = bool(missing)
+    return ps

@@ -35,6 +35,12 @@ cross_size = _hvd.cross_size
 Average, Sum, Adasum, Min, Max, Product = (
     _hvd.Average, _hvd.Sum, _hvd.Adasum, _hvd.Min, _hvd.Max, _hvd.Product)
 Compression = _hvd.Compression
+# graceful early exit (reference tensorflow join, operations.cc:1085-1109)
+join = _hvd.join
+# capability queries (reference TF re-exports of basics.py:160-258)
+from horovod_tpu.common.basics import export_capability_queries as _ecq
+
+_ecq(globals())
 
 
 def _tf():
@@ -125,18 +131,23 @@ def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
 
 
 def _grouped_allreduce_np(arrs, op: ReduceOp, name: Optional[str],
-                          compression=None):
+                          compression=None, prescale_factor=1.0,
+                          postscale_factor=1.0):
     """Fused grouped reduction via the engine's bucketed allreduce_tree
     (one collective per fusion bucket, not one per tensor)."""
     e = _engine()
     dts = [e.replicate(a) for a in arrs]
-    outs = e.allreduce_tree(dts, op, name, compression)
+    outs = e.allreduce_tree(dts, op, name, compression,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
     return [_to_host(o).astype(a.dtype, copy=False)
             for o, a in zip(outs, arrs)]
 
 
 def grouped_allreduce(tensors, op: ReduceOp = Average,
-                      name: Optional[str] = None, compression=None):
+                      name: Optional[str] = None, compression=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
     tf = _tf()
     tensors = list(tensors)
     if not tensors:
@@ -144,13 +155,15 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
     if any(tf.is_tensor(t) for t in tensors) and not tf.executing_eagerly():
         outs = tf.py_function(
             lambda *ts: _grouped_allreduce_np(
-                [t.numpy() for t in ts], op, name, compression),
+                [t.numpy() for t in ts], op, name, compression,
+                prescale_factor, postscale_factor),
             tensors, [t.dtype for t in tensors])
         for o, t in zip(outs, tensors):
             o.set_shape(t.shape)
         return list(outs)
     return [tf.convert_to_tensor(o) for o in _grouped_allreduce_np(
-        [np.asarray(t) for t in tensors], op, name, compression)]
+        [np.asarray(t) for t in tensors], op, name, compression,
+        prescale_factor, postscale_factor)]
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -249,11 +262,17 @@ def DistributedGradientTape(tape, op: ReduceOp = Average,
 # -- Keras optimizer wrapper (reference _keras/__init__.py:28-135) ----------
 
 def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
-                           sparse_as_dense=False):
+                           sparse_as_dense=False,
+                           gradient_predivide_factor=1.0):
     """Reduce a grads_and_vars list: dense grads through ONE fused
     grouped allreduce, IndexedSlices through the sparse-as-allgather
-    path (reference _make_allreduce_grads_fn semantics)."""
+    path (reference _make_allreduce_grads_fn semantics, incl. the
+    predivide split: scale by 1/f before the SUM and f/size after)."""
     tf = _tf()
+    pre = post = 1.0
+    if gradient_predivide_factor != 1.0:
+        f = gradient_predivide_factor
+        reduce_op, pre, post = Sum, 1.0 / f, f / size()
     gv = [list(x) for x in gv]
     dense = [(i, g) for i, (g, _) in enumerate(gv)
              if g is not None and not isinstance(g, tf.IndexedSlices)]
@@ -262,7 +281,9 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
     if dense:
         reduced = grouped_allreduce([g for _, g in dense],
                                     op=reduce_op,
-                                    name=f"{name_prefix}.grads")
+                                    name=f"{name_prefix}.grads",
+                                    prescale_factor=pre,
+                                    postscale_factor=post)
     else:
         reduced = []
     for (i, _), r in zip(dense, reduced):
@@ -277,8 +298,9 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
 def DistributedOptimizer(optimizer, op: ReduceOp = Average,
                          name: Optional[str] = None,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = True,
-                         sparse_as_dense: bool = False):
+                         average_aggregated_gradients: bool = False,
+                         sparse_as_dense: bool = False,
+                         gradient_predivide_factor: float = 1.0):
     """Wrap a keras optimizer so apply_gradients allreduces first. Like
     the reference (_keras/__init__.py:28-135 create_distributed_optimizer)
     this dynamically subclasses the optimizer's own class and rebuilds it
@@ -290,7 +312,14 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
     LocalGradientAggregationHelper, reference
     tensorflow/gradient_aggregation.py:16 /
     gradient_aggregation_eager.py); ``average_aggregated_gradients``
-    divides the aggregate by the pass count."""
+    divides the aggregate by the pass count (reference default False:
+    aggregated passes SUM unless asked to average).
+    ``gradient_predivide_factor`` splits averaging around the sum —
+    1/f before, f/size after (reference tensorflow/__init__.py:487)."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor requires op=Average (reference "
+            "tensorflow/__init__.py:507)")
     cls = optimizer.__class__
     reduce_op = op
     k = int(backward_passes_per_step)
@@ -329,7 +358,8 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
             self._hvd_agg = {}
             self._hvd_agg_count = 0
         reduced = _reduce_grads_and_vars(gv, reduce_op, "opt",
-                                         sparse_as_dense)
+                                         sparse_as_dense,
+                                         gradient_predivide_factor)
         return super(dist_cls, self).apply_gradients(reduced, *args,
                                                      **kwargs)
 
@@ -380,7 +410,7 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
                 grads[i] = tf.convert_to_tensor(acc) * scale
             reduced = _reduce_grads_and_vars(
                 list(zip(grads, variables)), reduce_op, "opt",
-                sparse_as_dense)
+                sparse_as_dense, gradient_predivide_factor)
             result = super(dist_cls, self).apply_gradients(
                 reduced, *fwd_args, **fwd_kwargs)
             # Order the zeroing after the apply for v1-graph fetches

@@ -41,6 +41,12 @@ Average, Sum, Adasum, Min, Max, Product = (
 # allgather_object — cloudpickle over the engine's byte collectives)
 broadcast_object = _hvd.broadcast_object
 allgather_object = _hvd.allgather_object
+# graceful early exit (reference torch/mpi_ops.py:631-644 join)
+join = _hvd.join
+# capability queries (reference torch re-exports of basics.py:160-258)
+from horovod_tpu.common.basics import export_capability_queries as _ecq
+
+_ecq(globals())
 
 
 def _engine():

@@ -66,3 +66,23 @@ def test_timeline_with_xprof_trace(hvd, tmp_path):
     events = json.load(open(tl))["traceEvents"]
     assert events
     assert os.listdir(xprof)  # jax.profiler wrote its trace directory
+
+
+def test_capability_queries(hvd):
+    """Reference basics.py:160-258 query surface: vendor backends are
+    honestly absent, XLA is the (only) data plane, and the same answers
+    are re-exported on every framework shim."""
+    assert hvd.xla_built() is True
+    assert hvd.mpi_built() is False and hvd.mpi_enabled() is False
+    assert hvd.gloo_built() is False and hvd.gloo_enabled() is False
+    assert hvd.nccl_built() == 0
+    assert not hvd.ddl_built() and not hvd.ccl_built()
+    assert not hvd.cuda_built() and not hvd.rocm_built()
+    with pytest.raises(ValueError, match="XLA"):
+        hvd.mpi_threads_supported()
+    assert hvd.tpu_available() is False  # CPU loopback mesh
+
+    import horovod_tpu.torch as hvd_torch
+
+    assert hvd_torch.xla_built() is True and not hvd_torch.mpi_built()
+    assert hvd_torch.join is not None

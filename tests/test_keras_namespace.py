@@ -85,6 +85,30 @@ def test_load_model_roundtrip(tmp_path):
     m2.fit(x, y, epochs=1, verbose=0)  # retrainable: allreduce still wired
 
 
+def test_capability_queries_and_op_validation():
+    assert hvdk.xla_built() is True and hvdk.mpi_built() is False
+    assert hvdk.nccl_built() == 0
+    with pytest.raises(ValueError, match="Average and Sum"):
+        hvdk.DistributedOptimizer(keras.optimizers.SGD(0.1), op=hvdk.Max)
+
+
+def test_load_model_wraps_custom_optimizer(tmp_path):
+    """An unregistered custom optimizer saved unwrapped must reload
+    wrapped via custom_optimizers (reference keras/__init__.py:176)."""
+
+    class MyOpt(keras.optimizers.SGD):
+        pass
+
+    m = _model()
+    m.compile(optimizer=MyOpt(0.1), loss="mse")
+    m.fit(np.zeros((8, 4), np.float32), np.zeros((8, 3), np.float32),
+          epochs=1, verbose=0)
+    path = str(tmp_path / "custom.keras")
+    m.save(path)
+    m2 = hvdk.load_model(path, custom_optimizers=[MyOpt])
+    assert type(m2.optimizer).__name__ == "DistributedMyOpt"
+
+
 def test_load_model_wraps_plain_optimizer(tmp_path):
     """A model saved BEFORE distributed wrapping must come back wrapped
     (reference keras/__init__.py:176 registers every keras optimizer)."""

@@ -1,0 +1,106 @@
+"""Process sets — named subset communicators over sub-meshes (beyond the
+pinned reference era, which only had init(comm=[ranks]); the design note
+is in horovod_tpu/process_set.py)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.process_set import ProcessSet
+
+
+@pytest.fixture()
+def evens(hvd):
+    ps = hvd.add_process_set(hvd.ProcessSet([0, 2, 4, 6]))
+    yield ps
+    hvd.remove_process_set(ps)
+
+
+def test_registration_surface(hvd, evens):
+    assert evens.size() == 4
+    assert evens.ranks == (0, 2, 4, 6)
+    assert evens.included()  # single-controller drives every rank
+    assert evens.rank() == 0
+    assert "registered" in repr(evens)
+
+
+def test_rank_list_shorthand(hvd):
+    ps = hvd.add_process_set([1, 3])
+    try:
+        assert isinstance(ps, ProcessSet) and ps.size() == 2
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_unregistered_set_fails_loudly(hvd):
+    ps = hvd.ProcessSet([0, 1])
+    with pytest.raises(ValueError, match="not registered"):
+        hvd.allreduce(np.ones(2, np.float32), process_set=ps)
+
+
+def test_out_of_range_ranks_rejected(hvd):
+    with pytest.raises(ValueError, match="outside world"):
+        hvd.add_process_set([0, 99])
+    with pytest.raises(ValueError, match="at least one"):
+        hvd.ProcessSet([])
+
+
+def test_allreduce_over_subset(hvd, evens, rng):
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    out = hvd.gather(
+        hvd.allreduce(hvd.scatter(x, process_set=evens), op=hvd.Sum,
+                      process_set=evens),
+        process_set=evens)
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (4, 1)), rtol=1e-5)
+
+
+def test_subset_and_world_coexist(hvd, evens, rng):
+    """A set-scoped reduce must not disturb world collectives (separate
+    engines, separate compile caches)."""
+    xw = rng.normal(size=(8, 4)).astype(np.float32)
+    xs = rng.normal(size=(4, 4)).astype(np.float32)
+    w = hvd.gather(hvd.allreduce(hvd.scatter(xw), op=hvd.Average))
+    s = hvd.gather(hvd.allreduce(hvd.scatter(xs, process_set=evens),
+                                 op=hvd.Average, process_set=evens),
+                   process_set=evens)
+    np.testing.assert_allclose(w, np.tile(xw.mean(0), (8, 1)), rtol=1e-5)
+    np.testing.assert_allclose(s, np.tile(xs.mean(0), (4, 1)), rtol=1e-5)
+
+
+def test_broadcast_global_root_translation(hvd, evens, rng):
+    x = rng.normal(size=(4, 3)).astype(np.float32)
+    out = hvd.gather(hvd.broadcast(hvd.scatter(x, process_set=evens),
+                                   root_rank=4, process_set=evens),
+                     process_set=evens)
+    # global rank 4 is position 2 within (0, 2, 4, 6)
+    np.testing.assert_allclose(out, np.tile(x[2], (4, 1)), rtol=1e-6)
+    with pytest.raises(ValueError, match="not a member"):
+        hvd.broadcast(np.ones(2, np.float32), root_rank=3,
+                      process_set=evens)
+
+
+def test_allgather_and_alltoall_over_subset(hvd, evens, rng):
+    x = rng.normal(size=(4, 2, 3)).astype(np.float32)
+    got = hvd.gather(hvd.allgather(hvd.scatter(x, process_set=evens),
+                                   process_set=evens), process_set=evens)
+    want = x.reshape(8, 3)
+    for row in got:
+        np.testing.assert_allclose(row, want, rtol=1e-6)
+
+    a2a = rng.normal(size=(4, 4, 2)).astype(np.float32)
+    got = hvd.gather(hvd.alltoall(hvd.scatter(a2a, process_set=evens),
+                                  process_set=evens), process_set=evens)
+    np.testing.assert_allclose(got, a2a.transpose(1, 0, 2), rtol=1e-6)
+
+
+def test_remove_then_use_fails(hvd):
+    ps = hvd.add_process_set([0, 1, 2])
+    hvd.remove_process_set(ps)
+    with pytest.raises(ValueError, match="not registered"):
+        hvd.allreduce(np.ones(2, np.float32), process_set=ps)
+
+
+def test_init_with_process_sets_requires_fresh_runtime(hvd):
+    with pytest.raises(ValueError, match="already initialized"):
+        import horovod_tpu
+
+        horovod_tpu.init(process_sets=[[0, 1]])

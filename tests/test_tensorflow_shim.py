@@ -158,12 +158,44 @@ def test_optimizer_backward_passes_aggregation():
     v = tf.Variable([2.0, 2.0])
     opt = hvdtf.DistributedOptimizer(
         tf.keras.optimizers.SGD(learning_rate=1.0),
-        backward_passes_per_step=2)
+        backward_passes_per_step=2, average_aggregated_gradients=True)
     g = tf.constant([1.0, 1.0])
     assert opt.apply_gradients([(g, v)]) is None   # banked, no apply
     np.testing.assert_allclose(v.numpy(), [2.0, 2.0])
     opt.apply_gradients([(3.0 * g, v)])            # (1+3)/2 = 2 applied
     np.testing.assert_allclose(v.numpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_optimizer_aggregation_sums_by_default():
+    """Reference default average_aggregated_gradients=False: the k banked
+    passes SUM at the flush (gradient_aggregation.py:42)."""
+    import tensorflow as tf
+
+    v = tf.Variable([4.0, 4.0])
+    opt = hvdtf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        backward_passes_per_step=2)
+    opt.apply_gradients([(tf.constant([1.0, 1.0]), v)])
+    opt.apply_gradients([(tf.constant([3.0, 3.0]), v)])  # 1+3 = 4 applied
+    np.testing.assert_allclose(v.numpy(), [0.0, 0.0], atol=1e-6)
+
+
+def test_optimizer_gradient_predivide_factor():
+    """Predivide splits averaging around the sum: 1/f before, f/size
+    after (reference tensorflow/__init__.py:487) — net effect on a
+    replicated world equals the plain average."""
+    import tensorflow as tf
+
+    v = tf.Variable([2.0, 2.0])
+    opt = hvdtf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=1.0),
+        gradient_predivide_factor=4.0)
+    opt.apply_gradients([(tf.constant([1.0, 1.0]), v)])
+    np.testing.assert_allclose(v.numpy(), [1.0, 1.0], atol=1e-6)
+    with pytest.raises(ValueError, match="op=Average"):
+        hvdtf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=1.0),
+            gradient_predivide_factor=2.0, op=hvdtf.Sum)
 
 
 def test_adasum_delta_optimizer():
@@ -214,7 +246,7 @@ def test_optimizer_graph_mode_aggregation():
     v = tf.Variable([2.0, 2.0])
     opt = hvdtf.DistributedOptimizer(
         tf.keras.optimizers.SGD(learning_rate=1.0),
-        backward_passes_per_step=2)
+        backward_passes_per_step=2, average_aggregated_gradients=True)
 
     @tf.function
     def step(g):
