@@ -196,6 +196,18 @@ class Context:
         return process_set
 
     def remove_process_set(self, process_set) -> None:
+        from ..process_set import ProcessSet
+
+        if not isinstance(process_set, ProcessSet):
+            # Symmetric with add_process_set's rank-list shorthand:
+            # resolve to the registered set with those ranks.
+            ranks = tuple(sorted({int(r) for r in process_set}))
+            matches = [ps for ps in self._process_sets
+                       if ps.ranks == ranks]
+            if not matches:
+                raise ValueError(f"no registered process set with ranks "
+                                 f"{list(ranks)}")
+            process_set = matches[0]
         process_set._engine = None
         self._process_sets = [ps for ps in self._process_sets
                               if ps is not process_set]
@@ -337,6 +349,9 @@ def tpu_available() -> bool:
             return any(d.platform == "tpu" for d in jax.devices())
         except RuntimeError:
             return False
+    global _tpu_probe_result
+    if _tpu_probe_result is not None:  # subprocess probe is expensive —
+        return _tpu_probe_result       # the answer can't change in-process
     import subprocess
     import sys
 
@@ -344,10 +359,15 @@ def tpu_available() -> bool:
             "sys.exit(0 if any(d.platform == 'tpu' for d in jax.devices())"
             " else 1)")
     try:
-        return subprocess.run([sys.executable, "-c", code], timeout=120,
-                              capture_output=True).returncode == 0
+        _tpu_probe_result = subprocess.run(
+            [sys.executable, "-c", code], timeout=120,
+            capture_output=True).returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        _tpu_probe_result = False
+    return _tpu_probe_result
+
+
+_tpu_probe_result: Optional[bool] = None
 
 
 # Single source of truth for the query surface the framework shims

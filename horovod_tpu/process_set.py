@@ -85,8 +85,11 @@ def _build_engine(ctx, ps: ProcessSet):
         raise ValueError(f"process set ranks {bad} outside world size "
                          f"{world}")
     devices = [ctx.topology.devices[r] for r in ps.ranks]
-    missing = [r for r, d in zip(ps.ranks, devices)
-               if d.process_index != ctx.topology.process_index]
+    # A set MAY span processes (multi-controller JAX runs global
+    # computations over meshes with non-addressable devices) — but then
+    # EVERY member process must register the same set and join each
+    # set-scoped call, the same lockstep contract as any multi-process
+    # collective here.
     sub_topo = topo_lib.discover(devices=devices)
     mesh = topo_lib.build_mesh(sub_topo, ctx.config.rank_axis)
     ps._engine = EagerEngine(mesh, ctx.config.rank_axis, ctx.config,
@@ -94,5 +97,4 @@ def _build_engine(ctx, ps: ProcessSet):
                              stall_inspector=ctx.stall,
                              hier_mesh=None, controller=None,
                              autotuner=None)
-    ps._remote_members = bool(missing)
     return ps

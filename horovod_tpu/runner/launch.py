@@ -231,8 +231,9 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="hvdtpurun",
         description="Launch a horovod_tpu training job "
                     "(horovodrun equivalent for TPU).")
-    p.add_argument("-np", "--num-proc", type=int, default=1,
-                   help="number of worker processes")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of worker processes (default 1; on a TPU "
+                        "pod an UNSET -np auto-scales to the pod's chips)")
     p.add_argument("-H", "--hosts", default=None,
                    help="host list, e.g. host1:4,host2:4")
     p.add_argument("--hostfile", default=None,
@@ -371,6 +372,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         print(__version__)
         return 0
     args = apply_config_file(args, argv)
+    # An explicit -np 1 must survive pod auto-scaling; only an UNSET -np
+    # may be grown to the pod size below.
+    np_unset = args.num_proc is None
+    if np_unset:
+        args.num_proc = 1
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -403,6 +409,29 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                 # a working local launch into a crash.
                 print(f"hvdtpurun: ignoring LSF environment ({e}); "
                       "launching locally", file=sys.stderr)
+        if host_infos is None:
+            # On a Cloud TPU pod VM the platform publishes the full
+            # topology as env metadata — no -H/--hostfile needed
+            # (tpu_pod.py; SURVEY §7.6 "discovers TPU pod topology").
+            from . import tpu_pod
+
+            try:
+                pod = tpu_pod.discover_pod()
+            except ValueError as e:
+                # Stale/inconsistent pod metadata must not turn a working
+                # local launch into a crash (same contract as LSF above).
+                print(f"hvdtpurun: ignoring TPU pod environment ({e}); "
+                      "launching locally", file=sys.stderr)
+                pod = None
+            if pod is not None:
+                host_infos = pod.host_infos()
+                if np_unset and pod.num_chips > 1:
+                    print(f"hvdtpurun: TPU pod detected "
+                          f"({pod.accelerator_type or 'unknown type'}, "
+                          f"{pod.num_hosts} hosts x {pod.chips_per_host} "
+                          f"chips); running -np {pod.num_chips}",
+                          file=sys.stderr)
+                    args.num_proc = pod.num_chips
 
     if host_infos is not None:
         # Validate np against available slots (reference: horovodrun errors

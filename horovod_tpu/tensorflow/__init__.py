@@ -270,8 +270,13 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
     predivide split: scale by 1/f before the SUM and f/size after)."""
     tf = _tf()
     pre = post = 1.0
+    sparse_op = reduce_op
     if gradient_predivide_factor != 1.0:
         f = gradient_predivide_factor
+        # Dense path: split the average around a SUM. The sparse
+        # (allgather) path keeps the original AVERAGE — predivide is a
+        # dense-reduction scaling trick and must not turn gathered
+        # slices into an unscaled sum.
         reduce_op, pre, post = Sum, 1.0 / f, f / size()
     gv = [list(x) for x in gv]
     dense = [(i, g) for i, (g, _) in enumerate(gv)
@@ -289,7 +294,7 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
     for (i, _), r in zip(dense, reduced):
         gv[i][0] = r
     for i, g in sparse:
-        gv[i][0] = allreduce(g, op=reduce_op,
+        gv[i][0] = allreduce(g, op=sparse_op,
                              name=f"{name_prefix}.sparse{i}",
                              sparse_as_dense=sparse_as_dense)
     return [tuple(x) for x in gv]

@@ -104,3 +104,12 @@ def test_init_with_process_sets_requires_fresh_runtime(hvd):
         import horovod_tpu
 
         horovod_tpu.init(process_sets=[[0, 1]])
+
+
+def test_remove_by_rank_list(hvd):
+    ps = hvd.add_process_set([0, 5])
+    hvd.remove_process_set([5, 0])  # order-insensitive resolution
+    with pytest.raises(ValueError, match="not registered"):
+        ps.engine
+    with pytest.raises(ValueError, match="no registered process set"):
+        hvd.remove_process_set([0, 5])

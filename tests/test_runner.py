@@ -358,3 +358,90 @@ def test_run_ssh_fans_out(tmp_path, fake_ssh):
     for pid in ("0", "1"):
         n, coord = (out_dir / pid).read_text().split()
         assert n == "2" and coord.startswith("hostA:")
+
+
+# -- TPU pod discovery (runner/tpu_pod.py) ----------------------------------
+
+def test_tpu_pod_discovery_from_env():
+    from horovod_tpu.runner import tpu_pod
+
+    env = {"TPU_WORKER_HOSTNAMES": "t1k-w0, t1k-w1,t1k-w2,t1k-w3",
+           "TPU_WORKER_ID": "2",
+           "TPU_ACCELERATOR_TYPE": "v5litepod-16"}
+    pod = tpu_pod.discover_pod(env)
+    assert pod.num_hosts == 4 and pod.worker_id == 2
+    assert pod.chips_per_host == 4 and pod.num_chips == 16
+    infos = pod.host_infos()
+    assert [h.hostname for h in infos] == ["t1k-w0", "t1k-w1", "t1k-w2",
+                                           "t1k-w3"]
+    assert all(h.slots == 4 for h in infos)
+
+
+def test_tpu_pod_chips_from_bounds_and_cores():
+    from horovod_tpu.runner import tpu_pod
+
+    env = {"TPU_WORKER_HOSTNAMES": "a,b",
+           "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1"}
+    assert tpu_pod.discover_pod(env).chips_per_host == 4
+    # v3 counts CORES in the accelerator suffix (2 per chip)
+    env = {"TPU_WORKER_HOSTNAMES": "a,b,c,d",
+           "TPU_ACCELERATOR_TYPE": "v3-32"}
+    assert tpu_pod.discover_pod(env).chips_per_host == 4
+
+
+def test_tpu_pod_absent_and_invalid():
+    from horovod_tpu.runner import tpu_pod
+
+    assert tpu_pod.discover_pod({}) is None
+    with pytest.raises(ValueError, match="TPU_WORKER_ID"):
+        tpu_pod.discover_pod({"TPU_WORKER_HOSTNAMES": "a,b",
+                              "TPU_WORKER_ID": "5"})
+
+
+def test_launch_autodetects_tpu_pod(monkeypatch, tmp_path):
+    """hvdtpurun with no -H on a pod VM derives hosts + np from the env
+    metadata and takes the ssh fan-out path."""
+    import horovod_tpu.runner.launch as launch
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "podw0,podw1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    seen = {}
+
+    def fake_run_ssh(host_infos, command, env_extra, np, *a, **kw):
+        seen["hosts"] = [(h.hostname, h.slots) for h in host_infos]
+        seen["np"] = np
+        return 0
+
+    monkeypatch.setattr(launch, "run_ssh", fake_run_ssh)
+    rc = launch.run_commandline(["python", "-c", "pass"])
+    assert rc == 0
+    assert seen["hosts"] == [("podw0", 4), ("podw1", 4)]
+    assert seen["np"] == 8
+
+
+def test_launch_explicit_np1_survives_pod(monkeypatch):
+    """-np 1 given explicitly must NOT be auto-scaled to the pod size,
+    and malformed pod metadata falls back to a local launch."""
+    import horovod_tpu.runner.launch as launch
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "podw0,podw1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    seen = {}
+
+    def fake_run_ssh(host_infos, command, env_extra, np, *a, **kw):
+        seen["np"] = np
+        return 0
+
+    monkeypatch.setattr(launch, "run_ssh", fake_run_ssh)
+    assert launch.run_commandline(["-np", "1", "python", "-c",
+                                   "pass"]) == 0
+    assert seen["np"] == 1
+
+    monkeypatch.setenv("TPU_WORKER_ID", "7")  # out of range → local
+    calls = {}
+    monkeypatch.setattr(
+        launch, "run_local",
+        lambda np, *a, **kw: (calls.setdefault("np", np), 0)[1])
+    assert launch.run_commandline(["python", "-c", "pass"]) == 0
+    assert calls["np"] == 1
