@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""PyTorch MNIST — the reference examples/pytorch/pytorch_mnist.py
+recipe on the ``horovod_tpu.torch`` shim (host-side torch training with
+engine-backed collectives; for TPU-throughput training use the JAX
+surface — see mnist_train.py and docs/performance.md §5).
+
+The reference recipe, line for line:
+  1. hvd.init()
+  2. shard the dataset by rank
+  3. scale the learning rate by hvd.size()
+  4. wrap the optimizer in hvd.DistributedOptimizer
+  5. hvd.broadcast_parameters + broadcast_optimizer_state from rank 0
+
+Run: HVD_TPU_FORCE_CPU_DEVICES=8 python examples/torch_mnist.py --epochs 1
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+try:
+    import horovod_tpu.torch as hvd
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import horovod_tpu.torch as hvd
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(1, 8, 3, padding=1)
+        self.fc1 = nn.Linear(8 * 14 * 14, 64)
+        self.fc2 = nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.conv(x)), 2)
+        x = x.flatten(1)
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def synthetic_mnist(n=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 1, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=(n,)).astype(np.int64)
+    return torch.from_numpy(x), torch.from_numpy(y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    x, y = synthetic_mnist()
+    shard = slice(hvd.rank(), None, hvd.size())
+    x, y = x[shard], y[shard]
+
+    model = Net()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=args.lr * hvd.size(),
+                        momentum=0.9),
+        named_parameters=model.named_parameters())
+
+    # Restart consistency (reference steps 5).
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    model.train()
+    for epoch in range(args.epochs):
+        losses = []
+        for i in range(0, len(x), args.batch_size):
+            xb, yb = x[i:i + args.batch_size], y[i:i + args.batch_size]
+            opt.zero_grad()
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        avg = hvd.allreduce(torch.tensor(np.mean(losses)),
+                            name=f"epoch{epoch}.loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg):.4f}")
+    if hvd.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -198,19 +198,29 @@ class Context:
     def remove_process_set(self, process_set) -> None:
         from ..process_set import ProcessSet
 
-        if not isinstance(process_set, ProcessSet):
-            # Symmetric with add_process_set's rank-list shorthand:
-            # resolve to the registered set with those ranks.
-            ranks = tuple(sorted({int(r) for r in process_set}))
+        if isinstance(process_set, ProcessSet) and \
+                process_set in self._process_sets:
+            resolved = process_set
+        else:
+            # Resolve by member ranks — covers the rank-list shorthand
+            # AND a fresh ProcessSet instance equal to a registered one
+            # (silently no-op'ing on those would leave the real set and
+            # its engine alive).
+            ranks = tuple(sorted({int(r) for r in (
+                process_set.ranks if isinstance(process_set, ProcessSet)
+                else process_set)}))
             matches = [ps for ps in self._process_sets
                        if ps.ranks == ranks]
             if not matches:
                 raise ValueError(f"no registered process set with ranks "
                                  f"{list(ranks)}")
-            process_set = matches[0]
-        process_set._engine = None
+            resolved = matches[0]
+        resolved._engine = None
+        if isinstance(process_set, ProcessSet) and \
+                resolved is not process_set:
+            process_set._engine = None  # the caller's handle too
         self._process_sets = [ps for ps in self._process_sets
-                              if ps is not process_set]
+                              if ps is not resolved]
 
     def shutdown(self) -> None:
         if self._shutdown:

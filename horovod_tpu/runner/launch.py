@@ -424,7 +424,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                       "launching locally", file=sys.stderr)
                 pod = None
             if pod is not None:
-                host_infos = pod.host_infos()
+                # Single-host "pods" publish an internal IP that won't
+                # match gethostname() — keep those on run_local instead
+                # of demanding working ssh-to-self.
+                host_infos = (pod.host_infos() if pod.num_hosts > 1
+                              else None)
                 if np_unset and pod.num_chips > 1:
                     print(f"hvdtpurun: TPU pod detected "
                           f"({pod.accelerator_type or 'unknown type'}, "

@@ -1,0 +1,5 @@
+"""Alias of horovod_tpu.keras.callbacks (reference
+horovod/tensorflow/keras/callbacks.py)."""
+
+from horovod_tpu.keras.callbacks import *  # noqa: F401,F403
+from horovod_tpu.keras.callbacks import __all__  # noqa: F401

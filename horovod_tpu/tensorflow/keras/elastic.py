@@ -1,0 +1,5 @@
+"""Alias of horovod_tpu.keras.elastic (reference
+horovod/tensorflow/keras/elastic.py)."""
+
+from horovod_tpu.keras.elastic import *  # noqa: F401,F403
+from horovod_tpu.keras.elastic import __all__  # noqa: F401

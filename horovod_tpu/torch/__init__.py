@@ -66,8 +66,10 @@ def _replicated(tensor: torch.Tensor):
 def _to_host(dt) -> np.ndarray:
     """Distributed (size, *shape) result -> this rank's row on host.
     Reads only the first addressable shard instead of device_get'ing the
-    full stack (a size x overfetch on large tensors)."""
-    return np.asarray(dt.addressable_shards[0].data)[0]
+    full stack (a size x overfetch on large tensors). Always an ndarray
+    — a scalar row would otherwise come back as a numpy scalar, which
+    torch.from_numpy rejects."""
+    return np.asarray(np.asarray(dt.addressable_shards[0].data)[0])
 
 
 # -- collectives (reference torch/mpi_ops.py) -------------------------------
@@ -138,6 +140,55 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
     return e.handles.allocate(out)
 
 
+def allgather_async(tensor: torch.Tensor,
+                    name: Optional[str] = None) -> int:
+    """Reference torch/mpi_ops.py:302 — handle resolves to the
+    rank-concatenated result."""
+    e = _engine()
+    out = e.allgather(_replicated(tensor), name)
+    h = e.handles.allocate(out)
+    _inplace_targets()[h] = ("allgather", tensor)
+    return h
+
+
+def alltoall_async(tensor: torch.Tensor,
+                   name: Optional[str] = None) -> int:
+    """Reference torch/mpi_ops.py:515, even-split form (matching this
+    shim's sync alltoall; negotiated uneven splits live on the core
+    surface, horovod_tpu.alltoall(splits=...))."""
+    e = _engine()
+    out = e.alltoall(_replicated(tensor), name)
+    return e.handles.allocate(out)
+
+
+def _inplace_targets() -> dict:
+    """Handle -> target-tensor registry for the _-suffixed async ops.
+    Lives ON the engine so it resets with shutdown()/init() exactly like
+    HandleManager — a module-level dict would alias recycled handle ids
+    across engine generations and write results into dead tensors."""
+    e = _engine()
+    reg = getattr(e, "_torch_inplace_targets", None)
+    if reg is None:
+        reg = e._torch_inplace_targets = {}
+    return reg
+
+
+def allreduce_async_(tensor: torch.Tensor, op: ReduceOp = Average,
+                     name: Optional[str] = None) -> int:
+    """Reference torch/mpi_ops.py:223 allreduce_async_."""
+    h = allreduce_async(tensor, op, name)
+    _inplace_targets()[h] = ("inplace", tensor)
+    return h
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0,
+                     name: Optional[str] = None) -> int:
+    """Reference torch/mpi_ops.py:451 broadcast_async_."""
+    h = broadcast_async(tensor, root_rank, name)
+    _inplace_targets()[h] = ("inplace", tensor)
+    return h
+
+
 def poll(handle: int) -> bool:
     return _engine().poll(handle)
 
@@ -145,8 +196,18 @@ def poll(handle: int) -> bool:
 def synchronize(handle: int) -> torch.Tensor:
     val = _engine().synchronize(handle)
     if isinstance(val, torch.Tensor):
-        return val
-    return torch.from_numpy(_to_host(val).copy())
+        out = val
+    else:
+        out = torch.from_numpy(_to_host(val).copy())
+    kind, target = _inplace_targets().pop(handle, (None, None))
+    if kind == "inplace":
+        target.copy_(out.reshape(target.shape).to(target.dtype))
+        return target
+    if kind == "allgather":
+        # This rank's row holds the stacked gather; flatten rank-major
+        # exactly like the sync allgather surface.
+        return out.reshape((-1,) + tuple(target.shape[1:])).to(target.dtype)
+    return out
 
 
 # -- parameter/optimizer broadcast (reference torch/functions.py:30-108) ----

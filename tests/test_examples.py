@@ -49,3 +49,10 @@ def test_estimator_example():
 def test_adasum_example():
     _run(["examples/adasum_resnet.py", "--tiny", "--steps", "2",
           "--batch-size", "16"])
+
+
+def test_torch_mnist_example():
+    pytest.importorskip("torch")
+    out = _run(["examples/torch_mnist.py", "--epochs", "1",
+                "--batch-size", "32"])
+    assert "done" in out

@@ -147,3 +147,17 @@ def test_elastic_keras_state_and_callbacks():
                      hvdk.elastic.UpdateEpochStateCallback(state)])
     assert state.epoch == 2
     assert state.batch == 0  # reset at epoch end
+
+
+def test_tensorflow_keras_namespace_alias():
+    """import horovod_tpu.tensorflow.keras as hvd must expose the same
+    surface as horovod_tpu.keras (reference ships both paths)."""
+    import horovod_tpu.keras as a
+    import horovod_tpu.tensorflow.keras as b
+
+    assert b.DistributedOptimizer is a.DistributedOptimizer
+    assert b.load_model is a.load_model
+    assert b.callbacks.MetricAverageCallback is \
+        a.callbacks.MetricAverageCallback
+    assert b.elastic.KerasState is a.elastic.KerasState
+    assert b.size() == a.size() == 8

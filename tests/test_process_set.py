@@ -113,3 +113,12 @@ def test_remove_by_rank_list(hvd):
         ps.engine
     with pytest.raises(ValueError, match="no registered process set"):
         hvd.remove_process_set([0, 5])
+
+
+def test_remove_by_equal_instance(hvd):
+    """A fresh ProcessSet equal to a registered one resolves to it —
+    a silent no-op would leave the registered engine alive."""
+    ps = hvd.add_process_set([0, 6])
+    hvd.remove_process_set(ProcessSet([6, 0]))
+    with pytest.raises(ValueError, match="not registered"):
+        ps.engine

@@ -445,3 +445,19 @@ def test_launch_explicit_np1_survives_pod(monkeypatch):
         lambda np, *a, **kw: (calls.setdefault("np", np), 0)[1])
     assert launch.run_commandline(["python", "-c", "pass"]) == 0
     assert calls["np"] == 1
+
+
+def test_single_host_pod_runs_local(monkeypatch):
+    """A one-host pod publishing an internal IP must not demand
+    ssh-to-self; it runs locally with np auto-scaled to the chips."""
+    import horovod_tpu.runner.launch as launch
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "10.164.0.2")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    calls = {}
+    monkeypatch.setattr(
+        launch, "run_local",
+        lambda np, *a, **kw: (calls.setdefault("np", np), 0)[1])
+    assert launch.run_commandline(["python", "-c", "pass"]) == 0
+    assert calls["np"] == 8

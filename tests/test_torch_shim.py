@@ -236,3 +236,36 @@ def test_torch_state_commit_restore_sync():
     # but exercises the full collective path.
     state.sync()
     torch.testing.assert_close(model.weight.detach(), w_committed)
+
+
+def test_async_inplace_and_allgather_variants():
+    """Reference torch/mpi_ops.py _-suffixed async ops: synchronize
+    writes in place for allreduce_async_/broadcast_async_, and
+    allgather_async resolves to the rank-concatenated result."""
+    t = torch.tensor([1.0, 2.0])
+    h = hvdt.allreduce_async_(t, op=hvdt.Sum, name="ar_ip")
+    out = hvdt.synchronize(h)
+    assert out is t
+    np.testing.assert_allclose(t.numpy(), [8.0, 16.0])
+
+    b = torch.tensor([3.0, 4.0])
+    h = hvdt.broadcast_async_(b, root_rank=0, name="bc_ip")
+    assert hvdt.synchronize(h) is b
+    np.testing.assert_allclose(b.numpy(), [3.0, 4.0])
+
+    g = torch.ones(2, 3)
+    h = hvdt.allgather_async(g, name="ag_async")
+    out = hvdt.synchronize(h)
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(out.numpy(), np.ones((16, 3)))
+
+    a = torch.arange(16, dtype=torch.float32).reshape(8, 2)
+    h = hvdt.alltoall_async(a, name="a2a_async")
+    out = hvdt.synchronize(h)
+    assert out.shape == (8, 2)
+
+
+def test_scalar_allreduce():
+    """0-dim tensors (metric averaging's common case) round-trip."""
+    out = hvdt.allreduce(torch.tensor(3.0), op=hvdt.Average)
+    assert out.shape == () and float(out) == 3.0
