@@ -103,7 +103,7 @@ def _supervise(argv):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=0,
-                   help="0 = per-model default (128 CNN, 8 BERT)")
+                   help="0 = per-model default (128 CNN, 8 BERT/GPT)")
     p.add_argument("--image-size", type=int, default=0,
                    help="0 = model's native size (224; 299 for inception3)")
     p.add_argument("--seq-len", type=int, default=512)
@@ -112,7 +112,8 @@ def main():
     p.add_argument("--batches-per-iter", type=int, default=5)
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3",
-                            "bert_large", "bert_base"])
+                            "bert_large", "bert_base", "gpt_small",
+                            "gpt_medium"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -178,10 +179,13 @@ def main():
 
 def _run_benchmark(args, n):
     is_bert = args.model.startswith("bert")
-    batch_size = args.batch_size or (8 if is_bert else 128)
+    is_gpt = args.model.startswith("gpt")
+    batch_size = args.batch_size or (8 if (is_bert or is_gpt) else 128)
 
     if is_bert:
         run_batch, unit, baseline = _setup_bert(args, batch_size, n)
+    elif is_gpt:
+        run_batch, unit, baseline = _setup_gpt(args, batch_size, n)
     else:
         run_batch, unit, baseline = _setup_cnn(args, batch_size, n)
 
@@ -213,10 +217,11 @@ def _run_benchmark(args, n):
     # the metric is per-chip, so divide the measured global rate by n.
     val = float(np.mean(rates)) / n
     result = {
-        "metric": f"{args.model}_{'samples' if is_bert else 'images'}"
+        "metric": f"{args.model}_"
+                  f"{'samples' if (is_bert or is_gpt) else 'images'}"
                   f"_per_sec_per_chip",
         "value": round(val, 2),
-        "unit": "samples/s" if is_bert else "img/s",
+        "unit": "samples/s" if (is_bert or is_gpt) else "img/s",
         "vs_baseline": round(val / baseline, 3),
     }
     flops = _step_flops(n)
@@ -433,6 +438,48 @@ def _setup_bert(args, batch_size, n):
 
     run = _make_stepper(apply_loss, (params, opt_state), n,
                         (tokens, mask_positions.astype(jnp.float32), labels))
+    return run, "samples/s", BERT_BASELINE_PER_DEVICE
+
+
+def _setup_gpt(args, batch_size, n):
+    """Causal-LM pretraining step on the GPT decoder (next-token loss,
+    AdamW, flash attention + RoPE) — the model family this framework
+    adds beyond the reference's CNN + BERT benchmarks. No reference
+    number exists, so the BERT nominal per-device baseline stands in."""
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import gpt_medium, gpt_small
+
+    model = (gpt_small if args.model == "gpt_small" else gpt_medium)()
+    rng = jax.random.PRNGKey(0)
+    S = args.seq_len
+    tokens = jax.random.randint(rng, (batch_size, S + 1), 0,
+                                model.vocab_size)
+
+    params = model.init(rng, tokens[:, :-1])["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4),
+                                  axis_name=hvd.rank_axis())
+    opt_state = tx.init(params)
+
+    def apply_loss(state, data, pmean_axis):
+        p, st = state
+        (toks,) = data
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, toks[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks[:, 1:]).mean()
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        if pmean_axis is not None:
+            l = jax.lax.pmean(l, pmean_axis)
+        updates, st = tx.update(g, st, p)
+        p = optax.apply_updates(p, updates)
+        return p, st, l
+
+    run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,))
     return run, "samples/s", BERT_BASELINE_PER_DEVICE
 
 

@@ -1,0 +1,136 @@
+"""Decoder-only causal LM (GPT-style) in Flax — third benchmark model
+family beyond the reference's CNN + BERT set (the reference scales batch
+only; a causal LM is where the sequence-parallel capabilities this
+framework adds — ring attention / Ulysses — earn their keep).
+
+TPU-first choices, same pattern as models/bert.py: bf16 compute / fp32
+params, fused QKV (one MXU matmul), Pallas flash attention with
+``causal=True`` as the default inner loop, rotary position embeddings
+(no learned position table — RoPE composes with ring attention because
+positions travel with the query/key blocks), weight-tied LM head, and a
+pluggable ``attend_fn`` so ``parallel/ring_attention`` can slot in for
+long sequences without touching the model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.flash_attention import flash_attention
+
+
+def rope(x, positions=None, base: float = 10000.0):
+    """Rotary position embedding on (B, S, H, D) — rotate each head-dim
+    pair by a position-dependent angle. ``positions`` (B, S) overrides
+    the default arange, which is how a sequence-parallel shard applies
+    its GLOBAL positions to a LOCAL block."""
+    b, s, h, d = x.shape
+    if positions is None:
+        positions = jnp.arange(s)[None, :]
+    positions = positions.astype(jnp.float32)
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None] * freqs[None, None, :]   # (B, S, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]                     # (B, S, 1, D/2)
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _causal_attend(q, k, v, mask=None):
+    return flash_attention(q, k, v, mask=mask, causal=True)
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attend_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        b, s, h = x.shape
+        head_dim = h // self.num_heads
+        qkv = nn.Dense(3 * h, dtype=self.dtype, param_dtype=jnp.float32,
+                       name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = rope(q.reshape(b, s, self.num_heads, head_dim), positions)
+        k = rope(k.reshape(b, s, self.num_heads, head_dim), positions)
+        v = v.reshape(b, s, self.num_heads, head_dim)
+        attend = self.attend_fn or _causal_attend
+        o = attend(q, k, v).reshape(b, s, h)
+        return nn.Dense(h, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="out")(o)
+
+
+class DecoderLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attend_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x + CausalSelfAttention(self.num_heads, self.dtype,
+                                    self.attend_fn,
+                                    name="attn")(y, positions)
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_out")(y)
+        return x + y
+
+
+class GPT(nn.Module):
+    """Pre-LN decoder-only transformer with weight-tied LM head."""
+
+    vocab_size: int = 32000
+    num_layers: int = 12
+    hidden: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    attend_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        emb = nn.Embed(self.vocab_size, self.hidden,
+                       param_dtype=jnp.float32, name="tok_emb")
+        x = emb(tokens).astype(self.dtype)
+        for i in range(self.num_layers):
+            x = DecoderLayer(self.num_heads, self.mlp_dim, self.dtype,
+                             self.attend_fn, name=f"layer{i}")(x,
+                                                               positions)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="final_ln")(x)
+        # Weight-tied head: logits in fp32 for a stable softmax.
+        logits = x.astype(jnp.float32) @ emb.embedding.T
+        return logits
+
+
+def gpt_small(**kw):
+    """~124M params (GPT-2 small geometry)."""
+    return GPT(num_layers=12, hidden=768, num_heads=12, mlp_dim=3072,
+               vocab_size=kw.pop("vocab_size", 50257), **kw)
+
+
+def gpt_medium(**kw):
+    """~350M params (GPT-2 medium geometry)."""
+    return GPT(num_layers=24, hidden=1024, num_heads=16, mlp_dim=4096,
+               vocab_size=kw.pop("vocab_size", 50257), **kw)
+
+
+def gpt_tiny(**kw):
+    """Test-sized decoder for the loopback tier."""
+    return GPT(num_layers=2, hidden=64, num_heads=4, mlp_dim=128,
+               vocab_size=kw.pop("vocab_size", 128),
+               dtype=kw.pop("dtype", jnp.float32), **kw)
