@@ -14,12 +14,10 @@ long sequences without touching the model.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
-import numpy as np
 
 from ..ops.flash_attention import flash_attention
 

@@ -56,3 +56,9 @@ def test_torch_mnist_example():
     out = _run(["examples/torch_mnist.py", "--epochs", "1",
                 "--batch-size", "32"])
     assert "done" in out
+
+
+def test_gpt_long_context_example():
+    out = _run(["examples/gpt_long_context.py", "--steps", "6",
+                "--seq-len", "32"])
+    assert "done: dp=2 sp=4 seq=32" in out
