@@ -55,7 +55,8 @@ def _axes_bound(*axes) -> bool:
 def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
                  fusion_threshold: int, prescale: float = 1.0,
                  postscale: float = 1.0, hierarchical: bool = False,
-                 local_axis: str = "local", cross_axis: str = "cross"):
+                 local_axis: str = "local", cross_axis: str = "cross",
+                 quantized_cross: bool = False):
     """Fused (bucketed) allreduce of a gradient pytree over the mesh axis.
 
     Outside an SPMD region (axis names unbound) the reduction degenerates
@@ -83,7 +84,14 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
             w = C._apply_scale(w, prescale)
             nl = jax.lax.axis_size(local_axis)
             w, n = fusion_lib.pad_to_multiple(w, nl)
-            w = C.hierarchical_allreduce_staged(w, op, local_axis, cross_axis)
+            if quantized_cross:
+                # EQuARX path: int8 payload on the DCN hop
+                # (collectives.quantized_hierarchical_allreduce).
+                w = C.quantized_hierarchical_allreduce(
+                    w, op, local_axis, cross_axis)
+            else:
+                w = C.hierarchical_allreduce_staged(w, op, local_axis,
+                                                    cross_axis)
             w = jax.lax.slice_in_dim(w, 0, n)
             w = C._apply_scale(w, postscale)
         else:
@@ -129,7 +137,8 @@ def DistributedOptimizer(optimizer,
                          fusion_threshold_bytes: Optional[int] = None,
                          hierarchical: bool = False,
                          local_axis: str = "local",
-                         cross_axis: str = "cross"):
+                         cross_axis: str = "cross",
+                         quantized_cross: bool = False):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -141,6 +150,11 @@ def DistributedOptimizer(optimizer,
     before one fused allreduce + inner update (reference
     gradient_aggregation.py semantics: allreduce every k-th call, identity
     updates in between).
+
+    ``quantized_cross`` (requires ``hierarchical``) carries the DCN hop
+    of each fused bucket as block-scaled int8 — the EQuARX-style
+    quantized allreduce (collectives.quantized_hierarchical_allreduce);
+    gradients land within block-absmax rounding error of the exact sum.
     """
     try:
         import optax
@@ -148,6 +162,11 @@ def DistributedOptimizer(optimizer,
         raise ImportError("DistributedOptimizer requires optax") from e
 
     _check_reduce_safe(compression)
+    if quantized_cross and (not hierarchical or op not in (
+            C.ReduceOp.SUM, C.ReduceOp.AVERAGE)):
+        raise ValueError("quantized_cross requires hierarchical=True and "
+                         "a SUM/AVERAGE op (the int8 hop rides the "
+                         "staged RS->AR->AG pipeline)")
 
     k = int(backward_passes_per_step)
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
@@ -156,7 +175,7 @@ def DistributedOptimizer(optimizer,
         return _reduce_tree(grads, op, axis_name, compression,
                             fusion_threshold_bytes, prescale_factor,
                             postscale_factor, hierarchical, local_axis,
-                            cross_axis)
+                            cross_axis, quantized_cross)
 
     if k <= 1:
         def init_fn(params):
