@@ -56,6 +56,13 @@ _ctx = _basics.context
 
 def __getattr__(name):
     # Lazy submodules with heavy deps (orbax, TF) — imported on first use.
+    if name == "run":
+        # Reference horovod/__init__.py: `from horovod.runner import run`
+        # — lazily here (runner pulls cloudpickle).
+        from .runner import run as _run
+
+        globals()["run"] = _run
+        return _run
     if name in ("checkpoint", "callbacks", "elastic", "executor",
                 "tensorflow", "torch", "mxnet", "store", "estimator",
                 "spark"):

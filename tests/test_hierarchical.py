@@ -217,7 +217,7 @@ def test_optimizer_quantized_cross(mesh2d, rng):
     for l0, lN in results.values():
         assert lN < l0 * 0.05, results
     e, q = results["exact"][1], results["quantized"][1]
-    assert abs(q - e) < 0.2 * e + 1e-4, results
+    assert abs(q - e) < 0.02 * e + 1e-4, results
 
 
 def test_optimizer_quantized_cross_validation():

@@ -120,6 +120,62 @@ def hierarchical_evidence():
     }
 
 
+def quantized_cross_evidence():
+    """EQuARX int8 DCN hops: read the COMPILED HLO and account the
+    cross-axis collective payloads by element type — evidence the s8
+    wire format actually reaches the executable, not just the Python."""
+    import re
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("cross", "local"))
+    n = 1 << 20
+
+    def compiled_text(fn):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local")))).lower(
+                np.ones((8, n), np.float32)).compile().as_text()
+
+    def collective_bytes(text):
+        """Sum result-payload bytes of collective DEFINITIONS by element
+        type. Anchored to `= <shape> <op>(` so consumers that merely
+        reference a collective's instruction name (get-tuple-element
+        etc.) are not counted, and tuple-shaped results contribute every
+        element."""
+        sizes = {"s8": 1, "f32": 4, "bf16": 2, "f16": 2}
+        out = {k: 0 for k in sizes}
+        for m in re.finditer(
+                r"= (\(?[^=\n]*?)\s*"
+                r"(all-to-all|all-gather|all-reduce|"
+                r"reduce-scatter|collective-permute)\(", text):
+            for dt, shape in re.findall(r"(s8|f32|bf16|f16)\[([\d,]*)\]",
+                                        m.group(1)):
+                elems = 1
+                for d in shape.split(","):
+                    if d:
+                        elems *= int(d)
+                out[dt] += elems * sizes[dt]
+        return {k: v for k, v in out.items() if v}
+
+    exact = collective_bytes(compiled_text(
+        lambda v: C.hierarchical_allreduce_staged(
+            v.reshape(n), C.ReduceOp.SUM, "local", "cross")[None]))
+    quant = collective_bytes(compiled_text(
+        lambda v: C.quantized_hierarchical_allreduce(
+            v.reshape(n), C.ReduceOp.SUM, "local", "cross")[None]))
+    return {
+        "buffer_mib_per_rank": mib(n * 4),
+        "exact_collective_bytes": {k: mib(v) for k, v in exact.items()},
+        "quantized_collective_bytes": {k: mib(v)
+                                       for k, v in quant.items()},
+        "note": ("compiled-HLO accounting: the quantized path's "
+                 "collective payloads are s8 (plus small fp32 scale "
+                 "vectors), the exact path's are f32 — the ~4x DCN "
+                 "byte reduction is in the executable, not just "
+                 "claimed"),
+    }
+
+
 def fusion_evidence():
     """Grouped (fused-bucket) vs per-tensor eager allreduce."""
     hvd.init()
@@ -186,6 +242,7 @@ if __name__ == "__main__":
     evidence = {
         "donation": donation_evidence(),
         "hierarchical": hierarchical_evidence(),
+        "quantized_cross": quantized_cross_evidence(),
         "fusion": fusion_evidence(),
         "overlap": overlap_evidence(),
     }
