@@ -321,5 +321,5 @@ __all__ = [
     "mpi_built", "mpi_enabled", "mpi_threads_supported", "gloo_built",
     "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built", "xla_built", "tpu_available",
-    "ProcessSet", "add_process_set", "remove_process_set",
+    "ProcessSet", "add_process_set", "remove_process_set", "run",
 ]

@@ -37,6 +37,7 @@ local_rank = _hvd.local_rank
 local_size = _hvd.local_size
 Average, Sum, Adasum, Min, Max, Product = (
     _hvd.Average, _hvd.Sum, _hvd.Adasum, _hvd.Min, _hvd.Max, _hvd.Product)
+Compression = _hvd.Compression
 # object helpers (reference torch/functions.py broadcast_object /
 # allgather_object — cloudpickle over the engine's byte collectives)
 broadcast_object = _hvd.broadcast_object
@@ -124,12 +125,20 @@ def alltoall(tensor: torch.Tensor,
 # -- async handle model (reference torch/mpi_ops.py:223-646) ----------------
 
 def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    compression=None) -> int:
     """Launches the collective (XLA dispatch is async — the reference's
     background-thread asynchrony maps onto the XLA stream) and returns an
     int handle; the device→host copy happens in synchronize()."""
+    if compression is not None:
+        from horovod_tpu.optim import _check_reduce_safe
+
+        _check_reduce_safe(compression)  # int8 scales don't sum
     e = _engine()
-    out = e.allreduce(_replicated(tensor), op, name)
+    out = e.allreduce(_replicated(tensor), op, name,
+                      prescale_factor, postscale_factor, compression)
     return e.handles.allocate(out)
 
 
@@ -260,9 +269,12 @@ class _DistributedOptimizerMixin:
     engine's controller/fusion doing the bucketing the C++ core did."""
 
     def _dist_init(self, base_cls, named_parameters, op,
-                   backward_passes_per_step):
+                   backward_passes_per_step, compression=None,
+                   gradient_predivide_factor: float = 1.0):
         self._base_cls = base_cls
         self.op = op
+        self._compression = compression
+        self._predivide = gradient_predivide_factor
         self.backward_passes_per_step = backward_passes_per_step
         self._handles = {}          # id(p) -> (p, handle-or-None)
         self._allreduce_delay = {}  # id(p) -> remaining local passes
@@ -289,7 +301,15 @@ class _DistributedOptimizerMixin:
             # contributes zeros.
             p.grad = torch.zeros_like(p)
         name = self._names.get(id(p), f"grad.{id(p)}")
-        return allreduce_async(p.grad, op=self.op, name=name)
+        op, pre, post = self.op, 1.0, 1.0
+        if self._predivide != 1.0:
+            # Reference optimizer.py: scale 1/f before the SUM, f/size
+            # after (splits the averaging around the reduction).
+            op, pre, post = Sum, 1.0 / self._predivide, \
+                self._predivide / size()
+        return allreduce_async(p.grad, op=op, name=name,
+                               prescale_factor=pre, postscale_factor=post,
+                               compression=self._compression)
 
     def _make_hook(self):
         def hook(p: torch.Tensor) -> None:
@@ -392,8 +412,10 @@ class _DistributedAdasumMixin:
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
+                         compression=None,
+                         backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
-                         backward_passes_per_step: int = 1):
+                         gradient_predivide_factor: float = 1.0):
     """Returns an instance of a dynamic subclass of the USER's optimizer
     class with the mixin's step/synchronize grafted on — the reference's
     own architecture (torch/optimizer.py:381: ``cls = type(...,
@@ -406,7 +428,18 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
 
     ``op=Adasum`` grafts the delta-based mixin instead (the reference
     routes Adasum the same way, torch/optimizer.py:440+: adaptive
-    summation operates on optimizer deltas, not gradients)."""
+    summation operates on optimizer deltas, not gradients).
+    ``compression`` rides each per-gradient allreduce (reference
+    optimizer.py compression param); ``gradient_predivide_factor``
+    splits averaging around the sum (1/f before, f/size after) and
+    requires op=Average."""
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError("gradient_predivide_factor requires op=Average "
+                         "(reference torch/optimizer.py)")
+    if compression is not None:
+        from horovod_tpu.optim import _check_reduce_safe
+
+        _check_reduce_safe(compression)
     if op == Adasum:
         if backward_passes_per_step != 1:
             raise NotImplementedError(
@@ -429,7 +462,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     obj = cls.__new__(cls)
     obj.__dict__.update(optimizer.__dict__)  # share param_groups + state
     obj._dist_init(optimizer.__class__, named_parameters, op,
-                   backward_passes_per_step)
+                   backward_passes_per_step, compression,
+                   gradient_predivide_factor)
     return obj
 
 

@@ -11,7 +11,7 @@ import pytest
 import jax.numpy as jnp
 
 
-DTYPES = [np.float32, np.float64, np.int32]
+DTYPES = [np.float32, np.float64, np.float16, np.int32, np.int64]
 
 
 @pytest.mark.parametrize("dtype", DTYPES)

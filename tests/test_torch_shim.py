@@ -269,3 +269,37 @@ def test_scalar_allreduce():
     """0-dim tensors (metric averaging's common case) round-trip."""
     out = hvdt.allreduce(torch.tensor(3.0), op=hvdt.Average)
     assert out.shape == () and float(out) == 3.0
+
+
+def test_optimizer_compression_and_predivide():
+    """Reference torch/optimizer.py kwargs: compression rides each
+    gradient allreduce; gradient_predivide_factor splits the averaging
+    (net effect on a replicated world = plain average)."""
+    model = torch.nn.Linear(4, 2)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        compression=hvdt.Compression.fp16,
+        gradient_predivide_factor=4.0)
+    x = torch.ones(8, 4)
+    loss = model(x).sum()
+    before = [p.detach().clone() for p in model.parameters()]
+    loss.backward()
+    opt.step()
+    # Params must move by EXACTLY lr * grad: grad(W) = sum_batch x = 8,
+    # grad(b) = 8; the replicated-world average equals the local grad,
+    # predivide's 1/f..f/size split must cancel, and fp16 is lossless on
+    # 8.0 — any predivide scaling bug shows up as a 2x/4x/16x offset.
+    for b, p in zip(before, model.parameters()):
+        torch.testing.assert_close(b - p, torch.full_like(p, 8.0))
+    with pytest.raises(ValueError, match="op=Average"):
+        hvdt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0),
+            op=hvdt.Sum, gradient_predivide_factor=2.0)
+    with pytest.raises(ValueError, match="wire-format"):
+        hvdt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0),
+            compression=hvdt.Compression.int8)
+    with pytest.raises(ValueError, match="wire-format"):
+        hvdt.allreduce_async(torch.ones(4), op=hvdt.Sum,
+                             compression=hvdt.Compression.int8)

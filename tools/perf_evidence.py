@@ -239,11 +239,19 @@ def overlap_evidence():
 
 
 if __name__ == "__main__":
-    evidence = {
-        "donation": donation_evidence(),
-        "hierarchical": hierarchical_evidence(),
-        "quantized_cross": quantized_cross_evidence(),
-        "fusion": fusion_evidence(),
-        "overlap": overlap_evidence(),
+    sections = {
+        "donation": donation_evidence,
+        "hierarchical": hierarchical_evidence,
+        "quantized_cross": quantized_cross_evidence,
+        "fusion": fusion_evidence,
+        "overlap": overlap_evidence,
     }
+    import sys
+
+    wanted = sys.argv[1:] or list(sections)
+    unknown = [w for w in wanted if w not in sections]
+    if unknown:
+        raise SystemExit(f"unknown section(s) {unknown}; "
+                         f"choose from {list(sections)}")
+    evidence = {name: sections[name]() for name in wanted}
     print(json.dumps(evidence, indent=2))
