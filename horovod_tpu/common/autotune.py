@@ -97,30 +97,57 @@ class Autotuner:
                  candidates_bytes: Sequence[int] = DEFAULT_CANDIDATES,
                  warmup_samples: int = 3,
                  steps_per_sample: int = 10,
-                 log_file: Optional[str] = None):
+                 log_file: Optional[str] = None,
+                 tune_hierarchical: bool = False):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.log_file = log_file
+        # Joint (threshold, hierarchical) space when asked — the
+        # reference's ParameterManager tunes the hierarchical toggle
+        # alongside the fusion threshold (parameter_manager.cc).
+        self.tune_hierarchical = tune_hierarchical
+        hs = (0, 1) if tune_hierarchical else (0,)
+        self._space: List[Tuple[int, int]] = [
+            (t, h) for t in self.candidates for h in hs]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
         self._secs = 0.0
-        self._samples: Dict[int, List[float]] = {}
-        self._current = self.candidates[len(self.candidates) // 2]
+        self._samples: Dict[Tuple[int, int], List[float]] = {}
+        self._cur = self._space[len(self._space) // 2]
         self._done = False
         # Samples arrive from finalizer-pool threads (eager engine) and
         # the training loop (AutotunedStepper) concurrently; all state
         # transitions are serialized here.
         self._tlock = threading.RLock()
+        # Single source for the CSV schema: row values come from the
+        # same column list as the header.
+        self._columns = (("threshold_bytes", "hierarchical")
+                         if tune_hierarchical else ("threshold_bytes",))
         if log_file:
             with open(log_file, "w") as f:
-                f.write("threshold_bytes,score_bytes_per_sec\n")
+                f.write(",".join(self._columns)
+                        + ",score_bytes_per_sec\n")
 
     @property
     def current(self) -> int:
         with self._tlock:
-            return self._current
+            return self._cur[0]
+
+    @property
+    def current_hierarchical(self) -> bool:
+        with self._tlock:
+            return bool(self._cur[1])
+
+    @property
+    def current_point(self) -> Tuple[int, bool]:
+        """Atomic (threshold, hierarchical) snapshot — readers that need
+        both must not take them in two lock acquisitions (a concurrent
+        suggest() in between would yield a pair the tuner never
+        proposed)."""
+        with self._tlock:
+            return self._cur[0], bool(self._cur[1])
 
     @property
     def done(self) -> bool:
@@ -146,16 +173,24 @@ class Autotuner:
         """Atomic record + (if a sample completed) suggest — the one call
         sites should use when multiple threads feed the tuner. Returns the
         (possibly updated) current threshold."""
+        return self.feed_point(nbytes, seconds)[0]
+
+    def feed_point(self, nbytes: float,
+                   seconds: float) -> Tuple[int, bool]:
+        """Like feed() but returns the full (threshold, hierarchical)
+        point under ONE lock acquisition."""
         with self._tlock:
             self.record(nbytes, seconds)
             if self.ready():
                 self.suggest()
-            return self._current
+            return self._cur[0], bool(self._cur[1])
 
-    def _log(self, threshold: int, score: float) -> None:
+    def _log(self, point: Tuple[int, int], score: float) -> None:
         if self.log_file:
+            row = point[:len(self._columns)]
             with open(self.log_file, "a") as f:
-                f.write(f"{threshold},{score:.1f}\n")
+                f.write(",".join(str(v) for v in row)
+                        + f",{score:.1f}\n")
 
     def suggest(self) -> int:
         """Finalize the current sample and pick the next threshold via
@@ -163,19 +198,25 @@ class Autotuner:
         with self._tlock:
             return self._suggest_locked()
 
+    @staticmethod
+    def _features(point: Tuple[int, int]) -> List[float]:
+        # log2(threshold) spans ~20-28; scale the hierarchical toggle so
+        # the RBF kernel treats "other branch" as a real distance.
+        return [math.log2(point[0]), 2.0 * point[1]]
+
     def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
-        self._samples.setdefault(self._current, []).append(score)
-        self._log(self._current, score)
+        self._samples.setdefault(self._cur, []).append(score)
+        self._log(self._cur, score)
         self._bytes = self._secs = 0.0
         self._steps = 0
         self._warmed = 0  # re-warm after changing threshold (recompile)
 
-        xs = np.array([[math.log2(t)] for t in self._samples])
+        xs = np.array([self._features(p) for p in self._samples])
         ys = np.array([float(np.mean(v)) for v in self._samples.values()])
         y_mean, y_std = ys.mean(), max(ys.std(), 1e-9)
         ys_n = (ys - y_mean) / y_std
-        grid = np.array([[math.log2(t)] for t in self.candidates])
+        grid = np.array([self._features(p) for p in self._space])
 
         # Native GP+EI core (native/gp_core.cc — the reference's
         # gaussian_process.cc+bayesian_optimization.cc analog); numpy
@@ -191,21 +232,24 @@ class Autotuner:
             mu, var = gp.predict(grid)
             ei = expected_improvement(mu, var, ys_n.max())
 
-        untried = [i for i, t in enumerate(self.candidates)
-                   if t not in self._samples]
+        untried = [i for i, p in enumerate(self._space)
+                   if p not in self._samples]
         if untried:
             # Explore the untried candidate with max EI first.
             i = max(untried, key=lambda j: ei[j])
         else:
             i = int(np.argmax(ei))
             if ei[i] < 1e-3:
-                # Converged: lock in the empirically best threshold.
-                best_t = max(self._samples,
-                             key=lambda t: float(np.mean(self._samples[t])))
-                self._current = best_t
+                # Converged: lock in the empirically best point.
+                best = max(self._samples,
+                           key=lambda p: float(np.mean(self._samples[p])))
+                self._cur = best
                 self._done = True
-                logger.info("autotune converged: fusion threshold %d MiB",
-                            best_t // _MB)
-                return best_t
-        self._current = self.candidates[i]
-        return self._current
+                logger.info(
+                    "autotune converged: fusion threshold %d MiB"
+                    + (", hierarchical=%s" % bool(best[1])
+                       if self.tune_hierarchical else ""),
+                    best[0] // _MB)
+                return best[0]
+        self._cur = self._space[i]
+        return self._cur[0]

@@ -308,12 +308,25 @@ class AutotunedStepper:
         self._calls = 0
         self._tuner_done = False  # set when rank 0 broadcasts :done
         self._threshold = tuner.current
-        self._step = build_step(self._threshold)
+        # Joint tuning (reference ParameterManager's hierarchical toggle):
+        # build_step then takes (threshold, hierarchical).
+        self._joint = getattr(tuner, "tune_hierarchical", False)
+        self._hier = (tuner.current_hierarchical if self._joint else False)
+        self._step = self._rebuild()
         self.rebuilds = 0
+
+    def _rebuild(self):
+        if self._joint:
+            return self._build(self._threshold, self._hier)
+        return self._build(self._threshold)
 
     @property
     def fusion_threshold(self) -> int:
         return self._threshold
+
+    @property
+    def hierarchical(self) -> bool:
+        return self._hier
 
     def __call__(self, *args, **kwargs):
         import time
@@ -325,12 +338,13 @@ class AutotunedStepper:
         dt = time.perf_counter() - t0
         c = self._controller
         if c is None or c.size == 1:
-            new = self.tuner.feed(self.grad_bytes, dt)
+            new, tuner_h = self.tuner.feed_point(self.grad_bytes, dt)
+            new_h = tuner_h if self._joint else self._hier
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new = self._threshold
+            new, new_h = self._threshold, self._hier
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -338,17 +352,20 @@ class AutotunedStepper:
                 # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                mine = str(self.tuner.current) + (
+                cur_t, cur_h = self.tuner.current_point  # atomic pair
+                mine = f"{cur_t}|{int(cur_h) if self._joint else 0}" + (
                     ":done" if c.rank == 0 and self.tuner.done else "")
                 vals = c.exchange("autotune_threshold", mine)
                 v0 = vals[0]  # rank 0's decision wins
                 if v0.endswith(":done"):
                     self._tuner_done = True
                     v0 = v0[:-5]
-                new = int(v0)
-        if new != self._threshold:
-            self._threshold = new
-            self._step = self._build(new)
+                t_str, h_str = v0.split("|")
+                new = int(t_str)
+                new_h = bool(int(h_str)) if self._joint else self._hier
+        if new != self._threshold or new_h != self._hier:
+            self._threshold, self._hier = new, new_h
+            self._step = self._rebuild()
             self.rebuilds += 1
         return out
 

@@ -75,13 +75,34 @@ def _to_host(dt) -> np.ndarray:
 
 # -- collectives (reference torch/mpi_ops.py) -------------------------------
 
+def _validate_compression(compression) -> None:
+    """Fail fast on anything that isn't a Compressor — e.g. a ReduceOp
+    positionally misbound after the signature gained the reference's
+    argument order (a ReduceOp would otherwise surface only as an
+    AttributeError deep inside the engine)."""
+    if compression is None:
+        return
+    if not (hasattr(compression, "compress")
+            and hasattr(compression, "decompress")):
+        raise TypeError(
+            f"compression must be a Compressor (hvd.Compression.*), got "
+            f"{compression!r} — check argument order: "
+            f"(optimizer, named_parameters, compression, "
+            f"backward_passes_per_step, op, gradient_predivide_factor)")
+    from horovod_tpu.optim import _check_reduce_safe
+
+    _check_reduce_safe(compression)
+
+
 def allreduce(tensor: torch.Tensor, op: ReduceOp = Average,
               name: Optional[str] = None,
               prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0) -> torch.Tensor:
+              postscale_factor: float = 1.0,
+              compression=None) -> torch.Tensor:
+    _validate_compression(compression)
     e = _engine()
     out = e.allreduce(_replicated(tensor), op, name,
-                      prescale_factor, postscale_factor)
+                      prescale_factor, postscale_factor, compression)
     return torch.from_numpy(_to_host(out).copy()).to(tensor.dtype)
 
 
@@ -132,10 +153,7 @@ def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
     """Launches the collective (XLA dispatch is async — the reference's
     background-thread asynchrony maps onto the XLA stream) and returns an
     int handle; the device→host copy happens in synchronize()."""
-    if compression is not None:
-        from horovod_tpu.optim import _check_reduce_safe
-
-        _check_reduce_safe(compression)  # int8 scales don't sum
+    _validate_compression(compression)  # int8 scales don't sum
     e = _engine()
     out = e.allreduce(_replicated(tensor), op, name,
                       prescale_factor, postscale_factor, compression)
@@ -389,8 +407,9 @@ class _DistributedAdasumMixin:
     ranks, and applies the reduced delta — adaptive summation over
     optimizer-shaped steps, not raw grads."""
 
-    def _dist_init(self, base_cls, named_parameters):
+    def _dist_init(self, base_cls, named_parameters, compression=None):
         self._base_cls = base_cls
+        self._compression = compression
         self._names = {}
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
@@ -404,7 +423,8 @@ class _DistributedAdasumMixin:
             b = before[id(p)]
             delta = p.detach() - b
             name = self._names.get(id(p), f"adasum.delta.{id(p)}")
-            reduced = allreduce(delta, op=Adasum, name=name)
+            reduced = allreduce(delta, op=Adasum, name=name,
+                                compression=self._compression)
             with torch.no_grad():
                 p.copy_(b + reduced)
         return result
@@ -436,10 +456,7 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     if gradient_predivide_factor != 1.0 and op != Average:
         raise ValueError("gradient_predivide_factor requires op=Average "
                          "(reference torch/optimizer.py)")
-    if compression is not None:
-        from horovod_tpu.optim import _check_reduce_safe
-
-        _check_reduce_safe(compression)
+    _validate_compression(compression)
     if op == Adasum:
         if backward_passes_per_step != 1:
             raise NotImplementedError(
@@ -453,7 +470,7 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                     if not k.startswith("__")})
         obj = cls.__new__(cls)
         obj.__dict__.update(optimizer.__dict__)
-        obj._dist_init(optimizer.__class__, named_parameters)
+        obj._dist_init(optimizer.__class__, named_parameters, compression)
         return obj
     cls = type(optimizer.__class__.__name__,
                (optimizer.__class__,),

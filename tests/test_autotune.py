@@ -241,3 +241,48 @@ def test_sync_batch_norm(hvd, rng):
     out = np.asarray(f(jnp.asarray(gx)))
     np.testing.assert_allclose(out, np.asarray(expected), rtol=1e-4,
                                atol=1e-4)
+
+def test_autotuner_joint_hierarchical():
+    """Joint (threshold, hierarchical) tuning — the reference
+    ParameterManager tunes the toggle alongside the threshold. Synthetic
+    objective: hierarchical=1 is 3x faster and 16 MiB is the best
+    threshold; the tuner must converge on that pair."""
+    mb = 1024 * 1024
+    candidates = [4 * mb, 16 * mb, 64 * mb]
+    base = {4 * mb: 300.0, 16 * mb: 1000.0, 64 * mb: 500.0}
+    t = Autotuner(candidates_bytes=candidates, warmup_samples=0,
+                  steps_per_sample=2, tune_hierarchical=True)
+    for _ in range(80):
+        for _ in range(t.steps_per_sample):
+            score = base[t.current] * (3.0 if t.current_hierarchical
+                                       else 1.0)
+            t.record(score, 1.0)
+        if t.ready():
+            t.suggest()
+        if t.done:
+            break
+    assert t.done
+    assert t.current == 16 * mb
+    assert t.current_hierarchical is True
+
+
+def test_stepper_joint_rebuilds_on_hierarchical_change():
+    """AutotunedStepper with a joint tuner passes (threshold,
+    hierarchical) to build and rebuilds when either moves."""
+    from horovod_tpu.optim import AutotunedStepper
+
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=0,
+                  steps_per_sample=1, tune_hierarchical=True)
+    seen = []
+
+    def build(threshold, hierarchical):
+        seen.append((threshold, hierarchical))
+        return lambda x: x + 1
+
+    stepper = AutotunedStepper(build, grad_bytes=1000, tuner=t,
+                               block=False)
+    for i in range(12):
+        stepper(i)
+    assert stepper.rebuilds >= 1
+    assert any(h for _, h in seen) and any(not h for _, h in seen), seen
+    assert stepper.hierarchical in (True, False)

@@ -303,3 +303,21 @@ def test_optimizer_compression_and_predivide():
     with pytest.raises(ValueError, match="wire-format"):
         hvdt.allreduce_async(torch.ones(4), op=hvdt.Sum,
                              compression=hvdt.Compression.int8)
+
+
+def test_adasum_optimizer_carries_compression():
+    """compression must reach the Adasum delta allreduce (reference
+    _DistributedAdasumOptimizer supports it), and a misbound ReduceOp in
+    the compression slot fails fast."""
+    model = torch.nn.Linear(3, 1)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        compression=hvdt.Compression.fp16, op=hvdt.Adasum)
+    assert opt._compression is hvdt.Compression.fp16
+    loss = model(torch.ones(2, 3)).sum()
+    loss.backward()
+    opt.step()  # delta allreduce runs through the fp16 wire
+    with pytest.raises(TypeError, match="argument order"):
+        hvdt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1), None, hvdt.Sum)
