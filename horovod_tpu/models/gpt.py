@@ -128,7 +128,10 @@ def gpt_medium(**kw):
 
 
 def gpt_tiny(**kw):
-    """Test-sized decoder for the loopback tier."""
-    return GPT(num_layers=2, hidden=64, num_heads=4, mlp_dim=128,
-               vocab_size=kw.pop("vocab_size", 128),
-               dtype=kw.pop("dtype", jnp.float32), **kw)
+    """Test-sized decoder for the loopback tier (every field
+    overridable)."""
+    for k, v in (("num_layers", 2), ("hidden", 64), ("num_heads", 4),
+                 ("mlp_dim", 128), ("vocab_size", 128),
+                 ("dtype", jnp.float32)):
+        kw.setdefault(k, v)
+    return GPT(**kw)

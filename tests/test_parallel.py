@@ -424,3 +424,35 @@ def test_pipeline_gpt_decoder_stages(sp_mesh, rng):
     logits = h.astype(jnp.float32) @ emb.T
     np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_ulysses_matches_single_device(sp_mesh, hvd):
+    """GPT under Ulysses head-scatter SP (causal inner attention over
+    the gathered full sequence) == single-device forward — the second
+    SP flavor on the same attend_fn hook as the ring test."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import gpt_tiny
+    from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.parallel.ulysses import ulysses_attend_fn
+
+    S = 64
+    toks = jax.random.randint(jax.random.PRNGKey(5), (1, S), 0, 128)
+    m_full = gpt_tiny(num_heads=8)  # heads divisible by sp=8
+    params = m_full.init(jax.random.PRNGKey(0), toks)
+    want = m_full.apply(params, toks)
+
+    def causal_inner(q, k, v, mask=None):
+        return flash_attention(q, k, v, mask=mask, causal=True)
+
+    m_sp = gpt_tiny(num_heads=8,
+                    attend_fn=ulysses_attend_fn("sp", causal_inner))
+    positions = jnp.arange(S)[None, :]
+
+    f = jax.jit(jax.shard_map(
+        lambda tb, pos: m_sp.apply(params, tb, positions=pos),
+        mesh=sp_mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"), check_vma=False))
+    got = f(toks, positions)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
