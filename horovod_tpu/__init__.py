@@ -45,7 +45,8 @@ from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
                               Sum)
 from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
-                    DistributedOptimizer, broadcast_parameters)
+                    DistributedOptimizer, ShardedOptimizer,
+                    broadcast_parameters, sharded_init, sharded_update)
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
 
@@ -313,7 +314,8 @@ __all__ = [
     "broadcast_async", "poll", "synchronize", "start_timeline",
     "stop_timeline", "spmd_step", "ReduceOp", "Average", "Sum", "Adasum",
     "Min", "Max", "Product", "Compression", "DistributedOptimizer",
-    "DistributedGradFn", "AutotunedStepper",
+    "DistributedGradFn", "AutotunedStepper", "ShardedOptimizer",
+    "sharded_init", "sharded_update",
     "broadcast_parameters", "broadcast_object",
     "allgather_object", "broadcast_variables", "collective_ops",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",

@@ -377,3 +377,102 @@ def broadcast_parameters(params, root_rank: int = 0,
     broadcast_parameters / tensorflow broadcast_variables)."""
     return jax.tree.map(
         lambda p: C.broadcast(p, root_rank, axis_name), params)
+
+
+# -- ZeRO-1 sharded optimizer state (beyond the reference) ------------------
+#
+# The reference replicates optimizer state on every rank (its
+# DistributedOptimizer wraps a local optimizer; state is per-rank,
+# memory = full). On TPU the idiomatic win is to SHARD the state over
+# the rank axis: reduce-scatter the gradients, update only this rank's
+# 1/n slice of each parameter with the inner optax transform, and
+# all-gather the resulting updates — optimizer memory drops to 1/n (the
+# ZeRO-1 / Megatron "distributed optimizer" recipe) while the wire cost
+# stays the allreduce-equivalent RS+AG pair.
+#
+# Works for ELEMENTWISE inner transforms (sgd/momentum/adam/adamw/...).
+# Transforms that couple elements across the tree (global-norm clipping)
+# would compute shard-local statistics — compose those OUTSIDE.
+
+def _shard_leaf(x, axis_name: str):
+    """(full leaf) -> this rank's padded 1/n flat slice."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    flat = x.reshape(-1)
+    flat, _ = fusion_lib.pad_to_multiple(flat, n)
+    chunk = flat.shape[0] // n
+    return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+
+
+def sharded_init(tx, params, axis_name: str = "hvd"):
+    """Inner-optimizer state over PARAMETER SHARDS — call inside the
+    same shard_map/jit region as :func:`sharded_update` (the shard
+    shapes depend on the bound axis)."""
+    return tx.init(jax.tree.map(lambda p: _shard_leaf(p, axis_name),
+                                params))
+
+
+def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
+                   grad_op: C.ReduceOp = C.ReduceOp.AVERAGE):
+    """ZeRO-1 step: RS(grads) -> inner update on this rank's shard ->
+    AG(updates). Returns ``(updates, new_state)`` with ``updates``
+    shaped like ``params`` (apply with ``optax.apply_updates``)."""
+    if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+        raise ValueError("sharded_update supports SUM/AVERAGE")
+    n = jax.lax.axis_size(axis_name)
+
+    def rs(g):
+        flat, _ = fusion_lib.pad_to_multiple(g.reshape(-1), n)
+        return C.reducescatter(flat, grad_op, axis_name)
+
+    g_shards = jax.tree.map(rs, grads)
+    p_shards = jax.tree.map(lambda p: _shard_leaf(p, axis_name), params)
+    u_shards, new_state = tx.update(g_shards, state, p_shards)
+
+    def ag(u, p):
+        return C.allgather(u, axis_name)[:p.size].reshape(p.shape)
+
+    updates = jax.tree.map(ag, u_shards, params)
+    return updates, new_state
+
+
+class ShardedOptimizer:
+    """Object wrapper over :func:`sharded_init`/:func:`sharded_update`
+    mirroring the optax GradientTransformation shape::
+
+        tx = hvd.ShardedOptimizer(optax.adamw(1e-3), axis_name=ax)
+        # inside the jitted step (axis bound):
+        state = tx.init(params)                  # 1/n-sized state
+        updates, state = tx.update(grads, state, params)
+    """
+
+    def __init__(self, inner, axis_name: str = "hvd",
+                 grad_op: C.ReduceOp = C.ReduceOp.AVERAGE):
+        self.inner = inner
+        self.axis_name = axis_name
+        self.grad_op = grad_op
+
+    def init(self, params):
+        return sharded_init(self.inner, params, self.axis_name)
+
+    def state_specs(self, params):
+        """PartitionSpecs for carrying the sharded state through
+        shard_map: vector leaves are P(axis) (each rank owns its slice;
+        the global array is the shard concatenation), scalar leaves
+        (step counters) replicate. Only leaf RANK matters, so the probe
+        shapes need no world size — callable before init()."""
+        from jax.sharding import PartitionSpec as P
+
+        shapes = jax.eval_shape(
+            self.inner.init,
+            jax.tree.map(lambda p: jax.ShapeDtypeStruct((1,), p.dtype),
+                         params))
+        return jax.tree.map(
+            lambda s: P(self.axis_name) if s.ndim else P(), shapes)
+
+    def update(self, grads, state, params=None):
+        if params is None:
+            raise ValueError("ShardedOptimizer.update requires params "
+                             "(the shard slices come from them)")
+        return sharded_update(self.inner, grads, state, params,
+                              self.axis_name, self.grad_op)
