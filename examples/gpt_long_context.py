@@ -42,6 +42,9 @@ def main():
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer state over dp (ZeRO-1: "
+                        "hvd.ShardedOptimizer — 1/dp adam memory)")
     args = p.parse_args()
 
     hvd.init()
@@ -58,8 +61,12 @@ def main():
     rng = jax.random.PRNGKey(0)
     toks = jax.random.randint(rng, (args.batch, S + 1), 0, 128)
     params = gpt_tiny().init(rng, toks[:1, :-1])["params"]
-    tx = hvd.DistributedOptimizer(optax.adam(1e-2), axis_name="dp")
-    opt_state = tx.init(params)
+    if args.zero1:
+        tx = hvd.ShardedOptimizer(optax.adam(1e-2), axis_name="dp")
+        state_specs = tx.state_specs(params)
+    else:
+        tx = hvd.DistributedOptimizer(optax.adam(1e-2), axis_name="dp")
+        state_specs = P()
 
     def step(p_, s_, x, y):
         pos = jax.lax.axis_index("sp") * (S // sp) + jnp.arange(S // sp)
@@ -79,15 +86,24 @@ def main():
 
     f = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), P("dp", "sp"), P("dp", "sp")),
-        out_specs=(P(), P(), P()), check_vma=False))
+        in_specs=(P(), state_specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), state_specs, P()), check_vma=False))
+
+    if args.zero1:
+        init_f = jax.jit(jax.shard_map(
+            lambda p_: (tx.init(p_),), mesh=mesh, in_specs=(P(),),
+            out_specs=(state_specs,), check_vma=False))
+        (opt_state,) = init_f(params)
+    else:
+        opt_state = tx.init(params)
 
     for i in range(args.steps):
         params, opt_state, loss = f(params, opt_state,
                                     toks[:, :-1], toks[:, 1:])
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.4f}")
-    print(f"done: dp={dp} sp={sp} seq={S}")
+    print(f"done: dp={dp} sp={sp} seq={S}"
+          + (" zero1" if args.zero1 else ""))
 
 
 if __name__ == "__main__":

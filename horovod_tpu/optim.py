@@ -394,46 +394,65 @@ def broadcast_parameters(params, root_rank: int = 0,
 # Transforms that couple elements across the tree (global-norm clipping)
 # would compute shard-local statistics — compose those OUTSIDE.
 
-def _shard_leaf(x, axis_name: str):
-    """(full leaf) -> this rank's padded 1/n flat slice."""
+def _require_axis(axis_name: str, what: str) -> None:
+    if not _axes_bound(axis_name):
+        raise ValueError(
+            f"{what} must run inside the jitted SPMD region (shard_map/"
+            f"pjit binding axis {axis_name!r}) — the shard shapes and "
+            f"slices depend on the bound axis. Wrap the call in your "
+            f"spmd_step (see ShardedOptimizer docstring).")
+
+
+def _shard_flat(flat, axis_name: str):
+    """(1-D bucket) -> this rank's padded 1/n slice."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    flat = x.reshape(-1)
     flat, _ = fusion_lib.pad_to_multiple(flat, n)
     chunk = flat.shape[0] // n
     return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
 
 
-def sharded_init(tx, params, axis_name: str = "hvd"):
-    """Inner-optimizer state over PARAMETER SHARDS — call inside the
+def sharded_init(tx, params, axis_name: str = "hvd",
+                 fusion_threshold_bytes: Optional[int] = None):
+    """Inner-optimizer state over FUSED-BUCKET SHARDS — call inside the
     same shard_map/jit region as :func:`sharded_update` (the shard
-    shapes depend on the bound axis)."""
-    return tx.init(jax.tree.map(lambda p: _shard_leaf(p, axis_name),
-                                params))
+    shapes depend on the bound axis). State structure = the inner
+    transform's state over a list of per-bucket shard arrays."""
+    _require_axis(axis_name, "sharded_init")
+    threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
+    plan = fusion_lib.plan_fusion(params, threshold)
+    flats = fusion_lib.fuse(params, plan)
+    return tx.init([_shard_flat(f, axis_name) for f in flats])
 
 
 def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
-                   grad_op: C.ReduceOp = C.ReduceOp.AVERAGE):
-    """ZeRO-1 step: RS(grads) -> inner update on this rank's shard ->
-    AG(updates). Returns ``(updates, new_state)`` with ``updates``
-    shaped like ``params`` (apply with ``optax.apply_updates``)."""
+                   grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                   fusion_threshold_bytes: Optional[int] = None,
+                   **extra):
+    """ZeRO-1 step over fused buckets: RS(bucket grads) -> inner update
+    on this rank's shards -> AG(bucket updates). A few large collectives
+    instead of one pair per leaf (same bucketing as the replicated
+    path). Returns ``(updates, new_state)`` with ``updates`` shaped like
+    ``params`` (apply with ``optax.apply_updates``)."""
     if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
         raise ValueError("sharded_update supports SUM/AVERAGE")
+    _require_axis(axis_name, "sharded_update")
     n = jax.lax.axis_size(axis_name)
+    threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
+    plan = fusion_lib.plan_fusion(grads, threshold)
+    g_flats = fusion_lib.fuse(grads, plan)
+    p_flats = fusion_lib.fuse(params, plan)
 
-    def rs(g):
-        flat, _ = fusion_lib.pad_to_multiple(g.reshape(-1), n)
-        return C.reducescatter(flat, grad_op, axis_name)
+    def rs(f):
+        padded, _ = fusion_lib.pad_to_multiple(f, n)
+        return C.reducescatter(padded, grad_op, axis_name)
 
-    g_shards = jax.tree.map(rs, grads)
-    p_shards = jax.tree.map(lambda p: _shard_leaf(p, axis_name), params)
-    u_shards, new_state = tx.update(g_shards, state, p_shards)
-
-    def ag(u, p):
-        return C.allgather(u, axis_name)[:p.size].reshape(p.shape)
-
-    updates = jax.tree.map(ag, u_shards, params)
-    return updates, new_state
+    g_shards = [rs(f) for f in g_flats]
+    p_shards = [_shard_flat(f, axis_name) for f in p_flats]
+    u_shards, new_state = tx.update(g_shards, state, p_shards, **extra)
+    u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
+               for u, f in zip(u_shards, g_flats)]
+    return fusion_lib.unfuse(u_flats, plan), new_state
 
 
 class ShardedOptimizer:
@@ -447,32 +466,39 @@ class ShardedOptimizer:
     """
 
     def __init__(self, inner, axis_name: str = "hvd",
-                 grad_op: C.ReduceOp = C.ReduceOp.AVERAGE):
+                 grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                 fusion_threshold_bytes: Optional[int] = None):
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
+        self.fusion_threshold_bytes = fusion_threshold_bytes
 
     def init(self, params):
-        return sharded_init(self.inner, params, self.axis_name)
+        return sharded_init(self.inner, params, self.axis_name,
+                            self.fusion_threshold_bytes)
+
+    def update(self, grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError("ShardedOptimizer.update requires params "
+                             "(the shard slices come from them)")
+        return sharded_update(self.inner, grads, state, params,
+                              self.axis_name, self.grad_op,
+                              self.fusion_threshold_bytes, **extra)
 
     def state_specs(self, params):
         """PartitionSpecs for carrying the sharded state through
         shard_map: vector leaves are P(axis) (each rank owns its slice;
         the global array is the shard concatenation), scalar leaves
-        (step counters) replicate. Only leaf RANK matters, so the probe
-        shapes need no world size — callable before init()."""
+        (step counters) replicate. The probe uses the same fusion plan
+        as init/update so the state STRUCTURE (one shard per bucket)
+        matches; only leaf rank matters, so shard length 1 suffices —
+        callable before init()."""
         from jax.sharding import PartitionSpec as P
 
-        shapes = jax.eval_shape(
-            self.inner.init,
-            jax.tree.map(lambda p: jax.ShapeDtypeStruct((1,), p.dtype),
-                         params))
+        threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
+        plan = fusion_lib.plan_fusion(params, threshold)
+        probe = [jax.ShapeDtypeStruct((1,), b.dtype)
+                 for b in plan.buckets]
+        shapes = jax.eval_shape(self.inner.init, probe)
         return jax.tree.map(
             lambda s: P(self.axis_name) if s.ndim else P(), shapes)
-
-    def update(self, grads, state, params=None):
-        if params is None:
-            raise ValueError("ShardedOptimizer.update requires params "
-                             "(the shard slices come from them)")
-        return sharded_update(self.inner, grads, state, params,
-                              self.axis_name, self.grad_op)

@@ -62,3 +62,9 @@ def test_gpt_long_context_example():
     out = _run(["examples/gpt_long_context.py", "--steps", "6",
                 "--seq-len", "32"])
     assert "done: dp=2 sp=4 seq=32" in out
+
+
+def test_gpt_long_context_zero1_example():
+    out = _run(["examples/gpt_long_context.py", "--steps", "6",
+                "--seq-len", "32", "--zero1"])
+    assert "done: dp=2 sp=4 seq=32 zero1" in out

@@ -127,9 +127,6 @@ def test_sharded_optimizer_matches_replicated(hvd):
     """ShardedOptimizer (RS grads -> shard update -> AG updates) must
     follow the replicated DistributedOptimizer's trajectory exactly for
     an elementwise inner (adam)."""
-    import optax
-    from jax.sharding import PartitionSpec as P
-
     ax = hvd.rank_axis()
     rng = np.random.default_rng(0)
     X = rng.standard_normal((16, 10)).astype(np.float32)
@@ -191,8 +188,9 @@ def test_sharded_optimizer_matches_replicated(hvd):
 
 
 def test_sharded_optimizer_requires_params(hvd):
-    import optax
-
     tx = hvd.ShardedOptimizer(optax.sgd(0.1))
     with pytest.raises(ValueError, match="requires params"):
         tx.update({}, None)
+    # Outside an SPMD region the error names the fix, not a NameError.
+    with pytest.raises(ValueError, match="inside the jitted SPMD"):
+        tx.init({"w": jnp.zeros((4,))})
