@@ -439,8 +439,13 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
     _require_axis(axis_name, "sharded_update")
     n = jax.lax.axis_size(axis_name)
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
-    plan = fusion_lib.plan_fusion(grads, threshold)
-    g_flats = fusion_lib.fuse(grads, plan)
+    # Plan over PARAMS (grads share the treedef): the state was built
+    # over the params plan, and a grad leaf cast to another dtype must
+    # not change the bucket structure out from under the carried state.
+    plan = fusion_lib.plan_fusion(params, threshold)
+    g_flats = fusion_lib.fuse(
+        jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params),
+        plan)
     p_flats = fusion_lib.fuse(params, plan)
 
     def rs(f):
@@ -471,7 +476,12 @@ class ShardedOptimizer:
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
-        self.fusion_threshold_bytes = fusion_threshold_bytes
+        # Pinned ONCE (like the DistributedOptimizer factory): the state
+        # layout is one shard per bucket, so a live autotuner moving the
+        # threshold between traces must not replan the buckets out from
+        # under the carried state.
+        self.fusion_threshold_bytes = _resolve_fusion_threshold(
+            fusion_threshold_bytes)
 
     def init(self, params):
         return sharded_init(self.inner, params, self.axis_name,
