@@ -1,0 +1,90 @@
+"""Tensor parallelism — Megatron-style column/row-parallel layers.
+
+The reference has no TP (SURVEY.md §2.7: "Nothing shards weights within
+an op"); on TPU it is a mesh axis away. The canonical transformer
+pattern pairs the two shardings so one allreduce covers a whole MLP
+block (or attention block):
+
+  column-parallel W1 (out-features sharded, no comm)
+      -> nonlinearity on the local shard
+  row-parallel W2 (in-features sharded, psum the partial outputs)
+
+These are per-rank functions for use inside shard_map over a ``tp``
+axis; weights arrive already sharded (the caller shards with
+P(..., "tp") / P("tp", ...) specs — XLA's GSPMD can do the same from
+annotations, but the explicit form composes with this framework's
+per-rank collectives and keeps the comm visible).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def column_parallel(x, w_shard, b_shard=None):
+    """y_shard = x @ W[:, shard] (+ b[shard]) — out-features sharded
+    over the tp axis; input replicated; NO communication."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel(x_shard, w_shard, axis_name: str = "tp", b=None):
+    """y = psum_tp(x[shard] @ W[shard, :]) (+ b) — in-features sharded;
+    each rank holds the matching activation shard; ONE allreduce
+    produces the replicated output (the Megatron g-operator)."""
+    y = lax.psum(x_shard @ w_shard, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tp_mlp(x, w1_shard, b1_shard, w2_shard, b2,
+           axis_name: str = "tp",
+           activation: Callable = jax.nn.gelu):
+    """The paired block: column-parallel in, row-parallel out — exactly
+    one allreduce for the whole MLP regardless of width."""
+    h = activation(column_parallel(x, w1_shard, b1_shard))
+    return row_parallel(h, w2_shard, axis_name, b2)
+
+
+def shard_column(w, axis_name: str = "tp"):
+    """Slice a replicated (..., out) weight to this rank's out-feature
+    shard — for initializing TP from a replicated checkpoint."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if w.shape[-1] % n:
+        raise ValueError(f"out dim {w.shape[-1]} not divisible by tp "
+                         f"size {n} (a silent truncation would drop "
+                         f"features)")
+    chunk = w.shape[-1] // n
+    return lax.dynamic_slice_in_dim(w, idx * chunk, chunk,
+                                    axis=w.ndim - 1)
+
+
+def shard_row(w, axis_name: str = "tp"):
+    """Slice a replicated (in, out) weight to this rank's in-feature
+    shard."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if w.shape[0] % n:
+        raise ValueError(f"in dim {w.shape[0]} not divisible by tp "
+                         f"size {n}")
+    chunk = w.shape[0] // n
+    return lax.dynamic_slice_in_dim(w, idx * chunk, chunk, axis=0)
+
+
+def tp_attention_qkv(x, wq_shard, wk_shard, wv_shard, num_heads_local):
+    """Column-parallel QKV: heads shard over tp (each rank computes its
+    head subset); pair with a row-parallel output projection."""
+    b, s, _ = x.shape
+
+    def split(w):
+        y = x @ w
+        return y.reshape(b, s, num_heads_local, -1)
+
+    return split(wq_shard), split(wk_shard), split(wv_shard)

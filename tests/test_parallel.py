@@ -456,3 +456,142 @@ def test_gpt_ulysses_matches_single_device(sp_mesh, hvd):
     got = f(toks, positions)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_tp_mlp_matches_unsharded(rng):
+    """Megatron-style TP block (column-parallel -> gelu -> row-parallel)
+    over tp=8 == the unsharded MLP, with exactly one allreduce."""
+    from horovod_tpu.parallel.tensor_parallel import (shard_column,
+                                                      shard_row, tp_mlp)
+
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    b, d, h = 4, 16, 32  # hidden 32 shards to 4 per rank
+    x = rng.standard_normal((b, d)).astype(np.float32)
+    W1 = rng.standard_normal((d, h)).astype(np.float32) * 0.3
+    b1 = rng.standard_normal((h,)).astype(np.float32) * 0.1
+    W2 = rng.standard_normal((h, d)).astype(np.float32) * 0.3
+    b2 = rng.standard_normal((d,)).astype(np.float32) * 0.1
+
+    want = jax.nn.gelu(x @ W1 + b1) @ W2 + b2
+
+    def fwd(x, W1, b1, W2, b2):
+        return tp_mlp(x, shard_column(W1, "tp"), shard_column(b1, "tp"),
+                      shard_row(W2, "tp"), b2, "tp")
+
+    f = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False))
+    got = f(x, W1, b1, W2, b2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    # Exactly ONE all-reduce in the compiled TP block (reuse f).
+    import re
+
+    hlo = f.lower(x, W1, b1, W2, b2).compile().as_text()
+    n_ar = len(re.findall(r"= \S+ all-reduce\(", hlo))
+    assert n_ar == 1, f"expected 1 allreduce, compiled {n_ar}"
+
+    # Non-divisible shard dims fail loudly, never truncate.
+    bad = jax.jit(jax.shard_map(
+        lambda w: shard_column(w, "tp"), mesh=mesh, in_specs=P(),
+        out_specs=P("tp"), check_vma=False))
+    with pytest.raises(ValueError, match="not divisible"):
+        bad(jnp.zeros((4, 30), jnp.float32))
+
+
+def test_tp_attention_block_matches_unsharded(rng):
+    """Full TP attention: column-parallel QKV (heads shard over tp=8) +
+    row-parallel output projection == the unsharded block, one
+    allreduce."""
+    from horovod_tpu.ops.flash_attention import reference_attention
+    from horovod_tpu.parallel.tensor_parallel import (row_parallel,
+                                                      shard_column,
+                                                      shard_row,
+                                                      tp_attention_qkv)
+
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    b, s, d, heads, hd = 2, 8, 16, 8, 4
+    x = rng.standard_normal((b, s, d)).astype(np.float32)
+    Wq, Wk, Wv = (rng.standard_normal((d, heads * hd)).astype(np.float32)
+                  * 0.3 for _ in range(3))
+    Wo = rng.standard_normal((heads * hd, d)).astype(np.float32) * 0.3
+
+    # Unsharded reference block.
+    def full_block(x):
+        q = (x @ Wq).reshape(b, s, heads, hd)
+        k = (x @ Wk).reshape(b, s, heads, hd)
+        v = (x @ Wv).reshape(b, s, heads, hd)
+        o = reference_attention(q, k, v).reshape(b, s, heads * hd)
+        return o @ Wo
+
+    want = full_block(jnp.asarray(x))
+
+    def fwd(x, Wq, Wk, Wv, Wo):
+        n = jax.lax.axis_size("tp")
+        q, k, v = tp_attention_qkv(
+            x, shard_column(Wq, "tp"), shard_column(Wk, "tp"),
+            shard_column(Wv, "tp"), heads // n)
+        o = reference_attention(q, k, v)
+        o = o.reshape(b, s, (heads // n) * hd)
+        return row_parallel(o, shard_row(Wo, "tp"), "tp")
+
+    f = jax.jit(jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P(),) * 5, out_specs=P(),
+        check_vma=False))
+    got = f(x, Wq, Wk, Wv, Wo)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tp_dp_2d_training(hvd, rng):
+    """2-D (dp, tp) training: weights shard over tp, gradients average
+    over dp through DistributedOptimizer — loss drops and the TP shards
+    stay consistent."""
+    import optax
+    from horovod_tpu.parallel.tensor_parallel import (shard_column,
+                                                      shard_row, tp_mlp)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+    d, h = 8, 16
+    X = rng.standard_normal((8, d)).astype(np.float32)
+    Y = rng.standard_normal((8, 1)).astype(np.float32)
+    params = {
+        "W1": (rng.standard_normal((d, h)) * 0.3).astype(np.float32),
+        "b1": np.zeros((h,), np.float32),
+        "W2": (rng.standard_normal((h, 1)) * 0.3).astype(np.float32),
+        "b2": np.zeros((1,), np.float32),
+    }
+    params = jax.tree.map(jnp.asarray, params)
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2), axis_name="dp")
+    st = tx.init(params)
+
+    def step(p, s, xb, yb):
+        def loss_fn(p):
+            out = tp_mlp(xb, shard_column(p["W1"], "tp"),
+                         shard_column(p["b1"], "tp"),
+                         shard_row(p["W2"], "tp"), p["b2"], "tp")
+            return jnp.mean((out - yb) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        # SHARDED params (W1/b1/W2): each tp rank's grad is nonzero only
+        # on its slice of the replicated master, so psum over tp
+        # assembles the full gradient. REPLICATED params (b2, used after
+        # the row-parallel psum) already hold the full grad on every tp
+        # rank — psumming those would scale them by tp size.
+        g = {k: (jax.lax.psum(v, "tp") if k != "b2" else v)
+             for k, v in g.items()}
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.pmean(
+            l, ("dp", "tp"))
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    losses = []
+    p, s = params, st
+    for _ in range(25):
+        p, s, l = f(p, s, X, Y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
