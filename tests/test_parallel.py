@@ -504,7 +504,6 @@ def test_tp_attention_block_matches_unsharded(rng):
     """Full TP attention: column-parallel QKV (heads shard over tp=8) +
     row-parallel output projection == the unsharded block, one
     allreduce."""
-    from horovod_tpu.ops.flash_attention import reference_attention
     from horovod_tpu.parallel.tensor_parallel import (row_parallel,
                                                       shard_column,
                                                       shard_row,
@@ -595,3 +594,64 @@ def test_tp_dp_2d_training(hvd, rng):
         p, s, l = f(p, s, X, Y)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_3d_dp_tp_sp_block_matches_unsharded(rng):
+    """The full 3-D composition on one (dp=2, tp=2, sp=2) mesh: batch
+    shards over dp, attention heads over tp (column-parallel QKV +
+    row-parallel output), sequence over sp (causal ring attention inside
+    each head subset), followed by a tp MLP — the Megatron 3-D recipe,
+    forward-identical to the unsharded block."""
+    from horovod_tpu.parallel.tensor_parallel import (row_parallel,
+                                                      shard_column,
+                                                      shard_row,
+                                                      tp_attention_qkv,
+                                                      tp_mlp)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("dp", "tp", "sp"))
+    B, S, D, heads, hd, mlp_h = 4, 16, 8, 4, 4, 16
+    x = rng.standard_normal((B, S, D)).astype(np.float32)
+    Wq, Wk, Wv = (rng.standard_normal((D, heads * hd)).astype(np.float32)
+                  * 0.3 for _ in range(3))
+    Wo = rng.standard_normal((heads * hd, D)).astype(np.float32) * 0.3
+    W1 = rng.standard_normal((D, mlp_h)).astype(np.float32) * 0.3
+    b1 = np.zeros((mlp_h,), np.float32)
+    W2 = rng.standard_normal((mlp_h, D)).astype(np.float32) * 0.3
+    b2 = np.zeros((D,), np.float32)
+
+    def full_block(x):
+        q = (x @ Wq).reshape(B, S, heads, hd)
+        k = (x @ Wk).reshape(B, S, heads, hd)
+        v = (x @ Wv).reshape(B, S, heads, hd)
+        o = reference_attention(q, k, v, causal=True)
+        att = o.reshape(B, S, heads * hd) @ Wo
+        h = att + x
+        return h + jax.nn.gelu(h @ W1 + b1) @ W2 + b2
+
+    want = full_block(jnp.asarray(x))
+
+    def fwd(x, Wq, Wk, Wv, Wo, W1, b1, W2, b2):
+        # x arrives (B/dp, S/sp, D): batch- and sequence-local.
+        n_tp = jax.lax.axis_size("tp")
+        q, k, v = tp_attention_qkv(
+            x, shard_column(Wq, "tp"), shard_column(Wk, "tp"),
+            shard_column(Wv, "tp"), heads // n_tp)
+        # Causal over GLOBAL positions: ring attention stitches the
+        # sequence shards inside each tp head subset.
+        o = ring_attention(q, k, v, "sp", causal=True)
+        b_l, s_l = o.shape[0], o.shape[1]
+        att = row_parallel(o.reshape(b_l, s_l, -1),
+                           shard_row(Wo, "tp"), "tp")
+        h = att + x
+        return h + tp_mlp(h, shard_column(W1, "tp"),
+                          shard_column(b1, "tp"),
+                          shard_row(W2, "tp"), b2, "tp")
+
+    f = jax.jit(jax.shard_map(
+        fwd, mesh=mesh,
+        in_specs=(P("dp", "sp"),) + (P(),) * 8,
+        out_specs=P("dp", "sp"), check_vma=False))
+    got = f(x, Wq, Wk, Wv, Wo, W1, b1, W2, b2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
