@@ -112,8 +112,8 @@ def main():
     p.add_argument("--batches-per-iter", type=int, default=5)
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3",
-                            "bert_large", "bert_base", "gpt_small",
-                            "gpt_medium"])
+                            "vit_base", "bert_large", "bert_base",
+                            "gpt_small", "gpt_medium"])
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -343,11 +343,12 @@ def _setup_cnn(args, batch_size, n):
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
+    from horovod_tpu.models import (InceptionV3, ResNet50, ResNet101,
+                                    VGG16, vit_base)
 
     model = {"resnet50": ResNet50, "resnet101": ResNet101,
-             "vgg16": VGG16, "inception3": InceptionV3}[args.model](
-        num_classes=1000)
+             "vgg16": VGG16, "inception3": InceptionV3,
+             "vit_base": vit_base}[args.model](num_classes=1000)
     image_size = args.image_size or (
         299 if args.model == "inception3" else 224)
     rng = jax.random.PRNGKey(0)

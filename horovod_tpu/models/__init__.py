@@ -9,3 +9,4 @@ from .inception import InceptionV3  # noqa: F401
 from .mlp import MLP, ConvNet  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401
+from .vit import ViT, vit_base, vit_tiny  # noqa: F401

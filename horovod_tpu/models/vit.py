@@ -1,0 +1,76 @@
+"""Vision Transformer in Flax — fourth image-model family (the
+reference's benchmark set is CNN-only: ResNet/VGG/Inception,
+docs/benchmarks.rst; ViT is the post-reference standard and maps
+straight onto the MXU: one big conv for patch embedding, then the same
+TransformerLayer stack as models/bert.py with its attend_fn hook, so
+all the SP/TP machinery composes unchanged).
+
+TPU-first choices match the other models: bf16 compute / fp32 params,
+learned position embeddings, CLS-token head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .bert import TransformerLayer
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    attend_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        del train  # no dropout on the benchmark path (same as bert.py)
+        b, h, w = images.shape[:3]
+        if h % self.patch_size or w % self.patch_size:
+            raise ValueError(
+                f"image size {h}x{w} not divisible by patch_size "
+                f"{self.patch_size}; SAME-padding a partial patch would "
+                f"silently change the geometry")
+        x = nn.Conv(self.hidden, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(images.astype(self.dtype))
+        x = x.reshape(b, -1, self.hidden)            # (B, N patches, H)
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.hidden), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.hidden)).astype(self.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.hidden), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = TransformerLayer(self.num_heads, self.mlp_dim, self.dtype,
+                                 self.attend_fn, name=f"layer{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="final_ln")(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=jnp.float32,
+                        name="head")(x[:, 0]).astype(jnp.float32)
+
+
+def vit_base(**kw):
+    """ViT-B/16 geometry (~86M params)."""
+    return ViT(**kw)
+
+
+def vit_tiny(**kw):
+    """Test-sized ViT for the loopback tier."""
+    for k, v in (("patch_size", 8), ("hidden", 32), ("num_layers", 2),
+                 ("num_heads", 4), ("mlp_dim", 64), ("num_classes", 10),
+                 ("dtype", jnp.float32)):
+        kw.setdefault(k, v)
+    return ViT(**kw)

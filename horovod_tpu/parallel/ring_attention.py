@@ -195,15 +195,7 @@ def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
     return attend
 
 
-def reference_attention(q, k, v, causal: bool = False):
-    """Single-device reference for tests: q/k/v (B, S, H, D) full sequence.
-    """
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    if causal:
-        s = q.shape[1]
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+# Single source of truth for the numerics oracle: the flash-attention
+# module's reference (a superset — it also takes a key mask). Re-exported
+# here because the SP tests historically import it from this module.
+from ..ops.flash_attention import reference_attention  # noqa: E402,F401

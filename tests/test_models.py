@@ -290,3 +290,48 @@ def test_gpt_trains_distributed(hvd):
         params, st, l = step(params, st, toks)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+# -- ViT (models/vit.py) ----------------------------------------------------
+
+def test_vit_forward_and_distributed_training(hvd):
+    """ViT forward shapes + one-epoch DP training drops the loss; the
+    attend_fn hook accepts the Ulysses adapter like bert/gpt (patch
+    count +cls = 17 tokens is not sp-divisible, so SP composition is
+    exercised at the attend level elsewhere — here DP only)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import vit_tiny
+
+    m = vit_tiny()
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (16, 32, 32, 3), jnp.float32)
+    y = jax.random.randint(rng, (16,), 0, 10)
+    params = m.init(rng, x[:2])["params"]
+    logits = m.apply({"params": params}, x[:2])
+    assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+
+    ax = hvd.rank_axis()
+    tx = hvd.DistributedOptimizer(optax.adam(3e-3), axis_name=ax)
+    st = tx.init(params)
+
+    @hvd.spmd_step(in_specs=(P(), P(), P(ax), P(ax)),
+                   out_specs=(P(), P(), P()))
+    def step(p, s, xb, yb):
+        def loss_fn(p):
+            lg = m.apply({"params": p}, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg, yb).mean()
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.pmean(l, ax)
+
+    losses = []
+    for _ in range(12):
+        params, st, l = step(params, st, x, y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.8, losses
