@@ -116,9 +116,24 @@ class Context:
         client = RendezvousClient(host, int(port), timeout_s=5.0)
         last_seen = {"v": None}
 
+        warned = {"auth": False}
+
         def notifier() -> bool:
+            import urllib.error
+
             try:
                 raw = client.get("elastic", "topology_version")
+            except urllib.error.HTTPError as e:
+                if e.code == 403 and not warned["auth"]:
+                    # A silent False would permanently disable topology
+                    # notification — a wrong/missing
+                    # HVD_TPU_RENDEZVOUS_SECRET must be loud.
+                    warned["auth"] = True
+                    logger.warning(
+                        "elastic host-update polling rejected (403): "
+                        "HVD_TPU_RENDEZVOUS_SECRET missing or mismatched"
+                        " — topology changes will NOT be observed")
+                return False
             except OSError:
                 return False
             if raw is None:

@@ -425,12 +425,23 @@ def run_elastic(args, command: List[str],
 
     driver = ElasticDriver(discovery, min_np, max_np)
     driver.start_discovery()
-    rdv = RendezvousServer("127.0.0.1")
+    # Per-job HMAC secret (reference runner/common/util/secret.py): the
+    # KV coordinates worker lifecycle, so an unauthenticated writer on
+    # the network could fake topology changes.
+    import secrets as _secrets
+
+    # The driver keeps the secret out of its own os.environ: the server
+    # and workers get it explicitly, and a lingering env entry would
+    # leak into every later subprocess and make any secretless
+    # server/client in this process silently adopt a stale key.
+    job_secret = _secrets.token_hex(16)
+    rdv = RendezvousServer("127.0.0.1", secret=job_secret.encode())
     rdv_port = rdv.start()
     topo_version = 0
     rdv.put("elastic", "topology_version", str(topo_version).encode())
     env_extra = dict(env_extra)
     env_extra["HVD_TPU_RENDEZVOUS"] = f"127.0.0.1:{rdv_port}"
+    env_extra["HVD_TPU_RENDEZVOUS_SECRET"] = job_secret
 
     def bump_version():
         nonlocal topo_version

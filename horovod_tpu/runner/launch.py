@@ -150,14 +150,29 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     for i, hostname in enumerate(hosts):
         env = build_env_for_slot({}, coord, num_proc, i,
                                  {**env_extra, **_slot_local_env(0, 1)})
-        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        # *_SECRET vars must not ride the remote argv (any local user on
+        # the worker reads it via ps); they travel over ssh stdin as one
+        # export line the bootstrap evals before exec'ing the command.
+        secrets = {k: v for k, v in env.items() if k.endswith("_SECRET")}
+        plain = {k: v for k, v in env.items() if k not in secrets}
+        env_str = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in plain.items())
         remote_cmd = f"cd {shlex.quote(os.getcwd())} && {env_str} " + \
             " ".join(shlex.quote(c) for c in command)
+        input_data = None
+        if secrets:
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in secrets.items())
+            remote_cmd = ('IFS= read -r __HVD_SECRET_ENV__ && '
+                          'eval "export $__HVD_SECRET_ENV__"; '
+                          + remote_cmd)
+            input_data = (exports + "\n").encode()
         ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
         if ssh_port:
             ssh_cmd += ["-p", str(ssh_port)]
         ssh_cmd += [hostname, remote_cmd]
-        handles.append(sse.spawn(ssh_cmd, prefix=hostname))
+        handles.append(sse.spawn(ssh_cmd, prefix=hostname,
+                                 input_data=input_data))
     return _wait_fail_fast(handles, [h.thread for h in handles])
 
 

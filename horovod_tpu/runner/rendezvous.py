@@ -9,15 +9,44 @@ notification — so the same minimal KV protocol is provided.
 
 Protocol: PUT /kv/<scope>/<key> (body = value bytes), GET returns 200+body
 or 404, DELETE removes. GET /kv/<scope>?list=1 returns JSON key list.
+
+Authentication: like the reference's service layer (runner/common/util/
+secret.py + network.py — every message carries an HMAC over its
+content), requests may carry ``X-HVD-Auth: HMAC-SHA256(secret,
+method|path?query|body)``. A server constructed with a secret (or with
+HVD_TPU_RENDEZVOUS_SECRET set) rejects missing/invalid digests with
+403; the launcher generates a fresh per-job secret and hands it to the
+workers through their env.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
+
+_AUTH_HEADER = "X-HVD-Auth"
+
+
+def _env_secret() -> Optional[bytes]:
+    s = os.environ.get("HVD_TPU_RENDEZVOUS_SECRET", "")
+    return s.encode() if s else None
+
+
+def _digest(secret: bytes, method: str, path_qs: str,
+            body: bytes) -> str:
+    mac = hmac.new(secret, digestmod=hashlib.sha256)
+    mac.update(method.encode())
+    mac.update(b"|")
+    mac.update(path_qs.encode())
+    mac.update(b"|")
+    mac.update(body)
+    return mac.hexdigest()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -32,12 +61,26 @@ class _Handler(BaseHTTPRequestHandler):
     def _lock(self) -> threading.Lock:
         return self.server.kv_lock  # type: ignore[attr-defined]
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        secret = self.server.kv_secret  # type: ignore[attr-defined]
+        if secret is None:
+            return True
+        given = self.headers.get(_AUTH_HEADER, "")
+        want = _digest(secret, self.command, self.path, body)
+        if hmac.compare_digest(given, want):
+            return True
+        self.send_response(403)
+        self.end_headers()
+        return False
+
     def do_PUT(self):
         parsed = urlparse(self.path)
         path = parsed.path
         nx = bool(parse_qs(parsed.query).get("nx"))
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._authorized(body):
+            return
         with self._lock():
             if nx and path in self._store():
                 # Atomic put-if-absent: first writer wins; the loser gets
@@ -54,6 +97,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if not self._authorized():
+            return
         parsed = urlparse(self.path)
         qs = parse_qs(parsed.query)
         with self._lock():
@@ -78,6 +123,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(val)
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         path = urlparse(self.path).path
         with self._lock():
             existed = self._store().pop(path, None) is not None
@@ -89,8 +136,10 @@ class RendezvousServer:
     """Reference: http/http_server.py RendezvousServer (start/stop,
     ephemeral port)."""
 
-    def __init__(self, host: str = "0.0.0.0"):
+    def __init__(self, host: str = "0.0.0.0",
+                 secret: Optional[bytes] = None):
         self._host = host
+        self._secret = secret if secret is not None else _env_secret()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -98,6 +147,7 @@ class RendezvousServer:
         self._server = ThreadingHTTPServer((self._host, port), _Handler)
         self._server.kv_store = {}          # type: ignore[attr-defined]
         self._server.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.kv_secret = self._secret  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -127,30 +177,38 @@ class RendezvousServer:
 
 
 class RendezvousClient:
-    """Worker-side client (reference: http/http_client.py)."""
+    """Worker-side client (reference: http/http_client.py). Signs every
+    request when a secret is configured (argument or
+    HVD_TPU_RENDEZVOUS_SECRET)."""
 
-    def __init__(self, addr: str, port: int, timeout_s: float = 30.0):
+    def __init__(self, addr: str, port: int, timeout_s: float = 30.0,
+                 secret: Optional[bytes] = None):
         self.base = f"http://{addr}:{port}"
         self.timeout_s = timeout_s
+        self._secret = secret if secret is not None else _env_secret()
 
-    def put(self, scope: str, key: str, value: bytes) -> None:
+    def _request(self, path_qs: str, method: str,
+                 data: Optional[bytes] = None):
         import urllib.request
 
-        req = urllib.request.Request(
-            f"{self.base}/kv/{scope}/{key}", data=value, method="PUT")
-        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        req = urllib.request.Request(self.base + path_qs, data=data,
+                                     method=method)
+        if self._secret is not None:
+            req.add_header(_AUTH_HEADER,
+                           _digest(self._secret, method, path_qs,
+                                   data or b""))
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        self._request(f"/kv/{scope}/{key}", "PUT", value).read()
 
     def put_if_absent(self, scope: str, key: str, value: bytes) -> bytes:
         """Atomic first-writer-wins PUT; returns the WINNING value (the
         caller's on success, the already-stored one on conflict)."""
         import urllib.error
-        import urllib.request
 
-        req = urllib.request.Request(
-            f"{self.base}/kv/{scope}/{key}?nx=1", data=value,
-            method="PUT")
         try:
-            urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            self._request(f"/kv/{scope}/{key}?nx=1", "PUT", value).read()
             return value
         except urllib.error.HTTPError as e:
             if e.code == 409:
@@ -159,12 +217,9 @@ class RendezvousClient:
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         import urllib.error
-        import urllib.request
 
         try:
-            return urllib.request.urlopen(
-                f"{self.base}/kv/{scope}/{key}",
-                timeout=self.timeout_s).read()
+            return self._request(f"/kv/{scope}/{key}", "GET").read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
@@ -185,19 +240,14 @@ class RendezvousClient:
             time.sleep(0.05)
 
     def list(self, scope: str) -> list:
-        import urllib.request
-
-        data = urllib.request.urlopen(
-            f"{self.base}/kv/{scope}?list=1", timeout=self.timeout_s).read()
-        return json.loads(data)
+        return json.loads(self._request(f"/kv/{scope}?list=1",
+                                        "GET").read())
 
     def delete(self, scope: str, key: str) -> None:
         import urllib.error
-        import urllib.request
 
-        req = urllib.request.Request(
-            f"{self.base}/kv/{scope}/{key}", method="DELETE")
         try:
-            urllib.request.urlopen(req, timeout=self.timeout_s).read()
-        except urllib.error.HTTPError:
-            pass
+            self._request(f"/kv/{scope}/{key}", "DELETE").read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # 403 etc. must surface, only absent is ok
+                raise

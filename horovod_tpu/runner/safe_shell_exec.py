@@ -89,26 +89,35 @@ class SpawnedProcess:
 
 def spawn(command: List[str], env: Optional[Dict[str, str]] = None,
           prefix: Optional[str] = None, use_pty: bool = True,
-          sink=None) -> SpawnedProcess:
+          sink=None, input_data: Optional[bytes] = None) -> SpawnedProcess:
     """Start ``command`` under a pseudo-terminal (children see a tty →
     line buffering, progress bars) in its own process group, with a pump
-    thread prefixing output lines. Returns the control handle."""
+    thread prefixing output lines. Returns the control handle.
+    ``input_data`` is written to the child's stdin then closed — the
+    channel secrets travel on (they must never ride argv, which any
+    local user can read via ps)."""
     sink = sink or sys.stdout
+    stdin = subprocess.PIPE if input_data is not None else None
     if use_pty:
         try:
             master, slave = pty.openpty()
         except OSError:  # no pty available (containers without devpts)
             use_pty = False
     if use_pty:
-        proc = subprocess.Popen(command, env=env, stdout=slave,
+        proc = subprocess.Popen(command, env=env, stdin=stdin,
+                                stdout=slave,
                                 stderr=slave, start_new_session=True)
         os.close(slave)
         fd = master
     else:
-        proc = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
+        proc = subprocess.Popen(command, env=env, stdin=stdin,
+                                stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT,
                                 start_new_session=True)
         fd = proc.stdout.fileno()
+    if input_data is not None:
+        proc.stdin.write(input_data)
+        proc.stdin.close()
 
     def pump_and_close():
         try:

@@ -67,8 +67,10 @@ def negotiate_coordinator(client: RendezvousClient, index: int,
 
 def _make_mapper(rdv_addr: Tuple[str, int], num_proc: int, fn, args,
                  kwargs, env_extra: Optional[Dict[str, str]],
-                 start_timeout: float):
-    """Builds the partition mapper executed inside each Spark task."""
+                 start_timeout: float, secret: Optional[str] = None):
+    """Builds the partition mapper executed inside each Spark task. The
+    per-job KV secret travels in the closure (executors don't share the
+    driver's env)."""
     import cloudpickle
 
     payload = cloudpickle.dumps((fn, args, kwargs or {}))
@@ -77,7 +79,9 @@ def _make_mapper(rdv_addr: Tuple[str, int], num_proc: int, fn, args,
     def mapper(index, _iterator):
         import cloudpickle as cp
 
-        client = RendezvousClient(host, port, timeout_s=30.0)
+        client = RendezvousClient(host, port, timeout_s=30.0,
+                                  secret=secret.encode() if secret
+                                  else None)
         env = negotiate_coordinator(client, index, num_proc,
                                     timeout_s=start_timeout)
         if env_extra:
@@ -122,13 +126,17 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     # executors can reach (spark.driver.host).
     driver_host = spark_context.getConf().get("spark.driver.host",
                                               socket.gethostname())
-    rdv = RendezvousServer("0.0.0.0")
+    import secrets as _secrets
+
+    job_secret = _secrets.token_hex(16)
+    rdv = RendezvousServer("0.0.0.0", secret=job_secret.encode())
     rdv_port = rdv.start()
     job_group = "horovod_tpu.spark"
     holder: Dict[str, Any] = {}
     try:
         mapper = _make_mapper((driver_host, rdv_port), num_proc, fn,
-                              args, kwargs, env, start_timeout)
+                              args, kwargs, env, start_timeout,
+                              secret=job_secret)
         rdd = spark_context.parallelize(range(num_proc),
                                         numSlices=num_proc)
 

@@ -461,3 +461,26 @@ def test_single_host_pod_runs_local(monkeypatch):
         lambda np, *a, **kw: (calls.setdefault("np", np), 0)[1])
     assert launch.run_commandline(["python", "-c", "pass"]) == 0
     assert calls["np"] == 8
+
+
+def test_rendezvous_hmac_auth():
+    """Per-job HMAC auth (reference runner/common/util/secret.py role):
+    a matching secret round-trips, a missing or wrong one gets 403."""
+    import urllib.error
+
+    srv = RendezvousServer("127.0.0.1", secret=b"sesame")
+    port = srv.start()
+    try:
+        good = RendezvousClient("127.0.0.1", port, secret=b"sesame")
+        good.put("s", "k", b"v")
+        assert good.get("s", "k") == b"v"
+        assert good.list("s") == ["k"]
+        assert good.put_if_absent("s", "k", b"w") == b"v"
+
+        for bad in (RendezvousClient("127.0.0.1", port, secret=b"wrong"),
+                    RendezvousClient("127.0.0.1", port, secret=None)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                bad.get("s", "k")
+            assert ei.value.code == 403
+    finally:
+        srv.stop()
