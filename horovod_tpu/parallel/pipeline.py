@@ -70,6 +70,108 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     return outs
 
 
+def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
+                             stage_params, x_micro, y_micro,
+                             axis_name: str = "pp"):
+    """One training step under a REAL 1F1B (PipeDream-flush) schedule.
+
+    Unlike :func:`pipeline_apply` + autodiff (GPipe semantics: all
+    forwards, then all backwards — activation memory grows with
+    ``n_micro``), this interleaves one backward between forwards in
+    steady state, so at most ``n_stages`` microbatch activations are
+    live per device (the 1F1B memory bound). Backward recomputes the
+    stage forward from the stored INPUT activation (Megatron-style
+    activation recomputation), so only inputs are buffered.
+
+    Lockstep SPMD schedule, one global tick loop of
+    ``2*(n_micro + n_stages - 1)`` ticks:
+
+    - stage ``s`` runs FORWARD of microbatch ``f`` at tick ``2f + s``
+    - stage ``s`` runs BACKWARD of microbatch ``b`` at tick
+      ``2b + 2n - 1 - s``
+
+    The parities of the two tick sets differ on every device, so each
+    device strictly alternates F-tick / B-tick in steady state — one
+    forward, one backward. Activations advance via ``ppermute`` (+1)
+    each tick; output cotangents flow via ``ppermute`` (-1). An
+    activation stored at tick ``2f+s`` is consumed at ``2f+2n-1-s`` and
+    its ring slot (``f mod n``) is overwritten no earlier than
+    ``2f+2n+s`` — the ``n``-slot ring is exactly the 1F1B bound.
+
+    Args:
+      stage_fn: (params, activation) -> activation, same signature on
+        every device (homogeneous-stage SPMD restriction).
+      loss_fn: (last_stage_out (B, ...), y (B, ...)) -> scalar loss for
+        ONE microbatch.
+      stage_params: this device's stage parameters (sharded over
+        ``axis_name`` outside).
+      x_micro: (n_micro, B, ...) microbatch inputs (consumed on stage 0).
+      y_micro: (n_micro, B, ...) targets (consumed on the LAST stage).
+
+    Returns ``(grads, loss_sum)``: grads = d(sum of microbatch losses)/
+    d(stage_params) for THIS device's stage; loss_sum = the summed loss
+    (valid on the last stage; use :func:`select_last_stage`-style psum
+    or divide by ``n_micro`` for the mean). Every device pays one
+    stage_fn eval + one recompute-VJP per tick (the standard cost of a
+    lockstep SPMD schedule: unscheduled slots run gated dummy work).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_micro.shape[0]
+    state_shape = x_micro.shape[1:]
+    total = 2 * (m + n - 1)
+
+    fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+    bwd_perm = [(j, (j - 1) % n) for j in range(n)]
+
+    acts0 = jnp.zeros((n,) + state_shape, x_micro.dtype)
+    carry_f0 = jnp.zeros(state_shape, x_micro.dtype)
+    carry_b0 = jnp.zeros(state_shape, x_micro.dtype)
+    grads0 = jax.tree.map(jnp.zeros_like, stage_params)
+    loss0 = jnp.zeros((), jnp.float32)
+
+    def body(t, loop):
+        carry_f, carry_b, acts, grads, loss_sum = loop
+
+        # ---- forward slot: microbatch f at tick 2f + idx -------------
+        tf_ = t - idx
+        f = jnp.clip(tf_ // 2, 0, m - 1)
+        do_f = (tf_ >= 0) & (tf_ % 2 == 0) & (tf_ // 2 < m)
+        inp = jnp.where(idx == 0, x_micro[f], carry_f)
+        out_f = stage_fn(stage_params, inp)
+        acts = lax.cond(
+            do_f,
+            lambda a: lax.dynamic_update_index_in_dim(a, inp, f % n, 0),
+            lambda a: a, acts)
+
+        # ---- backward slot: microbatch b at tick 2b + 2n - 1 - idx ---
+        tb_ = t - (2 * n - 1 - idx)
+        b = jnp.clip(tb_ // 2, 0, m - 1)
+        do_b = (tb_ >= 0) & (tb_ % 2 == 0) & (tb_ // 2 < m)
+        inp_b = acts[b % n]
+        out_b, vjp_fn = jax.vjp(stage_fn, stage_params, inp_b)
+        loss_b, g_last = jax.value_and_grad(
+            lambda o: loss_fn(o, y_micro[b]))(out_b)
+        g_out = jnp.where(idx == n - 1, g_last,
+                          carry_b.astype(g_last.dtype))
+        dp, dx = vjp_fn(g_out.astype(out_b.dtype))
+        grads = jax.tree.map(
+            lambda G, d: G + jnp.where(do_b, d, jnp.zeros_like(d)),
+            grads, dp)
+        loss_sum = loss_sum + jnp.where(
+            do_b & (idx == n - 1), loss_b.astype(jnp.float32), 0.0)
+
+        # ---- advance the two wavefronts ------------------------------
+        carry_f = lax.ppermute(out_f, axis_name, fwd_perm)
+        carry_b = lax.ppermute(dx.astype(carry_b.dtype), axis_name,
+                               bwd_perm)
+        return carry_f, carry_b, acts, grads, loss_sum
+
+    _, _, _, grads, loss_sum = lax.fori_loop(
+        0, total, body, (carry_f0, carry_b0, acts0, grads0, loss0))
+    return grads, loss_sum
+
+
 def select_last_stage(outs, axis_name: str = "pp"):
     """Broadcast the final-stage outputs to every pp device (psum of the
     masked value — same lowering as collectives.broadcast)."""
