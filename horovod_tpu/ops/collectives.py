@@ -82,6 +82,16 @@ def _apply_scale(x, scale: Optional[float]):
     into the surrounding computation — no separate kernel needed."""
     if scale is None or scale == 1.0:
         return x
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        # The reference scales integer tensors in double precision and
+        # casts back (test_torch.py prescale: "For integer types,
+        # scaling done in FP64") — a dtype-cast scale would floor 0.5
+        # to 0. fp64 when x64 is enabled; otherwise fp32 (exact for
+        # magnitudes < 2^24 — TPUs have no native fp64 anyway).
+        import jax
+
+        ft = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        return (x.astype(ft) * jnp.asarray(scale, ft)).astype(x.dtype)
     return x * jnp.asarray(scale, dtype=x.dtype)
 
 

@@ -49,6 +49,27 @@ def _tf():
     return tf
 
 
+def _no_autograph(fn):
+    """Keep autograph OUT of the shim (reference ops are C++ kernels —
+    autograph never sees them; here the 'kernel' is Python engine code,
+    and letting autograph trace/convert through it is both slow and
+    fragile: converted engine helpers have been observed resurfacing
+    from autograph's cache with broken signatures). Applied lazily so
+    importing the shim does not import tensorflow."""
+    import functools
+
+    cell = []
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not cell:  # convert once, off the per-op hot path
+            cell.append(
+                _tf().autograph.experimental.do_not_convert(fn))
+        return cell[0](*args, **kwargs)
+
+    return wrapper
+
+
 def _engine():
     from horovod_tpu.common import basics
 
@@ -93,6 +114,7 @@ def _allreduce_np(arr: np.ndarray, op: ReduceOp, name: Optional[str],
     return _to_host(out).astype(arr.dtype, copy=False)
 
 
+@_no_autograph
 def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=None, sparse_as_dense: bool = False):
@@ -144,6 +166,7 @@ def _grouped_allreduce_np(arrs, op: ReduceOp, name: Optional[str],
             for o, a in zip(outs, arrs)]
 
 
+@_no_autograph
 def grouped_allreduce(tensors, op: ReduceOp = Average,
                       name: Optional[str] = None, compression=None,
                       prescale_factor: float = 1.0,
@@ -166,6 +189,7 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
         prescale_factor, postscale_factor)]
 
 
+@_no_autograph
 def allgather(tensor, name: Optional[str] = None):
     """Concatenate along dim 0 over ranks (reference allgather)."""
     tf = _tf()
@@ -184,6 +208,7 @@ def allgather(tensor, name: Optional[str] = None):
     return _bridge(np_fn, tensor, out_shape)
 
 
+@_no_autograph
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     e = _engine()
     return _bridge(
@@ -193,6 +218,7 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
         tensor)
 
 
+@_no_autograph
 def alltoall(tensor, name: Optional[str] = None):
     e = _engine()
     return _bridge(

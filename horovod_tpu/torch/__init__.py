@@ -56,12 +56,33 @@ def _engine():
     return basics.context().engine
 
 
+def _tensor_to_np(tensor: torch.Tensor) -> np.ndarray:
+    """Torch -> numpy, including bfloat16 (which ``Tensor.numpy()``
+    rejects): bf16 round-trips losslessly through fp32 host memory into
+    an ``ml_dtypes.bfloat16`` ndarray, so the ENGINE still computes and
+    reduces in bf16 — the wire dtype the caller asked for."""
+    if tensor.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return (tensor.detach().to(torch.float32).cpu().numpy()
+                .astype(ml_dtypes.bfloat16))
+    return tensor.detach().cpu().numpy()
+
+
+def _np_to_tensor(arr: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
+    """numpy -> torch of the caller's dtype; bf16 ndarrays (which
+    ``torch.from_numpy`` rejects) bridge through fp32 losslessly."""
+    if arr.dtype.kind not in "biufc":  # ml_dtypes extension types
+        arr = arr.astype(np.float32)
+    return torch.from_numpy(np.array(arr, copy=True)).to(dtype)
+
+
 def _replicated(tensor: torch.Tensor):
     """Torch tensor -> explicitly replicated distributed tensor. Explicit
     replicate (not _as_distributed) so a tensor whose leading dim happens
     to equal world size is not mis-read as an already rank-major stack
     and scattered (same hazard fixed in functions.broadcast_variables)."""
-    return _engine().replicate(tensor.detach().cpu().numpy())
+    return _engine().replicate(_tensor_to_np(tensor))
 
 
 def _to_host(dt) -> np.ndarray:
@@ -103,7 +124,7 @@ def allreduce(tensor: torch.Tensor, op: ReduceOp = Average,
     e = _engine()
     out = e.allreduce(_replicated(tensor), op, name,
                       prescale_factor, postscale_factor, compression)
-    return torch.from_numpy(_to_host(out).copy()).to(tensor.dtype)
+    return _np_to_tensor(_to_host(out), tensor.dtype)
 
 
 def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
@@ -119,15 +140,15 @@ def allgather(tensor: torch.Tensor,
     result is ``size`` stacked copies reshaped to (size*n, ...)."""
     e = _engine()
     out = _to_host(e.allgather(_replicated(tensor), name))
-    return torch.from_numpy(out.reshape((-1,) + tuple(tensor.shape[1:]))
-                            .copy()).to(tensor.dtype)
+    return _np_to_tensor(out.reshape((-1,) + tuple(tensor.shape[1:])),
+                         tensor.dtype)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int = 0,
               name: Optional[str] = None) -> torch.Tensor:
     e = _engine()
     out = e.broadcast(_replicated(tensor), root_rank, name)
-    return torch.from_numpy(_to_host(out).copy()).to(tensor.dtype)
+    return _np_to_tensor(_to_host(out), tensor.dtype)
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int = 0,
@@ -140,7 +161,37 @@ def alltoall(tensor: torch.Tensor,
              name: Optional[str] = None) -> torch.Tensor:
     e = _engine()
     out = _to_host(e.alltoall(_replicated(tensor), name))
-    return torch.from_numpy(out.copy()).to(tensor.dtype)
+    return _np_to_tensor(out, tensor.dtype)
+
+
+def grouped_allreduce(tensors, op: ReduceOp = Average,
+                      name: Optional[str] = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      compression=None):
+    """Fused-bucket allreduce of a list of tensors (reference
+    torch/mpi_ops.py grouped_allreduce): one negotiation + one fused
+    flat buffer instead of a dispatch per tensor."""
+    _validate_compression(compression)
+    e = _engine()
+    arrs = {str(i): _replicated(t) for i, t in enumerate(tensors)}
+    out = e.allreduce_tree(arrs, op, name, compression,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+    return [_np_to_tensor(_to_host(out[str(i)]), t.dtype)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce_(tensors, op: ReduceOp = Average,
+                       name: Optional[str] = None,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0,
+                       compression=None):
+    outs = grouped_allreduce(tensors, op, name, prescale_factor,
+                             postscale_factor, compression)
+    for t, o in zip(tensors, outs):
+        t.copy_(o)
+    return tensors
 
 
 # -- async handle model (reference torch/mpi_ops.py:223-646) ----------------
@@ -157,14 +208,18 @@ def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
     e = _engine()
     out = e.allreduce(_replicated(tensor), op, name,
                       prescale_factor, postscale_factor, compression)
-    return e.handles.allocate(out)
+    h = e.handles.allocate(out)
+    _inplace_targets()[h] = ("plain", tensor.dtype)
+    return h
 
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
                     name: Optional[str] = None) -> int:
     e = _engine()
     out = e.broadcast(_replicated(tensor), root_rank, name)
-    return e.handles.allocate(out)
+    h = e.handles.allocate(out)
+    _inplace_targets()[h] = ("plain", tensor.dtype)
+    return h
 
 
 def allgather_async(tensor: torch.Tensor,
@@ -185,7 +240,9 @@ def alltoall_async(tensor: torch.Tensor,
     surface, horovod_tpu.alltoall(splits=...))."""
     e = _engine()
     out = e.alltoall(_replicated(tensor), name)
-    return e.handles.allocate(out)
+    h = e.handles.allocate(out)
+    _inplace_targets()[h] = ("plain", tensor.dtype)
+    return h
 
 
 def _inplace_targets() -> dict:
@@ -225,7 +282,10 @@ def synchronize(handle: int) -> torch.Tensor:
     if isinstance(val, torch.Tensor):
         out = val
     else:
-        out = torch.from_numpy(_to_host(val).copy())
+        arr = _to_host(val)
+        if arr.dtype.kind not in "biufc":  # bf16 via ml_dtypes
+            arr = arr.astype(np.float32)
+        out = torch.from_numpy(arr.copy())
     kind, target = _inplace_targets().pop(handle, (None, None))
     if kind == "inplace":
         target.copy_(out.reshape(target.shape).to(target.dtype))
@@ -234,6 +294,13 @@ def synchronize(handle: int) -> torch.Tensor:
         # This rank's row holds the stacked gather; flatten rank-major
         # exactly like the sync allgather surface.
         return out.reshape((-1,) + tuple(target.shape[1:])).to(target.dtype)
+    if kind == "plain":
+        # Restore the caller's dtype (bf16 bridges through fp32 host
+        # memory) — the sync surface's contract. Only the DTYPE is
+        # registered for plain handles: a strong tensor ref would pin
+        # every input until synchronize(), leaking on fire-and-forget
+        # handles.
+        return out.to(target)
     return out
 
 

@@ -1,0 +1,273 @@
+"""Collective dtype/option matrix for the torch + TF shims — the
+reference sweeps every collective across ~10 dtypes x fused/unfused x
+pre/postscale x error cases (test/parallel/test_torch.py:144-300,
+test/parallel/test_tensorflow.py:101-400); this is that matrix on the
+8-virtual-rank engine.
+
+Contracts verified per dtype family:
+  * output dtype == input dtype (boundary preservation, incl. torch
+    bfloat16 which cannot cross Tensor.numpy()/from_numpy directly)
+  * SUM is exact (== size * t) for integer dtypes; AVERAGE of
+    identical ranks is exact for every dtype (reference threshold-0
+    cases)
+  * prescale/postscale: integer tensors scale through float math then
+    truncate back (reference: "For integer types, scaling done in
+    FP64"; fp32 here — x64 is disabled under JAX, documented demotion)
+  * int64/float64 ride JAX's documented demotion (compute in
+    int32/fp32) but come back in the caller's dtype
+  * grouped (fused) results == per-tensor (unfused) results
+  * typed errors, not deadlocks, for invalid option combinations
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvdt
+
+pytestmark = pytest.mark.slow
+
+TORCH_DTYPES = [torch.uint8, torch.int8, torch.int32, torch.int64,
+                torch.float16, torch.bfloat16, torch.float32,
+                torch.float64]
+
+
+def _as_f32(t):
+    return t.to(torch.float32)
+
+
+@pytest.fixture(autouse=True)
+def _init(hvd):
+    yield
+
+
+# -- torch: allreduce -------------------------------------------------------
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+@pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+def test_torch_allreduce_sum_dtype(hvd, dtype, dim):
+    n = hvd.size()
+    t = torch.arange(2 ** dim).reshape((2,) * dim)
+    t = (t % 5).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Sum, name=f"mx_s_{dtype}_{dim}")
+    assert out.dtype == dtype
+    np.testing.assert_allclose(_as_f32(out).numpy(),
+                               _as_f32(t).numpy() * n, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+def test_torch_allreduce_average_identity(hvd, dtype):
+    """Identical ranks -> average == input, exactly (threshold-0 case
+    of the reference's test_horovod_allreduce_average)."""
+    t = (torch.arange(6) % 5).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Average, name=f"mx_a_{dtype}")
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(_as_f32(out).numpy(),
+                                  _as_f32(t).numpy())
+
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.int64,
+                                   torch.float16, torch.float32,
+                                   torch.float64], ids=str)
+def test_torch_allreduce_prescale(hvd, dtype):
+    """prescale=0.5: ints truncate through float math (ref semantics),
+    floats scale exactly."""
+    n = hvd.size()
+    t = torch.tensor([1, 3, 10]).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Sum, prescale_factor=0.5,
+                         name=f"mx_pre_{dtype}")
+    assert out.dtype == dtype
+    if dtype in (torch.int32, torch.int64):
+        expected = np.trunc(np.array([1, 3, 10]) * 0.5) * n
+    else:
+        expected = np.array([1, 3, 10]) * 0.5 * n
+    np.testing.assert_allclose(_as_f32(out).numpy(), expected, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.float32], ids=str)
+def test_torch_allreduce_postscale(hvd, dtype):
+    """postscale applies AFTER the sum (ints: float math, truncated)."""
+    n = hvd.size()
+    t = torch.tensor([1, 3]).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Sum, postscale_factor=0.5,
+                         name=f"mx_post_{dtype}")
+    expected = np.trunc(np.array([1, 3]) * n * 0.5)
+    np.testing.assert_allclose(_as_f32(out).numpy(), expected, rtol=1e-3)
+
+
+# -- torch: other collectives ----------------------------------------------
+
+@pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+def test_torch_allgather_dtype(hvd, dtype):
+    n = hvd.size()
+    t = (torch.arange(6).reshape(2, 3) % 5).to(dtype)
+    out = hvdt.allgather(t, name=f"mx_ag_{dtype}")
+    assert out.dtype == dtype and out.shape == (2 * n, 3)
+    np.testing.assert_array_equal(
+        _as_f32(out).numpy(), np.tile(_as_f32(t).numpy(), (n, 1)))
+
+
+@pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+def test_torch_broadcast_dtype(hvd, dtype):
+    t = (torch.arange(4) % 5).to(dtype)
+    out = hvdt.broadcast(t, root_rank=0, name=f"mx_bc_{dtype}")
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(_as_f32(out).numpy(),
+                                  _as_f32(t).numpy())
+
+
+@pytest.mark.parametrize("dtype", [torch.uint8, torch.int64,
+                                   torch.bfloat16, torch.float32],
+                         ids=str)
+def test_torch_alltoall_dtype(hvd, dtype):
+    n = hvd.size()
+    t = (torch.arange(n) % 5).to(dtype)  # one row per destination
+    out = hvdt.alltoall(t, name=f"mx_a2a_{dtype}")
+    assert out.dtype == dtype and out.shape == (n,)
+    # Every rank sent the same tensor; this rank receives segment
+    # [rank] from each peer — under the replicated single-controller
+    # world that is n copies of element [rank].
+    r = hvdt.rank()
+    np.testing.assert_array_equal(
+        _as_f32(out).numpy(), np.full((n,), float(r % 5)))
+
+
+# -- torch: fused (grouped) vs unfused --------------------------------------
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.bfloat16,
+                                   torch.float32, torch.float64],
+                         ids=str)
+def test_torch_grouped_matches_per_tensor(hvd, dtype):
+    ts = [(torch.arange(5) % 4).to(dtype),
+          (torch.arange(8).reshape(2, 4) % 3).to(dtype)]
+    fused = hvdt.grouped_allreduce(ts, op=hvdt.Sum,
+                                   name=f"mx_g_{dtype}")
+    unfused = [hvdt.allreduce(t, op=hvdt.Sum, name=f"mx_u_{dtype}_{i}")
+               for i, t in enumerate(ts)]
+    for f, u in zip(fused, unfused):
+        assert f.dtype == u.dtype == dtype
+        np.testing.assert_array_equal(_as_f32(f).numpy(),
+                                      _as_f32(u).numpy())
+
+
+@pytest.mark.parametrize("dtype", [torch.bfloat16, torch.float16,
+                                   torch.int32], ids=str)
+def test_torch_async_restores_dtype(hvd, dtype):
+    """synchronize() of a plain async handle returns the CALLER's dtype
+    (the sync surface's contract) — bf16 bridges host memory via fp32."""
+    t = (torch.arange(4) % 3).to(dtype)
+    h = hvdt.allreduce_async(t, op=hvdt.Sum, name=f"mx_as_{dtype}")
+    out = hvdt.synchronize(h)
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(_as_f32(out).numpy(),
+                                  _as_f32(t).numpy() * hvd.size())
+
+
+def test_torch_grouped_inplace_forwards_scaling(hvd):
+    n = hvd.size()
+    ts = [torch.tensor([2.0, 4.0])]
+    hvdt.grouped_allreduce_(ts, op=hvdt.Sum, name="mx_gis",
+                            prescale_factor=0.5)
+    np.testing.assert_allclose(ts[0].numpy(), np.array([1.0, 2.0]) * n)
+
+
+def test_torch_grouped_inplace(hvd):
+    n = hvd.size()
+    ts = [torch.ones(3), torch.full((2,), 2.0)]
+    hvdt.grouped_allreduce_(ts, op=hvdt.Sum, name="mx_gi")
+    np.testing.assert_allclose(ts[0].numpy(), np.full(3, n))
+    np.testing.assert_allclose(ts[1].numpy(), np.full(2, 2.0 * n))
+
+
+# -- torch: typed error cases ----------------------------------------------
+
+def test_torch_predivide_requires_average(hvd):
+    with pytest.raises(ValueError, match="op=Average"):
+        hvdt.DistributedOptimizer(
+            torch.optim.SGD([torch.nn.Parameter(torch.ones(2))], lr=0.1),
+            gradient_predivide_factor=2.0, op=hvdt.Sum)
+
+
+def test_torch_compression_type_error(hvd):
+    with pytest.raises(TypeError, match="Compressor"):
+        hvdt.allreduce(torch.ones(2), op=hvdt.Sum, compression=hvdt.Sum)
+
+
+# -- tensorflow matrix ------------------------------------------------------
+
+tf = pytest.importorskip("tensorflow")
+import horovod_tpu.tensorflow as hvdtf  # noqa: E402
+
+TF_DTYPES = [tf.uint8, tf.int32, tf.int64, tf.float16, tf.bfloat16,
+             tf.float32, tf.float64]
+
+
+@pytest.mark.parametrize("dtype", TF_DTYPES, ids=lambda d: d.name)
+def test_tf_allreduce_sum_dtype(hvd, dtype):
+    n = hvd.size()
+    t = tf.cast(tf.range(6) % 5, dtype)
+    out = hvdtf.allreduce(t, op=hvdtf.Sum, name=f"mxtf_s_{dtype.name}")
+    assert out.dtype == dtype
+    np.testing.assert_allclose(
+        tf.cast(out, tf.float32).numpy(),
+        tf.cast(t, tf.float32).numpy() * n, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", TF_DTYPES, ids=lambda d: d.name)
+def test_tf_allreduce_average_identity(hvd, dtype):
+    t = tf.cast(tf.range(6) % 5, dtype)
+    out = hvdtf.allreduce(t, op=hvdtf.Average,
+                          name=f"mxtf_a_{dtype.name}")
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(tf.cast(out, tf.float32).numpy(),
+                                  tf.cast(t, tf.float32).numpy())
+
+
+@pytest.mark.parametrize("dtype", [tf.int32, tf.float32, tf.float64],
+                         ids=lambda d: d.name)
+def test_tf_allreduce_prescale(hvd, dtype):
+    n = hvd.size()
+    t = tf.cast(tf.constant([1, 3, 10]), dtype)
+    out = hvdtf.allreduce(t, op=hvdtf.Sum, prescale_factor=0.5,
+                          name=f"mxtf_pre_{dtype.name}")
+    assert out.dtype == dtype
+    if dtype == tf.int32:
+        expected = np.trunc(np.array([1, 3, 10]) * 0.5) * n
+    else:
+        expected = np.array([1, 3, 10]) * 0.5 * n
+    np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(),
+                               expected, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", [tf.uint8, tf.int64, tf.bfloat16,
+                                   tf.float32],
+                         ids=lambda d: d.name)
+def test_tf_allgather_dtype(hvd, dtype):
+    n = hvd.size()
+    t = tf.cast(tf.reshape(tf.range(6) % 5, (2, 3)), dtype)
+    out = hvdtf.allgather(t, name=f"mxtf_ag_{dtype.name}")
+    assert out.dtype == dtype and out.shape == (2 * n, 3)
+
+
+@pytest.mark.parametrize("dtype", [tf.int32, tf.bfloat16, tf.float32],
+                         ids=lambda d: d.name)
+def test_tf_grouped_matches_per_tensor(hvd, dtype):
+    ts = [tf.cast(tf.range(5) % 4, dtype),
+          tf.cast(tf.reshape(tf.range(8) % 3, (2, 4)), dtype)]
+    fused = hvdtf.grouped_allreduce(ts, op=hvdtf.Sum,
+                                    name=f"mxtf_g_{dtype.name}")
+    unfused = [hvdtf.allreduce(t, op=hvdtf.Sum,
+                               name=f"mxtf_u_{dtype.name}_{i}")
+               for i, t in enumerate(ts)]
+    for f, u in zip(fused, unfused):
+        assert f.dtype == dtype
+        np.testing.assert_array_equal(
+            tf.cast(f, tf.float32).numpy(),
+            tf.cast(u, tf.float32).numpy())
+
+
+def test_tf_predivide_requires_average(hvd):
+    with pytest.raises(ValueError, match="op=Average"):
+        hvdtf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1),
+            gradient_predivide_factor=2.0, op=hvdtf.Sum)
