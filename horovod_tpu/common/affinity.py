@@ -1,0 +1,78 @@
+"""Thread/core affinity pinning (reference common/common.cc:140-203
+``parse_and_set_affinity``): ``HOROVOD_THREAD_AFFINITY`` /
+``HVD_TPU_THREAD_AFFINITY`` holds one core id per local rank,
+comma-separated; rank ``local_rank`` pins to its id.
+
+On TPU-VMs the device does the math but the HOST feeds it — input
+pipelines, the eager engine's finalizer pool, and the host side of
+infeed all compete for cores, and co-located processes (one per chip on
+a multi-chip VM) otherwise migrate onto each other's cores. Pinning the
+PROCESS (``os.sched_setaffinity(0, ...)``) covers every thread it
+spawns afterwards, which is the Python analog of the reference pinning
+its background thread.
+
+Like the reference, malformed specs LOG errors and leave affinity
+untouched — a bad env var must never kill a training job.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def parse_affinity(spec: str, local_size: int) -> Optional[List[int]]:
+    """``"0,4,8,12"`` -> [0, 4, 8, 12]; None (+ error log) on any of the
+    reference's rejection cases: non-numeric, negative, or fewer ids
+    than ``local_size``."""
+    ids: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            core = int(part)
+        except ValueError:
+            logger.error("No digits were found in thread-affinity "
+                         "spec %r", spec)
+            return None
+        if core < 0:
+            logger.error("Core ID cannot be less than zero but got %d "
+                         "in %r", core, spec)
+            return None
+        ids.append(core)
+    if len(ids) < local_size:
+        logger.error("Expected %d core ids but got %d in %r",
+                     local_size, len(ids), spec)
+        return None
+    return ids
+
+
+def set_affinity(core_id: int) -> bool:
+    """Pin this process (and its future threads) to ``core_id``."""
+    if not hasattr(os, "sched_setaffinity"):  # non-Linux host
+        logger.error("sched_setaffinity unavailable on this platform; "
+                     "thread affinity ignored")
+        return False
+    try:
+        os.sched_setaffinity(0, {core_id})
+        logger.info("pinned process to core %d", core_id)
+        return True
+    except OSError as e:
+        logger.error("failed to set affinity to core %d: %s", core_id, e)
+        return False
+
+
+def parse_and_set_affinity(spec: Optional[str], local_size: int,
+                           local_rank: int) -> bool:
+    """The reference's entry point: no-op on empty spec; parse; pin this
+    rank's core. Returns True iff a pin happened."""
+    if not spec:
+        return False
+    ids = parse_affinity(spec, max(local_size, local_rank + 1))
+    if ids is None:
+        return False
+    return set_affinity(ids[local_rank])

@@ -126,9 +126,12 @@ class Autotuner:
         self._columns = (("threshold_bytes", "hierarchical")
                          if tune_hierarchical else ("threshold_bytes",))
         if log_file:
+            # Decision trace (reference HOROVOD_AUTOTUNE_LOG,
+            # parameter_manager.cc LogParameters): when + what was
+            # tried + how it scored + on how many step samples.
             with open(log_file, "w") as f:
-                f.write(",".join(self._columns)
-                        + ",score_bytes_per_sec\n")
+                f.write("unix_time," + ",".join(self._columns)
+                        + ",score_bytes_per_sec,steps\n")
 
     @property
     def current(self) -> int:
@@ -187,10 +190,13 @@ class Autotuner:
 
     def _log(self, point: Tuple[int, int], score: float) -> None:
         if self.log_file:
+            import time as _time
+
             row = point[:len(self._columns)]
             with open(self.log_file, "a") as f:
-                f.write(",".join(str(v) for v in row)
-                        + f",{score:.1f}\n")
+                f.write(f"{_time.time():.3f},"
+                        + ",".join(str(v) for v in row)
+                        + f",{score:.1f},{self._steps}\n")
 
     def suggest(self) -> int:
         """Finalize the current sample and pick the next threshold via

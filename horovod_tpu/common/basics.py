@@ -45,6 +45,15 @@ class Context:
             devices = [topo.devices[r] for r in comm]
             topo = topo_lib.discover(devices=devices)
         self.topology = topo
+        # Host-core pinning before any worker threads spawn (reference
+        # common.cc:140-203 parse_and_set_affinity; input pipelines and
+        # the finalizer pool inherit the pin).
+        from .affinity import parse_and_set_affinity
+
+        parse_and_set_affinity(
+            config.thread_affinity,
+            int(os.environ.get("HVD_TPU_LOCAL_SIZE", "1")),
+            int(os.environ.get("HVD_TPU_LOCAL_RANK", "0")))
         self.mesh = topo_lib.build_mesh(topo, config.rank_axis)
         self.hier_mesh = None
         if topo.is_homogeneous and topo.cross_size > 1:

@@ -99,6 +99,9 @@ class Config:
     # controller.cc:63-358; here it is opt-in because the negotiation-free
     # cached fast path is the default). Single-process SPMD needs no knob.
     join_mode: bool = False
+    # Host-core pinning: one core id per local rank, comma-separated
+    # (reference: HOROVOD_THREAD_AFFINITY, common.cc:140-203).
+    thread_affinity: Optional[str] = None
     # Logging level.
     log_level: str = "warning"
     # Mesh axis name used for the data-parallel "ranks" axis.
@@ -132,6 +135,7 @@ class Config:
         c.compression_dtype = _env("COMPRESSION_DTYPE")
         c.elastic = _env_bool("ELASTIC", False)
         c.join_mode = _env_bool("JOIN_MODE", False)
+        c.thread_affinity = _env("THREAD_AFFINITY")
         c.log_level = _env("LOG_LEVEL", "warning") or "warning"
         c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
         c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)

@@ -1,0 +1,65 @@
+"""Affinity pinning (reference common/common.cc:140-203
+parse_and_set_affinity): parse semantics + real sched_setaffinity on
+the current process, restored afterwards."""
+
+import os
+
+import pytest
+
+from horovod_tpu.common.affinity import (parse_affinity,
+                                         parse_and_set_affinity,
+                                         set_affinity)
+
+
+def test_parse_valid():
+    assert parse_affinity("0,4, 8 ,12", 4) == [0, 4, 8, 12]
+
+
+def test_parse_rejects_non_numeric(caplog):
+    assert parse_affinity("0,x,2", 3) is None
+
+
+def test_parse_rejects_negative():
+    assert parse_affinity("0,-1,2", 3) is None
+
+
+def test_parse_rejects_too_few():
+    """Reference: 'Expected N core ids but got M' -> no pin."""
+    assert parse_affinity("0,1", 4) is None
+
+
+def test_empty_spec_is_noop():
+    assert parse_and_set_affinity(None, 1, 0) is False
+    assert parse_and_set_affinity("", 1, 0) is False
+
+
+@pytest.mark.skipif(not hasattr(os, "sched_setaffinity"),
+                    reason="Linux-only")
+def test_set_affinity_pins_and_is_visible():
+    before = os.sched_getaffinity(0)
+    try:
+        core = min(before)
+        assert parse_and_set_affinity(str(core), 1, 0) is True
+        assert os.sched_getaffinity(0) == {core}
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+@pytest.mark.skipif(not hasattr(os, "sched_setaffinity"),
+                    reason="Linux-only")
+def test_local_rank_selects_column():
+    before = os.sched_getaffinity(0)
+    cores = sorted(before)
+    if len(cores) < 2:
+        pytest.skip("needs >=2 cores")
+    try:
+        assert parse_and_set_affinity(f"{cores[0]},{cores[1]}", 2, 1)
+        assert os.sched_getaffinity(0) == {cores[1]}
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_bad_core_id_fails_soft():
+    """A core id beyond the machine must log, not raise (reference
+    logs ERROR and continues)."""
+    assert set_affinity(10 ** 6) is False

@@ -64,8 +64,11 @@ def test_autotuner_logs_csv(tmp_path):
     t.record(100.0, 1.0)
     t.suggest()
     lines = open(log).read().strip().splitlines()
-    assert lines[0] == "threshold_bytes,score_bytes_per_sec"
+    assert lines[0] == "unix_time,threshold_bytes,score_bytes_per_sec,steps"
     assert len(lines) == 2
+    ts, thr, score, steps = lines[1].split(",")
+    assert float(ts) > 0 and thr.isdigit()
+    assert float(score) > 0 and int(steps) >= 1
 
 
 def test_autotuner_warmup_discarded():
