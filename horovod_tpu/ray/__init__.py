@@ -1,0 +1,339 @@
+"""Ray cluster integration — the reference's ``horovod.ray`` surface
+(ray/runner.py:90-482: ``BaseHorovodWorker``, ``Coordinator``,
+``RayExecutor``; ray/elastic.py: ``RayHostDiscovery``) re-hosted on the
+TPU engine.
+
+Design collapse vs the reference: the reference needs ``NodeColocator``
+actors + placement groups to pin NCCL peers and pick NICs
+(ray/runner.py:90-176). Here workers bootstrap ONE ``jax.distributed``
+world from env vars (the same bootstrap the CLI launcher and the
+process-pool :mod:`horovod_tpu.executor` use), so colocation reduces to
+grouping registered hostnames into local ranks — the ``Coordinator``'s
+job — and the data plane is XLA-over-ICI/DCN, not NCCL-over-NIC.
+
+``ray`` is imported lazily at call time: the adapter is importable (and
+its protocol testable, via an API-faithful stand-in installed in
+``sys.modules['ray']`` — see tests/fake_ray.py) on machines without
+ray. On a real cluster, actors are real Ray processes; each worker
+process sets its slot env THEN initializes the engine, exactly like a
+launcher-spawned slot.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _ray():
+    import ray
+
+    return ray
+
+
+# -- settings (reference ray/runner.py:22-42 MiniSettings) ------------------
+
+@dataclass
+class MiniSettings:
+    """Start/placement knobs (reference MiniSettings)."""
+
+    timeout_s: int = 300
+    placement_group_timeout_s: int = 100
+    extra_env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def start_timeout(self) -> int:
+        return self.timeout_s
+
+
+# -- worker actor (reference ray/runner.py:48-88 BaseHorovodWorker) ---------
+
+class BaseHorovodWorker:
+    """Runs inside a Ray actor process. Mirrors the reference's worker:
+    report hostname, accept env updates, execute functions. The engine
+    (hvd.init()) is created lazily by the user's fn AFTER env arrives,
+    so the jax.distributed bootstrap sees the slot env."""
+
+    def __init__(self, world_rank: int = 0, world_size: int = 1):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.executable: Any = None
+
+    def hostname(self) -> str:
+        return socket.gethostname()
+
+    def free_port(self) -> int:
+        """Probe a free port ON THIS HOST — the jax.distributed
+        coordinator binds inside rank 0's process, so the port must be
+        free where rank 0 lives, not on the driver machine."""
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def update_env_vars(self, env_vars: Dict[str, str]) -> None:
+        """Apply BEFORE any jax/engine import in this process."""
+        os.environ.update({k: str(v) for k, v in env_vars.items()})
+
+    def env_vars(self) -> Dict[str, str]:
+        return dict(os.environ)
+
+    def start_executable(self, executable_cls: Optional[type] = None,
+                         executable_args: Optional[list] = None,
+                         executable_kwargs: Optional[dict] = None) -> None:
+        """Instantiate the user's class inside the worker (reference
+        start_executable — after env arrives, so its __init__ may init
+        the engine)."""
+        if executable_cls is not None:
+            self.executable = executable_cls(*(executable_args or []),
+                                             **(executable_kwargs or {}))
+
+    def execute(self, fn: Callable) -> Any:
+        """fn(executable) — reference worker execute contract."""
+        return fn(self.executable)
+
+    def shutdown_engine(self) -> None:
+        import horovod_tpu as hvd
+
+        if hvd.is_initialized():
+            hvd.shutdown()
+
+
+# -- coordinator (reference ray/runner.py:178-248) --------------------------
+
+class Coordinator:
+    """Collects (hostname, world_rank) registrations and derives the
+    per-worker slot env: global/local/cross ranks plus the
+    jax.distributed coordinator address (reference
+    establish_rendezvous builds the gloo rendezvous env the same way).
+    """
+
+    def __init__(self, settings: Optional[MiniSettings] = None):
+        self.settings = settings or MiniSettings()
+        self.hostnames_by_rank: Dict[int, str] = {}
+        self.coordinator_port: Optional[int] = None
+
+    @property
+    def world_size(self) -> int:
+        return len(self.hostnames_by_rank)
+
+    @property
+    def hoststring(self) -> str:
+        hosts: Dict[str, List[int]] = {}
+        for rank in sorted(self.hostnames_by_rank):
+            hosts.setdefault(self.hostnames_by_rank[rank], []).append(rank)
+        return ",".join(f"{h}:{len(r)}" for h, r in hosts.items())
+
+    def register(self, hostname: str, world_rank: int) -> None:
+        self.hostnames_by_rank[world_rank] = hostname
+
+    def finalize_registration(self) -> Dict[int, Dict[str, str]]:
+        """Per-rank env (reference returns rank/size/local/cross vars;
+        here the HVD_TPU_* bootstrap the engine's topology reads)."""
+        by_host: Dict[str, List[int]] = {}
+        for rank in sorted(self.hostnames_by_rank):
+            by_host.setdefault(self.hostnames_by_rank[rank], []).append(rank)
+
+        rank0_host = self.hostnames_by_rank.get(0, "127.0.0.1")
+        if self.coordinator_port is None:
+            # Fallback probe on the CALLING machine — callers that can
+            # reach rank 0's host (RayExecutor.start does, via the
+            # worker's free_port()) should set coordinator_port first:
+            # a port free here may be taken over there.
+            s = socket.socket()
+            s.bind(("", 0))
+            self.coordinator_port = s.getsockname()[1]
+            s.close()
+        coordinator = f"{rank0_host}:{self.coordinator_port}"
+
+        envs: Dict[int, Dict[str, str]] = {}
+        for host, ranks in by_host.items():
+            for local_rank, rank in enumerate(ranks):
+                envs[rank] = {
+                    "HVD_TPU_COORDINATOR": coordinator,
+                    "HVD_TPU_NUM_PROC": str(self.world_size),
+                    "HVD_TPU_PROC_ID": str(rank),
+                    "HVD_TPU_LOCAL_RANK": str(local_rank),
+                    "HVD_TPU_LOCAL_SIZE": str(len(ranks)),
+                    "HVD_TPU_CROSS_RANK":
+                        str(sorted(by_host).index(host)),
+                    "HVD_TPU_CROSS_SIZE": str(len(by_host)),
+                    **self.settings.extra_env,
+                }
+        return envs
+
+
+# -- executor (reference ray/runner.py:250-482) -----------------------------
+
+class RayExecutor:
+    """Persistent Horovod worker pool on Ray actors.
+
+    Surface parity with the reference RayExecutor: ``create_settings``,
+    ``start(executable_cls=...)``, ``run``, ``run_remote``, ``execute``,
+    ``execute_single``, ``shutdown``, ``num_workers``.
+
+    Example::
+
+        ray.init(address="auto")
+        ex = RayExecutor(RayExecutor.create_settings(300), num_workers=4)
+        ex.start()
+        ex.run(train_fn)          # fn may hvd.init() + use collectives
+        ex.shutdown()
+    """
+
+    @classmethod
+    def create_settings(cls, timeout_s: int = 300,
+                        ssh_identity_file: Optional[str] = None,
+                        ssh_str: Optional[str] = None) -> MiniSettings:
+        # ssh args accepted for signature parity; Ray actors need no ssh.
+        return MiniSettings(timeout_s=timeout_s)
+
+    def __init__(self, settings: Optional[MiniSettings] = None,
+                 num_workers: int = 1, cpus_per_worker: int = 1,
+                 use_gpu: bool = False, gpus_per_worker: int = 0,
+                 env: Optional[Dict[str, str]] = None):
+        self.settings = settings or MiniSettings()
+        self._num_workers = int(num_workers)
+        self.cpus_per_worker = cpus_per_worker
+        self.use_gpu = use_gpu          # accepted for parity; TPU/CPU here
+        self.gpus_per_worker = gpus_per_worker
+        self.env = dict(env or {})
+        self.workers: List[Any] = []
+        self.coordinator = Coordinator(self.settings)
+        self._started = False
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def start(self,
+              executable_cls: Optional[type] = None,
+              executable_args: Optional[list] = None,
+              executable_kwargs: Optional[dict] = None,
+              extra_env_vars: Optional[Dict[str, str]] = None) -> None:
+        """Create the actors, run the registration round, push each
+        worker its slot env, then instantiate ``executable_cls`` inside
+        each worker (reference start(): create_workers → Coordinator
+        registration → establish_rendezvous → update_env_vars →
+        start_executable fan-outs)."""
+        ray = _ray()
+        remote_cls = ray.remote(BaseHorovodWorker)
+        opts: Dict[str, Any] = {"num_cpus": self.cpus_per_worker}
+        if self.use_gpu and self.gpus_per_worker:
+            opts["num_gpus"] = self.gpus_per_worker
+        remote_cls = remote_cls.options(**opts)
+        self.workers = [
+            remote_cls.remote(world_rank=rank,
+                              world_size=self._num_workers)
+            for rank in range(self._num_workers)]
+
+        hostnames = ray.get([w.hostname.remote() for w in self.workers])
+        for rank, hostname in enumerate(hostnames):
+            self.coordinator.register(hostname, rank)
+        # Reserve the jax.distributed coordinator port on rank 0's HOST
+        # (it binds inside rank 0's actor process).
+        self.coordinator.coordinator_port = ray.get(
+            self.workers[0].free_port.remote())
+        envs = self.coordinator.finalize_registration()
+
+        base = {**self.env, **(extra_env_vars or {})}
+        ray.get([
+            w.update_env_vars.remote({**base, **envs[rank]})
+            for rank, w in enumerate(self.workers)])
+        if executable_cls is not None:
+            ray.get([w.start_executable.remote(
+                        executable_cls, executable_args,
+                        executable_kwargs)
+                     for w in self.workers])
+        self._started = True
+
+    def run_remote(self, fn: Callable, args: Optional[list] = None,
+                   kwargs: Optional[dict] = None) -> List[Any]:
+        """Dispatch without blocking; returns the object refs
+        (reference run_remote)."""
+        if not self._started:
+            raise RuntimeError("RayExecutor not started — call start()")
+        call = _IgnoreExecutable(fn, tuple(args or ()), kwargs or {})
+        return [w.execute.remote(call) for w in self.workers]
+
+    def run(self, fn: Callable, args: Optional[list] = None,
+            kwargs: Optional[dict] = None) -> List[Any]:
+        """Run ``fn`` on every worker, rank order results (reference
+        run contract)."""
+        return _ray().get(self.run_remote(fn, args, kwargs))
+
+    def execute(self, fn: Callable[[Any], Any]) -> List[Any]:
+        """Apply ``fn(executable)`` on every worker (reference execute
+        — for executable_cls users)."""
+        if not self._started:
+            raise RuntimeError("RayExecutor not started — call start()")
+        return _ray().get([w.execute.remote(fn) for w in self.workers])
+
+    def execute_single(self, fn: Callable, args: Optional[list] = None,
+                       kwargs: Optional[dict] = None, rank: int = 0
+                       ) -> Any:
+        """One worker only; fn must not issue collectives."""
+        if not self._started:
+            raise RuntimeError("RayExecutor not started — call start()")
+        call = _IgnoreExecutable(fn, tuple(args or ()), kwargs or {})
+        return _ray().get(self.workers[rank].execute.remote(call))
+
+    def shutdown(self) -> None:
+        ray = _ray()
+        if self.workers:
+            try:
+                ray.get([w.shutdown_engine.remote()
+                         for w in self.workers],
+                        timeout=self.settings.timeout_s)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            for w in self.workers:
+                ray.kill(w)
+        self.workers = []
+        self._started = False
+
+
+class _IgnoreExecutable:
+    """Picklable bridge for run()/execute_single(): the worker's
+    execute(fn) channel passes the executable, which plain functions
+    don't take — swallow it and call fn(*args, **kwargs)."""
+
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict):
+        self._fn, self._args, self._kwargs = fn, args, kwargs
+
+    def __call__(self, _executable) -> Any:
+        return self._fn(*self._args, **self._kwargs)
+
+
+# -- elastic discovery (reference ray/elastic.py:34-74) ---------------------
+
+class RayHostDiscovery:
+    """Feeds the elastic driver from the live Ray cluster state: every
+    alive node with CPU (or GPU when use_gpu) resources contributes
+    ``slots`` worker slots (reference RayHostDiscovery.find_...)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        ray = _ray()
+        hosts: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive", False):
+                continue
+            resources = node.get("Resources", {})
+            hostname = node.get("NodeManagerHostname") \
+                or node.get("NodeManagerAddress", "unknown")
+            if self.use_gpu:
+                slots = int(resources.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(resources.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[hostname] = slots
+        return hosts

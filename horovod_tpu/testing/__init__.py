@@ -1,0 +1,4 @@
+"""Test substrates: API-faithful stand-ins for cluster schedulers that
+are not installable in the CI image (ray, pyspark). Production code
+never imports these; tests install them into ``sys.modules`` to
+exercise the real adapters."""

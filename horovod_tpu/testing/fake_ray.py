@@ -1,0 +1,211 @@
+"""A process-backed, API-faithful stand-in for the slice of the ``ray``
+API that :mod:`horovod_tpu.ray` uses — ray itself is not installable in
+the CI image (no network), and a thread-based mock could not host real
+collectives.
+
+Fidelity choices that matter for the adapter tests:
+
+* **Actors are real OS processes** (``multiprocessing`` spawn context),
+  like Ray's — so ``RayExecutor`` workers can set slot env vars, build
+  a genuine multi-process ``jax.distributed`` world, and run REAL
+  collectives through the engine, exactly as they would on a Ray
+  cluster.
+* **Method calls are async**: ``handle.method.remote(...)`` returns an
+  ObjectRef immediately; per-actor dispatch threads keep all actors
+  concurrent (sequential dispatch would deadlock SPMD collectives).
+* **cloudpickle on the wire**, like Ray, so closures and lambdas pass.
+
+Covered API: ``init/is_initialized/shutdown``, ``remote(cls)`` (+
+``.options()``), actor ``.remote()`` construction, method
+``.remote()``, ``get(ref|list, timeout=)``, ``kill(handle)``,
+``nodes()``. Reference for the adapter under test: ray/runner.py.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue
+import socket
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+_mp = mp.get_context("spawn")
+_STATE: Dict[str, Any] = {"initialized": False, "actors": []}
+
+
+def init(*args, **kwargs) -> None:
+    _STATE["initialized"] = True
+
+
+def is_initialized() -> bool:
+    return bool(_STATE["initialized"])
+
+
+def shutdown() -> None:
+    for actor in list(_STATE["actors"]):
+        actor._terminate()
+    _STATE["actors"] = []
+    _STATE["initialized"] = False
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return [{
+        "Alive": True,
+        "NodeManagerHostname": socket.gethostname(),
+        "NodeManagerAddress": "127.0.0.1",
+        "Resources": {"CPU": float(os.cpu_count() or 1)},
+    }]
+
+
+def _actor_main(conn, cls_blob: bytes) -> None:
+    """Child process: build the instance, serve method calls forever."""
+    import cloudpickle
+
+    cls, args, kwargs = cloudpickle.loads(cls_blob)
+    try:
+        instance = cls(*args, **kwargs)
+        conn.send_bytes(cloudpickle.dumps(("ok", None)))
+    except BaseException:
+        conn.send_bytes(cloudpickle.dumps(
+            ("error", traceback.format_exc())))
+        return
+    while True:
+        try:
+            blob = conn.recv_bytes()
+        except EOFError:
+            return
+        msg = cloudpickle.loads(blob)
+        if msg[0] == "stop":
+            return
+        _, method, args, kwargs = msg
+        try:
+            reply = ("ok", getattr(instance, method)(*args, **kwargs))
+        except BaseException:
+            reply = ("error", traceback.format_exc())
+        conn.send_bytes(cloudpickle.dumps(reply))
+
+
+class ObjectRef:
+    def __init__(self, future: Future):
+        self._future = future
+
+
+class _RemoteMethod:
+    def __init__(self, actor: "ActorHandle", name: str):
+        self._actor, self._name = actor, name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._actor._call(self._name, args, kwargs)
+
+
+class ActorHandle:
+    """One spawned process + a dispatch thread serializing its calls."""
+
+    def __init__(self, cls: type, args: tuple, kwargs: dict):
+        import cloudpickle
+
+        parent, child = _mp.Pipe()
+        self._conn = parent
+        self._proc = _mp.Process(
+            target=_actor_main,
+            args=(child, cloudpickle.dumps((cls, args, kwargs))),
+            daemon=True)
+        self._proc.start()
+        child.close()
+        status, detail = cloudpickle.loads(self._conn.recv_bytes())
+        if status != "ok":
+            self._proc.join(timeout=5)
+            raise RuntimeError(f"actor constructor failed:\n{detail}")
+        self._queue: "queue.Queue" = queue.Queue()
+        self._alive = True
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True)
+        self._thread.start()
+        _STATE["actors"].append(self)
+
+    def _dispatch_loop(self) -> None:
+        import cloudpickle
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            method, args, kwargs, future = item
+            try:
+                self._conn.send_bytes(
+                    cloudpickle.dumps(("call", method, args, kwargs)))
+                status, value = cloudpickle.loads(self._conn.recv_bytes())
+            except (EOFError, OSError) as e:
+                future.set_exception(
+                    RuntimeError(f"actor died: {e!r}"))
+                continue
+            if status == "ok":
+                future.set_result(value)
+            else:
+                future.set_exception(RayTaskError(value))
+
+    def _call(self, method: str, args: tuple, kwargs: dict) -> ObjectRef:
+        if not self._alive:
+            raise RuntimeError("actor has been killed")
+        future: Future = Future()
+        self._queue.put((method, args, kwargs, future))
+        return ObjectRef(future)
+
+    def _terminate(self) -> None:
+        if not self._alive:
+            return
+        self._alive = False
+        self._queue.put(None)
+        try:
+            import cloudpickle
+
+            self._conn.send_bytes(cloudpickle.dumps(("stop",)))
+        except (OSError, ValueError):
+            pass
+        self._proc.join(timeout=10)
+        if self._proc.is_alive():
+            self._proc.kill()
+            self._proc.join(timeout=5)
+        self._conn.close()
+
+    def __getattr__(self, name: str) -> _RemoteMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _RemoteMethod(self, name)
+
+
+class RayTaskError(RuntimeError):
+    """Remote traceback carrier (ray.exceptions.RayTaskError analog)."""
+
+
+class _RemoteClass:
+    def __init__(self, cls: type, options: Optional[dict] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "_RemoteClass":
+        return _RemoteClass(self._cls, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        if not _STATE["initialized"]:
+            raise RuntimeError("ray.init() has not been called")
+        return ActorHandle(self._cls, args, kwargs)
+
+
+def remote(cls=None, **opts):
+    if cls is None:  # @ray.remote(num_cpus=...) decorator form
+        return lambda c: _RemoteClass(c, opts)
+    return _RemoteClass(cls)
+
+
+def get(refs, timeout: Optional[float] = None):
+    if isinstance(refs, ObjectRef):
+        return refs._future.result(timeout=timeout)
+    return [r._future.result(timeout=timeout) for r in refs]
+
+
+def kill(actor: ActorHandle) -> None:
+    actor._terminate()
