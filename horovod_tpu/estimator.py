@@ -38,7 +38,8 @@ def _resolve_loss(loss):
 
 def _train_worker(store: Store, run_id: str, model, optimizer, loss,
                   epochs: int, batch_size: int, seed: int,
-                  shuffle: bool, has_val: bool = False) -> Dict[str, Any]:
+                  shuffle: bool, has_val: bool = False,
+                  data_format: str = "pickle") -> Dict[str, Any]:
     """Per-worker training loop (the reference's RemoteTrainer fn,
     spark/keras/remote.py): shard by rank, grads averaged across the
     world via the engine's grouped allreduce, rank 0 checkpoints."""
@@ -51,16 +52,35 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
     rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
     multiproc = nproc > 1
 
-    X, y = store.read_obj(store.get_data_path(run_id, "train"))
-    # Validation presence travels as an explicit flag (NOT file
-    # existence — a reused run_id must not resurrect a previous fit's
-    # stale val set), and only rank 0 evaluates it: the other ranks'
-    # val_history is never consumed.
-    val = None
-    if has_val and rank == 0:
-        val = store.read_obj(store.get_data_path(run_id, "val"))
-    # Rank shard (the reference trains each worker on its data partition).
-    Xs, ys = (X[rank::nproc], y[rank::nproc]) if multiproc else (X, y)
+    if data_format == "parquet":
+        # Columnar path (reference Petastorm contract): this rank opens
+        # ONLY its shard files — no size x overfetch of the pickle blob.
+        from .parquet import ParquetDataset
+
+        shard = ParquetDataset(
+            store, store.path_join(store.get_run_path(run_id),
+                                   "train_parquet"),
+            rank=rank, size=nproc).load()
+        Xs, ys = shard["x"], shard["y"]
+        val = None
+        if has_val and rank == 0:
+            v = ParquetDataset(
+                store, store.path_join(store.get_run_path(run_id),
+                                       "val_parquet")).load()
+            val = (v["x"], v["y"])
+    else:
+        X, y = store.read_obj(store.get_data_path(run_id, "train"))
+        # Validation presence travels as an explicit flag (NOT file
+        # existence — a reused run_id must not resurrect a previous
+        # fit's stale val set), and only rank 0 evaluates it: the other
+        # ranks' val_history is never consumed.
+        val = None
+        if has_val and rank == 0:
+            val = store.read_obj(store.get_data_path(run_id, "val"))
+        # Rank shard (the reference trains each worker on its
+        # partition).
+        Xs, ys = (X[rank::nproc], y[rank::nproc]) if multiproc \
+            else (X, y)
 
     loss_fn = _resolve_loss(loss)
     rng = jax.random.PRNGKey(seed)
@@ -194,7 +214,12 @@ class Estimator:
                  epochs: int = 1, batch_size: int = 32,
                  run_id: Optional[str] = None, shuffle: bool = True,
                  seed: int = 0,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 data_format: str = "pickle"):
+        if data_format not in ("pickle", "parquet"):
+            raise ValueError(
+                f"data_format must be 'pickle' or 'parquet', got "
+                f"{data_format!r}")
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -206,6 +231,7 @@ class Estimator:
         self.shuffle = shuffle
         self.seed = seed
         self.worker_env = worker_env
+        self.data_format = data_format
 
     def fit(self, X, y, validation=None, executor=None) -> TrainedModel:
         """Train over the executor pool; returns the fitted transformer.
@@ -238,16 +264,35 @@ class Estimator:
             val_idx, train_idx = idx[:n_val], idx[n_val:]
             validation = (X[val_idx], y[val_idx])
             X, y = X[train_idx], y[train_idx]
-        if validation is not None:
+        if self.data_format == "parquet":
+            from .parquet import write_parquet_shards
+
+            run_path = self.store.get_run_path(run_id)
+            # One shard per worker so the rank::size file assignment
+            # gives every worker data (reference util.py repartitions
+            # to a multiple of the worker count the same way).
+            write_parquet_shards(
+                self.store, self.store.path_join(run_path,
+                                                 "train_parquet"),
+                {"x": X, "y": y}, num_shards=max(self.num_proc, 1))
+            if validation is not None:
+                write_parquet_shards(
+                    self.store, self.store.path_join(run_path,
+                                                     "val_parquet"),
+                    {"x": np.asarray(validation[0]),
+                     "y": np.asarray(validation[1])}, num_shards=1)
+        else:
+            if validation is not None:
+                self.store.write_obj(
+                    self.store.get_data_path(run_id, "val"),
+                    (np.asarray(validation[0]),
+                     np.asarray(validation[1])))
             self.store.write_obj(
-                self.store.get_data_path(run_id, "val"),
-                (np.asarray(validation[0]), np.asarray(validation[1])))
-        self.store.write_obj(self.store.get_data_path(run_id, "train"),
-                             (X, y))
+                self.store.get_data_path(run_id, "train"), (X, y))
 
         args = (self.store, run_id, self.model, self.optimizer, self.loss,
                 self.epochs, self.batch_size, self.seed, self.shuffle,
-                validation is not None)
+                validation is not None, self.data_format)
         if executor is not None:
             results = executor.run(_train_worker, args=args)
         else:

@@ -23,9 +23,17 @@ class Store:
 
     @classmethod
     def create(cls, prefix_path: str, **kwargs) -> "Store":
-        """Scheme dispatch (reference Store.create: HDFS vs local)."""
+        """Scheme dispatch (reference Store.create: HDFS vs local —
+        store.py:60-78). Any URL scheme (hdfs://, s3://, memory://, ...)
+        routes to the fsspec-backed store; gs:// prefers the dedicated
+        GCS store when gcsfs is present."""
         if prefix_path.startswith("gs://"):
+            # No fsspec fallback: resolving gs:// through fsspec needs
+            # the same gcsfs package, so the curated error is strictly
+            # more actionable.
             return GCSStore(prefix_path, **kwargs)
+        if "://" in prefix_path:
+            return FsspecStore(prefix_path, **kwargs)
         return LocalStore(prefix_path, **kwargs)
 
     # -- filesystem primitives --------------------------------------------
@@ -46,6 +54,11 @@ class Store:
         raise NotImplementedError
 
     def path_join(self, *parts: str) -> str:
+        raise NotImplementedError
+
+    def open(self, path: str, mode: str = "rb"):
+        """Streaming file handle — the primitive the columnar (parquet)
+        data path reads/writes through."""
         raise NotImplementedError
 
     # -- object layer ------------------------------------------------------
@@ -110,6 +123,72 @@ class LocalStore(Store):
         return iter(sorted(os.listdir(path)) if os.path.isdir(path)
                     else [])
 
+    def open(self, path: str, mode: str = "rb"):
+        if "w" in mode:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        return open(path, mode)
+
+
+class FsspecStore(Store):
+    """URL-addressed store over any fsspec filesystem — the HDFSStore
+    analog (reference store.py HDFSStore:1-504 rides pyarrow's HDFS
+    client; fsspec is the ecosystem's superset: hdfs://, s3://, gcs://,
+    memory://, ...). The filesystem is resolved once from the prefix
+    scheme; paths keep their fully-qualified URL form so run layouts
+    copy-paste between backends."""
+
+    def __init__(self, prefix_path: str, **storage_options):
+        import fsspec
+
+        self._fs, _ = fsspec.core.url_to_fs(prefix_path,
+                                            **storage_options)
+        self._prefix = prefix_path.rstrip("/")
+        self._fs.makedirs(self._strip(self._prefix), exist_ok=True)
+
+    def _strip(self, path: str) -> str:
+        return self._fs._strip_protocol(path)
+
+    def prefix(self) -> str:
+        return self._prefix
+
+    def path_join(self, *parts: str) -> str:
+        return "/".join(p.strip("/") if i else p.rstrip("/")
+                        for i, p in enumerate(parts))
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(self._strip(path))
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open(self._strip(path), "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        p = self._strip(path)
+        parent = p.rsplit("/", 1)[0]
+        if parent:
+            self._fs.makedirs(parent, exist_ok=True)
+        with self._fs.open(p, "wb") as f:
+            f.write(data)
+
+    def mkdirs(self, path: str) -> None:
+        self._fs.makedirs(self._strip(path), exist_ok=True)
+
+    def listdir(self, path: str):
+        p = self._strip(path)
+        if not self._fs.exists(p):
+            return iter([])
+        return iter(sorted(
+            name.rsplit("/", 1)[-1]
+            for name in self._fs.ls(p, detail=False)))
+
+    def open(self, path: str, mode: str = "rb"):
+        p = self._strip(path)
+        if "w" in mode:
+            parent = p.rsplit("/", 1)[0]
+            if parent:
+                self._fs.makedirs(parent, exist_ok=True)
+        return self._fs.open(p, mode)
+
 
 class GCSStore(Store):
     """GCS store (the HDFSStore analog for TPU pods). Gated on gcsfs /
@@ -152,3 +231,6 @@ class GCSStore(Store):
 
     def listdir(self, path: str):  # pragma: no cover - needs GCS
         return iter(self._fs.ls(path))
+
+    def open(self, path: str, mode: str = "rb"):  # pragma: no cover
+        return self._fs.open(path, mode)
