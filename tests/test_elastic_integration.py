@@ -139,3 +139,126 @@ def test_elastic_blacklist_and_resume(tmp_path, monkeypatch):
     assert first_c <= 6
     # hostA kept rank 0 across the restart (rank stability).
     assert all(rank == 0 for h, rank, _, _ in recs if h == "hostA")
+
+
+# -- scale-UP (host join, no failure) ---------------------------------------
+
+GROW_TRAIN_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import ObjectStore
+from horovod_tpu.common.elastic import JaxState
+
+workdir = sys.argv[1]
+TOTAL = 12
+hvd.init(force_cpu_devices=1)
+rank = int(os.environ["HVD_TPU_PROC_ID"])
+host = os.environ.get("HVD_TPU_HOSTNAME", "?")
+store = ObjectStore(os.path.join(workdir, "ckpt"))
+
+state = JaxState(w=np.zeros(2, np.float32), step=0)
+saved = store.get("state")
+if saved is not None:
+    for k, v in saved.items():
+        setattr(state, k, v)
+    state.save()
+
+log = open(os.path.join(workdir, "progress.log"), "a")
+
+
+@hvd.elastic.run
+def train(state):
+    while state.step < TOTAL:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="grad")
+        state.w = state.w + np.asarray(
+            out.addressable_data(0)).reshape(-1)
+        state.step += 1
+        if state.step == 4 and rank == 0:
+            # Announce capacity: discovery starts offering hostB. No
+            # failure happens — the driver must notice the ADDITION and
+            # interrupt workers at a commit boundary.
+            open(os.path.join(workdir, "grow"), "w").write("1")
+        if state.step >= 6 and hvd.size() == 1:
+            # Hold here until the join lands (discovery polls every
+            # ~1s; commit() checks the topology channel and raises
+            # HostsUpdatedInterrupt). Bounded so a driver bug fails the
+            # test with evidence instead of hanging it.
+            import time
+            for _ in range(150):
+                time.sleep(0.2)
+                state.commit()
+        state.commit()
+        if rank == 0:
+            store.put("state", dict(state.committed_items()))
+        print(f"PROGRESS {host} rank={rank} step={state.step} "
+              f"size={hvd.size()}", file=log, flush=True)
+
+
+train(state)
+"""
+
+GROW_DISCOVERY_SCRIPT = """#!/bin/bash
+echo "hostA:1"
+if [ -f {workdir}/grow ]; then
+  echo "hostB:1"
+fi
+"""
+
+
+@pytest.mark.slow
+def test_elastic_scale_up_on_host_join(tmp_path, monkeypatch):
+    """Reference elastic_common.py host-ADD scenario: discovery grows
+    mid-training (no failure), the driver interrupts at commit(), and
+    post-reset the world is LARGER with survivor ranks stable."""
+    workdir = str(tmp_path)
+    train_py = os.path.join(workdir, "train.py")
+    with open(train_py, "w") as f:
+        f.write(GROW_TRAIN_SCRIPT)
+    disco = os.path.join(workdir, "discovery.sh")
+    with open(disco, "w") as f:
+        f.write(GROW_DISCOVERY_SCRIPT.format(workdir=workdir))
+    os.chmod(disco, os.stat(disco).st_mode | stat.S_IEXEC)
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_FORCE_LOCAL", "1")
+    monkeypatch.setenv("HVD_TPU_ELASTIC_RESET_LIMIT", "10")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    rc = launch_lib.run_commandline(
+        ["-np", "1", "--elastic", "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", disco, "--",
+         sys.executable, train_py, workdir])
+    assert rc == 0
+
+    recs = []
+    for l in open(os.path.join(workdir, "progress.log")).read() \
+            .splitlines():
+        if not l.startswith("PROGRESS"):
+            continue
+        parts = l.split()
+        kv = dict(p.split("=") for p in parts[2:])
+        recs.append((parts[1], int(kv["rank"]), int(kv["step"]),
+                     int(kv["size"])))
+    assert recs, "no progress recorded"
+    assert max(step for _, _, step, _ in recs) == 12
+
+    # Before the join the world is 1; after the reset it is 2 — and the
+    # post-reset world STAYS 2 (scale-up, not flapping).
+    sizes_by_step = {}
+    for _, _, step, size in recs:
+        sizes_by_step.setdefault(step, set()).add(size)
+    assert 1 in sizes_by_step[1], sizes_by_step
+    last_sizes = sizes_by_step[max(sizes_by_step)]
+    assert last_sizes == {2}, sizes_by_step
+    # hostB actually trained steps.
+    assert any(h == "hostB" for h, _, _, _ in recs), \
+        "joined host never trained"
+    # Survivor rank stability: hostA is rank 0 before AND after.
+    assert all(rank == 0 for h, rank, _, _ in recs if h == "hostA")
