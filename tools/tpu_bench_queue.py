@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Opportunistic TPU bench queue (VERDICT r2 #1): the axon chip has
+multi-hour outages, so instead of hoping the backend serves at the one
+moment someone runs bench.py, this harness probes cheaply in a loop and
+drains a queued measurement list inside whatever clean window appears.
+
+Queue (each job = one subprocess, strictly serialized — the tunnel
+serves ONE chip and a SIGKILLed worker's stale lease starves the next
+for minutes):
+  model benches : bench.py --_worker --_platform=tpu --model M
+                  (resnet50 re-run + bert_large + gpt_small + vit_base
+                  + inception3, each with mfu_pct)
+  micro benches : tools/tpu_microbench.py {flash, overlap, fusion}
+
+A job's JSON is recorded ONLY if it reports platform == "tpu"; results
+land in results/tpu_r03/<job>.json plus a combined results.json. State
+survives restarts (done jobs are skipped). Methodology matches the
+reference's examples/tensorflow2/tensorflow2_synthetic_benchmark.py
+(synthetic data, timed batches after warmup).
+
+Usage: python tools/tpu_bench_queue.py [--max-hours H] [--once]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUTDIR = os.path.join(REPO, "results", "tpu_r03")
+
+PROBE_TIMEOUT = 90
+PROBE_SLEEP = 420          # between failed probes
+LEASE_COOLDOWN = 150       # after a killed TPU child, let the lease expire
+MAX_FAILS_PER_JOB = 3
+
+JOBS = [
+    # (name, argv tail, timeout_s). Model benches use the worker entry
+    # directly (no supervisor) so a down backend costs ONE timeout and
+    # never silently records a CPU-fallback number.
+    ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
+                  "--model", "resnet50"], 1200),
+    ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
+                    "--model", "bert_large"], 1200),
+    ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
+                   "--model", "gpt_small"], 1200),
+    ("vit_base", ["bench.py", "--_worker", "--_platform=tpu",
+                  "--model", "vit_base"], 1200),
+    ("inception3", ["bench.py", "--_worker", "--_platform=tpu",
+                    "--model", "inception3"], 1200),
+    ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
+    ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
+    ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
+]
+
+
+def _log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] queue: {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _state_path():
+    return os.path.join(OUTDIR, "state.json")
+
+
+def load_state():
+    try:
+        with open(_state_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"done": {}, "fails": {}}
+
+
+def save_state(state):
+    os.makedirs(OUTDIR, exist_ok=True)
+    tmp = _state_path() + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=2)
+    os.replace(tmp, _state_path())
+
+
+def probe():
+    """True iff the TPU backend answers within PROBE_TIMEOUT."""
+    code = ("import jax; d = jax.devices(); "
+            "assert d[0].platform == 'tpu', d; print(d[0].device_kind)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=PROBE_TIMEOUT, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log("probe: hung (timeout) — backend down")
+        return False
+    if proc.returncode != 0:
+        _log(f"probe: rc={proc.returncode} "
+             f"{(proc.stderr or '').strip().splitlines()[-1:]}")
+        return False
+    _log(f"probe: serving ({proc.stdout.strip()})")
+    return True
+
+
+def run_job(name, argv, timeout_s):
+    cmd = [sys.executable] + [
+        a if a.startswith("-") or not a.endswith(".py")
+        else os.path.join(REPO, a) for a in argv]
+    _log(f"job {name}: starting (timeout {timeout_s}s)")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log(f"job {name}: TIMED OUT after {timeout_s}s")
+        time.sleep(LEASE_COOLDOWN)
+        return None
+    dt = time.time() - t0
+    tail = (proc.stderr or "")[-1500:]
+    if proc.returncode != 0:
+        _log(f"job {name}: rc={proc.returncode} after {dt:.0f}s\n{tail}")
+        return None
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    try:
+        payload = json.loads(lines[-1])
+    except (IndexError, json.JSONDecodeError):
+        _log(f"job {name}: unparseable stdout tail: {lines[-1:]}")
+        return None
+    if payload.get("platform") != "tpu":
+        _log(f"job {name}: refused non-TPU record "
+             f"(platform={payload.get('platform')})")
+        return None
+    payload["wall_s"] = round(dt, 1)
+    payload["captured_unix"] = int(time.time())
+    _log(f"job {name}: OK in {dt:.0f}s -> {json.dumps(payload)[:300]}")
+    return payload
+
+
+def write_result(name, payload):
+    os.makedirs(OUTDIR, exist_ok=True)
+    with open(os.path.join(OUTDIR, f"{name}.json"), "w") as f:
+        json.dump(payload, f, indent=2)
+    combined = {}
+    for n, _, _ in JOBS:
+        p = os.path.join(OUTDIR, f"{n}.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                combined[n] = json.load(f)
+    with open(os.path.join(OUTDIR, "results.json"), "w") as f:
+        json.dump(combined, f, indent=2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe+drain pass, no sleep loop")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    state = load_state()
+    _log(f"starting; done={sorted(state['done'])}")
+
+    while time.time() < deadline:
+        pending = [(n, a, t) for n, a, t in JOBS
+                   if n not in state["done"]
+                   and state["fails"].get(n, 0) < MAX_FAILS_PER_JOB]
+        if not pending:
+            _log("queue drained (or all jobs exhausted retries); exiting")
+            break
+        if probe():
+            name, argv, timeout_s = pending[0]
+            payload = run_job(name, argv, timeout_s)
+            if payload is not None:
+                write_result(name, payload)
+                state["done"][name] = payload.get("captured_unix")
+            else:
+                state["fails"][name] = state["fails"].get(name, 0) + 1
+            save_state(state)
+            # No sleep on success — drain the window while it lasts.
+            continue
+        if args.once:
+            break
+        time.sleep(PROBE_SLEEP)
+
+    remaining = [n for n, _, _ in JOBS if n not in state["done"]]
+    _log(f"exiting; captured={sorted(state['done'])} missing={remaining}")
+    return 0 if not remaining else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""On-chip micro-benchmarks (VERDICT r2 #3): the measurements
+docs/performance.md §4b deferred "until the backend serves".
+
+Sections:
+  flash    — Pallas flash attention vs the jnp reference at
+             S ∈ {1024, 2048, 4096}, fwd and fwd+bwd, bf16 causal.
+  overlap  — the async-handle model's actual purpose (reference
+             gpu_operations.h:107-119 async completion): N collectives
+             dispatched then synchronized once vs N blocking host
+             round-trips, plus compute-overlap (independent matmul chain
+             issued while a large collective is in flight).
+  fusion   — grouped (fused-bucket) vs per-tensor eager allreduce.
+
+Unlike tools/perf_evidence.py this does NOT force the CPU backend — it
+runs on whatever jax.devices() serves (the axon v5e chip in practice)
+and records the platform so a CPU record can't masquerade as chip
+evidence. Prints ONE JSON object.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SMALL = "--small" in sys.argv  # smoke-scale shapes (CPU CI only)
+# The axon platform registration overrides a JAX_PLATFORMS env var (the
+# same trap bench.py documents), so CPU smoke runs must force the
+# backend through jax.config BEFORE first use.
+FORCE_CPU = "--cpu" in sys.argv
+
+
+def _log(msg):
+    print(f"microbench: {msg}", file=sys.stderr, flush=True)
+
+
+def _time_ms(fn, iters=20, warmup=3):
+    import jax
+
+    if SMALL:
+        iters, warmup = 2, 1
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def flash_section():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import flash_attention as fa
+
+    rng = jax.random.PRNGKey(0)
+    B, H, D = (1, 2, 64) if SMALL else (4, 8, 64)
+    out = {}
+    for S in (256,) if SMALL else (1024, 2048, 4096):
+        q, k, v = (jax.random.normal(jax.random.fold_in(rng, i),
+                                     (B, S, H, D), dtype=jnp.bfloat16)
+                   for i in range(3))
+
+        flash_f = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True))
+        ref_f = jax.jit(lambda q, k, v: fa.reference_attention(
+            q, k, v, causal=True))
+
+        def grad_of(f):
+            def loss(q, k, v):
+                return f(q, k, v).astype(jnp.float32).sum()
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        flash_g, ref_g = grad_of(flash_f), grad_of(ref_f)
+
+        row = {
+            "fwd_flash_ms": round(_time_ms(lambda: flash_f(q, k, v)), 3),
+            "fwd_ref_ms": round(_time_ms(lambda: ref_f(q, k, v)), 3),
+            "bwd_flash_ms": round(_time_ms(lambda: flash_g(q, k, v)), 3),
+            "bwd_ref_ms": round(_time_ms(lambda: ref_g(q, k, v)), 3),
+        }
+        row["fwd_speedup"] = round(row["fwd_ref_ms"] / row["fwd_flash_ms"], 2)
+        row["bwd_speedup"] = round(row["bwd_ref_ms"] / row["bwd_flash_ms"], 2)
+        out[f"S={S}"] = row
+        _log(f"flash S={S}: {row}")
+    return out
+
+
+def overlap_section():
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    nelem = 1 << 12 if SMALL else 1 << 20
+    ntens = 4 if SMALL else 16  # each name costs one eager compile
+    tensors = [np.ones((nelem,), np.float32) for _ in range(ntens)]
+
+    def async_batch():
+        handles = [hvd.allreduce_async(t, op=hvd.Sum, name=f"ov{i}")
+                   for i, t in enumerate(tensors)]
+        return [hvd.synchronize(h) for h in handles]
+
+    def sync_each():
+        outs = []
+        for i, t in enumerate(tensors):
+            o = hvd.allreduce(t, op=hvd.Sum, name=f"sv{i}")
+            jax.block_until_ready(o)
+            outs.append(o)
+        return outs
+
+    dispatch = {
+        "tensors": ntens,
+        "mib_each": round(nelem * 4 / 2**20, 3),
+        "async_then_sync_ms": round(_time_ms(async_batch, iters=10), 2),
+        "blocking_each_ms": round(_time_ms(sync_each, iters=10), 2),
+    }
+    dispatch["speedup"] = round(
+        dispatch["blocking_each_ms"] / dispatch["async_then_sync_ms"], 2)
+
+    # Compute-overlap: a big collective in flight while an INDEPENDENT
+    # matmul chain runs. Serial = sync the collective first, then the
+    # matmuls; overlapped = dispatch async, run matmuls, sync last.
+    big = np.ones((1 << 14 if SMALL else 1 << 22,), np.float32)  # 16 MiB
+    dim = 256 if SMALL else 2048
+    a = jax.device_put(np.random.default_rng(0)
+                       .standard_normal((dim, dim))
+                       .astype(np.float32))
+
+    @jax.jit
+    def matmul_chain(a):
+        for _ in range(2 if SMALL else 8):
+            a = jnp.tanh(a @ a) * 0.01
+        return a
+
+    def overlapped():
+        h = hvd.allreduce_async(big, op=hvd.Sum, name="ovl_big")
+        c = matmul_chain(a)
+        return hvd.synchronize(h), c
+
+    def serialized():
+        o = hvd.allreduce(big, op=hvd.Sum, name="ser_big")
+        jax.block_until_ready(o)
+        c = matmul_chain(a)
+        return o, c
+
+    compute = {
+        "collective_mib": round(big.nbytes / 2**20, 3),
+        "overlapped_ms": round(_time_ms(overlapped, iters=10), 2),
+        "serialized_ms": round(_time_ms(serialized, iters=10), 2),
+    }
+    compute["speedup"] = round(
+        compute["serialized_ms"] / compute["overlapped_ms"], 2)
+    return {"dispatch": dispatch, "compute_overlap": compute,
+            "world_size": hvd.size()}
+
+
+def fusion_section():
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    ngrp = 8 if SMALL else 64
+    tensors = {f"g{i}": np.ones((256,), np.float32) for i in range(ngrp)}
+
+    def grouped():
+        out = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="fuse")
+        jax.block_until_ready(jax.tree.leaves(out))
+        return out
+
+    def per_tensor():
+        return [jax.block_until_ready(
+                    hvd.allreduce(v, op=hvd.Sum, name=f"pt{i}"))
+                for i, v in enumerate(tensors.values())]
+
+    out = {"tensors": ngrp,
+           "grouped_ms": round(_time_ms(grouped, iters=10), 2),
+           "per_tensor_ms": round(_time_ms(per_tensor, iters=10), 2)}
+    out["speedup"] = round(out["per_tensor_ms"] / out["grouped_ms"], 1)
+    return out
+
+
+SECTIONS = {"flash": flash_section, "overlap": overlap_section,
+            "fusion": fusion_section}
+
+
+def main():
+    import jax
+
+    if FORCE_CPU:
+        jax.config.update("jax_platforms", "cpu")
+    wanted = [a for a in sys.argv[1:] if not a.startswith("-")] \
+        or list(SECTIONS)
+    unknown = [w for w in wanted if w not in SECTIONS]
+    if unknown:
+        raise SystemExit(f"unknown section(s) {unknown}; "
+                         f"choose from {list(SECTIONS)}")
+    dev = jax.devices()[0]
+    result = {"platform": dev.platform, "device_kind": dev.device_kind}
+    for name in wanted:
+        _log(f"section {name} ...")
+        result[name] = SECTIONS[name]()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
