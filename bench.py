@@ -110,6 +110,10 @@ def main():
     p.add_argument("--num-warmup", type=int, default=3)
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--batches-per-iter", type=int, default=5)
+    p.add_argument("--profile-dir", default="",
+                   help="capture a jax.profiler trace of the timed "
+                        "iterations into this directory (MFU "
+                        "diagnosis; ~100MB per run)")
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3",
                             "vit_base", "bert_large", "bert_base",
@@ -204,14 +208,29 @@ def _run_benchmark(args, n):
         force(run_batch())
     _log(f"warmup+compile done in {time.perf_counter() - t0:.1f}s")
 
+    profiling = False
+    if args.profile_dir:
+        try:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            _log(f"profiler unavailable: {e}")
+
     rates = []
-    for _ in range(args.num_iters):
-        t0 = time.perf_counter()
-        for _ in range(args.batches_per_iter):
-            l = run_batch()
-        force(l)
-        dt = time.perf_counter() - t0
-        rates.append(batch_size * args.batches_per_iter / dt)
+    try:
+        for _ in range(args.num_iters):
+            t0 = time.perf_counter()
+            for _ in range(args.batches_per_iter):
+                l = run_batch()
+            force(l)
+            dt = time.perf_counter() - t0
+            rates.append(batch_size * args.batches_per_iter / dt)
+    finally:
+        # A mid-iteration failure (the flaky-backend case this tooling
+        # exists for) must still flush the trace.
+        if profiling:
+            jax.profiler.stop_trace()
+            _log(f"profiler trace written to {args.profile_dir}")
 
     # batch_size is the GLOBAL batch (sharded over n chips in spmd mode);
     # the metric is per-chip, so divide the measured global rate by n.

@@ -233,6 +233,33 @@ class Estimator:
         self.worker_env = worker_env
         self.data_format = data_format
 
+    # -- Spark-ML-style Params surface (reference
+    #    spark/common/params.py:145-270 EstimatorParams: setX/getX
+    #    chainable accessors; setParams bulk form). The attribute IS the
+    #    storage — no Spark Param machinery to re-create. ---------------
+
+    _PARAMS = ("model", "optimizer", "loss", "store", "num_proc",
+               "epochs", "batch_size", "run_id", "shuffle", "seed",
+               "worker_env", "data_format")
+
+    def setParams(self, **kwargs) -> "Estimator":
+        for k, v in kwargs.items():
+            if k not in self._PARAMS:
+                raise ValueError(
+                    f"unknown param {k!r}; valid: {self._PARAMS}")
+            self._set_one(k, v)
+        return self
+
+    def _set_one(self, name: str, value) -> "Estimator":
+        if name == "data_format" and value not in ("pickle", "parquet"):
+            # Same validation as __init__ — setters must not smuggle a
+            # bad format past it to fail later inside the workers.
+            raise ValueError(
+                f"data_format must be 'pickle' or 'parquet', got "
+                f"{value!r}")
+        setattr(self, name, value)
+        return self
+
     def fit(self, X, y, validation=None, executor=None) -> TrainedModel:
         """Train over the executor pool; returns the fitted transformer.
 
@@ -304,3 +331,23 @@ class Estimator:
         trained.history = results[0]["history"]
         trained.val_history = results[0]["val_history"]
         return trained
+
+
+def _install_param_accessors() -> None:
+    """setEpochs/getEpochs etc. for every Estimator param (reference
+    spark/common/params.py accessor naming: snake_case param ->
+    CamelCase chainable setter/getter pair)."""
+    for p in Estimator._PARAMS:
+        camel = "".join(s.capitalize() for s in p.split("_"))
+
+        def setter(self, value, _p=p):
+            return self._set_one(_p, value)
+
+        def getter(self, _p=p):
+            return getattr(self, _p)
+
+        setattr(Estimator, f"set{camel}", setter)
+        setattr(Estimator, f"get{camel}", getter)
+
+
+_install_param_accessors()

@@ -308,6 +308,162 @@ class _IgnoreExecutable:
         return self._fn(*self._args, **self._kwargs)
 
 
+# -- elastic (reference ray/elastic.py) -------------------------------------
+
+@dataclass
+class ElasticSettings:
+    """create_settings product (reference ray/elastic.py:97-152)."""
+
+    min_np: int = 1
+    max_np: Optional[int] = None
+    reset_limit: Optional[int] = None
+    elastic_timeout: int = 600
+    timeout_s: int = 30
+    extra_env: Dict[str, str] = field(default_factory=dict)
+
+
+class ElasticRayExecutor:
+    """Elastic jobs with hosts/slots discovered from the LIVE Ray
+    cluster state (reference ElasticRayExecutor, ray/elastic.py:61-300:
+    "leverages the Ray global state to detect available hosts").
+
+    Rides the framework's elastic driver (runner/elastic_driver.py:
+    rank-stable assignments, blacklist, topology-version interrupt
+    channel) with :class:`RayHostDiscovery` as the discovery source —
+    nodes joining/leaving the Ray cluster grow/shrink the job between
+    commit points.
+
+    Example::
+
+        ray.init(address="auto")
+        settings = ElasticRayExecutor.create_settings(min_np=1)
+        executor = ElasticRayExecutor(settings, cpus_per_slot=2)
+        executor.start()
+        results = executor.run(train_fn)   # fn uses @hvd.elastic.run
+    """
+
+    @staticmethod
+    def create_settings(min_np: int = 1, max_np: Optional[int] = None,
+                        reset_limit: Optional[int] = None,
+                        elastic_timeout: int = 600,
+                        timeout_s: int = 30,
+                        extra_env: Optional[Dict[str, str]] = None
+                        ) -> ElasticSettings:
+        """No silent **kwargs: a typoed setting must error, not be
+        discarded (the reference forwards to Settings which validates
+        the same way)."""
+        return ElasticSettings(min_np=min_np, max_np=max_np,
+                               reset_limit=reset_limit,
+                               elastic_timeout=elastic_timeout,
+                               timeout_s=timeout_s,
+                               extra_env=dict(extra_env or {}))
+
+    def __init__(self, settings: Optional[ElasticSettings] = None,
+                 use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 override_discovery: bool = True):
+        self.settings = settings or ElasticSettings()
+        self.env_vars = dict(env_vars or {})
+        self.discovery: Optional["RayHostDiscovery"] = None
+        if override_discovery:
+            self.discovery = RayHostDiscovery(
+                use_gpu=use_gpu, cpus_per_slot=cpus_per_slot,
+                gpus_per_slot=gpus_per_slot)
+
+    def start(self) -> None:
+        """Validate the cluster serves at least min_np slots."""
+        if self.discovery is None:
+            raise RuntimeError("no discovery source; construct with "
+                               "override_discovery=True or set "
+                               ".discovery")
+        hosts = self.discovery.find_available_hosts_and_slots()
+        if sum(hosts.values()) < self.settings.min_np:
+            raise RuntimeError(
+                f"Ray cluster offers {sum(hosts.values())} slots < "
+                f"min_np={self.settings.min_np}")
+
+    def run(self, worker_fn: Callable) -> List[Any]:
+        """Run ``worker_fn`` elastically; returns the FINAL topology's
+        completed worker values in numeric rank order (reference run
+        contract — the fn handles its own elastic state via
+        hvd.elastic.run)."""
+        import argparse
+        import pickle
+        import sys
+        import tempfile
+
+        import cloudpickle
+
+        from ..runner.elastic_driver import run_elastic
+
+        if self.discovery is None:
+            raise RuntimeError("no discovery source; construct with "
+                               "override_discovery=True or set "
+                               ".discovery")
+        with tempfile.TemporaryDirectory(prefix="hvd_ray_elastic_") \
+                as tmp:
+            fn_path = os.path.join(tmp, "fn.pkl")
+            results_dir = os.path.join(tmp, "results")
+            with open(fn_path, "wb") as f:
+                cloudpickle.dump(worker_fn, f)
+
+            hosts = self.discovery.find_available_hosts_and_slots()
+            np_now = min(sum(hosts.values()),
+                         self.settings.max_np or sum(hosts.values()))
+            args = argparse.Namespace(
+                num_proc=np_now, min_np=self.settings.min_np,
+                max_np=self.settings.max_np,
+                host_discovery_script=None, hosts=None, ssh_port=None)
+            rc = run_elastic(
+                args,
+                [sys.executable, "-m", "horovod_tpu.ray.elastic_worker",
+                 fn_path, results_dir],
+                env_extra={**self.settings.extra_env, **self.env_vars},
+                discovery=self.discovery,
+                reset_limit=self.settings.reset_limit,
+                slot_wait_timeout_s=self.settings.elastic_timeout)
+            if rc != 0:
+                raise RuntimeError(
+                    f"elastic run failed with exit code {rc}")
+            return self._collect_results(results_dir)
+
+    @staticmethod
+    def _collect_results(results_dir: str) -> List[Any]:
+        """Keep only the FINAL topology's values: files are named
+        rank_{rank}_of_{np}; an aborted epoch's leftovers (different
+        world size, or a rank >= the final size) must not mix in. The
+        final epoch is identified by the newest file's world size."""
+        import pickle
+
+        if not os.path.isdir(results_dir):
+            return []
+        entries = []  # (mtime, rank, np, path)
+        for name in os.listdir(results_dir):
+            if not (name.startswith("rank_") and name.endswith(".pkl")):
+                continue
+            try:
+                rank_s, np_s = name[len("rank_"):-len(".pkl")] \
+                    .split("_of_")
+                rank, world = int(rank_s), int(np_s)
+            except ValueError:
+                continue
+            path = os.path.join(results_dir, name)
+            entries.append((os.path.getmtime(path), rank, world, path))
+        if not entries:
+            return []
+        final_world = max(entries)[2]
+        by_rank = {}
+        for _, rank, world, path in sorted(entries):
+            if world == final_world and rank < world:
+                by_rank[rank] = path  # later mtime wins per rank
+        results = []
+        for rank in sorted(by_rank):
+            with open(by_rank[rank], "rb") as f:
+                results.append(pickle.load(f))
+        return results
+
+
 # -- elastic discovery (reference ray/elastic.py:34-74) ---------------------
 
 class RayHostDiscovery:

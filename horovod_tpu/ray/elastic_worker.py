@@ -1,0 +1,32 @@
+"""Worker entry for ElasticRayExecutor.run(): loads the cloudpickled
+user function, executes it (the user's fn does its own
+``@hvd.elastic.run`` state handling, like the reference's
+worker_fn contract, ray/elastic.py:241-264), and drops this rank's
+return value where the driver collects it."""
+
+import os
+import pickle
+import sys
+
+
+def main(fn_path: str, results_dir: str) -> int:
+    import cloudpickle
+
+    with open(fn_path, "rb") as f:
+        worker_fn = cloudpickle.load(f)
+    value = worker_fn()
+    rank = os.environ.get("HVD_TPU_PROC_ID", "0")
+    world = os.environ.get("HVD_TPU_NUM_PROC", "1")
+    os.makedirs(results_dir, exist_ok=True)
+    # World size in the name lets the driver keep only the final
+    # topology's values when earlier epochs were aborted mid-write.
+    name = f"rank_{rank}_of_{world}.pkl"
+    tmp = os.path.join(results_dir, f".{name}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, os.path.join(results_dir, name))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

@@ -398,7 +398,10 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
 
 
 def run_elastic(args, command: List[str],
-                env_extra: Dict[str, str]) -> int:
+                env_extra: Dict[str, str],
+                discovery: Optional[HostDiscovery] = None,
+                reset_limit: Optional[int] = None,
+                slot_wait_timeout_s: Optional[float] = None) -> int:
     """Driver-side elastic launch (reference gloo_run_elastic
     gloo_run.py:326 + launch.py:616 + elastic/driver.py:68-309).
 
@@ -414,9 +417,12 @@ def run_elastic(args, command: List[str],
     so the restart IS the reset)."""
     min_np = args.min_np or args.num_proc
     max_np = args.max_np or args.num_proc
-    if args.host_discovery_script:
-        discovery: HostDiscovery = ScriptHostDiscovery(
-            args.host_discovery_script)
+    if discovery is not None:
+        # Injected source (e.g. ray.RayHostDiscovery over live cluster
+        # state) wins over script/hosts flags.
+        pass
+    elif args.host_discovery_script:
+        discovery = ScriptHostDiscovery(args.host_discovery_script)
     else:
         host_infos = (hosts_lib.parse_hosts(args.hosts) if args.hosts
                       else [hosts_lib.HostInfo("localhost", max_np)])
@@ -452,7 +458,8 @@ def run_elastic(args, command: List[str],
         attempts = 0
         while True:
             try:
-                driver.wait_for_available_slots(min_np)
+                driver.wait_for_available_slots(
+                    min_np, timeout_s=slot_wait_timeout_s or 600.0)
             except TimeoutError as e:
                 logger.error("elastic: %s", e)
                 return 1
@@ -475,8 +482,10 @@ def run_elastic(args, command: List[str],
                 driver.record_failure(h)
             bump_version()
             attempts += 1
-            if attempts > int(os.environ.get(
-                    "HVD_TPU_ELASTIC_RESET_LIMIT", "100")):
+            limit = (reset_limit if reset_limit is not None
+                     else int(os.environ.get(
+                         "HVD_TPU_ELASTIC_RESET_LIMIT", "100")))
+            if attempts > limit:
                 logger.error("elastic: reset limit exceeded")
                 return rc or 1
             if not driver.host_manager.current_hosts():

@@ -18,8 +18,8 @@ from horovod_tpu.testing import fake_ray
 sys.modules.setdefault("ray", fake_ray)
 
 from horovod_tpu.ray import (BaseHorovodWorker, Coordinator,  # noqa: E402
-                             MiniSettings, RayExecutor,
-                             RayHostDiscovery)
+                             ElasticRayExecutor, MiniSettings,
+                             RayExecutor, RayHostDiscovery)
 
 pytestmark = pytest.mark.slow
 
@@ -189,3 +189,59 @@ def test_ray_host_discovery_gpu_empty(ray_ctx):
     # CPU-only node: GPU discovery must come back empty, not error.
     assert RayHostDiscovery(use_gpu=True).\
         find_available_hosts_and_slots() == {}
+
+
+def test_elastic_ray_executor_runs(ray_ctx, monkeypatch, tmp_path):
+    """ElasticRayExecutor end-to-end: slots from ray.nodes(), workers
+    launched by the elastic driver, per-rank results collected
+    (reference ray/elastic.py run contract)."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_FORCE_LOCAL", "1")
+    settings = ElasticRayExecutor.create_settings(min_np=1, max_np=2)
+    ex = ElasticRayExecutor(settings,
+                            env_vars={**WORKER_ENV})
+    ex.start()
+
+    def work():
+        import os
+
+        return ("done", int(os.environ["HVD_TPU_PROC_ID"]))
+
+    results = ex.run(work)
+    assert 1 <= len(results) <= 2
+    assert all(r[0] == "done" for r in results)
+    assert sorted(r[1] for r in results) == list(range(len(results)))
+
+
+def test_elastic_collect_results_final_topology(tmp_path):
+    """Stale per-rank pickles from an aborted epoch (different world
+    size) are excluded; ranks order numerically, not lexically."""
+    import os
+    import pickle
+    import time
+
+    d = str(tmp_path)
+
+    def drop(rank, world, value, mtime_offset):
+        p = os.path.join(d, f"rank_{rank}_of_{world}.pkl")
+        with open(p, "wb") as f:
+            pickle.dump(value, f)
+        t = time.time() + mtime_offset
+        os.utime(p, (t, t))
+
+    # Aborted 4-world epoch leftovers (older)...
+    for r in range(4):
+        drop(r, 4, f"stale{r}", -100)
+    # ...then the final 11-world epoch (newest), enough ranks to catch
+    # lexicographic ordering (rank_10 before rank_2).
+    for r in range(11):
+        drop(r, 11, f"final{r}", 0)
+
+    out = ElasticRayExecutor._collect_results(d)
+    assert out == [f"final{r}" for r in range(11)]
+
+
+def test_elastic_ray_executor_requires_capacity(ray_ctx):
+    settings = ElasticRayExecutor.create_settings(min_np=10 ** 6)
+    ex = ElasticRayExecutor(settings)
+    with pytest.raises(RuntimeError, match="slots"):
+        ex.start()

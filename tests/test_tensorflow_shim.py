@@ -89,6 +89,38 @@ def test_collectives_inside_tf_function():
     np.testing.assert_allclose(ar.numpy(), np.full((2, 3), 8.0), rtol=1e-6)
 
 
+def test_graph_mode_costs_one_host_roundtrip_per_call():
+    """Pin the documented perf consequence of the py_function bridge
+    (docs/performance.md §TF-graph-mode): every EXECUTION of a traced
+    tf.function re-enters the host engine — the collective is not
+    constant-folded into the graph, and each call pays one host
+    round-trip (the reference's C++ op runs in-graph instead)."""
+    from horovod_tpu.common import basics
+
+    engine = basics.context().engine
+    real = engine.allreduce
+    calls = {"n": 0}
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    engine.allreduce = counting
+    try:
+        @tf.function
+        def step(t):
+            return hvdtf.allreduce(t, op=hvdtf.Sum, name="gm_pin")
+
+        t = tf.ones([3])
+        step(t)      # trace + first execution
+        first = calls["n"]
+        assert first >= 1
+        step(t + 1)  # same signature: re-EXECUTES the bridge
+        assert calls["n"] == first + 1
+    finally:
+        engine.allreduce = real
+
+
 def test_grouped_allreduce_fused():
     ts = [tf.ones([4]), tf.constant([1.0, 2.0])]
     outs = hvdtf.grouped_allreduce(ts, op=hvdtf.Sum)

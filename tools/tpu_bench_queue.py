@@ -42,6 +42,16 @@ JOBS = [
     # never silently records a CPU-fallback number.
     ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "resnet50"], 1200),
+    # MFU diagnosis (VERDICT r2 #2): batch 256 per the reference CNN
+    # benchmark's large-batch configuration, plus a profiled run whose
+    # trace feeds the input-feed-vs-compute analysis.
+    ("resnet50_b256", ["bench.py", "--_worker", "--_platform=tpu",
+                       "--model", "resnet50", "--batch-size", "256"],
+     1500),
+    ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                          "--model", "resnet50", "--batch-size", "256",
+                          "--num-iters", "3", "--profile-dir",
+                          "results/tpu_r03/trace_resnet50"], 1500),
     ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
                     "--model", "bert_large"], 1200),
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
