@@ -312,7 +312,14 @@ class _IgnoreExecutable:
 
 @dataclass
 class ElasticSettings:
-    """create_settings product (reference ray/elastic.py:97-152)."""
+    """create_settings product (reference ray/elastic.py:97-152).
+
+    ``elastic_timeout``: seconds to wait for >= min_np slots before the
+    job fails. ``timeout_s``: worker graceful-exit window on a topology
+    change (seconds between the interrupt being published and workers
+    being terminated). ``max_np=None`` means UNCAPPED — the job grows
+    to whatever the cluster offers (the reference's 'entire Ray cluster
+    is available' contract)."""
 
     min_np: int = 1
     max_np: Optional[int] = None
@@ -389,7 +396,6 @@ class ElasticRayExecutor:
         contract — the fn handles its own elastic state via
         hvd.elastic.run)."""
         import argparse
-        import pickle
         import sys
         import tempfile
 
@@ -411,9 +417,13 @@ class ElasticRayExecutor:
             hosts = self.discovery.find_available_hosts_and_slots()
             np_now = min(sum(hosts.values()),
                          self.settings.max_np or sum(hosts.values()))
+            # max_np=None means uncapped: run_elastic folds None to
+            # num_proc, which would freeze the job at today's cluster
+            # size — pass an effectively-infinite cap instead so new
+            # nodes grow the world.
             args = argparse.Namespace(
                 num_proc=np_now, min_np=self.settings.min_np,
-                max_np=self.settings.max_np,
+                max_np=self.settings.max_np or 2 ** 30,
                 host_discovery_script=None, hosts=None, ssh_port=None)
             rc = run_elastic(
                 args,
@@ -422,7 +432,8 @@ class ElasticRayExecutor:
                 env_extra={**self.settings.extra_env, **self.env_vars},
                 discovery=self.discovery,
                 reset_limit=self.settings.reset_limit,
-                slot_wait_timeout_s=self.settings.elastic_timeout)
+                slot_wait_timeout_s=self.settings.elastic_timeout,
+                grace_secs=self.settings.timeout_s)
             if rc != 0:
                 raise RuntimeError(
                     f"elastic run failed with exit code {rc}")

@@ -266,7 +266,8 @@ def _stream(proc: subprocess.Popen, tag: str) -> threading.Thread:
 def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
                command: List[str], env_extra: Dict[str, str],
                ssh_port=None, poll_interval: float = 0.1,
-               on_hosts_updated=None):
+               on_hosts_updated=None,
+               grace_secs: Optional[float] = None):
     """Run one elastic epoch with per-worker exit tracking.
 
     Returns ``(rc, failed_hosts, interrupted)``: ``failed_hosts`` are
@@ -334,7 +335,8 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     terminated = False
     epoch_ending = False
     grace_deadline = None
-    grace = float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30"))
+    grace = (grace_secs if grace_secs is not None else
+             float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30")))
 
     def terminate_all():
         for _, p in procs:
@@ -401,7 +403,8 @@ def run_elastic(args, command: List[str],
                 env_extra: Dict[str, str],
                 discovery: Optional[HostDiscovery] = None,
                 reset_limit: Optional[int] = None,
-                slot_wait_timeout_s: Optional[float] = None) -> int:
+                slot_wait_timeout_s: Optional[float] = None,
+                grace_secs: Optional[float] = None) -> int:
     """Driver-side elastic launch (reference gloo_run_elastic
     gloo_run.py:326 + launch.py:616 + elastic/driver.py:68-309).
 
@@ -459,7 +462,9 @@ def run_elastic(args, command: List[str],
         while True:
             try:
                 driver.wait_for_available_slots(
-                    min_np, timeout_s=slot_wait_timeout_s or 600.0)
+                    min_np,
+                    timeout_s=(600.0 if slot_wait_timeout_s is None
+                               else slot_wait_timeout_s))
             except TimeoutError as e:
                 logger.error("elastic: %s", e)
                 return 1
@@ -475,7 +480,7 @@ def run_elastic(args, command: List[str],
             rc, failed_hosts, interrupted = _run_epoch(
                 driver, slots, command, env_extra,
                 ssh_port=getattr(args, "ssh_port", None),
-                on_hosts_updated=bump_version)
+                on_hosts_updated=bump_version, grace_secs=grace_secs)
             if rc == 0 and not failed_hosts and not interrupted:
                 return 0
             for h in failed_hosts:

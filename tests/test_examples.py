@@ -46,6 +46,12 @@ def test_estimator_example():
     _run(["examples/estimator_fit.py", "--epochs", "3"])
 
 
+def test_ray_example():
+    out = _run(["examples/ray_train.py"],
+               extra_env={"HVD_TPU_EXAMPLE_FAKE_RAY": "1"})
+    assert "ray_train: OK" in out
+
+
 def test_adasum_example():
     _run(["examples/adasum_resnet.py", "--tiny", "--steps", "2",
           "--batch-size", "16"])

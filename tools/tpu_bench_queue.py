@@ -56,6 +56,12 @@ JOBS = [
                     "--model", "bert_large"], 1200),
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
+    # Long-context leg: the flash-attention decode path at 4x the
+    # default sequence length (the capability SURVEY §5 makes
+    # first-class).
+    ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
+                "--model", "gpt_small", "--seq-len", "2048",
+                "--batch-size", "4"], 1500),
     ("vit_base", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "vit_base"], 1200),
     ("inception3", ["bench.py", "--_worker", "--_platform=tpu",
