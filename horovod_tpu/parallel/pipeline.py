@@ -8,6 +8,18 @@ tick via ``lax.ppermute``, and a ``lax.fori_loop`` runs
 through the loop gives the backward pipeline for free (at GPipe-style
 activation memory; pair with ``jax.checkpoint`` on the stage fn to trade
 FLOPs for memory).
+
+Two schedules are provided: :func:`pipeline_apply` (GPipe fill-drain,
+autodiff backward) and :func:`pipeline_train_step_1f1b` (explicit
+interleaved 1F1B). Megatron's VIRTUAL-STAGE interleaving (v chunks per
+device, bubble ÷ v) is deliberately NOT implemented: under lockstep
+SPMD every device executes the same traced program every tick, so a
+device would pay v gated forward evals + v recompute-VJPs per tick
+whether or not its chunks are scheduled — the bubble saved is smaller
+than the dummy work added for every v > 1. Virtual stages pay off in
+MPMD runtimes where idle slots cost nothing; on a TPU mesh the 1F1B
+memory bound (this module) plus XLA's latency-hiding scheduler is the
+right trade.
 """
 
 from __future__ import annotations

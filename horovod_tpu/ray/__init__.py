@@ -325,7 +325,9 @@ class ElasticSettings:
     max_np: Optional[int] = None
     reset_limit: Optional[int] = None
     elastic_timeout: int = 600
-    timeout_s: int = 30
+    # None = fall through to the HVD_TPU_ELASTIC_GRACE_SECS env knob
+    # (default 30) — an explicit value here overrides it.
+    timeout_s: Optional[int] = None
     extra_env: Dict[str, str] = field(default_factory=dict)
 
 
@@ -353,7 +355,7 @@ class ElasticRayExecutor:
     def create_settings(min_np: int = 1, max_np: Optional[int] = None,
                         reset_limit: Optional[int] = None,
                         elastic_timeout: int = 600,
-                        timeout_s: int = 30,
+                        timeout_s: Optional[int] = None,
                         extra_env: Optional[Dict[str, str]] = None
                         ) -> ElasticSettings:
         """No silent **kwargs: a typoed setting must error, not be

@@ -245,3 +245,73 @@ def test_elastic_ray_executor_requires_capacity(ray_ctx):
     ex = ElasticRayExecutor(settings)
     with pytest.raises(RuntimeError, match="slots"):
         ex.start()
+
+
+def test_elastic_ray_executor_scales_up(ray_ctx, monkeypatch,
+                                        tmp_path):
+    """Ray 'cluster' grows mid-run (discovery flips from 1 to 2 hosts
+    once a worker drops a marker): with max_np=None (uncapped) the
+    elastic driver must interrupt and restart with the larger world —
+    the scale-up contract the reference's ElasticRayExecutor rides
+    Ray autoscaling for."""
+    import os
+
+    monkeypatch.setenv("HVD_TPU_ELASTIC_FORCE_LOCAL", "1")
+    marker = str(tmp_path / "grow")
+    sizes_log = str(tmp_path / "sizes.log")
+
+    class GrowingDiscovery:
+        def find_available_hosts_and_slots(self):
+            hosts = {"hostA": 1}
+            if os.path.exists(marker):
+                hosts["hostB"] = 1
+            return hosts
+
+    settings = ElasticRayExecutor.create_settings(min_np=1,
+                                                  timeout_s=20)
+    ex = ElasticRayExecutor(settings, override_discovery=False,
+                            env_vars={**WORKER_ENV})
+    ex.discovery = GrowingDiscovery()
+    ex.start()
+
+    def work(marker=marker, sizes_log=sizes_log):
+        import os
+        import time
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu.common.elastic import JaxState
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1)
+
+        state = JaxState(step=0)
+
+        @hvd.elastic.run
+        def train(state):
+            while state.step < 6:
+                hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                              name="g")
+                state.step += 1
+                if state.step == 2 and hvd.size() == 1:
+                    open(marker, "w").write("1")
+                if state.step >= 3 and hvd.size() == 1:
+                    # Hold until the join lands (discovery ~1s poll).
+                    for _ in range(100):
+                        time.sleep(0.2)
+                        state.commit()
+                state.commit()
+                with open(sizes_log, "a") as f:
+                    f.write(f"{state.step} {hvd.size()}\n")
+
+        train(state)
+        return hvd.size()
+
+    results = ex.run(work)
+    # Final world: both hosts -> 2 workers, each returning size 2.
+    assert results == [2, 2]
+    recs = [tuple(map(int, l.split()))
+            for l in open(sizes_log).read().splitlines()]
+    assert any(size == 1 for _, size in recs), "never ran small"
+    assert recs[-1][1] == 2, recs[-5:]
