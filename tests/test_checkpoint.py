@@ -84,3 +84,64 @@ def test_elastic_state_disk_roundtrip(tmp_path, hvd):
     fresh.epoch = 99
     fresh.restore()
     assert fresh.epoch == 2
+
+
+def test_checkpoint_sharded_zero1_resume(tmp_path, hvd):
+    """Distributed checkpoint/resume of ZeRO-1 SHARDED optimizer state
+    (SURVEY §5 depth: the state being saved is partitioned over the
+    8-device mesh, not replicated): save mid-training, restore into a
+    fresh run, and the resumed trajectory must match the uninterrupted
+    one exactly."""
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd_mod
+
+    ax = hvd_mod.rank_axis()
+    tx = hvd_mod.ShardedOptimizer(optax.adamw(0.1), axis_name=ax)
+    p0 = {"w": jnp.zeros((8 * 4, 2), jnp.float32)}
+    specs = tx.state_specs(p0)
+    x = jnp.ones((16, 8 * 4), jnp.float32)
+    y = jnp.ones((16, 2), jnp.float32)
+
+    @hvd_mod.spmd_step(in_specs=(P(),), out_specs=(specs,))
+    def init_s(p):
+        return (tx.init(p),)
+
+    @hvd_mod.spmd_step(in_specs=(P(), specs, P(ax), P(ax)),
+                       out_specs=(P(), specs, P()))
+    def step(p, s, xb, yb):
+        l, g = jax.value_and_grad(
+            lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.pmean(l, ax)
+
+    def run(p, s, nsteps):
+        for _ in range(nsteps):
+            p, s, _ = step(p, s, x, y)
+        return p, s
+
+    # Uninterrupted: 4 steps.
+    p, (s,) = dict(p0), init_s(p0)
+    p_mid, s_mid = run(p, s, 2)
+    with ckpt.CheckpointManager(str(tmp_path / "c")) as mgr:
+        assert mgr.save(2, {"params": p_mid, "opt": s_mid})
+        mgr.wait()
+        p_a, _ = run(p_mid, s_mid, 2)
+
+        # Resume: restore the SHARDED tree with the live (sharded)
+        # state as target so placements come back partitioned.
+        restored = mgr.restore(2, target={"params": p_mid,
+                                          "opt": s_mid})
+    # The headline property: restored leaves carry the SAME sharding
+    # as the live target (partitioned, not replicated/numpy).
+    import jax
+
+    for got, want in zip(jax.tree.leaves(restored["opt"]),
+                         jax.tree.leaves(s_mid)):
+        assert getattr(got, "sharding", None) == want.sharding, (
+            got, want.sharding)
+    p_b, _ = run(restored["params"], restored["opt"], 2)
+    np.testing.assert_allclose(np.asarray(p_b["w"]),
+                               np.asarray(p_a["w"]), rtol=1e-6)

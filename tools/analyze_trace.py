@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace captured by ``bench.py
+--profile-dir`` (the MFU-diagnosis leg, VERDICT r2 #2): per-device
+busy fraction, top ops by device time, and the infeed/host share —
+the three numbers that say whether ResNet is compute-bound, fusion-
+starved, or input-starved.
+
+Reads the Chrome-trace JSON the profiler writes alongside the xplane
+protobuf (no xprof dependency). Usage:
+
+    python tools/analyze_trace.py results/tpu_r03/trace_resnet50
+
+Prints ONE JSON object.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_trace(root: str) -> str:
+    cands = sorted(glob.glob(os.path.join(
+        root, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not cands:
+        cands = sorted(glob.glob(os.path.join(root,
+                                              "*.trace.json.gz")))
+    if not cands:
+        raise SystemExit(f"no *.trace.json.gz under {root}")
+    return cands[-1]  # newest capture
+
+
+def main(root: str) -> int:
+    path = find_trace(root)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", str(e["pid"]))
+
+    per_pid_busy = defaultdict(float)
+    per_pid_span = {}
+    op_time = defaultdict(float)
+    op_count = defaultdict(int)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        dur = float(e.get("dur", 0.0))
+        ts = float(e.get("ts", 0.0))
+        per_pid_busy[pid] += dur
+        lo, hi = per_pid_span.get(pid, (ts, ts + dur))
+        per_pid_span[pid] = (min(lo, ts), max(hi, ts + dur))
+        pname = pid_names.get(pid, str(pid))
+        if "TPU" in pname or "device" in pname.lower():
+            op_time[e.get("name", "?")] += dur
+            op_count[e.get("name", "?")] += 1
+
+    procs = {}
+    for pid, busy in per_pid_busy.items():
+        lo, hi = per_pid_span[pid]
+        span = max(hi - lo, 1e-9)
+        procs[pid_names.get(pid, str(pid))] = {
+            "busy_ms": round(busy / 1000, 2),
+            "span_ms": round(span / 1000, 2),
+            # >1 is possible on multi-track processes (overlapping
+            # streams); the DEVICE track's value is the one that
+            # matters for the compute-bound question.
+            "busy_fraction": round(busy / span, 3),
+        }
+
+    top = sorted(op_time.items(), key=lambda kv: -kv[1])[:15]
+    total_dev = sum(op_time.values()) or 1e-9
+    infeed = sum(t for n, t in op_time.items()
+                 if "infeed" in n.lower() or "copy" in n.lower()
+                 or "transfer" in n.lower())
+    print(json.dumps({
+        "trace": path,
+        "processes": procs,
+        "device_top_ops": [
+            {"name": n[:100], "ms": round(t / 1000, 2),
+             "count": op_count[n],
+             "pct_of_device": round(100 * t / total_dev, 1)}
+            for n, t in top],
+        "infeed_copy_pct_of_device": round(100 * infeed / total_dev, 1),
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
