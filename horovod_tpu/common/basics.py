@@ -54,6 +54,14 @@ class Context:
             config.thread_affinity,
             int(os.environ.get("HVD_TPU_LOCAL_SIZE", "1")),
             int(os.environ.get("HVD_TPU_LOCAL_RANK", "0")))
+        if config.compilation_cache_dir:
+            # Warm-start XLA compiles from disk: an elastic reset or
+            # relaunch re-traces the same programs, and TPU compiles
+            # run tens of seconds — the cache turns them into reads.
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir",
+                              config.compilation_cache_dir)
         self.mesh = topo_lib.build_mesh(topo, config.rank_axis)
         self.hier_mesh = None
         if topo.is_homogeneous and topo.cross_size > 1:

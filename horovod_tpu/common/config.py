@@ -102,6 +102,11 @@ class Config:
     # Host-core pinning: one core id per local rank, comma-separated
     # (reference: HOROVOD_THREAD_AFFINITY, common.cc:140-203).
     thread_affinity: Optional[str] = None
+    # Persistent XLA compilation cache directory (no reference analog —
+    # CUDA kernels ship precompiled; XLA recompiles per process, and an
+    # elastic reset IS a process restart, so warm-starting compiles
+    # from disk directly shortens every reset and relaunch).
+    compilation_cache_dir: Optional[str] = None
     # Logging level.
     log_level: str = "warning"
     # Mesh axis name used for the data-parallel "ranks" axis.
@@ -136,6 +141,7 @@ class Config:
         c.elastic = _env_bool("ELASTIC", False)
         c.join_mode = _env_bool("JOIN_MODE", False)
         c.thread_affinity = _env("THREAD_AFFINITY")
+        c.compilation_cache_dir = _env("COMPILATION_CACHE_DIR")
         c.log_level = _env("LOG_LEVEL", "warning") or "warning"
         c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
         c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)

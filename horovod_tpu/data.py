@@ -68,10 +68,15 @@ class ElasticSampler:
         self.processed_indices = set()
         self._repartition()
 
+    def get_indices(self, batch_idx: int, batch_size: int) -> List[int]:
+        """This rank's indices for batch ``batch_idx`` (reference
+        get_indices)."""
+        start = batch_idx * batch_size
+        return self.local_indices()[start:start + batch_size]
+
     def record_batch(self, batch_idx: int, batch_size: int) -> None:
         """Mark the batch's indices processed (reference record_batch)."""
-        start = batch_idx * batch_size
-        self.record_indices(self.local_indices()[start:start + batch_size])
+        self.record_indices(self.get_indices(batch_idx, batch_size))
 
     def record_indices(self, indices: Sequence[int]) -> None:
         self.processed_indices.update(int(i) for i in indices)

@@ -80,7 +80,105 @@ class _OptimizerHandler:
         self.save()
 
 
+class ElasticSampler(torch.utils.data.Sampler):
+    """torch-native elastic sampler — drop-in for the reference's
+    ``hvd.elastic.ElasticSampler`` (torch/elastic/sampler.py:24-135):
+    a ``torch.utils.data.Sampler`` usable directly in a ``DataLoader``
+    that repartitions UNPROCESSED indices after elastic resets. Thin
+    torch face over the framework-neutral
+    :class:`horovod_tpu.data.ElasticSampler` (same partition math,
+    padding, and deterministic per-epoch shuffle)."""
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        from ..data import ElasticSampler as _Impl
+
+        self.dataset = dataset
+        self._impl = _Impl(len(dataset), shuffle=shuffle, seed=seed)
+
+    # reference surface --------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self._impl.epoch
+
+    @property
+    def processed_indices(self):
+        return self._impl.processed_indices
+
+    def set_epoch(self, epoch: int) -> None:
+        self._impl.set_epoch(epoch)
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        self._impl.record_batch(batch_idx, batch_size)
+
+    def record_indices(self, indices) -> None:
+        self._impl.record_indices(indices)
+
+    def get_indices(self, batch_idx: int, batch_size: int):
+        return self._impl.get_indices(batch_idx, batch_size)
+
+    def reset(self) -> None:
+        # Reference semantics: the dataset length is re-read on every
+        # reset (a re-sharded/appended dataset repartitions correctly).
+        self._impl.dataset_size = len(self.dataset)
+        self._impl.reset()
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._impl.epoch,
+                "processed_indices": set(self._impl.processed_indices)}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self._impl.epoch = state_dict["epoch"]
+        self._impl.processed_indices = set(
+            state_dict["processed_indices"])
+        self.reset()  # wrapper reset: re-reads len(self.dataset) too
+
+    def __iter__(self):
+        return iter(self._impl)
+
+    def __len__(self) -> int:
+        return len(self._impl)
+
+
+class _SamplerHandler:
+    """Reference state.py SamplerStateHandler: snapshot the processed
+    set, restore it on rollback, and on sync adopt rank 0's view then
+    repartition for the NEW topology."""
+
+    def __init__(self, sampler):
+        self.value = sampler
+        self._saved = sampler.state_dict()
+
+    def save(self):
+        self._saved = self.value.state_dict()
+
+    def restore(self):
+        self.value.load_state_dict(self._saved)
+
+    def sync(self):
+        # Reference SamplerStateHandler: the processed set is the UNION
+        # of every rank's view (each rank recorded only its own batches
+        # since the last commit) — rank 0 alone would drop the others'
+        # progress and retrain those samples.
+        from horovod_tpu import allgather_object
+
+        states = allgather_object(self.value.state_dict(),
+                                  name="elastic.sampler")
+        merged: set = set()
+        for s in states:
+            merged |= set(s["processed_indices"])
+        self.value.load_state_dict({
+            "epoch": max(s["epoch"] for s in states),
+            "processed_indices": merged,
+        })  # load ends with reset() → repartition for the new world
+
+    def set_value(self, sampler):
+        self.value = sampler
+        self.save()
+
+
 def _make_handler(value):
+    if isinstance(value, ElasticSampler):
+        return _SamplerHandler(value)
     if isinstance(value, torch.nn.Module):
         return _ModelHandler(value)
     if isinstance(value, torch.optim.Optimizer) or (

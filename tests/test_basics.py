@@ -86,3 +86,41 @@ def test_capability_queries(hvd):
 
     assert hvd_torch.xla_built() is True and not hvd_torch.mpi_built()
     assert hvd_torch.join is not None
+
+
+def test_compilation_cache_knob(tmp_path, hvd, monkeypatch):
+    """HVD_TPU_COMPILATION_CACHE_DIR warm-starts XLA compiles from disk
+    (elastic resets/relaunches re-trace the same programs): after a
+    jitted collective, the cache directory holds entries."""
+    import glob
+
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd_mod
+
+    cache = str(tmp_path / "xla_cache")
+    monkeypatch.setenv("HVD_TPU_COMPILATION_CACHE_DIR", cache)
+    # Entry thresholds down so CPU-fast compiles persist in the test.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        hvd_mod.shutdown()
+        hvd_mod.init()
+        assert jax.config.jax_compilation_cache_dir == cache
+        out = hvd_mod.allreduce(np.ones(12, np.float32), op=hvd_mod.Sum,
+                                name="cc_knob")
+        jax.block_until_ready(out)
+        assert glob.glob(cache + "/*"), "no cache entries written"
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          0)
+        jax.config.update("jax_compilation_cache_dir", None)
+        # Clear the env BEFORE re-init, or Context re-applies the tmp
+        # cache dir and leaks it into the rest of the session.
+        monkeypatch.delenv("HVD_TPU_COMPILATION_CACHE_DIR")
+        hvd_mod.shutdown()
+        hvd_mod.init()
+        assert jax.config.jax_compilation_cache_dir is None

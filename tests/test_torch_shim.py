@@ -321,3 +321,59 @@ def test_adasum_optimizer_carries_compression():
     with pytest.raises(TypeError, match="argument order"):
         hvdt.DistributedOptimizer(
             torch.optim.SGD(model.parameters(), lr=0.1), None, hvdt.Sum)
+
+
+def test_elastic_sampler_dataloader(hvd):
+    """torch-native ElasticSampler (reference torch/elastic/sampler.py)
+    drives a real DataLoader; record_batch + reset repartitions only
+    the UNPROCESSED remainder."""
+    import torch
+
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    data = list(range(64))
+    s = ElasticSampler(data, shuffle=False)
+    assert len(s) == 8  # 64 / 8 ranks
+    loader = torch.utils.data.DataLoader(data, batch_size=4, sampler=s)
+    batches = [b.tolist() for b in loader]
+    assert sum(len(b) for b in batches) == 8
+
+    # Record the first batch processed, then reset (same topology):
+    # those indices never come back.
+    s.record_indices(batches[0])
+    s.reset()
+    remaining = list(s)
+    assert not set(batches[0]) & set(remaining)
+
+    # state_dict round-trip preserves the processed set.
+    sd = s.state_dict()
+    s2 = ElasticSampler(data, shuffle=False)
+    s2.load_state_dict(sd)
+    assert s2.processed_indices == set(batches[0])
+
+
+def test_torch_state_sampler_handler(hvd):
+    """TorchState snapshots/rolls back the sampler's processed set
+    (reference SamplerStateHandler): restore() returns to the last
+    commit."""
+    import torch
+
+    from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+    s = ElasticSampler(list(range(32)), shuffle=False)
+    state = TorchState(sampler=s, step=0)
+
+    s.record_indices([0, 1, 2, 3])
+    state.step = 1
+    state.commit()
+
+    s.record_indices([4, 5, 6, 7])
+    state.step = 2
+    assert s.processed_indices == {0, 1, 2, 3, 4, 5, 6, 7}
+
+    state.restore()
+    assert state.step == 1
+    assert s.processed_indices == {0, 1, 2, 3}
+
+    state.sync()  # single-controller: adopt rank 0's (own) view
+    assert s.processed_indices == {0, 1, 2, 3}
