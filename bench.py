@@ -61,12 +61,53 @@ ATTEMPTS = (
 )
 
 
-def _supervise(argv):
+def _cached_tpu_record(argv, model):
+    """The opportunistic queue (tools/tpu_bench_queue.py) may have
+    captured this model's REAL chip number earlier in a serving window.
+    If the live TPU attempts fail, that record — clearly marked
+    cached=true with its capture time — beats a CPU-fallback number
+    that says nothing about the chip.
+
+    Guard rails: the cache is keyed by model at the queue's DEFAULT
+    config, so any config-altering flag in argv (batch size, seq len,
+    smoke, ...) disables the lookup; records older than a day are
+    ignored (a stale number must not mask a live regression forever)."""
+    config_flags = [a for a in argv
+                    if a.startswith("-")
+                    and not (a == "--model" or a.startswith("--model="))]
+    if config_flags:
+        return None
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results", "tpu_r03", f"{model}.json")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(payload, dict) or payload.get("platform") != "tpu":
+        return None
+    age = time.time() - float(payload.get("captured_unix", 0))
+    if age > 24 * 3600:
+        _log(f"cached chip record is {age / 3600:.1f}h old; ignoring")
+        return None
+    payload["cached"] = True
+    return payload
+
+
+def _supervise(argv, model):
     import subprocess
 
     user_forced = [a for a in argv if a in ("--smoke",)]
     last_tail = ""
     for i, (platform, extra, timeout_s, backoff) in enumerate(ATTEMPTS):
+        if platform != "tpu" and i > 0:
+            cached = _cached_tpu_record(argv, model)
+            if cached is not None:
+                _log("live TPU attempts failed; emitting the queue's "
+                     f"cached chip record (captured_unix="
+                     f"{cached.get('captured_unix')})")
+                _emit(cached)
+                return 0
         if backoff:
             _log(f"backing off {backoff}s before attempt {i + 1}")
             time.sleep(backoff)
@@ -126,7 +167,7 @@ def main():
     args, _ = p.parse_known_args()
 
     if not args._worker:
-        return _supervise(sys.argv[1:])
+        return _supervise(sys.argv[1:], args.model)
 
     import jax
     if args._platform == "cpu":

@@ -1,0 +1,97 @@
+"""The outage-aware TPU bench queue (tools/tpu_bench_queue.py) is
+perf-evidence infrastructure — test its contracts: only platform=="tpu"
+records are accepted, state survives restarts, and a serving window is
+drained job-by-job."""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/tools")
+
+import tpu_bench_queue as q  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _outdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(q, "OUTDIR", str(tmp_path / "out"))
+    yield
+
+
+def _job(payload, name="j1"):
+    code = f"import json; print(json.dumps({payload!r}))"
+    return (name, ["-c", code], 60)
+
+
+def test_run_job_accepts_tpu_record():
+    name, argv, timeout_s = _job({"metric": "m", "value": 1.0,
+                                  "platform": "tpu"})
+    out = q.run_job(name, argv, timeout_s)
+    assert out["value"] == 1.0 and "captured_unix" in out
+
+
+def test_run_job_refuses_cpu_record():
+    """A CPU fallback must never masquerade as chip evidence."""
+    name, argv, timeout_s = _job({"metric": "m", "value": 1.0,
+                                  "platform": "cpu"})
+    assert q.run_job(name, argv, timeout_s) is None
+
+
+def test_run_job_handles_garbage_and_failure():
+    assert q.run_job("g", ["-c", "print('not json')"], 60) is None
+    assert q.run_job("f", ["-c", "raise SystemExit(3)"], 60) is None
+
+
+def test_state_roundtrip():
+    st = q.load_state()
+    assert st == {"done": {}, "fails": {}}
+    st["done"]["resnet50"] = 123
+    st["fails"]["flash"] = 2
+    q.save_state(st)
+    assert q.load_state() == st
+
+
+def test_main_drains_when_probe_serves(monkeypatch):
+    """One serving window: every queued job runs once, results land in
+    the combined results.json, exit code 0."""
+    jobs = [_job({"metric": "a", "value": 1, "platform": "tpu"}, "a"),
+            _job({"metric": "b", "value": 2, "platform": "tpu"}, "b")]
+    monkeypatch.setattr(q, "JOBS", jobs)
+    monkeypatch.setattr(q, "probe", lambda: True)
+    monkeypatch.setattr(sys, "argv", ["tpu_bench_queue.py", "--once",
+                                      "--max-hours", "0.01"])
+    # --once breaks after ONE probe failure but drains on success.
+    assert q.main() == 0
+    combined = json.load(open(q.OUTDIR + "/results.json"))
+    assert set(combined) == {"a", "b"}
+    assert q.load_state()["done"].keys() == {"a", "b"}
+
+
+def test_main_retries_then_gives_up(monkeypatch):
+    jobs = [_job({"platform": "cpu"}, "bad")]
+    monkeypatch.setattr(q, "JOBS", jobs)
+    monkeypatch.setattr(q, "probe", lambda: True)
+    monkeypatch.setattr(q, "MAX_FAILS_PER_JOB", 2)
+    monkeypatch.setattr(sys, "argv", ["tpu_bench_queue.py", "--once",
+                                      "--max-hours", "0.01"])
+    assert q.main() == 1
+    assert q.load_state()["fails"]["bad"] == 2
+
+
+def test_done_jobs_skip_on_restart(monkeypatch):
+    ran = []
+
+    def fake_run(name, argv, timeout_s):
+        ran.append(name)
+        return {"platform": "tpu", "captured_unix": 1}
+
+    jobs = [_job({}, "a"), _job({}, "b")]
+    monkeypatch.setattr(q, "JOBS", jobs)
+    monkeypatch.setattr(q, "probe", lambda: True)
+    monkeypatch.setattr(q, "run_job", fake_run)
+    q.save_state({"done": {"a": 1}, "fails": {}})
+    monkeypatch.setattr(sys, "argv", ["tpu_bench_queue.py", "--once",
+                                      "--max-hours", "0.01"])
+    assert q.main() == 0
+    assert ran == ["b"]
