@@ -82,11 +82,12 @@ def _cached_tpu_record(argv, model):
     try:
         with open(path) as f:
             payload = json.load(f)
-    except (OSError, json.JSONDecodeError):
+        if not isinstance(payload, dict) \
+                or payload.get("platform") != "tpu":
+            return None
+        age = time.time() - float(payload.get("captured_unix", 0))
+    except (OSError, json.JSONDecodeError, TypeError, ValueError):
         return None
-    if not isinstance(payload, dict) or payload.get("platform") != "tpu":
-        return None
-    age = time.time() - float(payload.get("captured_unix", 0))
     if age > 24 * 3600:
         _log(f"cached chip record is {age / 3600:.1f}h old; ignoring")
         return None

@@ -103,3 +103,44 @@ def test_estimator_fit_transform_over_executor_pool(tmp_path):
     # Per-epoch checkpoints exist.
     assert store.exists(store.path_join(
         store.get_checkpoint_path("fit1"), "epoch_0.pkl"))
+
+
+@pytest.mark.slow
+def test_keras_estimator_fit_transform(tmp_path):
+    """KerasEstimator (reference spark/keras/estimator.py shape):
+    a real tf.keras model serialized to 2 worker processes, trained
+    under the TF shim's DistributedOptimizer with broadcast/metric
+    callbacks, transformer loadable from the Store alone."""
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.keras_estimator import (KerasEstimator,
+                                             TrainedKerasModel)
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    true_w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = (X @ true_w).astype(np.float32)
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, input_shape=(4,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+
+    store = Store.create(str(tmp_path / "store"))
+    est = KerasEstimator(model=model, store=store, num_proc=2,
+                         epochs=12, batch_size=16, run_id="k1",
+                         worker_env={
+                             "XLA_FLAGS":
+                                 "--xla_force_host_platform_device_count=1",
+                             "HVD_TPU_FORCE_CPU_DEVICES": "1",
+                         })
+    trained = est.fit(X, y, validation=0.125)
+    assert trained.history[-1] < trained.history[0] * 0.5
+    assert len(trained.val_history) == 12
+
+    pred = trained.transform(X)
+    assert pred.shape == (64, 1)
+    mse = float(((pred - y) ** 2).mean())
+    assert mse < float((y ** 2).mean()) * 0.5
+
+    again = TrainedKerasModel.load(store, "k1")
+    np.testing.assert_allclose(again.transform(X), pred, rtol=1e-6)
