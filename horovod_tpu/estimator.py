@@ -21,6 +21,50 @@ import numpy as np
 from .store import Store
 
 
+def split_validation(X, y, validation, seed: int = 0):
+    """Shared validation handling for every estimator family: a float
+    fraction becomes a SEEDED random (train, val) split (a head slice
+    of ordered data would hold out a biased sample — the reference
+    estimators split randomly too); a (Xv, yv) tuple passes through.
+    Returns (X, y, validation_or_None)."""
+    X, y = np.asarray(X), np.asarray(y)
+    if isinstance(validation, float):
+        if not 0.0 < validation < 1.0:
+            raise ValueError("validation fraction must be in (0, 1)")
+        idx = np.random.default_rng(seed).permutation(len(X))
+        n_val = max(int(len(X) * validation), 1)
+        validation = (X[idx[:n_val]], y[idx[:n_val]])
+        X, y = X[idx[n_val:]], y[idx[n_val:]]
+    return X, y, validation
+
+
+def stage_pickle_data(store: Store, run_id: str, X, y,
+                      validation) -> None:
+    """Write the train (and optional val) arrays into the run layout."""
+    if validation is not None:
+        store.write_obj(store.get_data_path(run_id, "val"),
+                        (np.asarray(validation[0]),
+                         np.asarray(validation[1])))
+    store.write_obj(store.get_data_path(run_id, "train"), (X, y))
+
+
+def rank_shard(X, y, rank: int, nproc: int):
+    """Strided rank shard EQUALIZED to len(X)//nproc rows (shards
+    differ by <= 1 row; uneven per-epoch batch counts would leave one
+    rank's collective without partners — every estimator worker must
+    run the identical number of steps). Raises when a rank would be
+    empty: silently training on nothing corrupts the model (NaN loss)
+    with no signal."""
+    if nproc <= 1:
+        return X, y
+    min_shard = len(X) // nproc
+    if min_shard == 0:
+        raise ValueError(
+            f"{len(X)} training rows cannot feed {nproc} workers — "
+            f"reduce num_proc or provide more data")
+    return X[rank::nproc][:min_shard], y[rank::nproc][:min_shard]
+
+
 def _resolve_loss(loss):
     if callable(loss):
         return loss
@@ -50,18 +94,28 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
     hvd.init()
     nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
     rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
-    multiproc = nproc > 1
 
     if data_format == "parquet":
         # Columnar path (reference Petastorm contract): this rank opens
         # ONLY its shard files — no size x overfetch of the pickle blob.
         from .parquet import ParquetDataset
 
-        shard = ParquetDataset(
+        ds = ParquetDataset(
             store, store.path_join(store.get_run_path(run_id),
                                    "train_parquet"),
-            rank=rank, size=nproc).load()
+            rank=rank, size=nproc)
+        shard = ds.load()
         Xs, ys = shard["x"], shard["y"]
+        if nproc > 1 and ds.total_rows:
+            # Same equalization as rank_shard: file shards differ by
+            # <= 1 row, and unequal step counts desync the per-step
+            # collectives.
+            min_shard = ds.total_rows // nproc
+            if min_shard == 0:
+                raise ValueError(
+                    f"{ds.total_rows} training rows cannot feed "
+                    f"{nproc} workers")
+            Xs, ys = Xs[:min_shard], ys[:min_shard]
         val = None
         if has_val and rank == 0:
             v = ParquetDataset(
@@ -77,10 +131,10 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
         val = None
         if has_val and rank == 0:
             val = store.read_obj(store.get_data_path(run_id, "val"))
-        # Rank shard (the reference trains each worker on its
-        # partition).
-        Xs, ys = (X[rank::nproc], y[rank::nproc]) if multiproc \
-            else (X, y)
+        # Equalized rank shard (the reference trains each worker on
+        # its partition; equal sizes keep the per-step grouped
+        # allreduce counts aligned across ranks).
+        Xs, ys = rank_shard(X, y, rank, nproc)
 
     loss_fn = _resolve_loss(loss)
     rng = jax.random.PRNGKey(seed)

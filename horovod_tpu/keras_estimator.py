@@ -20,6 +20,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .estimator import (rank_shard, split_validation,
+                         stage_pickle_data)
 from .store import Store
 
 
@@ -54,15 +56,10 @@ def _keras_train_worker(store: Store, run_id: str,
     X, y = store.read_obj(store.get_data_path(run_id, "train"))
     val = store.read_obj(store.get_data_path(run_id, "val")) \
         if has_val else None
-    Xs, ys = (X[rank::nproc], y[rank::nproc]) if nproc > 1 else (X, y)
-    if nproc > 1:
-        # Equalize shard sizes (strided shards differ by <= 1 row):
-        # uneven per-epoch batch counts would desynchronize the
-        # per-step allreduce collectives across ranks — one rank's
-        # extra apply_gradients would have no partner (the reference
-        # remote trainer equalizes steps_per_epoch the same way).
-        min_shard = len(X) // nproc
-        Xs, ys = Xs[:min_shard], ys[:min_shard]
+    # Equalized shards: uneven per-epoch batch counts would
+    # desynchronize the per-step allreduce collectives across ranks
+    # (the reference remote trainer equalizes steps_per_epoch too).
+    Xs, ys = rank_shard(X, y, rank, nproc)
 
     opt_cfg = optimizer_cfg or blob["optimizer"]
     opt = tf.keras.optimizers.deserialize(opt_cfg) if opt_cfg \
@@ -178,20 +175,8 @@ class KerasEstimator:
         if self.store is None:
             raise ValueError("KerasEstimator requires a store=")
         run_id = self.run_id or f"krun_{int(time.time() * 1000):x}"
-        X, y = np.asarray(X), np.asarray(y)
-        if isinstance(validation, float):
-            if not 0.0 < validation < 1.0:
-                raise ValueError("validation fraction must be in (0,1)")
-            idx = np.random.default_rng(0).permutation(len(X))
-            n_val = max(int(len(X) * validation), 1)
-            validation = (X[idx[:n_val]], y[idx[:n_val]])
-            X, y = X[idx[n_val:]], y[idx[n_val:]]
-        if validation is not None:
-            self.store.write_obj(self.store.get_data_path(run_id, "val"),
-                                 (np.asarray(validation[0]),
-                                  np.asarray(validation[1])))
-        self.store.write_obj(self.store.get_data_path(run_id, "train"),
-                             (X, y))
+        X, y, validation = split_validation(X, y, validation)
+        stage_pickle_data(self.store, run_id, X, y, validation)
 
         blob = _serialize_model(self.model)
         opt_cfg = tf.keras.optimizers.serialize(self.optimizer) \

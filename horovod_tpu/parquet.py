@@ -72,6 +72,7 @@ def write_parquet_shards(store: Store, dir_path: str,
         paths.append(path)
     store.write(store.path_join(dir_path, _MANIFEST), json.dumps({
         "files": [f"part-{s:05d}.parquet" for s in range(num_shards)],
+        "num_rows": nrows,
         "columns": {k: {"dtype": str(np.asarray(v).dtype),
                         "shape": shapes[k]}
                     for k, v in columns.items()},
@@ -98,11 +99,16 @@ class ParquetDataset:
         self.batch_size = batch_size
         self.shuffle_seed = shuffle_seed
         self._columns_meta: Dict[str, dict] = {}
+        #: dataset-wide row count from the manifest (None for
+        #: pre-manifest directories) — lets every rank agree on a
+        #: global quantity without reading the other ranks' files.
+        self.total_rows: Optional[int] = None
         manifest_path = store.path_join(dir_path, _MANIFEST)
         if store.exists(manifest_path):
             manifest = json.loads(store.read(manifest_path))
             all_files = manifest["files"]
             self._columns_meta = manifest.get("columns", {})
+            self.total_rows = manifest.get("num_rows")
         else:  # pre-manifest directory: fall back to a listing
             all_files = sorted(n for n in store.listdir(dir_path)
                                if n.endswith(".parquet"))

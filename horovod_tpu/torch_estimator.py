@@ -1,0 +1,195 @@
+"""TorchEstimator — the reference's Spark Torch estimator
+(spark/torch/estimator.py: ship a torch model into cluster workers,
+train under hvd.DistributedOptimizer, return a transformer) re-hosted
+on the executor pool + Store.
+
+Torch models cloudpickle cleanly, so unlike the Keras path the model
+object itself crosses the boundary; each worker wraps the user's
+optimizer factory in ``horovod_tpu.torch.DistributedOptimizer``,
+broadcasts initial parameters, and trains its rank shard. Shards are
+equalized so the per-step allreduce count matches on every rank.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .estimator import rank_shard, split_validation, stage_pickle_data
+from .store import Store
+
+
+def _torch_train_worker(store: Store, run_id: str, model,
+                        optimizer_factory: Callable, loss_name: str,
+                        epochs: int, batch_size: int,
+                        has_val: bool) -> Dict[str, Any]:
+    """Reference spark/torch/remote.py RemoteTrainer recipe."""
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvdt
+
+    hvd.init()
+    nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
+    rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+
+    X, y = store.read_obj(store.get_data_path(run_id, "train"))
+    val = store.read_obj(store.get_data_path(run_id, "val")) \
+        if has_val else None
+    Xs, ys = rank_shard(X, y, rank, nproc)
+    Xt = torch.from_numpy(np.ascontiguousarray(Xs))
+    yt = torch.from_numpy(np.ascontiguousarray(ys))
+
+    loss_fn = {"mse": torch.nn.MSELoss(),
+               "cross_entropy": torch.nn.CrossEntropyLoss()}[loss_name]
+    opt = hvdt.DistributedOptimizer(
+        optimizer_factory(model.parameters()),
+        named_parameters=model.named_parameters())
+    hvdt.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    # ceil-stepping covers the tail partial batch (identical count on
+    # every rank because shards are equalized).
+    starts = list(range(0, len(Xt), batch_size)) or [0]
+    history: List[float] = []
+    val_history: List[float] = []
+    for _ in range(epochs):
+        model.train()
+        epoch_loss = 0.0
+        for s0 in starts:
+            xb = Xt[s0:s0 + batch_size]
+            yb = yt[s0:s0 + batch_size]
+            opt.zero_grad()
+            l = loss_fn(model(xb), yb)
+            l.backward()
+            opt.step()
+            epoch_loss += float(l)
+        history.append(epoch_loss / len(starts))
+        if val is not None:
+            model.eval()
+            with torch.no_grad():
+                vl = loss_fn(model(torch.from_numpy(
+                                 np.ascontiguousarray(val[0]))),
+                             torch.from_numpy(
+                                 np.ascontiguousarray(val[1])))
+            val_history.append(float(vl))
+    if rank == 0:
+        store.write_obj(
+            store.path_join(store.get_checkpoint_path(run_id),
+                            "torch_final.pkl"),
+            {k: v.cpu().numpy() for k, v in model.state_dict().items()})
+        store.write_obj(
+            store.path_join(store.get_logs_path(run_id),
+                            "history.pkl"),
+            {"train": history, "val": val_history})
+    return {"rank": rank}
+
+
+class TrainedTorchModel:
+    """Reference TorchModel Spark Transformer: batched host predict."""
+
+    def __init__(self, model, store: Store, run_id: str,
+                 history=None, val_history=None):
+        self.model = model
+        self.store = store
+        self.run_id = run_id
+        self.history = history or []
+        self.val_history = val_history or []
+
+    @classmethod
+    def load(cls, store: Store, run_id: str,
+             model) -> "TrainedTorchModel":
+        import torch
+
+        weights = store.read_obj(store.path_join(
+            store.get_checkpoint_path(run_id), "torch_final.pkl"))
+        model.load_state_dict({k: torch.from_numpy(np.array(v))
+                               for k, v in weights.items()})
+        history: List[float] = []
+        val_history: List[float] = []
+        hist_path = store.path_join(store.get_logs_path(run_id),
+                                    "history.pkl")
+        if store.exists(hist_path):
+            logged = store.read_obj(hist_path)
+            history = logged.get("train", [])
+            val_history = logged.get("val", [])
+        return cls(model, store, run_id, history, val_history)
+
+    def transform(self, X, batch_size: int = 1024) -> np.ndarray:
+        import torch
+
+        self.model.eval()
+        outs = []
+        with torch.no_grad():
+            for i in range(0, len(X), batch_size):
+                xb = torch.from_numpy(
+                    np.ascontiguousarray(X[i:i + batch_size]))
+                outs.append(self.model(xb).cpu().numpy())
+        if outs:
+            return np.concatenate(outs)
+        # Empty input: derive the output shape from a 0-row forward so
+        # the result still concatenates/indexes like real predictions.
+        with torch.no_grad():
+            empty = self.model(torch.zeros((0,) + tuple(X.shape[1:]),
+                                           dtype=torch.float32))
+        return empty.cpu().numpy()
+
+
+class TorchEstimator:
+    """fit/transform for torch models over the executor pool
+    (reference spark/torch/estimator.py TorchEstimator).
+
+    ``optimizer`` is a FACTORY ``params -> torch.optim.Optimizer``
+    (e.g. ``lambda p: torch.optim.SGD(p, lr=0.05)``) so each worker
+    builds its optimizer against its own model replica.
+    """
+
+    LOSSES = ("mse", "cross_entropy")
+
+    def __init__(self, model, optimizer: Callable,
+                 loss: str = "mse", store: Optional[Store] = None,
+                 num_proc: int = 2, epochs: int = 1,
+                 batch_size: int = 32, run_id: Optional[str] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
+        if loss not in self.LOSSES:
+            raise ValueError(f"loss must be one of {self.LOSSES}, "
+                             f"got {loss!r}")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.store = store
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.run_id = run_id
+        self.worker_env = worker_env
+
+    def fit(self, X, y, validation=None,
+            executor=None) -> TrainedTorchModel:
+        import time
+
+        from .executor import Executor
+
+        if self.store is None:
+            raise ValueError("TorchEstimator requires a store=")
+        run_id = self.run_id or f"trun_{int(time.time() * 1000):x}"
+        X, y, validation = split_validation(X, y, validation)
+        stage_pickle_data(self.store, run_id, X, y, validation)
+
+        args = (self.store, run_id, self.model, self.optimizer,
+                self.loss, self.epochs, self.batch_size,
+                validation is not None)
+        if executor is not None:
+            executor.run(_torch_train_worker, args=args)
+        else:
+            with Executor(np=self.num_proc,
+                          env=self.worker_env) as ex:
+                ex.run(_torch_train_worker, args=args)
+        # A FRESH replica: mutating the caller's model in place would
+        # make a second fit() warm-start silently (the Keras path
+        # rebuilds from JSON for the same reason).
+        import copy
+
+        return TrainedTorchModel.load(self.store, run_id,
+                                      copy.deepcopy(self.model))
