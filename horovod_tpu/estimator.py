@@ -107,15 +107,23 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
         shard = ds.load()
         Xs, ys = shard["x"], shard["y"]
         if nproc > 1 and ds.total_rows:
-            # Same equalization as rank_shard: file shards differ by
-            # <= 1 row, and unequal step counts desync the per-step
-            # collectives.
+            # Equal step counts on every rank, even when the file
+            # count is not a multiple of nproc (round-robin file
+            # assignment then skews rows per rank): trim long shards
+            # and PAD short ones by cycling (the reference
+            # DistributedSampler pads the same way) to exactly
+            # total_rows // nproc rows.
             min_shard = ds.total_rows // nproc
             if min_shard == 0:
                 raise ValueError(
                     f"{ds.total_rows} training rows cannot feed "
                     f"{nproc} workers")
-            Xs, ys = Xs[:min_shard], ys[:min_shard]
+            if len(Xs) < min_shard:
+                reps = -(-min_shard // max(len(Xs), 1))
+                Xs = np.concatenate([Xs] * reps)[:min_shard]
+                ys = np.concatenate([ys] * reps)[:min_shard]
+            else:
+                Xs, ys = Xs[:min_shard], ys[:min_shard]
         val = None
         if has_val and rank == 0:
             v = ParquetDataset(
@@ -332,19 +340,8 @@ class Estimator:
             raise ValueError("Estimator requires a store= "
                              "(hvd.store.Store.create(prefix))")
         run_id = self.run_id or f"run_{int(time.time() * 1000):x}"
-        X = np.asarray(X)
-        y = np.asarray(y)
-        if isinstance(validation, float):
-            if not 0.0 < validation < 1.0:
-                raise ValueError("validation fraction must be in (0, 1)")
-            # Seeded random split — a head-slice of ordered data would
-            # hold out a biased sample (the reference estimators split
-            # randomly too).
-            idx = np.random.default_rng(self.seed).permutation(len(X))
-            n_val = max(int(len(X) * validation), 1)
-            val_idx, train_idx = idx[:n_val], idx[n_val:]
-            validation = (X[val_idx], y[val_idx])
-            X, y = X[train_idx], y[train_idx]
+        X, y, validation = split_validation(X, y, validation,
+                                            seed=self.seed)
         if self.data_format == "parquet":
             from .parquet import write_parquet_shards
 
@@ -363,13 +360,7 @@ class Estimator:
                     {"x": np.asarray(validation[0]),
                      "y": np.asarray(validation[1])}, num_shards=1)
         else:
-            if validation is not None:
-                self.store.write_obj(
-                    self.store.get_data_path(run_id, "val"),
-                    (np.asarray(validation[0]),
-                     np.asarray(validation[1])))
-            self.store.write_obj(
-                self.store.get_data_path(run_id, "train"), (X, y))
+            stage_pickle_data(self.store, run_id, X, y, validation)
 
         args = (self.store, run_id, self.model, self.optimizer, self.loss,
                 self.epochs, self.batch_size, self.seed, self.shuffle,

@@ -24,7 +24,7 @@ import os
 import socket
 from typing import Any, Dict, Optional, Tuple
 
-from .runner.rendezvous import RendezvousClient, RendezvousServer
+from ..runner.rendezvous import RendezvousClient, RendezvousServer
 
 _SCOPE = "spark"
 

@@ -1,0 +1,13 @@
+"""Drop-in for the reference's ``horovod.spark.keras`` import path
+(spark/keras/__init__.py): re-exports the Keras estimator family.
+The implementation lives in :mod:`horovod_tpu.keras_estimator` — the
+Spark-specific substrate (Petastorm readers, Spark DataFrame
+ingestion) is replaced by the Store + executor-pool recipe, with the
+parquet columnar path (`horovod_tpu.parquet`) standing in for
+Petastorm."""
+
+from horovod_tpu.keras_estimator import (KerasEstimator,  # noqa: F401
+                                         TrainedKerasModel)
+
+# Reference exposes the transformer as KerasModel.
+KerasModel = TrainedKerasModel

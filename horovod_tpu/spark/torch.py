@@ -1,0 +1,9 @@
+"""Drop-in for the reference's ``horovod.spark.torch`` import path
+(spark/torch/__init__.py): re-exports the Torch estimator family from
+:mod:`horovod_tpu.torch_estimator`."""
+
+from horovod_tpu.torch_estimator import (TorchEstimator,  # noqa: F401
+                                         TrainedTorchModel)
+
+# Reference exposes the transformer as TorchModel.
+TorchModel = TrainedTorchModel

@@ -36,11 +36,17 @@ def _torch_train_worker(store: Store, run_id: str, model,
     rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
 
     X, y = store.read_obj(store.get_data_path(run_id, "train"))
+    # Only rank 0's val_history is persisted/consumed — the other
+    # ranks must not pay the full-set read + per-epoch forward.
     val = store.read_obj(store.get_data_path(run_id, "val")) \
-        if has_val else None
+        if (has_val and rank == 0) else None
     Xs, ys = rank_shard(X, y, rank, nproc)
-    Xt = torch.from_numpy(np.ascontiguousarray(Xs))
+    # Cast to the model's parameter dtype (numpy defaults to float64,
+    # torch modules to float32); cross-entropy targets must be long.
+    pdtype = next(model.parameters()).dtype
+    Xt = torch.from_numpy(np.ascontiguousarray(Xs)).to(pdtype)
     yt = torch.from_numpy(np.ascontiguousarray(ys))
+    yt = yt.long() if loss_name == "cross_entropy" else yt.to(pdtype)
 
     loss_fn = {"mse": torch.nn.MSELoss(),
                "cross_entropy": torch.nn.CrossEntropyLoss()}[loss_name]
@@ -68,11 +74,13 @@ def _torch_train_worker(store: Store, run_id: str, model,
         history.append(epoch_loss / len(starts))
         if val is not None:
             model.eval()
+            vx = torch.from_numpy(
+                np.ascontiguousarray(val[0])).to(pdtype)
+            vy = torch.from_numpy(np.ascontiguousarray(val[1]))
+            vy = vy.long() if loss_name == "cross_entropy" \
+                else vy.to(pdtype)
             with torch.no_grad():
-                vl = loss_fn(model(torch.from_numpy(
-                                 np.ascontiguousarray(val[0]))),
-                             torch.from_numpy(
-                                 np.ascontiguousarray(val[1])))
+                vl = loss_fn(model(vx), vy)
             val_history.append(float(vl))
     if rank == 0:
         store.write_obj(
@@ -120,11 +128,12 @@ class TrainedTorchModel:
         import torch
 
         self.model.eval()
+        pdtype = next(self.model.parameters()).dtype
         outs = []
         with torch.no_grad():
             for i in range(0, len(X), batch_size):
-                xb = torch.from_numpy(
-                    np.ascontiguousarray(X[i:i + batch_size]))
+                xb = torch.from_numpy(np.ascontiguousarray(
+                    X[i:i + batch_size])).to(pdtype)
                 outs.append(self.model(xb).cpu().numpy())
         if outs:
             return np.concatenate(outs)
@@ -132,7 +141,7 @@ class TrainedTorchModel:
         # the result still concatenates/indexes like real predictions.
         with torch.no_grad():
             empty = self.model(torch.zeros((0,) + tuple(X.shape[1:]),
-                                           dtype=torch.float32))
+                                           dtype=pdtype))
         return empty.cpu().numpy()
 
 
