@@ -118,8 +118,14 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
                 raise ValueError(
                     f"{ds.total_rows} training rows cannot feed "
                     f"{nproc} workers")
+            if len(Xs) == 0:
+                raise ValueError(
+                    f"rank {rank} drew no parquet shard files "
+                    f"(dataset has fewer files than {nproc} workers) "
+                    f"— rewrite the shards with num_shards >= the "
+                    f"worker count")
             if len(Xs) < min_shard:
-                reps = -(-min_shard // max(len(Xs), 1))
+                reps = -(-min_shard // len(Xs))
                 Xs = np.concatenate([Xs] * reps)[:min_shard]
                 ys = np.concatenate([ys] * reps)[:min_shard]
             else:

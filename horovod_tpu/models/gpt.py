@@ -89,7 +89,13 @@ class DecoderLayer(nn.Module):
 
 
 class GPT(nn.Module):
-    """Pre-LN decoder-only transformer with weight-tied LM head."""
+    """Pre-LN decoder-only transformer with weight-tied LM head.
+
+    ``remat=True`` wraps each decoder layer in ``nn.remat``
+    (jax.checkpoint): activations are recomputed during backprop
+    instead of stored, cutting long-context HBM from O(layers x S x
+    hidden) to O(S x hidden) at ~1/3 extra FLOPs — the standard TPU
+    memory/compute trade for sequence lengths past a few thousand."""
 
     vocab_size: int = 32000
     num_layers: int = 12
@@ -98,16 +104,18 @@ class GPT(nn.Module):
     mlp_dim: int = 3072
     dtype: Any = jnp.bfloat16
     attend_fn: Optional[Callable] = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None):
         emb = nn.Embed(self.vocab_size, self.hidden,
                        param_dtype=jnp.float32, name="tok_emb")
         x = emb(tokens).astype(self.dtype)
+        layer_cls = nn.remat(DecoderLayer) if self.remat else DecoderLayer
         for i in range(self.num_layers):
-            x = DecoderLayer(self.num_heads, self.mlp_dim, self.dtype,
-                             self.attend_fn, name=f"layer{i}")(x,
-                                                               positions)
+            x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
+                          self.attend_fn, name=f"layer{i}")(x,
+                                                            positions)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_ln")(x)
         # Weight-tied head: logits in fp32 for a stable softmax.

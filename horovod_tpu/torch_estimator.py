@@ -44,9 +44,16 @@ def _torch_train_worker(store: Store, run_id: str, model,
     # Cast to the model's parameter dtype (numpy defaults to float64,
     # torch modules to float32); cross-entropy targets must be long.
     pdtype = next(model.parameters()).dtype
-    Xt = torch.from_numpy(np.ascontiguousarray(Xs)).to(pdtype)
-    yt = torch.from_numpy(np.ascontiguousarray(ys))
-    yt = yt.long() if loss_name == "cross_entropy" else yt.to(pdtype)
+
+    def to_tensors(xa, ya):
+        xt = torch.from_numpy(np.ascontiguousarray(xa)).to(pdtype)
+        yt = torch.from_numpy(np.ascontiguousarray(ya))
+        yt = yt.long() if loss_name == "cross_entropy" \
+            else yt.to(pdtype)
+        return xt, yt
+
+    Xt, yt = to_tensors(Xs, ys)
+    val_t = to_tensors(*val) if val is not None else None
 
     loss_fn = {"mse": torch.nn.MSELoss(),
                "cross_entropy": torch.nn.CrossEntropyLoss()}[loss_name]
@@ -72,15 +79,10 @@ def _torch_train_worker(store: Store, run_id: str, model,
             opt.step()
             epoch_loss += float(l)
         history.append(epoch_loss / len(starts))
-        if val is not None:
+        if val_t is not None:
             model.eval()
-            vx = torch.from_numpy(
-                np.ascontiguousarray(val[0])).to(pdtype)
-            vy = torch.from_numpy(np.ascontiguousarray(val[1]))
-            vy = vy.long() if loss_name == "cross_entropy" \
-                else vy.to(pdtype)
             with torch.no_grad():
-                vl = loss_fn(model(vx), vy)
+                vl = loss_fn(model(val_t[0]), val_t[1])
             val_history.append(float(vl))
     if rank == 0:
         store.write_obj(

@@ -335,3 +335,32 @@ def test_vit_forward_and_distributed_training(hvd):
         params, st, l = step(params, st, x, y)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_gpt_remat_matches_no_remat(rng):
+    """remat=True (per-layer jax.checkpoint) must be numerically
+    invisible: identical logits AND identical grads, only the
+    activation-memory profile changes."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.gpt import GPT
+
+    kw = dict(vocab_size=64, num_layers=2, hidden=32, num_heads=2,
+              mlp_dim=64, dtype=jnp.float32)
+    toks = jnp.asarray(rng.integers(0, 64, (2, 16)))
+    m0, m1 = GPT(**kw), GPT(**kw, remat=True)
+    params = m0.init(jax.random.PRNGKey(0), toks)["params"]
+
+    def loss(m):
+        def f(p):
+            lg = m.apply({"params": p}, toks)
+            return (lg.astype(jnp.float32) ** 2).mean()
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(m0))(params)
+    l1, g1 = jax.value_and_grad(loss(m1))(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
