@@ -48,6 +48,88 @@ def stage_pickle_data(store: Store, run_id: str, X, y,
     store.write_obj(store.get_data_path(run_id, "train"), (X, y))
 
 
+def validate_data_format(data_format: str) -> str:
+    if data_format not in ("pickle", "parquet"):
+        raise ValueError(
+            f"data_format must be 'pickle' or 'parquet', got "
+            f"{data_format!r}")
+    return data_format
+
+
+def stage_data(store: Store, run_id: str, X, y, validation,
+               data_format: str, num_shards: int) -> None:
+    """One staging dispatch for every estimator family."""
+    if data_format == "parquet":
+        stage_parquet_data(store, run_id, X, y, validation,
+                           num_shards=num_shards)
+    else:
+        stage_pickle_data(store, run_id, X, y, validation)
+
+
+def stage_parquet_data(store: Store, run_id: str, X, y, validation,
+                       num_shards: int) -> None:
+    """Write train (one shard per worker) + optional val as parquet
+    through the Store (the Petastorm-equivalent columnar layout)."""
+    from .parquet import write_parquet_shards
+
+    run_path = store.get_run_path(run_id)
+    write_parquet_shards(
+        store, store.path_join(run_path, "train_parquet"),
+        {"x": X, "y": y}, num_shards=max(num_shards, 1))
+    if validation is not None:
+        write_parquet_shards(
+            store, store.path_join(run_path, "val_parquet"),
+            {"x": np.asarray(validation[0]),
+             "y": np.asarray(validation[1])}, num_shards=1)
+
+
+def load_parquet_shard(store: Store, run_id: str, rank: int,
+                       nproc: int):
+    """This rank's equalized parquet shard (reads ONLY its files).
+
+    Equal step counts on every rank, even when the file count is not a
+    multiple of nproc (round-robin file assignment then skews rows per
+    rank): long shards trim and short ones pad by cycling (the
+    reference DistributedSampler pads the same way) to exactly
+    total_rows // nproc rows. A rank with zero files raises — the
+    dataset must carry >= nproc shard files."""
+    from .parquet import ParquetDataset
+
+    ds = ParquetDataset(
+        store, store.path_join(store.get_run_path(run_id),
+                               "train_parquet"),
+        rank=rank, size=nproc)
+    shard = ds.load()
+    Xs, ys = shard["x"], shard["y"]
+    if nproc > 1 and ds.total_rows is not None:
+        min_shard = ds.total_rows // nproc
+        if min_shard == 0:
+            raise ValueError(
+                f"{ds.total_rows} training rows cannot feed "
+                f"{nproc} workers")
+        if len(Xs) == 0:
+            raise ValueError(
+                f"rank {rank} drew no parquet shard files (dataset "
+                f"has fewer files than {nproc} workers) — rewrite "
+                f"the shards with num_shards >= the worker count")
+        if len(Xs) < min_shard:
+            reps = -(-min_shard // len(Xs))
+            Xs = np.concatenate([Xs] * reps)[:min_shard]
+            ys = np.concatenate([ys] * reps)[:min_shard]
+        else:
+            Xs, ys = Xs[:min_shard], ys[:min_shard]
+    return Xs, ys
+
+
+def load_parquet_val(store: Store, run_id: str):
+    from .parquet import ParquetDataset
+
+    v = ParquetDataset(
+        store, store.path_join(store.get_run_path(run_id),
+                               "val_parquet")).load()
+    return v["x"], v["y"]
+
+
 def rank_shard(X, y, rank: int, nproc: int):
     """Strided rank shard EQUALIZED to len(X)//nproc rows (shards
     differ by <= 1 row; uneven per-epoch batch counts would leave one
@@ -98,44 +180,9 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
     if data_format == "parquet":
         # Columnar path (reference Petastorm contract): this rank opens
         # ONLY its shard files — no size x overfetch of the pickle blob.
-        from .parquet import ParquetDataset
-
-        ds = ParquetDataset(
-            store, store.path_join(store.get_run_path(run_id),
-                                   "train_parquet"),
-            rank=rank, size=nproc)
-        shard = ds.load()
-        Xs, ys = shard["x"], shard["y"]
-        if nproc > 1 and ds.total_rows:
-            # Equal step counts on every rank, even when the file
-            # count is not a multiple of nproc (round-robin file
-            # assignment then skews rows per rank): trim long shards
-            # and PAD short ones by cycling (the reference
-            # DistributedSampler pads the same way) to exactly
-            # total_rows // nproc rows.
-            min_shard = ds.total_rows // nproc
-            if min_shard == 0:
-                raise ValueError(
-                    f"{ds.total_rows} training rows cannot feed "
-                    f"{nproc} workers")
-            if len(Xs) == 0:
-                raise ValueError(
-                    f"rank {rank} drew no parquet shard files "
-                    f"(dataset has fewer files than {nproc} workers) "
-                    f"— rewrite the shards with num_shards >= the "
-                    f"worker count")
-            if len(Xs) < min_shard:
-                reps = -(-min_shard // len(Xs))
-                Xs = np.concatenate([Xs] * reps)[:min_shard]
-                ys = np.concatenate([ys] * reps)[:min_shard]
-            else:
-                Xs, ys = Xs[:min_shard], ys[:min_shard]
-        val = None
-        if has_val and rank == 0:
-            v = ParquetDataset(
-                store, store.path_join(store.get_run_path(run_id),
-                                       "val_parquet")).load()
-            val = (v["x"], v["y"])
+        Xs, ys = load_parquet_shard(store, run_id, rank, nproc)
+        val = load_parquet_val(store, run_id) \
+            if (has_val and rank == 0) else None
     else:
         X, y = store.read_obj(store.get_data_path(run_id, "train"))
         # Validation presence travels as an explicit flag (NOT file
@@ -284,10 +331,7 @@ class Estimator:
                  seed: int = 0,
                  worker_env: Optional[Dict[str, str]] = None,
                  data_format: str = "pickle"):
-        if data_format not in ("pickle", "parquet"):
-            raise ValueError(
-                f"data_format must be 'pickle' or 'parquet', got "
-                f"{data_format!r}")
+        validate_data_format(data_format)
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -348,25 +392,11 @@ class Estimator:
         run_id = self.run_id or f"run_{int(time.time() * 1000):x}"
         X, y, validation = split_validation(X, y, validation,
                                             seed=self.seed)
-        if self.data_format == "parquet":
-            from .parquet import write_parquet_shards
-
-            run_path = self.store.get_run_path(run_id)
-            # One shard per worker so the rank::size file assignment
-            # gives every worker data (reference util.py repartitions
-            # to a multiple of the worker count the same way).
-            write_parquet_shards(
-                self.store, self.store.path_join(run_path,
-                                                 "train_parquet"),
-                {"x": X, "y": y}, num_shards=max(self.num_proc, 1))
-            if validation is not None:
-                write_parquet_shards(
-                    self.store, self.store.path_join(run_path,
-                                                     "val_parquet"),
-                    {"x": np.asarray(validation[0]),
-                     "y": np.asarray(validation[1])}, num_shards=1)
-        else:
-            stage_pickle_data(self.store, run_id, X, y, validation)
+        # One shard per worker so the rank::size file assignment
+        # gives every worker data (reference util.py repartitions to a
+        # multiple of the worker count the same way).
+        stage_data(self.store, run_id, X, y, validation,
+                   self.data_format, num_shards=self.num_proc)
 
         args = (self.store, run_id, self.model, self.optimizer, self.loss,
                 self.epochs, self.batch_size, self.seed, self.shuffle,

@@ -20,8 +20,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .estimator import (rank_shard, split_validation,
-                         stage_pickle_data)
+from .estimator import (load_parquet_shard, load_parquet_val,
+                         rank_shard, split_validation,
+                         stage_data, validate_data_format)
 from .store import Store
 
 
@@ -40,7 +41,8 @@ def _serialize_model(model) -> Dict[str, Any]:
 def _keras_train_worker(store: Store, run_id: str,
                         blob: Dict[str, Any], loss, optimizer_cfg,
                         epochs: int, batch_size: int,
-                        has_val: bool) -> Dict[str, Any]:
+                        has_val: bool,
+                        data_format: str = "pickle") -> Dict[str, Any]:
     """Runs in each executor worker (reference spark/keras/remote.py
     RemoteTrainer): rank-sharded fit under the TF shim's distributed
     optimizer + callbacks; rank 0 persists weights/history."""
@@ -53,13 +55,20 @@ def _keras_train_worker(store: Store, run_id: str,
     nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
     rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
 
-    X, y = store.read_obj(store.get_data_path(run_id, "train"))
-    val = store.read_obj(store.get_data_path(run_id, "val")) \
-        if has_val else None
-    # Equalized shards: uneven per-epoch batch counts would
-    # desynchronize the per-step allreduce collectives across ranks
-    # (the reference remote trainer equalizes steps_per_epoch too).
-    Xs, ys = rank_shard(X, y, rank, nproc)
+    if data_format == "parquet":
+        Xs, ys = load_parquet_shard(store, run_id, rank, nproc)
+        val = load_parquet_val(store, run_id) if has_val else None
+    else:
+        X, y = store.read_obj(store.get_data_path(run_id, "train"))
+        val = store.read_obj(store.get_data_path(run_id, "val")) \
+            if has_val else None
+        # Equalized shards: uneven per-epoch batch counts would
+        # desynchronize the per-step allreduce collectives across
+        # ranks (the reference remote trainer equalizes
+        # steps_per_epoch too).
+        Xs, ys = rank_shard(X, y, rank, nproc)
+    if val is not None:
+        val = (np.asarray(val[0]), np.asarray(val[1]))
 
     opt_cfg = optimizer_cfg or blob["optimizer"]
     opt = tf.keras.optimizers.deserialize(opt_cfg) if opt_cfg \
@@ -153,7 +162,9 @@ class KerasEstimator:
                  loss: Optional[str] = None, optimizer=None,
                  num_proc: int = 2, epochs: int = 1,
                  batch_size: int = 32, run_id: Optional[str] = None,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 data_format: str = "pickle"):
+        validate_data_format(data_format)
         self.model = model
         self.store = store
         self.loss = loss
@@ -163,6 +174,7 @@ class KerasEstimator:
         self.batch_size = batch_size
         self.run_id = run_id
         self.worker_env = worker_env
+        self.data_format = data_format
 
     def fit(self, X, y, validation=None,
             executor=None) -> TrainedKerasModel:
@@ -176,13 +188,15 @@ class KerasEstimator:
             raise ValueError("KerasEstimator requires a store=")
         run_id = self.run_id or f"krun_{int(time.time() * 1000):x}"
         X, y, validation = split_validation(X, y, validation)
-        stage_pickle_data(self.store, run_id, X, y, validation)
+        stage_data(self.store, run_id, X, y, validation,
+                   self.data_format, num_shards=self.num_proc)
 
         blob = _serialize_model(self.model)
         opt_cfg = tf.keras.optimizers.serialize(self.optimizer) \
             if self.optimizer is not None else None
         args = (self.store, run_id, blob, self.loss, opt_cfg,
-                self.epochs, self.batch_size, validation is not None)
+                self.epochs, self.batch_size, validation is not None,
+                self.data_format)
         if executor is not None:
             results = executor.run(_keras_train_worker, args=args)
         else:

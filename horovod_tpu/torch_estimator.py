@@ -17,14 +17,17 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .estimator import rank_shard, split_validation, stage_pickle_data
+from .estimator import (load_parquet_shard, load_parquet_val,
+                         rank_shard, split_validation,
+                         stage_data, validate_data_format)
 from .store import Store
 
 
 def _torch_train_worker(store: Store, run_id: str, model,
                         optimizer_factory: Callable, loss_name: str,
                         epochs: int, batch_size: int,
-                        has_val: bool) -> Dict[str, Any]:
+                        has_val: bool,
+                        data_format: str = "pickle") -> Dict[str, Any]:
     """Reference spark/torch/remote.py RemoteTrainer recipe."""
     import torch
 
@@ -35,12 +38,17 @@ def _torch_train_worker(store: Store, run_id: str, model,
     nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
     rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
 
-    X, y = store.read_obj(store.get_data_path(run_id, "train"))
-    # Only rank 0's val_history is persisted/consumed — the other
-    # ranks must not pay the full-set read + per-epoch forward.
-    val = store.read_obj(store.get_data_path(run_id, "val")) \
-        if (has_val and rank == 0) else None
-    Xs, ys = rank_shard(X, y, rank, nproc)
+    if data_format == "parquet":
+        Xs, ys = load_parquet_shard(store, run_id, rank, nproc)
+        val = load_parquet_val(store, run_id) \
+            if (has_val and rank == 0) else None
+    else:
+        X, y = store.read_obj(store.get_data_path(run_id, "train"))
+        # Only rank 0's val_history is persisted/consumed — the other
+        # ranks must not pay the full-set read + per-epoch forward.
+        val = store.read_obj(store.get_data_path(run_id, "val")) \
+            if (has_val and rank == 0) else None
+        Xs, ys = rank_shard(X, y, rank, nproc)
     # Cast to the model's parameter dtype (numpy defaults to float64,
     # torch modules to float32); cross-entropy targets must be long.
     pdtype = next(model.parameters()).dtype
@@ -162,7 +170,10 @@ class TorchEstimator:
                  loss: str = "mse", store: Optional[Store] = None,
                  num_proc: int = 2, epochs: int = 1,
                  batch_size: int = 32, run_id: Optional[str] = None,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 data_format: str = "pickle"):
+        validate_data_format(data_format)
+        self.data_format = data_format
         if loss not in self.LOSSES:
             raise ValueError(f"loss must be one of {self.LOSSES}, "
                              f"got {loss!r}")
@@ -186,11 +197,12 @@ class TorchEstimator:
             raise ValueError("TorchEstimator requires a store=")
         run_id = self.run_id or f"trun_{int(time.time() * 1000):x}"
         X, y, validation = split_validation(X, y, validation)
-        stage_pickle_data(self.store, run_id, X, y, validation)
+        stage_data(self.store, run_id, X, y, validation,
+                   self.data_format, num_shards=self.num_proc)
 
         args = (self.store, run_id, self.model, self.optimizer,
                 self.loss, self.epochs, self.batch_size,
-                validation is not None)
+                validation is not None, self.data_format)
         if executor is not None:
             executor.run(_torch_train_worker, args=args)
         else:

@@ -183,3 +183,33 @@ def test_estimator_rejects_unknown_data_format():
 
     with pytest.raises(ValueError, match="data_format"):
         Estimator(model=None, optimizer=None, data_format="arrow")
+
+
+@pytest.mark.slow
+def test_torch_estimator_parquet_data_format(tmp_path):
+    """The columnar path also feeds the torch estimator family."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.torch_estimator import TorchEstimator
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    y = (X @ np.array([[1.0], [-2.0], [0.5], [3.0]],
+                      np.float32)).astype(np.float32)
+    torch.manual_seed(0)
+    store = Store.create(str(tmp_path / "store"))
+    est = TorchEstimator(
+        model=torch.nn.Sequential(torch.nn.Linear(4, 1)),
+        optimizer=lambda p: torch.optim.SGD(p, lr=0.05),
+        store=store, num_proc=2, epochs=12, batch_size=16,
+        run_id="tp1", data_format="parquet",
+        worker_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HVD_TPU_FORCE_CPU_DEVICES": "1",
+        })
+    trained = est.fit(X, y, validation=0.125)
+    assert trained.history[-1] < trained.history[0] * 0.5
+    run = store.get_run_path("tp1")
+    assert store.exists(store.path_join(run, "train_parquet",
+                                        "_manifest.json"))
+    assert not store.exists(store.get_data_path("tp1", "train"))
