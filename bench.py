@@ -160,6 +160,9 @@ def main():
                    choices=["resnet50", "resnet101", "vgg16", "inception3",
                             "vit_base", "bert_large", "bert_base",
                             "gpt_small", "gpt_medium"])
+    p.add_argument("--remat", action="store_true",
+                   help="per-layer activation recomputation on the GPT "
+                        "models (long-context HBM relief)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -514,7 +517,8 @@ def _setup_gpt(args, batch_size, n):
     import horovod_tpu as hvd
     from horovod_tpu.models import gpt_medium, gpt_small
 
-    model = (gpt_small if args.model == "gpt_small" else gpt_medium)()
+    model = (gpt_small if args.model == "gpt_small"
+             else gpt_medium)(remat=args.remat)
     rng = jax.random.PRNGKey(0)
     S = args.seq_len
     tokens = jax.random.randint(rng, (batch_size, S + 1), 0,

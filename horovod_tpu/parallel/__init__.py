@@ -1,2 +1,15 @@
 """Parallelism strategies over the collective primitive set: mesh builders,
-sequence parallelism (ring attention, Ulysses), expert parallel, pipeline."""
+sequence parallelism (ring attention, Ulysses), expert parallel,
+tensor parallel, pipeline (GPipe fill-drain + interleaved 1F1B)."""
+
+from .mesh import build_mesh, data_spec, param_spec  # noqa: F401
+from .moe import moe_layer, top2_gating  # noqa: F401
+from .pipeline import (pipeline_apply,  # noqa: F401
+                       pipeline_train_step_1f1b, select_last_stage)
+from .ring_attention import (ring_attend_fn,  # noqa: F401
+                             ring_attention)
+from .tensor_parallel import (column_parallel,  # noqa: F401
+                              row_parallel, shard_column, shard_row,
+                              tp_attention_qkv, tp_mlp)
+from .ulysses import (ulysses_attend_fn,  # noqa: F401
+                      ulysses_attention)
