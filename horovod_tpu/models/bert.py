@@ -16,7 +16,6 @@ from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
-import numpy as np
 
 
 # Default attention: the Pallas flash kernel on TPU (O(S) memory,

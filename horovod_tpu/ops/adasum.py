@@ -28,7 +28,6 @@ needed — the formula is symmetric in (a, b).
 from __future__ import annotations
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 from jax import lax
 

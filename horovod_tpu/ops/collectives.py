@@ -18,8 +18,6 @@ Reduce-op enum values match the reference C ABI
 from __future__ import annotations
 
 import enum
-import math
-from functools import partial
 from typing import Optional, Sequence
 
 import jax

@@ -17,7 +17,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 # Canonical axis order: slowest/outermost first. dp may span DCN; the
 # rightmost axes must ride ICI (tp does neighbor-heavy collectives).

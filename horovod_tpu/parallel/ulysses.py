@@ -21,7 +21,6 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-import jax.numpy as jnp
 from jax import lax
 
 
