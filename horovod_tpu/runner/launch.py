@@ -237,6 +237,45 @@ def _nic_discovery_coordinator(hosts: List[str],
                 p.wait()
 
 
+def check_build() -> str:
+    """Capability matrix (reference horovodrun --check-build,
+    launch.py:107-143) — honest answers: shims are available when
+    their framework imports; the one tensor-op plane is XLA."""
+    from .. import __version__
+    from ..common import basics
+
+    def mark(v):
+        return "X" if v else " "
+
+    def importable(mod):
+        import importlib.util
+
+        return importlib.util.find_spec(mod) is not None
+
+    return f"""\
+horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [X] JAX (native)
+    [{mark(importable('tensorflow'))}] TensorFlow (shim)
+    [{mark(importable('torch'))}] PyTorch (shim)
+    [{mark(importable('mxnet'))}] MXNet (shim)
+
+Available Controllers:
+    [X] XLA single-controller (SPMD)
+    [X] jax.distributed + rendezvous KV (multi-process)
+    [{mark(basics.mpi_built())}] MPI
+    [{mark(basics.gloo_built())}] Gloo
+
+Available Tensor Operations:
+    [{mark(basics.xla_built())}] XLA (ICI/DCN)
+    [{mark(basics.nccl_built())}] NCCL
+    [{mark(basics.ddl_built())}] DDL
+    [{mark(basics.ccl_built())}] CCL
+    [{mark(basics.mpi_built())}] MPI
+    [{mark(basics.gloo_built())}] Gloo"""
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     return _build_parser().parse_args(argv)
 
@@ -260,6 +299,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssh-port", type=int, default=None)
     p.add_argument("-v", "--verbose", action="store_true")
     p.add_argument("--version", action="store_true")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print the capability matrix (reference "
+                        "horovodrun --check-build, launch.py:107-143) "
+                        "and exit")
     # Knob flags -> env (reference launch.py:392-523 / config_parser.py).
     p.add_argument("--fusion-threshold-mb", type=float, default=None)
     p.add_argument("--cache-capacity", type=int, default=None)
@@ -387,6 +430,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         print(__version__)
         return 0
     args = apply_config_file(args, argv)
+    # After the config merge so `check-build: true` in a YAML file works
+    # like the flag (the config contract covers every flag).
+    if args.check_build:
+        print(check_build())
+        return 0
     # An explicit -np 1 must survive pod auto-scaling; only an UNSET -np
     # may be grown to the pod size below.
     np_unset = args.num_proc is None

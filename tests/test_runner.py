@@ -484,3 +484,16 @@ def test_rendezvous_hmac_auth():
             assert ei.value.code == 403
     finally:
         srv.stop()
+
+
+def test_check_build_matrix():
+    """hvdtpurun --check-build (reference launch.py:107-143): honest
+    capability matrix — XLA/JAX checked, vendor backends unchecked."""
+    from horovod_tpu.runner import launch
+
+    out = launch.check_build()
+    assert "[X] JAX (native)" in out
+    assert "[X] XLA (ICI/DCN)" in out
+    assert "[ ] NCCL" in out and "[ ] DDL" in out
+    rc = launch.run_commandline(["--check-build"])
+    assert rc == 0
