@@ -244,6 +244,55 @@ def test_pipeline_1f1b_matches_sequential(sp_mesh, rng, n_micro):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("n_stage,n_micro,b,d", [
+    (4, 2, 1, 3),    # fewer stages than devices (subset mesh), m < n
+    (4, 7, 3, 5),    # odd microbatch count, odd width
+    (8, 9, 2, 4),    # m > n steady state, full mesh
+])
+def test_pipeline_1f1b_shape_sweep(rng, n_stage, n_micro, b, d):
+    """The 1F1B tick algebra must hold for arbitrary (stages,
+    microbatches, batch, width) — including a SUBSET pp mesh (4 of the
+    8 devices)."""
+    from horovod_tpu.parallel.pipeline import pipeline_train_step_1f1b
+
+    mesh = Mesh(np.array(jax.devices()[:n_stage]), ("pp",))
+    Ws = rng.standard_normal((n_stage, d, d)).astype(np.float32) * 0.4
+    xs = rng.standard_normal((n_micro, b, d)).astype(np.float32)
+    ys = rng.standard_normal((n_micro, b, d)).astype(np.float32)
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    def loss_fn(o, y):
+        return ((o - y) ** 2).sum()
+
+    def wrapped(w, x, y):
+        g, l = pipeline_train_step_1f1b(stage_fn, loss_fn, w[0], x, y,
+                                        "pp")
+        idx = jax.lax.axis_index("pp")
+        l = jax.lax.psum(jnp.where(idx == n_stage - 1, l, 0.0), "pp")
+        return g[None], l
+
+    f = jax.jit(jax.shard_map(
+        wrapped, mesh=mesh, in_specs=(P("pp"), P(), P()),
+        out_specs=(P("pp"), P()), check_vma=False))
+    grads, loss = f(jnp.asarray(Ws), jnp.asarray(xs), jnp.asarray(ys))
+
+    def seq_loss(Ws):
+        total = 0.0
+        for i in range(n_micro):
+            a = xs[i]
+            for s in range(n_stage):
+                a = jnp.tanh(a @ Ws[s])
+            total = total + ((a - ys[i]) ** 2).sum()
+        return total
+
+    el, eg = jax.value_and_grad(seq_loss)(jnp.asarray(Ws))
+    np.testing.assert_allclose(float(loss), float(el), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(eg),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pipeline_1f1b_composes_with_dp(rng):
     """2-D (dp=2, pp=4) mesh: each dp replica runs the 1F1B pipeline on
     its batch shard, stage grads psum over dp — the PP x DP composition
