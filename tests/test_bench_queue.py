@@ -95,3 +95,40 @@ def test_done_jobs_skip_on_restart(monkeypatch):
                                       "--max-hours", "0.01"])
     assert q.main() == 0
     assert ran == ["b"]
+
+
+def test_analyze_trace_summary(tmp_path):
+    """tools/analyze_trace.py digests a Chrome-trace capture into the
+    busy-fraction / top-ops / infeed summary."""
+    import gzip
+    import subprocess
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+         "ts": 0.0, "dur": 8000.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "infeed.copy",
+         "ts": 8000.0, "dur": 2000.0},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "python",
+         "ts": 0.0, "dur": 5000.0},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    proc = subprocess.run(
+        [sys.executable,
+         str(__import__("pathlib").Path(q.REPO) / "tools"
+             / "analyze_trace.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    dev = out["processes"]["/device:TPU:0"]
+    assert dev["busy_ms"] == 10.0 and dev["busy_fraction"] == 1.0
+    top = out["device_top_ops"]
+    assert top[0]["name"] == "fusion.1" and top[0]["pct_of_device"] == 80.0
+    assert out["infeed_copy_pct_of_device"] == 20.0
