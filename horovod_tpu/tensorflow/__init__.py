@@ -70,16 +70,12 @@ def _no_autograph(fn):
     return wrapper
 
 
-def _engine():
-    from horovod_tpu.common import basics
-
-    return basics.context().engine
-
-
-def _replicated(tensor):
-    """TF tensor -> explicitly replicated distributed tensor (same
-    leading-dim==size hazard note as the torch shim's _replicated)."""
-    return _engine().replicate(np.asarray(tensor))
+def _engine(process_set=None):
+    # Membership check + sub-mesh engine routing live on the core
+    # surface (horovod_tpu._engine / process_set.py). The TF collectives
+    # replicate explicitly via e.replicate(...) (same leading-dim==size
+    # hazard note as the torch shim's _replicated).
+    return _hvd._engine(process_set)
 
 
 def _to_host(dt) -> np.ndarray:
@@ -107,17 +103,19 @@ def _bridge(np_fn, tensor, out_shape=None):
 
 def _allreduce_np(arr: np.ndarray, op: ReduceOp, name: Optional[str],
                   prescale_factor: float, postscale_factor: float,
-                  compression=None) -> np.ndarray:
-    out = _engine().allreduce(_engine().replicate(arr), op, name,
-                              prescale_factor, postscale_factor,
-                              compression)
+                  compression=None, process_set=None) -> np.ndarray:
+    e = _engine(process_set)
+    out = e.allreduce(e.replicate(arr), op, name,
+                      prescale_factor, postscale_factor,
+                      compression)
     return _to_host(out).astype(arr.dtype, copy=False)
 
 
 @_no_autograph
 def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
-              compression=None, sparse_as_dense: bool = False):
+              compression=None, sparse_as_dense: bool = False,
+              process_set=None):
     """Dense allreduce; a tf.IndexedSlices input takes the
     SPARSE-AS-ALLGATHER path (reference tensorflow/__init__.py:92-108):
     values and indices are allgathered — the mathematical equivalent of
@@ -149,15 +147,16 @@ def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
                                 dense_shape=tensor.dense_shape)
     return _bridge(
         lambda a: _allreduce_np(a, op, name, prescale_factor,
-                                postscale_factor, compression), tensor)
+                                postscale_factor, compression,
+                                process_set), tensor)
 
 
 def _grouped_allreduce_np(arrs, op: ReduceOp, name: Optional[str],
                           compression=None, prescale_factor=1.0,
-                          postscale_factor=1.0):
+                          postscale_factor=1.0, process_set=None):
     """Fused grouped reduction via the engine's bucketed allreduce_tree
     (one collective per fusion bucket, not one per tensor)."""
-    e = _engine()
+    e = _engine(process_set)
     dts = [e.replicate(a) for a in arrs]
     outs = e.allreduce_tree(dts, op, name, compression,
                             prescale_factor=prescale_factor,
@@ -170,7 +169,7 @@ def _grouped_allreduce_np(arrs, op: ReduceOp, name: Optional[str],
 def grouped_allreduce(tensors, op: ReduceOp = Average,
                       name: Optional[str] = None, compression=None,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0):
+                      postscale_factor: float = 1.0, process_set=None):
     tf = _tf()
     tensors = list(tensors)
     if not tensors:
@@ -179,48 +178,52 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
         outs = tf.py_function(
             lambda *ts: _grouped_allreduce_np(
                 [t.numpy() for t in ts], op, name, compression,
-                prescale_factor, postscale_factor),
+                prescale_factor, postscale_factor, process_set),
             tensors, [t.dtype for t in tensors])
         for o, t in zip(outs, tensors):
             o.set_shape(t.shape)
         return list(outs)
     return [tf.convert_to_tensor(o) for o in _grouped_allreduce_np(
         [np.asarray(t) for t in tensors], op, name, compression,
-        prescale_factor, postscale_factor)]
+        prescale_factor, postscale_factor, process_set)]
 
 
 @_no_autograph
-def allgather(tensor, name: Optional[str] = None):
+def allgather(tensor, name: Optional[str] = None, process_set=None):
     """Concatenate along dim 0 over ranks (reference allgather)."""
     tf = _tf()
-    e = _engine()
+    e = _engine(process_set)
 
     def np_fn(arr):
         out = _to_host(e.allgather(e.replicate(arr), name))
         return out.reshape((-1,) + arr.shape[1:]).astype(arr.dtype,
                                                          copy=False)
 
+    gather_n = process_set.size() if process_set is not None else size()
     out_shape = None
     if tf.is_tensor(tensor) and tensor.shape.rank and \
             tensor.shape[0] is not None:
-        out_shape = tf.TensorShape([tensor.shape[0] * size()]).concatenate(
-            tensor.shape[1:])
+        out_shape = tf.TensorShape(
+            [tensor.shape[0] * gather_n]).concatenate(tensor.shape[1:])
     return _bridge(np_fn, tensor, out_shape)
 
 
 @_no_autograph
-def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
-    e = _engine()
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    """With ``process_set``, ``root_rank`` is the GLOBAL rank of the
+    root (resolution happens in horovod_tpu.broadcast)."""
+    e = _engine(process_set)
     return _bridge(
-        lambda arr: _to_host(e.broadcast(e.replicate(arr), root_rank,
-                                         name)).astype(arr.dtype,
-                                                       copy=False),
+        lambda arr: _to_host(_hvd.broadcast(
+            e.replicate(arr), root_rank, name,
+            process_set=process_set)).astype(arr.dtype, copy=False),
         tensor)
 
 
 @_no_autograph
-def alltoall(tensor, name: Optional[str] = None):
-    e = _engine()
+def alltoall(tensor, name: Optional[str] = None, process_set=None):
+    e = _engine(process_set)
     return _bridge(
         lambda arr: _to_host(e.alltoall(e.replicate(arr), name)).astype(
             arr.dtype, copy=False),

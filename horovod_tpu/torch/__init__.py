@@ -50,10 +50,10 @@ from horovod_tpu.common.basics import export_capability_queries as _ecq
 _ecq(globals())
 
 
-def _engine():
-    from horovod_tpu.common import basics
-
-    return basics.context().engine
+def _engine(process_set=None):
+    # Membership check + sub-mesh engine routing live on the core
+    # surface (horovod_tpu._engine / process_set.py).
+    return _hvd._engine(process_set)
 
 
 def _tensor_to_np(tensor: torch.Tensor) -> np.ndarray:
@@ -77,12 +77,12 @@ def _np_to_tensor(arr: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
     return torch.from_numpy(np.array(arr, copy=True)).to(dtype)
 
 
-def _replicated(tensor: torch.Tensor):
+def _replicated(tensor: torch.Tensor, process_set=None):
     """Torch tensor -> explicitly replicated distributed tensor. Explicit
     replicate (not _as_distributed) so a tensor whose leading dim happens
     to equal world size is not mis-read as an already rank-major stack
     and scattered (same hazard fixed in functions.broadcast_variables)."""
-    return _engine().replicate(_tensor_to_np(tensor))
+    return _engine(process_set).replicate(_tensor_to_np(tensor))
 
 
 def _to_host(dt) -> np.ndarray:
@@ -119,48 +119,57 @@ def allreduce(tensor: torch.Tensor, op: ReduceOp = Average,
               name: Optional[str] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
-              compression=None) -> torch.Tensor:
+              compression=None, process_set=None) -> torch.Tensor:
     _validate_compression(compression)
-    e = _engine()
-    out = e.allreduce(_replicated(tensor), op, name,
+    e = _engine(process_set)
+    out = e.allreduce(_replicated(tensor, process_set), op, name,
                       prescale_factor, postscale_factor, compression)
     return _np_to_tensor(_to_host(out), tensor.dtype)
 
 
 def allreduce_(tensor: torch.Tensor, op: ReduceOp = Average,
-               name: Optional[str] = None) -> torch.Tensor:
-    tensor.copy_(allreduce(tensor, op, name))
+               name: Optional[str] = None,
+               process_set=None) -> torch.Tensor:
+    tensor.copy_(allreduce(tensor, op, name, process_set=process_set))
     return tensor
 
 
 def allgather(tensor: torch.Tensor,
-              name: Optional[str] = None) -> torch.Tensor:
+              name: Optional[str] = None,
+              process_set=None) -> torch.Tensor:
     """Concatenate along dim 0 over ranks (reference allgather contract).
     Under single-controller SPMD every rank holds this tensor, so the
     result is ``size`` stacked copies reshaped to (size*n, ...)."""
-    e = _engine()
-    out = _to_host(e.allgather(_replicated(tensor), name))
+    e = _engine(process_set)
+    out = _to_host(e.allgather(_replicated(tensor, process_set), name))
     return _np_to_tensor(out.reshape((-1,) + tuple(tensor.shape[1:])),
                          tensor.dtype)
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int = 0,
-              name: Optional[str] = None) -> torch.Tensor:
-    e = _engine()
-    out = e.broadcast(_replicated(tensor), root_rank, name)
+              name: Optional[str] = None,
+              process_set=None) -> torch.Tensor:
+    """With ``process_set``, ``root_rank`` is the GLOBAL rank of the
+    root (core-surface convention — resolution happens in
+    horovod_tpu.broadcast)."""
+    out = _hvd.broadcast(_replicated(tensor, process_set), root_rank,
+                         name, process_set=process_set)
     return _np_to_tensor(_to_host(out), tensor.dtype)
 
 
 def broadcast_(tensor: torch.Tensor, root_rank: int = 0,
-               name: Optional[str] = None) -> torch.Tensor:
-    tensor.copy_(broadcast(tensor, root_rank, name))
+               name: Optional[str] = None,
+               process_set=None) -> torch.Tensor:
+    tensor.copy_(broadcast(tensor, root_rank, name,
+                           process_set=process_set))
     return tensor
 
 
 def alltoall(tensor: torch.Tensor,
-             name: Optional[str] = None) -> torch.Tensor:
-    e = _engine()
-    out = _to_host(e.alltoall(_replicated(tensor), name))
+             name: Optional[str] = None,
+             process_set=None) -> torch.Tensor:
+    e = _engine(process_set)
+    out = _to_host(e.alltoall(_replicated(tensor, process_set), name))
     return _np_to_tensor(out, tensor.dtype)
 
 
@@ -168,13 +177,14 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
                       name: Optional[str] = None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      compression=None):
+                      compression=None, process_set=None):
     """Fused-bucket allreduce of a list of tensors (reference
     torch/mpi_ops.py grouped_allreduce): one negotiation + one fused
     flat buffer instead of a dispatch per tensor."""
     _validate_compression(compression)
-    e = _engine()
-    arrs = {str(i): _replicated(t) for i, t in enumerate(tensors)}
+    e = _engine(process_set)
+    arrs = {str(i): _replicated(t, process_set)
+            for i, t in enumerate(tensors)}
     out = e.allreduce_tree(arrs, op, name, compression,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor)
@@ -186,9 +196,9 @@ def grouped_allreduce_(tensors, op: ReduceOp = Average,
                        name: Optional[str] = None,
                        prescale_factor: float = 1.0,
                        postscale_factor: float = 1.0,
-                       compression=None):
+                       compression=None, process_set=None):
     outs = grouped_allreduce(tensors, op, name, prescale_factor,
-                             postscale_factor, compression)
+                             postscale_factor, compression, process_set)
     for t, o in zip(tensors, outs):
         t.copy_(o)
     return tensors

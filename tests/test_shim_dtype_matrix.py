@@ -271,3 +271,232 @@ def test_tf_predivide_requires_average(hvd):
         hvdtf.DistributedOptimizer(
             tf.keras.optimizers.SGD(0.1),
             gradient_predivide_factor=2.0, op=hvdtf.Sum)
+
+
+# -- torch: Min/Max/Product (beyond the pinned reference era) ----------------
+
+@pytest.mark.parametrize("dtype", [torch.uint8, torch.int32, torch.int64,
+                                   torch.bfloat16, torch.float32,
+                                   torch.float64], ids=str)
+def test_torch_allreduce_min_max(hvd, dtype):
+    """Identical ranks -> Min == Max == input, per dtype."""
+    t = (torch.arange(6) % 5).to(dtype)
+    for op, tag in ((hvdt.Min, "min"), (hvdt.Max, "max")):
+        out = hvdt.allreduce(t, op=op, name=f"mx_{tag}_{dtype}")
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(_as_f32(out).numpy(),
+                                      _as_f32(t).numpy())
+
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.float32,
+                                   torch.float64], ids=str)
+def test_torch_allreduce_product(hvd, dtype):
+    """Identical ranks -> product == t**n (values in {1, 2}; 2^8 = 256
+    stays exact in every dtype here)."""
+    n = hvd.size()
+    t = torch.tensor([1, 2, 1, 2]).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Product, name=f"mx_prod_{dtype}")
+    assert out.dtype == dtype
+    np.testing.assert_allclose(_as_f32(out).numpy(),
+                               _as_f32(t).numpy() ** n, rtol=1e-3)
+
+
+# -- torch: shape edges ------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.float32], ids=str)
+def test_torch_allreduce_scalar(hvd, dtype):
+    """0-d tensors ride the same path (reference sweeps dims 1..3; the
+    scalar case is the degenerate boundary)."""
+    n = hvd.size()
+    out = hvdt.allreduce(torch.tensor(3).to(dtype), op=hvdt.Sum,
+                         name=f"mx_sc_{dtype}")
+    assert out.dtype == dtype and out.shape == ()
+    assert float(_as_f32(out)) == 3.0 * n
+
+
+def test_torch_allreduce_empty(hvd):
+    """Zero-element tensors must not deadlock or crash (reference
+    test_horovod_allreduce on empty input)."""
+    out = hvdt.allreduce(torch.ones(0, 3), op=hvdt.Sum, name="mx_empty")
+    assert out.shape == (0, 3) and out.dtype == torch.float32
+
+
+@pytest.mark.parametrize("root", [1, 7])
+def test_torch_broadcast_nonzero_root(hvd, root):
+    """Non-zero roots exercise the root-selection plumbing; under the
+    replicated single-controller world the value check is identity, the
+    contract check is dtype/shape preservation + no error."""
+    t = torch.arange(5, dtype=torch.float32)
+    out = hvdt.broadcast(t, root_rank=root, name=f"mx_bcr_{root}")
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+
+
+# -- torch: process-set-scoped collectives -----------------------------------
+
+@pytest.fixture()
+def evens(hvd):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    yield ps
+    hvd.remove_process_set(ps)
+
+
+@pytest.mark.parametrize("dtype", [torch.int32, torch.bfloat16,
+                                   torch.float32], ids=str)
+def test_torch_allreduce_process_set(hvd, evens, dtype):
+    """Set-scoped sum multiplies by the SET size (4), not world size."""
+    t = (torch.arange(6) % 5).to(dtype)
+    out = hvdt.allreduce(t, op=hvdt.Sum, name=f"mx_ps_{dtype}",
+                         process_set=evens)
+    assert out.dtype == dtype
+    np.testing.assert_allclose(_as_f32(out).numpy(),
+                               _as_f32(t).numpy() * evens.size(),
+                               rtol=1e-2)
+
+
+def test_torch_allgather_process_set(hvd, evens):
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvdt.allgather(t, name="mx_ps_ag", process_set=evens)
+    assert out.shape == (2 * evens.size(), 3)
+    np.testing.assert_array_equal(
+        out.numpy(), np.tile(t.numpy(), (evens.size(), 1)))
+
+
+def test_torch_broadcast_process_set_global_root(hvd, evens):
+    """root_rank is the GLOBAL rank (must be a member); a non-member
+    root raises a typed error, not a wrong answer."""
+    t = torch.ones(3)
+    out = hvdt.broadcast(t, root_rank=2, name="mx_ps_bc",
+                         process_set=evens)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+    with pytest.raises(ValueError, match="not a member"):
+        hvdt.broadcast(t, root_rank=3, name="mx_ps_bc2",
+                       process_set=evens)
+
+
+def test_torch_grouped_allreduce_process_set(hvd, evens):
+    ts = [torch.ones(3), torch.full((2,), 2.0)]
+    outs = hvdt.grouped_allreduce(ts, op=hvdt.Sum, name="mx_ps_g",
+                                  process_set=evens)
+    np.testing.assert_allclose(outs[0].numpy(), np.full(3, 4.0))
+    np.testing.assert_allclose(outs[1].numpy(), np.full(2, 8.0))
+
+
+def test_torch_unregistered_process_set_fails(hvd):
+    ps = hvd.ProcessSet([0, 1])
+    with pytest.raises(ValueError, match="not registered"):
+        hvdt.allreduce(torch.ones(2), name="mx_ps_bad", process_set=ps)
+
+
+# -- torch: async edge cases -------------------------------------------------
+
+def test_torch_poll_becomes_true_then_synchronize(hvd):
+    import time
+
+    t = torch.ones(4)
+    h = hvdt.allreduce_async(t, op=hvdt.Sum, name="mx_poll")
+    deadline = time.monotonic() + 30.0
+    while not hvdt.poll(h) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hvdt.poll(h)  # dispatch completed; handle still consumable
+    out = hvdt.synchronize(h)
+    np.testing.assert_allclose(out.numpy(), np.full(4, float(hvd.size())))
+
+
+def test_torch_synchronize_twice_fails(hvd):
+    h = hvdt.allreduce_async(torch.ones(2), op=hvdt.Sum, name="mx_sync2")
+    hvdt.synchronize(h)
+    with pytest.raises((KeyError, ValueError)):
+        hvdt.synchronize(h)
+
+
+# -- tensorflow: wider matrix ------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [tf.int32, tf.bfloat16, tf.float32],
+                         ids=lambda d: d.name)
+def test_tf_allreduce_min_max(hvd, dtype):
+    t = tf.cast(tf.range(6) % 5, dtype)
+    for op, tag in ((hvdtf.Min, "min"), (hvdtf.Max, "max")):
+        out = hvdtf.allreduce(t, op=op, name=f"mxtf_{tag}_{dtype.name}")
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(
+            tf.cast(out, tf.float32).numpy(),
+            tf.cast(t, tf.float32).numpy())
+
+
+@pytest.mark.parametrize("dtype", [tf.int32, tf.float32],
+                         ids=lambda d: d.name)
+def test_tf_allreduce_postscale(hvd, dtype):
+    n = hvd.size()
+    t = tf.cast(tf.constant([1, 3]), dtype)
+    out = hvdtf.allreduce(t, op=hvdtf.Sum, postscale_factor=0.5,
+                          name=f"mxtf_post_{dtype.name}")
+    expected = np.trunc(np.array([1, 3]) * n * 0.5)
+    np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(),
+                               expected, rtol=1e-3)
+
+
+@pytest.mark.parametrize("dtype", [tf.uint8, tf.int64, tf.bfloat16,
+                                   tf.float32], ids=lambda d: d.name)
+def test_tf_broadcast_dtype(hvd, dtype):
+    t = tf.cast(tf.range(4) % 5, dtype)
+    out = hvdtf.broadcast(t, root_rank=0, name=f"mxtf_bc_{dtype.name}")
+    assert out.dtype == dtype
+    np.testing.assert_array_equal(tf.cast(out, tf.float32).numpy(),
+                                  tf.cast(t, tf.float32).numpy())
+
+
+@pytest.mark.parametrize("dtype", [tf.int32, tf.bfloat16, tf.float32],
+                         ids=lambda d: d.name)
+def test_tf_alltoall_dtype(hvd, dtype):
+    n = hvd.size()
+    t = tf.cast(tf.range(n) % 5, dtype)
+    out = hvdtf.alltoall(t, name=f"mxtf_a2a_{dtype.name}")
+    assert out.dtype == dtype and tuple(out.shape) == (n,)
+    r = hvdtf.rank()
+    np.testing.assert_array_equal(
+        tf.cast(out, tf.float32).numpy(), np.full((n,), float(r % 5)))
+
+
+def test_tf_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([1, 3, 5, 7])
+    try:
+        t = tf.constant([1.0, 2.0])
+        out = hvdtf.allreduce(t, op=hvdtf.Sum, name="mxtf_ps",
+                              process_set=ps)
+        np.testing.assert_allclose(out.numpy(),
+                                   t.numpy() * ps.size())
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_tf_broadcast_process_set_global_root(hvd):
+    ps = hvd.add_process_set([1, 3, 5, 7])
+    try:
+        t = tf.constant([4.0, 5.0])
+        out = hvdtf.broadcast(t, root_rank=3, name="mxtf_ps_bc",
+                              process_set=ps)
+        np.testing.assert_array_equal(out.numpy(), t.numpy())
+        with pytest.raises(ValueError, match="not a member"):
+            hvdtf.broadcast(t, root_rank=0, name="mxtf_ps_bc2",
+                            process_set=ps)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_tf_allgather_process_set_graph_shape(hvd):
+    """Graph-mode static shape must use the SET size, not world size
+    (a wrong declared shape miscompiles downstream shape inference)."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        t = tf.ones((2, 3))
+
+        @tf.function
+        def g(x):
+            out = hvdtf.allgather(x, name="mxtf_ps_ag", process_set=ps)
+            tf.debugging.assert_equal(tf.shape(out)[0], 2 * ps.size())
+            return out
+
+        out = g(t)
+        assert tuple(out.shape) == (2 * ps.size(), 3)
+    finally:
+        hvd.remove_process_set(ps)
