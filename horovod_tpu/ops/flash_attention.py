@@ -8,14 +8,21 @@ per-block combine in horovod_tpu/parallel/ring_attention.py) keeps the
 (S, S) logits matrix out of HBM entirely — O(S) memory instead of O(S²),
 with every block matmul MXU-shaped.
 
-Layout: q, k, v are (B, S, H, D) as produced by the models' fused QKV
-projection. The kernel grid is (B, H, S/block_q); K/V live whole in VMEM
-per (batch, head) and the kernel loops their blocks with a carried
-(m, l, acc) online softmax. Backward is the standard two-kernel split
-(dq over q blocks; dk/dv over kv blocks) against the saved logsumexp.
-Off-TPU (or shapes Pallas can't tile) falls back to the plain jnp
-reference — numerically identical, used by the CPU test suite which also
-runs the real kernel bodies in interpret mode.
+Layout: the public API takes (B, S, H, D) as produced by the models'
+fused QKV projection; internally the kernels run on (B, H, S, D) so
+every block's minor-two dims are MXU/VPU-tileable (block_q, D) tiles —
+Mosaic requires the last two block dims be (8k, 128k) or match the
+array, which a (…, H, D) layout with a size-1 head block violates for
+H > 1. Rank-deficient operands ride the same rule via lane/sublane
+broadcast: the key mask crosses as (B, 8, S) and the logsumexp as
+(B, H, S, 128), the trick the stock jax.experimental TPU flash kernel
+uses for l/m/segment-ids. The kernel grid is (B, H, S/block_q); K/V
+live whole in VMEM per (batch, head) and the kernel loops their blocks
+with a carried (m, l, acc) online softmax. Backward is the standard
+two-kernel split (dq over q blocks; dk/dv over kv blocks) against the
+saved logsumexp. Off-TPU (or shapes Pallas can't tile) falls back to
+the plain jnp reference — numerically identical, used by the CPU test
+suite which also runs the real kernel bodies in interpret mode.
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ from .pallas_kernels import _decide
 
 _NEG = -1e30  # mask value; NOT -inf (exp(-inf - -inf) = nan)
 _LANE = 128
+_SUBLANES = 8
 
 
 def _pick_block(s: int, target: int = 128) -> Optional[int]:
@@ -64,7 +72,7 @@ def reference_attention(q, k, v, mask=None, causal=False):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
                 block_q, block_k, seq_len, causal, scale):
-    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # (bq, D)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale      # (bq, D)
     qi = pl.program_id(2)
     nk = seq_len // block_k
     if causal:
@@ -75,13 +83,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)                                    # (bk, D)
-        v = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        kmask = m_ref[0, pl.ds(j * block_k, block_k)] > 0   # (bk,)
+        kmask = m_ref[0, 0, pl.ds(j * block_k, block_k)] > 0  # (bk,)
         s = jnp.where(kmask[None, :], s, _NEG)
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -103,8 +111,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
     a0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, a0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0, :, 0, :] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :, :] = jnp.broadcast_to(m + jnp.log(l),
+                                           (block_q, _LANE))
 
 
 # -- backward kernels -------------------------------------------------------
@@ -112,14 +121,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref, *,
 def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
                dlse_ref, dq_ref, *, block_q, block_k, seq_len, causal,
                scale):
-    q = q_ref[0, :, 0, :].astype(jnp.float32)
-    do = do_ref[0, :, 0, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :][:, None]                         # (bq, 1)
-    delta = delta_ref[0, 0, :][:, None]
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    # lse/delta/dlse blocks are lane-broadcast (bq, 128); every lane
+    # holds the same value — read lane 0 as the (bq, 1) column.
+    lse = lse_ref[0, 0, :, :][:, 0:1]                       # (bq, 1)
+    delta = delta_ref[0, 0, :, :][:, 0:1]
     # Cotangent of the lse OUTPUT (nonzero when callers combine blocks —
     # ring attention): lse = logsumexp(s) and dlse/ds = p, so the term
     # folds into ds as p * dlse.
-    dlse = dlse_ref[0, 0, :][:, None]
+    dlse = dlse_ref[0, 0, :, :][:, 0:1]
     qi = pl.program_id(2)
     nk = seq_len // block_k
     if causal:
@@ -130,13 +141,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
         hi = nk
 
     def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        kmask = m_ref[0, pl.ds(j * block_k, block_k)] > 0
+        kmask = m_ref[0, 0, pl.ds(j * block_k, block_k)] > 0
         s = jnp.where(kmask[None, :], s, _NEG)
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -154,30 +165,30 @@ def _dq_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
 
     dq = jax.lax.fori_loop(
         0, hi, body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq_ref[0, :, 0, :] = dq.astype(dq_ref.dtype)
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
                 dlse_ref, dk_ref, dv_ref, *, block_q, block_k, seq_len,
                 causal, scale):
     ki = pl.program_id(2)
-    k = k_ref[0, :, 0, :].astype(jnp.float32)               # (bk, D)
-    v = v_ref[0, :, 0, :].astype(jnp.float32)
-    # m_ref is the FULL (1, S) key mask; this grid step's K block is bk
-    # wide, so slice the matching window.
-    kmask = m_ref[0, pl.ds(ki * block_k, block_k)] > 0      # (bk,)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)               # (bk, D)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    # m_ref is the FULL (8, S) sublane-broadcast key mask; this grid
+    # step's K block is bk wide, so slice the matching window.
+    kmask = m_ref[0, 0, pl.ds(ki * block_k, block_k)] > 0   # (bk,)
     nq = seq_len // block_q
     lo = jax.lax.div(ki * block_k, block_q) if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
             jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-        dlse = dlse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), :][:, 0:1]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), :][:, 0:1]
+        dlse = dlse_ref[0, 0, pl.ds(i * block_q, block_q), :][:, 0:1]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = jnp.where(kmask[None, :], s, _NEG)
@@ -201,21 +212,41 @@ def _dkv_kernel(q_ref, k_ref, v_ref, m_ref, do_ref, lse_ref, delta_ref,
 
     z = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
-    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
 # -- pallas_call plumbing ---------------------------------------------------
 
 def _specs(b, s, h, d, bq, bk):
-    q_spec = pl.BlockSpec((1, bq, 1, d), lambda bi, hi, i: (bi, i, hi, 0))
-    kv_spec = pl.BlockSpec((1, s, 1, d), lambda bi, hi, i: (bi, 0, hi, 0))
-    m_spec = pl.BlockSpec((1, s), lambda bi, hi, i: (bi, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq), lambda bi, hi, i: (bi, hi, i))
-    lse_full = pl.BlockSpec((1, 1, s), lambda bi, hi, i: (bi, hi, 0))
-    kv_block = pl.BlockSpec((1, bk, 1, d),
-                            lambda bi, hi, j: (bi, j, hi, 0))
+    """Block specs over the internal (B, H, S, D) layout: every block's
+    minor-two dims are a Mosaic-tileable (rows, lanes) tile. The key
+    mask rides as (B, 8, S) (full-S block, 8 identical sublanes) and
+    lse/delta as (B, H, S, 128) (lane-broadcast)."""
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    m_spec = pl.BlockSpec((1, _SUBLANES, s), lambda bi, hi, i: (bi, 0, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq, _LANE),
+                            lambda bi, hi, i: (bi, hi, i, 0))
+    lse_full = pl.BlockSpec((1, 1, s, _LANE),
+                            lambda bi, hi, i: (bi, hi, 0, 0))
+    kv_block = pl.BlockSpec((1, 1, bk, d),
+                            lambda bi, hi, j: (bi, hi, j, 0))
     return q_spec, kv_spec, m_spec, lse_spec, lse_full, kv_block
+
+
+def _lanes(x):
+    """(B, H, S) -> lane-broadcast (B, H, S, 128) fp32."""
+    return jnp.broadcast_to(x.astype(jnp.float32)[..., None],
+                            x.shape + (_LANE,))
+
+
+def _sublanes(mask):
+    """(B, S) key mask -> sublane-broadcast (B, 8, S) fp32 (the layout
+    _specs' m_spec blocks over; fwd and bwd must agree)."""
+    b, s = mask.shape
+    return jnp.broadcast_to(mask.astype(jnp.float32)[:, None, :],
+                            (b, _SUBLANES, s))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -232,16 +263,20 @@ def _flash_fwd_impl(q, k, v, mask, causal, bq, bk, interpret):
     q_spec, kv_spec, m_spec, lse_spec, _, _ = _specs(b, s, h, d, bq, bk)
     kern = functools.partial(_fwd_kernel, block_q=bq, block_k=bk,
                              seq_len=s, causal=causal, scale=scale)
+    # (B, S, H, D) API layout -> (B, H, S, D) kernel layout; XLA fuses
+    # these transposes into the surrounding projections.
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    mask8 = _sublanes(mask)
     o, lse = pl.pallas_call(
         kern,
         grid=(b, h, s // bq),
         in_specs=[q_spec, kv_spec, kv_spec, m_spec],
         out_specs=[q_spec, lse_spec],
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
-                   jax.ShapeDtypeStruct((b, h, s), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(qt.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, _LANE), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask)
-    return o, lse
+    )(qt, kt, vt, mask8)
+    return jnp.swapaxes(o, 1, 2), lse[..., 0]
 
 
 def _flash_fwd(q, k, v, mask, causal, bq, bk, interpret):
@@ -257,9 +292,12 @@ def _flash_bwd(causal, bq, bk, interpret, res, cotangents):
     # delta_i = rowsum(do_i * o_i) — cheap elementwise, computed in-graph.
     delta = jnp.einsum("bshd,bshd->bhs", do.astype(jnp.float32),
                        o.astype(jnp.float32))
-    dlse = dlse.astype(jnp.float32)
     q_spec, kv_spec, m_spec, lse_blk, lse_full, kv_block = _specs(
         b, s, h, d, bq, bk)
+
+    qt, kt, vt, dot = (jnp.swapaxes(x, 1, 2) for x in (q, k, v, do))
+    mask8 = _sublanes(mask)
+    lse_l, delta_l, dlse_l = _lanes(lse), _lanes(delta), _lanes(dlse)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_q=bq, block_k=bk, seq_len=s,
@@ -268,9 +306,9 @@ def _flash_bwd(causal, bq, bk, interpret, res, cotangents):
         in_specs=[q_spec, kv_spec, kv_spec, m_spec, q_spec,
                   lse_blk, lse_blk, lse_blk],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v, mask, do, lse, delta, dlse)
+    )(qt, kt, vt, mask8, dot, lse_l, delta_l, dlse_l)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=bq, block_k=bk, seq_len=s,
@@ -279,11 +317,12 @@ def _flash_bwd(causal, bq, bk, interpret, res, cotangents):
         in_specs=[kv_spec, kv_block, kv_block, m_spec, kv_spec,
                   lse_full, lse_full, lse_full],
         out_specs=[kv_block, kv_block],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, v.dtype)],
         interpret=interpret,
-    )(q, k, v, mask, do, lse, delta, dlse)
-    return dq, dk, dv, None
+    )(qt, kt, vt, mask8, dot, lse_l, delta_l, dlse_l)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2), None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -127,22 +127,25 @@ def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
         if sparse_as_dense:
             return allreduce(tf.convert_to_tensor(tensor), op, name,
                              prescale_factor, postscale_factor,
-                             compression)
+                             compression, process_set=process_set)
         if op not in (Average, Sum):
             raise NotImplementedError(
                 "sparse allreduce supports Average/Sum (reference "
                 "tensorflow/__init__.py:101)")
         # Ragged gather: ranks may hold different numbers of slices (the
         # normal case for embedding gradients) — allgather_local
-        # negotiates per-rank row counts through the controller.
-        e = _engine()
+        # negotiates per-rank row counts through the controller. With a
+        # process_set both the gather and the averaging denominator are
+        # SET-scoped.
+        e = _engine(process_set)
+        n = process_set.size() if process_set is not None else size()
         values = tf.convert_to_tensor(e.allgather_local(
             np.asarray(tensor.values), name=f"{name or 'sparse'}.values"))
         indices = tf.convert_to_tensor(e.allgather_local(
             np.asarray(tensor.indices),
             name=f"{name or 'sparse'}.indices"))
         if op == Average:
-            values = values / size()
+            values = values / n
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
     return _bridge(

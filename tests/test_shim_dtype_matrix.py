@@ -500,3 +500,25 @@ def test_tf_allgather_process_set_graph_shape(hvd):
         assert tuple(out.shape) == (2 * ps.size(), 3)
     finally:
         hvd.remove_process_set(ps)
+
+
+def test_tf_sparse_allreduce_process_set(hvd):
+    """IndexedSlices (embedding-gradient) allreduce with a process_set:
+    the gather spans SET members only and Average divides by SET size."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        slices = tf.IndexedSlices(
+            values=tf.constant([[2.0, 4.0]]), indices=tf.constant([1]),
+            dense_shape=tf.constant([4, 2]))
+        out = hvdtf.allreduce(slices, op=hvdtf.Average, name="mxtf_sp_ps",
+                              process_set=ps)
+        assert out.values.shape[0] == ps.size()
+        np.testing.assert_allclose(
+            out.values.numpy(),
+            np.tile(np.array([[2.0, 4.0]]) / ps.size(), (ps.size(), 1)))
+        dense = hvdtf.allreduce(slices, op=hvdtf.Sum, name="mxtf_sd_ps",
+                                sparse_as_dense=True, process_set=ps)
+        expected = np.zeros((4, 2)); expected[1] = [8.0, 16.0]
+        np.testing.assert_allclose(dense.numpy(), expected)
+    finally:
+        hvd.remove_process_set(ps)
