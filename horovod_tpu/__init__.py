@@ -144,6 +144,15 @@ def _engine(process_set=None):
     return process_set.engine
 
 
+def _communicator_size(process_set=None) -> int:
+    """Size of the communicator a collective runs over: the SET's when
+    one is given, else the world's — the denominator every averaging/
+    predivide split must use (one definition; the shims share it)."""
+    if process_set is not None:
+        return process_set.size()
+    return size()
+
+
 def scatter(stacked, process_set=None):
     """Host-stacked (size, *shape) -> rank-sharded distributed tensor."""
     return _engine(process_set).scatter(stacked)

@@ -24,19 +24,24 @@ import numpy as np
 
 from .common import basics
 
-# Per-call sequence numbers keep KV keys unique across repeated calls with
+# Per-NAME sequence numbers keep KV keys unique across repeated calls with
 # the same name (the coordination-service KV store has set-once semantics;
 # without this, epoch 2's broadcast would collide with — or worse, silently
-# read — epoch 1's bytes). All processes execute the same call sequence, so
-# counters stay in step — the same assumption the reference's name-keyed
-# negotiation makes for repeated hvd.broadcast_object calls.
+# read — epoch 1's bytes). Counters are per name, not global: all processes
+# that USE a given name must execute the same call sequence for that name
+# (the reference's name-keyed negotiation makes the same assumption), but
+# calls under other names — e.g. a process-set-scoped broadcast only set
+# members perform — no longer desynchronize unrelated names' counters on
+# the processes that skip them. Corollary: a name must not be used both
+# set-scoped and world-scoped.
 _seq_lock = threading.Lock()
-_seq = itertools.count()
+_seq: dict = {}
 
 
-def _next_seq() -> int:
+def _next_seq(name: str) -> int:
     with _seq_lock:
-        return next(_seq)
+        it = _seq.setdefault(name, itertools.count())
+        return next(it)
 
 
 def _kv_broadcast_bytes(data: bytes, root_rank: int, key: str) -> bytes:
@@ -60,7 +65,7 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     (reference: functions.py:98-135)."""
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    key = f"hvd_tpu/bcast/{name}/{_next_seq()}"
+    key = f"hvd_tpu/bcast/{name}/{_next_seq(name)}"
     data = _kv_broadcast_bytes(buf.getvalue(), root_rank, key)
     return pickle.loads(data)
 
@@ -77,7 +82,7 @@ def allgather_object(obj: Any, name: str = "obj") -> List[Any]:
     client = jdist.global_state.client
     me = jax.process_index()
     n = jax.process_count()
-    seq = _next_seq()
+    seq = _next_seq(name)
     buf = io.BytesIO()
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     client.key_value_set_bytes(f"hvd_tpu/ag/{name}/{seq}/{me}",

@@ -134,11 +134,20 @@ def allreduce(tensor, op: ReduceOp = Average, name: Optional[str] = None,
                 "tensorflow/__init__.py:101)")
         # Ragged gather: ranks may hold different numbers of slices (the
         # normal case for embedding gradients) — allgather_local
-        # negotiates per-rank row counts through the controller. With a
-        # process_set both the gather and the averaging denominator are
-        # SET-scoped.
+        # negotiates per-rank row counts through the controller. A
+        # process-set engine has NO controller (process_set.py builds it
+        # controller=None), so in a multi-process world the per-process
+        # row counts could silently diverge: fail loudly instead.
+        import jax
+
+        if process_set is not None and jax.process_count() > 1:
+            raise NotImplementedError(
+                "sparse (IndexedSlices) allreduce over a process_set is "
+                "not supported in multi-process worlds: the set engine "
+                "has no controller to negotiate ragged row counts. Use "
+                "sparse_as_dense=True, which reduces a dense tensor.")
         e = _engine(process_set)
-        n = process_set.size() if process_set is not None else size()
+        n = _hvd._communicator_size(process_set)
         values = tf.convert_to_tensor(e.allgather_local(
             np.asarray(tensor.values), name=f"{name or 'sparse'}.values"))
         indices = tf.convert_to_tensor(e.allgather_local(
@@ -202,7 +211,7 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
         return out.reshape((-1,) + arr.shape[1:]).astype(arr.dtype,
                                                          copy=False)
 
-    gather_n = process_set.size() if process_set is not None else size()
+    gather_n = _hvd._communicator_size(process_set)
     out_shape = None
     if tf.is_tensor(tensor) and tensor.shape.rank and \
             tensor.shape[0] is not None:
@@ -233,7 +242,8 @@ def alltoall(tensor, name: Optional[str] = None, process_set=None):
         tensor)
 
 
-def broadcast_variables(variables, root_rank: int = 0) -> None:
+def broadcast_variables(variables, root_rank: int = 0,
+                        process_set=None) -> None:
     """In-place assign of root's values onto tf.Variables (reference
     tensorflow/functions.py:47 broadcast_variables). Handles both
     tf.Variable (.value() method) and keras-3 Variable (.value
@@ -241,7 +251,8 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
     tf = _tf()
     for i, v in enumerate(variables):
         v.assign(broadcast(tf.convert_to_tensor(v), root_rank,
-                           name=f"bcast.{getattr(v, 'name', i)}"))
+                           name=f"bcast.{getattr(v, 'name', i)}",
+                           process_set=process_set))
 
 
 broadcast_object = _hvd.broadcast_object
@@ -252,10 +263,11 @@ allgather_object = _hvd.allgather_object
 
 class _DistributedGradientTape:
     def __init__(self, tape, op: ReduceOp = Average,
-                 compression=None):
+                 compression=None, process_set=None):
         self._tape = tape
         self._op = op
         self._compression = compression
+        self._process_set = process_set
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
@@ -280,36 +292,41 @@ class _DistributedGradientTape:
         if present:
             reduced = grouped_allreduce([g for _, g in present],
                                         op=self._op, name="tape.grads",
-                                        compression=self._compression)
+                                        compression=self._compression,
+                                        process_set=self._process_set)
             for (i, _), r in zip(present, reduced):
                 flat[i] = r
         return tf.nest.pack_sequence_as(grads, flat)
 
 
 def DistributedGradientTape(tape, op: ReduceOp = Average,
-                            compression=None) -> _DistributedGradientTape:
-    return _DistributedGradientTape(tape, op, compression)
+                            compression=None,
+                            process_set=None) -> _DistributedGradientTape:
+    return _DistributedGradientTape(tape, op, compression, process_set)
 
 
 # -- Keras optimizer wrapper (reference _keras/__init__.py:28-135) ----------
 
 def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
                            sparse_as_dense=False,
-                           gradient_predivide_factor=1.0):
+                           gradient_predivide_factor=1.0,
+                           process_set=None):
     """Reduce a grads_and_vars list: dense grads through ONE fused
     grouped allreduce, IndexedSlices through the sparse-as-allgather
     path (reference _make_allreduce_grads_fn semantics, incl. the
-    predivide split: scale by 1/f before the SUM and f/size after)."""
+    predivide split: scale by 1/f before the SUM and f/size after —
+    size being the communicator's, i.e. the set's when one is given)."""
     tf = _tf()
     pre = post = 1.0
     sparse_op = reduce_op
     if gradient_predivide_factor != 1.0:
         f = gradient_predivide_factor
+        n = _hvd._communicator_size(process_set)
         # Dense path: split the average around a SUM. The sparse
         # (allgather) path keeps the original AVERAGE — predivide is a
         # dense-reduction scaling trick and must not turn gathered
         # slices into an unscaled sum.
-        reduce_op, pre, post = Sum, 1.0 / f, f / size()
+        reduce_op, pre, post = Sum, 1.0 / f, f / n
     gv = [list(x) for x in gv]
     dense = [(i, g) for i, (g, _) in enumerate(gv)
              if g is not None and not isinstance(g, tf.IndexedSlices)]
@@ -320,7 +337,8 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
                                     op=reduce_op,
                                     name=f"{name_prefix}.grads",
                                     prescale_factor=pre,
-                                    postscale_factor=post)
+                                    postscale_factor=post,
+                                    process_set=process_set)
     else:
         reduced = []
     for (i, _), r in zip(dense, reduced):
@@ -328,7 +346,8 @@ def _reduce_grads_and_vars(gv, reduce_op, name_prefix,
     for i, g in sparse:
         gv[i][0] = allreduce(g, op=sparse_op,
                              name=f"{name_prefix}.sparse{i}",
-                             sparse_as_dense=sparse_as_dense)
+                             sparse_as_dense=sparse_as_dense,
+                             process_set=process_set)
     return [tuple(x) for x in gv]
 
 
@@ -337,7 +356,8 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = False,
                          sparse_as_dense: bool = False,
-                         gradient_predivide_factor: float = 1.0):
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None):
     """Wrap a keras optimizer so apply_gradients allreduces first. Like
     the reference (_keras/__init__.py:28-135 create_distributed_optimizer)
     this dynamically subclasses the optimizer's own class and rebuilds it
@@ -396,7 +416,8 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
             self._hvd_agg_count = 0
         reduced = _reduce_grads_and_vars(gv, reduce_op, "opt",
                                          sparse_as_dense,
-                                         gradient_predivide_factor)
+                                         gradient_predivide_factor,
+                                         process_set)
         return super(dist_cls, self).apply_gradients(reduced, *args,
                                                      **kwargs)
 
@@ -447,7 +468,7 @@ def DistributedOptimizer(optimizer, op: ReduceOp = Average,
                 grads[i] = tf.convert_to_tensor(acc) * scale
             reduced = _reduce_grads_and_vars(
                 list(zip(grads, variables)), reduce_op, "opt",
-                sparse_as_dense, gradient_predivide_factor)
+                sparse_as_dense, gradient_predivide_factor, process_set)
             result = super(dist_cls, self).apply_gradients(
                 reduced, *fwd_args, **fwd_kwargs)
             # Order the zeroing after the apply for v1-graph fetches

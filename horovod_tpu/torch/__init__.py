@@ -210,47 +210,50 @@ def allreduce_async(tensor: torch.Tensor, op: ReduceOp = Average,
                     name: Optional[str] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
-                    compression=None) -> int:
+                    compression=None, process_set=None) -> int:
     """Launches the collective (XLA dispatch is async — the reference's
     background-thread asynchrony maps onto the XLA stream) and returns an
-    int handle; the device→host copy happens in synchronize()."""
+    int handle; the device→host copy happens in synchronize().
+
+    Handles always live on the WORLD engine's HandleManager (even for
+    set-scoped collectives), so poll/synchronize need no process_set."""
     _validate_compression(compression)  # int8 scales don't sum
-    e = _engine()
-    out = e.allreduce(_replicated(tensor), op, name,
+    e = _engine(process_set)
+    out = e.allreduce(_replicated(tensor, process_set), op, name,
                       prescale_factor, postscale_factor, compression)
-    h = e.handles.allocate(out)
+    h = _engine().handles.allocate(out)
     _inplace_targets()[h] = ("plain", tensor.dtype)
     return h
 
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int = 0,
-                    name: Optional[str] = None) -> int:
-    e = _engine()
-    out = e.broadcast(_replicated(tensor), root_rank, name)
-    h = e.handles.allocate(out)
+                    name: Optional[str] = None, process_set=None) -> int:
+    out = _hvd.broadcast(_replicated(tensor, process_set), root_rank,
+                         name, process_set=process_set)
+    h = _engine().handles.allocate(out)
     _inplace_targets()[h] = ("plain", tensor.dtype)
     return h
 
 
 def allgather_async(tensor: torch.Tensor,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, process_set=None) -> int:
     """Reference torch/mpi_ops.py:302 — handle resolves to the
     rank-concatenated result."""
-    e = _engine()
-    out = e.allgather(_replicated(tensor), name)
-    h = e.handles.allocate(out)
+    e = _engine(process_set)
+    out = e.allgather(_replicated(tensor, process_set), name)
+    h = _engine().handles.allocate(out)
     _inplace_targets()[h] = ("allgather", tensor)
     return h
 
 
 def alltoall_async(tensor: torch.Tensor,
-                   name: Optional[str] = None) -> int:
+                   name: Optional[str] = None, process_set=None) -> int:
     """Reference torch/mpi_ops.py:515, even-split form (matching this
     shim's sync alltoall; negotiated uneven splits live on the core
     surface, horovod_tpu.alltoall(splits=...))."""
-    e = _engine()
-    out = e.alltoall(_replicated(tensor), name)
-    h = e.handles.allocate(out)
+    e = _engine(process_set)
+    out = e.alltoall(_replicated(tensor, process_set), name)
+    h = _engine().handles.allocate(out)
     _inplace_targets()[h] = ("plain", tensor.dtype)
     return h
 
@@ -268,17 +271,19 @@ def _inplace_targets() -> dict:
 
 
 def allreduce_async_(tensor: torch.Tensor, op: ReduceOp = Average,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None,
+                     process_set=None) -> int:
     """Reference torch/mpi_ops.py:223 allreduce_async_."""
-    h = allreduce_async(tensor, op, name)
+    h = allreduce_async(tensor, op, name, process_set=process_set)
     _inplace_targets()[h] = ("inplace", tensor)
     return h
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int = 0,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None,
+                     process_set=None) -> int:
     """Reference torch/mpi_ops.py:451 broadcast_async_."""
-    h = broadcast_async(tensor, root_rank, name)
+    h = broadcast_async(tensor, root_rank, name, process_set=process_set)
     _inplace_targets()[h] = ("inplace", tensor)
     return h
 
@@ -316,7 +321,8 @@ def synchronize(handle: int) -> torch.Tensor:
 
 # -- parameter/optimizer broadcast (reference torch/functions.py:30-108) ----
 
-def broadcast_parameters(params, root_rank: int = 0) -> None:
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
     """In-place broadcast of a state_dict or iterable of (name, tensor)."""
     if hasattr(params, "items"):
         items: Iterable[Tuple[str, torch.Tensor]] = params.items()
@@ -325,11 +331,12 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
     for name, p in items:
         if isinstance(p, torch.Tensor):
             broadcast_(p.data if p.requires_grad else p, root_rank,
-                       name=f"bcast.{name}")
+                       name=f"bcast.{name}", process_set=process_set)
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
-                              root_rank: int = 0) -> None:
+                              root_rank: int = 0,
+                              process_set=None) -> None:
     """Broadcast optimizer hyper/state tensors + scalars from root
     (reference torch/functions.py broadcast_optimizer_state: state tensors
     via collectives, scalars via the object channel)."""
@@ -342,10 +349,13 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
             if isinstance(v, torch.Tensor):
                 tensors[f"opt.{gi}.{k}"] = v
             else:
+                # Scalars ride the PROCESS-level object channel (KV
+                # store) — set-agnostic by construction; in the
+                # single-controller world it is an identity.
                 group_state[k] = broadcast_object(
                     v, root_rank, name=f"opt.{gi}.{k}")
     for name, t in tensors.items():
-        broadcast_(t, root_rank, name=name)
+        broadcast_(t, root_rank, name=name, process_set=process_set)
     for gi, group in enumerate(state_dict["param_groups"]):
         for k in list(group.keys()):
             if k != "params":
@@ -365,11 +375,13 @@ class _DistributedOptimizerMixin:
 
     def _dist_init(self, base_cls, named_parameters, op,
                    backward_passes_per_step, compression=None,
-                   gradient_predivide_factor: float = 1.0):
+                   gradient_predivide_factor: float = 1.0,
+                   process_set=None):
         self._base_cls = base_cls
         self.op = op
         self._compression = compression
         self._predivide = gradient_predivide_factor
+        self._process_set = process_set
         self.backward_passes_per_step = backward_passes_per_step
         self._handles = {}          # id(p) -> (p, handle-or-None)
         self._allreduce_delay = {}  # id(p) -> remaining local passes
@@ -399,12 +411,15 @@ class _DistributedOptimizerMixin:
         op, pre, post = self.op, 1.0, 1.0
         if self._predivide != 1.0:
             # Reference optimizer.py: scale 1/f before the SUM, f/size
-            # after (splits the averaging around the reduction).
+            # after (splits the averaging around the reduction) — size
+            # is the COMMUNICATOR's, i.e. the set's when one is given.
+            n = _hvd._communicator_size(self._process_set)
             op, pre, post = Sum, 1.0 / self._predivide, \
-                self._predivide / size()
+                self._predivide / n
         return allreduce_async(p.grad, op=op, name=name,
                                prescale_factor=pre, postscale_factor=post,
-                               compression=self._compression)
+                               compression=self._compression,
+                               process_set=self._process_set)
 
     def _make_hook(self):
         def hook(p: torch.Tensor) -> None:
@@ -484,9 +499,11 @@ class _DistributedAdasumMixin:
     ranks, and applies the reduced delta — adaptive summation over
     optimizer-shaped steps, not raw grads."""
 
-    def _dist_init(self, base_cls, named_parameters, compression=None):
+    def _dist_init(self, base_cls, named_parameters, compression=None,
+                   process_set=None):
         self._base_cls = base_cls
         self._compression = compression
+        self._process_set = process_set
         self._names = {}
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
@@ -501,7 +518,8 @@ class _DistributedAdasumMixin:
             delta = p.detach() - b
             name = self._names.get(id(p), f"adasum.delta.{id(p)}")
             reduced = allreduce(delta, op=Adasum, name=name,
-                                compression=self._compression)
+                                compression=self._compression,
+                                process_set=self._process_set)
             with torch.no_grad():
                 p.copy_(b + reduced)
         return result
@@ -512,7 +530,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=None,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
-                         gradient_predivide_factor: float = 1.0):
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None):
     """Returns an instance of a dynamic subclass of the USER's optimizer
     class with the mixin's step/synchronize grafted on — the reference's
     own architecture (torch/optimizer.py:381: ``cls = type(...,
@@ -547,7 +566,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                     if not k.startswith("__")})
         obj = cls.__new__(cls)
         obj.__dict__.update(optimizer.__dict__)
-        obj._dist_init(optimizer.__class__, named_parameters, compression)
+        obj._dist_init(optimizer.__class__, named_parameters, compression,
+                       process_set)
         return obj
     cls = type(optimizer.__class__.__name__,
                (optimizer.__class__,),
@@ -557,7 +577,7 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     obj.__dict__.update(optimizer.__dict__)  # share param_groups + state
     obj._dist_init(optimizer.__class__, named_parameters, op,
                    backward_passes_per_step, compression,
-                   gradient_predivide_factor)
+                   gradient_predivide_factor, process_set)
     return obj
 
 

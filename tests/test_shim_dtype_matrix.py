@@ -522,3 +522,64 @@ def test_tf_sparse_allreduce_process_set(hvd):
         np.testing.assert_allclose(dense.numpy(), expected)
     finally:
         hvd.remove_process_set(ps)
+
+
+# -- process_set through the training wrappers -------------------------------
+
+def test_torch_optimizer_process_set(hvd):
+    """DistributedOptimizer(process_set=...) averages grads over the SET:
+    identical grads on every member -> averaged grad == local grad, and
+    the predivide split divides by SET size, not world size."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        p = torch.nn.Parameter(torch.zeros(3))
+        opt = hvdt.DistributedOptimizer(
+            torch.optim.SGD([p], lr=1.0), named_parameters=[("p", p)],
+            gradient_predivide_factor=2.0, process_set=ps)
+        (p * torch.arange(3.0)).sum().backward()
+        opt.step()
+        # grad = [0,1,2] on all members; Average -> unchanged; lr 1.0.
+        np.testing.assert_allclose(p.detach().numpy(),
+                                   -np.arange(3.0), rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_torch_broadcast_parameters_process_set(hvd):
+    ps = hvd.add_process_set([1, 3, 5, 7])
+    try:
+        t = torch.arange(4.0)
+        hvdt.broadcast_parameters([("w", t)], root_rank=3,
+                                  process_set=ps)
+        np.testing.assert_array_equal(t.numpy(), np.arange(4.0))
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_tf_tape_process_set(hvd):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        v = tf.Variable([1.0, 2.0])
+        with hvdtf.DistributedGradientTape(
+                tf.GradientTape(), process_set=ps) as tape:
+            loss = tf.reduce_sum(v * v)
+        g = tape.gradient(loss, [v])[0]
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_tf_optimizer_predivide_process_set(hvd):
+    """The keras wrapper's predivide post-factor uses SET size: with
+    f=2 and identical grads g on 4 members, (g/2) summed over 4 then
+    * 2/4 == g."""
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        v = tf.Variable([0.0, 0.0])
+        opt = hvdtf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), gradient_predivide_factor=2.0,
+            process_set=ps)
+        opt.apply_gradients([(tf.constant([1.0, 3.0]), v)])
+        np.testing.assert_allclose(v.numpy(), [-1.0, -3.0], rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
