@@ -54,6 +54,12 @@ JOBS = [
                           "results/tpu_r03/trace_resnet50"], 1500),
     ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
                     "--model", "bert_large"], 1200),
+    # Tuned-batch leg: b8 is the reference config's per-worker batch;
+    # b32 amortizes layernorm/host overheads over 4x the MXU rows (the
+    # number a throughput-tuned TPU user would run).
+    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "bert_large", "--batch-size", "32"],
+     1500),
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
     # Long-context leg: the flash-attention decode path at 4x the
@@ -125,8 +131,15 @@ def run_job(name, argv, timeout_s):
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        _log(f"job {name}: TIMED OUT after {timeout_s}s")
+    except subprocess.TimeoutExpired as e:
+        # The partial stderr says WHERE it hung (backend init vs compile
+        # vs mid-iteration) — the difference between "lease/outage" and
+        # "this model's program is slow".
+        partial = e.stderr or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        _log(f"job {name}: TIMED OUT after {timeout_s}s; stderr tail:\n"
+             f"{partial[-800:]}")
         time.sleep(LEASE_COOLDOWN)
         return None
     dt = time.time() - t0
