@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""TensorFlow-2 custom training loop — the reference
+examples/tensorflow2/tensorflow2_mnist.py recipe on the
+``horovod_tpu.tensorflow`` shim (host-side TF training with
+engine-backed collectives; for TPU-throughput training use the JAX
+surface — see mnist_train.py and docs/performance.md §5).
+
+The reference recipe, line for line:
+  1. hvd.init()
+  2. shard the dataset by rank
+  3. scale the learning rate by hvd.size()
+  4. tape = hvd.DistributedGradientTape(tf.GradientTape())
+  5. hvd.broadcast_variables(model + optimizer) after the first step
+
+Run: HVD_TPU_FORCE_CPU_DEVICES=8 python examples/tf2_mnist.py --epochs 1
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+try:
+    import horovod_tpu.tensorflow as hvd
+except ModuleNotFoundError:  # running from a source checkout
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import horovod_tpu.tensorflow as hvd
+
+import tensorflow as tf
+
+
+def build_model():
+    return tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(8, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+
+
+def synthetic_mnist(n=1024, seed=0):
+    """Synthetic images with LEARNABLE labels (a fixed random linear
+    teacher) so the one-epoch demo's loss visibly drops — random labels
+    would start at the uniform floor ln(10) with nothing to learn."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    teacher = rng.normal(size=(28 * 28, 10)).astype(np.float32)
+    y = np.argmax(x.reshape(n, -1) @ teacher, axis=1).astype(np.int64)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-4,
+                   help="per-worker base rate; scaled by hvd.size() "
+                        "per the reference recipe")
+    args = p.parse_args()
+
+    hvd.init()
+
+    # Shard by rank (reference: dataset.shard(hvd.size(), hvd.rank())).
+    x, y = synthetic_mnist()
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model = build_model()
+    # Reference: scale lr by the number of workers.
+    opt = tf.keras.optimizers.Adam(args.lr * hvd.size())
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    def train_step(xb, yb, first_batch):
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = loss_fn(yb, model(xb, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first_batch:
+            # Reference: broadcast AFTER the first step so optimizer
+            # slots exist (tensorflow2_mnist.py:79-87).
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        return loss
+
+    nb = len(x) // args.batch_size
+    first_loss = last_loss = None
+    for epoch in range(args.epochs):
+        for i in range(nb):
+            sl = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            loss = train_step(tf.constant(x[sl]), tf.constant(y[sl]),
+                              epoch == 0 and i == 0)
+            if first_loss is None:
+                first_loss = float(loss)
+            last_loss = float(loss)
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {last_loss:.4f}")
+
+    assert last_loss < first_loss, (first_loss, last_loss)
+    # Averaged metric across workers, the MetricAverageCallback pattern.
+    avg = hvd.allreduce(tf.constant(last_loss), op=hvd.Average,
+                        name="final_loss")
+    if hvd.rank() == 0:
+        print(f"final loss {first_loss:.4f} -> {float(avg):.4f} "
+              f"(allreduce-averaged over {hvd.size()} ranks)")
+
+
+if __name__ == "__main__":
+    main()

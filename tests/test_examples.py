@@ -81,3 +81,9 @@ def test_parity_doc_references_resolve():
     test module it cites must exist (tools/check_parity.py)."""
     out = _run(["tools/check_parity.py"], timeout=60)
     assert "all file/test/module references resolve" in out
+
+
+def test_tf2_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = _run(["examples/tf2_mnist.py", "--epochs", "3"])
+    assert "allreduce-averaged over 8 ranks" in out
