@@ -344,7 +344,7 @@ def scaling_projection():
     models = {
         # name -> (grad bytes/step/chip, per-chip batch, trace summary)
         "resnet50_b256": (25.6e6 * 4, 256, "trace_summary.json"),
-        "bert_large": (340e6 * 4, 8, None),
+        "bert_large": (340e6 * 4, 8, "trace_bert_summary.json"),
     }
     out = {}
     for name, (grad_bytes, bsz, trace) in models.items():

@@ -60,6 +60,10 @@ JOBS = [
     ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
                         "--model", "bert_large", "--batch-size", "32"],
      1500),
+    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                      "--model", "bert_large", "--num-iters", "3",
+                      "--profile-dir", "results/tpu_r03/trace_bert"],
+     1200),
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
     # Long-context leg: the flash-attention decode path at 4x the
