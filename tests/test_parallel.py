@@ -805,3 +805,58 @@ def test_3d_dp_tp_sp_block_matches_unsharded(rng):
     got = f(x, Wq, Wk, Wv, Wo, W1, b1, W2, b2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-4, atol=3e-4)
+
+
+def test_tp_manual_grad_combine_matches_unsharded(rng):
+    """The MANUAL tp-grad combination rule (used by the dryrun's TP leg):
+    under per-rank semantics every tp rank computes its own loss copy and
+    row_parallel's psum transposes to a psum of cotangents, so slice-used
+    params' grads arrive tp-scaled — pmean over tp assembles the disjoint
+    slices AND cancels the factor, while the post-psum bias grad is
+    already exact. One SGD step must match the unsharded step exactly."""
+    from horovod_tpu.parallel.tensor_parallel import (shard_column,
+                                                      shard_row, tp_mlp)
+
+    dp, tp = 2, 4
+    mesh = Mesh(np.array(jax.devices()).reshape(dp, tp), ("dp", "tp"))
+    b, d, h = 4, 8, 16
+    x = rng.standard_normal((b, d)).astype(np.float32)
+    y = rng.standard_normal((b, 1)).astype(np.float32)
+    W1 = (rng.standard_normal((d, h)) * 0.3).astype(np.float32)
+    b1 = np.zeros((h,), np.float32)
+    W2 = (rng.standard_normal((h, 1)) * 0.3).astype(np.float32)
+    b2 = np.zeros((1,), np.float32)
+
+    def step(W1, b1, W2, b2, xb, yb):
+        def loss(W1, b1, W2, b2):
+            out = tp_mlp(xb, shard_column(W1, "tp"),
+                         shard_column(b1, "tp"),
+                         shard_row(W2, "tp"), b2, "tp")
+            return ((out - yb) ** 2).mean()
+
+        l, (gW1, gb1, gW2, gb2) = jax.value_and_grad(
+            loss, argnums=(0, 1, 2, 3))(W1, b1, W2, b2)
+        gW1, gb1, gW2 = (jax.lax.pmean(v, "tp")
+                         for v in (gW1, gb1, gW2))
+        g = jax.tree.map(lambda v: jax.lax.pmean(v, "dp"),
+                         (gW1, gb1, gW2, gb2))
+        new = [p - 0.1 * gi for p, gi in zip((W1, b1, W2, b2), g)]
+        return (*new, jax.lax.pmean(l, "dp"))
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P(), P()), check_vma=False))
+    nW1, nb1, nW2, nb2, l = f(W1, b1, W2, b2, x, y)
+
+    def ref_loss(W1, b1, W2, b2):
+        out = jax.nn.gelu(x @ W1 + b1) @ W2 + b2
+        return ((out - y) ** 2).mean()
+
+    rl, rg = jax.value_and_grad(ref_loss, argnums=(0, 1, 2, 3))(
+        W1, b1, W2, b2)
+    refs = [p - 0.1 * gi for p, gi in zip((W1, b1, W2, b2), rg)]
+    for got, want in zip((nW1, nb1, nW2, nb2), refs):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(l), float(rl), rtol=1e-5)
