@@ -9,7 +9,8 @@ from .pipeline import (pipeline_apply,  # noqa: F401
 from .ring_attention import (ring_attend_fn,  # noqa: F401
                              ring_attention)
 from .tensor_parallel import (column_parallel,  # noqa: F401
-                              row_parallel, shard_column, shard_row,
+                              combine_slice_grads, row_parallel,
+                              shard_column, shard_row,
                               tp_attention_qkv, tp_mlp)
 from .ulysses import (ulysses_attend_fn,  # noqa: F401
                       ulysses_attention)

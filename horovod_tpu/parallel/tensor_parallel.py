@@ -78,6 +78,28 @@ def shard_row(w, axis_name: str = "tp"):
     return lax.dynamic_slice_in_dim(w, idx * chunk, chunk, axis=0)
 
 
+def combine_slice_grads(grads, axis_name: str = "tp"):
+    """Combine gradients of SLICE-used replicated params (those fed
+    through :func:`shard_column` / :func:`shard_row`) taken with
+    ``jax.grad`` inside ``shard_map(check_vma=False)``.
+
+    Under per-rank semantics every tp rank computes its own copy of the
+    loss, and :func:`row_parallel`'s psum transposes to a psum of
+    cotangents — so each rank's slice-grad (nonzero only in its shard
+    slice) arrives scaled by the axis size. ``pmean`` over the axis
+    both assembles the disjoint slices and cancels that factor.
+
+    Do NOT pass grads of params used replicated AFTER the psum (e.g.
+    ``row_parallel``'s bias): those are already exact on every rank,
+    and averaging them is a no-op while summing would scale by tp.
+    Pinned against the unsharded step by
+    tests/test_parallel.py::test_tp_manual_grad_combine_matches_unsharded.
+    """
+    import jax
+
+    return jax.tree.map(lambda v: lax.pmean(v, axis_name), grads)
+
+
 def tp_attention_qkv(x, wq_shard, wk_shard, wv_shard, num_heads_local):
     """Column-parallel QKV: heads shard over tp (each rank computes its
     head subset); pair with a row-parallel output projection."""

@@ -124,3 +124,28 @@ def test_compilation_cache_knob(tmp_path, hvd, monkeypatch):
         hvd_mod.shutdown()
         hvd_mod.init()
         assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_allgather_object_single_process(hvd):
+    """Single-controller world: one object per PROCESS (not per rank) —
+    the reference's per-rank gather collapses to [obj] here."""
+    out = hvd.allgather_object({"r": 7, "x": [1, 2]}, name="ago")
+    assert out == [{"r": 7, "x": [1, 2]}]
+
+
+def test_core_broadcast_async_handle(hvd):
+    import numpy as np
+
+    x = np.arange(5, dtype=np.float32)
+    h = hvd.broadcast_async(x, root_rank=0, name="core_bca")
+    out = hvd.synchronize(h)
+    np.testing.assert_array_equal(
+        np.asarray(out.addressable_data(0))[0], x)
+
+
+def test_topology_queries(hvd):
+    """local/cross rank-size queries stay consistent with world size
+    (reference basics.py local_rank/cross_rank surface)."""
+    assert hvd.local_size() * hvd.cross_size() == hvd.size()
+    assert 0 <= hvd.local_rank() < hvd.local_size()
+    assert 0 <= hvd.cross_rank() < hvd.cross_size()

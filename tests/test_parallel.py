@@ -814,8 +814,8 @@ def test_tp_manual_grad_combine_matches_unsharded(rng):
     params' grads arrive tp-scaled — pmean over tp assembles the disjoint
     slices AND cancels the factor, while the post-psum bias grad is
     already exact. One SGD step must match the unsharded step exactly."""
-    from horovod_tpu.parallel.tensor_parallel import (shard_column,
-                                                      shard_row, tp_mlp)
+    from horovod_tpu.parallel.tensor_parallel import (
+        combine_slice_grads, shard_column, shard_row, tp_mlp)
 
     dp, tp = 2, 4
     mesh = Mesh(np.array(jax.devices()).reshape(dp, tp), ("dp", "tp"))
@@ -836,8 +836,7 @@ def test_tp_manual_grad_combine_matches_unsharded(rng):
 
         l, (gW1, gb1, gW2, gb2) = jax.value_and_grad(
             loss, argnums=(0, 1, 2, 3))(W1, b1, W2, b2)
-        gW1, gb1, gW2 = (jax.lax.pmean(v, "tp")
-                         for v in (gW1, gb1, gW2))
+        gW1, gb1, gW2 = combine_slice_grads((gW1, gb1, gW2), "tp")
         g = jax.tree.map(lambda v: jax.lax.pmean(v, "dp"),
                          (gW1, gb1, gW2, gb2))
         new = [p - 0.1 * gi for p, gi in zip((W1, b1, W2, b2), g)]
