@@ -145,7 +145,9 @@ def _supervise(argv, model):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", type=int, default=0,
-                   help="0 = per-model default (128 CNN, 8 BERT/GPT)")
+                   help="0 = per-model default (256 CNN, 8 BERT/GPT; "
+                        "the chip matrix measured b256 ~8%% faster than "
+                        "b128 on v5e — docs/performance.md §4)")
     p.add_argument("--image-size", type=int, default=0,
                    help="0 = model's native size (224; 299 for inception3)")
     p.add_argument("--seq-len", type=int, default=512)
@@ -229,7 +231,7 @@ def main():
 def _run_benchmark(args, n):
     is_bert = args.model.startswith("bert")
     is_gpt = args.model.startswith("gpt")
-    batch_size = args.batch_size or (8 if (is_bert or is_gpt) else 128)
+    batch_size = args.batch_size or (8 if (is_bert or is_gpt) else 256)
 
     if is_bert:
         run_batch, unit, baseline = _setup_bert(args, batch_size, n)

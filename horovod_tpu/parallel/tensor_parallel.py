@@ -95,8 +95,6 @@ def combine_slice_grads(grads, axis_name: str = "tp"):
     Pinned against the unsharded step by
     tests/test_parallel.py::test_tp_manual_grad_combine_matches_unsharded.
     """
-    import jax
-
     return jax.tree.map(lambda v: lax.pmean(v, axis_name), grads)
 
 
