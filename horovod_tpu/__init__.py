@@ -45,7 +45,7 @@ from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
                               Sum)
 from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
-                    DistributedOptimizer, ShardedOptimizer,
+                    DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
                     broadcast_parameters, sharded_init, sharded_update)
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
@@ -324,7 +324,7 @@ __all__ = [
     "stop_timeline", "spmd_step", "ReduceOp", "Average", "Sum", "Adasum",
     "Min", "Max", "Product", "Compression", "DistributedOptimizer",
     "DistributedGradFn", "AutotunedStepper", "ShardedOptimizer",
-    "sharded_init", "sharded_update",
+    "FSDPOptimizer", "sharded_init", "sharded_update",
     "broadcast_parameters", "broadcast_object",
     "allgather_object", "broadcast_variables", "collective_ops",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",

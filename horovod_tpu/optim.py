@@ -394,6 +394,18 @@ def broadcast_parameters(params, root_rank: int = 0,
 # Transforms that couple elements across the tree (global-norm clipping)
 # would compute shard-local statistics — compose those OUTSIDE.
 
+def _sharded_state_specs(inner, plan, axis_name: str):
+    """PartitionSpecs for an inner transform's state over bucket shards:
+    vector leaves P(axis), scalar leaves (step counters) replicated. A
+    length-1 probe per bucket suffices — only leaf rank matters."""
+    from jax.sharding import PartitionSpec as P
+
+    probe = [jax.ShapeDtypeStruct((1,), b.dtype) for b in plan.buckets]
+    shapes = jax.eval_shape(inner.init, probe)
+    return jax.tree.map(
+        lambda s: P(axis_name) if s.ndim else P(), shapes)
+
+
 def _require_axis(axis_name: str, what: str) -> None:
     if not _axes_bound(axis_name):
         raise ValueError(
@@ -501,14 +513,120 @@ class ShardedOptimizer:
         the global array is the shard concatenation), scalar leaves
         (step counters) replicate. The probe uses the same fusion plan
         as init/update so the state STRUCTURE (one shard per bucket)
-        matches; only leaf rank matters, so shard length 1 suffices —
-        callable before init()."""
-        from jax.sharding import PartitionSpec as P
-
+        matches — callable before init()."""
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
-        probe = [jax.ShapeDtypeStruct((1,), b.dtype)
-                 for b in plan.buckets]
-        shapes = jax.eval_shape(self.inner.init, probe)
-        return jax.tree.map(
-            lambda s: P(self.axis_name) if s.ndim else P(), shapes)
+        return _sharded_state_specs(self.inner, plan, self.axis_name)
+
+
+# -- FSDP / ZeRO-3: fully-sharded parameters (beyond the reference) ---------
+#
+# ZeRO-1 (above) shards the OPTIMIZER STATE; FSDP additionally keeps the
+# PARAMETERS at rest as 1/n bucket shards. Per step: all-gather shards ->
+# full params for compute, reduce-scatter grads -> shard-local inner
+# update -> new shards. At-rest memory for params + Adam state drops to
+# 1/n; the transient peak is full params + activations during the step
+# (fusion-bucket granularity — XLA's scheduler overlaps the per-bucket
+# allgathers with the first layers' compute the same way it overlaps the
+# grad reduction with backprop). Wire cost per step: AG(params) +
+# RS(grads) — the same bytes as ZeRO-1's RS+AG pair plus the param
+# gather that replicated storage gets for free.
+
+class FSDPOptimizer:
+    """Fully-sharded (ZeRO-3-style) training helper over fused buckets::
+
+        tx = hvd.FSDPOptimizer(optax.adamw(1e-3), axis_name=ax)
+        # inside the jitted SPMD region (axis bound):
+        shards = tx.shard_params(params)    # full -> 1/n bucket shards
+        state  = tx.init(shards)            # inner state on shards (1/n)
+        # each step:
+        full   = tx.gather_params(shards)   # AG per bucket -> pytree
+        loss, grads = jax.value_and_grad(loss_fn)(full, batch)
+        shards, state = tx.update(grads, state, shards)  # RS + update
+
+    Carry ``shards``/``state`` through shard_map with
+    :meth:`shard_specs` / :meth:`state_specs` (leaves are P(axis)).
+    Elementwise inner transforms only — same contract as
+    :class:`ShardedOptimizer`."""
+
+    def __init__(self, inner, axis_name: str = "hvd",
+                 grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                 fusion_threshold_bytes: Optional[int] = None):
+        if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+            raise ValueError("FSDPOptimizer supports SUM/AVERAGE")
+        self.inner = inner
+        self.axis_name = axis_name
+        self.grad_op = grad_op
+        self.fusion_threshold_bytes = _resolve_fusion_threshold(
+            fusion_threshold_bytes)
+        self._plan = None
+        self._flat_lens = None
+
+    def bind(self, params_template):
+        """Pin the bucket plan from a params pytree (real arrays or
+        ShapeDtypeStructs). Called implicitly by shard_params; explicit
+        bind() lets gather/update trace in a separate jit region."""
+        self._plan = fusion_lib.plan_fusion(params_template,
+                                            self.fusion_threshold_bytes)
+        self._flat_lens = [b.total_elems for b in self._plan.buckets]
+        return self
+
+    def _require_bound(self, what: str):
+        if self._plan is None:
+            raise ValueError(
+                f"{what} needs the bucket plan — call shard_params "
+                f"(or bind(params_template)) first")
+
+    def shard_params(self, params):
+        """Full params -> list of this rank's 1/n bucket shards."""
+        _require_axis(self.axis_name, "FSDPOptimizer.shard_params")
+        self.bind(params)
+        flats = fusion_lib.fuse(params, self._plan)
+        return [_shard_flat(f, self.axis_name) for f in flats]
+
+    def gather_params(self, shards):
+        """Bucket shards -> full params pytree (one all-gather per
+        bucket; padding from the shard split sliced back off)."""
+        self._require_bound("gather_params")
+        _require_axis(self.axis_name, "FSDPOptimizer.gather_params")
+        flats = [C.allgather(s, self.axis_name)[:length]
+                 for s, length in zip(shards, self._flat_lens)]
+        return fusion_lib.unfuse(flats, self._plan)
+
+    def init(self, shards):
+        return self.inner.init(shards)
+
+    def update(self, grads, state, shards, **extra):
+        """RS(full grads) -> inner update on this rank's shards ->
+        apply. Returns (new_shards, new_state)."""
+        self._require_bound("update")
+        _require_axis(self.axis_name, "FSDPOptimizer.update")
+        n = jax.lax.axis_size(self.axis_name)
+        g_flats = fusion_lib.fuse(grads, self._plan)
+
+        def rs(f):
+            padded, _ = fusion_lib.pad_to_multiple(f, n)
+            return C.reducescatter(padded, self.grad_op, self.axis_name)
+
+        g_shards = [rs(f).astype(s.dtype)
+                    for f, s in zip(g_flats, shards)]
+        u_shards, new_state = self.inner.update(g_shards, state, shards,
+                                                **extra)
+        new_shards = [(s + u).astype(s.dtype)
+                      for s, u in zip(shards, u_shards)]
+        return new_shards, new_state
+
+    def shard_specs(self, params_template):
+        """P(axis) per bucket shard — for carrying shards through
+        shard_map. Binds the plan from the template."""
+        from jax.sharding import PartitionSpec as P
+
+        self.bind(params_template)
+        return [P(self.axis_name)] * len(self._flat_lens)
+
+    def state_specs(self, params_template):
+        """Specs for the inner state over bucket shards (vector leaves
+        P(axis), scalars replicated)."""
+        self.bind(params_template)
+        return _sharded_state_specs(self.inner, self._plan,
+                                    self.axis_name)
