@@ -45,7 +45,13 @@ def main():
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer state over dp (ZeRO-1: "
                         "hvd.ShardedOptimizer — 1/dp adam memory)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="fully-shard PARAMS over dp (ZeRO-3: "
+                        "hvd.FSDPOptimizer — 1/dp params + adam at "
+                        "rest; AG for compute, RS grads)")
     args = p.parse_args()
+    if args.zero1 and args.fsdp:
+        raise SystemExit("--zero1 and --fsdp are exclusive")
 
     hvd.init()
     n = hvd.size()
@@ -64,38 +70,63 @@ def main():
     if args.zero1:
         tx = hvd.ShardedOptimizer(optax.adam(1e-2), axis_name="dp")
         state_specs = tx.state_specs(params)
+    elif args.fsdp:
+        tx = hvd.FSDPOptimizer(optax.adam(1e-2), axis_name="dp")
+        param_specs = tx.shard_specs(params)
+        state_specs = tx.state_specs(params)
     else:
         tx = hvd.DistributedOptimizer(optax.adam(1e-2), axis_name="dp")
         state_specs = P()
 
-    def step(p_, s_, x, y):
+    def loss_of(p_, x, y):
         pos = jax.lax.axis_index("sp") * (S // sp) + jnp.arange(S // sp)
+        logits = model.apply(
+            {"params": p_}, x,
+            positions=jnp.broadcast_to(pos[None], x.shape))
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
 
-        def loss_fn(p_):
-            logits = model.apply(
-                {"params": p_}, x,
-                positions=jnp.broadcast_to(pos[None], x.shape))
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean()
+    if args.fsdp:
+        def step(shards, s_, x, y):
+            full = tx.gather_params(shards)
+            l, g = jax.value_and_grad(loss_of)(full, x, y)
+            g = jax.tree.map(lambda v: jax.lax.pmean(v, "sp"), g)
+            shards, s_ = tx.update(g, s_, shards)
+            return shards, s_, jax.lax.pmean(l, ("dp", "sp"))
 
-        l, g = jax.value_and_grad(loss_fn)(p_)
-        g = jax.tree.map(lambda v: jax.lax.pmean(v, "sp"), g)
-        u, s_ = tx.update(g, s_, p_)
-        return optax.apply_updates(p_, u), s_, jax.lax.pmean(
-            l, ("dp", "sp"))
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(param_specs, state_specs,
+                      P("dp", "sp"), P("dp", "sp")),
+            out_specs=(param_specs, state_specs, P()), check_vma=False))
+        def _setup(p_):
+            sh = tx.shard_params(p_)
+            return sh, tx.init(sh)
 
-    f = jax.jit(jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(P(), state_specs, P("dp", "sp"), P("dp", "sp")),
-        out_specs=(P(), state_specs, P()), check_vma=False))
-
-    if args.zero1:
-        init_f = jax.jit(jax.shard_map(
-            lambda p_: (tx.init(p_),), mesh=mesh, in_specs=(P(),),
-            out_specs=(state_specs,), check_vma=False))
-        (opt_state,) = init_f(params)
+        setup = jax.jit(jax.shard_map(
+            _setup, mesh=mesh, in_specs=(P(),),
+            out_specs=(param_specs, state_specs), check_vma=False))
+        params, opt_state = setup(params)
     else:
-        opt_state = tx.init(params)
+        def step(p_, s_, x, y):
+            l, g = jax.value_and_grad(loss_of)(p_, x, y)
+            g = jax.tree.map(lambda v: jax.lax.pmean(v, "sp"), g)
+            u, s_ = tx.update(g, s_, p_)
+            return optax.apply_updates(p_, u), s_, jax.lax.pmean(
+                l, ("dp", "sp"))
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), state_specs, P("dp", "sp"), P("dp", "sp")),
+            out_specs=(P(), state_specs, P()), check_vma=False))
+
+        if args.zero1:
+            init_f = jax.jit(jax.shard_map(
+                lambda p_: (tx.init(p_),), mesh=mesh, in_specs=(P(),),
+                out_specs=(state_specs,), check_vma=False))
+            (opt_state,) = init_f(params)
+        else:
+            opt_state = tx.init(params)
 
     for i in range(args.steps):
         params, opt_state, loss = f(params, opt_state,
@@ -103,7 +134,8 @@ def main():
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.4f}")
     print(f"done: dp={dp} sp={sp} seq={S}"
-          + (" zero1" if args.zero1 else ""))
+          + (" zero1" if args.zero1 else "")
+          + (" fsdp" if args.fsdp else ""))
 
 
 if __name__ == "__main__":

@@ -87,3 +87,9 @@ def test_tf2_mnist_example():
     pytest.importorskip("tensorflow")
     out = _run(["examples/tf2_mnist.py", "--epochs", "3"])
     assert "allreduce-averaged over 8 ranks" in out
+
+
+def test_gpt_long_context_fsdp_example():
+    out = _run(["examples/gpt_long_context.py", "--steps", "6",
+                "--seq-len", "32", "--fsdp"])
+    assert "done: dp=2 sp=4 seq=32 fsdp" in out and "loss" in out
