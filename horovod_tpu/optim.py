@@ -406,6 +406,35 @@ def _sharded_state_specs(inner, plan, axis_name: str):
         lambda s: P(axis_name) if s.ndim else P(), shapes)
 
 
+def _gather_sharded_state(inner, plan, state, axis_name: str):
+    """Sharded inner state -> WORLD-SIZE-INDEPENDENT full state: every
+    vector (bucket-shard) leaf all-gathers and drops the shard-split
+    padding; scalar leaves pass through. The inverse of
+    :func:`_reshard_state` — together they carry ZeRO-1/FSDP state
+    across an elastic WORLD-SIZE CHANGE, where the 1/n shard shapes
+    (and their pad-to-multiple) differ between the old and new worlds
+    so a sharded checkpoint cannot be restored directly."""
+    full_probe = [jax.ShapeDtypeStruct((b.total_elems,), b.dtype)
+                  for b in plan.buckets]
+    full_shapes = jax.eval_shape(inner.init, full_probe)
+
+    def one(leaf, shp):
+        if shp.ndim:
+            return C.allgather(leaf, axis_name)[:shp.shape[0]]
+        return leaf
+
+    return jax.tree.map(one, state, full_shapes)
+
+
+def _reshard_state(state_full, axis_name: str):
+    """Full (gathered) inner state -> this world's shards: vector
+    leaves re-split 1/n under the CURRENTLY BOUND axis (whatever its
+    size), scalars pass through."""
+    return jax.tree.map(
+        lambda v: _shard_flat(v, axis_name) if v.ndim else v,
+        state_full)
+
+
 def _require_axis(axis_name: str, what: str) -> None:
     if not _axes_bound(axis_name):
         raise ValueError(
@@ -517,6 +546,30 @@ class ShardedOptimizer:
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
         return _sharded_state_specs(self.inner, plan, self.axis_name)
+
+    def gather_state(self, state, params):
+        """Sharded state -> world-size-independent full state (inside
+        the OLD world's SPMD region) — checkpoint this across an
+        elastic resize; restore with :meth:`reshard_state` in the new
+        world.
+
+        The layout is still FUSION-PLAN-dependent: the new world's
+        optimizer must resolve the SAME fusion threshold (pass
+        ``fusion_threshold_bytes`` explicitly in elastic jobs — a
+        live autotuner or changed env knob in the restarted process
+        would re-bucket and silently misalign the per-bucket mu/nu
+        vectors)."""
+        _require_axis(self.axis_name, "ShardedOptimizer.gather_state")
+        threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
+        plan = fusion_lib.plan_fusion(params, threshold)
+        return _gather_sharded_state(self.inner, plan, state,
+                                     self.axis_name)
+
+    def reshard_state(self, state_full):
+        """Full (gathered) state -> this world's 1/n shards (inside the
+        NEW world's SPMD region, whatever its size)."""
+        _require_axis(self.axis_name, "ShardedOptimizer.reshard_state")
+        return _reshard_state(state_full, self.axis_name)
 
 
 # -- FSDP / ZeRO-3: fully-sharded parameters (beyond the reference) ---------
@@ -630,3 +683,24 @@ class FSDPOptimizer:
         self.bind(params_template)
         return _sharded_state_specs(self.inner, self._plan,
                                     self.axis_name)
+
+    def gather_state(self, state):
+        """Sharded state -> world-size-independent full state (inside
+        the OLD world's SPMD region); pair with :meth:`reshard_state`
+        (and gather_params/shard_params for the params themselves) to
+        carry FSDP training across an elastic resize.
+
+        Same caveat as ShardedOptimizer.gather_state: the layout is
+        fusion-plan-dependent — pin ``fusion_threshold_bytes``
+        explicitly across the resize so the new world re-buckets
+        identically."""
+        self._require_bound("gather_state")
+        _require_axis(self.axis_name, "FSDPOptimizer.gather_state")
+        return _gather_sharded_state(self.inner, self._plan, state,
+                                     self.axis_name)
+
+    def reshard_state(self, state_full):
+        """Full (gathered) state -> this world's 1/n shards (inside the
+        NEW world's SPMD region, whatever its size)."""
+        _require_axis(self.axis_name, "FSDPOptimizer.reshard_state")
+        return _reshard_state(state_full, self.axis_name)

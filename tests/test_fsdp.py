@@ -99,3 +99,150 @@ def test_fsdp_outside_axis_fails(hvd, problem):
     fs = hvd.FSDPOptimizer(optax.sgd(0.1), axis_name=hvd.rank_axis())
     with pytest.raises(ValueError, match="SPMD region"):
         fs.shard_params(params)
+
+
+# -- elastic resize: sharded state across a WORLD-SIZE change ---------------
+
+def _mk_mesh(ndev):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:ndev]), ("z",))
+
+
+def _ref_trajectory(inner, params, X, Y, steps):
+    p = jax.tree.map(jnp.asarray, params)
+    st = inner.init(p)
+    for _ in range(steps):
+        _, g = jax.value_and_grad(_loss)(p, X, Y)
+        u, st = inner.update(g, st, p)
+        p = optax.apply_updates(p, u)
+    return p
+
+
+def test_zero1_state_survives_world_resize(hvd, problem):
+    """Train 2 steps in a 4-rank world, gather the sharded state, resume
+    in an 8-rank world via reshard_state — the 4-step trajectory matches
+    uninterrupted replicated training (the elastic scale-UP case; shard
+    shapes and padding differ between the worlds)."""
+    from jax.sharding import PartitionSpec as P
+
+    X, Y, params = problem
+    inner = optax.adamw(1e-2)
+    tx = hvd.ShardedOptimizer(inner, axis_name="z")
+    specs = tx.state_specs(params)
+
+    def make_step(mesh):
+        def step(p, s, xb, yb):
+            l, g = jax.value_and_grad(_loss)(p, xb, yb)
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s, jax.lax.pmean(l, "z")
+
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), specs, P("z"), P("z")),
+            out_specs=(P(), specs, P()), check_vma=False))
+
+    mesh4, mesh8 = _mk_mesh(4), _mk_mesh(8)
+    init4 = jax.jit(jax.shard_map(
+        lambda p: (tx.init(p),), mesh=mesh4, in_specs=(P(),),
+        out_specs=(specs,), check_vma=False))
+    gather4 = jax.jit(jax.shard_map(
+        lambda s, p: (tx.gather_state(s, p),), mesh=mesh4,
+        in_specs=(specs, P()), out_specs=(P(),), check_vma=False))
+    reshard8 = jax.jit(jax.shard_map(
+        lambda sf: (tx.reshard_state(sf),), mesh=mesh8,
+        in_specs=(P(),), out_specs=(specs,), check_vma=False))
+
+    # Old world: 4 ranks, 2 steps.
+    p = jax.tree.map(jnp.asarray, params)
+    (s,) = init4(p)
+    step4 = make_step(mesh4)
+    for _ in range(2):
+        p, s, _ = step4(p, s, X, Y)
+    (s_full,) = gather4(s, p)
+
+    # Host hop between the worlds — exactly a checkpoint's journey
+    # (device arrays from the old mesh can't feed the new mesh's jit).
+    s_full = jax.tree.map(np.asarray, s_full)
+    p = jax.tree.map(np.asarray, p)
+
+    # New world: 8 ranks, reshard, 2 more steps.
+    (s8,) = reshard8(s_full)
+    step8 = make_step(mesh8)
+    for _ in range(2):
+        p, s8, _ = step8(p, s8, X, Y)
+
+    ref = _ref_trajectory(inner, params, X, Y, 4)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p[k].addressable_data(0)),
+            np.asarray(ref[k]), rtol=2e-4, atol=1e-6)
+
+
+def test_fsdp_state_survives_world_resize(hvd, problem):
+    """Same scale-up for FSDP: params AND state gather in the 4-rank
+    world and reshard into the 8-rank world."""
+    from jax.sharding import PartitionSpec as P
+
+    X, Y, params = problem
+    inner = optax.adamw(1e-2)
+    fs = hvd.FSDPOptimizer(inner, axis_name="z")
+    sspecs = fs.shard_specs(params)
+    stspecs = fs.state_specs(params)
+
+    def make_step(mesh):
+        def step(shards, st, xb, yb):
+            full = fs.gather_params(shards)
+            l, g = jax.value_and_grad(_loss)(full, xb, yb)
+            shards, st = fs.update(g, st, shards)
+            return shards, st, jax.lax.pmean(l, "z")
+
+        return jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(sspecs, stspecs, P("z"), P("z")),
+            out_specs=(sspecs, stspecs, P()), check_vma=False))
+
+    mesh4, mesh8 = _mk_mesh(4), _mk_mesh(8)
+
+    def setup_fn(p):
+        sh = fs.shard_params(p)
+        return sh, fs.init(sh)
+
+    setup4 = jax.jit(jax.shard_map(
+        setup_fn, mesh=mesh4, in_specs=(P(),),
+        out_specs=(sspecs, stspecs), check_vma=False))
+    gather4 = jax.jit(jax.shard_map(
+        lambda sh, st: (fs.gather_params(sh), fs.gather_state(st)),
+        mesh=mesh4, in_specs=(sspecs, stspecs),
+        out_specs=(P(), P()), check_vma=False))
+
+    def reshard_fn(pf, sf):
+        return fs.shard_params(pf), fs.reshard_state(sf)
+
+    reshard8 = jax.jit(jax.shard_map(
+        reshard_fn, mesh=mesh8, in_specs=(P(), P()),
+        out_specs=(sspecs, stspecs), check_vma=False))
+
+    shards, st = setup4(params)
+    step4 = make_step(mesh4)
+    for _ in range(2):
+        shards, st, _ = step4(shards, st, X, Y)
+    p_full, s_full = gather4(shards, st)
+
+    # Host hop between worlds (the checkpoint's journey).
+    p_full = jax.tree.map(np.asarray, p_full)
+    s_full = jax.tree.map(np.asarray, s_full)
+
+    shards8, st8 = reshard8(p_full, s_full)
+    step8 = make_step(mesh8)
+    for _ in range(2):
+        shards8, st8, _ = step8(shards8, st8, X, Y)
+
+    final8 = jax.jit(jax.shard_map(
+        lambda sh: (fs.gather_params(sh),), mesh=mesh8,
+        in_specs=(sspecs,), out_specs=(P(),), check_vma=False))
+    (final,) = final8(shards8)
+
+    ref = _ref_trajectory(inner, params, X, Y, 4)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(final[k].addressable_data(0)),
+            np.asarray(ref[k]), rtol=2e-4, atol=1e-6)
