@@ -273,7 +273,16 @@ Available Tensor Operations:
     [{mark(basics.ddl_built())}] DDL
     [{mark(basics.ccl_built())}] CCL
     [{mark(basics.mpi_built())}] MPI
-    [{mark(basics.gloo_built())}] Gloo"""
+    [{mark(basics.gloo_built())}] Gloo
+
+Available Parallelism Strategies (beyond the reference):
+    [X] DP (fused/hierarchical/Adasum/quantized-DCN allreduce)
+    [X] TP (Megatron column/row-parallel)
+    [X] PP (GPipe + interleaved 1F1B)
+    [X] SP (ring attention + Ulysses)
+    [X] EP (GShard top-2 MoE)
+    [X] ZeRO-1 (sharded optimizer state)
+    [X] FSDP/ZeRO-3 (fully-sharded parameters)"""
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
