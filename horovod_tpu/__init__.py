@@ -188,6 +188,21 @@ def allgather(x, name: Optional[str] = None, process_set=None):
     return _engine(process_set).allgather(x, name)
 
 
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set=None):
+    """Allgather every leaf of a list/dict (the later-Horovod grouped
+    surface): per-leaf dispatch (XLA's async dispatch pipelines the
+    copies; unlike allreduce there is no flat-buffer win to fuse, so
+    leaves stay separate executables). Unnamed calls pass None through
+    so each leaf gets the engine's unique auto-naming — a constant
+    default prefix would collide across distinct unnamed calls."""
+    e = _engine(process_set)
+    leaves, treedef = jax.tree.flatten(tensors)
+    outs = [e.allgather(v, f"{name}.{i}" if name else None)
+            for i, v in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, outs)
+
+
 def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
     """With ``process_set``, ``root_rank`` is the GLOBAL rank of the
@@ -212,6 +227,18 @@ def alltoall(x, name: Optional[str] = None, splits=None, process_set=None):
 def reducescatter(x, op: ReduceOp = ReduceOp.SUM,
                   name: Optional[str] = None, process_set=None):
     return _engine(process_set).reducescatter(x, op, name)
+
+
+def grouped_reducescatter(tensors, op: ReduceOp = ReduceOp.SUM,
+                          name: Optional[str] = None, process_set=None):
+    """Reducescatter every leaf of a list/dict (later-Horovod grouped
+    surface; per-leaf dispatch — same naming contract as
+    :func:`grouped_allgather`)."""
+    e = _engine(process_set)
+    leaves, treedef = jax.tree.flatten(tensors)
+    outs = [e.reducescatter(v, op, f"{name}.{i}" if name else None)
+            for i, v in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, outs)
 
 
 def barrier(process_set=None):
@@ -317,8 +344,9 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "is_homogeneous", "mesh",
     "hierarchical_mesh", "rank_axis", "scatter", "gather", "allreduce",
-    "grouped_allreduce", "allgather", "broadcast", "alltoall",
-    "reducescatter", "barrier", "join", "allreduce_async",
+    "grouped_allreduce", "allgather", "grouped_allgather", "broadcast",
+    "alltoall", "reducescatter", "grouped_reducescatter", "barrier",
+    "join", "allreduce_async",
     "allgather_async",
     "broadcast_async", "poll", "synchronize", "start_timeline",
     "stop_timeline", "spmd_step", "ReduceOp", "Average", "Sum", "Adasum",

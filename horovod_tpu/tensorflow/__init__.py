@@ -221,6 +221,45 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
 
 
 @_no_autograph
+def reducescatter(tensor, op: ReduceOp = Sum,
+                  name: Optional[str] = None, process_set=None):
+    """This rank's 1/n slice of the elementwise reduction over dim 0
+    (the later-Horovod TF surface; absent from the pinned era)."""
+    tf = _tf()
+    e = _engine(process_set)
+
+    def np_fn(arr):
+        out = _to_host(e.reducescatter(e.replicate(arr), op, name))
+        return out.astype(arr.dtype, copy=False)
+
+    n = _hvd._communicator_size(process_set)
+    out_shape = None
+    if tf.is_tensor(tensor) and tensor.shape.rank and \
+            tensor.shape[0] is not None:
+        out_shape = tf.TensorShape(
+            [tensor.shape[0] // n]).concatenate(tensor.shape[1:])
+    return _bridge(np_fn, tensor, out_shape)
+
+
+@_no_autograph
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set=None):
+    # name=None passes through per leaf: the engine auto-names each
+    # uniquely (a constant default prefix would collide across calls).
+    return [allgather(t, f"{name}.{i}" if name else None,
+                      process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+@_no_autograph
+def grouped_reducescatter(tensors, op: ReduceOp = Sum,
+                          name: Optional[str] = None, process_set=None):
+    return [reducescatter(t, op, f"{name}.{i}" if name else None,
+                          process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+@_no_autograph
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
     """With ``process_set``, ``root_rank`` is the GLOBAL rank of the

@@ -192,6 +192,33 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
             for i, t in enumerate(tensors)]
 
 
+def reducescatter(tensor: torch.Tensor, op: ReduceOp = Sum,
+                  name: Optional[str] = None,
+                  process_set=None) -> torch.Tensor:
+    """This rank's 1/n slice of the elementwise reduction over dim 0
+    (the later-Horovod torch surface; absent from the pinned era)."""
+    e = _engine(process_set)
+    out = _to_host(e.reducescatter(_replicated(tensor, process_set), op,
+                                   name))
+    return _np_to_tensor(out, tensor.dtype)
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set=None):
+    # name=None passes through per leaf: the engine auto-names each
+    # uniquely (a constant default prefix would collide across calls).
+    return [allgather(t, f"{name}.{i}" if name else None,
+                      process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_reducescatter(tensors, op: ReduceOp = Sum,
+                          name: Optional[str] = None, process_set=None):
+    return [reducescatter(t, op, f"{name}.{i}" if name else None,
+                          process_set=process_set)
+            for i, t in enumerate(tensors)]
+
+
 def grouped_allreduce_(tensors, op: ReduceOp = Average,
                        name: Optional[str] = None,
                        prescale_factor: float = 1.0,

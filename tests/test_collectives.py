@@ -289,3 +289,35 @@ def test_grouped_allreduce_pre_postscale(hvd):
     # 2*0.5 summed over 8 ranks = 8, then *2 = 16; 3*0.5*8*2 = 24.
     np.testing.assert_allclose(a, 16.0, rtol=1e-6)
     np.testing.assert_allclose(b, 24.0, rtol=1e-6)
+
+
+def test_grouped_allgather_core(hvd, rng):
+    tree = {"a": rng.standard_normal((8, 2, 3)).astype(np.float32),
+            "b": rng.standard_normal((8, 1, 4)).astype(np.float32)}
+    dts = {k: hvd.scatter(v) for k, v in tree.items()}
+    out = hvd.grouped_allgather(dts, name="gag")
+    for k, v in tree.items():
+        got = hvd.gather(out[k])[0]
+        np.testing.assert_allclose(
+            got, v.reshape((-1,) + v.shape[2:]), rtol=1e-6)
+
+
+def test_grouped_reducescatter_core(hvd, rng):
+    tree = [rng.standard_normal((8, 16, 2)).astype(np.float32)]
+    out = hvd.grouped_reducescatter([hvd.scatter(tree[0])], op=hvd.Sum,
+                                    name="grs")
+    total = tree[0].sum(axis=0)
+    got = hvd.gather(out[0])
+    for r in range(8):
+        np.testing.assert_allclose(got[r], total[r * 2:(r + 1) * 2],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_allgather_unnamed_no_collision(hvd, rng):
+    """Two distinct UNNAMED grouped calls must not collide on names —
+    each leaf rides the engine's unique auto-naming."""
+    a = hvd.scatter(rng.standard_normal((8, 2)).astype(np.float32))
+    b = hvd.scatter(rng.standard_normal((8, 2)).astype(np.float32))
+    out1 = hvd.grouped_allgather([a])
+    out2 = hvd.grouped_allgather([b])
+    assert hvd.gather(out1[0]).shape == hvd.gather(out2[0]).shape

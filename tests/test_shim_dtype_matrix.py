@@ -583,3 +583,56 @@ def test_tf_optimizer_predivide_process_set(hvd):
         np.testing.assert_allclose(v.numpy(), [-1.0, -3.0], rtol=1e-5)
     finally:
         hvd.remove_process_set(ps)
+
+
+# -- later-Horovod surface: reducescatter + grouped allgather/rs -------------
+
+def test_torch_reducescatter(hvd):
+    """Replicated input -> this rank's slice of the n-fold sum (the
+    single-controller shim reads rank 0's shard)."""
+    n = hvd.size()
+    t = torch.arange(n * 2, dtype=torch.float32).reshape(n * 2, 1)
+    out = hvdt.reducescatter(t, op=hvdt.Sum, name="mx_rs")
+    assert out.shape == (2, 1)
+    np.testing.assert_allclose(out.numpy(), t.numpy()[:2] * n)
+
+
+def test_torch_grouped_allgather(hvd):
+    n = hvd.size()
+    ts = [torch.ones(2, 3), torch.full((1, 2), 2.0)]
+    outs = hvdt.grouped_allgather(ts, name="mx_gag")
+    assert outs[0].shape == (2 * n, 3) and outs[1].shape == (n, 2)
+    np.testing.assert_allclose(outs[1].numpy(), np.full((n, 2), 2.0))
+
+
+def test_torch_grouped_reducescatter(hvd):
+    n = hvd.size()
+    ts = [torch.ones(n * 2, 1), torch.full((n, 3), 2.0)]
+    outs = hvdt.grouped_reducescatter(ts, op=hvdt.Sum, name="mx_grs")
+    np.testing.assert_allclose(outs[0].numpy(), np.full((2, 1), float(n)))
+    np.testing.assert_allclose(outs[1].numpy(),
+                               np.full((1, 3), 2.0 * n))
+
+
+def test_tf_reducescatter_graph_shape(hvd):
+    """Graph mode declares the sliced static shape (dim0 / n)."""
+    n = hvd.size()
+    t = tf.ones((n * 2, 3))
+
+    @tf.function
+    def g(x):
+        out = hvdtf.reducescatter(x, op=hvdtf.Sum, name="mxtf_rs")
+        tf.debugging.assert_equal(tf.shape(out)[0], 2)
+        return out
+
+    out = g(t)
+    assert tuple(out.shape) == (2, 3)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 3), float(n)))
+
+
+def test_tf_grouped_allgather(hvd):
+    n = hvd.size()
+    outs = hvdtf.grouped_allgather([tf.ones((2, 2)), tf.ones((1,))],
+                                   name="mxtf_gag")
+    assert tuple(outs[0].shape) == (2 * n, 2)
+    assert tuple(outs[1].shape) == (n,)
