@@ -28,7 +28,7 @@ Eager collectives operate on rank-major distributed tensors
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 

@@ -14,7 +14,7 @@ gradients averaged through the engine's collectives.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 

@@ -21,7 +21,6 @@ wraps ``jax.grad``/``jax.value_and_grad`` results with the same reduction.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax

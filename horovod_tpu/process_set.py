@@ -23,7 +23,7 @@ is the caller's responsibility, as it is in the reference.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 
 class ProcessSet:

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Iterator, Optional
+from typing import Any, Iterator
 
 
 class Store:
