@@ -192,6 +192,65 @@ def grouped_allreduce(tensors, op: ReduceOp = Average,
             for i, t in enumerate(tensors)]
 
 
+def sparse_allreduce_async(tensor: torch.Tensor,
+                           name: Optional[str] = None,
+                           op: ReduceOp = Average,
+                           process_set=None):
+    """Allreduce a torch SPARSE COO tensor (the later-Horovod
+    ``sparse_allreduce_async`` surface): values/indices ride the ragged
+    controller-negotiated allgather — the mathematical equivalent of
+    summing the sparse operands (the same sparse-as-allgather design as
+    the TF shim's IndexedSlices path) — with AVERAGE dividing the
+    gathered values by the communicator size. Returns a zero-arg
+    callable resolving to the reduced sparse tensor (the reference
+    returns a synchronize-style handle; a callable keeps the shim free
+    of sparse entries in the dense HandleManager)."""
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async needs a sparse COO "
+                         "tensor; use allreduce/allreduce_async for "
+                         "dense tensors")
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            "sparse allreduce supports Average/Sum")
+    import jax as _jax
+
+    if process_set is not None and _jax.process_count() > 1:
+        raise NotImplementedError(
+            "sparse allreduce over a process_set is not supported in "
+            "multi-process worlds (the set engine has no controller to "
+            "negotiate ragged row counts)")
+    t = tensor.coalesce()
+    e = _engine(process_set)
+    n = _hvd._communicator_size(process_set)
+    # _tensor_to_np handles the boundary (detach/cpu/bf16 bridge) like
+    # every dense collective here. COO indices are (ndim, nnz); gather
+    # along nnz -> transpose first.
+    vals = e.allgather_local(_tensor_to_np(t.values()),
+                             name=f"{name or 'sp'}.values")
+    idxs = e.allgather_local(_tensor_to_np(t.indices()).T,
+                             name=f"{name or 'sp'}.indices")
+
+    def handle() -> torch.Tensor:
+        arr = np.array(vals, copy=True)
+        if arr.dtype.kind not in "biufc":  # ml_dtypes bf16 bridge
+            arr = arr.astype(np.float32)
+        v = torch.from_numpy(arr)
+        if op == Average:
+            # Divide in float BEFORE the coalesce-sum (n copies of v/n
+            # re-sum to exactly v; integer division first would
+            # truncate each addend to zero), restore dtype after.
+            v = v.to(torch.float32) / n
+        idx = torch.from_numpy(
+            np.ascontiguousarray(np.array(idxs, copy=True).T))
+        out = torch.sparse_coo_tensor(
+            idx, v, size=tuple(tensor.shape)).coalesce()
+        return torch.sparse_coo_tensor(
+            out.indices(), out.values().to(tensor.dtype),
+            size=tuple(tensor.shape))
+
+    return handle
+
+
 def reducescatter(tensor: torch.Tensor, op: ReduceOp = Sum,
                   name: Optional[str] = None,
                   process_set=None) -> torch.Tensor:

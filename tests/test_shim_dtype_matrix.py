@@ -636,3 +636,46 @@ def test_tf_grouped_allgather(hvd):
                                    name="mxtf_gag")
     assert tuple(outs[0].shape) == (2 * n, 2)
     assert tuple(outs[1].shape) == (n,)
+
+
+# -- torch: sparse COO allreduce (later-Horovod surface) ---------------------
+
+def test_torch_sparse_allreduce(hvd):
+    """Sparse COO allreduce: gathered values average to the input under
+    identical ranks; duplicate coordinates sum through coalesce."""
+    n = hvd.size()
+    i = torch.tensor([[0, 2], [1, 0]])
+    v = torch.tensor([4.0, 8.0])
+    sp = torch.sparse_coo_tensor(i, v, (3, 2))
+    h = hvdt.sparse_allreduce_async(sp, name="mx_sp", op=hvdt.Sum)
+    out = h().to_dense()
+    expected = torch.zeros(3, 2)
+    expected[0, 1], expected[2, 0] = 4.0 * n, 8.0 * n
+    np.testing.assert_allclose(out.numpy(), expected.numpy())
+
+    # AVERAGE: n gathered copies each divided by n, coalesce-summed
+    # back to the input — identity under identical ranks.
+    h2 = hvdt.sparse_allreduce_async(sp, name="mx_sp2", op=hvdt.Average)
+    np.testing.assert_allclose(h2().to_dense().numpy(),
+                               sp.to_dense().numpy())
+
+
+def test_torch_sparse_allreduce_rejects_dense(hvd):
+    with pytest.raises(ValueError, match="sparse COO"):
+        hvdt.sparse_allreduce_async(torch.ones(3))
+
+
+@pytest.mark.parametrize("dtype", [torch.bfloat16, torch.int32,
+                                   torch.float32], ids=str)
+def test_torch_sparse_allreduce_dtypes(hvd, dtype):
+    """Output dtype == input dtype, incl. the bf16 boundary bridge and
+    int averages (identity under identical ranks)."""
+    i = torch.tensor([[1], [0]])
+    sp = torch.sparse_coo_tensor(i, torch.tensor([6]).to(dtype), (2, 2))
+    h = hvdt.sparse_allreduce_async(sp, name=f"mx_spd_{dtype}",
+                                    op=hvdt.Average)
+    out = h()
+    assert out.dtype == dtype
+    np.testing.assert_allclose(
+        out.to_dense().to(torch.float32).numpy(),
+        sp.to_dense().to(torch.float32).numpy())
