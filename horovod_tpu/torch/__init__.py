@@ -230,23 +230,29 @@ def sparse_allreduce_async(tensor: torch.Tensor,
     idxs = e.allgather_local(_tensor_to_np(t.indices()).T,
                              name=f"{name or 'sp'}.indices")
 
+    # Only shape/dtype survive into the closure — capturing the tensor
+    # itself would pin the full input gradient for the handle's life.
+    shape, dtype = tuple(tensor.shape), tensor.dtype
+
     def handle() -> torch.Tensor:
         arr = np.array(vals, copy=True)
         if arr.dtype.kind not in "biufc":  # ml_dtypes bf16 bridge
             arr = arr.astype(np.float32)
-        v = torch.from_numpy(arr)
-        if op == Average:
-            # Divide in float BEFORE the coalesce-sum (n copies of v/n
-            # re-sum to exactly v; integer division first would
-            # truncate each addend to zero), restore dtype after.
-            v = v.to(torch.float32) / n
         idx = torch.from_numpy(
             np.ascontiguousarray(np.array(idxs, copy=True).T))
+        # Coalesce-sum FIRST, in the gathered dtype (exact for ints and
+        # fp64), divide AFTER for Average — dividing before the sum
+        # accumulates n rounding errors (1/12 summed 12x = 0.99999988,
+        # truncating to 0 for ints).
         out = torch.sparse_coo_tensor(
-            idx, v, size=tuple(tensor.shape)).coalesce()
-        return torch.sparse_coo_tensor(
-            out.indices(), out.values().to(tensor.dtype),
-            size=tuple(tensor.shape))
+            idx, torch.from_numpy(arr), size=shape).coalesce()
+        ov = out.values()
+        if op == Average:
+            ov = ov.to(torch.float64) / n
+            if not dtype.is_floating_point:
+                ov = ov.round()
+        return torch.sparse_coo_tensor(out.indices(), ov.to(dtype),
+                                       size=shape)
 
     return handle
 
@@ -462,12 +468,13 @@ class _DistributedOptimizerMixin:
     def _dist_init(self, base_cls, named_parameters, op,
                    backward_passes_per_step, compression=None,
                    gradient_predivide_factor: float = 1.0,
-                   process_set=None):
+                   process_set=None, sparse_as_dense: bool = False):
         self._base_cls = base_cls
         self.op = op
         self._compression = compression
         self._predivide = gradient_predivide_factor
         self._process_set = process_set
+        self._sparse_as_dense = sparse_as_dense
         self.backward_passes_per_step = backward_passes_per_step
         self._handles = {}          # id(p) -> (p, handle-or-None)
         self._allreduce_delay = {}  # id(p) -> remaining local passes
@@ -493,6 +500,13 @@ class _DistributedOptimizerMixin:
             # (optimizer.py:107); a force-sync before any backward
             # contributes zeros.
             p.grad = torch.zeros_like(p)
+        if p.grad.is_sparse:
+            # Sparse embedding grads (Embedding(sparse=True)): densify
+            # (the knob was validated at hook entry, before the delay
+            # counter moved — a raise here would leave the counter
+            # at 0 and turn a retried backward into a bare assert).
+            self._check_sparse_grad(p)
+            p.grad = p.grad.to_dense()
         name = self._names.get(id(p), f"grad.{id(p)}")
         op, pre, post = self.op, 1.0, 1.0
         if self._predivide != 1.0:
@@ -507,8 +521,19 @@ class _DistributedOptimizerMixin:
                                compression=self._compression,
                                process_set=self._process_set)
 
+    def _check_sparse_grad(self, p: torch.Tensor) -> None:
+        if (p.grad is not None and p.grad.is_sparse
+                and not self._sparse_as_dense):
+            raise ValueError(
+                "DistributedOptimizer got a sparse gradient; pass "
+                "sparse_as_dense=True (densify + allreduce) or "
+                "reduce it yourself via sparse_allreduce_async")
+
     def _make_hook(self):
         def hook(p: torch.Tensor) -> None:
+            # Validate sparse grads BEFORE the delay counter moves so
+            # the informative error re-surfaces on a retried backward.
+            self._check_sparse_grad(p)
             # Reference torch/optimizer.py:134-149: count down the local
             # aggregation delay; the allreduce fires on the k-th backward
             # (p.grad accumulated the k local passes in the meantime).
@@ -617,7 +642,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          backward_passes_per_step: int = 1,
                          op: ReduceOp = Average,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None):
+                         process_set=None,
+                         sparse_as_dense: bool = False):
     """Returns an instance of a dynamic subclass of the USER's optimizer
     class with the mixin's step/synchronize grafted on — the reference's
     own architecture (torch/optimizer.py:381: ``cls = type(...,
@@ -663,7 +689,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     obj.__dict__.update(optimizer.__dict__)  # share param_groups + state
     obj._dist_init(optimizer.__class__, named_parameters, op,
                    backward_passes_per_step, compression,
-                   gradient_predivide_factor, process_set)
+                   gradient_predivide_factor, process_set,
+                   sparse_as_dense)
     return obj
 
 

@@ -679,3 +679,30 @@ def test_torch_sparse_allreduce_dtypes(hvd, dtype):
     np.testing.assert_allclose(
         out.to_dense().to(torch.float32).numpy(),
         sp.to_dense().to(torch.float32).numpy())
+
+
+def test_torch_optimizer_sparse_grads(hvd):
+    """Embedding(sparse=True) grads: typed error by default, densified
+    allreduce under sparse_as_dense=True (reference DistributedOptimizer
+    knob semantics)."""
+    emb = torch.nn.Embedding(4, 3, sparse=True)
+    opt = hvdt.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.5),
+        named_parameters=emb.named_parameters())
+    # The grad hook launches the reduction during backward — that is
+    # where the typed error surfaces.
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        emb(torch.tensor([1, 2])).sum().backward()
+
+    emb2 = torch.nn.Embedding(4, 3, sparse=True)
+    with torch.no_grad():
+        emb2.weight.fill_(1.0)
+    opt2 = hvdt.DistributedOptimizer(
+        torch.optim.SGD(emb2.parameters(), lr=0.5),
+        named_parameters=emb2.named_parameters(),
+        sparse_as_dense=True)
+    emb2(torch.tensor([1])).sum().backward()
+    opt2.step()
+    w = emb2.weight.detach()
+    np.testing.assert_allclose(w[1].numpy(), np.full(3, 0.5))  # 1 - 0.5*1
+    np.testing.assert_allclose(w[0].numpy(), np.ones(3))       # untouched
