@@ -174,10 +174,39 @@ def run_job(name, argv, timeout_s):
     return payload
 
 
+# profile job -> (trace dir, analyzer summary filename): the summary
+# feeds perf_evidence.py's device-basis scaling rows.
+PROFILE_TRACES = {
+    "resnet50_profile": ("trace_resnet50", "trace_summary.json"),
+    "bert_profile": ("trace_bert", "trace_bert_summary.json"),
+}
+
+
+def _summarize_trace(job_name):
+    trace_dir, summary = PROFILE_TRACES.get(job_name, (None, None))
+    if trace_dir is None:
+        return
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "analyze_trace.py"),
+             os.path.join(OUTDIR, trace_dir)],
+            capture_output=True, text=True, timeout=300)
+        if proc.returncode == 0:
+            with open(os.path.join(OUTDIR, summary), "w") as f:
+                f.write(proc.stdout)
+            _log(f"job {job_name}: trace summarized -> {summary}")
+        else:
+            _log(f"job {job_name}: trace analysis rc={proc.returncode}")
+    except Exception as e:  # noqa: BLE001 — post-processing only
+        _log(f"job {job_name}: trace analysis failed ({e})")
+
+
 def write_result(name, payload):
     os.makedirs(OUTDIR, exist_ok=True)
     with open(os.path.join(OUTDIR, f"{name}.json"), "w") as f:
         json.dump(payload, f, indent=2)
+    _summarize_trace(name)
     combined = {}
     for n, _, _ in JOBS:
         p = os.path.join(OUTDIR, f"{n}.json")
