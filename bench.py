@@ -159,7 +159,8 @@ def main():
                         "iterations into this directory (MFU "
                         "diagnosis; ~100MB per run)")
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101", "vgg16", "inception3",
+                   choices=["resnet50", "resnet101", "resnet152",
+                            "vgg16", "vgg19", "inception3",
                             "vit_base", "bert_large", "bert_base",
                             "gpt_small", "gpt_medium"])
     p.add_argument("--remat", action="store_true",
@@ -410,10 +411,11 @@ def _setup_cnn(args, batch_size, n):
 
     import horovod_tpu as hvd
     from horovod_tpu.models import (InceptionV3, ResNet50, ResNet101,
-                                    VGG16, vit_base)
+                                    ResNet152, VGG16, VGG19, vit_base)
 
     model = {"resnet50": ResNet50, "resnet101": ResNet101,
-             "vgg16": VGG16, "inception3": InceptionV3,
+             "resnet152": ResNet152, "vgg16": VGG16, "vgg19": VGG19,
+             "inception3": InceptionV3,
              "vit_base": vit_base}[args.model](num_classes=1000)
     image_size = args.image_size or (
         299 if args.model == "inception3" else 224)
