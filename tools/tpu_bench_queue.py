@@ -36,10 +36,14 @@ PROBE_SLEEP = 420          # between failed probes
 LEASE_COOLDOWN = 150       # after a killed TPU child, let the lease expire
 MAX_FAILS_PER_JOB = 3
 
+# Ordered by ROUND VALUE, not model family: if the backend serves only
+# a short window, the first jobs eat it — so the matrix-completing
+# model rows (GPT/ViT/Inception — the >=3-families-with-MFU bar) and
+# the kernel/overlap microbenches come before tuned-batch extras.
+# (name, argv tail, timeout_s). Model benches use the worker entry
+# directly (no supervisor) so a down backend costs ONE timeout and
+# never silently records a CPU-fallback number.
 JOBS = [
-    # (name, argv tail, timeout_s). Model benches use the worker entry
-    # directly (no supervisor) so a down backend costs ONE timeout and
-    # never silently records a CPU-fallback number.
     ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "resnet50"], 1200),
     # MFU diagnosis (VERDICT r2 #2): batch 256 per the reference CNN
@@ -48,33 +52,14 @@ JOBS = [
     ("resnet50_b256", ["bench.py", "--_worker", "--_platform=tpu",
                        "--model", "resnet50", "--batch-size", "256"],
      1500),
-    ("resnet50_b512", ["bench.py", "--_worker", "--_platform=tpu",
-                       "--model", "resnet50", "--batch-size", "512"],
-     1500),
     ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
                           "--model", "resnet50", "--batch-size", "256",
                           "--num-iters", "3", "--profile-dir",
                           "results/tpu_r03/trace_resnet50"], 1500),
     ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
                     "--model", "bert_large"], 1200),
-    # Tuned-batch leg: b8 is the reference config's per-worker batch;
-    # b32 amortizes layernorm/host overheads over 4x the MXU rows (the
-    # number a throughput-tuned TPU user would run).
-    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
-                        "--model", "bert_large", "--batch-size", "32"],
-     1500),
-    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
-                      "--model", "bert_large", "--num-iters", "3",
-                      "--profile-dir", "results/tpu_r03/trace_bert"],
-     1200),
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
-    # Long-context leg: the flash-attention decode path at 4x the
-    # default sequence length (the capability SURVEY §5 makes
-    # first-class).
-    ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
-                "--model", "gpt_small", "--seq-len", "2048",
-                "--batch-size", "4"], 1500),
     # Batch pinned explicitly: the CNN default moved to 256 (measured
     # better for resnet50 only); first captures for these stay at the
     # b128 config the earlier legs used — deliberate, comparable.
@@ -86,6 +71,25 @@ JOBS = [
     ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
     ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
     ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
+    # Long-context leg: the flash-attention decode path at 4x the
+    # default sequence length (the capability SURVEY §5 makes
+    # first-class).
+    ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
+                "--model", "gpt_small", "--seq-len", "2048",
+                "--batch-size", "4"], 1500),
+    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                      "--model", "bert_large", "--num-iters", "3",
+                      "--profile-dir", "results/tpu_r03/trace_bert"],
+     1200),
+    # Tuned-batch legs: b8 is the reference config's per-worker batch;
+    # b32 amortizes layernorm/host overheads over 4x the MXU rows (the
+    # number a throughput-tuned TPU user would run).
+    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "bert_large", "--batch-size", "32"],
+     1500),
+    ("resnet50_b512", ["bench.py", "--_worker", "--_platform=tpu",
+                       "--model", "resnet50", "--batch-size", "512"],
+     1500),
 ]
 
 
