@@ -1,0 +1,89 @@
+"""Seeded randomized sweep of the eager collectives against a numpy
+oracle — deterministic (fixed seeds), broad (random shapes x dtypes x
+ops x scale factors), the property-based complement to the fixed
+matrix in test_collectives/test_shim_dtype_matrix."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+DTYPES = [np.float32, np.float16, np.int32]
+OPS = ["sum", "avg", "min", "max"]
+
+
+def _rand(rng, shape, dtype):
+    if np.issubdtype(dtype, np.integer):
+        return rng.integers(-20, 20, size=shape).astype(dtype)
+    return (rng.standard_normal(shape) * 4).astype(dtype)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_allreduce(hvd, seed):
+    rng = np.random.default_rng(1000 + seed)
+    ndim = int(rng.integers(1, 4))
+    shape = (8,) + tuple(int(rng.integers(1, 9)) for _ in range(ndim))
+    dtype = DTYPES[seed % len(DTYPES)]
+    opname = OPS[seed % len(OPS)]
+    op = {"sum": hvd.Sum, "avg": hvd.Average, "min": hvd.Min,
+          "max": hvd.Max}[opname]
+    if opname == "avg" and np.issubdtype(dtype, np.integer):
+        pytest.skip("int average: covered by the fixed identity tests")
+    x = _rand(rng, shape, dtype)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=op,
+                                   name=f"fz_{seed}"))
+    oracle = {"sum": lambda v: v.sum(0), "avg": lambda v: v.mean(0),
+              "min": lambda v: v.min(0), "max": lambda v: v.max(0)}
+    want = oracle[opname](x.astype(np.float64)).astype(np.float64)
+    tol = 2e-2 if dtype == np.float16 else 2e-5
+    for r in range(8):
+        np.testing.assert_allclose(out[r].astype(np.float64), want,
+                                   rtol=tol, atol=tol * 10)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_allreduce_scaled(hvd, seed):
+    rng = np.random.default_rng(2000 + seed)
+    shape = (8, int(rng.integers(1, 33)))
+    pre = float(rng.uniform(0.25, 2.0))
+    post = float(rng.uniform(0.25, 2.0))
+    x = _rand(rng, shape, np.float32)
+    out = hvd.gather(hvd.allreduce(hvd.scatter(x), op=hvd.Sum,
+                                   prescale_factor=pre,
+                                   postscale_factor=post,
+                                   name=f"fzs_{seed}"))
+    want = (x.astype(np.float64) * pre).sum(0) * post
+    np.testing.assert_allclose(out[0].astype(np.float64), want,
+                               rtol=3e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_other_collectives(hvd, seed):
+    rng = np.random.default_rng(3000 + seed)
+    cols = int(rng.integers(1, 7))
+    rows = int(rng.integers(1, 5))
+    x = _rand(rng, (8, rows, cols), np.float32)
+    which = seed % 3
+    if which == 0:
+        out = hvd.gather(hvd.allgather(hvd.scatter(x),
+                                       name=f"fza_{seed}"))
+        want = x.reshape(8 * rows, cols)
+        for r in range(8):
+            np.testing.assert_allclose(out[r], want, rtol=1e-6)
+    elif which == 1:
+        root = int(rng.integers(0, 8))
+        out = hvd.gather(hvd.broadcast(hvd.scatter(x), root_rank=root,
+                                       name=f"fzb_{seed}"))
+        for r in range(8):
+            np.testing.assert_allclose(out[r], x[root], rtol=1e-6)
+    else:
+        rows8 = int(rng.integers(1, 4)) * 8  # divisible for the scatter
+        y = _rand(rng, (8, rows8, cols), np.float32)
+        out = hvd.gather(hvd.reducescatter(hvd.scatter(y), op=hvd.Sum,
+                                           name=f"fzr_{seed}"))
+        total = y.astype(np.float64).sum(0)
+        k = rows8 // 8
+        for r in range(8):
+            np.testing.assert_allclose(out[r].astype(np.float64),
+                                       total[r * k:(r + 1) * k],
+                                       rtol=2e-5, atol=1e-4)
