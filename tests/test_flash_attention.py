@@ -111,3 +111,45 @@ def test_fallback_off_tpu_and_odd_seq(rng):
     ref = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(6))
+def test_flash_fuzz_matches_reference(seed):
+    """Seeded random (B,S,H,D) x causal x mask configs: kernel fwd AND
+    grads track the jnp reference (interpret mode)."""
+    rng = np.random.default_rng(4000 + seed)
+    B = int(rng.integers(1, 3))
+    S = int(rng.choice([16, 24, 32]))
+    H = int(rng.integers(1, 4))
+    D = int(rng.choice([8, 16]))
+    causal = bool(seed % 2)
+    key = jax.random.PRNGKey(seed)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (B, S, H, D), dtype=jnp.float32)
+               for i in range(3))
+    mask = None
+    if seed % 3 == 0:
+        mask = (rng.random((B, S)) > 0.3).astype(np.float32)
+        mask[:, 0] = 1.0  # at least one attendable key per batch
+        mask = jnp.asarray(mask)
+
+    def flash_loss(q, k, v):
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               use_pallas=True, block_q=8, block_k=8
+                               ).astype(jnp.float32).sum()
+
+    def ref_loss(q, k, v):
+        return reference_attention(q, k, v, mask=mask, causal=causal
+                                   ).astype(jnp.float32).sum()
+
+    got = flash_attention(q, k, v, mask=mask, causal=causal,
+                          use_pallas=True, block_q=8, block_k=8)
+    want = reference_attention(q, k, v, mask=mask, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
