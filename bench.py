@@ -77,8 +77,11 @@ def _cached_tpu_record(argv, model):
                     and not (a == "--model" or a.startswith("--model="))]
     if config_flags:
         return None
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results", "tpu_r03", f"{model}.json")
+    here = os.path.dirname(os.path.abspath(__file__))
+    for rdir in ("tpu_r04", "tpu_r03"):
+        path = os.path.join(here, "results", rdir, f"{model}.json")
+        if os.path.exists(path):
+            break
     try:
         with open(path) as f:
             payload = json.load(f)
@@ -166,6 +169,15 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="per-layer activation recomputation on the GPT "
                         "models (long-context HBM relief)")
+    p.add_argument("--no-s2d", action="store_true",
+                   help="disable the space-to-depth ResNet stem "
+                        "(measures the lever's value; default stem is "
+                        "the MLPerf-style s2d form)")
+    p.add_argument("--sync-per-iter", action="store_true",
+                   help="legacy timing: force a host fetch of the loss "
+                        "every batches-per-iter batches instead of once "
+                        "at window end (serializes host and device; "
+                        "r03 measured it as a 14%% wall tax)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -235,11 +247,14 @@ def _run_benchmark(args, n):
     batch_size = args.batch_size or (8 if (is_bert or is_gpt) else 256)
 
     if is_bert:
-        run_batch, unit, baseline = _setup_bert(args, batch_size, n)
+        run_batch, unit, baseline, model_flops = _setup_bert(
+            args, batch_size, n)
     elif is_gpt:
-        run_batch, unit, baseline = _setup_gpt(args, batch_size, n)
+        run_batch, unit, baseline, model_flops = _setup_gpt(
+            args, batch_size, n)
     else:
-        run_batch, unit, baseline = _setup_cnn(args, batch_size, n)
+        run_batch, unit, baseline, model_flops = _setup_cnn(
+            args, batch_size, n)
 
     # Warmup (includes compile). Completion is forced with a HOST FETCH of
     # the loss scalar, not block_until_ready(): device_get must return real
@@ -264,15 +279,34 @@ def _run_benchmark(args, n):
         except Exception as e:  # noqa: BLE001 — diagnostics only
             _log(f"profiler unavailable: {e}")
 
-    rates = []
+    total_batches = args.num_iters * args.batches_per_iter
     try:
-        for _ in range(args.num_iters):
+        if args.sync_per_iter:
+            # Legacy mode: one host fetch per iteration group. Serializes
+            # host and device — r03's profiled run measured the wall rate
+            # at 86% of the device rate under this loop (VERDICT r3 #3).
+            rates = []
+            for _ in range(args.num_iters):
+                t0 = time.perf_counter()
+                for _ in range(args.batches_per_iter):
+                    l = run_batch()
+                force(l)
+                rates.append(batch_size * args.batches_per_iter
+                             / (time.perf_counter() - t0))
+            val = float(np.mean(rates)) / n
+            window_s = None
+        else:
+            # Steady-state window: dispatch every step async, force ONE
+            # fetch at the end. Each step's donated state feeds the next,
+            # so the final loss fetch cannot complete before the whole
+            # chain has executed — same completion guarantee as the
+            # per-iter fetch, none of the per-dispatch serialization.
             t0 = time.perf_counter()
-            for _ in range(args.batches_per_iter):
+            for _ in range(total_batches):
                 l = run_batch()
             force(l)
-            dt = time.perf_counter() - t0
-            rates.append(batch_size * args.batches_per_iter / dt)
+            window_s = time.perf_counter() - t0
+            val = batch_size * total_batches / window_s / n
     finally:
         # A mid-iteration failure (the flaky-backend case this tooling
         # exists for) must still flush the trace.
@@ -282,7 +316,6 @@ def _run_benchmark(args, n):
 
     # batch_size is the GLOBAL batch (sharded over n chips in spmd mode);
     # the metric is per-chip, so divide the measured global rate by n.
-    val = float(np.mean(rates)) / n
     result = {
         "metric": f"{args.model}_"
                   f"{'samples' if (is_bert or is_gpt) else 'images'}"
@@ -291,19 +324,51 @@ def _run_benchmark(args, n):
         "unit": "samples/s" if (is_bert or is_gpt) else "img/s",
         "vs_baseline": round(val / baseline, 3),
     }
-    flops = _step_flops(n)
-    if flops:
-        # MFU against the chip's peak (bf16); evidence the number is
-        # physically plausible, not a timing artifact.
-        peak = _peak_flops()
-        result["step_tflop"] = round(flops / 1e12, 3)
+    # Mandatory config record (VERDICT r3 weak #7): every number
+    # carries the exact configuration that produced it, so records
+    # from different rounds/batches can never be silently compared.
+    image_size = None if (is_bert or is_gpt) else (
+        args.image_size or (299 if args.model == "inception3" else 224))
+    config = {
+        "model": args.model,
+        "global_batch": batch_size,
+        "n_chips": n,
+        "seq_len": args.seq_len if (is_bert or is_gpt) else None,
+        "image_size": image_size,
+        "s2d_stem": (not args.no_s2d)
+        if args.model.startswith("resnet") else None,
+        "timing": "per_iter_sync" if args.sync_per_iter
+        else "window_single_fetch",
+        "steps_timed": total_batches,
+        "remat": bool(args.remat) if is_gpt else None,
+    }
+    result["config"] = config
+    result["config_note"] = (
+        f"{config['model']} gb={config['global_batch']} "
+        f"n={config['n_chips']} "
+        + (f"S={config['seq_len']}" if (is_bert or is_gpt)
+           else f"px={config['image_size']}"))
+    if window_s is not None:
+        result["window_s"] = round(window_s, 3)
+
+    peak = _peak_flops()
+    exec_flops = _step_flops(n)
+    if exec_flops:
+        # Executable basis: XLA cost analysis of the compiled step —
+        # counts everything the program actually does (BN stats,
+        # transposes, optimizer). Evidence the rate is physically
+        # plausible, NOT comparable to published model-MFU numbers.
+        result["step_tflop"] = round(exec_flops / 1e12, 3)
         if peak:
-            # flops is the GLOBAL step program (lowering precedes SPMD
-            # partitioning), so the denominator is the n-chip aggregate
-            # peak: (global steps/s × global flops) / (n × per-chip peak)
-            # — the n cancels against the per-chip rate.
-            mfu = (val / batch_size) * flops / peak
-            result["mfu_pct"] = round(100.0 * mfu, 1)
+            mfu = (val / batch_size) * exec_flops / peak
+            result["mfu_exec_pct"] = round(100.0 * mfu, 1)
+    if model_flops and peak:
+        # Model basis: analytic textbook FLOPs (3x fwd for CNNs;
+        # 6*P*S + 12*L*S^2*d for transformers) — THE number to compare
+        # against published MFU figures (VERDICT r3 #2).
+        result["model_flops_per_sample_g"] = round(model_flops / 1e9, 2)
+        result["mfu_model_pct"] = round(100.0 * val * model_flops / peak,
+                                        1)
     return result
 
 
@@ -404,6 +469,35 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
     return run_batch
 
 
+_CNN_FWD_GFLOPS = {
+    # Analytic forward GFLOPs per image at native resolution (textbook
+    # numbers; training = 3x forward). The model-basis MFU denominator.
+    "resnet50": (4.1, 224), "resnet101": (7.8, 224),
+    "resnet152": (11.5, 224), "vgg16": (15.5, 224),
+    "vgg19": (19.6, 224), "inception3": (5.73, 299),
+    "vit_base": (17.6, 224),
+}
+
+
+def _cnn_model_flops(model, image_size):
+    fwd_g, native = _CNN_FWD_GFLOPS.get(model, (None, None))
+    if fwd_g is None:
+        return None
+    return 3.0 * fwd_g * 1e9 * (image_size / native) ** 2
+
+
+def _transformer_model_flops(params, num_layers, hidden, seq_len):
+    """Per-sample training FLOPs, standard accounting: 6*P per token for
+    the parameter matmuls (the tied LM head counts P_emb once, the
+    embedding lookup is free — they cancel) + 12*L*S^2*d for the
+    attention score/value matmuls (fwd 4*L*S^2*d, x3 for training)."""
+    import jax
+
+    p_total = sum(int(np.prod(v.shape)) for v in jax.tree.leaves(params))
+    return (6.0 * p_total * seq_len
+            + 12.0 * num_layers * seq_len * seq_len * hidden)
+
+
 def _setup_cnn(args, batch_size, n):
     import jax
     import jax.numpy as jnp
@@ -413,10 +507,13 @@ def _setup_cnn(args, batch_size, n):
     from horovod_tpu.models import (InceptionV3, ResNet50, ResNet101,
                                     ResNet152, VGG16, VGG19, vit_base)
 
+    kw = {"num_classes": 1000}
+    if args.model.startswith("resnet"):
+        kw["space_to_depth"] = not args.no_s2d
     model = {"resnet50": ResNet50, "resnet101": ResNet101,
              "resnet152": ResNet152, "vgg16": VGG16, "vgg19": VGG19,
              "inception3": InceptionV3,
-             "vit_base": vit_base}[args.model](num_classes=1000)
+             "vit_base": vit_base}[args.model](**kw)
     image_size = args.image_size or (
         299 if args.model == "inception3" else 224)
     rng = jax.random.PRNGKey(0)
@@ -460,7 +557,8 @@ def _setup_cnn(args, batch_size, n):
 
     run = _make_stepper(apply_loss, (params, batch_stats, opt_state),
                         n, (images, labels))
-    return run, "img/s", CNN_BASELINE_PER_DEVICE
+    return (run, "img/s", CNN_BASELINE_PER_DEVICE,
+            _cnn_model_flops(args.model, image_size))
 
 
 def _setup_bert(args, batch_size, n):
@@ -483,8 +581,12 @@ def _setup_bert(args, batch_size, n):
     labels = tokens  # predict the original token at masked positions
 
     params = model.init(rng, tokens)["params"]
-    tx = hvd.DistributedOptimizer(optax.adamw(1e-4),
-                                  axis_name=hvd.rank_axis())
+    # bf16 first moment: halves the Adam mu HBM traffic per step (the
+    # "bf16-dominant optimizer path" lever; nu stays fp32 — optax only
+    # exposes mu_dtype, and the second moment is scale-sensitive).
+    tx = hvd.DistributedOptimizer(
+        optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
+        axis_name=hvd.rank_axis())
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -507,7 +609,9 @@ def _setup_bert(args, batch_size, n):
 
     run = _make_stepper(apply_loss, (params, opt_state), n,
                         (tokens, mask_positions.astype(jnp.float32), labels))
-    return run, "samples/s", BERT_BASELINE_PER_DEVICE
+    return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
+            _transformer_model_flops(params, model.num_layers,
+                                     model.hidden_size, args.seq_len))
 
 
 def _setup_gpt(args, batch_size, n):
@@ -529,8 +633,11 @@ def _setup_gpt(args, batch_size, n):
                                 model.vocab_size)
 
     params = model.init(rng, tokens[:, :-1])["params"]
-    tx = hvd.DistributedOptimizer(optax.adamw(1e-4),
-                                  axis_name=hvd.rank_axis())
+    import jax.numpy as jnp
+
+    tx = hvd.DistributedOptimizer(
+        optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
+        axis_name=hvd.rank_axis())
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -550,7 +657,9 @@ def _setup_gpt(args, batch_size, n):
         return p, st, l
 
     run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,))
-    return run, "samples/s", BERT_BASELINE_PER_DEVICE
+    return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
+            _transformer_model_flops(params, model.num_layers,
+                                     model.hidden, args.seq_len))
 
 
 if __name__ == "__main__":

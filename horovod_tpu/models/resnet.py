@@ -50,6 +50,16 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # MLPerf-style TPU stem: space-to-depth(2) the image and replace the
+    # 7x7/stride-2 conv with an equivalent-stride 4x4/stride-1 conv over
+    # 4x the input channels. A 3-channel 7x7 conv wastes the MXU (the
+    # contraction dim 7*7*3 tiles terribly); the 4*4*12 form covers an
+    # 8x8 receptive field in original pixels (a superset of 7x7) at the
+    # same output shape. Requires even H, W. Opt-in: it changes the
+    # conv_init param shape ((7,7,3,F) -> (4,4,12,F)), so checkpoints
+    # do not transfer across the toggle — bench.py turns it on for the
+    # benchmark configs (--no-s2d reverts).
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -61,8 +71,20 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.space_to_depth:
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth stem requires even H, W; got "
+                    f"{h}x{w} (pass space_to_depth=False for odd sizes)")
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(1, 2), (1, 2)], name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -29,7 +29,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUTDIR = os.path.join(REPO, "results", "tpu_r03")
+OUTDIR = os.path.join(REPO, "results", "tpu_r04")
 
 PROBE_TIMEOUT = 90
 PROBE_SLEEP = 420          # between failed probes
@@ -44,25 +44,15 @@ MAX_FAILS_PER_JOB = 3
 # directly (no supervisor) so a down backend costs ONE timeout and
 # never silently records a CPU-fallback number.
 JOBS = [
-    ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
-                  "--model", "resnet50"], 1200),
-    # MFU diagnosis (VERDICT r2 #2): batch 256 per the reference CNN
-    # benchmark's large-batch configuration, plus a profiled run whose
-    # trace feeds the input-feed-vs-compute analysis.
-    ("resnet50_b256", ["bench.py", "--_worker", "--_platform=tpu",
-                       "--model", "resnet50", "--batch-size", "256"],
-     1500),
-    ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
-                          "--model", "resnet50", "--batch-size", "256",
-                          "--num-iters", "3", "--profile-dir",
-                          "results/tpu_r03/trace_resnet50"], 1500),
-    ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
-                    "--model", "bert_large"], 1200),
+    # VERDICT r3 #1's priority: the GPT/flash causal path has NEVER run
+    # on real TPU — converting that unknown into a number outranks
+    # everything else, then the rest of the model matrix, then the
+    # microbenches, then tuned-batch + profile legs.
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
-    # Batch pinned explicitly: the CNN default moved to 256 (measured
-    # better for resnet50 only); first captures for these stay at the
-    # b128 config the earlier legs used — deliberate, comparable.
+    ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
+                "--model", "gpt_small", "--seq-len", "2048",
+                "--batch-size", "4"], 1500),
     ("vit_base", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "vit_base", "--batch-size", "128"], 1200),
     ("inception3", ["bench.py", "--_worker", "--_platform=tpu",
@@ -71,25 +61,36 @@ JOBS = [
     ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
     ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
     ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
-    # Long-context leg: the flash-attention decode path at 4x the
-    # default sequence length (the capability SURVEY §5 makes
-    # first-class).
-    ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
-                "--model", "gpt_small", "--seq-len", "2048",
-                "--batch-size", "4"], 1500),
-    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
-                      "--model", "bert_large", "--num-iters", "3",
-                      "--profile-dir", "results/tpu_r03/trace_bert"],
-     1200),
-    # Tuned-batch legs: b8 is the reference config's per-worker batch;
-    # b32 amortizes layernorm/host overheads over 4x the MXU rows (the
-    # number a throughput-tuned TPU user would run).
-    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
-                        "--model", "bert_large", "--batch-size", "32"],
-     1500),
+    # r04 configs carry the new levers: s2d stem (CNN default), bf16
+    # Adam mu, single-fetch window timing. The nos2d leg isolates the
+    # stem lever on an otherwise identical config.
+    ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
+                  "--model", "resnet50", "--batch-size", "256"], 1500),
     ("resnet50_b512", ["bench.py", "--_worker", "--_platform=tpu",
                        "--model", "resnet50", "--batch-size", "512"],
      1500),
+    ("resnet50_nos2d", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "resnet50", "--batch-size", "256",
+                        "--no-s2d"], 1500),
+    ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
+                    "--model", "bert_large"], 1200),
+    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "bert_large", "--batch-size", "32"],
+     1500),
+    # Profiled runs: device-vs-wall gap (the r03 14% host tax — the
+    # window timing fix should close it to <5%) + device-basis scaling.
+    ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                          "--model", "resnet50", "--batch-size", "256",
+                          "--num-iters", "3", "--profile-dir",
+                          "results/tpu_r04/trace_resnet50"], 1500),
+    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                      "--model", "bert_large", "--num-iters", "3",
+                      "--profile-dir", "results/tpu_r04/trace_bert"],
+     1200),
+    # Elastic reset under fire (VERDICT r3 #6): train → SIGKILL →
+    # lease cooldown → orbax restore + persistent-compile-cache warm
+    # start, all on the real chip.
+    ("elastic_reset", ["tools/tpu_elastic_reset.py"], 1800),
 ]
 
 
