@@ -224,12 +224,16 @@ def alltoall(x, name: Optional[str] = None, splits=None, process_set=None):
     return _engine(process_set).alltoall(x, name, splits=splits)
 
 
-def reducescatter(x, op: ReduceOp = ReduceOp.SUM,
+def reducescatter(x, op: ReduceOp = ReduceOp.AVERAGE,
                   name: Optional[str] = None, process_set=None):
+    """This rank's 1/n slice of the elementwise reduction over dim 0.
+    Default op is AVERAGE on every surface (core + torch + TF),
+    matching upstream's reducescatter default — pass op=Sum for the
+    unscaled reduction."""
     return _engine(process_set).reducescatter(x, op, name)
 
 
-def grouped_reducescatter(tensors, op: ReduceOp = ReduceOp.SUM,
+def grouped_reducescatter(tensors, op: ReduceOp = ReduceOp.AVERAGE,
                           name: Optional[str] = None, process_set=None):
     """Reducescatter every leaf of a list/dict (later-Horovod grouped
     surface; per-leaf dispatch — same naming contract as

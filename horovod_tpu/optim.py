@@ -613,14 +613,37 @@ class FSDPOptimizer:
             fusion_threshold_bytes)
         self._plan = None
         self._flat_lens = None
+        self._sig = None
 
     def bind(self, params_template):
         """Pin the bucket plan from a params pytree (real arrays or
         ShapeDtypeStructs). Called implicitly by shard_params; explicit
-        bind() lets gather/update trace in a separate jit region."""
+        bind() lets gather/update trace in a separate jit region.
+
+        The instance is stateful: the first bind pins the tree
+        structure, and a later bind with a STRUCTURALLY DIFFERENT
+        template raises — silently replacing the plan would misalign
+        any shards already produced under the old one. Use unbind() (or
+        a fresh instance) to retarget deliberately."""
+        sig = (str(jax.tree.structure(params_template)),
+               tuple((tuple(x.shape), str(x.dtype))
+                     for x in jax.tree.leaves(params_template)))
+        if self._sig is not None and sig != self._sig:
+            raise ValueError(
+                "FSDPOptimizer is already bound to a different param "
+                "tree (structure or leaf shapes changed); shards from "
+                "the old plan would silently misalign. Use a fresh "
+                "FSDPOptimizer per param tree, or call unbind() first")
+        self._sig = sig
         self._plan = fusion_lib.plan_fusion(params_template,
                                             self.fusion_threshold_bytes)
         self._flat_lens = [b.total_elems for b in self._plan.buckets]
+        return self
+
+    def unbind(self):
+        """Drop the bound plan so the instance can be re-bound to a new
+        param tree (any shards/state from the old plan become invalid)."""
+        self._plan = self._flat_lens = self._sig = None
         return self
 
     def _require_bound(self, what: str):
@@ -628,6 +651,13 @@ class FSDPOptimizer:
             raise ValueError(
                 f"{what} needs the bucket plan — call shard_params "
                 f"(or bind(params_template)) first")
+
+    def _check_shards(self, shards, what: str):
+        if len(shards) != len(self._flat_lens):
+            raise ValueError(
+                f"{what}: got {len(shards)} bucket shards but the bound "
+                f"plan has {len(self._flat_lens)} buckets — these shards "
+                f"come from a different plan/template")
 
     def shard_params(self, params):
         """Full params -> list of this rank's 1/n bucket shards."""
@@ -640,6 +670,7 @@ class FSDPOptimizer:
         """Bucket shards -> full params pytree (one all-gather per
         bucket; padding from the shard split sliced back off)."""
         self._require_bound("gather_params")
+        self._check_shards(shards, "gather_params")
         _require_axis(self.axis_name, "FSDPOptimizer.gather_params")
         flats = [C.allgather(s, self.axis_name)[:length]
                  for s, length in zip(shards, self._flat_lens)]
@@ -652,6 +683,7 @@ class FSDPOptimizer:
         """RS(full grads) -> inner update on this rank's shards ->
         apply. Returns (new_shards, new_state)."""
         self._require_bound("update")
+        self._check_shards(shards, "update")
         _require_axis(self.axis_name, "FSDPOptimizer.update")
         n = jax.lax.axis_size(self.axis_name)
         g_flats = fusion_lib.fuse(grads, self._plan)

@@ -221,10 +221,12 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
 
 
 @_no_autograph
-def reducescatter(tensor, op: ReduceOp = Sum,
+def reducescatter(tensor, op: ReduceOp = Average,
                   name: Optional[str] = None, process_set=None):
     """This rank's 1/n slice of the elementwise reduction over dim 0
-    (the later-Horovod TF surface; absent from the pinned era)."""
+    (the later-Horovod TF surface; absent from the pinned era). The
+    default op matches upstream's reducescatter default (Average), so a
+    drop-in migration keeps its scaling."""
     tf = _tf()
     e = _engine(process_set)
 
@@ -236,6 +238,12 @@ def reducescatter(tensor, op: ReduceOp = Sum,
     out_shape = None
     if tf.is_tensor(tensor) and tensor.shape.rank and \
             tensor.shape[0] is not None:
+        if tensor.shape[0] % n != 0:
+            # Fail loudly instead of declaring a floor-divided static
+            # shape that silently disagrees with the engine.
+            raise ValueError(
+                f"reducescatter dim 0 ({tensor.shape[0]}) must be "
+                f"divisible by the communicator size ({n})")
         out_shape = tf.TensorShape(
             [tensor.shape[0] // n]).concatenate(tensor.shape[1:])
     return _bridge(np_fn, tensor, out_shape)
@@ -252,7 +260,7 @@ def grouped_allgather(tensors, name: Optional[str] = None,
 
 
 @_no_autograph
-def grouped_reducescatter(tensors, op: ReduceOp = Sum,
+def grouped_reducescatter(tensors, op: ReduceOp = Average,
                           name: Optional[str] = None, process_set=None):
     return [reducescatter(t, op, f"{name}.{i}" if name else None,
                           process_set=process_set)

@@ -257,11 +257,12 @@ def sparse_allreduce_async(tensor: torch.Tensor,
     return handle
 
 
-def reducescatter(tensor: torch.Tensor, op: ReduceOp = Sum,
+def reducescatter(tensor: torch.Tensor, op: ReduceOp = Average,
                   name: Optional[str] = None,
                   process_set=None) -> torch.Tensor:
     """This rank's 1/n slice of the elementwise reduction over dim 0
-    (the later-Horovod torch surface; absent from the pinned era)."""
+    (the later-Horovod torch surface; absent from the pinned era). The
+    default op matches upstream's reducescatter default (Average)."""
     e = _engine(process_set)
     out = _to_host(e.reducescatter(_replicated(tensor, process_set), op,
                                    name))
@@ -277,7 +278,7 @@ def grouped_allgather(tensors, name: Optional[str] = None,
             for i, t in enumerate(tensors)]
 
 
-def grouped_reducescatter(tensors, op: ReduceOp = Sum,
+def grouped_reducescatter(tensors, op: ReduceOp = Average,
                           name: Optional[str] = None, process_set=None):
     return [reducescatter(t, op, f"{name}.{i}" if name else None,
                           process_set=process_set)
