@@ -126,3 +126,53 @@ def test_pairwise_combine_uses_kernels(rng):
     want = (an * (1 - dot / (2 * na2)) +
             bn * (1 - dot / (2 * nb2))).reshape(a.shape)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_flash_block_specs_obey_mosaic_tiling_rule():
+    """Static pin of the Mosaic constraint that cost a round-3 chip
+    window: every BlockSpec's minor-two dims must be (multiple of 8,
+    multiple of 128) OR equal the array dims. CPU interpret mode never
+    checks this, so the rule is asserted statically here for every
+    benchmark shape (BERT/GPT S=512, GPT-2k, microbench S in {1k, 2k,
+    4k}, and the S=512 block sweep) against the exact spec/array pairs
+    each pallas_call binds."""
+    from horovod_tpu.ops.flash_attention import (_LANE, _SUBLANES,
+                                                 _pick_block, _specs)
+
+    def ok(block, array):
+        if len(block) < 2:
+            return True
+        last = block[-1] == array[-1] or block[-1] % 128 == 0
+        sub = block[-2] == array[-2] or block[-2] % 8 == 0
+        return last and sub
+
+    configs = [
+        # (b, s, h, d, block_q, block_k)
+        (8, 512, 16, 64, 128, 128),    # bert_large bench
+        (8, 512, 12, 64, 128, 128),    # gpt_small bench
+        (4, 2048, 12, 64, 128, 128),   # gpt_2k long-context leg
+        (4, 1024, 8, 64, 128, 128),    # microbench
+        (4, 4096, 8, 64, 128, 128),
+        (4, 512, 8, 64, 256, 128),     # S=512 block sweep entries
+        (4, 512, 8, 64, 256, 256),
+        (4, 512, 8, 64, 512, 512),
+    ]
+    for b, s, h, d, cbq, cbk in configs:
+        d_pad = d if d % _LANE == 0 else d + (_LANE - d % _LANE)
+        bq, bk = _pick_block(s, cbq), _pick_block(s, cbk)
+        assert bq and bk, (s, cbq, cbk)
+        q_spec, kv_spec, m_spec, lse_blk, lse_full, kv_block = _specs(
+            b, s, h, d_pad, bq, bk)
+        qshape = (b, h, s, d_pad)
+        mshape = (b, _SUBLANES, s)
+        lshape = (b, h, s, _LANE)
+        # (spec, array) pairs exactly as the three pallas_calls bind
+        # them: fwd ins/outs, dq ins/outs, dkv ins/outs.
+        pairs = [
+            (q_spec, qshape), (kv_spec, qshape), (m_spec, mshape),
+            (lse_blk, lshape), (lse_full, lshape), (kv_block, qshape),
+        ]
+        for spec, array in pairs:
+            assert ok(spec.block_shape, array), (
+                f"Mosaic-untileable block {spec.block_shape} over "
+                f"{array} at config {(b, s, h, d, cbq, cbk)}")

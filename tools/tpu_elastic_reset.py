@@ -26,6 +26,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # workers run with sys.path[0] = tools/
 LEASE_COOLDOWN = 180
 
 
@@ -211,7 +212,7 @@ def supervise(args):
         "compile_s_warm": warm,
         "config_note": f"ConvNet adam total={args.total_steps} "
                        f"save_every={args.save_every}; SIGKILL after "
-                       f"first save; {LEASE_COOLDOWN}s lease cooldown",
+                       f"first save; {cooldown}s lease cooldown",
     }
     if args.cold_compile_s is not None:
         result["compile_s_cold"] = args.cold_compile_s
