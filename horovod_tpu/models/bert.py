@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -99,7 +100,13 @@ class Bert(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_ln")(x)
         # Masked-LM logits via embedding tie (standard BERT pretraining).
-        logits = emb.attend(x.astype(jnp.float32))
+        # bf16 operands + fp32 accumulation: the V x H head matmul at
+        # fp32 runs ~4x off the MXU's bf16 peak; accumulating in fp32
+        # keeps the softmax stable (the standard LM-head recipe).
+        logits = jax.lax.dot_general(
+            x.astype(self.dtype), emb.embedding.astype(self.dtype),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return logits
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops.flash_attention import flash_attention
@@ -118,8 +119,14 @@ class GPT(nn.Module):
                                                             positions)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_ln")(x)
-        # Weight-tied head: logits in fp32 for a stable softmax.
-        logits = x.astype(jnp.float32) @ emb.embedding.T
+        # Weight-tied head: bf16 operands + fp32 accumulation — the
+        # V x H matmul at fp32 runs ~4x off the MXU's bf16 peak, and
+        # fp32 accumulation keeps the softmax stable (standard LM-head
+        # recipe).
+        logits = jax.lax.dot_general(
+            x.astype(self.dtype), emb.embedding.astype(self.dtype),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return logits
 
 

@@ -1,0 +1,44 @@
+"""Pins for bench.py's model-basis MFU helpers (VERDICT r3 #2): the
+analytic FLOP counts must stay on the textbook bases the records claim,
+or mfu_model_pct silently changes meaning across rounds."""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import bench  # noqa: E402
+
+
+def test_cnn_model_flops_textbook_basis():
+    # ResNet-50 at native 224: 3 x 4.1 GFLOP/img.
+    got = bench._cnn_model_flops("resnet50", 224)
+    assert abs(got - 3 * 4.1e9) / got < 1e-6
+    # Resolution scaling is quadratic (the conv-FLOPs law).
+    assert abs(bench._cnn_model_flops("resnet50", 112) - got / 4) < 1.0
+    # Inception's native size is 299, not 224.
+    inc = 3 * 5.73e9
+    assert abs(bench._cnn_model_flops("inception3", 299) - inc) / inc \
+        < 1e-6
+    assert bench._cnn_model_flops("unknown_model", 224) is None
+
+
+def test_transformer_model_flops_formula():
+    # Tiny fake params: P = 1000 total elements.
+    params = {"a": np.zeros((10, 50)), "b": np.zeros((500,))}
+    L, d, S = 2, 8, 16
+    got = bench._transformer_model_flops(params, L, d, S)
+    # 6*P*S + 12*L*S^2*d, exactly.
+    assert got == 6.0 * 1000 * S + 12.0 * L * S * S * d
+
+
+def test_transformer_model_flops_bert_large_magnitude():
+    """BERT-large S=512 lands near the expected ~1.1 TFLOP/sample
+    (6*335M*512 = 1.03T params term + 77G attention term) — the sanity
+    band that keeps mfu_model_pct honest."""
+    p_bert = 335e6  # ~BERT-large parameter count
+    params = {"w": np.zeros((int(p_bert),), np.int8)}
+    got = bench._transformer_model_flops(params, 24, 1024, 512)
+    assert 0.9e12 < got < 1.4e12, got
