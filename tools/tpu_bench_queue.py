@@ -143,10 +143,17 @@ def run_job(name, argv, timeout_s):
         a if a.startswith("-") or not a.endswith(".py")
         else os.path.join(REPO, a) for a in argv]
     _log(f"job {name}: starting (timeout {timeout_s}s)")
+    # Persistent XLA compile cache shared by all jobs: a retry or a
+    # same-config sibling (resnet50 vs resnet50_profile, bert_large vs
+    # bert_profile) skips its 20-40s compile — real minutes inside a
+    # scarce serving window.
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(OUTDIR, "xla_cache"))
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout_s, cwd=REPO)
+                              timeout=timeout_s, cwd=REPO, env=env)
     except subprocess.TimeoutExpired as e:
         # The partial stderr says WHERE it hung (backend init vs compile
         # vs mid-iteration) — the difference between "lease/outage" and
