@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""ZeRO-3 / FSDP training with horovod_tpu.FSDPOptimizer.
+
+Params live at rest as 1/n bucket shards; each step all-gathers full
+params for compute, reduce-scatters grads, and updates shard-locally —
+at-rest memory for params + Adam state drops to 1/n of replicated DP
+(docs: optim.py FSDPOptimizer; no reference analog — ZeRO-3 is a
+capability this framework adds beyond the reference).
+
+Run (defaults to the 8-virtual-device CPU mesh under the test env):
+    python examples/fsdp_train.py --steps 20
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--hidden", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    # Topology from the environment: HVD_TPU_FORCE_CPU_DEVICES=8 gives
+    # the loopback mesh (the test harness sets it); on TPU just init().
+    hvd.init()
+    n = hvd.size()
+    ax = hvd.rank_axis()
+
+    # A 2-layer MLP regression problem, params as a plain pytree.
+    rng = np.random.default_rng(0)
+    d_in, d_h = 32, args.hidden
+    W_true = rng.standard_normal((d_in, 1)).astype(np.float32)
+    X = rng.standard_normal((n * 16, d_in)).astype(np.float32)
+    Y = X @ W_true
+    params = {
+        "w1": jnp.asarray(rng.standard_normal((d_in, d_h)) * 0.1,
+                          jnp.float32),
+        "b1": jnp.zeros((d_h,), jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((d_h, 1)) * 0.1,
+                          jnp.float32),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+    fs = hvd.FSDPOptimizer(optax.adamw(args.lr), axis_name=ax)
+    shard_specs = fs.shard_specs(params)
+    state_specs = fs.state_specs(params)
+
+    @hvd.spmd_step(in_specs=(P(),), out_specs=(shard_specs, state_specs))
+    def setup(p):
+        shards = fs.shard_params(p)   # full -> this rank's 1/n buckets
+        return shards, fs.init(shards)
+
+    @hvd.spmd_step(in_specs=(shard_specs, state_specs, P(ax), P(ax)),
+                   out_specs=(shard_specs, state_specs, P()))
+    def step(shards, st, xb, yb):
+        full = fs.gather_params(shards)          # AG per bucket
+
+        def loss_fn(p):
+            h = jnp.tanh(xb @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] + p["b2"] - yb) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(full)
+        shards, st = fs.update(g, st, shards)    # RS + local AdamW
+        return shards, st, jax.lax.pmean(l, ax)
+
+    shards, st = setup(params)
+    first = None
+    for i in range(args.steps):
+        shards, st, loss = step(shards, st, X, Y)
+        l = float(np.asarray(loss.addressable_data(0)).reshape(-1)[0])
+        if first is None:
+            first = l
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:3d} loss {l:.5f}")
+
+    assert l < first, (first, l)
+    shard_elems = sum(int(np.prod(s.shape))
+                      for s in jax.tree.leaves(shards)) // n
+    full_elems = sum(int(np.prod(v.shape))
+                     for v in jax.tree.leaves(params))
+    print(f"FSDP OK: loss {first:.5f} -> {l:.5f}; at-rest "
+          f"{shard_elems} elems/rank vs {full_elems} replicated "
+          f"({n}x reduction)")
+
+
+if __name__ == "__main__":
+    main()

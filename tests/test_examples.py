@@ -93,3 +93,13 @@ def test_gpt_long_context_fsdp_example():
     out = _run(["examples/gpt_long_context.py", "--steps", "6",
                 "--seq-len", "32", "--fsdp"])
     assert "done: dp=2 sp=4 seq=32 fsdp" in out and "loss" in out
+
+
+def test_fsdp_example():
+    out = _run(["examples/fsdp_train.py", "--steps", "12"])
+    assert "FSDP OK" in out
+
+
+def test_moe_example():
+    out = _run(["examples/moe_train.py", "--steps", "10"])
+    assert "MoE OK" in out
