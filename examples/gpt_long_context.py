@@ -33,7 +33,10 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models import gpt_tiny
-from horovod_tpu.parallel.ring_attention import ring_attention
+from horovod_tpu.parallel.ring_attention import (ring_attention,
+                                                 stripe_layout,
+                                                 striped_attention,
+                                                 striped_positions)
 
 
 def main():
@@ -45,6 +48,11 @@ def main():
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer state over dp (ZeRO-1: "
                         "hvd.ShardedOptimizer — 1/dp adam memory)")
+    p.add_argument("--striped", action="store_true",
+                   help="striped (interleaved-stripe) causal SP: every "
+                        "ring hop does equal work, vs contiguous "
+                        "blocks where later ranks do ~2x the earliest "
+                        "ranks' (Brandon et al. 2023)")
     p.add_argument("--fsdp", action="store_true",
                    help="fully-shard PARAMS over dp (ZeRO-3: "
                         "hvd.FSDPOptimizer — 1/dp params + adam at "
@@ -61,8 +69,12 @@ def main():
     assert S % sp == 0 and args.batch % dp == 0
 
     mesh = Mesh(np.array(jax.devices()).reshape(dp, sp), ("dp", "sp"))
-    model = gpt_tiny(attend_fn=lambda q, k, v: ring_attention(
-        q, k, v, "sp", causal=True))
+    if args.striped:
+        model = gpt_tiny(attend_fn=lambda q, k, v: striped_attention(
+            q, k, v, "sp"))
+    else:
+        model = gpt_tiny(attend_fn=lambda q, k, v: ring_attention(
+            q, k, v, "sp", causal=True))
 
     rng = jax.random.PRNGKey(0)
     toks = jax.random.randint(rng, (args.batch, S + 1), 0, 128)
@@ -79,7 +91,13 @@ def main():
         state_specs = P()
 
     def loss_of(p_, x, y):
-        pos = jax.lax.axis_index("sp") * (S // sp) + jnp.arange(S // sp)
+        # Striped layout: global positions are interleaved, and RoPE
+        # must see the TRUE global ids of this shard's tokens.
+        if args.striped:
+            pos = striped_positions(S // sp, "sp")
+        else:
+            pos = jax.lax.axis_index("sp") * (S // sp) \
+                + jnp.arange(S // sp)
         logits = model.apply(
             {"params": p_}, x,
             positions=jnp.broadcast_to(pos[None], x.shape))
@@ -128,12 +146,19 @@ def main():
         else:
             opt_state = tx.init(params)
 
+    x_all, y_all = toks[:, :-1], toks[:, 1:]
+    if args.striped:
+        # Permute tokens (and their next-token labels, which travel
+        # with them) into stripe order so the contiguous sp shard of
+        # position r holds the stripe {j*sp + r}.
+        x_all = stripe_layout(x_all, sp)
+        y_all = stripe_layout(y_all, sp)
     for i in range(args.steps):
-        params, opt_state, loss = f(params, opt_state,
-                                    toks[:, :-1], toks[:, 1:])
+        params, opt_state, loss = f(params, opt_state, x_all, y_all)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {float(loss):.4f}")
     print(f"done: dp={dp} sp={sp} seq={S}"
+          + (" striped" if args.striped else "")
           + (" zero1" if args.zero1 else "")
           + (" fsdp" if args.fsdp else ""))
 

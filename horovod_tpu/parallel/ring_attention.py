@@ -117,6 +117,33 @@ def _ring_flash_available(q, use_flash: Optional[bool]) -> bool:
     return flash_available(q.shape[1], use_flash)
 
 
+def _flash_block(q, k, v, mask, causal, use_flash):
+    """One ring hop through the Pallas kernel -> (o_f32, lse). Shared by
+    the contiguous and striped rings so the decline contract and the
+    fp32 cast live in one place."""
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    out = flash_attention_with_lse(q, k, v, mask=mask, causal=causal,
+                                   use_pallas=use_flash)
+    if out is None:  # flash_available() said yes — must not decline
+        raise RuntimeError(
+            "flash_attention_with_lse declined after flash_available() "
+            "approved — the availability predicate and the kernel "
+            "wrapper are out of sync")
+    o_i, lse_i = out
+    return o_i.astype(jnp.float32), lse_i
+
+
+def _combine_partial(o, lse, o_i, lse_i):
+    """Logsumexp-weighted merge of a new normalized partial (o_i, lse_i)
+    into the running (o, lse) — the blockwise-softmax combine every ring
+    variant shares."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w_new = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w_old + o_i * w_new, lse_new
+
+
 def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
                           use_flash: Optional[bool]):
     """Ring steps through the Pallas flash kernel: each block yields a
@@ -124,8 +151,6 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
     logaddexp-weighted averaging (both outputs differentiable, so the
     whole ring backprops through the kernels). The key-mask shard
     rotates with its K/V block."""
-    from ..ops.flash_attention import flash_attention_with_lse
-
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
@@ -135,17 +160,9 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
                 else jnp.zeros((b, 0), jnp.float32))
 
     def block(k_cur, v_cur, m_cur, block_causal: bool):
-        out = flash_attention_with_lse(q, k_cur, v_cur,
-                                       mask=m_cur if has_mask else None,
-                                       causal=block_causal,
-                                       use_pallas=use_flash)
-        if out is None:  # flash_available() said yes — must not decline
-            raise RuntimeError(
-                "flash_attention_with_lse declined after "
-                "flash_available() approved — the availability "
-                "predicate and the kernel wrapper are out of sync")
-        o_i, lse_i = out
-        return o_i.astype(jnp.float32), lse_i
+        return _flash_block(q, k_cur, v_cur,
+                            m_cur if has_mask else None, block_causal,
+                            use_flash)
 
     def body(i, carry):
         o, lse, k_cur, v_cur, m_cur = carry
@@ -164,20 +181,135 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
                              jnp.full((b, h, s), NEG_INF, jnp.float32))))
         else:
             o_i, lse_i = block(k_cur, v_cur, m_cur, False)
-        lse_new = jnp.logaddexp(lse, lse_i)
-        w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
-        w_new = jnp.exp(lse_i - lse_new).transpose(0, 2, 1)[..., None]
-        o = o * w_old + o_i * w_new
+        o, lse = _combine_partial(o, lse, o_i, lse_i)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         m_nxt = (lax.ppermute(m_cur, axis_name, perm) if has_mask
                  else m_cur)
-        return o, lse_new, k_nxt, v_nxt, m_nxt
+        return o, lse, k_nxt, v_nxt, m_nxt
 
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
     lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
     o, _, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v, key_mask))
     return o.astype(q.dtype)
+
+
+# -- striped attention (balanced causal ring) -------------------------------
+#
+# Contiguous-block causal ring attention is load-imbalanced: when source
+# block src > idx nothing is visible, so low-index devices idle through
+# most ring steps while device n-1 does n real block-attends — the ring
+# still pays n hops of latency for ~n/2 hops of useful work. Striped
+# attention (Brandon et al. 2023, PAPERS.md) fixes this with an
+# interleaved layout: device r holds global positions {j*n + r}. Then
+# for ANY (idx, src) pair the visible set is triangular over local
+# indices — jq > jk always visible, jq == jk visible iff idx >= src,
+# jq < jk never — so every device does the same ~s^2/2 work on every
+# hop. ~2x wall-clock over contiguous causal at large n.
+
+
+def stripe_layout(x, n: int):
+    """Permute a contiguous global sequence (B, S, ...) into stripe
+    order: new position ``r*(S/n) + j`` holds global token ``j*n + r``,
+    so a plain contiguous S-axis shard over ``n`` devices hands device
+    ``r`` the stripe {j*n + r}. Same shape in, same shape out."""
+    b, s = x.shape[:2]
+    xs = x.reshape((b, s // n, n) + x.shape[2:])       # [.., j, r, ..]
+    return jnp.moveaxis(xs, 2, 1).reshape(x.shape)     # [.., r, j, ..]
+
+
+def unstripe_layout(x, n: int):
+    """Inverse of :func:`stripe_layout` (stripe order -> contiguous)."""
+    b, s = x.shape[:2]
+    xs = x.reshape((b, n, s // n) + x.shape[2:])       # [.., r, j, ..]
+    return jnp.moveaxis(xs, 2, 1).reshape(x.shape)     # [.., j, r, ..]
+
+
+def striped_positions(s_local: int, axis_name: str = "sp"):
+    """(S_local,) global position ids of this device's stripe — pass to
+    RoPE/position embeddings (models.gpt rope takes ``positions``)."""
+    return jnp.arange(s_local) * lax.axis_size(axis_name) \
+        + lax.axis_index(axis_name)
+
+
+def striped_attention(q, k, v, axis_name: str = "sp",
+                      use_flash: Optional[bool] = None):
+    """Causal attention over STRIPE-sharded q/k/v (see stripe_layout).
+
+    q, k, v: (B, S_local, H, D) — this device's stripe. Returns the
+    attention output for the local stripe. Causality is over GLOBAL
+    positions; for non-causal attention striping buys nothing — use
+    ring_attention.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if use_flash is not False and _ring_flash_available(q, use_flash):
+        def kernel_block(k_cur, v_cur, strict):
+            """causal kernel over local indices; ``strict`` (idx < src)
+            excludes the diagonal by rolling K/V one position right and
+            masking the wrapped slot 0 — causal over the shifted keys is
+            exactly jq >= jk+1 over the originals."""
+            if strict:
+                k_in = jnp.roll(k_cur, 1, axis=1)
+                v_in = jnp.roll(v_cur, 1, axis=1)
+                kmask = jnp.ones((b, s), jnp.float32).at[:, 0].set(0.0)
+            else:
+                k_in, v_in, kmask = k_cur, v_cur, None
+            return _flash_block(q, k_in, v_in, kmask, True, use_flash)
+
+        def body(i, carry):
+            o, lse, k_cur, v_cur = carry
+            src = (idx - i) % n
+            o_i, lse_i = lax.cond(
+                idx >= src,
+                lambda: kernel_block(k_cur, v_cur, False),
+                lambda: kernel_block(k_cur, v_cur, True))
+            o, lse = _combine_partial(o, lse, o_i, lse_i)
+            return (o, lse, lax.ppermute(k_cur, axis_name, perm),
+                    lax.ppermute(v_cur, axis_name, perm))
+
+        o0 = jnp.zeros((b, s, h, d), jnp.float32)
+        lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+        o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+        return o.astype(q.dtype)
+
+    m = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    o = jnp.zeros((b, s, h, d), jnp.float32)
+    jq = jnp.arange(s)[:, None]
+    jk = jnp.arange(s)[None, :]
+
+    def body(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - i) % n
+        # global causality on stripes: (jq - jk) * n >= src - idx.
+        blk = ((jq - jk) * n >= src - idx)[None, None]
+        m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, blk)
+        return (m, l, o, lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm))
+
+    m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
+    denom = l.transpose(0, 2, 1)[..., None]
+    out = o / jnp.maximum(denom, 1e-30)
+    return out.astype(q.dtype)
+
+
+def striped_attend_fn(axis_name: str = "sp"):
+    """attend_fn adapter for the causal models (models.gpt GPT): striped
+    sequence-parallel attention. Pair with ``striped_positions`` for
+    RoPE — the stripe's GLOBAL positions must feed the rotary angles."""
+
+    def attend(q, k, v, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "striped attention + key mask: rotate the mask with the "
+                "stripes via ring_attention instead")
+        return striped_attention(q, k, v, axis_name)
+
+    return attend
 
 
 def ring_attend_fn(axis_name: str = "sp", causal: bool = False):

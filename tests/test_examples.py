@@ -103,3 +103,9 @@ def test_fsdp_example():
 def test_moe_example():
     out = _run(["examples/moe_train.py", "--steps", "10"])
     assert "MoE OK" in out
+
+
+def test_gpt_long_context_striped_example():
+    out = _run(["examples/gpt_long_context.py", "--steps", "6",
+                "--striped"])
+    assert "done: dp=2 sp=4 seq=64 striped" in out and "loss" in out

@@ -369,6 +369,76 @@ def alltoallv_skew_evidence():
     }
 
 
+def host_gap_evidence():
+    """Wall-vs-device rate from the captured profiled runs (VERDICT r3
+    #3: the r03 per-iteration loss fetch cost 14% of wall time; the
+    round-4 single-fetch window should close the gap to <5%). Reads the
+    newest profile record + its trace summary; skips rows that have not
+    been captured yet."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rdirs = ("tpu_r04", "tpu_r03")
+    rows = {}
+    for model, rec_names, trace in (
+            ("resnet50", ["resnet50", "resnet50_b256"],
+             "trace_summary.json"),
+            ("bert_large", ["bert_large"], "trace_bert_summary.json")):
+        # Record and trace must come from the SAME round: the metric
+        # verifies that round's timing loop, so pairing an r04 rate with
+        # an r03 device basis would measure nothing.
+        rec = summary = None
+        rec_src = trace_src = None
+        for rdir in rdirs:
+            cand_rec = cand_src = None
+            for cand in rec_names:
+                p = os.path.join(here, "results", rdir, f"{cand}.json")
+                if cand_rec is None and os.path.exists(p):
+                    try:
+                        with open(p) as f:
+                            cand_rec = json.load(f)
+                        cand_src = f"{rdir}/{cand}.json"
+                    except (OSError, json.JSONDecodeError):
+                        cand_rec = None
+            ts = os.path.join(here, "results", rdir, trace)
+            if cand_rec is not None and os.path.exists(ts):
+                try:
+                    with open(ts) as f:
+                        summary = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                rec, rec_src = cand_rec, cand_src
+                trace_src = f"{rdir}/{trace}"
+                break
+        if rec is None or summary is None:
+            rows[model] = {"skipped": "record + trace not both captured "
+                                      "in any one round yet"}
+            continue
+        dev_ms = None
+        for op in summary.get("device_top_ops", []):
+            if op["name"].startswith("jit_train_step") and op["count"]:
+                dev_ms = op["ms"] / op["count"]
+                break
+        bsz = (rec.get("config") or {}).get("global_batch")
+        if dev_ms is None or not bsz:
+            rows[model] = {"skipped": "no device step in trace "
+                                      "or no config in record"}
+            continue
+        device_rate = bsz / (dev_ms / 1e3)
+        wall_rate = rec["value"] * (rec.get("config") or {}).get(
+            "n_chips", 1)
+        rows[model] = {
+            "wall_rate": round(wall_rate, 1),
+            "device_rate": round(device_rate, 1),
+            "wall_vs_device_pct": round(100 * wall_rate / device_rate,
+                                        1),
+            "timing_mode": (rec.get("config") or {}).get("timing"),
+            "record_source": rec_src, "trace_source": trace_src,
+        }
+    rows["note"] = ("target: wall >= 95% of device rate with the "
+                    "single-fetch window (r03 measured 86% under the "
+                    "per-iteration fetch)")
+    return rows
+
+
 def scaling_projection():
     """DP scaling-efficiency roofline from MEASURED single-chip step
     times (results/tpu_r03/*.json) + per-step gradient bytes + v5e ICI
@@ -486,6 +556,7 @@ if __name__ == "__main__":
         "overlap": overlap_evidence,
         "pipeline": pipeline_evidence,
         "alltoallv_skew": alltoallv_skew_evidence,
+        "host_gap": host_gap_evidence,
         "scaling": scaling_projection,
     }
     import sys
