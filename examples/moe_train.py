@@ -88,6 +88,9 @@ def main():
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:3d} loss {l:.5f}")
 
+    if l is None:
+        print("MoE OK: no steps run")
+        return
     assert args.steps < 2 or l < first, (first, l)
     print(f"MoE OK: loss {first:.5f} -> {l:.5f} over {n} experts "
           f"(ep={n}, top-2 gating, static capacity)")
