@@ -70,8 +70,9 @@ def _cached_tpu_record(argv, model):
 
     Guard rails: the cache is keyed by model at the queue's DEFAULT
     config, so any config-altering flag in argv (batch size, seq len,
-    smoke, ...) disables the lookup; records older than a day are
-    ignored (a stale number must not mask a live regression forever)."""
+    smoke, ...) disables the lookup; records older than two days are
+    ignored (a stale number must not mask a live regression forever,
+    but outages routinely exceed 24h — the record carries its age)."""
     config_flags = [a for a in argv
                     if a.startswith("-")
                     and not (a == "--model" or a.startswith("--model="))]
@@ -91,10 +92,15 @@ def _cached_tpu_record(argv, model):
         age = time.time() - float(payload.get("captured_unix", 0))
     except (OSError, json.JSONDecodeError, TypeError, ValueError):
         return None
-    if age > 24 * 3600:
+    if age > 48 * 3600:
+        # Two-day cap: beyond that a cached number is more likely to
+        # mask a regression than to inform. Inside it, a clearly-marked
+        # cached chip record beats a CPU-fallback number that says
+        # nothing about the chip (outages routinely exceed 24h here).
         _log(f"cached chip record is {age / 3600:.1f}h old; ignoring")
         return None
     payload["cached"] = True
+    payload["cached_age_h"] = round(age / 3600, 1)
     return payload
 
 

@@ -161,6 +161,12 @@ def allgatherv(x, sizes: Sequence[int], axis_name: str = "hvd"):
 
     XLA has no ragged all-gather; pad-to-max + static slice-out is the
     standard TPU lowering and keeps shapes static for the compiler.
+
+    Wire bound: O(n * max(sizes)) — and unlike alltoallv (whose per-
+    (src,dst) variance alltoallv_chunked exploits), this is essentially
+    tight for an SPMD allgather: every rank must receive every source
+    segment, and a static program must size each hop for the largest
+    contributor. Skew here costs at most max/mean, not n * max/sum.
     """
     maxs = max(sizes) if len(sizes) else 0
     assert x.shape[0] == maxs, f"input must be padded to {maxs} rows"
