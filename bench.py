@@ -79,29 +79,37 @@ def _cached_tpu_record(argv, model):
     if config_flags:
         return None
     here = os.path.dirname(os.path.abspath(__file__))
-    for rdir in ("tpu_r04", "tpu_r03"):
+    if here not in sys.path:
+        sys.path.insert(0, here)
+    from tools.round_dirs import SEARCH_ORDER
+
+    for rdir in SEARCH_ORDER:
+        # A corrupt/truncated record in a newer dir (e.g. the queue host
+        # died mid-write) must not shadow a valid older one — fall
+        # through to the next directory on any load/validation failure.
         path = os.path.join(here, "results", rdir, f"{model}.json")
-        if os.path.exists(path):
-            break
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-        if not isinstance(payload, dict) \
-                or payload.get("platform") != "tpu":
-            return None
-        age = time.time() - float(payload.get("captured_unix", 0))
-    except (OSError, json.JSONDecodeError, TypeError, ValueError):
-        return None
-    if age > 48 * 3600:
-        # Two-day cap: beyond that a cached number is more likely to
-        # mask a regression than to inform. Inside it, a clearly-marked
-        # cached chip record beats a CPU-fallback number that says
-        # nothing about the chip (outages routinely exceed 24h here).
-        _log(f"cached chip record is {age / 3600:.1f}h old; ignoring")
-        return None
-    payload["cached"] = True
-    payload["cached_age_h"] = round(age / 3600, 1)
-    return payload
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict) \
+                    or payload.get("platform") != "tpu":
+                continue
+            age = time.time() - float(payload.get("captured_unix", 0))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            continue
+        if age > 48 * 3600:
+            # Two-day cap: beyond that a cached number is more likely to
+            # mask a regression than to inform. Inside it, a
+            # clearly-marked cached chip record beats a CPU-fallback
+            # number that says nothing about the chip (outages routinely
+            # exceed 24h here).
+            _log(f"cached chip record ({rdir}) is {age / 3600:.1f}h "
+                 f"old; ignoring")
+            continue
+        payload["cached"] = True
+        payload["cached_age_h"] = round(age / 3600, 1)
+        return payload
+    return None
 
 
 def _supervise(argv, model):
@@ -190,6 +198,11 @@ def main():
     p.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--_platform", default="", help=argparse.SUPPRESS)
     args, _ = p.parse_known_args()
+
+    if args.num_iters < 1 or args.batches_per_iter < 1:
+        # ADVICE r4: zero iterations left the window-timing loop with no
+        # batch to force (NameError) and the legacy path with mean([]).
+        p.error("--num-iters and --batches-per-iter must be >= 1")
 
     if not args._worker:
         return _supervise(sys.argv[1:], args.model)
@@ -330,6 +343,12 @@ def _run_benchmark(args, n):
         "unit": "samples/s" if (is_bert or is_gpt) else "img/s",
         "vs_baseline": round(val / baseline, 3),
     }
+    if args.model.startswith("resnet") and not args.no_s2d:
+        # ADVICE r4: the P100-era baseline was measured on the standard
+        # 7x7-stem ResNet; the default s2d stem is a different model
+        # variant, so the ratio is cross-variant. Recorded so the number
+        # is self-describing; --no-s2d gives the stem-matched ratio.
+        result["baseline_variant"] = "standard_7x7_stem"
     # Mandatory config record (VERDICT r3 weak #7): every number
     # carries the exact configuration that produced it, so records
     # from different rounds/batches can never be silently compared.

@@ -28,6 +28,7 @@ Eager collectives operate on rank-major distributed tensors
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -224,20 +225,60 @@ def alltoall(x, name: Optional[str] = None, splits=None, process_set=None):
     return _engine(process_set).alltoall(x, name, splits=splits)
 
 
-def reducescatter(x, op: ReduceOp = ReduceOp.AVERAGE,
+_rs_default_warned = False
+
+
+def _reducescatter_default_op() -> ReduceOp:
+    """One-release transition warning (ADVICE r4): the eager-surface
+    default flipped SUM -> AVERAGE in r4 for upstream parity — a silent
+    1/n scaling change for callers relying on the old default. Warns
+    once per process when ``op`` is left defaulted."""
+    global _rs_default_warned
+    if not _rs_default_warned:
+        _rs_default_warned = True
+        import sys
+        import warnings
+
+        # Attribute the once-per-process warning to the USER's call
+        # site: the depth to it varies by surface (core vs torch vs the
+        # TF shim's autograph wrappers vs grouped_*), so walk out of
+        # this package instead of hard-coding a stacklevel.
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        level = 2
+        f = sys._getframe(1)
+        while (f.f_back is not None
+               and f.f_code.co_filename.startswith(pkg)):
+            f = f.f_back
+            level += 1
+        warnings.warn(
+            "reducescatter's default op is AVERAGE as of round 4 "
+            "(upstream parity; previously SUM on this surface). Pass "
+            "op=hvd.Sum explicitly for the unscaled reduction. Note the "
+            "in-jit horovod_tpu.ops.collectives.reducescatter still "
+            "defaults to SUM.", UserWarning, stacklevel=level)
+    return ReduceOp.AVERAGE
+
+
+def reducescatter(x, op: Optional[ReduceOp] = None,
                   name: Optional[str] = None, process_set=None):
     """This rank's 1/n slice of the elementwise reduction over dim 0.
     Default op is AVERAGE on every surface (core + torch + TF),
     matching upstream's reducescatter default — pass op=Sum for the
-    unscaled reduction."""
+    unscaled reduction. (The in-jit ``ops.collectives.reducescatter``
+    keeps the SUM default; see docs/api.md.)"""
+    if op is None:
+        op = _reducescatter_default_op()
     return _engine(process_set).reducescatter(x, op, name)
 
 
-def grouped_reducescatter(tensors, op: ReduceOp = ReduceOp.AVERAGE,
+def grouped_reducescatter(tensors, op: Optional[ReduceOp] = None,
                           name: Optional[str] = None, process_set=None):
     """Reducescatter every leaf of a list/dict (later-Horovod grouped
     surface; per-leaf dispatch — same naming contract as
-    :func:`grouped_allgather`)."""
+    :func:`grouped_allgather`). Defaulted ``op`` is AVERAGE (see
+    :func:`reducescatter` for the SUM->AVERAGE transition note)."""
+    if op is None:
+        op = _reducescatter_default_op()
     e = _engine(process_set)
     leaves, treedef = jax.tree.flatten(tensors)
     outs = [e.reducescatter(v, op, f"{name}.{i}" if name else None)

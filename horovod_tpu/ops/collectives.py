@@ -275,6 +275,9 @@ def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
     static shape across ranks); ``recv_counts`` is the static column of
     per-source valid row counts as a (n,) int32 array indexed by this
     rank. Callers slice ``recv[s*seg : s*seg + splits[s][my_rank]]``.
+    Padding rows (beyond each segment's valid count) are zeros — each
+    hop's chunk is masked before the wire so rows a sender slices past
+    its segment boundary never leak to the receiver.
     """
     n = len(splits_matrix)
     if lax.axis_size(axis_name) != n:
@@ -303,10 +306,22 @@ def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
     x = jnp.concatenate(
         [x, jnp.zeros((seg,) + rest, x.dtype)], axis=0)
 
+    # Per-(src,dst) valid-count table, indexed with the traced rank id
+    # to zero a chunk's rows past this rank's true split: a hop padded
+    # to b_k > splits[me][dst] would otherwise slice live rows belonging
+    # to the NEXT destination segment into the padding (silent
+    # corruption for any caller that reduces over a whole segment).
+    split_tbl = jnp.asarray(splits_matrix, jnp.int32)
+
+    def _masked(chunk, valid):
+        row = lax.broadcasted_iota(jnp.int32, chunk.shape, 0)
+        return jnp.where(row < valid, chunk, jnp.zeros_like(chunk))
+
     # Hop 0: local copy (never on the wire).
     b0 = max(splits_matrix[r][r] for r in range(n))
     if b0:
         chunk = lax.dynamic_slice_in_dim(x, send_off[me, me], b0, 0)
+        chunk = _masked(chunk, split_tbl[me, me])
         out = lax.dynamic_update_slice_in_dim(out, chunk, me * seg, 0)
 
     for k in range(1, n):
@@ -318,6 +333,7 @@ def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
         # Slice this rank's (padded-to-b_k) chunk for its hop-k dest.
         chunk = lax.dynamic_slice_in_dim(
             x, send_off[me, dst_idx[me]], bk, 0)
+        chunk = _masked(chunk, split_tbl[me, dst_idx[me]])
         # Send to (r+k) mod n; receive from (r-k) mod n.
         perm = [(r, (r + k) % n) for r in range(n)]
         got = lax.ppermute(chunk, axis_name, perm)

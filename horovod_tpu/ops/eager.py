@@ -29,6 +29,7 @@ identical tensors would see.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -58,14 +59,34 @@ class HandleManager:
     the oldest COMPLETED results first; an evicted handle behaves like
     an already-synchronized one (poll -> True, synchronize -> KeyError).
     If the table is full of genuinely in-flight work, allocate raises —
-    that backlog is a program bug, not a cache-sizing problem."""
+    that backlog is a program bug, not a cache-sizing problem.
+
+    The bound is configurable via ``HVD_TPU_MAX_RETAINED_HANDLES`` for
+    long-running poll-only callers that legitimately defer synchronize()
+    past 16384 outstanding results (ADVICE r4)."""
 
     max_retained = 16384
+    # Class-level so that runtime overrides of the class attribute (the
+    # documented tuning pattern, used by tests) are never shadowed by a
+    # per-instance copy; the env var is read once at import.
+    _env = os.environ.get("HVD_TPU_MAX_RETAINED_HANDLES", "")
+    if _env:
+        try:
+            max_retained = int(_env)
+        except ValueError:
+            raise ValueError(
+                f"HVD_TPU_MAX_RETAINED_HANDLES must be an integer >= 1, "
+                f"got {_env!r}") from None
+        if max_retained < 1:
+            raise ValueError(
+                f"HVD_TPU_MAX_RETAINED_HANDLES must be >= 1, got {_env}")
+    del _env
 
     def __init__(self):
         self._lock = threading.Lock()
         self._next = 0
         self._results: Dict[int, Any] = {}
+        self._evicted_count = 0
 
     @staticmethod
     def _ready(val) -> bool:
@@ -83,6 +104,7 @@ class HandleManager:
                     if self._ready(self._results[h]):
                         del self._results[h]
                         evicted += 1
+                self._evicted_count += evicted
                 if evicted and not getattr(self, "_evict_warned", False):
                     self._evict_warned = True
                     logger.warning(
@@ -114,8 +136,22 @@ class HandleManager:
     def synchronize(self, handle: int):
         with self._lock:
             if handle not in self._results:
+                hint = ""
+                if self._evicted_count:
+                    # Self-diagnosing failure (ADVICE r4): without this,
+                    # an evicted handle's KeyError is indistinguishable
+                    # from a never-issued one.
+                    hint = (f" (NOTE: this table has evicted "
+                            f"{self._evicted_count} completed-but-"
+                            f"unsynchronized results after hitting "
+                            f"max_retained={self.max_retained}; if this "
+                            f"handle was issued long ago it was likely "
+                            f"evicted — raise "
+                            f"HVD_TPU_MAX_RETAINED_HANDLES or "
+                            f"synchronize() promptly)")
                 raise KeyError(
-                    f"unknown or already-synchronized handle: {handle}")
+                    f"unknown or already-synchronized handle: "
+                    f"{handle}{hint}")
             val = self._results.pop(handle)
         for l in jax.tree.leaves(val):
             if hasattr(l, "block_until_ready"):

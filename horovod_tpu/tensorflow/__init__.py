@@ -221,12 +221,16 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
 
 
 @_no_autograph
-def reducescatter(tensor, op: ReduceOp = Average,
+def reducescatter(tensor, op: Optional[ReduceOp] = None,
                   name: Optional[str] = None, process_set=None):
     """This rank's 1/n slice of the elementwise reduction over dim 0
     (the later-Horovod TF surface; absent from the pinned era). The
     default op matches upstream's reducescatter default (Average), so a
-    drop-in migration keeps its scaling."""
+    drop-in migration keeps its scaling; the default flipped from Sum
+    in round 4, so a defaulted call warns once per process (see
+    horovod_tpu.reducescatter)."""
+    if op is None:
+        op = _hvd._reducescatter_default_op()
     tf = _tf()
     e = _engine(process_set)
 
@@ -260,7 +264,7 @@ def grouped_allgather(tensors, name: Optional[str] = None,
 
 
 @_no_autograph
-def grouped_reducescatter(tensors, op: ReduceOp = Average,
+def grouped_reducescatter(tensors, op: Optional[ReduceOp] = None,
                           name: Optional[str] = None, process_set=None):
     return [reducescatter(t, op, f"{name}.{i}" if name else None,
                           process_set=process_set)

@@ -257,12 +257,18 @@ def sparse_allreduce_async(tensor: torch.Tensor,
     return handle
 
 
-def reducescatter(tensor: torch.Tensor, op: ReduceOp = Average,
+def reducescatter(tensor: torch.Tensor, op: Optional[ReduceOp] = None,
                   name: Optional[str] = None,
                   process_set=None) -> torch.Tensor:
     """This rank's 1/n slice of the elementwise reduction over dim 0
     (the later-Horovod torch surface; absent from the pinned era). The
-    default op matches upstream's reducescatter default (Average)."""
+    default op matches upstream's reducescatter default (Average); the
+    default flipped from Sum in round 4, so a defaulted call warns once
+    per process (see horovod_tpu.reducescatter)."""
+    if op is None:
+        from .. import _reducescatter_default_op
+
+        op = _reducescatter_default_op()
     e = _engine(process_set)
     out = _to_host(e.reducescatter(_replicated(tensor, process_set), op,
                                    name))
@@ -278,7 +284,7 @@ def grouped_allgather(tensors, name: Optional[str] = None,
             for i, t in enumerate(tensors)]
 
 
-def grouped_reducescatter(tensors, op: ReduceOp = Average,
+def grouped_reducescatter(tensors, op: Optional[ReduceOp] = None,
                           name: Optional[str] = None, process_set=None):
     return [reducescatter(t, op, f"{name}.{i}" if name else None,
                           process_set=process_set)

@@ -415,3 +415,9 @@ def test_alltoallv_chunked_skewed_oracle(hvd, rng):
             np.testing.assert_allclose(
                 out[d, s * seg:s * seg + cnt], datas[s][off:off + cnt],
                 rtol=1e-6, err_msg=f"src {s} -> dst {d}")
+            # Padding rows must be ZEROS (ADVICE r4: a hop padded past
+            # splits[s][d] used to leak the sender's next destination
+            # segment into them, corrupting whole-segment reductions).
+            np.testing.assert_array_equal(
+                out[d, s * seg + cnt:(s + 1) * seg], 0.0,
+                err_msg=f"padding src {s} -> dst {d} not zero")

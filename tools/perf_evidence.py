@@ -38,6 +38,14 @@ import horovod_tpu as hvd
 from horovod_tpu.ops import collectives as C
 
 
+def _round_search_order():
+    """Newest-first results dirs, from the shared tools/round_dirs.py."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from round_dirs import SEARCH_ORDER
+
+    return SEARCH_ORDER
+
+
 def mib(nbytes):
     return round(nbytes / (1024 * 1024), 2)
 
@@ -376,7 +384,7 @@ def host_gap_evidence():
     newest profile record + its trace summary; skips rows that have not
     been captured yet."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    rdirs = ("tpu_r04", "tpu_r03")
+    rdirs = _round_search_order()
     rows = {}
     for model, rec_names, trace in (
             ("resnet50", ["resnet50", "resnet50_b256"],
@@ -480,7 +488,7 @@ def scaling_projection():
             pass
         return None
 
-    rdirs = ("tpu_r04", "tpu_r03")  # newest round's captures win
+    rdirs = _round_search_order()  # newest round's captures win
     models = {
         # row -> (grad bytes/step/chip, per-chip batch,
         #         candidate record names newest-config-first,

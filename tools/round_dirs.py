@@ -1,0 +1,14 @@
+"""Single source of truth for the per-round TPU results directories.
+
+Every tool that reads or writes chip-capture records imports these (the
+round bump used to be a hand-edit across four files — bench.py,
+tpu_bench_queue.py, perf_evidence.py, tpu_elastic_reset.py — and rounds
+4→5 missed two of them, silently pairing stale captures).
+"""
+
+# Where THIS round's queue writes its captures.
+CURRENT = "tpu_r05"
+
+# Newest-first search order for cached chip records; bounded by the
+# 48-hour freshness cap applied at the read sites.
+SEARCH_ORDER = ("tpu_r05", "tpu_r04", "tpu_r03")

@@ -29,7 +29,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUTDIR = os.path.join(REPO, "results", "tpu_r04")
+sys.path.insert(0, REPO)
+from tools.round_dirs import CURRENT as _ROUND  # noqa: E402
+
+OUTDIR = os.path.join(REPO, "results", _ROUND)
 
 PROBE_TIMEOUT = 90
 PROBE_SLEEP = 420          # between failed probes
@@ -82,10 +85,10 @@ JOBS = [
     ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
                           "--model", "resnet50", "--batch-size", "256",
                           "--num-iters", "3", "--profile-dir",
-                          "results/tpu_r04/trace_resnet50"], 1500),
+                          f"results/{_ROUND}/trace_resnet50"], 1500),
     ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
                       "--model", "bert_large", "--num-iters", "3",
-                      "--profile-dir", "results/tpu_r04/trace_bert"],
+                      "--profile-dir", f"results/{_ROUND}/trace_bert"],
      1200),
     # Elastic reset under fire (VERDICT r3 #6): train → SIGKILL →
     # lease cooldown → orbax restore + persistent-compile-cache warm

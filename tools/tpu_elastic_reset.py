@@ -27,6 +27,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)  # workers run with sys.path[0] = tools/
+
+from tools.round_dirs import CURRENT as _ROUND  # noqa: E402
 LEASE_COOLDOWN = 180
 
 
@@ -226,10 +228,10 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--phase", type=int, default=1)
     ap.add_argument("--ckpt-dir",
-                    default=os.path.join(REPO, "results", "tpu_r04",
+                    default=os.path.join(REPO, "results", _ROUND,
                                          "elastic_ckpt"))
     ap.add_argument("--cache-dir",
-                    default=os.path.join(REPO, "results", "tpu_r04",
+                    default=os.path.join(REPO, "results", _ROUND,
                                          "xla_cache"))
     ap.add_argument("--total-steps", type=int, default=40)
     ap.add_argument("--save-every", type=int, default=10)
