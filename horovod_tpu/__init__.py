@@ -217,12 +217,16 @@ def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
     return _engine(process_set).broadcast(x, root_rank, name)
 
 
-def alltoall(x, name: Optional[str] = None, splits=None, process_set=None):
+def alltoall(x, name: Optional[str] = None, splits=None, process_set=None,
+             chunked: Optional[bool] = None):
     """Even all-to-all, or — with ``splits`` — the dynamic uneven variant
     where recv splits are negotiated through the controller (reference:
     operations.cc:1020-1081, controller.h:56-58 AlltoallGetRecvSplits).
-    See EagerEngine.alltoallv for the two call conventions."""
-    return _engine(process_set).alltoall(x, name, splits=splits)
+    See EagerEngine.alltoallv for the two call conventions. ``chunked``
+    (extension) selects the uneven wire form: None auto-routes skewed
+    tables through the bounded per-hop exchange, True/False forces it."""
+    return _engine(process_set).alltoall(x, name, splits=splits,
+                                         chunked=chunked)
 
 
 _rs_default_warned = False

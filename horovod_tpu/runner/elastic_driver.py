@@ -267,7 +267,8 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
                command: List[str], env_extra: Dict[str, str],
                ssh_port=None, poll_interval: float = 0.1,
                on_hosts_updated=None,
-               grace_secs: Optional[float] = None):
+               grace_secs: Optional[float] = None,
+               spawner=None):
     """Run one elastic epoch with per-worker exit tracking.
 
     Returns ``(rc, failed_hosts, interrupted)``: ``failed_hosts`` are
@@ -278,6 +279,15 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     (bumping the rendezvous topology_version), then workers get
     HVD_TPU_ELASTIC_GRACE_SECS to exit gracefully at a commit() point
     (HOSTS_UPDATED_EXIT_CODE) before being terminated.
+
+    ``spawner`` plugs in a non-subprocess execution substrate (the Spark
+    task pool — reference spark/runner.py:303 runs elastic workers
+    inside Spark task services the same way): called as
+    ``spawner(slots, command, env_extra)`` and must return a list of
+    ``(hostname, handle)`` where handle is Popen-like (``poll`` /
+    ``terminate`` / ``send_signal`` / ``wait``). The spawner owns slot
+    env construction (coordinator negotiation may be deferred to the
+    workers themselves).
     """
     import shlex
     import signal
@@ -286,7 +296,9 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     local = _is_local_epoch(slots)
     procs: List = []  # (hostname, Popen)
     threads: List[threading.Thread] = []
-    if local:
+    if spawner is not None:
+        procs = list(spawner(slots, command, env_extra))
+    elif local:
         port = _free_port()
         coordinator = f"127.0.0.1:{port}"
         for s in slots:
@@ -339,9 +351,17 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
              float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30")))
 
     def terminate_all():
+        # Signal EVERY worker, even ones whose handle already reported
+        # an exit: a KV-backed pool handle may have SYNTHESIZED rc=1
+        # from a transiently stale heartbeat while the remote worker is
+        # actually alive — skipping it would leave a live duplicate of
+        # the dead epoch running. Popen.terminate on an exited child is
+        # a no-op, so the blanket signal is safe for local epochs too.
         for _, p in procs:
-            if p.poll() is None:
+            try:
                 p.terminate()
+            except (ProcessLookupError, OSError):
+                pass
 
     try:
         while True:
@@ -404,7 +424,11 @@ def run_elastic(args, command: List[str],
                 discovery: Optional[HostDiscovery] = None,
                 reset_limit: Optional[int] = None,
                 slot_wait_timeout_s: Optional[float] = None,
-                grace_secs: Optional[float] = None) -> int:
+                grace_secs: Optional[float] = None,
+                spawner=None,
+                rdv_server: Optional[RendezvousServer] = None,
+                rdv_advertise: Optional[str] = None,
+                rdv_secret: Optional[str] = None) -> int:
     """Driver-side elastic launch (reference gloo_run_elastic
     gloo_run.py:326 + launch.py:616 + elastic/driver.py:68-309).
 
@@ -443,14 +467,26 @@ def run_elastic(args, command: List[str],
     # and workers get it explicitly, and a lingering env entry would
     # leak into every later subprocess and make any secretless
     # server/client in this process silently adopt a stale key.
-    job_secret = _secrets.token_hex(16)
-    rdv = RendezvousServer("127.0.0.1", secret=job_secret.encode())
-    rdv_port = rdv.start()
+    owns_rdv = rdv_server is None
+    if owns_rdv:
+        job_secret = _secrets.token_hex(16)
+        rdv = RendezvousServer("127.0.0.1", secret=job_secret.encode())
+        rdv_port = rdv.start()
+        advertise = f"127.0.0.1:{rdv_port}"
+    else:
+        # Caller-owned server (the Spark composition: one KV reachable
+        # from executor hosts serves the task pool AND the elastic
+        # topology channel). The caller supplies the address workers
+        # can reach and the matching secret, and stops the server.
+        rdv = rdv_server
+        job_secret = rdv_secret
+        advertise = rdv_advertise or f"127.0.0.1:{rdv.port()}"
     topo_version = 0
     rdv.put("elastic", "topology_version", str(topo_version).encode())
     env_extra = dict(env_extra)
-    env_extra["HVD_TPU_RENDEZVOUS"] = f"127.0.0.1:{rdv_port}"
-    env_extra["HVD_TPU_RENDEZVOUS_SECRET"] = job_secret
+    env_extra["HVD_TPU_RENDEZVOUS"] = advertise
+    if job_secret:
+        env_extra["HVD_TPU_RENDEZVOUS_SECRET"] = job_secret
 
     def bump_version():
         nonlocal topo_version
@@ -480,7 +516,8 @@ def run_elastic(args, command: List[str],
             rc, failed_hosts, interrupted = _run_epoch(
                 driver, slots, command, env_extra,
                 ssh_port=getattr(args, "ssh_port", None),
-                on_hosts_updated=bump_version, grace_secs=grace_secs)
+                on_hosts_updated=bump_version, grace_secs=grace_secs,
+                spawner=spawner)
             if rc == 0 and not failed_hosts and not interrupted:
                 return 0
             for h in failed_hosts:
@@ -499,5 +536,6 @@ def run_elastic(args, command: List[str],
                     "job failed (reference registration.py:156)")
                 return rc or 1
     finally:
-        rdv.stop()
+        if owns_rdv:
+            rdv.stop()
         driver.stop()

@@ -39,24 +39,28 @@ def _free_port() -> int:
 
 def negotiate_coordinator(client: RendezvousClient, index: int,
                           num_proc: int, hostname: Optional[str] = None,
-                          timeout_s: float = 600.0) -> Dict[str, str]:
+                          timeout_s: float = 600.0,
+                          scope: str = _SCOPE) -> Dict[str, str]:
     """Per-task coordinator negotiation over the driver's KV store
     (the SparkTaskService registration protocol, reference
     spark/runner.py:161-186, distilled): task 0 publishes
     ``<its-host>:<free-port>`` as the jax.distributed coordinator; every
-    task returns the worker env the launcher would have exported."""
+    task returns the worker env the launcher would have exported.
+    ``scope`` isolates concurrent negotiations (elastic epochs negotiate
+    under ``sparkep/<epoch>`` so a restarted world never reads the dead
+    epoch's coordinator)."""
     hostname = hostname or socket.gethostname()
     if index == 0:
         # put_if_absent: a retried/speculated task 0 converges on the
         # FIRST published address instead of splitting the world across
         # two coordinators.
         coordinator = client.put_if_absent(
-            _SCOPE, "coordinator",
+            scope, "coordinator",
             f"{hostname}:{_free_port()}".encode()).decode()
     else:
-        raw = client.wait(_SCOPE, "coordinator", timeout_s=timeout_s)
+        raw = client.wait(scope, "coordinator", timeout_s=timeout_s)
         coordinator = raw.decode()
-    client.put(_SCOPE, f"registered/{index}", hostname.encode())
+    client.put(scope, f"registered/{index}", hostname.encode())
     return {
         "HVD_TPU_COORDINATOR": coordinator,
         "HVD_TPU_NUM_PROC": str(num_proc),
@@ -94,28 +98,36 @@ def _make_mapper(rdv_addr: Tuple[str, int], num_proc: int, fn, args,
     return mapper
 
 
+def _resolve_context(spark_context):
+    """The active SparkContext; pyspark is only required when none is
+    given (tests drive the full mapper path through a
+    pyspark-API-compatible stub — testing/fake_spark.py)."""
+    if spark_context is not None:
+        return spark_context
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark (or an explicit "
+            "spark_context); for non-Spark clusters use "
+            "horovod_tpu.runner.run / horovod_tpu.executor.Executor "
+            "(same per-rank contract)") from e
+    from pyspark.sql import SparkSession
+
+    session = SparkSession.getActiveSession()
+    if session is None:
+        raise RuntimeError("no active SparkSession and no "
+                           "spark_context given")
+    return session.sparkContext
+
+
 def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
         spark_context=None, env: Optional[Dict[str, str]] = None,
         start_timeout: float = 600.0):
     """Run ``fn`` as ``num_proc`` workers inside Spark tasks; returns
     per-rank results in rank order (reference horovod.spark.run
     contract, spark/runner.py:195+)."""
-    try:
-        import pyspark  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "horovod_tpu.spark.run requires pyspark; for non-Spark "
-            "clusters use horovod_tpu.runner.run / "
-            "horovod_tpu.executor.Executor (same per-rank contract)"
-        ) from e
-    from pyspark.sql import SparkSession
-
-    if spark_context is None:
-        session = SparkSession.getActiveSession()
-        if session is None:
-            raise RuntimeError("no active SparkSession and no "
-                               "spark_context given")
-        spark_context = session.sparkContext
+    spark_context = _resolve_context(spark_context)
     if num_proc is None:
         num_proc = spark_context.defaultParallelism
 
@@ -174,4 +186,164 @@ def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
             raise holder["error"]
         return [r for _, r in sorted(holder["results"])]
     finally:
+        rdv.stop()
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None,
+                start_timeout: float = 600.0,
+                elastic_timeout: float = 600.0,
+                reset_limit: Optional[int] = None,
+                env: Optional[Dict[str, str]] = None,
+                spark_context=None):
+    """Run ``fn`` elastically inside Spark tasks (reference
+    ``horovod.spark.run_elastic``, spark/runner.py:303-417): ``max_np``
+    long-lived Spark tasks form a worker pool, the elastic driver
+    (runner/elastic_driver.py) discovers the alive tasks, execs workers
+    inside them, and rescales between ``min_np`` and ``max_np`` as
+    tasks come and go (executor loss, dynamic allocation). ``fn`` owns
+    its elastic state via ``hvd.elastic.run``, like the reference's fn
+    contract. Returns the FINAL topology's per-rank results in rank
+    order.
+
+    Composition mirrors ray/__init__.py ElasticRayExecutor.run: a
+    pluggable discovery + spawner pair over the shared elastic driver;
+    here both ride the driver-hosted rendezvous KV, which Spark
+    executors can reach (spark.driver.host)."""
+    import argparse
+    import pickle
+    import sys
+    import threading
+    import time
+
+    import cloudpickle
+
+    from ..runner.elastic_driver import run_elastic as _run_elastic
+    from .elastic_worker import RESULT_SCOPE
+    from .task_pool import (SCOPE as POOL_SCOPE, SparkPoolSpawner,
+                            SparkTaskPoolDiscovery, make_pool_mapper)
+
+    spark_context = _resolve_context(spark_context)
+    if num_proc is None:
+        num_proc = spark_context.defaultParallelism
+    min_np = min_np or num_proc
+    max_np = max_np or num_proc
+
+    driver_host = spark_context.getConf().get("spark.driver.host",
+                                              socket.gethostname())
+    import secrets as _secrets
+
+    job_secret = _secrets.token_hex(16)
+    rdv = RendezvousServer("0.0.0.0", secret=job_secret.encode())
+    rdv_port = rdv.start()
+    client = RendezvousClient("127.0.0.1", rdv_port, timeout_s=30.0,
+                              secret=job_secret.encode())
+    job_group = "horovod_tpu.spark.elastic"
+    pool_holder: Dict[str, Any] = {}
+    pool_thread: Optional[Any] = None
+
+    def pool_job():
+        try:
+            spark_context.setJobGroup(job_group,
+                                      "horovod_tpu elastic pool",
+                                      interruptOnCancel=True)
+            mapper = make_pool_mapper(driver_host, rdv_port, job_secret)
+            pool_holder["done"] = spark_context.parallelize(
+                range(max_np), numSlices=max_np) \
+                .mapPartitionsWithIndex(mapper).collect()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            # A lost task makes collect() fail on some backends; that is
+            # the elastic driver's business (discovery sees the stale
+            # heartbeat), not a pool-thread crash.
+            pool_holder["error"] = e
+
+    try:
+        client.put(POOL_SCOPE, "fn",
+                   cloudpickle.dumps((fn, args, kwargs or {})))
+        pool_thread = threading.Thread(target=pool_job, daemon=True)
+        pool_thread.start()
+
+        # Initial registration barrier (reference
+        # _register_task_addresses: the initial num_proc tasks must
+        # register within start_timeout) — also makes the first epoch's
+        # world size deterministic instead of racing task startup.
+        class _PoolDiscovery(SparkTaskPoolDiscovery):
+            # Total pool death must fail the run FAST with the Spark
+            # root cause, not park in the elastic slot-wait until
+            # elastic_timeout: discovery is polled from the driver's
+            # wait loops, so an empty host set + a stored pool error
+            # surfaces there.
+            def find_available_hosts_and_slots(self):
+                hosts = super().find_available_hosts_and_slots()
+                if not hosts and "error" in pool_holder:
+                    raise RuntimeError(
+                        "Spark pool job failed while the elastic run "
+                        "was waiting for tasks") from pool_holder["error"]
+                return hosts
+
+        discovery = _PoolDiscovery(client)
+        deadline = time.monotonic() + start_timeout
+        while True:
+            alive = sum(
+                discovery.find_available_hosts_and_slots().values())
+            if alive >= num_proc:
+                break
+            if "error" in pool_holder:
+                raise pool_holder["error"]
+            if time.monotonic() > deadline:
+                spark_context.cancelJobGroup(job_group)
+                raise TimeoutError(
+                    f"only {alive}/{num_proc} Spark pool tasks "
+                    f"registered within {start_timeout}s — the cluster "
+                    "cannot co-schedule the requested world (shrink "
+                    "num_proc or grow the executor pool)")
+            time.sleep(0.25)
+
+        spawner = SparkPoolSpawner(client, discovery)
+        ns = argparse.Namespace(
+            num_proc=num_proc, min_np=min_np, max_np=max_np,
+            host_discovery_script=None, hosts=None, ssh_port=None)
+        rc = _run_elastic(
+            ns,
+            [sys.executable, "-m", "horovod_tpu.spark.elastic_worker"],
+            env_extra=dict(env or {}),
+            discovery=discovery,
+            reset_limit=reset_limit,
+            slot_wait_timeout_s=elastic_timeout,
+            spawner=spawner,
+            rdv_server=rdv,
+            rdv_advertise=f"{driver_host}:{rdv_port}",
+            rdv_secret=job_secret)
+        if rc != 0:
+            crashes = []
+            for key in client.list(POOL_SCOPE):
+                if key.startswith("error/"):
+                    raw = client.get(POOL_SCOPE, key) or b""
+                    crashes.append(f"task {key[len('error/'):]}:\n"
+                                   f"{raw.decode(errors='replace')}")
+            detail = ("\n".join(crashes) if crashes
+                      else "(no task service crash reports)")
+            raise RuntimeError(
+                f"elastic Spark run failed with exit code {rc}; "
+                f"{detail}") from pool_holder.get("error")
+
+        # Collect the FINAL epoch's results (earlier epochs were aborted
+        # by rescales; their partial values are keyed by their own epoch
+        # and never mix in).
+        results = []
+        for rank in range(spawner.last_world or 0):
+            raw = client.wait(RESULT_SCOPE,
+                              f"{spawner.epoch}/{rank}", timeout_s=30.0)
+            results.append(pickle.loads(raw))
+        return results
+    finally:
+        try:
+            client.put(POOL_SCOPE, "shutdown", b"1")
+        except OSError:
+            pass
+        if pool_thread is not None:
+            pool_thread.join(timeout=30.0)
+            if pool_thread.is_alive():
+                spark_context.cancelJobGroup(job_group)
         rdv.stop()

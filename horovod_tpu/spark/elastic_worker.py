@@ -1,0 +1,51 @@
+"""Worker entry for ``horovod_tpu.spark.run_elastic`` — runs inside a
+pool task's subprocess (reference: the command gloo_run_elastic execs
+inside SparkTaskService, spark/runner.py:303-417 + gloo_run.py:326).
+
+Everything travels over the driver's rendezvous KV (executors share no
+filesystem with the driver): the cloudpickled user fn is fetched from
+``sparkpool/fn``, the per-epoch jax.distributed coordinator is
+negotiated under ``sparkep/<epoch>``, and this rank's return value is
+published to ``sparkres/<epoch>/<rank>``.
+
+The user fn owns its elastic state handling (``hvd.elastic.run``), like
+the reference's run_elastic fn contract."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+from ..runner.rendezvous import RendezvousClient
+from . import negotiate_coordinator
+from .task_pool import SCOPE as POOL_SCOPE
+
+RESULT_SCOPE = "sparkres"
+
+
+def main() -> int:
+    addr = os.environ["HVD_TPU_RENDEZVOUS"]
+    host, port = addr.rsplit(":", 1)
+    secret = os.environ.get("HVD_TPU_RENDEZVOUS_SECRET", "")
+    client = RendezvousClient(host, int(port), timeout_s=30.0,
+                              secret=secret.encode() if secret else None)
+    epoch = int(os.environ["HVD_TPU_SPARK_EPOCH"])
+    rank = int(os.environ["HVD_TPU_PROC_ID"])
+    world = int(os.environ["HVD_TPU_NUM_PROC"])
+
+    env = negotiate_coordinator(client, rank, world,
+                                scope=f"sparkep/{epoch}")
+    os.environ.update(env)
+
+    import cloudpickle
+
+    blob = client.wait(POOL_SCOPE, "fn", timeout_s=60.0)
+    fn, args, kwargs = cloudpickle.loads(blob)
+    value = fn(*args, **kwargs)
+    client.put(RESULT_SCOPE, f"{epoch}/{rank}", pickle.dumps(value))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

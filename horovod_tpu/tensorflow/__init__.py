@@ -310,6 +310,69 @@ broadcast_object = _hvd.broadcast_object
 allgather_object = _hvd.allgather_object
 
 
+def BroadcastGlobalVariablesHook(root_rank: int = 0, device: str = "",
+                                 process_set=None):
+    """TF1 estimator/MonitoredSession hook (reference
+    tensorflow/__init__.py:211-244): broadcasts ALL global variables
+    from ``root_rank`` right after session creation, so every worker
+    starts from identical state under random init or a root-only
+    checkpoint restore.
+
+    Factory returning a ``tf.compat.v1.train.SessionRunHook`` instance
+    (a factory, not a module-level class, because the shim loads TF
+    lazily). Mechanics differ from the reference by design: the
+    reference builds an in-graph broadcast op; here values round-trip
+    through the engine's XLA broadcast at ``after_create_session`` time
+    and re-enter the graph through placeholder-fed assigns — graph-mode
+    sessions can't host the JAX collective, and a one-time startup
+    broadcast has no steady-state performance budget. ``device`` is
+    accepted for API parity and ignored (placement is XLA's business).
+
+    Usage (drop-in):
+        hooks = [hvd.BroadcastGlobalVariablesHook(0)]
+        with tf.compat.v1.train.MonitoredTrainingSession(
+                hooks=hooks, ...) as sess: ...
+    """
+    tf = _tf()
+    v1 = tf.compat.v1
+    e = _engine(process_set)
+
+    class _BroadcastGlobalVariablesHook(v1.train.SessionRunHook):
+        def __init__(self):
+            self.root_rank = root_rank
+            self._vars = []
+            self._placeholders = []
+            self._assigns = []
+
+        def begin(self):
+            # Graph-build time: one placeholder-fed assign per global
+            # variable (ops must exist before the session finalizes the
+            # graph — MonitoredSession forbids post-begin graph edits).
+            self._vars = list(v1.global_variables())
+            self._placeholders = [
+                v1.placeholder(v.dtype.base_dtype, v.shape)
+                for v in self._vars]
+            self._assigns = [
+                v1.assign(v, ph)
+                for v, ph in zip(self._vars, self._placeholders)]
+
+        def after_create_session(self, session, coord):
+            values = session.run(self._vars)
+            for i, (var, ph, assign, val) in enumerate(
+                    zip(self._vars, self._placeholders, self._assigns,
+                        values)):
+                arr = np.asarray(val)
+                out = _to_host(_hvd.broadcast(
+                    e.replicate(arr), self.root_rank,
+                    name=f"v1hook.{getattr(var, 'name', i)}",
+                    process_set=process_set))
+                out = out.astype(arr.dtype, copy=False)
+                session.run(assign,
+                            feed_dict={ph: out.reshape(arr.shape)})
+
+    return _BroadcastGlobalVariablesHook()
+
+
 # -- DistributedGradientTape (reference tensorflow/__init__.py:564-629) -----
 
 class _DistributedGradientTape:

@@ -52,12 +52,48 @@ def shutdown() -> None:
 
 
 def nodes() -> List[Dict[str, Any]]:
+    if _STATE.get("nodes") is not None:
+        return [dict(n) for n in _STATE["nodes"]]
     return [{
         "Alive": True,
         "NodeManagerHostname": socket.gethostname(),
         "NodeManagerAddress": "127.0.0.1",
         "Resources": {"CPU": float(os.cpu_count() or 1)},
     }]
+
+
+# -- dynamic cluster membership (test hooks, not ray API) -------------------
+#
+# RayHostDiscovery reads ray.nodes() on every elastic discovery poll;
+# these hooks let tests script node arrival/loss (the autoscaling and
+# node-death scenarios the reference's ElasticRayExecutor rides Ray
+# for) without a real cluster.
+
+def _set_nodes(hostnames_to_cpus: Dict[str, float]) -> None:
+    _STATE["nodes"] = [{
+        "Alive": True,
+        "NodeManagerHostname": h,
+        "NodeManagerAddress": "127.0.0.1",
+        "Resources": {"CPU": float(c)},
+    } for h, c in hostnames_to_cpus.items()]
+
+
+def _remove_node(hostname: str) -> None:
+    """Simulate node loss: the node drops from ray.nodes() (Ray also
+    reports dead nodes with Alive=False for a while — model both)."""
+    kept = []
+    for n in _STATE.get("nodes") or []:
+        if n["NodeManagerHostname"] == hostname:
+            dead = dict(n)
+            dead["Alive"] = False
+            kept.append(dead)
+        else:
+            kept.append(n)
+    _STATE["nodes"] = kept
+
+
+def _reset_nodes() -> None:
+    _STATE["nodes"] = None
 
 
 def _actor_main(conn, cls_blob: bytes) -> None:

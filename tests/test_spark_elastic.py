@@ -1,0 +1,194 @@
+"""spark.run / spark.run_elastic over the process-backed fake-executor
+tier (VERDICT r4 #2/#3): real OS processes host the Spark tasks, real
+subprocesses host the elastic workers, and executor loss is injected by
+killing a live task process — the analog of the reference's
+test/integration/test_spark.py elastic scenarios, minus pyspark itself
+(not installable here; tests/test_real_integrations.py carries the
+real-pyspark legs).
+
+Reference semantics under test: horovod/spark/runner.py:132-417 (run +
+run_elastic contracts: per-rank results in rank order; elastic world
+shrinks between min_np and max_np when tasks die, training resumes)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+import horovod_tpu.spark as hvd_spark
+from horovod_tpu.testing.fake_spark import FakeSparkContext
+
+# Worker processes are fresh interpreters; like pyspark, cloudpickle
+# serializes module-level test fns by REFERENCE, so workers must be able
+# to import this module (real jobs ship their code the same way).
+_WORKER_ENV = {
+    "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)) + ":"
+                  + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def _probe_fn(tag):
+    """Returns this worker's identity + negotiated env (no jax — the
+    composition under test is discovery/spawn/negotiate/collect; real
+    collectives under elastic churn are covered by
+    test_elastic_integration.py)."""
+    return (tag,
+            int(os.environ["HVD_TPU_PROC_ID"]),
+            int(os.environ["HVD_TPU_NUM_PROC"]),
+            os.environ["HVD_TPU_COORDINATOR"])
+
+
+def _parked_until_shrunk_fn():
+    """Parks while the world is 3 wide (until the epoch is torn down),
+    completes at any smaller world — makes the shrink deterministic.
+    No orphan guard needed here: pool workers carry PR_SET_PDEATHSIG
+    (task_pool._worker_pdeathsig), so the killed task's parked worker
+    dies with its service — the production path, exercised by this
+    test."""
+    world = int(os.environ["HVD_TPU_NUM_PROC"])
+    if world >= 3:
+        time.sleep(600)
+        return ("never", -1, world)
+    return ("resumed", int(os.environ["HVD_TPU_PROC_ID"]), world)
+
+
+def test_spark_run_mapper_path_via_stub():
+    """The static run() path end-to-end through the pyspark-compatible
+    stub: real task processes, coordinator negotiation, rank-ordered
+    results (reference spark/runner.py:195 run contract)."""
+    ctx = FakeSparkContext(default_parallelism=2)
+    res = hvd_spark.run(_probe_fn, args=("static",), num_proc=2,
+                        spark_context=ctx, start_timeout=60.0)
+    assert [r[1] for r in res] == [0, 1]
+    assert all(r[0] == "static" and r[2] == 2 for r in res)
+    # Both ranks converged on ONE negotiated coordinator.
+    assert len({r[3] for r in res}) == 1
+
+
+def test_spark_run_elastic_full_world():
+    """run_elastic with a stable pool: all num_proc workers run inside
+    Spark tasks and report in rank order (reference
+    spark/runner.py:303 run_elastic contract)."""
+    ctx = FakeSparkContext(default_parallelism=3)
+    res = hvd_spark.run_elastic(_probe_fn, args=("elastic",),
+                                num_proc=3, min_np=2, max_np=3,
+                                spark_context=ctx, start_timeout=60.0,
+                                elastic_timeout=60.0,
+                                env=_WORKER_ENV)
+    assert [r[1] for r in res] == [0, 1, 2]
+    assert all(r[0] == "elastic" and r[2] == 3 for r in res)
+    assert len({r[3] for r in res}) == 1
+
+
+def test_spark_run_elastic_shrinks_on_task_death(monkeypatch):
+    """Fault injection (reference elastic_common.py): SIGKILL one live
+    Spark task mid-epoch -> its heartbeat goes stale -> discovery
+    shrinks the world -> a new epoch resumes at np=2 and completes."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_GRACE_SECS", "2")
+    ctx = FakeSparkContext(default_parallelism=3)
+
+    def children_of(pid):
+        try:
+            with open(f"/proc/{pid}/task/{pid}/children") as f:
+                return [int(x) for x in f.read().split()]
+        except OSError:
+            return []
+
+    def kill_one_task():
+        # Kill only once task 2's service has SPAWNED its epoch-1
+        # worker — killing during registration would just trip the
+        # start_timeout barrier, not the elastic path under test.
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            p = ctx.task_processes.get(2)
+            if p is not None and p.pid and children_of(p.pid):
+                break
+            time.sleep(0.2)
+        time.sleep(1.0)  # let the epoch settle into its parked state
+        ctx.kill_task(2)
+
+    killer = threading.Thread(target=kill_one_task, daemon=True)
+    killer.start()
+    res = hvd_spark.run_elastic(_parked_until_shrunk_fn, num_proc=3,
+                                min_np=2, max_np=3, spark_context=ctx,
+                                start_timeout=60.0,
+                                elastic_timeout=120.0,
+                                env=_WORKER_ENV)
+    killer.join(timeout=10.0)
+    assert len(res) == 2
+    assert all(r[0] == "resumed" and r[2] == 2 for r in res)
+    assert sorted(r[1] for r in res) == [0, 1]
+
+
+def test_spark_run_elastic_registration_timeout():
+    """A pool that cannot co-schedule num_proc tasks fails fast with a
+    clear TimeoutError (reference start_timeout semantics)."""
+    ctx = FakeSparkContext(default_parallelism=1,
+                           max_concurrent_tasks=1)
+    with pytest.raises(TimeoutError, match="pool tasks"):
+        hvd_spark.run_elastic(_probe_fn, args=("x",), num_proc=3,
+                              min_np=3, max_np=3, spark_context=ctx,
+                              start_timeout=3.0, elastic_timeout=5.0)
+
+
+class _FakeKV:
+    """In-memory stand-in for RendezvousClient (get/put/delete/list)."""
+
+    def __init__(self):
+        self.store = {}
+
+    def get(self, scope, key):
+        return self.store.get(f"{scope}/{key}")
+
+    def put(self, scope, key, value):
+        self.store[f"{scope}/{key}"] = value
+
+    def delete(self, scope, key):
+        self.store.pop(f"{scope}/{key}", None)
+
+    def list(self, scope):
+        p = scope + "/"
+        return [k[len(p):] for k in self.store if k.startswith(p)]
+
+
+def test_pool_handle_detects_task_reincarnation():
+    """A Spark-retried task (same index, fresh service incarnation)
+    renews the heartbeat — that must NOT mask the death of the worker
+    the previous incarnation hosted (code-review r5 finding)."""
+    from horovod_tpu.spark.task_pool import (PoolWorkerHandle, SCOPE,
+                                             SparkTaskPoolDiscovery)
+
+    kv = _FakeKV()
+    disc = SparkTaskPoolDiscovery(kv, stale_after_s=60.0)
+    kv.put(SCOPE, "hb/0", b"1:incA")
+    disc.observe_task(0)
+    h = PoolWorkerHandle(disc, kv, index=0, epoch=1,
+                         incarnation=disc.tracker.incarnation(0))
+    # Same incarnation, beating: alive.
+    kv.put(SCOPE, "hb/0", b"2:incA")
+    assert h.poll() is None
+    # Task retried: fresh incarnation heartbeats -> worker reported dead
+    # even though the heartbeat is perfectly fresh.
+    kv.put(SCOPE, "hb/0", b"1:incB")
+    assert h.poll() == 1
+
+
+def test_heartbeat_tracker_ignores_clock_skew():
+    """Liveness is judged by the VALUE changing on the driver's
+    monotonic clock, never by comparing remote timestamps (code-review
+    r5 finding: cross-host wall-clock skew must not matter)."""
+    from horovod_tpu.spark.task_pool import _HeartbeatTracker
+
+    tr = _HeartbeatTracker(stale_after_s=0.3)
+    # Values that would parse as ancient/future timestamps are fine:
+    # only change matters.
+    assert tr.observe(0, "1:x")
+    assert tr.observe(0, "2:x")
+    assert tr.observe(0, "2:x")  # unchanged but within stale window
+    time.sleep(0.4)
+    assert not tr.observe(0, "2:x")  # unchanged past the window: dead
+    assert tr.observe(0, "3:x")  # beats again: alive again
+    assert not tr.observe(1, None)  # never seen, no key: dead

@@ -346,3 +346,30 @@ def test_tensorflow_state_variables():
     v2.assign([[8.0]])
     state.restore()
     np.testing.assert_allclose(v2.numpy(), [[7.0]])
+
+
+def test_broadcast_global_variables_hook_v1_session(hvd):
+    """TF1 session-hook surface (reference tensorflow/__init__.py:211-244
+    BroadcastGlobalVariablesHook): inside a real graph-mode
+    MonitoredSession, the hook broadcasts every global variable from
+    root after session creation — begin() builds the assign ops before
+    the graph finalizes, after_create_session feeds the engine's
+    broadcast results back in."""
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvdt
+
+    v1 = tf.compat.v1
+    g = tf.Graph()
+    with g.as_default():
+        w = v1.get_variable("hook_w", initializer=np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = v1.get_variable("hook_b", initializer=np.float32(3.5))
+        hook = hvdt.BroadcastGlobalVariablesHook(0)
+        with v1.train.MonitoredTrainingSession(hooks=[hook]) as sess:
+            # Values after the hook == root's values (identity on the
+            # single-controller world, but the whole graph-mode pipeline
+            # — placeholders, assigns, engine broadcast — must run).
+            got_w, got_b = sess.run([w, b])
+    np.testing.assert_allclose(
+        got_w, np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert got_b == np.float32(3.5)

@@ -377,6 +377,73 @@ def alltoallv_skew_evidence():
     }
 
 
+def striped_evidence():
+    """Striped vs contiguous-block causal ring attention (VERDICT r4
+    #7): back the balance claim with MEASURED step times on the CPU
+    mesh, not structure alone.
+
+    Work model: both forms run n ring hops in SPMD lockstep (every hop
+    ends in a ppermute rendezvous, so a hop costs the MAX work over
+    devices). Contiguous causal: at every hop some device attends a
+    FULL visible block (device idx attends src<=idx), so the ring pays
+    ~n full block-attends of critical path while doing only n(n+1)/2
+    real ones — the drained-tail imbalance. Striped (interleaved
+    layout): every device does the same ~half-block of triangular work
+    on every hop — critical path ~n half-blocks, ideal ratio -> 2x at
+    large n. With n=8 the model predicts contiguous/striped =
+    n / ((n+1)/2) = 1.78x; the measured ratio below is the evidence
+    (CPU-mesh caveat: 8 virtual devices share host cores, which
+    under-reports lockstep stalls, so the measured ratio is a floor)."""
+    import time as _time
+
+    from jax.sharding import Mesh
+
+    from horovod_tpu.parallel.ring_attention import (ring_attention,
+                                                     striped_attention)
+
+    hvd.init()
+    mesh = Mesh(np.array(hvd._ctx().mesh.devices), ("sp",))
+    n = 8
+    b, s_total, h, d = 1, 2048, 4, 64
+    rng = np.random.default_rng(3)
+    q = rng.standard_normal((b, s_total, h, d)).astype(np.float32)
+
+    def make(fn):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(None, "sp"),
+            out_specs=P(None, "sp"), check_vma=False))
+
+    ring_f = make(lambda q, k, v: ring_attention(q, k, v, "sp",
+                                                 causal=True))
+    striped_f = make(lambda q, k, v: striped_attention(q, k, v, "sp"))
+
+    def bench(f, iters=20):
+        f(q, q, q).block_until_ready()  # compile + warm
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = f(q, q, q)
+        out.block_until_ready()
+        return (_time.perf_counter() - t0) / iters * 1e3
+
+    ring_ms = bench(ring_f)
+    striped_ms = bench(striped_f)
+    return {
+        "shape": f"b={b} S={s_total} (S_local={s_total // n}) h={h} "
+                 f"d={d}, n={n} ring hops",
+        "contiguous_causal_ms": round(ring_ms, 2),
+        "striped_ms": round(striped_ms, 2),
+        "measured_ratio": round(ring_ms / striped_ms, 2),
+        "model_ratio_n8": round(n / ((n + 1) / 2), 2),
+        "model_ratio_large_n": 2.0,
+        "note": "lockstep hops cost max-over-devices work: contiguous "
+                "causal always has one device attending a full block "
+                "per hop (drained tail); striped gives every device the "
+                "same triangular half-block. CPU-mesh measurement is a "
+                "floor on the TPU ratio (shared host cores hide "
+                "lockstep stalls); the queue carries an on-chip row.",
+    }
+
+
 def host_gap_evidence():
     """Wall-vs-device rate from the captured profiled runs (VERDICT r3
     #3: the r03 per-iteration loss fetch cost 14% of wall time; the
@@ -564,6 +631,7 @@ if __name__ == "__main__":
         "overlap": overlap_evidence,
         "pipeline": pipeline_evidence,
         "alltoallv_skew": alltoallv_skew_evidence,
+        "striped": striped_evidence,
         "host_gap": host_gap_evidence,
         "scaling": scaling_projection,
     }

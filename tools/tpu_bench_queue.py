@@ -62,6 +62,7 @@ JOBS = [
                     "--model", "inception3", "--batch-size", "128"],
      1200),
     ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
+    ("striped", ["tools/tpu_microbench.py", "striped"], 900),
     ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
     ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
     # r04 configs carry the new levers: s2d stem (CNN default), bf16

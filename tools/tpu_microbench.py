@@ -139,6 +139,70 @@ def flash_section():
     return out
 
 
+def striped_section():
+    """Per-hop kernel costs of striped attention, single chip (VERDICT
+    r4 #7's on-chip row). A single chip cannot host the n-device ring
+    itself (the CPU-mesh ratio lives in perf_evidence.py striped); what
+    it CAN prove is the piece the CPU interpreter can't: the three hop
+    kernels striped/contiguous rings actually dispatch, on real MXU —
+
+      full_block    — non-causal full SxS block (contiguous ring's
+                      worst hop, the one that sets its critical path)
+      causal_block  — triangular diagonal hop (both forms)
+      strict_block  — striped's strict-diagonal fallback (roll-by-one +
+                      key-mask, ring_attention.py kernel_block): must
+                      cost ~the causal block, NOT the full one, or the
+                      balance claim dies at the kernel level.
+
+    ring hop cost = max over devices; striped's claim needs
+    strict ~= causal << full-is-not-needed-every-hop."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import flash_attention as fa
+
+    rng = jax.random.PRNGKey(5)
+    B, H, D = (1, 2, 64) if SMALL else (4, 8, 64)
+    out = {}
+    for S in (256,) if SMALL else (1024, 2048):
+        q, k, v = (jax.random.normal(jax.random.fold_in(rng, i),
+                                     (B, S, H, D), dtype=jnp.bfloat16)
+                   for i in range(3))
+        kmask = jnp.ones((B, S), jnp.float32).at[:, 0].set(0.0)
+
+        # flash_attention auto-selects the Pallas kernel on TPU (jnp
+        # fallback keeps the CPU --small smoke meaningful). The strict
+        # hop is exactly striped's kernel_block form: roll K/V one right
+        # + mask the wrapped slot (ring_attention.py:250-261).
+        full_f = jax.jit(
+            lambda q, k, v: fa.flash_attention(q, k, v, causal=False))
+        causal_f = jax.jit(
+            lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
+        strict_f = jax.jit(
+            lambda q, k, v: fa.flash_attention(
+                q, jnp.roll(k, 1, axis=1), jnp.roll(v, 1, axis=1),
+                mask=kmask, causal=True))
+
+        row = {}
+        for key, fn in (("full_block_ms", lambda: full_f(q, k, v)),
+                        ("causal_block_ms", lambda: causal_f(q, k, v)),
+                        ("strict_block_ms", lambda: strict_f(q, k, v))):
+            try:
+                row[key] = round(_time_ms(fn), 3)
+            except Exception as e:  # noqa: BLE001 — evidence collection
+                row[key] = (
+                    f"failed: {(str(e) or repr(e)).splitlines()[0][:120]}")
+        if all(isinstance(row.get(f"{p}_block_ms"), float)
+               for p in ("full", "causal", "strict")):
+            row["strict_vs_causal"] = round(
+                row["strict_block_ms"] / row["causal_block_ms"], 2)
+            row["full_vs_causal"] = round(
+                row["full_block_ms"] / row["causal_block_ms"], 2)
+        out[f"S={S}"] = row
+        _log(f"striped hop kernels S={S}: {row}")
+    return out
+
+
 def overlap_section():
     import jax
     import jax.numpy as jnp
@@ -235,8 +299,8 @@ def fusion_section():
     return out
 
 
-SECTIONS = {"flash": flash_section, "overlap": overlap_section,
-            "fusion": fusion_section}
+SECTIONS = {"flash": flash_section, "striped": striped_section,
+            "overlap": overlap_section, "fusion": fusion_section}
 
 
 def main():
