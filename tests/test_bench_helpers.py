@@ -42,3 +42,71 @@ def test_transformer_model_flops_bert_large_magnitude():
     params = {"w": np.zeros((int(p_bert),), np.int8)}
     got = bench._transformer_model_flops(params, 24, 1024, 512)
     assert 0.9e12 < got < 1.4e12, got
+
+
+def test_cached_tpu_record_fallthrough(tmp_path, monkeypatch):
+    """The cached-chip-record lookup (ADVICE r4 / code-review r5): a
+    corrupt or stale record in a NEWER round dir must fall through to a
+    valid older one, never shadow it; config-altering flags disable the
+    lookup entirely."""
+    import json
+    import time as _time
+
+    import bench as b
+    from tools.round_dirs import SEARCH_ORDER
+
+    newest, older = SEARCH_ORDER[0], SEARCH_ORDER[1]
+    # Point bench at a fake repo root with fake round dirs (scoped to
+    # the module under test — never the process-global os.path), and
+    # pre-seed sys.path so bench's own one-time insert of the fake root
+    # is skipped (monkeypatch would not revert it).
+    monkeypatch.setattr(b, "__file__", str(tmp_path / "bench.py"))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    good = {"platform": "tpu", "value": 123.0,
+            "captured_unix": int(_time.time()) - 3600}
+    for rdir, content in ((newest, "{corrupt"),
+                          (older, json.dumps(good))):
+        d = tmp_path / "results" / rdir
+        d.mkdir(parents=True)
+        (d / "resnet50.json").write_text(content)
+
+    rec = b._cached_tpu_record([], "resnet50")
+    assert rec is not None and rec["value"] == 123.0
+    assert rec["cached"] is True and rec["cached_age_h"] == 1.0
+
+    # Config-altering flags (anything but --model) disable the lookup.
+    assert b._cached_tpu_record(["--batch-size", "512"],
+                                "resnet50") is None
+    assert b._cached_tpu_record(["--model", "resnet50"],
+                                "resnet50") is not None
+
+    # A non-TPU record never serves as chip evidence.
+    (tmp_path / "results" / newest / "resnet50.json").write_text(
+        json.dumps({**good, "platform": "cpu"}))
+    rec = b._cached_tpu_record([], "resnet50")
+    assert rec["value"] == 123.0  # fell through to the r04 tpu record
+
+    # Past the 48h cap every record is refused.
+    stale = {**good, "captured_unix": int(_time.time()) - 49 * 3600}
+    (tmp_path / "results" / older / "resnet50.json").write_text(
+        json.dumps(stale))
+    (tmp_path / "results" / newest / "resnet50.json").write_text(
+        "{corrupt")
+    assert b._cached_tpu_record([], "resnet50") is None
+
+
+def test_round_dirs_single_source():
+    """bench, the queue, and the tools must agree on the round dirs
+    (code-review r5: the r4->r5 bump missed two of four files)."""
+    from tools.round_dirs import CURRENT, SEARCH_ORDER
+
+    assert SEARCH_ORDER[0] == CURRENT
+    import tools.tpu_bench_queue as q
+
+    assert q.OUTDIR.endswith(CURRENT)
+    import tools.tpu_elastic_reset as er
+
+    assert er._ROUND == CURRENT
+    import tools.perf_evidence as pe
+
+    assert tuple(pe._round_search_order()) == tuple(SEARCH_ORDER)
