@@ -72,12 +72,16 @@ def test_run_kwargs_roundtrip():
 
 
 @pytest.mark.slow
-def test_run_alltoallv_negotiated_splits():
+@pytest.mark.parametrize("chunked", [None, True])
+def test_run_alltoallv_negotiated_splits(chunked):
     """Dynamic alltoallv across a REAL 2-process world: each rank passes
     only its LOCAL split vector; recv splits arrive via the controller
-    exchange (reference: AlltoallGetRecvSplits, controller.h:56-58)."""
+    exchange (reference: AlltoallGetRecvSplits, controller.h:56-58).
+    Both wire forms (flat-auto and forced chunked) must return the same
+    rows — the auto-route has to be safe to engage in multi-process
+    mode."""
 
-    def work():
+    def work(chunked=chunked):
         import os
 
         import numpy as np
@@ -93,7 +97,8 @@ def test_run_alltoallv_negotiated_splits():
         rows = sum(splits)
         x = np.full((rows, 2), 10.0 * (rank + 1), np.float32)
         x[:, 1] = np.arange(rows)  # row ids for order checking
-        out = hvd.alltoall(x, splits=splits, name="a2av")
+        out = hvd.alltoall(x, splits=splits, name=f"a2av_{chunked}",
+                           chunked=chunked)
         return out.tolist()
 
     results = runner.run(work, np=2, env={
@@ -174,3 +179,44 @@ def test_run_diverged_shape_errors_not_hangs():
     })
     assert [r[0] for r in results] == ["mismatch", "mismatch"], results
     assert all(r[1] for r in results), results
+
+
+@pytest.mark.slow
+def test_run_alltoallv_chunked_flag_divergence_errors():
+    """code-review r5 guard rail: ranks passing DIFFERENT explicit
+    `chunked` wire forms to alltoallv must get a field-level
+    TensorShapeMismatchError (the choice rides the negotiation), not
+    compile a ppermute chain on one side and a single all_to_all on the
+    other and hang."""
+
+    def work():
+        import os
+
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu.common.exceptions import TensorShapeMismatchError
+
+        hvd.shutdown()
+        hvd.init(force_cpu_devices=1, stall_check_time_seconds=20.0)
+        assert hvd.size() == 2
+        rank = int(os.environ["HVD_TPU_PROC_ID"])
+        x = np.ones((2, 2), np.float32)
+        try:
+            hvd.alltoall(x, splits=[1, 1], name="a2av_div",
+                         chunked=(rank == 0))  # rank 1 diverges
+        except TensorShapeMismatchError as e:
+            # Must be the NEGOTIATED field-level report, not a local
+            # pre-negotiation validation error.
+            return ("mismatch" if "mismatched collective" in str(e)
+                    or "did not submit" in str(e)
+                    else f"local-error: {e}")
+        except Exception as e:  # noqa: BLE001
+            return f"other: {e!r}"
+        return "no-error"
+
+    results = runner.run(work, np=2, env={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HVD_TPU_FORCE_CPU_DEVICES": "1",
+    })
+    assert results == ["mismatch", "mismatch"], results
