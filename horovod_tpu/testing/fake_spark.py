@@ -78,12 +78,14 @@ class FakeRDD:
         out_queue = _mp.Queue()
         procs: Dict[int, Any] = {}
         pending = list(enumerate(self._partitions))
-        cap = self._ctx.max_concurrent_tasks or len(pending)
 
         def _schedule():
             # Spark's scheduler model: at most `cap` concurrent tasks;
             # the rest wait for a free slot (this is what starves a
-            # too-large pool and trips the registration barrier).
+            # too-large pool and trips the registration barrier). The
+            # cap is re-read each pass so tests can grow the "cluster"
+            # mid-job (dynamic allocation adding executors).
+            cap = self._ctx.max_concurrent_tasks or len(self._partitions)
             while pending and \
                     sum(p.is_alive() for p in procs.values()) < cap:
                 i, part = pending.pop(0)

@@ -30,6 +30,16 @@ _WORKER_ENV = {
 }
 
 
+def _children_of(pid):
+    """Child pids of a live process (Linux /proc; used to detect that a
+    task service has spawned its epoch worker)."""
+    try:
+        with open(f"/proc/{pid}/task/{pid}/children") as f:
+            return [int(x) for x in f.read().split()]
+    except (OSError, ValueError):
+        return []
+
+
 def _probe_fn(tag):
     """Returns this worker's identity + negotiated env (no jax — the
     composition under test is discovery/spawn/negotiate/collect; real
@@ -90,13 +100,6 @@ def test_spark_run_elastic_shrinks_on_task_death(monkeypatch):
     monkeypatch.setenv("HVD_TPU_ELASTIC_GRACE_SECS", "2")
     ctx = FakeSparkContext(default_parallelism=3)
 
-    def children_of(pid):
-        try:
-            with open(f"/proc/{pid}/task/{pid}/children") as f:
-                return [int(x) for x in f.read().split()]
-        except OSError:
-            return []
-
     def kill_one_task():
         # Kill only once task 2's service has SPAWNED its epoch-1
         # worker — killing during registration would just trip the
@@ -104,7 +107,7 @@ def test_spark_run_elastic_shrinks_on_task_death(monkeypatch):
         deadline = time.time() + 60.0
         while time.time() < deadline:
             p = ctx.task_processes.get(2)
-            if p is not None and p.pid and children_of(p.pid):
+            if p is not None and p.pid and _children_of(p.pid):
                 break
             time.sleep(0.2)
         time.sleep(1.0)  # let the epoch settle into its parked state
@@ -192,3 +195,52 @@ def test_heartbeat_tracker_ignores_clock_skew():
     assert not tr.observe(0, "2:x")  # unchanged past the window: dead
     assert tr.observe(0, "3:x")  # beats again: alive again
     assert not tr.observe(1, None)  # never seen, no key: dead
+
+
+def _parked_until_grown_fn():
+    """Parks at a 2-wide world, completes once the pool has grown to 3
+    (the dynamic-allocation scale-up contract)."""
+    world = int(os.environ["HVD_TPU_NUM_PROC"])
+    if world <= 2:
+        time.sleep(600)
+        return ("never", -1, world)
+    return ("grown", int(os.environ["HVD_TPU_PROC_ID"]), world)
+
+
+def test_spark_run_elastic_grows_on_new_task(monkeypatch):
+    """Growth half of the elastic contract (docs/elastic.md: a newly
+    scheduled task registers -> world grows): the fake cluster starts
+    with capacity for 2 of the 3 pool tasks; raising the co-scheduling
+    cap mid-epoch starts the third, discovery sees the new virtual
+    host, and the run completes at np=3."""
+    monkeypatch.setenv("HVD_TPU_ELASTIC_GRACE_SECS", "2")
+    ctx = FakeSparkContext(default_parallelism=3,
+                           max_concurrent_tasks=2)
+
+    def grow_cluster():
+        # Wait until epoch 1's parked workers are running, then add
+        # capacity for the third task.
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            # Snapshot: collect() inserts into task_processes from
+            # another thread while we iterate.
+            running = [p for p in list(ctx.task_processes.values())
+                       if p.is_alive()]
+            if len(running) >= 2 and any(_children_of(p.pid)
+                                         for p in running):
+                break
+            time.sleep(0.2)
+        time.sleep(1.0)
+        ctx.max_concurrent_tasks = 3
+
+    grower = threading.Thread(target=grow_cluster, daemon=True)
+    grower.start()
+    res = hvd_spark.run_elastic(_parked_until_grown_fn, num_proc=2,
+                                min_np=2, max_np=3, spark_context=ctx,
+                                start_timeout=60.0,
+                                elastic_timeout=120.0,
+                                env=_WORKER_ENV)
+    grower.join(timeout=10.0)
+    assert len(res) == 3
+    assert all(r[0] == "grown" and r[2] == 3 for r in res)
+    assert sorted(r[1] for r in res) == [0, 1, 2]
