@@ -231,6 +231,46 @@ def write_result(name, payload):
                 combined[n] = json.load(f)
     with open(os.path.join(OUTDIR, "results.json"), "w") as f:
         json.dump(combined, f, indent=2)
+    _write_summary_md(combined)
+
+
+def _write_summary_md(combined):
+    """Digest the captures into a human-readable table after every job,
+    so a window served while nobody is watching still leaves curated
+    evidence (not just raw JSON) for the round record."""
+    lines = [
+        "# TPU capture summary (auto-generated by tpu_bench_queue)",
+        "",
+        "One row per captured job; raw records sit beside this file.",
+        "",
+        "| job | metric | value | unit | model-MFU % | exec-MFU % | "
+        "vs_baseline | captured (unix) |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name, rec in sorted(combined.items()):
+        if not isinstance(rec, dict):
+            continue
+        lines.append(
+            f"| {name} | {rec.get('metric', '—')} "
+            f"| {rec.get('value', '—')} | {rec.get('unit', '—')} "
+            f"| {rec.get('mfu_model_pct', '—')} "
+            f"| {rec.get('mfu_exec_pct', '—')} "
+            f"| {rec.get('vs_baseline', '—')} "
+            f"| {rec.get('captured_unix', '—')} |")
+    lines += [
+        "",
+        "Microbench jobs (flash/striped/overlap/fusion/elastic_reset) "
+        "carry structured payloads — see their JSON.",
+    ]
+    try:
+        # utf-8 explicitly: the em-dash placeholders are this script's
+        # only non-ASCII output, and a LANG=C queue host must not die
+        # mid-serving-window on an encoding error.
+        with open(os.path.join(OUTDIR, "SUMMARY.md"), "w",
+                  encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+    except (OSError, ValueError) as e:
+        _log(f"summary write failed ({e})")
 
 
 def main():
