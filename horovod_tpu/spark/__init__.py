@@ -121,12 +121,58 @@ def _resolve_context(spark_context):
     return session.sparkContext
 
 
-def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
+_drop_in_warned = False
+
+
+def _absorb_drop_in_knobs(caller: str, **knobs) -> None:
+    """Accept (and honestly dispose of) the reference signature's extra
+    knobs so `import horovod_tpu.spark as spark` is call-compatible
+    (reference spark/runner.py:195/303). ``verbose>=2`` raises the
+    package log level; the transport/stream knobs have no TPU meaning
+    (one XLA data plane; worker output goes to Spark task logs) and are
+    warned about once per process when set."""
+    import logging as _logging
+
+    verbose = knobs.pop("verbose", None)
+    if verbose is not None and verbose >= 2:
+        _logging.getLogger("horovod_tpu").setLevel(_logging.DEBUG)
+    # None/()/False are the reference's own "unset" defaults — only a
+    # knob the caller actively set deserves the warning.
+    ignored = {k: v for k, v in knobs.items()
+               if v not in (None, (), False)}
+    if ignored:
+        global _drop_in_warned
+        if not _drop_in_warned:
+            _drop_in_warned = True
+            import warnings
+
+            warnings.warn(
+                f"{caller}: ignoring reference-signature knobs with no "
+                f"TPU meaning: {sorted(ignored)} (one XLA data plane — "
+                "no MPI/gloo transport choice, no NIC selection; worker "
+                "stdout/stderr go to the Spark task logs)",
+                UserWarning, stacklevel=3)
+
+
+def run(fn, args=(), kwargs=None, num_proc: Optional[int] = None, *,
         spark_context=None, env: Optional[Dict[str, str]] = None,
-        start_timeout: float = 600.0):
+        start_timeout: float = 600.0, use_mpi=None, use_gloo=None,
+        extra_mpi_args=None, stdout=None, stderr=None, verbose=1,
+        nics=None, prefix_output_with_timestamp=False):
     """Run ``fn`` as ``num_proc`` workers inside Spark tasks; returns
     per-rank results in rank order (reference horovod.spark.run
-    contract, spark/runner.py:195+)."""
+    contract, spark/runner.py:195+). Everything past ``num_proc`` is
+    keyword-only on purpose: the reference's positional order diverges
+    there (its 5th positional is start_timeout where this signature
+    adds spark_context), so a positional reference call fails loudly
+    (TypeError) instead of silently misbinding. The compat knobs
+    (use_mpi/.../prefix_output_with_timestamp) are absorbed — see
+    :func:`_absorb_drop_in_knobs`."""
+    _absorb_drop_in_knobs(
+        "horovod_tpu.spark.run", verbose=verbose, use_mpi=use_mpi,
+        use_gloo=use_gloo, extra_mpi_args=extra_mpi_args, stdout=stdout,
+        stderr=stderr, nics=nics,
+        prefix_output_with_timestamp=prefix_output_with_timestamp)
     spark_context = _resolve_context(spark_context)
     if num_proc is None:
         num_proc = spark_context.defaultParallelism
@@ -195,8 +241,8 @@ def run_elastic(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
                 start_timeout: float = 600.0,
                 elastic_timeout: float = 600.0,
                 reset_limit: Optional[int] = None,
-                env: Optional[Dict[str, str]] = None,
-                spark_context=None):
+                env: Optional[Dict[str, str]] = None, *,
+                spark_context=None, verbose=1, nics=None):
     """Run ``fn`` elastically inside Spark tasks (reference
     ``horovod.spark.run_elastic``, spark/runner.py:303-417): ``max_np``
     long-lived Spark tasks form a worker pool, the elastic driver
@@ -210,7 +256,10 @@ def run_elastic(fn, args=(), kwargs=None, num_proc: Optional[int] = None,
     Composition mirrors ray/__init__.py ElasticRayExecutor.run: a
     pluggable discovery + spawner pair over the shared elastic driver;
     here both ride the driver-hosted rendezvous KV, which Spark
-    executors can reach (spark.driver.host)."""
+    executors can reach (spark.driver.host). ``verbose``/``nics`` exist
+    for drop-in call compatibility (reference spark/runner.py:303)."""
+    _absorb_drop_in_knobs("horovod_tpu.spark.run_elastic",
+                          verbose=verbose, nics=nics)
     import argparse
     import pickle
     import sys

@@ -244,3 +244,47 @@ def test_spark_run_elastic_grows_on_new_task(monkeypatch):
     assert len(res) == 3
     assert all(r[0] == "grown" and r[2] == 3 for r in res)
     assert sorted(r[1] for r in res) == [0, 1, 2]
+
+
+def test_drop_in_signature_knobs_absorbed():
+    """Reference-signature extras (use_mpi/use_gloo/nics/stdout/...)
+    are call-compatible: meaningless-on-TPU knobs warn once and are
+    ignored; verbose>=2 raises the package log level (drop-in
+    migration contract, reference spark/runner.py:195/303)."""
+    import inspect
+    import logging
+    import warnings
+
+    for fn, extras in ((hvd_spark.run,
+                        {"use_mpi", "use_gloo", "extra_mpi_args",
+                         "stdout", "stderr", "verbose", "nics"}),
+                       (hvd_spark.run_elastic, {"verbose", "nics"})):
+        assert extras <= set(inspect.signature(fn).parameters), fn
+
+    hvd_spark._drop_in_warned = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        hvd_spark._absorb_drop_in_knobs("t", verbose=2, use_mpi=True)
+    assert any("no TPU meaning" in str(x.message) for x in w)
+    assert logging.getLogger("horovod_tpu").level == logging.DEBUG
+    logging.getLogger("horovod_tpu").setLevel(logging.NOTSET)
+    # Defaulted/None/False knobs stay silent — a plain run(fn) call
+    # must never warn (code-review r5: the False default of
+    # prefix_output_with_timestamp used to trip the filter AND latch
+    # the once-flag, eating the warning for real misuse later).
+    hvd_spark._drop_in_warned = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        hvd_spark._absorb_drop_in_knobs(
+            "t", verbose=1, nics=None, stdout=None,
+            prefix_output_with_timestamp=False)
+    assert not w
+    # Positional misuse of the reference's ordering fails loudly: the
+    # reference's 5th positional is start_timeout, which here sits past
+    # the keyword-only barrier.
+    with pytest.raises(TypeError):
+        hvd_spark.run(_probe_fn, (), None, 2, 300.0)
+    with pytest.raises(TypeError):
+        # reference run_elastic's 11th positional (verbose).
+        hvd_spark.run_elastic(_probe_fn, (), None, 2, 2, 3, 300.0,
+                              300.0, None, None, 1)

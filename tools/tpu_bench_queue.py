@@ -247,16 +247,18 @@ def _write_summary_md(combined):
         "vs_baseline | captured (unix) |",
         "|---|---|---|---|---|---|---|---|",
     ]
+    def cell(v):
+        # Raw record strings must not break the table structure.
+        return str(v).replace("|", "\\|").replace("\n", " ")
+
     for name, rec in sorted(combined.items()):
         if not isinstance(rec, dict):
             continue
-        lines.append(
-            f"| {name} | {rec.get('metric', '—')} "
-            f"| {rec.get('value', '—')} | {rec.get('unit', '—')} "
-            f"| {rec.get('mfu_model_pct', '—')} "
-            f"| {rec.get('mfu_exec_pct', '—')} "
-            f"| {rec.get('vs_baseline', '—')} "
-            f"| {rec.get('captured_unix', '—')} |")
+        row = [cell(name)] + [
+            cell(rec.get(k, "—"))
+            for k in ("metric", "value", "unit", "mfu_model_pct",
+                      "mfu_exec_pct", "vs_baseline", "captured_unix")]
+        lines.append("| " + " | ".join(row) + " |")
     lines += [
         "",
         "Microbench jobs (flash/striped/overlap/fusion/elastic_reset) "
