@@ -21,6 +21,9 @@ import pytest
 import horovod_tpu.spark as hvd_spark
 from horovod_tpu.testing.fake_spark import FakeSparkContext
 
+# Process-spawning integration tier, like test_ray/test_examples.
+pytestmark = pytest.mark.slow
+
 # Worker processes are fresh interpreters; like pyspark, cloudpickle
 # serializes module-level test fns by REFERENCE, so workers must be able
 # to import this module (real jobs ship their code the same way).
