@@ -444,6 +444,26 @@ def test_handle_manager_full_of_pending_raises():
         HandleManager.max_retained = old
 
 
+def _assert_chunked_matches_oracle(out, counts, splits, datas, tag=""):
+    """Shared oracle check for alltoallv_chunked results: valid rows
+    match the sender's segment, recv_counts equals the table column,
+    and every padding row is ZERO (ADVICE r4: a hop padded past
+    splits[s][d] used to leak the sender's next destination segment)."""
+    n = len(splits)
+    seg = max(max(max(row) for row in splits), 1)
+    for d in range(n):
+        for s in range(n):
+            cnt = splits[s][d]
+            assert counts[d][s] == cnt, (tag, d, s)
+            off = sum(splits[s][:d])
+            np.testing.assert_allclose(
+                out[d, s * seg:s * seg + cnt], datas[s][off:off + cnt],
+                rtol=1e-6, err_msg=f"{tag} src {s} -> dst {d}")
+            np.testing.assert_array_equal(
+                out[d, s * seg + cnt:(s + 1) * seg], 0.0,
+                err_msg=f"{tag} padding src {s} -> dst {d} not zero")
+
+
 def test_alltoallv_chunked_skewed_oracle(hvd, rng):
     """Chunked (per-hop padded) uneven all-to-all vs a numpy oracle on a
     heavily skewed split table — the bounded-wire-bytes variant
@@ -481,18 +501,54 @@ def test_alltoallv_chunked_skewed_oracle(hvd, rng):
                               out_specs=(P("hvd"), P("hvd"))))
     out, counts = map(np.asarray, f(x))
 
-    seg = max(max(row) for row in splits)
-    for d in range(n):
-        for s in range(n):
-            cnt = splits[s][d]
-            assert counts[d][s] == cnt
-            off = sum(splits[s][:d])
-            np.testing.assert_allclose(
-                out[d, s * seg:s * seg + cnt], datas[s][off:off + cnt],
-                rtol=1e-6, err_msg=f"src {s} -> dst {d}")
-            # Padding rows must be ZEROS (ADVICE r4: a hop padded past
-            # splits[s][d] used to leak the sender's next destination
-            # segment into them, corrupting whole-segment reductions).
-            np.testing.assert_array_equal(
-                out[d, s * seg + cnt:(s + 1) * seg], 0.0,
-                err_msg=f"padding src {s} -> dst {d} not zero")
+    _assert_chunked_matches_oracle(out, counts, splits, datas)
+
+
+def test_alltoallv_chunked_randomized_tables(hvd):
+    """Property sweep: random split tables — including all-zero rows,
+    all-zero columns, and zero diagonals — must all match the numpy
+    oracle with zero padding (hardens the per-hop slicing/masking
+    against shapes the two fixed oracle tables don't hit)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import collectives as C
+
+    n, D = 8, 2
+    mesh = hvd._ctx().mesh
+    for seed in range(6):
+        srng = np.random.default_rng(100 + seed)
+        splits = srng.integers(0, 4, (n, n))
+        if seed == 1:
+            splits[2, :] = 0       # a rank that sends nothing
+        if seed == 2:
+            splits[:, 5] = 0       # a rank that receives nothing
+        if seed == 3:
+            np.fill_diagonal(splits, 0)  # no self-traffic
+        if seed == 4:
+            splits[:] = 0
+            splits[0, 7] = 11      # ONLY one (src,dst) pair
+        splits = [[int(v) for v in row] for row in splits]
+
+        max_send = max(max(sum(r) for r in splits), 1)
+        datas, sends = [], []
+        rng_ = np.random.default_rng(seed)
+        for r in range(n):
+            rows = sum(splits[r])
+            d = rng_.standard_normal((rows, D)).astype(np.float32)
+            datas.append(d)
+            pad = np.zeros((max_send, D), np.float32)
+            pad[:rows] = d
+            sends.append(pad)
+        x = np.stack(sends)
+
+        def per_rank(v, splits=splits):
+            out, counts = C.alltoallv_chunked(v[0], splits, "hvd")
+            return out[None], counts[None]
+
+        f = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh, in_specs=(P("hvd"),),
+            out_specs=(P("hvd"), P("hvd"))))
+        out, counts = map(np.asarray, f(x))
+        _assert_chunked_matches_oracle(out, counts, splits, datas,
+                                       tag=f"seed {seed}")
