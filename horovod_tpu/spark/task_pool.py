@@ -54,7 +54,8 @@ KILL_ESCALATE_S = 10.0
 def task_service_loop(index: int, client: RendezvousClient,
                       poll_s: float = 0.25) -> None:
     """Runs INSIDE a Spark task until the pool is shut down (the
-    SparkTaskService analog, reference spark/task_service.py): register,
+    SparkTaskService analog, reference spark/task/task_service.py):
+    register,
     heartbeat, execute one worker command at a time.
 
     Each service instance carries a fresh INCARNATION id in every
