@@ -262,3 +262,45 @@ def test_elastic_scale_up_on_host_join(tmp_path, monkeypatch):
         "joined host never trained"
     # Survivor rank stability: hostA is rank 0 before AND after.
     assert all(rank == 0 for h, rank, _, _ in recs if h == "hostA")
+
+
+@pytest.mark.slow
+def test_elastic_reset_tool_cpu_loopback(tmp_path):
+    """tools/tpu_elastic_reset.py end-to-end on the CPU loopback
+    backend (the on-chip elastic-reset proof harness, VERDICT r3 #6 /
+    r4 #5): train -> SIGKILL after the first save -> lease cooldown ->
+    orbax restore -> persistent-compile-cache warm restart completes
+    the remaining steps. Guards the harness itself so the queued TPU
+    leg can't rot between serving windows."""
+    import json
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "tpu_elastic_reset.py"),
+         "--platform", "cpu", "--total-steps", "20",
+         "--save-every", "4",
+         "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--cache-dir", str(tmp_path / "xla_cache"),
+         "--phase-timeout", "300"],
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(
+        [l for l in proc.stdout.splitlines() if l.strip()][-1])
+    assert rec["platform"] == "cpu"
+    assert rec["metric"] == "elastic_reset_resume_step"
+    # Killed after the first save -> resumes from a committed step and
+    # completes the full horizon. 20 steps with a save every 4 leaves a
+    # wide margin between the kill landing and the run finishing
+    # (code-review r5: a 6-step config could complete before SIGKILL,
+    # making resume_step overshoot final_step).
+    assert 1 <= rec["resume_step"] <= rec["final_step"]
+    assert rec["final_step"] == 19  # 20 steps, 0-indexed last
+    # The warm restart must have a POPULATED persistent cache to read —
+    # warm-vs-cold wall times alone cannot distinguish a working cache
+    # from a silently disabled one.
+    cache_files = [f for _, _, fs in os.walk(tmp_path / "xla_cache")
+                   for f in fs]
+    assert cache_files, "persistent compile cache is empty"
+    assert rec["compile_s_warm"] <= rec["compile_s_cold"] * 1.5 + 0.5
