@@ -95,6 +95,18 @@ JOBS = [
     # lease cooldown → orbax restore + persistent-compile-cache warm
     # start, all on the real chip.
     ("elastic_reset", ["tools/tpu_elastic_reset.py"], 1800),
+    # Tuned-batch GPT legs (r05): the first-ever chip run measured
+    # gb=8 at 13.4% model-MFU — batch-starved, not kernel-bound. These
+    # quantify the batch lever on the same causal-flash path.
+    ("gpt_small_b32", ["bench.py", "--_worker", "--_platform=tpu",
+                       "--model", "gpt_small", "--batch-size", "32"],
+     1200),
+    ("gpt_small_b64", ["bench.py", "--_worker", "--_platform=tpu",
+                       "--model", "gpt_small", "--batch-size", "64"],
+     1200),
+    ("gpt_2k_b16_remat", ["bench.py", "--_worker", "--_platform=tpu",
+                          "--model", "gpt_small", "--seq-len", "2048",
+                          "--batch-size", "16", "--remat"], 1500),
 ]
 
 

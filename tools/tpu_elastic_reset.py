@@ -181,6 +181,30 @@ def supervise(args):
     _log(f"lease cooldown {cooldown}s (stale-lease semantics)")
     time.sleep(cooldown)
 
+    # Snapshot the persistent cache BEFORE phase 2: a genuine warm
+    # restart reads existing entries and writes nothing, while a silent
+    # cache miss recompiles and (re)writes its key. Wall-clock
+    # warm-vs-cold comparison alone cannot tell these apart on fast
+    # compiles (code-review r5).
+    def _cache_snapshot():
+        snap = {}
+        for root, _, files in os.walk(args.cache_dir):
+            for f in files:
+                if f.endswith("-atime"):
+                    # jax's LRU cache touches a '<key>-atime' sidecar
+                    # on every cache READ when eviction is enabled —
+                    # a hit must not count as a write.
+                    continue
+                p = os.path.join(root, f)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                snap[p] = (st.st_mtime_ns, st.st_size)
+        return snap
+
+    cache_before = _cache_snapshot()
+
     # Phase 2: fresh process restores the checkpoint and finishes.
     _log("phase 2: resuming")
     try:
@@ -202,6 +226,10 @@ def supervise(args):
     # Cold compile time comes from phase 1's log marker; phase 2's
     # compile of the identical function should hit the persistent cache.
     warm = payload.get("compile_s")
+    cache_after = _cache_snapshot()
+    cache_written = sorted(
+        p for p, meta in cache_after.items()
+        if cache_before.get(p) != meta)
     result = {
         "metric": "elastic_reset_resume_step",
         "value": payload.get("resume_step"),
@@ -212,6 +240,11 @@ def supervise(args):
         "final_step": payload.get("final_step"),
         "final_loss": payload.get("final_loss"),
         "compile_s_warm": warm,
+        "cache_entries_before_phase2": len(cache_before),
+        # True iff phase 2 neither added nor rewrote any cache entry —
+        # i.e. every compile in phase 2 was served from the cache
+        # phase 1 populated.
+        "phase2_cache_hit": not cache_written,
         "config_note": f"ConvNet adam total={args.total_steps} "
                        f"save_every={args.save_every}; SIGKILL after "
                        f"first save; {cooldown}s lease cooldown",
