@@ -8,12 +8,15 @@ Queue (each job = one subprocess, strictly serialized — the tunnel
 serves ONE chip and a SIGKILLed worker's stale lease starves the next
 for minutes):
   model benches : bench.py --_worker --_platform=tpu --model M
-                  (resnet50 re-run + bert_large + gpt_small + vit_base
-                  + inception3, each with mfu_pct)
-  micro benches : tools/tpu_microbench.py {flash, overlap, fusion}
+                  (resnet50 s2d/nos2d + bert_large + gpt_small +
+                  vit_base + inception3 + tuned-batch legs, each with
+                  both MFU bases)
+  micro benches : tools/tpu_microbench.py {flash, striped, overlap,
+                  fusion} + tools/tpu_elastic_reset.py
 
 A job's JSON is recorded ONLY if it reports platform == "tpu"; results
-land in results/tpu_r03/<job>.json plus a combined results.json. State
+land in results/<round_dirs.CURRENT>/<job>.json (this round:
+results/tpu_r05/) plus a combined results.json. State
 survives restarts (done jobs are skipped). Methodology matches the
 reference's examples/tensorflow2/tensorflow2_synthetic_benchmark.py
 (synthetic data, timed batches after warmup).
@@ -47,10 +50,18 @@ MAX_FAILS_PER_JOB = 3
 # directly (no supervisor) so a down backend costs ONE timeout and
 # never silently records a CPU-fallback number.
 JOBS = [
-    # VERDICT r3 #1's priority: the GPT/flash causal path has NEVER run
-    # on real TPU — converting that unknown into a number outranks
-    # everything else, then the rest of the model matrix, then the
-    # microbenches, then tuned-batch + profile legs.
+    # r05 priority (reordered after the first window): the GPT/flash
+    # unknowns landed in the 15:41 window; the r03 ResNet record has
+    # now aged out of bench.py's 48h cache, so the HEADLINE metric
+    # (ResNet-50 + s2d lever) outranks everything, then the rest of
+    # the model matrix, then profiles/microbenches/tuned legs.
+    ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
+                  "--model", "resnet50", "--batch-size", "256"], 1500),
+    ("resnet50_nos2d", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "resnet50", "--batch-size", "256",
+                        "--no-s2d"], 1500),
+    # Landed in the 15:41 window (2026-08-02); kept in the list so a
+    # wiped state file re-captures them, but BELOW the headline legs.
     ("gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
                    "--model", "gpt_small"], 1200),
     ("gpt_2k", ["bench.py", "--_worker", "--_platform=tpu",
@@ -58,45 +69,21 @@ JOBS = [
                 "--batch-size", "4"], 1500),
     ("vit_base", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "vit_base", "--batch-size", "128"], 1200),
+    ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
+                    "--model", "bert_large"], 1200),
     ("inception3", ["bench.py", "--_worker", "--_platform=tpu",
                     "--model", "inception3", "--batch-size", "128"],
      1200),
-    ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
-    ("striped", ["tools/tpu_microbench.py", "striped"], 900),
-    ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
-    ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
-    # r04 configs carry the new levers: s2d stem (CNN default), bf16
-    # Adam mu, single-fetch window timing. The nos2d leg isolates the
-    # stem lever on an otherwise identical config.
-    ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
-                  "--model", "resnet50", "--batch-size", "256"], 1500),
-    ("resnet50_b512", ["bench.py", "--_worker", "--_platform=tpu",
-                       "--model", "resnet50", "--batch-size", "512"],
-     1500),
-    ("resnet50_nos2d", ["bench.py", "--_worker", "--_platform=tpu",
-                        "--model", "resnet50", "--batch-size", "256",
-                        "--no-s2d"], 1500),
-    ("bert_large", ["bench.py", "--_worker", "--_platform=tpu",
-                    "--model", "bert_large"], 1200),
-    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
-                        "--model", "bert_large", "--batch-size", "32"],
-     1500),
     # Profiled runs: device-vs-wall gap (the r03 14% host tax — the
     # window timing fix should close it to <5%) + device-basis scaling.
     ("resnet50_profile", ["bench.py", "--_worker", "--_platform=tpu",
                           "--model", "resnet50", "--batch-size", "256",
                           "--num-iters", "3", "--profile-dir",
                           f"results/{_ROUND}/trace_resnet50"], 1500),
-    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
-                      "--model", "bert_large", "--num-iters", "3",
-                      "--profile-dir", f"results/{_ROUND}/trace_bert"],
-     1200),
-    # Elastic reset under fire (VERDICT r3 #6): train → SIGKILL →
-    # lease cooldown → orbax restore + persistent-compile-cache warm
-    # start, all on the real chip.
-    ("elastic_reset", ["tools/tpu_elastic_reset.py"], 1800),
-    # Tuned-batch GPT legs (r05): the first-ever chip run measured
-    # gb=8 at 13.4% model-MFU — batch-starved, not kernel-bound. These
+    ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
+    ("striped", ["tools/tpu_microbench.py", "striped"], 900),
+    # Tuned-batch GPT legs (r05): the first chip run measured gb=8 at
+    # 13.4% model-MFU — batch-starved, not kernel-bound. These
     # quantify the batch lever on the same causal-flash path.
     ("gpt_small_b32", ["bench.py", "--_worker", "--_platform=tpu",
                        "--model", "gpt_small", "--batch-size", "32"],
@@ -107,6 +94,22 @@ JOBS = [
     ("gpt_2k_b16_remat", ["bench.py", "--_worker", "--_platform=tpu",
                           "--model", "gpt_small", "--seq-len", "2048",
                           "--batch-size", "16", "--remat"], 1500),
+    ("overlap", ["tools/tpu_microbench.py", "overlap"], 900),
+    ("fusion", ["tools/tpu_microbench.py", "fusion"], 900),
+    ("resnet50_b512", ["bench.py", "--_worker", "--_platform=tpu",
+                       "--model", "resnet50", "--batch-size", "512"],
+     1500),
+    ("bert_large_b32", ["bench.py", "--_worker", "--_platform=tpu",
+                        "--model", "bert_large", "--batch-size", "32"],
+     1500),
+    ("bert_profile", ["bench.py", "--_worker", "--_platform=tpu",
+                      "--model", "bert_large", "--num-iters", "3",
+                      "--profile-dir", f"results/{_ROUND}/trace_bert"],
+     1200),
+    # Elastic reset under fire (VERDICT r3 #6): train → SIGKILL →
+    # lease cooldown → orbax restore + persistent-compile-cache warm
+    # start, all on the real chip.
+    ("elastic_reset", ["tools/tpu_elastic_reset.py"], 1800),
 ]
 
 
