@@ -1,14 +1,20 @@
 #!/usr/bin/env python
 """Summarize a jax.profiler trace captured by ``bench.py
 --profile-dir`` (the MFU-diagnosis leg, VERDICT r2 #2): per-device
-busy fraction, top ops by device time, and the infeed/host share —
-the three numbers that say whether ResNet is compute-bound, fusion-
-starved, or input-starved.
+busy fraction, top device events, top XLA ops, per-step statistics,
+and the infeed/host share — the numbers that say whether a model is
+compute-bound, fusion-starved, or input-starved.
 
 Reads the Chrome-trace JSON the profiler writes alongside the xplane
-protobuf (no xprof dependency). Usage:
+protobuf (no xprof dependency). Events are grouped by their THREAD
+track (``thread_name`` metadata): TPU device processes expose separate
+"Steps", "XLA Modules", and "XLA Ops" tracks. ``device_top_ops`` keeps
+the historical cross-track aggregation (consumers:
+``perf_evidence.py`` looks up ``jit_train_step`` there — a MODULES
+track event); the sharper per-HLO-op breakdown the r03 summary lacked
+is emitted separately as ``device_top_xla_ops``. Usage:
 
-    python tools/analyze_trace.py results/tpu_r03/trace_resnet50
+    python tools/analyze_trace.py results/tpu_r05/trace_resnet50
 
 Prints ONE JSON object.
 """
@@ -32,6 +38,18 @@ def find_trace(root: str) -> str:
     return cands[-1]  # newest capture
 
 
+def _track_kind(thread_name: str) -> str:
+    """Classify a device-process thread track by its profiler name."""
+    t = (thread_name or "").lower()
+    if "step" in t:
+        return "steps"
+    if "module" in t:
+        return "modules"
+    if "xla op" in t or t == "ops":
+        return "ops"
+    return "other"
+
+
 def main(root: str) -> int:
     path = find_trace(root)
     with gzip.open(path, "rt") as f:
@@ -39,56 +57,110 @@ def main(root: str) -> int:
     events = data.get("traceEvents", [])
 
     pid_names = {}
+    tid_names = {}
     for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
             pid_names[e["pid"]] = e["args"].get("name", str(e["pid"]))
+        elif e.get("name") == "thread_name":
+            tid_names[(e["pid"], e.get("tid"))] = \
+                e["args"].get("name", "")
 
-    per_pid_busy = defaultdict(float)
+    per_pid_kind_busy = defaultdict(lambda: defaultdict(float))
     per_pid_span = {}
-    op_time = defaultdict(float)
-    op_count = defaultdict(int)
+    # Per (track-kind) op aggregation on device processes only.
+    op_time = defaultdict(lambda: defaultdict(float))
+    op_count = defaultdict(lambda: defaultdict(int))
+    step_durs = []
     for e in events:
         if e.get("ph") != "X":
             continue
         pid = e.get("pid")
         dur = float(e.get("dur", 0.0))
         ts = float(e.get("ts", 0.0))
-        per_pid_busy[pid] += dur
+        kind = _track_kind(tid_names.get((pid, e.get("tid")), ""))
+        per_pid_kind_busy[pid][kind] += dur
         lo, hi = per_pid_span.get(pid, (ts, ts + dur))
         per_pid_span[pid] = (min(lo, ts), max(hi, ts + dur))
         pname = pid_names.get(pid, str(pid))
         if "TPU" in pname or "device" in pname.lower():
-            op_time[e.get("name", "?")] += dur
-            op_count[e.get("name", "?")] += 1
+            name = e.get("name", "?")
+            op_time[kind][name] += dur
+            op_count[kind][name] += 1
+            if kind == "steps":
+                step_durs.append(dur)
 
     procs = {}
-    for pid, busy in per_pid_busy.items():
+    for pid in per_pid_span:
         lo, hi = per_pid_span[pid]
         span = max(hi - lo, 1e-9)
+        kinds = per_pid_kind_busy[pid]
+        # Busy time on the MODULES track is the executable's actual
+        # device occupancy; summing all tracks multi-counts the same
+        # microsecond (steps + modules + ops overlap) and can exceed
+        # 1.0x. Captures without a modules track fall back to the
+        # all-track sum — flagged so the two are never confused.
+        if kinds.get("modules"):
+            busy = kinds["modules"]
+            basis = "modules_track"
+        else:
+            busy = sum(kinds.values())
+            basis = "all_tracks_overlapping"
         procs[pid_names.get(pid, str(pid))] = {
             "busy_ms": round(busy / 1000, 2),
             "span_ms": round(span / 1000, 2),
-            # >1 is possible on multi-track processes (overlapping
-            # streams); the DEVICE track's value is the one that
-            # matters for the compute-bound question.
             "busy_fraction": round(busy / span, 3),
+            "busy_basis": basis,
         }
 
-    top = sorted(op_time.items(), key=lambda kv: -kv[1])[:15]
-    total_dev = sum(op_time.values()) or 1e-9
-    infeed = sum(t for n, t in op_time.items()
+    # Historical aggregate (all device tracks, SUMMED on name
+    # collisions): perf_evidence.py's jit_train_step lookup and the
+    # r03 summary format both read this.
+    merged_time = defaultdict(float)
+    merged_count = defaultdict(int)
+    for kind in op_time:
+        for n, t in op_time[kind].items():
+            merged_time[n] += t
+            merged_count[n] += op_count[kind][n]
+    top = sorted(merged_time.items(), key=lambda kv: -kv[1])[:15]
+    total_dev = sum(merged_time.values()) or 1e-9
+    infeed = sum(t for n, t in merged_time.items()
                  if "infeed" in n.lower() or "copy" in n.lower()
                  or "transfer" in n.lower())
-    print(json.dumps({
+
+    out = {
         "trace": path,
         "processes": procs,
         "device_top_ops": [
             {"name": n[:100], "ms": round(t / 1000, 2),
-             "count": op_count[n],
+             "count": merged_count[n],
              "pct_of_device": round(100 * t / total_dev, 1)}
             for n, t in top],
         "infeed_copy_pct_of_device": round(100 * infeed / total_dev, 1),
-    }, indent=2))
+    }
+
+    # The per-HLO-op view (dedicated "XLA Ops" track only, when the
+    # capture names its tracks): which fusions/convs/collectives eat
+    # the step — the breakdown the r03 numbers-only rows couldn't give.
+    ops = op_time.get("ops")
+    if ops:
+        ops_total = sum(ops.values()) or 1e-9
+        out["device_top_xla_ops"] = [
+            {"name": n[:100], "ms": round(t / 1000, 2),
+             "count": op_count["ops"][n],
+             "pct_of_ops_track": round(100 * t / ops_total, 1)}
+            for n, t in sorted(ops.items(), key=lambda kv: -kv[1])[:20]]
+    if step_durs:
+        step_durs.sort()
+        n = len(step_durs)
+        out["steps"] = {
+            "count": n,
+            "mean_ms": round(sum(step_durs) / n / 1000, 3),
+            "p50_ms": round(step_durs[n // 2] / 1000, 3),
+            "max_ms": round(step_durs[-1] / 1000, 3),
+        }
+    print(json.dumps(out, indent=2))
     return 0
 
 

@@ -43,18 +43,15 @@ LEASE_COOLDOWN = 150       # after a killed TPU child, let the lease expire
 MAX_FAILS_PER_JOB = 3
 
 # Ordered by ROUND VALUE, not model family: if the backend serves only
-# a short window, the first jobs eat it — so the matrix-completing
-# model rows (GPT/ViT/Inception — the >=3-families-with-MFU bar) and
-# the kernel/overlap microbenches come before tuned-batch extras.
+# a short window, the first jobs eat it. r05 order: headline ResNet
+# legs (the r03 record aged out of bench.py's 48h cache) → rest of the
+# model matrix → resnet profile → flash/striped microbenches →
+# tuned-batch GPT legs → overlap/fusion → tuned ResNet/BERT extras →
+# bert profile → elastic reset.
 # (name, argv tail, timeout_s). Model benches use the worker entry
 # directly (no supervisor) so a down backend costs ONE timeout and
 # never silently records a CPU-fallback number.
 JOBS = [
-    # r05 priority (reordered after the first window): the GPT/flash
-    # unknowns landed in the 15:41 window; the r03 ResNet record has
-    # now aged out of bench.py's 48h cache, so the HEADLINE metric
-    # (ResNet-50 + s2d lever) outranks everything, then the rest of
-    # the model matrix, then profiles/microbenches/tuned legs.
     ("resnet50", ["bench.py", "--_worker", "--_platform=tpu",
                   "--model", "resnet50", "--batch-size", "256"], 1500),
     ("resnet50_nos2d", ["bench.py", "--_worker", "--_platform=tpu",
