@@ -132,3 +132,65 @@ def test_analyze_trace_summary(tmp_path):
     top = out["device_top_ops"]
     assert top[0]["name"] == "fusion.1" and top[0]["pct_of_device"] == 80.0
     assert out["infeed_copy_pct_of_device"] == 20.0
+    assert dev["busy_basis"] == "all_tracks_overlapping"
+
+
+def test_analyze_trace_named_tracks(tmp_path):
+    """With thread_name metadata (real TPU captures), busy_fraction is
+    modules-track occupancy (not the overlapping multi-track sum), the
+    XLA-Ops track gets its own breakdown, and Steps-track events feed
+    per-step statistics while still appearing in the merged
+    device_top_ops that perf_evidence.py consumes."""
+    import gzip
+    import subprocess
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 10,
+         "args": {"name": "Steps"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 11,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 12,
+         "args": {"name": "XLA Ops"}},
+        # Two 4ms steps over a 10ms span; the module event overlaps
+        # them; ops subdivide the modules.
+        {"ph": "X", "pid": 1, "tid": 10, "name": "1",
+         "ts": 0.0, "dur": 4000.0},
+        {"ph": "X", "pid": 1, "tid": 10, "name": "2",
+         "ts": 5000.0, "dur": 4000.0},
+        {"ph": "X", "pid": 1, "tid": 11, "name": "jit_train_step(123)",
+         "ts": 0.0, "dur": 8000.0},
+        {"ph": "X", "pid": 1, "tid": 12, "name": "conv.7",
+         "ts": 0.0, "dur": 6000.0},
+        {"ph": "X", "pid": 1, "tid": 12, "name": "allreduce.2",
+         "ts": 6000.0, "dur": 2000.0},
+        {"ph": "X", "pid": 1, "tid": 99, "name": "end",
+         "ts": 9999.0, "dur": 1.0},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    proc = subprocess.run(
+        [sys.executable,
+         str(__import__("pathlib").Path(q.REPO) / "tools"
+             / "analyze_trace.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    dev = out["processes"]["/device:TPU:0"]
+    # modules track: 8ms busy over the 10ms span — NOT 18ms/10ms.
+    assert dev["busy_ms"] == 8.0 and dev["busy_fraction"] == 0.8
+    assert dev["busy_basis"] == "modules_track"
+    # merged view still carries the modules event for perf_evidence.
+    merged_names = {o["name"] for o in out["device_top_ops"]}
+    assert "jit_train_step(123)" in merged_names
+    # dedicated per-op view only has the ops track.
+    xla_ops = {o["name"]: o for o in out["device_top_xla_ops"]}
+    assert set(xla_ops) == {"conv.7", "allreduce.2"}
+    assert xla_ops["conv.7"]["pct_of_ops_track"] == 75.0
+    # steps statistics from the Steps track.
+    assert out["steps"]["count"] == 2
+    assert out["steps"]["mean_ms"] == 4.0

@@ -11,8 +11,8 @@ for minutes):
                   (resnet50 s2d/nos2d + bert_large + gpt_small +
                   vit_base + inception3 + tuned-batch legs, each with
                   both MFU bases)
-  micro benches : tools/tpu_microbench.py {flash, striped, overlap,
-                  fusion} + tools/tpu_elastic_reset.py
+  micro benches : tools/tpu_microbench.py {flash, striped, kernels,
+                  overlap, fusion} + tools/tpu_elastic_reset.py
 
 A job's JSON is recorded ONLY if it reports platform == "tpu"; results
 land in results/<round_dirs.CURRENT>/<job>.json (this round:
@@ -79,6 +79,11 @@ JOBS = [
                           f"results/{_ROUND}/trace_resnet50"], 1500),
     ("flash", ["tools/tpu_microbench.py", "flash"], 1200),
     ("striped", ["tools/tpu_microbench.py", "striped"], 900),
+    # Chip-proof for the kernel families no model bench exercises
+    # (adasum VHDD math, int8 block quant): the CPU interpreter does
+    # not catch TPU tiling violations, so these stay "believed
+    # working" until they compile AND match their oracles on chip.
+    ("kernels", ["tools/tpu_microbench.py", "kernels"], 900),
     # Tuned-batch GPT legs (r05): the first chip run measured gb=8 at
     # 13.4% model-MFU — batch-starved, not kernel-bound. These
     # quantify the batch lever on the same causal-flash path.

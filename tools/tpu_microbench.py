@@ -299,8 +299,91 @@ def fusion_section():
     return out
 
 
+def kernels_section():
+    """Chip-proof for the Pallas kernel families no model bench
+    exercises: adasum dot-norms/combine (ops/pallas_kernels.py:141,184
+    — the VHDD math of reference adasum.h:195-390) and block-scaled
+    int8 quantization (:237 — the wire-compression lever of the
+    int8-DCN hierarchical path). The r3 Mosaic bug showed the CPU
+    interpreter does NOT catch TPU tiling-rule violations, so until a
+    kernel has compiled AND matched its jnp oracle on the real chip it
+    is only believed working."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import pallas_kernels as pk
+
+    n = 1 << 14 if SMALL else 1 << 22  # 4M elements (16 MiB fp32)
+    key = jax.random.PRNGKey(7)
+    a = jax.random.normal(key, (n,), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(8), (n,), jnp.float32) * 3
+
+    out = {}
+
+    # adasum: pallas vs jnp-oracle numerics + timing.
+    dn_p = jax.jit(lambda a, b: pk.adasum_dot_norms(a, b,
+                                                    use_pallas=True))
+    dn_j = jax.jit(lambda a, b: pk.adasum_dot_norms(a, b,
+                                                    use_pallas=False))
+    got, ref = np.asarray(dn_p(a, b)), np.asarray(dn_j(a, b))
+    dn_err = float(np.max(np.abs(got - ref) / np.maximum(np.abs(ref),
+                                                         1e-6)))
+    cb_p = jax.jit(lambda a, b, s: pk.adasum_combine(a, b, s,
+                                                     use_pallas=True))
+    cb_j = jax.jit(lambda a, b, s: pk.adasum_combine(a, b, s,
+                                                     use_pallas=False))
+    s = dn_j(a, b)
+    cb_err = float(np.max(np.abs(np.asarray(cb_p(a, b, s))
+                                 - np.asarray(cb_j(a, b, s)))))
+    out["adasum"] = {
+        "n_elements": n,
+        "dot_norms_rel_err": round(dn_err, 8),
+        "combine_abs_err": round(cb_err, 8),
+        "dot_norms_pallas_ms": round(_time_ms(lambda: dn_p(a, b)), 3),
+        "dot_norms_jnp_ms": round(_time_ms(lambda: dn_j(a, b)), 3),
+        "combine_pallas_ms": round(_time_ms(lambda: cb_p(a, b, s)), 3),
+        "combine_jnp_ms": round(_time_ms(lambda: cb_j(a, b, s)), 3),
+    }
+    _log(f"kernels adasum: {out['adasum']}")
+
+    # int8 block quant: roundtrip error must be bounded by the absmax
+    # step size; pallas and jnp paths must agree exactly on q.
+    q_p = jax.jit(lambda x: pk.quantize_int8(x, use_pallas=True))
+    q_j = jax.jit(lambda x: pk.quantize_int8(x, use_pallas=False))
+    qp, sp, np_ = q_p(a)
+    qj, sj, _ = q_j(a)
+    q_agree = bool(np.array_equal(np.asarray(qp), np.asarray(qj)))
+    deq = jax.jit(lambda q, s: pk.dequantize_int8(
+        q, s, np_, a.shape, use_pallas=True))
+    rt = np.asarray(deq(qp, sp))
+    # per-block bound: |x - deq(x)| <= scale/2 per element.
+    step = float(np.max(np.asarray(sp)))
+    rt_err = float(np.max(np.abs(rt - np.asarray(a))))
+    out["int8_quant"] = {
+        "n_elements": n,
+        "q_pallas_equals_jnp": q_agree,
+        "roundtrip_max_abs_err": round(rt_err, 6),
+        "max_block_scale": round(step, 6),
+        "err_within_half_step": bool(rt_err <= step / 2 + 1e-6),
+        "quant_pallas_ms": round(_time_ms(lambda: q_p(a)[0]), 3),
+        "quant_jnp_ms": round(_time_ms(lambda: q_j(a)[0]), 3),
+    }
+    _log(f"kernels int8: {out['int8_quant']}")
+    # The pass/fail bit IS this section's deliverable: an oracle
+    # mismatch must fail the job (non-zero exit -> the queue records a
+    # failure and retries) instead of landing as green-looking
+    # evidence with a false buried in it.
+    ok = (dn_err < 1e-3 and cb_err < 1e-3 and q_agree
+          and out["int8_quant"]["err_within_half_step"])
+    out["ok"] = bool(ok)
+    if not ok:
+        raise SystemExit(f"kernels section oracle mismatch: {out}")
+    return out
+
+
 SECTIONS = {"flash": flash_section, "striped": striped_section,
-            "overlap": overlap_section, "fusion": fusion_section}
+            "overlap": overlap_section, "fusion": fusion_section,
+            "kernels": kernels_section}
 
 
 def main():
