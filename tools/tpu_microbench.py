@@ -39,17 +39,30 @@ def _log(msg):
     print(f"microbench: {msg}", file=sys.stderr, flush=True)
 
 
-def _time_ms(fn, iters=20, warmup=3):
+def _force(out):
+    """Completion barrier that survives the tunneled backend:
+    block_until_ready proved unreliable there (returned early →
+    over-peak 'throughput', see bench.py), but a device→host copy
+    cannot complete before the dispatched chain has executed. EVERY
+    leaf is fetched (one element each, one batched device_get) —
+    fetching only the first leaf would let sibling dispatches keep
+    running past the timer (code-review r5)."""
     import jax
 
+    slivers = [leaf.ravel()[:1] for leaf in jax.tree.leaves(out)
+               if hasattr(leaf, "ravel")]
+    return jax.device_get(slivers)
+
+
+def _time_ms(fn, iters=20, warmup=3):
     if SMALL:
         iters, warmup = 2, 1
     for _ in range(warmup):
-        jax.block_until_ready(fn())
+        _force(fn())
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn()
-    jax.block_until_ready(out)
+    _force(out)
     return (time.perf_counter() - t0) / iters * 1000
 
 
@@ -223,7 +236,7 @@ def overlap_section():
         outs = []
         for i, t in enumerate(tensors):
             o = hvd.allreduce(t, op=hvd.Sum, name=f"sv{i}")
-            jax.block_until_ready(o)
+            _force(o)  # a real host round trip per tensor
             outs.append(o)
         return outs
 
@@ -258,7 +271,7 @@ def overlap_section():
 
     def serialized():
         o = hvd.allreduce(big, op=hvd.Sum, name="ser_big")
-        jax.block_until_ready(o)
+        _force(o)  # wait out the collective before starting compute
         c = matmul_chain(a)
         return o, c
 
@@ -274,8 +287,6 @@ def overlap_section():
 
 
 def fusion_section():
-    import jax
-
     import horovod_tpu as hvd
 
     hvd.init()
@@ -284,13 +295,16 @@ def fusion_section():
 
     def grouped():
         out = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="fuse")
-        jax.block_until_ready(jax.tree.leaves(out))
+        _force(out)  # one barrier for the whole fused bucket
         return out
 
     def per_tensor():
-        return [jax.block_until_ready(
-                    hvd.allreduce(v, op=hvd.Sum, name=f"pt{i}"))
-                for i, v in enumerate(tensors.values())]
+        outs = []
+        for i, v in enumerate(tensors.values()):
+            o = hvd.allreduce(v, op=hvd.Sum, name=f"pt{i}")
+            _force(o)  # one barrier per tensor, matching dispatches
+            outs.append(o)
+        return outs
 
     out = {"tensors": ngrp,
            "grouped_ms": round(_time_ms(grouped, iters=10), 2),
