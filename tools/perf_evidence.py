@@ -512,8 +512,12 @@ def host_gap_evidence():
             if op["name"].startswith("jit_train_step") and op["count"]:
                 dev_ms = op["ms"] / op["count"]
                 break
+        # NO Steps-track fallback here: a Steps-track span includes
+        # within-step device idle while waiting on host dispatch — the
+        # very gap this metric exists to expose — so using it would
+        # make wall_vs_device self-pass at ~100% (code-review r5).
         bsz = (rec.get("config") or {}).get("global_batch")
-        if dev_ms is None or not bsz:
+        if not dev_ms or not bsz:
             rows[model] = {"skipped": "no device step in trace "
                                       "or no config in record"}
             continue
@@ -563,17 +567,26 @@ def scaling_projection():
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     def device_step_ms(trace_summary):
-        """Mean per-execution device time of the jitted train step."""
+        """Mean per-execution device time of the jitted train step.
+
+        Returns ``(ms, basis)``; a Steps-track fallback is marked as
+        such because its span includes within-step host-dispatch gaps
+        and therefore upper-bounds the true device time (efficiency
+        from it is conservative, not optimistic — comm is compared
+        against a LONGER compute span)."""
         try:
             with open(trace_summary) as f:
                 summary = json.load(f)
-            for op in summary["device_top_ops"]:
+            for op in summary.get("device_top_ops", []):
                 if op["name"].startswith("jit_train_step"):
-                    return op["ms"] / op["count"]
+                    return op["ms"] / op["count"], "modules_track"
+            ms = (summary.get("steps") or {}).get("mean_ms")
+            if ms:
+                return ms, "steps_track_span_incl_host_gaps"
         except (OSError, json.JSONDecodeError, KeyError,
                 ZeroDivisionError):
             pass
-        return None
+        return None, None
 
     rdirs = _round_search_order()  # newest round's captures win
     models = {
@@ -607,10 +620,11 @@ def scaling_projection():
             out[name] = {"skipped": "no (complete) chip record yet"}
             continue
         trace_path, trace_src = find([trace]) if trace else (None, None)
-        dev_ms = device_step_ms(trace_path) if trace_path else None
-        if dev_ms is not None:
+        dev_ms, dev_basis = (device_step_ms(trace_path)
+                             if trace_path else (None, None))
+        if dev_ms:
             step_s = dev_ms / 1e3
-            basis = "device step from profiler trace"
+            basis = f"device step from profiler trace ({dev_basis})"
         else:
             step_s = bsz / rec["value"]
             basis = ("wall step (includes tunnel host gaps; biases "
