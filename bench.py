@@ -180,6 +180,11 @@ def main():
                             "vgg16", "vgg19", "inception3",
                             "vit_base", "bert_large", "bert_base",
                             "gpt_small", "gpt_medium"])
+    p.add_argument("--overlap", action="store_true",
+                   help="readiness-ordered gradient buckets + issue-"
+                        "order chaining on the DistributedOptimizer "
+                        "(overlap=True; pairs with the latency-hiding "
+                        "XLA flags, HVD_TPU_OVERLAP_XLA_FLAGS=1)")
     p.add_argument("--remat", action="store_true",
                    help="per-layer activation recomputation on the GPT "
                         "models (long-context HBM relief)")
@@ -215,7 +220,11 @@ def main():
 
     import horovod_tpu as hvd
 
-    hvd.init()
+    # --overlap's A/B depends on the latency-hiding/async-collective
+    # flags: the barrier chain alone fixes issue ORDER; concurrency is
+    # the scheduler's job (docs/overlap.md). The helper only applies
+    # with positive TPU evidence, so the CPU fallback arms are safe.
+    hvd.init(overlap_xla_flags=args.overlap)
     platform = jax.devices()[0].platform
     n = hvd.size()
     _log(f"worker initialized: platform={platform} n={n}")
@@ -367,6 +376,7 @@ def _run_benchmark(args, n):
         else "window_single_fetch",
         "steps_timed": total_batches,
         "remat": bool(args.remat) if is_gpt else None,
+        "overlap": bool(args.overlap),
     }
     result["config"] = config
     result["config_note"] = (
@@ -561,7 +571,8 @@ def _setup_cnn(args, batch_size, n):
     # Reference benchmark uses plain SGD lr=0.01 wrapped in
     # DistributedOptimizer; same here (fused allreduce over the rank axis).
     tx = hvd.DistributedOptimizer(optax.sgd(0.01),
-                                  axis_name=hvd.rank_axis())
+                                  axis_name=hvd.rank_axis(),
+                                  overlap=args.overlap)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -618,7 +629,7 @@ def _setup_bert(args, batch_size, n):
     # exposes mu_dtype, and the second moment is scale-sensitive).
     tx = hvd.DistributedOptimizer(
         optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
-        axis_name=hvd.rank_axis())
+        axis_name=hvd.rank_axis(), overlap=args.overlap)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -670,7 +681,7 @@ def _setup_gpt(args, batch_size, n):
 
     tx = hvd.DistributedOptimizer(
         optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
-        axis_name=hvd.rank_axis())
+        axis_name=hvd.rank_axis(), overlap=args.overlap)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):

@@ -97,23 +97,29 @@ class Autotuner:
                  warmup_samples: int = 3,
                  steps_per_sample: int = 10,
                  log_file: Optional[str] = None,
-                 tune_hierarchical: bool = False):
+                 tune_hierarchical: bool = False,
+                 tune_overlap: bool = False):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.log_file = log_file
-        # Joint (threshold, hierarchical) space when asked — the
+        # Joint (threshold, hierarchical, overlap) space when asked — the
         # reference's ParameterManager tunes the hierarchical toggle
-        # alongside the fusion threshold (parameter_manager.cc).
+        # alongside the fusion threshold (parameter_manager.cc); the
+        # overlap toggle (readiness-ordered buckets + issue chaining,
+        # common/overlap.py) is this rebuild's addition. Points are
+        # always internal 3-tuples; untuned axes stay pinned at 0.
         self.tune_hierarchical = tune_hierarchical
+        self.tune_overlap = tune_overlap
         hs = (0, 1) if tune_hierarchical else (0,)
-        self._space: List[Tuple[int, int]] = [
-            (t, h) for t in self.candidates for h in hs]
+        ovs = (0, 1) if tune_overlap else (0,)
+        self._space: List[Tuple[int, int, int]] = [
+            (t, h, o) for t in self.candidates for h in hs for o in ovs]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
         self._secs = 0.0
-        self._samples: Dict[Tuple[int, int], List[float]] = {}
+        self._samples: Dict[Tuple[int, int, int], List[float]] = {}
         self._cur = self._space[len(self._space) // 2]
         self._done = False
         # Samples arrive from finalizer-pool threads (eager engine) and
@@ -121,9 +127,13 @@ class Autotuner:
         # transitions are serialized here.
         self._tlock = threading.RLock()
         # Single source for the CSV schema: row values come from the
-        # same column list as the header.
-        self._columns = (("threshold_bytes", "hierarchical")
-                         if tune_hierarchical else ("threshold_bytes",))
+        # same column list as the header (see _row).
+        cols = ["threshold_bytes"]
+        if tune_hierarchical:
+            cols.append("hierarchical")
+        if tune_overlap:
+            cols.append("overlap")
+        self._columns = tuple(cols)
         if log_file:
             # Decision trace (reference HOROVOD_AUTOTUNE_LOG,
             # parameter_manager.cc LogParameters): when + what was
@@ -143,6 +153,11 @@ class Autotuner:
             return bool(self._cur[1])
 
     @property
+    def current_overlap(self) -> bool:
+        with self._tlock:
+            return bool(self._cur[2])
+
+    @property
     def current_point(self) -> Tuple[int, bool]:
         """Atomic (threshold, hierarchical) snapshot — readers that need
         both must not take them in two lock acquisitions (a concurrent
@@ -150,6 +165,12 @@ class Autotuner:
         proposed)."""
         with self._tlock:
             return self._cur[0], bool(self._cur[1])
+
+    @property
+    def current_triple(self) -> Tuple[int, bool, bool]:
+        """Atomic (threshold, hierarchical, overlap) snapshot."""
+        with self._tlock:
+            return self._cur[0], bool(self._cur[1]), bool(self._cur[2])
 
     @property
     def done(self) -> bool:
@@ -181,20 +202,36 @@ class Autotuner:
                    seconds: float) -> Tuple[int, bool]:
         """Like feed() but returns the full (threshold, hierarchical)
         point under ONE lock acquisition."""
+        return self.feed_triple(nbytes, seconds)[:2]
+
+    def feed_triple(self, nbytes: float,
+                    seconds: float) -> Tuple[int, bool, bool]:
+        """Like feed() but returns the full (threshold, hierarchical,
+        overlap) point under ONE lock acquisition."""
         with self._tlock:
             self.record(nbytes, seconds)
             if self.ready():
                 self.suggest()
-            return self._cur[0], bool(self._cur[1])
+            return self._cur[0], bool(self._cur[1]), bool(self._cur[2])
 
-    def _log(self, point: Tuple[int, int], score: float) -> None:
+    def _row(self, point: Tuple[int, int, int]) -> List[int]:
+        """CSV row values matching _columns: the threshold always, each
+        toggle only when tuned (an untuned axis would log a constant 0
+        column that the header doesn't declare)."""
+        row = [point[0]]
+        if self.tune_hierarchical:
+            row.append(point[1])
+        if self.tune_overlap:
+            row.append(point[2])
+        return row
+
+    def _log(self, point: Tuple[int, int, int], score: float) -> None:
         if self.log_file:
             import time as _time
 
-            row = point[:len(self._columns)]
             with open(self.log_file, "a") as f:
                 f.write(f"{_time.time():.3f},"
-                        + ",".join(str(v) for v in row)
+                        + ",".join(str(v) for v in self._row(point))
                         + f",{score:.1f},{self._steps}\n")
 
     def suggest(self) -> int:
@@ -204,10 +241,10 @@ class Autotuner:
             return self._suggest_locked()
 
     @staticmethod
-    def _features(point: Tuple[int, int]) -> List[float]:
-        # log2(threshold) spans ~20-28; scale the hierarchical toggle so
-        # the RBF kernel treats "other branch" as a real distance.
-        return [math.log2(point[0]), 2.0 * point[1]]
+    def _features(point: Tuple[int, int, int]) -> List[float]:
+        # log2(threshold) spans ~20-28; scale the binary toggles so the
+        # RBF kernel treats "other branch" as a real distance.
+        return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2]]
 
     def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
@@ -253,7 +290,9 @@ class Autotuner:
                 logger.info(
                     "autotune converged: fusion threshold %d MiB"
                     + (", hierarchical=%s" % bool(best[1])
-                       if self.tune_hierarchical else ""),
+                       if self.tune_hierarchical else "")
+                    + (", overlap=%s" % bool(best[2])
+                       if self.tune_overlap else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

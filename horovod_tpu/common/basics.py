@@ -39,6 +39,14 @@ class Context:
         logger.setLevel(getattr(logging, config.log_level.upper(),
                                 logging.WARNING))
 
+        if config.overlap_xla_flags and not config.force_cpu_devices:
+            # Must land in XLA_FLAGS before the first backend touch (the
+            # topology discovery below initializes devices). The helper
+            # additionally requires positive TPU evidence — unknown
+            # --xla_tpu_* flags ABORT XLA on CPU/GPU-only installs.
+            from .xla_tuning import enable_overlap_scheduling
+
+            enable_overlap_scheduling()
         topo = topo_lib.discover(force_cpu_devices=config.force_cpu_devices)
         if comm is not None:
             # Subset communicator: restrict to the given global rank ids.

@@ -86,6 +86,14 @@ class Config:
     autotune_log: Optional[str] = None
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    # Overlap scheduling (no reference knob — the reference's background
+    # thread overlaps implicitly; here overlap=True on the optimizer
+    # surfaces selects readiness-ordered buckets + issue-order chaining,
+    # and this knob additionally applies the TPU async-collective /
+    # latency-hiding XLA flags at init (common/xla_tuning.py). Off by
+    # default; applied ONLY with positive TPU evidence (platform env /
+    # libtpu) — XLA aborts on unknown --xla_tpu_* flags elsewhere.
+    overlap_xla_flags: bool = False
     # Adasum scalar precision (reference keeps fp64 scalars, adasum.h).
     adasum_scalar_dtype: str = "float32"
     # Compression for the wire format of eager collectives.
@@ -135,6 +143,7 @@ class Config:
             "AUTOTUNE_WARMUP_SAMPLES", cls.autotune_warmup_samples)
         c.autotune_steps_per_sample = _env_int(
             "AUTOTUNE_STEPS_PER_SAMPLE", cls.autotune_steps_per_sample)
+        c.overlap_xla_flags = _env_bool("OVERLAP_XLA_FLAGS", False)
         c.adasum_scalar_dtype = _env(
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")

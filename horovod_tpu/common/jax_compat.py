@@ -1,0 +1,61 @@
+"""Compatibility fills for older jax releases.
+
+The codebase targets the modern jax surface — ``jax.shard_map`` at the
+top level, ``lax.axis_size``, shard_map's ``check_vma`` flag — but the
+deployed runtime may carry an older jax (0.4.x) where those names are
+absent even though the capability exists under an older spelling
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``;
+``lax.psum(1, axis)`` constant-folds to the static axis size and raises
+the same ``NameError`` on unbound axes that ``lax.axis_size`` does).
+
+:func:`ensure` fills ONLY attributes that are missing — on a modern jax
+it is a no-op, so there is no behavior fork to maintain. Called from
+``horovod_tpu/__init__`` so every import path gets the fills before any
+collective traces.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+_installed = False
+
+
+def _axis_size(axis_name):
+    """Static size of a bound mesh axis (lax.axis_size fill): psum of
+    the literal 1 constant-folds to a Python int inside shard_map/pmap,
+    and raises NameError on unbound axes — the exact contract callers
+    (e.g. optim._axes_bound) rely on."""
+    return lax.psum(1, axis_name)
+
+
+def _make_shard_map():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    accepts_check_vma = "check_vma" in inspect.signature(_sm).parameters
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        """jax.shard_map fill over jax.experimental.shard_map: maps the
+        modern ``check_vma`` keyword onto the old ``check_rep``."""
+        if check_vma is not None:
+            kw["check_vma" if accepts_check_vma else "check_rep"] = \
+                check_vma
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+
+    return shard_map
+
+
+def ensure() -> None:
+    """Idempotently install the fills for whatever is missing."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _make_shard_map()

@@ -1,0 +1,138 @@
+"""Overlap-aware collective scheduling — latency-hiding gradient fusion.
+
+Horovod's core performance claim is overlapping the gradient allreduce
+with the still-running backward pass (Sergeev & Del Balso, arXiv:
+1802.05799 §3; the background thread launches NCCL calls as gradients
+become ready). Under XLA there is no background thread — the jitted step
+IS the schedule — so overlap must be expressed through the program's
+dataflow plus XLA's latency-hiding/async-collective scheduler (the
+MLPerf TPU-pod recipe, arXiv:1909.09756 §4). Three levers, layered:
+
+1. **Readiness-ordered buckets** (``common/fusion.py`` ``order=
+   "reverse"``): each bucket's concat depends only on its own leaves, so
+   a bucket of late-layer gradients — the first backprop finishes — can
+   start its collective while early layers are still differentiating.
+   Flatten-order buckets mix early- and late-ready gradients, pinning
+   every bucket's collective behind the whole backward pass.
+2. **Issue-order chaining** (:func:`chain_issue_order`): a
+   ``jax.lax.optimization_barrier`` chain from each bucket's collective
+   into the next bucket's input pins the issue sequence to readiness
+   order. Without it XLA is free to sink every collective to the end of
+   the schedule (or issue a late bucket first and block the wire behind
+   it); the barrier is identity on values, so numerics are untouched.
+3. **Scheduler flags** (``common/xla_tuning.py``): TPU async collectives
+   + the latency-hiding scheduler, which move each chained collective's
+   start as early as its operands allow and fill the in-flight time with
+   the remaining backward compute.
+
+On CPU (tests, `--small` benches) the chain is inert — XLA CPU runs
+collectives synchronously — so ``overlap=True`` degrades to the same
+step time and bit-identical results: scheduling changes, numerics never.
+
+Surfaces: ``DistributedOptimizer(..., overlap=True)`` /
+``DistributedGradFn(..., overlap=True)`` (optim.py) route their bucketed
+reduction through :func:`fused_apply_overlapped`; models exposing layer
+groups can go further with :func:`staged_value_and_grad`, which issues
+each stage's reduction inside the hand-staged VJP walk.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+
+from . import fusion as fusion_lib
+
+
+def chain_issue_order(flats: Sequence, fn: Callable) -> List:
+    """Apply ``fn`` (the per-bucket collective) to each flat bucket,
+    pinning the ISSUE ORDER with an ``optimization_barrier`` chain:
+    bucket ``i+1``'s input is tied to bucket ``i``'s collective, so the
+    scheduler cannot start them out of readiness order. The collectives
+    serialize against each other — they share one wire (ICI ring) and
+    would anyway — while each stays free to overlap with the backward
+    compute that produces LATER buckets.
+
+    The barrier is a scheduling fence, not a math op: outputs equal
+    inputs exactly, so the chained reduction is bitwise-identical to the
+    unchained one.
+    """
+    outs: List = []
+    token = None
+    for f in flats:
+        if token is not None:
+            f, token = jax.lax.optimization_barrier((f, token))
+        out = fn(f)
+        outs.append(out)
+        token = out
+    return outs
+
+
+def fused_apply_overlapped(tree, fn: Callable, threshold_bytes: int,
+                           order: Union[str, Sequence[int]] =
+                           fusion_lib.ORDER_REVERSE):
+    """Overlap-scheduled analog of ``fusion.fused_apply``: plan buckets
+    in readiness ``order`` (reverse flatten by default; pass
+    ``fusion.measured_order(...)``'s permutation for a trace-measured
+    order), fuse, run ``fn`` per bucket with issue-order chaining, and
+    restore the tree. The plan stays a deterministic function of
+    (shapes, dtypes, threshold, order) — all ranks agree without
+    negotiation."""
+    plan = fusion_lib.plan_fusion(tree, threshold_bytes, order=order)
+    flats = fusion_lib.fuse(tree, plan)
+    outs = chain_issue_order(flats, fn)
+    return fusion_lib.unfuse(outs, plan)
+
+
+def staged_value_and_grad(stage_fns: Sequence[Callable],
+                          loss_fn: Callable,
+                          params: Sequence[Any],
+                          x,
+                          reduce_fn: Optional[Callable] = None):
+    """Per-stage VJP with eager per-stage gradient reduction — the
+    strongest overlap form, for models that expose layer groups.
+
+    ``stage_fns[i](params[i], act) -> act`` chain into ``loss_fn(act) ->
+    scalar``. The backward walk runs stage by stage; as soon as a
+    stage's parameter gradients exist, ``reduce_fn(grad_tree)`` (e.g. a
+    fused allreduce) is applied, and an ``optimization_barrier`` chain
+    pins the collectives' RELATIVE order to the backward walk (stage
+    ``i``'s reduce before stage ``i-1``'s). Each stage's backward
+    compute stays dependency-free of the collectives, so the program
+    *admits* the Horovod-style interleaving; actually hoisting each
+    collective's start under the remaining backward compute is the
+    async-collective + latency-hiding scheduler's job
+    (``xla_tuning.enable_overlap_scheduling``) — without those flags
+    the chain guarantees order, not concurrency. Returns ``(loss,
+    grads)`` with ``grads[i]`` the (reduced) gradient of ``params[i]``.
+
+    With ``reduce_fn=None`` this is just a staged ``value_and_grad`` —
+    useful for testing the staging itself.
+    """
+    if len(stage_fns) != len(params):
+        raise ValueError(f"{len(stage_fns)} stage fns but {len(params)} "
+                         f"param trees")
+    vjps = []
+    act = x
+    for f, p in zip(stage_fns, params):
+        act, vjp = jax.vjp(f, p, act)
+        vjps.append(vjp)
+    loss, loss_vjp = jax.vjp(loss_fn, act)
+    (g_act,) = loss_vjp(jax.numpy.ones_like(loss))
+
+    grads: List = [None] * len(stage_fns)
+    token = None
+    for i in range(len(stage_fns) - 1, -1, -1):
+        g_p, g_act = vjps[i](g_act)
+        if reduce_fn is not None:
+            if token is not None:
+                # Chain this stage's collective after the previous one:
+                # readiness-relative order on the shared wire (backward
+                # compute itself stays unchained — see docstring).
+                g_p, token = jax.lax.optimization_barrier((g_p, token))
+            g_p = reduce_fn(g_p)
+            token = jax.tree.leaves(g_p)[0] if jax.tree.leaves(g_p) \
+                else token
+        grads[i] = g_p
+    return loss, list(grads)

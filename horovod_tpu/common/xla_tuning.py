@@ -1,0 +1,123 @@
+"""XLA flag tuning for collective/compute overlap.
+
+The overlap layer (common/overlap.py) shapes the program's DATAFLOW so
+per-bucket collectives *can* start early; whether they actually run
+asynchronously under compute is the compiler's call. On TPU that call is
+gated by XLA flags: the latency-hiding scheduler (cost-model-driven
+instruction scheduling that hoists collective-starts and sinks
+collective-dones) and the async-collective-fusion passes (which split
+``all-reduce`` into ``all-reduce-start``/``-done`` pairs so compute can
+run in between). This module turns them on WITHOUT clobbering anything
+the user already put in ``XLA_FLAGS`` — user-set values always win, and
+re-applying is a no-op (idempotent), so init-time wiring can call it
+unconditionally.
+
+XLA reads ``XLA_FLAGS`` once at backend initialization: call
+:func:`enable_overlap_scheduling` (or set ``HVD_TPU_OVERLAP_XLA_FLAGS=1``
+so ``hvd.init()`` does) BEFORE the first ``jax.devices()`` /
+``jax.jit`` dispatch. Off by default on CPU: the CPU backend runs
+collectives synchronously, the flags buy nothing, and several are
+TPU-only — the helper skips applying when the environment pins a
+CPU-only platform (``JAX_PLATFORMS=cpu`` or the test harness's forced
+CPU mesh) unless ``force=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Mapping, MutableMapping, Optional, Tuple
+
+# (flag, value) pairs applied by enable_overlap_scheduling. The set
+# follows the MLPerf TPU-pod recipe (arXiv:1909.09756) as carried by
+# current large-scale JAX trainers: latency-hiding scheduling plus async
+# collective fusion for the reduce/gather families.
+TPU_OVERLAP_FLAGS: Tuple[Tuple[str, str], ...] = (
+    ("--xla_tpu_enable_latency_hiding_scheduler", "true"),
+    ("--xla_tpu_enable_async_collective_fusion", "true"),
+    ("--xla_tpu_enable_async_collective_fusion_fuse_all_gather", "true"),
+    ("--xla_tpu_enable_async_collective_fusion_multiple_steps", "true"),
+    ("--xla_tpu_overlap_compute_collective_tc", "true"),
+    ("--xla_enable_async_all_gather", "true"),
+    ("--xla_enable_async_collective_permute", "true"),
+)
+
+
+def flag_name(token: str) -> str:
+    """``--xla_foo=bar`` -> ``--xla_foo`` (bare ``--xla_foo`` unchanged)."""
+    return token.split("=", 1)[0]
+
+
+def merge_xla_flags(existing: str,
+                    flags: Tuple[Tuple[str, str], ...]) -> str:
+    """Append each flag not already present (by NAME — a user-set value
+    for the same flag wins regardless of what it is). Existing tokens
+    keep their order; merged output is stable under re-merging."""
+    tokens = existing.split()
+    present = {flag_name(t) for t in tokens}
+    additions = [f"{name}={value}" for name, value in flags
+                 if name not in present]
+    return " ".join(tokens + additions)
+
+
+def _cpu_only(env: Mapping[str, str]) -> bool:
+    """True when the environment pins a CPU-only JAX platform — the case
+    where overlap flags are dead weight (and partly TPU-only)."""
+    plats = env.get("JAX_PLATFORMS") or env.get("JAX_PLATFORM_NAME") or ""
+    names = [p.strip().lower() for p in plats.split(",") if p.strip()]
+    if names and all(n == "cpu" for n in names):
+        return True
+    # The test harness forces a virtual CPU mesh without JAX_PLATFORMS.
+    return bool(env.get("HVD_TPU_FORCE_CPU_DEVICES"))
+
+
+def _tpu_plausible(env: Mapping[str, str]) -> bool:
+    """Positive evidence a TPU backend may come up: the platform env
+    names one, or libtpu is importable. Required before applying —
+    unknown ``--xla_tpu_*`` flags make XLA ABORT the process at backend
+    init on CPU/GPU-only installs, so 'not provably CPU' is not a safe
+    enough gate."""
+    plats = (env.get("JAX_PLATFORMS") or env.get("JAX_PLATFORM_NAME")
+             or "").lower()
+    if any(p.strip() in ("tpu", "axon") for p in plats.split(",")):
+        return True
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec("libtpu") is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def enable_overlap_scheduling(
+        env: Optional[MutableMapping[str, str]] = None,
+        extra_flags: Tuple[Tuple[str, str], ...] = (),
+        force: bool = False) -> Optional[str]:
+    """Merge the TPU overlap flag set (plus ``extra_flags``) into
+    ``env['XLA_FLAGS']``. Returns the resulting flag string, or ``None``
+    when skipped because the environment is CPU-only (pass ``force=True``
+    to apply anyway, e.g. to test the merge itself).
+
+    Safe to call repeatedly — a second call changes nothing — and safe
+    to call with user flags already present: only flags the user has NOT
+    set are appended. Application needs POSITIVE TPU evidence (platform
+    env naming tpu/axon, or libtpu importable): XLA aborts the process
+    on unknown ``--xla_tpu_*`` flags, so a CPU/GPU-only install must
+    never receive them.
+    """
+    if env is None:
+        env = os.environ
+    if not force and (_cpu_only(env) or not _tpu_plausible(env)):
+        return None
+    merged = merge_xla_flags(env.get("XLA_FLAGS", ""),
+                             TPU_OVERLAP_FLAGS + tuple(extra_flags))
+    env["XLA_FLAGS"] = merged
+    return merged
+
+
+def overlap_flags_active(env: Optional[Mapping[str, str]] = None) -> bool:
+    """True iff every overlap flag is present in ``XLA_FLAGS`` (by name —
+    the user may have pinned different values)."""
+    if env is None:
+        env = os.environ
+    present = {flag_name(t) for t in env.get("XLA_FLAGS", "").split()}
+    return all(name in present for name, _ in TPU_OVERLAP_FLAGS)

@@ -55,8 +55,17 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
                  fusion_threshold: int, prescale: float = 1.0,
                  postscale: float = 1.0, hierarchical: bool = False,
                  local_axis: str = "local", cross_axis: str = "cross",
-                 quantized_cross: bool = False):
+                 quantized_cross: bool = False, overlap: bool = False,
+                 bucket_order=None):
     """Fused (bucketed) allreduce of a gradient pytree over the mesh axis.
+
+    ``overlap=True`` selects the latency-hiding schedule
+    (common/overlap.py): buckets are planned in readiness order (reverse
+    flatten by default, or an explicit ``bucket_order`` permutation from
+    ``fusion.measured_order``) and issued through an
+    ``optimization_barrier`` chain, so each bucket's collective can run
+    while backprop still computes earlier layers' gradients. Scheduling
+    only — results are bitwise-identical to ``overlap=False``.
 
     Outside an SPMD region (axis names unbound) the reduction degenerates
     to size-1 reference semantics: no cross-rank sum, but pre/post scaling
@@ -104,6 +113,14 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
         return compression.decompress(w, ctx)
 
     fn = one if bound else identity_with_scales
+    if overlap and bound:
+        from .common import overlap as overlap_lib
+
+        order = bucket_order if bucket_order is not None \
+            else fusion_lib.ORDER_REVERSE
+        return overlap_lib.fused_apply_overlapped(grads, fn,
+                                                  fusion_threshold,
+                                                  order=order)
     return fusion_lib.fused_apply(grads, fn, fusion_threshold)
 
 
@@ -137,7 +154,9 @@ def DistributedOptimizer(optimizer,
                          hierarchical: bool = False,
                          local_axis: str = "local",
                          cross_axis: str = "cross",
-                         quantized_cross: bool = False):
+                         quantized_cross: bool = False,
+                         overlap: bool = False,
+                         bucket_order=None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -154,6 +173,17 @@ def DistributedOptimizer(optimizer,
     of each fused bucket as block-scaled int8 — the EQuARX-style
     quantized allreduce (collectives.quantized_hierarchical_allreduce);
     gradients land within block-absmax rounding error of the exact sum.
+
+    ``overlap=True`` buckets gradients in readiness order and chains the
+    per-bucket collectives so they fire while the backward pass is still
+    computing (common/overlap.py — the reference's background-thread
+    overlap, expressed through XLA scheduling). Composes with
+    ``hierarchical``/``quantized_cross`` (each chained bucket runs the
+    staged reduction) and reduce-safe ``compression``; same numerics as
+    ``overlap=False``. Pair with the latency-hiding XLA flags
+    (``init(overlap_xla_flags=True)`` / common/xla_tuning.py) on TPU.
+    ``bucket_order`` optionally pins a measured leaf permutation
+    (``fusion.measured_order``) instead of the reverse-flatten proxy.
     """
     try:
         import optax
@@ -174,7 +204,8 @@ def DistributedOptimizer(optimizer,
         return _reduce_tree(grads, op, axis_name, compression,
                             fusion_threshold_bytes, prescale_factor,
                             postscale_factor, hierarchical, local_axis,
-                            cross_axis, quantized_cross)
+                            cross_axis, quantized_cross, overlap,
+                            bucket_order)
 
     if k <= 1:
         def init_fn(params):
@@ -226,7 +257,9 @@ def DistributedGradFn(grad_fn: Callable,
                       compression=NoneCompressor,
                       fusion_threshold_bytes: Optional[int] = None,
                       has_value: bool = False,
-                      reduce_value: bool = True):
+                      reduce_value: bool = True,
+                      overlap: bool = False,
+                      bucket_order=None):
     """DistributedGradientTape analog (reference
     tensorflow/__init__.py:564-629): wraps a function returning gradients
     (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
@@ -236,23 +269,30 @@ def DistributedGradFn(grad_fn: Callable,
     additionally averaged across ranks when ``reduce_value``. Explicit flag
     instead of tuple-sniffing so ``jax.grad(loss, argnums=(0, 1))`` (a
     tuple of gradients) is never misclassified.
+
+    ``overlap``/``bucket_order``: readiness-ordered buckets + issue-order
+    chaining, as on :func:`DistributedOptimizer` — scheduling only,
+    identical numerics.
     """
     _check_reduce_safe(compression)
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
+
+    def reduce_grads(grads):
+        return _reduce_tree(grads, op, axis_name, compression,
+                            fusion_threshold_bytes, overlap=overlap,
+                            bucket_order=bucket_order)
 
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
         if has_value:
             val, grads = out
-            grads = _reduce_tree(grads, op, axis_name, compression,
-                                 fusion_threshold_bytes)
+            grads = reduce_grads(grads)
             if reduce_value and _axes_bound(axis_name):
                 val = jax.tree.map(
                     lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
                     val)
             return val, grads
-        return _reduce_tree(out, op, axis_name, compression,
-                            fusion_threshold_bytes)
+        return reduce_grads(out)
 
     return wrapped
 
@@ -308,13 +348,21 @@ class AutotunedStepper:
         self._tuner_done = False  # set when rank 0 broadcasts :done
         self._threshold = tuner.current
         # Joint tuning (reference ParameterManager's hierarchical toggle):
-        # build_step then takes (threshold, hierarchical).
+        # build_step then takes (threshold, hierarchical). With a
+        # tune_overlap tuner the signature widens once more to
+        # (threshold, hierarchical, overlap) — the full triple the
+        # (re)built step must agree on across ranks.
         self._joint = getattr(tuner, "tune_hierarchical", False)
+        self._joint_overlap = getattr(tuner, "tune_overlap", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
+        self._ovl = (tuner.current_overlap if self._joint_overlap
+                     else False)
         self._step = self._rebuild()
         self.rebuilds = 0
 
     def _rebuild(self):
+        if self._joint_overlap:
+            return self._build(self._threshold, self._hier, self._ovl)
         if self._joint:
             return self._build(self._threshold, self._hier)
         return self._build(self._threshold)
@@ -327,6 +375,10 @@ class AutotunedStepper:
     def hierarchical(self) -> bool:
         return self._hier
 
+    @property
+    def overlap(self) -> bool:
+        return self._ovl
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -337,13 +389,15 @@ class AutotunedStepper:
         dt = time.perf_counter() - t0
         c = self._controller
         if c is None or c.size == 1:
-            new, tuner_h = self.tuner.feed_point(self.grad_bytes, dt)
+            new, tuner_h, tuner_o = self.tuner.feed_triple(
+                self.grad_bytes, dt)
             new_h = tuner_h if self._joint else self._hier
+            new_o = tuner_o if self._joint_overlap else self._ovl
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new, new_h = self._threshold, self._hier
+            new, new_h, new_o = self._threshold, self._hier, self._ovl
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -351,19 +405,24 @@ class AutotunedStepper:
                 # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                cur_t, cur_h = self.tuner.current_point  # atomic pair
-                mine = f"{cur_t}|{int(cur_h) if self._joint else 0}" + (
-                    ":done" if c.rank == 0 and self.tuner.done else "")
+                cur_t, cur_h, cur_o = self.tuner.current_triple  # atomic
+                mine = (f"{cur_t}|{int(cur_h) if self._joint else 0}"
+                        f"|{int(cur_o) if self._joint_overlap else 0}"
+                        + (":done" if c.rank == 0 and self.tuner.done
+                           else ""))
                 vals = c.exchange("autotune_threshold", mine)
                 v0 = vals[0]  # rank 0's decision wins
                 if v0.endswith(":done"):
                     self._tuner_done = True
                     v0 = v0[:-5]
-                t_str, h_str = v0.split("|")
+                t_str, h_str, o_str = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
-        if new != self._threshold or new_h != self._hier:
-            self._threshold, self._hier = new, new_h
+                new_o = bool(int(o_str)) if self._joint_overlap \
+                    else self._ovl
+        if (new != self._threshold or new_h != self._hier
+                or new_o != self._ovl):
+            self._threshold, self._hier, self._ovl = new, new_h, new_o
             self._step = self._rebuild()
             self.rebuilds += 1
         return out

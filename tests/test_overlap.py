@@ -1,0 +1,400 @@
+"""Overlap-aware gradient fusion tests (ISSUE 1 tentpole): readiness-
+ordered bucket plans are deterministic across ranks, ``overlap=True``
+changes SCHEDULING (optimization_barrier chain in the traced program)
+but never numerics, the measured-order timeline hook round-trips, and
+the autotuner covers the (threshold, hierarchical, overlap) space."""
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.common import fusion, overlap
+from horovod_tpu.common.autotune import Autotuner
+
+
+def _mlp_tree(rng, depth=6, width=16):
+    return {
+        f"layer{i:02d}": {
+            "w": jnp.asarray(rng.standard_normal((width, width))
+                             .astype(np.float32)),
+            "b": jnp.asarray(rng.standard_normal((width,))
+                             .astype(np.float32)),
+        } for i in range(depth)}
+
+
+# -- readiness-ordered planning ---------------------------------------------
+
+def test_reverse_order_buckets_cover_last_leaves_first(rng):
+    tree = _mlp_tree(rng, depth=4, width=8)
+    nleaves = len(jax.tree.leaves(tree))
+    # Threshold of one (w, b) pair -> multiple buckets.
+    thr = (8 * 8 + 8) * 4
+    plan = fusion.plan_fusion(tree, thr, order="reverse")
+    assert plan.order == "reverse"
+    assert len(plan.buckets) > 1
+    # Bucket 0 (the first to close) must cover the LAST flatten-order
+    # leaves — the gradients backprop completes first.
+    assert max(plan.buckets[0].leaf_indices) == nleaves - 1
+    assert min(plan.buckets[-1].leaf_indices) == 0
+    # Every leaf appears exactly once.
+    covered = sorted(i for b in plan.buckets for i in b.leaf_indices)
+    assert covered == list(range(nleaves))
+
+
+def test_reverse_plan_roundtrips_and_is_deterministic_across_ranks(rng):
+    tree = _mlp_tree(rng)
+    thr = 1024
+    # Simulated ranks: each plans independently from (shapes, dtypes,
+    # threshold, order) only — identical plans, no negotiation.
+    plans = [fusion.plan_fusion(tree, thr, order="reverse")
+             for _ in range(4)]
+    ref = plans[0]
+    for p in plans[1:]:
+        assert [b.leaf_indices for b in p.buckets] == \
+            [b.leaf_indices for b in ref.buckets]
+        assert [str(b.dtype) for b in p.buckets] == \
+            [str(b.dtype) for b in ref.buckets]
+    # fuse/unfuse round-trip under the permuted plan.
+    back = fusion.unfuse(fusion.fuse(tree, ref), ref)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_explicit_order_permutation_validated(rng):
+    tree = _mlp_tree(rng, depth=2, width=4)
+    n = len(jax.tree.leaves(tree))
+    perm = list(range(n - 1, -1, -1))
+    plan = fusion.plan_fusion(tree, 64, order=perm)
+    assert plan.order == "explicit"
+    with pytest.raises(ValueError, match="permutation"):
+        fusion.plan_fusion(tree, 64, order=[0, 0, 1])
+
+
+def test_buckets_emitted_in_closing_order_for_interleaved_dtypes():
+    """Under a readiness order, a bucket opened early but fed leaves
+    throughout the visit closes LAST and must be emitted last — opening
+    (bucket-id) order would pin the early-ready bucket's collective
+    behind it. The flatten default keeps the historical id-order
+    emission: the ZeRO-1/FSDP sharded-state layout indexes plan.buckets
+    positionally, so the default plan must not reorder across releases
+    (code review #3 + follow-up)."""
+    # Flatten order = sorted keys: a0(f32) b(int32) z1 z2 z3(f32).
+    # Reverse visit: z3 z2 z1 b a0 — the f32 bucket opens first (id 0)
+    # but closes only at a0 (pos 4); the int32 bucket closes at pos 3.
+    tree = {"a0": jnp.ones((4,), jnp.float32),
+            "b": jnp.arange(3, dtype=jnp.int32),
+            "z1": jnp.ones((4,), jnp.float32),
+            "z2": jnp.ones((4,), jnp.float32),
+            "z3": jnp.ones((4,), jnp.float32)}
+    plan = fusion.plan_fusion(tree, 1 << 20, order="reverse")
+    assert [str(b.dtype) for b in plan.buckets] == ["int32", "float32"]
+    # Default flatten order: unchanged historical emission (f32 bucket
+    # id 0 first) — sharded-state checkpoint layout stability.
+    plan_flat = fusion.plan_fusion(tree, 1 << 20, order="flatten")
+    assert [str(b.dtype) for b in plan_flat.buckets] == \
+        ["float32", "int32"]
+    back = fusion.unfuse(fusion.fuse(tree, plan), plan)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mixed_dtype_reverse_order_groups_by_dtype(rng):
+    tree = {"a": jnp.ones((4,), jnp.float32),
+            "b": jnp.arange(3, dtype=jnp.int32),
+            "c": jnp.ones((5,), jnp.float32)}
+    plan = fusion.plan_fusion(tree, 1 << 20, order="reverse")
+    dtypes = [str(b.dtype) for b in plan.buckets]
+    assert sorted(dtypes) == ["float32", "int32"]
+    back = fusion.unfuse(fusion.fuse(tree, plan), plan)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- measured-order hook ----------------------------------------------------
+
+def test_measured_order_from_timeline_trace(tmp_path, rng):
+    from horovod_tpu.common.timeline import (Timeline,
+                                             readiness_order_from_trace)
+
+    trace = str(tmp_path / "tl.json")
+    tl = Timeline(use_native=False)
+    tl.start(trace)
+    # Leaf names in keystr form, recorded out of flatten order — the
+    # trace's first-seen order is the measured readiness order.
+    for name in ("['layer01']['w']", "['layer00']['b']"):
+        tl.begin(name, "XLA_ALLREDUCE")
+        tl.end(name, "XLA_ALLREDUCE")
+    tl.stop()
+
+    names = readiness_order_from_trace(trace)
+    assert names == ["['layer01']['w']", "['layer00']['b']"]
+
+    tree = _mlp_tree(rng, depth=2, width=4)
+    perm = fusion.measured_order(tree, names)
+    leaves_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    keystrs = [jax.tree_util.keystr(p) for p, _ in leaves_paths]
+    # Measured leaves lead, in measured order...
+    assert keystrs[perm[0]] == "['layer01']['w']"
+    assert keystrs[perm[1]] == "['layer00']['b']"
+    # ...and the rest follow in reverse flatten order, covering all.
+    assert sorted(perm) == list(range(len(keystrs)))
+    unmeasured = [i for i in perm[2:]]
+    assert unmeasured == sorted(unmeasured, reverse=True)
+    # The permutation drives a valid plan.
+    plan = fusion.plan_fusion(tree, 64, order=perm)
+    back = fusion.unfuse(fusion.fuse(tree, plan), plan)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- issue-order chaining ---------------------------------------------------
+
+def test_chain_issue_order_is_identity_on_values(rng):
+    flats = [jnp.asarray(rng.standard_normal((n,)).astype(np.float32))
+             for n in (5, 7, 3)]
+    outs = overlap.chain_issue_order(flats, lambda f: f * 2.0)
+    for f, o in zip(flats, outs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(f) * 2.0,
+                                   rtol=1e-6)
+
+
+def test_fused_apply_overlapped_matches_fused_apply(rng):
+    tree = _mlp_tree(rng)
+    plain = fusion.fused_apply(tree, lambda f: f * 3.0,
+                               threshold_bytes=512)
+    ovl = overlap.fused_apply_overlapped(tree, lambda f: f * 3.0, 512)
+    for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(ovl)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_inserts_optimization_barrier(rng):
+    """overlap=True must change the traced program (the barrier chain),
+    not the math — the 'changes scheduling, not numerics' proof's
+    structural half."""
+    tree = _mlp_tree(rng, depth=4, width=8)
+
+    text_plain = str(jax.make_jaxpr(
+        lambda t: fusion.fused_apply(t, lambda f: f * 2.0, 512))(tree))
+    text_ovl = str(jax.make_jaxpr(
+        lambda t: overlap.fused_apply_overlapped(
+            t, lambda f: f * 2.0, 512))(tree))
+    assert "optimization_barrier" not in text_plain
+    assert "optimization_barrier" in text_ovl
+
+
+# -- SPMD equivalence: overlap=True == overlap=False ------------------------
+
+def _train(hvd, tx, params, X, Y, steps=5):
+    ax = hvd.rank_axis()
+
+    def loss_fn(p, xb, yb):
+        h = xb
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k]["w"] + p[k]["b"])
+        return jnp.mean((h - yb) ** 2)
+
+    @hvd.spmd_step(in_specs=(P(), P(), P(ax), P(ax)),
+                   out_specs=(P(), P(), P()))
+    def step(p, s, xb, yb):
+        l, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.pmean(l, ax)
+
+    p, s = params, tx.init(params)
+    losses = []
+    for _ in range(steps):
+        p, s, l = step(p, s, X, Y)
+        losses.append(float(np.asarray(l)))
+    return p, losses
+
+
+def test_overlap_equivalence_distributed_optimizer(hvd, rng):
+    """overlap=True vs overlap=False: bit-identical updates on CPU —
+    overlap changes the schedule, never the numerics."""
+    width = 8
+    params = _mlp_tree(rng, depth=4, width=width)
+    X = rng.standard_normal((16, width)).astype(np.float32)
+    Y = rng.standard_normal((16, width)).astype(np.float32)
+    thr = (width * width + width) * 4  # multiple buckets
+
+    tx_off = hvd_mod.DistributedOptimizer(
+        optax.sgd(0.05), axis_name=hvd.rank_axis(),
+        fusion_threshold_bytes=thr, overlap=False)
+    tx_on = hvd_mod.DistributedOptimizer(
+        optax.sgd(0.05), axis_name=hvd.rank_axis(),
+        fusion_threshold_bytes=thr, overlap=True)
+
+    p_off, l_off = _train(hvd, tx_off, params, X, Y)
+    p_on, l_on = _train(hvd, tx_on, params, X, Y)
+
+    # Same buckets, different order/chain: the per-bucket collective
+    # contents are identical arrays, so CPU results match bitwise.
+    np.testing.assert_array_equal(np.asarray(l_off), np.asarray(l_on))
+    for a, b in zip(jax.tree.leaves(p_off), jax.tree.leaves(p_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_equivalence_grad_fn(hvd, rng):
+    width = 8
+    params = _mlp_tree(rng, depth=3, width=width)
+    X = rng.standard_normal((16, width)).astype(np.float32)
+    ax = hvd.rank_axis()
+
+    def loss_fn(p, xb):
+        h = xb
+        for k in sorted(p):
+            h = jnp.tanh(h @ p[k]["w"] + p[k]["b"])
+        return jnp.mean(h ** 2)
+
+    def grads_with(overlap_on):
+        gfn = hvd_mod.DistributedGradFn(
+            jax.grad(loss_fn), axis_name=ax,
+            fusion_threshold_bytes=(width * width + width) * 4,
+            overlap=overlap_on)
+
+        @hvd.spmd_step(in_specs=(P(), P(ax)), out_specs=P())
+        def run(p, xb):
+            return gfn(p, xb)
+
+        return run(params, X)
+
+    g_off, g_on = grads_with(False), grads_with(True)
+    for a, b in zip(jax.tree.leaves(g_off), jax.tree.leaves(g_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_composes_with_compression(hvd, rng):
+    from horovod_tpu.ops.compression import Compression
+
+    width = 8
+    params = _mlp_tree(rng, depth=3, width=width)
+    X = rng.standard_normal((16, width)).astype(np.float32)
+    Y = rng.standard_normal((16, width)).astype(np.float32)
+    thr = (width * width + width) * 4
+
+    def tx(overlap_on):
+        return hvd_mod.DistributedOptimizer(
+            optax.sgd(0.05), axis_name=hvd.rank_axis(),
+            compression=Compression.fp16, fusion_threshold_bytes=thr,
+            overlap=overlap_on)
+
+    p_off, _ = _train(hvd, tx(False), params, X, Y, steps=3)
+    p_on, _ = _train(hvd, tx(True), params, X, Y, steps=3)
+    for a, b in zip(jax.tree.leaves(p_off), jax.tree.leaves(p_on)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- staged per-group VJP ---------------------------------------------------
+
+def test_staged_value_and_grad_matches_monolithic(rng):
+    width = 6
+    stages = 3
+    params = [
+        {"w": jnp.asarray(rng.standard_normal((width, width))
+                          .astype(np.float32)) * 0.3,
+         "b": jnp.zeros((width,), jnp.float32)}
+        for _ in range(stages)]
+    x = jnp.asarray(rng.standard_normal((4, width)).astype(np.float32))
+
+    def stage_fn(p, act):
+        return jnp.tanh(act @ p["w"] + p["b"])
+
+    def loss_fn(act):
+        return jnp.mean(act ** 2)
+
+    def monolithic(ps):
+        act = x
+        for p in ps:
+            act = stage_fn(p, act)
+        return loss_fn(act)
+
+    ref_loss, ref_grads = jax.value_and_grad(monolithic)(params)
+    loss, grads = overlap.staged_value_and_grad(
+        [stage_fn] * stages, loss_fn, params, x)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # With a reduce_fn the chain applies it per stage — scale by 2 and
+    # check both the math and the barrier in the traced program.
+    loss2, grads2 = overlap.staged_value_and_grad(
+        [stage_fn] * stages, loss_fn, params, x,
+        reduce_fn=lambda g: jax.tree.map(lambda v: v * 2.0, g))
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(grads2)):
+        np.testing.assert_allclose(np.asarray(a) * 2.0, np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    text = str(jax.make_jaxpr(lambda ps: overlap.staged_value_and_grad(
+        [stage_fn] * stages, loss_fn, ps, x,
+        reduce_fn=lambda g: g)[1])(params))
+    assert "optimization_barrier" in text
+
+    with pytest.raises(ValueError, match="stage fns"):
+        overlap.staged_value_and_grad([stage_fn], loss_fn, params, x)
+
+
+# -- autotune over the (threshold, hierarchical, overlap) space -------------
+
+def test_autotuner_triple_space_converges():
+    mb = 1024 * 1024
+    candidates = [4 * mb, 16 * mb, 64 * mb]
+    base = {4 * mb: 300.0, 16 * mb: 1000.0, 64 * mb: 500.0}
+    t = Autotuner(candidates_bytes=candidates, warmup_samples=0,
+                  steps_per_sample=2, tune_hierarchical=True,
+                  tune_overlap=True)
+    assert len(t._space) == len(candidates) * 2 * 2
+    for _ in range(200):
+        for _ in range(t.steps_per_sample):
+            score = base[t.current] \
+                * (2.0 if t.current_hierarchical else 1.0) \
+                * (1.5 if t.current_overlap else 1.0)
+            t.record(score, 1.0)
+        if t.ready():
+            t.suggest()
+        if t.done:
+            break
+    assert t.done
+    assert t.current == 16 * mb
+    assert t.current_hierarchical is True
+    assert t.current_overlap is True
+
+
+def test_autotuner_triple_csv_columns(tmp_path):
+    log = str(tmp_path / "triple.csv")
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=0,
+                  steps_per_sample=1, tune_overlap=True, log_file=log)
+    t.record(100.0, 1.0)
+    t.suggest()
+    lines = open(log).read().strip().splitlines()
+    assert lines[0] == ("unix_time,threshold_bytes,overlap,"
+                       "score_bytes_per_sec,steps")
+    assert len(lines[1].split(",")) == 5
+
+
+def test_stepper_triple_rebuilds_on_overlap_change():
+    from horovod_tpu.optim import AutotunedStepper
+
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=0,
+                  steps_per_sample=1, tune_hierarchical=True,
+                  tune_overlap=True)
+    seen = []
+
+    def build(threshold, hierarchical, overlap_on):
+        seen.append((threshold, hierarchical, overlap_on))
+        return lambda x: x + 1
+
+    stepper = AutotunedStepper(build, grad_bytes=1000, tuner=t,
+                               block=False)
+    for i in range(30):
+        stepper(i)
+        if t.done:
+            break
+    assert stepper.rebuilds >= 1
+    assert any(o for _, _, o in seen) and any(not o for _, _, o in seen), \
+        seen
+    assert stepper.overlap in (True, False)
+    assert len(seen[0]) == 3

@@ -1,0 +1,85 @@
+"""XLA overlap-flag helper tests (tier-1-safe, no backend init): the
+merge must be idempotent, must never clobber user-set XLA_FLAGS entries,
+and must stay off on CPU-only environments."""
+
+from horovod_tpu.common import xla_tuning
+
+
+def test_merge_appends_only_missing_flags():
+    existing = "--xla_force_host_platform_device_count=8"
+    merged = xla_tuning.merge_xla_flags(existing,
+                                        xla_tuning.TPU_OVERLAP_FLAGS)
+    toks = merged.split()
+    # User token survives, in place, first.
+    assert toks[0] == existing
+    for name, value in xla_tuning.TPU_OVERLAP_FLAGS:
+        assert f"{name}={value}" in toks
+
+
+def test_merge_preserves_user_value_for_same_flag():
+    user = "--xla_tpu_enable_latency_hiding_scheduler=false"
+    merged = xla_tuning.merge_xla_flags(user, xla_tuning.TPU_OVERLAP_FLAGS)
+    toks = merged.split()
+    assert user in toks
+    # The helper's value for that flag must NOT appear alongside.
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in toks
+    assert sum(t.startswith("--xla_tpu_enable_latency_hiding_scheduler")
+               for t in toks) == 1
+
+
+def test_enable_is_idempotent():
+    env = {"JAX_PLATFORMS": "tpu", "XLA_FLAGS": "--xla_foo=bar"}
+    first = xla_tuning.enable_overlap_scheduling(env)
+    second = xla_tuning.enable_overlap_scheduling(env)
+    assert first is not None
+    assert first == second == env["XLA_FLAGS"]
+    assert env["XLA_FLAGS"].split().count("--xla_foo=bar") == 1
+    assert xla_tuning.overlap_flags_active(env)
+
+
+def test_enable_skips_cpu_only_env():
+    for env in ({"JAX_PLATFORMS": "cpu"},
+                {"JAX_PLATFORM_NAME": "cpu"},
+                {"HVD_TPU_FORCE_CPU_DEVICES": "8"}):
+        out = xla_tuning.enable_overlap_scheduling(dict(env))
+        assert out is None
+    # force=True applies anyway (e.g. to test the merge itself).
+    env = {"JAX_PLATFORMS": "cpu"}
+    out = xla_tuning.enable_overlap_scheduling(env, force=True)
+    assert out is not None and xla_tuning.overlap_flags_active(env)
+    # Mixed platform lists naming a TPU are applied.
+    env = {"JAX_PLATFORMS": "tpu,cpu"}
+    assert xla_tuning.enable_overlap_scheduling(env) is not None
+
+
+def test_enable_requires_positive_tpu_evidence(monkeypatch):
+    """No platform hint and no libtpu -> NOT applied: XLA aborts the
+    process on unknown --xla_tpu_* flags on CPU/GPU-only installs, so
+    'not provably CPU' must not be enough (code review #1)."""
+    import importlib.util
+
+    if importlib.util.find_spec("libtpu") is None:
+        assert xla_tuning.enable_overlap_scheduling({}) is None
+    assert xla_tuning._tpu_plausible({"JAX_PLATFORMS": "axon,cpu"})
+    assert xla_tuning._tpu_plausible({"JAX_PLATFORMS": "tpu"})
+    assert not xla_tuning._tpu_plausible({"JAX_PLATFORMS": "cuda"}) or \
+        importlib.util.find_spec("libtpu") is not None
+
+
+def test_extra_flags_and_bare_flag_names():
+    env = {"JAX_PLATFORMS": "tpu",
+           "XLA_FLAGS": "--xla_dump_to"}  # bare flag, no value
+    out = xla_tuning.enable_overlap_scheduling(
+        env, extra_flags=(("--xla_custom_knob", "7"),))
+    assert "--xla_custom_knob=7" in out.split()
+    assert "--xla_dump_to" in out.split()
+
+
+def test_config_knob_parses_env(monkeypatch):
+    from horovod_tpu.common.config import Config
+
+    monkeypatch.delenv("HVD_TPU_OVERLAP_XLA_FLAGS", raising=False)
+    monkeypatch.delenv("HOROVOD_OVERLAP_XLA_FLAGS", raising=False)
+    assert Config.from_env().overlap_xla_flags is False
+    monkeypatch.setenv("HVD_TPU_OVERLAP_XLA_FLAGS", "1")
+    assert Config.from_env().overlap_xla_flags is True

@@ -23,6 +23,7 @@ import glob
 import gzip
 import json
 import os
+import statistics
 import sys
 from collections import defaultdict
 
@@ -125,9 +126,27 @@ def main(root: str) -> int:
             merged_count[n] += op_count[kind][n]
     top = sorted(merged_time.items(), key=lambda kv: -kv[1])[:15]
     total_dev = sum(merged_time.values()) or 1e-9
-    infeed = sum(t for n, t in merged_time.items()
-                 if "infeed" in n.lower() or "copy" in n.lower()
-                 or "transfer" in n.lower())
+
+    def _infeed_share(times):
+        return sum(t for n, t in times.items()
+                   if "infeed" in n.lower() or "copy" in n.lower()
+                   or "transfer" in n.lower())
+
+    # Infeed/copy share against the OPS-track total when the capture
+    # names its tracks: the merged cross-track total counts the same
+    # device microsecond once per overlapping track (steps + modules +
+    # ops, ~3x), silently deflating the percentage. Unnamed-track
+    # captures fall back to the merged total — flagged so the two bases
+    # are never confused.
+    ops_times = op_time.get("ops")
+    if ops_times:
+        infeed = _infeed_share(ops_times)
+        infeed_total = sum(ops_times.values()) or 1e-9
+        infeed_basis = "ops_track"
+    else:
+        infeed = _infeed_share(merged_time)
+        infeed_total = total_dev
+        infeed_basis = "all_tracks_overlapping"
 
     out = {
         "trace": path,
@@ -137,7 +156,8 @@ def main(root: str) -> int:
              "count": merged_count[n],
              "pct_of_device": round(100 * t / total_dev, 1)}
             for n, t in top],
-        "infeed_copy_pct_of_device": round(100 * infeed / total_dev, 1),
+        "infeed_copy_pct_of_device": round(100 * infeed / infeed_total, 1),
+        "infeed_copy_pct_basis": infeed_basis,
     }
 
     # The per-HLO-op view (dedicated "XLA Ops" track only, when the
@@ -157,7 +177,9 @@ def main(root: str) -> int:
         out["steps"] = {
             "count": n,
             "mean_ms": round(sum(step_durs) / n / 1000, 3),
-            "p50_ms": round(step_durs[n // 2] / 1000, 3),
+            # statistics.median interpolates the middle pair on even
+            # counts; the old n // 2 index took the upper-middle element.
+            "p50_ms": round(statistics.median(step_durs) / 1000, 3),
             "max_ms": round(step_durs[-1] / 1000, 3),
         }
     print(json.dumps(out, indent=2))

@@ -190,11 +190,6 @@ def supervise(args):
         snap = {}
         for root, _, files in os.walk(args.cache_dir):
             for f in files:
-                if f.endswith("-atime"):
-                    # jax's LRU cache touches a '<key>-atime' sidecar
-                    # on every cache READ when eviction is enabled —
-                    # a hit must not count as a write.
-                    continue
                 p = os.path.join(root, f)
                 try:
                     st = os.stat(p)
@@ -202,6 +197,18 @@ def supervise(args):
                     continue
                 snap[p] = (st.st_mtime_ns, st.st_size)
         return snap
+
+    def _cache_writes(before, after):
+        """Paths phase 2 WROTE: new files, or pre-existing files whose
+        size changed. A pre-existing file whose mtime moved but whose
+        size didn't is classified as a READ: jax's LRU cache touches
+        read entries (and maintains sidecar bookkeeping files whose
+        names are a jax-internal detail — the old check hard-coded the
+        '-atime' suffix and would flip phase2_cache_hit spuriously the
+        day a jax upgrade renames it)."""
+        return sorted(
+            p for p, (mtime, size) in after.items()
+            if p not in before or before[p][1] != size)
 
     cache_before = _cache_snapshot()
 
@@ -227,9 +234,7 @@ def supervise(args):
     # compile of the identical function should hit the persistent cache.
     warm = payload.get("compile_s")
     cache_after = _cache_snapshot()
-    cache_written = sorted(
-        p for p, meta in cache_after.items()
-        if cache_before.get(p) != meta)
+    cache_written = _cache_writes(cache_before, cache_after)
     result = {
         "metric": "elastic_reset_resume_step",
         "value": payload.get("resume_step"),
