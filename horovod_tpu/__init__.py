@@ -52,6 +52,7 @@ from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
                     broadcast_parameters, sharded_init, sharded_update)
+from .common.faults import recovery_stats
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
 
@@ -414,4 +415,5 @@ __all__ = [
     "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built", "xla_built", "tpu_available",
     "ProcessSet", "add_process_set", "remove_process_set", "run",
+    "recovery_stats",
 ]

@@ -117,7 +117,12 @@ class CheckpointManager:
                 if hasattr(x, "shape") else x, target)
             return self._mgr.restore(
                 step, args=self._ocp.args.StandardRestore(abstract))
-        return self._mgr.restore(step)
+        # No target: restore as plain numpy. An explicit StandardRestore()
+        # (no abstract tree) is required — orbax's CompositeCheckpointHandler
+        # refuses a bare restore(step) without a handler registry or
+        # CheckpointArgs (API drift in orbax >= 0.5).
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore())
 
     def close(self) -> None:
         self._mgr.close()

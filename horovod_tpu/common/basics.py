@@ -39,6 +39,13 @@ class Context:
         logger.setLevel(getattr(logging, config.log_level.upper(),
                                 logging.WARNING))
 
+        # Chaos: (re)install the fault plan if HVD_TPU_FAULT_PLAN changed
+        # since import — any entrypoint that reaches init() runs under
+        # the plan unchanged.
+        from . import faults as faults_lib
+
+        faults_lib.refresh_from_env()
+
         if config.overlap_xla_flags and not config.force_cpu_devices:
             # Must land in XLA_FLAGS before the first backend touch (the
             # topology discovery below initializes devices). The helper
@@ -68,6 +75,24 @@ class Context:
             # run tens of seconds — the cache turns them into reads.
             import jax
 
+            if jax.config.jax_compilation_cache_dir != \
+                    config.compilation_cache_dir:
+                # jax initializes its persistent cache at most once per
+                # process, at the FIRST compile — if anything compiled
+                # before init() (or a previous Context used another dir),
+                # the config update alone is silently ignored. Reset so
+                # the next compile re-initializes against our dir.
+                # Private API, so best-effort: a jax without it just
+                # keeps the first-compile-wins behavior.
+                try:
+                    from jax._src import compilation_cache as _jax_cc
+
+                    _jax_cc.reset_cache()
+                except Exception:  # noqa: BLE001
+                    logger.warning(
+                        "could not reset jax's persistent compilation "
+                        "cache; if anything compiled before init(), "
+                        "HVD_TPU_COMPILATION_CACHE_DIR may not apply")
             jax.config.update("jax_compilation_cache_dir",
                               config.compilation_cache_dir)
         self.mesh = topo_lib.build_mesh(topo, config.rank_axis)

@@ -19,14 +19,87 @@ from __future__ import annotations
 
 import copy
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from . import faults as faults_lib
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 logger = logging.getLogger("horovod_tpu")
+
+
+# -- preemption-aware checkpointing ------------------------------------------
+#
+# TPU preemption (a spot/queued-resource reclaim, a maintenance event)
+# arrives as SIGTERM with a short grace window. The handler only LATCHES a
+# flag — async-signal-safe — and the next ``state.commit()`` honors it:
+# final snapshot, registered persistence callbacks (e.g. a disk
+# checkpoint), then a clean HOSTS_UPDATED_EXIT_CODE exit so the elastic
+# driver reschedules the work without losing the last commit.
+
+_preempt_event = threading.Event()
+_preempt_lock = threading.Lock()
+_preempt_installed = False
+_preempt_callbacks: list = []
+
+
+def _on_preempt_signal(signum, frame) -> None:
+    # ONLY latch. The handler runs on the main thread between bytecodes:
+    # touching logging or RecoveryStats here could deadlock against a
+    # non-reentrant lock the interrupted frame already holds (Event.set
+    # is safe — nothing wait()s on this event's internal lock). The
+    # stat bump + log line happen at the commit() that honors the latch.
+    _preempt_event.set()
+
+
+def install_preemption_handler(signals=None) -> bool:
+    """Install the SIGTERM latch (idempotent). Returns False when not in
+    the main thread (the signal module's restriction) — callers treat
+    that as best-effort."""
+    global _preempt_installed
+    import signal as signal_mod
+
+    with _preempt_lock:
+        if _preempt_installed:
+            return True
+        sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
+        try:
+            for s in sigs:
+                signal_mod.signal(s, _on_preempt_signal)
+        except ValueError:  # not the main thread
+            return False
+        _preempt_installed = True
+        return True
+
+
+def preemption_requested() -> bool:
+    """True once a preemption signal has been latched."""
+    return _preempt_event.is_set()
+
+
+def on_preemption(callback: Callable[["State"], None]) -> None:
+    """Register a final-persistence callback run (with the state, after
+    its last save()) before the clean preemption exit — e.g. a closure
+    over ``checkpoint.save_state``."""
+    _preempt_callbacks.append(callback)
+
+
+def _reset_preemption_for_tests() -> None:
+    global _preempt_installed
+    import signal as signal_mod
+
+    with _preempt_lock:
+        _preempt_event.clear()
+        _preempt_callbacks.clear()
+        if _preempt_installed:
+            try:
+                signal_mod.signal(signal_mod.SIGTERM, signal_mod.SIG_DFL)
+            except ValueError:
+                pass
+            _preempt_installed = False
 
 
 class State:
@@ -45,10 +118,36 @@ class State:
             cb()
 
     def commit(self) -> None:
-        """Snapshot + check for host updates (reference elastic.py:60-93:
-        commit = save + check_host_updates)."""
+        """Snapshot + honor a latched preemption + check for host updates
+        (reference elastic.py:60-93: commit = save + check_host_updates;
+        the preemption leg is TPU-native — see module header)."""
+        # Chaos worker faults fire BEFORE the snapshot: a crash here is
+        # the harsh mid-step death whose uncommitted progress must be
+        # lost, and an injected preemption latches in time for THIS
+        # commit to honor it.
+        faults_lib.maybe_worker_fault()
         self.save()
+        self._handle_preemption()
         self.check_host_updates()
+
+    def _handle_preemption(self) -> None:
+        if not _preempt_event.is_set():
+            return
+        import sys
+
+        faults_lib.stats.bump("preemptions")
+        logger.warning("preemption signal latched; running final "
+                       "persistence callbacks")
+        for cb in list(_preempt_callbacks):
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — persistence is best-effort,
+                logger.exception(     # the committed snapshot still stands
+                    "preemption persistence callback failed")
+        logger.warning(
+            "elastic: preempted — committed state saved; exiting %d for "
+            "driver reschedule", HOSTS_UPDATED_EXIT_CODE)
+        sys.exit(HOSTS_UPDATED_EXIT_CODE)
 
     def save(self) -> None:
         raise NotImplementedError
@@ -204,18 +303,47 @@ def run(func: Callable) -> Callable:
 
         from . import basics
 
+        # Preemption latch: best-effort (signal handlers are main-thread
+        # only); a worker that can't install it just dies on SIGTERM as
+        # before.
+        install_preemption_handler()
         driver_managed = bool(os.environ.get("HVD_TPU_RENDEZVOUS"))
         reset_limit = int(os.environ.get(
             "HVD_TPU_ELASTIC_RESET_LIMIT", "100"))
+        # Reset backoff (HVD_TPU_ELASTIC_RESET_BACKOFF_{BASE_S,MAX_S,
+        # DEADLINE_S}): a zero-delay reset loop against a persistently
+        # failing runtime is a hot crash-loop that hammers rendezvous
+        # and discovery; full jitter decorrelates the surviving workers.
+        backoff = faults_lib.Backoff.from_env(
+            "HVD_TPU_ELASTIC_RESET_BACKOFF", base_s=0.25, cap_s=10.0)
+        # Backoff (and its deadline) meters a RECOVERY EPISODE, not the
+        # job's lifetime: a fault arriving after a healthy stretch
+        # re-anchors it, so a 10-minutes-in transient isn't charged for
+        # the 10 healthy minutes and escalated delays from an old crash
+        # loop don't haunt later, unrelated resets.
+        heal_s = max(60.0, backoff.cap_s * 2)
+        episode_anchor = time.monotonic()
+
+        def on_fault():
+            nonlocal episode_anchor
+            now = time.monotonic()
+            if now - episode_anchor > heal_s:
+                backoff.reset()
+            episode_anchor = now
+
         resets = 0
         skip_sync = False
         while True:
-            if not skip_sync:
-                state.sync()
             try:
+                # sync() INSIDE the recovery envelope: a peer dying
+                # mid-broadcast is exactly as recoverable as one dying
+                # mid-step, and must not escape the retry loop.
+                if not skip_sync:
+                    state.sync()
                 return func(state, *args, **kwargs)
             except HostsUpdatedInterrupt as e:
                 logger.info("elastic: hosts updated; re-initializing")
+                on_fault()
                 skip_sync = e.skip_sync
                 if driver_managed:
                     # The world membership is changing: exit cleanly at
@@ -227,7 +355,9 @@ def run(func: Callable) -> Callable:
                     raise
                 logger.warning("elastic: collective failure (%s); rolling "
                                "back to last commit", e)
+                on_fault()
                 state.restore()
+                faults_lib.stats.bump("restores")
                 skip_sync = False
                 if driver_managed:
                     logger.warning(
@@ -236,11 +366,19 @@ def run(func: Callable) -> Callable:
                         PEER_FAILURE_EXIT_CODE)
                     sys.exit(PEER_FAILURE_EXIT_CODE)
             resets += 1
+            faults_lib.stats.bump("resets")
             if resets > reset_limit:
                 raise RuntimeError(
                     f"elastic reset limit ({reset_limit}) exceeded")
+            t0 = time.monotonic()
+            if not backoff.sleep():
+                raise RuntimeError(
+                    "elastic reset deadline "
+                    f"({backoff.deadline_s}s) exceeded after "
+                    f"{resets} resets")
             _reset(basics)
             state.on_reset()
+            faults_lib.stats.add_downtime(time.monotonic() - t0)
 
     return wrapper
 

@@ -1,0 +1,508 @@
+"""Deterministic fault injection (chaos) + shared recovery primitives.
+
+The elastic layer (common/elastic.py, runner/elastic_driver.py,
+runner/rendezvous.py) is the framework's fault-tolerance story, and the
+reference ships its analogs as first-class citizens (stall detection,
+elastic blacklisting — Sergeev & Del Balso, arXiv:1802.05799). None of it
+is provable without *reproducible* failures, so this module provides:
+
+* ``FaultPlan`` / ``FaultInjector`` — a seedable plan of named injection
+  sites threaded through the stack, configured via the
+  ``HVD_TPU_FAULT_PLAN`` env var (JSON) so ANY entrypoint runs under
+  chaos unchanged. Every injection is logged (and appended to
+  ``HVD_TPU_FAULT_LOG`` as JSON lines) for replay/determinism checks.
+* ``Backoff`` — the shared retry policy (exponential + full jitter +
+  optional deadline) used by the rendezvous client, the elastic reset
+  loop, and script-based host discovery.
+* ``RecoveryStats`` — process-wide counters (resets, restores, retries,
+  blacklist events, preemptions, downtime) surfaced through the
+  timeline as instant events and dumped at exit.
+
+Injection sites (hit counters are per site, 1-based):
+
+===================  =====================================================
+site                 where it fires / what it does
+===================  =====================================================
+``collective``       eager engine submit: raises a runtime-shaped comm
+                     failure (class name ``XlaRuntimeError`` + comm
+                     marker message) that ``elastic._is_comm_failure``
+                     classifies — one hit per collective call
+``collective_stall`` eager engine submit: sleeps ``delay_s`` after the
+                     stall inspector's record_submit, tripping
+                     ``StallInspector`` thresholds
+``rendezvous``       RendezvousClient request: mode ``5xx`` (default,
+                     HTTP ``code``), ``drop`` (connection error) or
+                     ``delay`` (sleep ``delay_s``) — one hit per HTTP
+                     attempt, so the client's retry/backoff absorbs it
+``discovery``        HostManager poll: mode ``flap`` (default — report an
+                     empty host set) or ``drop_host`` (remove ``target``)
+``crash``            ``State.commit()`` entry (one hit per training
+                     step): hard ``os._exit(exit_code)`` BEFORE the
+                     snapshot — uncommitted progress is lost
+``preempt``          ``State.commit()`` entry: ``SIGTERM`` to self — the
+                     preemption handler latches, commit saves and exits
+                     ``HOSTS_UPDATED_EXIT_CODE``
+===================  =====================================================
+
+Plan JSON: ``{"seed": 42, "faults": [{"site": ..., "step": N |
+"probability": p, "times": k, ...}]}`` (a bare list is accepted, seed 0).
+``step`` fires on the Nth hit of the site; ``probability`` draws from a
+per-spec ``random.Random`` seeded from (seed, spec index, site) — same
+seed, same program ⇒ same injection sequence. ``rank`` / ``host``
+restrict a spec to a worker (matched against ``HVD_TPU_PROC_ID`` /
+``HVD_TPU_HOSTNAME``).
+
+With no plan installed every site is a single attribute load + None
+check — zero-overhead no-ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("horovod_tpu")
+
+ENV_PLAN = "HVD_TPU_FAULT_PLAN"
+ENV_LOG = "HVD_TPU_FAULT_LOG"
+
+SITES = ("collective", "collective_stall", "rendezvous", "discovery",
+         "crash", "preempt")
+
+_SPEC_FIELDS = ("site", "step", "probability", "times", "mode", "delay_s",
+                "code", "exit_code", "message", "rank", "host", "target")
+
+
+class XlaRuntimeError(RuntimeError):
+    """Runtime-shaped injected comm failure.
+
+    Deliberately named like the real ``jaxlib.xla_extension
+    .XlaRuntimeError`` so ``common.elastic._is_comm_failure`` classifies
+    it through its normal path (class-name + message-marker heuristics)
+    — chaos must exercise the production classifier, not a special
+    injection branch."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    step: Optional[int] = None      # fire on the Nth hit (1-based)
+    probability: float = 0.0        # else: per-hit Bernoulli draw
+    times: int = 1                  # max injections (<=0: unlimited)
+    mode: Optional[str] = None      # site-specific action selector
+    delay_s: float = 0.0
+    code: int = 503                 # HTTP status for rendezvous 5xx
+    exit_code: int = 1              # for the crash site
+    message: str = ""
+    rank: Optional[int] = None      # restrict to HVD_TPU_PROC_ID
+    host: Optional[str] = None      # restrict to HVD_TPU_HOSTNAME
+    target: Optional[str] = None    # e.g. hostname for discovery drop_host
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; known: {SITES}")
+        if self.step is None and self.probability <= 0.0:
+            raise ValueError(
+                f"fault spec for site {self.site!r} needs 'step' or a "
+                "positive 'probability'")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    seed: int = 0
+    faults: List[FaultSpec] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        if isinstance(data, list):
+            data = {"seed": 0, "faults": data}
+        if not isinstance(data, dict):
+            raise ValueError("fault plan must be a JSON object or list")
+        specs = []
+        for i, raw in enumerate(data.get("faults", [])):
+            unknown = set(raw) - set(_SPEC_FIELDS)
+            if unknown:
+                # A typo'd key must not silently disable the chaos it
+                # was meant to configure.
+                raise ValueError(
+                    f"fault spec #{i} has unknown keys {sorted(unknown)}")
+            specs.append(FaultSpec(**raw))
+        return cls(seed=int(data.get("seed", 0)), faults=specs)
+
+
+class FaultInjector:
+    """Evaluates a FaultPlan at the named sites, deterministically.
+
+    Thread-safe; each site keeps a hit counter, each spec a fired
+    counter and (for probability mode) its own seeded RNG stream."""
+
+    def __init__(self, plan: FaultPlan, log_path: Optional[str] = None):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[int, int] = {}
+        self._rngs = [random.Random(f"{plan.seed}:{i}:{s.site}")
+                      for i, s in enumerate(plan.faults)]
+        self._log_path = log_path if log_path is not None \
+            else os.environ.get(ENV_LOG) or None
+        self._rank = os.environ.get("HVD_TPU_PROC_ID")
+        self._host = os.environ.get("HVD_TPU_HOSTNAME")
+        self.injections: List[dict] = []
+
+    def _matches(self, i: int, spec: FaultSpec, hit: int) -> bool:
+        if spec.rank is not None and str(spec.rank) != self._rank:
+            return False
+        if spec.host is not None and spec.host != self._host:
+            return False
+        if spec.times > 0 and self._fired.get(i, 0) >= spec.times:
+            return False
+        if spec.step is not None:
+            return hit == spec.step or (
+                spec.times != 1 and hit > spec.step
+                and (spec.times <= 0
+                     or hit - spec.step < spec.times))
+        return self._rngs[i].random() < spec.probability
+
+    def check(self, site: str) -> Optional[FaultSpec]:
+        """Advance the site's hit counter; return the matching spec (and
+        record the injection) or None."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            for i, spec in enumerate(self.plan.faults):
+                if spec.site != site:
+                    continue
+                if self._matches(i, spec, hit):
+                    self._fired[i] = self._fired.get(i, 0) + 1
+                    rec = {"seq": len(self.injections) + 1, "site": site,
+                           "hit": hit, "spec": i,
+                           "mode": spec.mode, "rank": self._rank,
+                           "host": self._host}
+                    self.injections.append(rec)
+                    self._record(rec, spec)
+                    return spec
+        return None
+
+    def _record(self, rec: dict, spec: FaultSpec) -> None:
+        stats.bump("injections")
+        logger.warning(
+            "chaos: injecting %s (hit %d, spec %d, mode=%s, rank=%s, "
+            "host=%s)", rec["site"], rec["hit"], rec["spec"], spec.mode,
+            rec["rank"], rec["host"])
+        if self._log_path:
+            try:
+                with open(self._log_path, "a") as f:
+                    f.write(json.dumps({**rec, "t": time.time()}) + "\n")
+            except OSError:  # the log is best-effort, never fatal
+                pass
+
+    def hit_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._hits)
+
+
+# -- module-level installation ------------------------------------------------
+
+_injector: Optional[FaultInjector] = None
+_env_raw: Optional[str] = None
+
+
+def install(plan: FaultPlan,
+            log_path: Optional[str] = None) -> FaultInjector:
+    global _injector
+    _injector = FaultInjector(plan, log_path=log_path)
+    logger.warning("chaos: fault plan installed (seed=%d, %d specs)",
+                   plan.seed, len(plan.faults))
+    return _injector
+
+
+def uninstall() -> None:
+    global _injector, _env_raw
+    _injector = None
+    _env_raw = None
+
+
+def injector() -> Optional[FaultInjector]:
+    return _injector
+
+
+def active() -> bool:
+    return _injector is not None
+
+
+def refresh_from_env() -> Optional[FaultInjector]:
+    """(Re)install from HVD_TPU_FAULT_PLAN if its raw value changed since
+    the last parse (called at import, hvd.init(), and driver start so a
+    plan set after import still takes effect). A removed/emptied env var
+    uninstalls."""
+    global _env_raw, _injector
+    raw = os.environ.get(ENV_PLAN) or None
+    if raw == _env_raw:
+        return _injector
+    _env_raw = raw
+    if raw is None:
+        _injector = None
+        return None
+    return install(FaultPlan.from_json(raw))
+
+
+# -- site helpers (the one-liners call sites use) ----------------------------
+#
+# Each is a single global load + None check when no plan is installed.
+
+def maybe_collective_fault() -> None:
+    """Eager-engine submit: raise a runtime-shaped comm failure."""
+    inj = _injector
+    if inj is None:
+        return
+    spec = inj.check("collective")
+    if spec is not None:
+        raise XlaRuntimeError(
+            spec.message
+            or "injected: connection aborted by peer (chaos)")
+
+
+def maybe_collective_stall() -> None:
+    """Eager-engine submit, after record_submit: delay so the stall
+    inspector sees an in-flight collective past its thresholds."""
+    inj = _injector
+    if inj is None:
+        return
+    spec = inj.check("collective_stall")
+    if spec is not None and spec.delay_s > 0:
+        time.sleep(spec.delay_s)
+
+
+def maybe_rendezvous_fault() -> None:
+    """Rendezvous client, per HTTP attempt: 5xx / drop / delay."""
+    inj = _injector
+    if inj is None:
+        return
+    spec = inj.check("rendezvous")
+    if spec is None:
+        return
+    mode = spec.mode or "5xx"
+    if mode == "delay":
+        time.sleep(spec.delay_s)
+        return
+    import urllib.error
+
+    if mode == "drop":
+        raise urllib.error.URLError(
+            ConnectionResetError(spec.message or "injected: connection "
+                                 "reset (chaos)"))
+    raise urllib.error.HTTPError(
+        "chaos://injected", spec.code,
+        spec.message or "injected server error (chaos)", None, None)
+
+
+def maybe_discovery_flap(hosts: Dict[str, int]) -> Dict[str, int]:
+    """Host-discovery poll: flap the reported host set."""
+    inj = _injector
+    if inj is None:
+        return hosts
+    spec = inj.check("discovery")
+    if spec is None:
+        return hosts
+    if (spec.mode or "flap") == "drop_host":
+        return {h: s for h, s in hosts.items() if h != spec.target}
+    return {}
+
+
+def maybe_worker_fault() -> None:
+    """State.commit() entry (one hit per training step): crash hard or
+    deliver a preemption SIGTERM to self."""
+    inj = _injector
+    if inj is None:
+        return
+    spec = inj.check("crash")
+    if spec is not None:
+        logger.warning("chaos: hard worker crash (os._exit(%d))",
+                       spec.exit_code)
+        os._exit(spec.exit_code)
+    spec = inj.check("preempt")
+    if spec is not None:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+# -- shared retry/backoff policy ---------------------------------------------
+
+class Backoff:
+    """Exponential backoff with FULL jitter and an optional deadline.
+
+    delay(attempt n) ~ uniform(0, min(cap_s, base_s * factor**n)) — the
+    AWS "full jitter" policy: workers that fail together don't retry
+    together. Deterministic under an injected ``rng``
+    (``random.Random(seed)``); ``clock``/``sleep_fn`` are injectable for
+    tests."""
+
+    def __init__(self, base_s: float = 0.1, factor: float = 2.0,
+                 cap_s: float = 5.0, deadline_s: Optional[float] = None,
+                 rng=None, clock: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        self.base_s = base_s
+        self.factor = factor
+        self.cap_s = cap_s
+        self.deadline_s = deadline_s
+        self._rng = rng if rng is not None else random
+        self._clock = clock
+        self._sleep = sleep_fn
+        self._t0 = clock()
+        self.attempts = 0
+
+    @classmethod
+    def from_env(cls, prefix: str, base_s: float, cap_s: float,
+                 deadline_s: Optional[float] = None, **kwargs) -> "Backoff":
+        """Knobs ``<prefix>_BASE_S`` / ``<prefix>_MAX_S`` /
+        ``<prefix>_DEADLINE_S`` (unset/non-positive deadline = none)."""
+
+        def _f(name: str, default: Optional[float]) -> Optional[float]:
+            raw = os.environ.get(prefix + name)
+            if raw is None:
+                return default
+            try:
+                return float(raw)
+            except ValueError:
+                return default
+
+        deadline = _f("_DEADLINE_S", deadline_s)
+        if deadline is not None and deadline <= 0:
+            deadline = None
+        return cls(base_s=_f("_BASE_S", base_s), cap_s=_f("_MAX_S", cap_s),
+                   deadline_s=deadline, **kwargs)
+
+    def reset(self) -> None:
+        self.attempts = 0
+        self._t0 = self._clock()
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - (self._clock() - self._t0)
+
+    def next_delay(self) -> float:
+        ceiling = min(self.cap_s, self.base_s * (self.factor **
+                                                 self.attempts))
+        self.attempts += 1
+        return self._rng.uniform(0.0, ceiling)
+
+    def sleep(self) -> bool:
+        """Sleep the next jittered delay. Returns False (without
+        sleeping past it) when the deadline is exhausted — the caller
+        should stop retrying."""
+        delay = self.next_delay()
+        rem = self.remaining()
+        if rem is not None:
+            if rem <= 0:
+                return False
+            delay = min(delay, rem)
+        self._sleep(delay)
+        return self.remaining() is None or self.remaining() > 0
+
+
+# -- recovery observability ---------------------------------------------------
+
+class RecoveryStats:
+    """Process-wide recovery counters (reference analog: the coordinator
+    logs stalls/evictions but keeps no machine-readable account; at
+    pod scale "how often did we reset and how long were we down" IS the
+    SLO). Counters are bumped by the elastic/rendezvous/driver layers,
+    mirrored into the timeline as instant events when tracing is on,
+    and dumped at process exit once any counter is nonzero."""
+
+    COUNTERS = ("resets", "restores", "retries", "rendezvous_retries",
+                "discovery_retries", "blacklist_events",
+                "blacklist_recoveries", "preemptions", "injections")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self.downtime_seconds = 0.0
+        self._exit_hook_registered = False
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            if name.endswith("_retries"):
+                # "retries" aggregates every retry family
+                # (rendezvous_retries, discovery_retries, ...).
+                self._counts["retries"] = self._counts.get("retries", 0) + n
+        self._register_exit_hook()
+        self._emit_timeline(name)
+
+    def add_downtime(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        with self._lock:
+            self.downtime_seconds += seconds
+        self._register_exit_hook()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {k: self._counts.get(k, 0)
+                                   for k in self.COUNTERS}
+            for k, v in self._counts.items():
+                out.setdefault(k, v)
+            out["downtime_seconds"] = round(self.downtime_seconds, 3)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self.downtime_seconds = 0.0
+
+    def _emit_timeline(self, name: str) -> None:
+        # Loose coupling: only touch the timeline when a context exists
+        # and tracing is active; never let observability break recovery.
+        try:
+            from . import basics
+
+            if basics.is_initialized():
+                tl = basics.context().timeline
+                if tl is not None and tl.active:
+                    tl.recovery(name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _register_exit_hook(self) -> None:
+        if self._exit_hook_registered:
+            return
+        self._exit_hook_registered = True
+        import atexit
+
+        atexit.register(self._dump_at_exit)
+
+    def _dump_at_exit(self) -> None:
+        snap = self.snapshot()
+        if not any(v for v in snap.values()):
+            return
+        logger.warning("recovery stats at exit: %s", json.dumps(snap))
+        path = os.environ.get("HVD_TPU_RECOVERY_STATS_FILE")
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(snap, f)
+            except OSError:
+                pass
+
+
+stats = RecoveryStats()
+
+
+def recovery_stats() -> Dict[str, Any]:
+    """Snapshot of the process-wide recovery counters."""
+    return stats.snapshot()
+
+
+# Pick up a plan exported by the launcher before this process imported us.
+refresh_from_env()

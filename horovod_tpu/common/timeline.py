@@ -33,6 +33,11 @@ XLA_ALLGATHER = "XLA_ALLGATHER"
 XLA_BROADCAST = "XLA_BROADCAST"
 XLA_ALLTOALL = "XLA_ALLTOALL"
 UNFUSE = "MEMCPY_OUT_FUSION_BUFFER"
+# Recovery lifecycle markers (no reference analog by name — the reference
+# logs resets/blacklists as text; here each recovery-counter bump lands in
+# the trace as an instant event RECOVERY:<counter> so downtime and retry
+# storms are visible next to the collectives they interrupt).
+RECOVERY = "RECOVERY"
 
 
 def readiness_order_from_trace(filename: str,
@@ -199,6 +204,11 @@ class Timeline:
         """Cycle markers (reference HOROVOD_TIMELINE_MARK_CYCLES)."""
         if self._mark_cycles:
             self.instant("CYCLE")
+
+    def recovery(self, counter: str) -> None:
+        """Recovery-counter bump as an instant event (fed by
+        common.faults.RecoveryStats)."""
+        self.instant(f"{RECOVERY}:{counter}")
 
     # -- writer thread (reference timeline.cc TimelineWriter) --------------
 

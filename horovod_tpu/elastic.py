@@ -1,7 +1,12 @@
 """``hvd.elastic`` namespace — reference horovod/torch/elastic,
 horovod/tensorflow/elastic.py public surface (State/ObjectState + run
-wrapper), re-exported from the framework-agnostic core."""
+wrapper), re-exported from the framework-agnostic core, plus the
+TPU-native preemption-aware checkpointing hooks (SIGTERM latch honored
+at ``state.commit()``)."""
 
 from .common.elastic import (  # noqa: F401
-    JaxState, ObjectState, State, run)
+    HOSTS_UPDATED_EXIT_CODE, PEER_FAILURE_EXIT_CODE, JaxState, ObjectState,
+    State, install_preemption_handler, on_preemption,
+    preemption_requested, run)
+from .common.faults import recovery_stats  # noqa: F401
 from .checkpoint import restore_state, save_state  # noqa: F401

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 logger = logging.getLogger("horovod_tpu")
 
+from ..common import faults as faults_lib
 from ..common import fusion as fusion_lib
 from ..common.exceptions import (DuplicateTensorNameError,
                                  TensorShapeMismatchError)
@@ -559,6 +560,11 @@ class EagerEngine:
     # -- named-tensor tracking (duplicate detection, stall) ----------------
 
     def _begin(self, name: Optional[str], kind: str):
+        # Chaos site "collective": a runtime-shaped comm failure raised
+        # here takes the exact path a dead peer's XlaRuntimeError would —
+        # through the caller into elastic run()'s _is_comm_failure
+        # classification. No-op (one global load) without a fault plan.
+        faults_lib.maybe_collective_fault()
         if name is None:
             # Auto-name unnamed tensors (reference: framework bindings name
             # anonymous tensors "allreduce.noname.N", e.g. torch/mpi_ops.py)
@@ -585,6 +591,10 @@ class EagerEngine:
             time.sleep(0.001)
         if self.stall is not None:
             self.stall.record_submit(full)
+        # Chaos site "collective_stall": delay AFTER record_submit so the
+        # stall inspector sees a genuinely in-flight collective age past
+        # its thresholds (trips the watchdog, not a synthetic error).
+        faults_lib.maybe_collective_stall()
         if self.timeline is not None:
             self.timeline.begin(full, kind.upper())
         return full

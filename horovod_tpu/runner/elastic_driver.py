@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+from ..common import faults as faults_lib
 from . import hosts as hosts_lib
 from .launch import build_env_for_slot
 from .rendezvous import RendezvousServer
@@ -59,23 +60,37 @@ class ScriptHostDiscovery(HostDiscovery):
         self._script = script
         self._timeout_s = timeout_s
         self._last: Dict[str, int] = {}
+        # Failure backoff: a flapping/crashing discovery script gets
+        # re-run on an exponential full-jitter schedule
+        # (HVD_TPU_DISCOVERY_BACKOFF_{BASE_S,MAX_S}) instead of every
+        # poll — the last good answer serves in between.
+        self._backoff = faults_lib.Backoff.from_env(
+            "HVD_TPU_DISCOVERY_BACKOFF", base_s=1.0, cap_s=30.0)
+        self._retry_at = 0.0
+
+    def _fail(self, why: str) -> Dict[str, int]:
+        delay = self._backoff.next_delay()
+        self._retry_at = time.monotonic() + delay
+        faults_lib.stats.bump("discovery_retries")
+        logger.warning("elastic: discovery script failed (%s); keeping "
+                       "last known hosts, next attempt in %.1fs",
+                       why, delay)
+        return dict(self._last)
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
         # A hung or transiently failing script must not kill the discovery
         # thread or wipe the host set — fall back to the last good answer
         # (the reference's HostManager likewise only applies *successful*
         # discovery results).
+        if time.monotonic() < self._retry_at:
+            return dict(self._last)
         try:
             out = subprocess.run([self._script], capture_output=True,
                                  text=True, timeout=self._timeout_s)
         except (subprocess.TimeoutExpired, OSError) as e:
-            logger.warning("elastic: discovery script failed (%s); keeping "
-                           "last known hosts", e)
-            return dict(self._last)
+            return self._fail(str(e))
         if out.returncode != 0:
-            logger.warning("elastic: discovery script exited %d; keeping "
-                           "last known hosts", out.returncode)
-            return dict(self._last)
+            return self._fail(f"exit code {out.returncode}")
         hosts: Dict[str, int] = {}
         for line in out.stdout.splitlines():
             line = line.strip()
@@ -87,6 +102,8 @@ class ScriptHostDiscovery(HostDiscovery):
             else:
                 hosts[line] = 1
         self._last = dict(hosts)
+        self._backoff.reset()
+        self._retry_at = 0.0
         return hosts
 
 
@@ -96,51 +113,107 @@ class HostState:
     blacklisted: bool = False
 
 
+@dataclasses.dataclass
+class _BlacklistEntry:
+    until: float       # monotonic expiry; inf = permanent
+    strikes: int       # failures so far — doubles the next exile
+    announced: bool = False  # recovery-probe eligibility logged once
+
+
 class HostManager:
     """Tracks current/blacklisted hosts (reference discovery.py:61-164).
 
-    The blacklist is a persistent, separate set: a failed host that drops
-    out of discovery and later reappears stays blacklisted (the reference
-    excludes blacklisted hosts permanently)."""
+    The blacklist carries a TTL (``HVD_TPU_BLACKLIST_TTL_S``, default
+    300 s; <= 0 restores the reference's permanent exile): a TPU-VM that
+    failed once is routinely healthy again after a reboot/reschedule, and
+    permanent exile slowly bleeds a long-lived job of capacity. When an
+    entry expires the host becomes eligible again (the recovery probe —
+    it simply re-enters assignment); a host that fails again is exiled
+    for twice as long per accumulated strike."""
 
-    def __init__(self, discovery: HostDiscovery):
+    def __init__(self, discovery: HostDiscovery,
+                 blacklist_ttl_s: Optional[float] = None,
+                 clock=time.monotonic):
         self._discovery = discovery
         self._hosts: Dict[str, HostState] = {}
-        self._blacklist: Set[str] = set()
+        if blacklist_ttl_s is None:
+            try:
+                blacklist_ttl_s = float(os.environ.get(
+                    "HVD_TPU_BLACKLIST_TTL_S", "300"))
+            except ValueError:
+                blacklist_ttl_s = 300.0
+        self._ttl = blacklist_ttl_s
+        self._clock = clock
+        self._blacklist: Dict[str, _BlacklistEntry] = {}
+        self._last_usable: Optional[Dict[str, int]] = None
         self._lock = threading.Lock()
 
+    def _is_blacklisted_locked(self, hostname: str) -> bool:
+        e = self._blacklist.get(hostname)
+        if e is None:
+            return False
+        if self._clock() < e.until:
+            return True
+        if not e.announced:
+            # Recovery probe: the exile expired; the host re-enters
+            # assignment on the next topology change. Strikes persist so
+            # a re-failure is exiled longer, not forever-flapping.
+            e.announced = True
+            faults_lib.stats.bump("blacklist_recoveries")
+            logger.warning(
+                "elastic: blacklist TTL expired for host %s (strike %d); "
+                "eligible for recovery probe", hostname, e.strikes)
+        return False
+
     def update_available_hosts(self) -> bool:
-        """Poll discovery; returns True if the usable host set changed."""
+        """Poll discovery; returns True if the USABLE host set changed —
+        including a blacklist TTL expiring with no discovery change."""
         found = self._discovery.find_available_hosts_and_slots()
+        found = faults_lib.maybe_discovery_flap(found)
         with self._lock:
-            changed = False
-            for name, slots in found.items():
-                usable = name not in self._blacklist
-                if name not in self._hosts:
-                    self._hosts[name] = HostState(slots)
-                    changed = changed or usable
-                elif self._hosts[name].slots != slots:
-                    self._hosts[name].slots = slots
-                    changed = changed or usable
-            for name in list(self._hosts):
-                if name not in found:
-                    del self._hosts[name]
-                    changed = changed or name not in self._blacklist
-            return changed
+            self._hosts = {n: HostState(s) for n, s in found.items()}
+            usable = {n: s for n, s in found.items()
+                      if not self._is_blacklisted_locked(n)}
+            prev = self._last_usable
+            self._last_usable = usable
+            if prev is None:
+                return bool(usable)
+            return usable != prev
 
     def blacklist(self, hostname: str) -> None:
         with self._lock:
-            self._blacklist.add(hostname)
-        logger.warning("elastic: blacklisted host %s", hostname)
+            e = self._blacklist.get(hostname)
+            strikes = (e.strikes if e else 0) + 1
+            if self._ttl <= 0:
+                until = float("inf")
+            else:
+                until = self._clock() + self._ttl * (2 ** (strikes - 1))
+            self._blacklist[hostname] = _BlacklistEntry(until, strikes)
+        faults_lib.stats.bump("blacklist_events")
+        if self._ttl <= 0:
+            logger.warning("elastic: blacklisted host %s (permanent)",
+                           hostname)
+        else:
+            logger.warning(
+                "elastic: blacklisted host %s for %.0fs (strike %d)",
+                hostname, self._ttl * (2 ** (strikes - 1)), strikes)
 
     def current_hosts(self) -> Dict[str, int]:
         with self._lock:
             return {n: h.slots for n, h in self._hosts.items()
-                    if n not in self._blacklist}
+                    if not self._is_blacklisted_locked(n)}
 
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
-            return hostname in self._blacklist
+            return self._is_blacklisted_locked(hostname)
+
+    def blacklist_snapshot(self) -> Dict[str, Dict]:
+        """Diagnostic view: hostname -> {strikes, remaining_s}."""
+        with self._lock:
+            now = self._clock()
+            return {h: {"strikes": e.strikes,
+                        "remaining_s": max(0.0, e.until - now)}
+                    for h, e in self._blacklist.items()}
 
 
 class ElasticDriver:
@@ -294,6 +367,7 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     from .launch import _free_port, _slot_local_env
 
     local = _is_local_epoch(slots)
+    force_local = bool(os.environ.get("HVD_TPU_ELASTIC_FORCE_LOCAL"))
     procs: List = []  # (hostname, Popen)
     threads: List[threading.Thread] = []
     if spawner is not None:
@@ -302,11 +376,23 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
         port = _free_port()
         coordinator = f"127.0.0.1:{port}"
         for s in slots:
+            # FORCE_LOCAL simulates independent virtual hosts: each
+            # worker is its OWN single-process world (the CPU backend
+            # has no multiprocess collectives), while HVD_TPU_PROC_ID
+            # still carries the virtual global rank and
+            # HVD_TPU_VIRTUAL_NUM_PROC the epoch's virtual world size
+            # for scripts that assert on topology.
+            sim = ({"HVD_TPU_NUM_PROC": "1",
+                    "HVD_TPU_VIRTUAL_NUM_PROC": str(len(slots)),
+                    "HVD_TPU_VIRTUAL_HOSTS": ",".join(
+                        dict.fromkeys(sl.hostname for sl in slots))}
+                   if force_local else {})
             env = build_env_for_slot(
                 dict(os.environ), coordinator, len(slots), s.rank,
                 {**env_extra,
                  **_slot_local_env(s.local_rank, s.local_size),
-                 "HVD_TPU_HOSTNAME": s.hostname})
+                 "HVD_TPU_HOSTNAME": s.hostname,
+                 **sim})
             p = subprocess.Popen(command, env=env,
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT)
@@ -456,6 +542,9 @@ def run_elastic(args, command: List[str],
         discovery = FixedHostDiscovery(
             {h.hostname: h.slots for h in host_infos})
 
+    # Chaos: pick up a plan set after import (workers inherit the env —
+    # ssh epochs get it via env_extra below).
+    faults_lib.refresh_from_env()
     driver = ElasticDriver(discovery, min_np, max_np)
     driver.start_discovery()
     # Per-job HMAC secret (reference runner/common/util/secret.py): the
@@ -487,6 +576,12 @@ def run_elastic(args, command: List[str],
     env_extra["HVD_TPU_RENDEZVOUS"] = advertise
     if job_secret:
         env_extra["HVD_TPU_RENDEZVOUS_SECRET"] = job_secret
+    # Fault plan + injection log ride along explicitly: local epochs
+    # inherit os.environ, but ssh/spawner epochs build env from scratch
+    # — "any entrypoint runs under chaos unchanged" includes those.
+    for chaos_var in (faults_lib.ENV_PLAN, faults_lib.ENV_LOG):
+        if chaos_var in os.environ:
+            env_extra.setdefault(chaos_var, os.environ[chaos_var])
 
     def bump_version():
         nonlocal topo_version
@@ -495,19 +590,40 @@ def run_elastic(args, command: List[str],
 
     try:
         attempts = 0
+        epoch_down_since: Optional[float] = None
         while True:
             try:
                 driver.wait_for_available_slots(
                     min_np,
                     timeout_s=(600.0 if slot_wait_timeout_s is None
                                else slot_wait_timeout_s))
-            except TimeoutError as e:
-                logger.error("elastic: %s", e)
+            except TimeoutError:
+                # Graceful degradation below min_np: the job cannot
+                # continue, but nothing is lost — say exactly where the
+                # recovery state lives and why the world shrank.
+                hosts = driver.host_manager.current_hosts()
+                logger.error(
+                    "elastic: world shrank below min_np=%d and stayed "
+                    "there (usable hosts: %s, blacklist: %s). The last "
+                    "committed state is intact — workers persist at "
+                    "commit() points — so rerunning this command resumes "
+                    "from the last commit once capacity returns.",
+                    min_np, hosts or "{}",
+                    driver.host_manager.blacklist_snapshot() or "{}")
                 return 1
+            if epoch_down_since is not None:
+                faults_lib.stats.add_downtime(
+                    time.monotonic() - epoch_down_since)
+                epoch_down_since = None
             # Clear BEFORE computing assignments: a change landing after
             # the clear re-fires and interrupts the epoch; anything
             # earlier is folded into the assignments below.
             driver.clear_host_updates()
+            # Fresh poll: a restarted epoch must see hosts that appeared
+            # while the previous epoch was dying (the 1 s background
+            # poll may not have run since), or a fast failure loop keeps
+            # relaunching yesterday's topology.
+            driver.host_manager.update_available_hosts()
             slots = driver.update_assignments()
             logger.info(
                 "elastic launch attempt %d with np=%d over hosts %s",
@@ -520,6 +636,8 @@ def run_elastic(args, command: List[str],
                 spawner=spawner)
             if rc == 0 and not failed_hosts and not interrupted:
                 return 0
+            epoch_down_since = time.monotonic()
+            faults_lib.stats.bump("resets")
             for h in failed_hosts:
                 driver.record_failure(h)
             bump_version()
@@ -532,8 +650,10 @@ def run_elastic(args, command: List[str],
                 return rc or 1
             if not driver.host_manager.current_hosts():
                 logger.error(
-                    "elastic: every host is blacklisted or gone — "
-                    "job failed (reference registration.py:156)")
+                    "elastic: every host is blacklisted or gone — job "
+                    "failed (reference registration.py:156). Last "
+                    "committed state is preserved; blacklist TTLs: %s",
+                    driver.host_manager.blacklist_snapshot() or "{}")
                 return rc or 1
     finally:
         if owns_rdv:

@@ -331,6 +331,10 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-np", type=int, default=None)
     p.add_argument("--max-np", type=int, default=None)
     p.add_argument("--host-discovery-script", default=None)
+    p.add_argument("--fault-plan", default=None,
+                   help="chaos: JSON fault plan (or @/path/to/plan.json) "
+                        "exported to workers as HVD_TPU_FAULT_PLAN — see "
+                        "horovod_tpu/common/faults.py for sites/format")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
@@ -428,6 +432,17 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_LOG_LEVEL"] = args.log_level
     if args.elastic:
         env["HVD_TPU_ELASTIC"] = "1"
+    if args.fault_plan:
+        plan = args.fault_plan
+        if plan.startswith("@"):
+            with open(plan[1:]) as f:
+                plan = f.read()
+        # Parse eagerly: a malformed plan must fail the launch, not
+        # silently strip the chaos from every worker.
+        from ..common.faults import FaultPlan
+
+        FaultPlan.from_json(plan)
+        env["HVD_TPU_FAULT_PLAN"] = plan
     return env
 
 

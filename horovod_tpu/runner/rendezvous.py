@@ -24,11 +24,14 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
+
+logger = logging.getLogger("horovod_tpu")
 
 _AUTH_HEADER = "X-HVD-Auth"
 
@@ -179,25 +182,75 @@ class RendezvousServer:
 class RendezvousClient:
     """Worker-side client (reference: http/http_client.py). Signs every
     request when a secret is configured (argument or
-    HVD_TPU_RENDEZVOUS_SECRET)."""
+    HVD_TPU_RENDEZVOUS_SECRET).
+
+    Every request retries transient failures — connection errors,
+    timeouts, HTTP 5xx — with exponential full-jitter backoff
+    (``retries`` attempts beyond the first; knobs
+    ``HVD_TPU_RENDEZVOUS_RETRIES`` /
+    ``HVD_TPU_RENDEZVOUS_BACKOFF_{BASE_S,MAX_S}``). 4xx responses
+    (404 absent key, 403 auth, 409 put-if-absent conflict) carry
+    protocol meaning and surface immediately."""
 
     def __init__(self, addr: str, port: int, timeout_s: float = 30.0,
-                 secret: Optional[bytes] = None):
+                 secret: Optional[bytes] = None,
+                 retries: Optional[int] = None):
         self.base = f"http://{addr}:{port}"
         self.timeout_s = timeout_s
         self._secret = secret if secret is not None else _env_secret()
+        if retries is None:
+            try:
+                retries = int(os.environ.get(
+                    "HVD_TPU_RENDEZVOUS_RETRIES", "4"))
+            except ValueError:
+                retries = 4
+        self.retries = max(0, retries)
+
+    def _backoff(self):
+        from ..common import faults as faults_lib
+
+        return faults_lib.Backoff.from_env(
+            "HVD_TPU_RENDEZVOUS_BACKOFF", base_s=0.1, cap_s=2.0)
 
     def _request(self, path_qs: str, method: str,
                  data: Optional[bytes] = None):
+        import urllib.error
         import urllib.request
 
-        req = urllib.request.Request(self.base + path_qs, data=data,
-                                     method=method)
-        if self._secret is not None:
-            req.add_header(_AUTH_HEADER,
-                           _digest(self._secret, method, path_qs,
-                                   data or b""))
-        return urllib.request.urlopen(req, timeout=self.timeout_s)
+        from ..common import faults as faults_lib
+
+        backoff = self._backoff()
+        attempt = 0
+        while True:
+            try:
+                # Chaos site: per-attempt, so an injected 5xx/drop is
+                # absorbed by this very retry loop.
+                faults_lib.maybe_rendezvous_fault()
+                req = urllib.request.Request(self.base + path_qs,
+                                             data=data, method=method)
+                if self._secret is not None:
+                    req.add_header(_AUTH_HEADER,
+                                   _digest(self._secret, method, path_qs,
+                                           data or b""))
+                return urllib.request.urlopen(req,
+                                              timeout=self.timeout_s)
+            except urllib.error.HTTPError as e:
+                # 4xx is protocol semantics; only server-side errors are
+                # retryable.
+                if e.code < 500 or attempt >= self.retries:
+                    raise
+                err = e
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError) as e:
+                if attempt >= self.retries:
+                    raise
+                err = e
+            attempt += 1
+            faults_lib.stats.bump("rendezvous_retries")
+            logger.debug(
+                "rendezvous: %s %s failed (%s); retry %d/%d",
+                method, path_qs, err, attempt, self.retries)
+            backoff.sleep()
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         self._request(f"/kv/{scope}/{key}", "PUT", value).read()
@@ -227,17 +280,33 @@ class RendezvousClient:
 
     def wait(self, scope: str, key: str,
              timeout_s: float = 60.0) -> bytes:
+        """Poll until the key exists. Polling backs off exponentially
+        (full jitter, capped at ``HVD_TPU_RENDEZVOUS_WAIT_MAX_POLL_S``,
+        default 1 s) — N workers hot-polling a slow coordinator at 50 ms
+        is a self-inflicted thundering herd."""
         import time
 
+        from ..common import faults as faults_lib
+
+        try:
+            cap = float(os.environ.get(
+                "HVD_TPU_RENDEZVOUS_WAIT_MAX_POLL_S", "1.0"))
+        except ValueError:
+            cap = 1.0
+        backoff = faults_lib.Backoff(base_s=0.05, cap_s=cap)
         deadline = time.monotonic() + timeout_s
         while True:
             val = self.get(scope, key)
             if val is not None:
                 return val
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if now > deadline:
                 raise TimeoutError(f"rendezvous key {scope}/{key} not set "
                                    f"within {timeout_s}s")
-            time.sleep(0.05)
+            # Never jitter past the caller's deadline (plus a floor so a
+            # nearly-expired wait still yields the CPU).
+            time.sleep(min(max(backoff.next_delay(), 0.005),
+                           max(deadline - now, 0.01)))
 
     def list(self, scope: str) -> list:
         return json.loads(self._request(f"/kv/{scope}?list=1",

@@ -27,6 +27,7 @@ TRAIN_SCRIPT = """
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 import sys
+import time
 
 import numpy as np
 
@@ -39,7 +40,21 @@ TOTAL = 12
 hvd.init(force_cpu_devices=1)
 rank = int(os.environ["HVD_TPU_PROC_ID"])
 host = os.environ.get("HVD_TPU_HOSTNAME", "?")
+# Virtual world (HVD_TPU_ELASTIC_FORCE_LOCAL): every worker is its own
+# 1-process jax world, so the driver exports the epoch's virtual
+# topology and lockstep must be simulated through the shared workdir.
+peers = os.environ.get("HVD_TPU_VIRTUAL_HOSTS", "").split(",")
 store = ObjectStore(os.path.join(workdir, "ckpt"))
+kill_marker = os.path.join(workdir, "killed")
+bprog = os.path.join(workdir, "hostB.step")
+
+
+def b_step():
+    try:
+        return int(open(bprog).read() or 0)
+    except (OSError, ValueError):
+        return 0
+
 
 state = JaxState(w=np.zeros(2, np.float32), step=0)
 saved = store.get("state")
@@ -54,12 +69,28 @@ log = open(os.path.join(workdir, "progress.log"), "a")
 @hvd.elastic.run
 def train(state):
     while state.step < TOTAL:
+        if host == "hostA" and "hostB" in peers:
+            # Pace with hostB (real worlds pace via the collective;
+            # independent virtual worlds must pace via the filesystem):
+            # never run ahead of it while it lives...
+            while not os.path.exists(kill_marker) \\
+                    and b_step() < state.step:
+                time.sleep(0.01)
+            if os.path.exists(kill_marker):
+                # ...and once it died mid-epoch, hold at a commit point
+                # until the driver tears this epoch down (bounded so a
+                # driver bug fails with evidence instead of hanging).
+                for _ in range(150):
+                    time.sleep(0.2)
+                    state.commit()
         out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
                             name="grad")
         w = np.asarray(out.addressable_data(0)).reshape(-1)
         state.w = state.w + w
         state.step += 1
-        kill_marker = os.path.join(workdir, "killed")
+        if host == "hostB":
+            with open(bprog, "w") as f:
+                f.write(str(state.step))
         if (state.step == 5 and host == "hostB"
                 and not os.path.exists(kill_marker)):
             open(kill_marker, "w").write("1")
@@ -159,6 +190,10 @@ TOTAL = 12
 hvd.init(force_cpu_devices=1)
 rank = int(os.environ["HVD_TPU_PROC_ID"])
 host = os.environ.get("HVD_TPU_HOSTNAME", "?")
+# Virtual world size: under HVD_TPU_ELASTIC_FORCE_LOCAL each worker is
+# its own single-process jax world, so the driver exports the epoch's
+# virtual topology separately.
+world = int(os.environ.get("HVD_TPU_VIRTUAL_NUM_PROC", "0")) or hvd.size()
 store = ObjectStore(os.path.join(workdir, "ckpt"))
 
 state = JaxState(w=np.zeros(2, np.float32), step=0)
@@ -184,7 +219,7 @@ def train(state):
             # failure happens — the driver must notice the ADDITION and
             # interrupt workers at a commit boundary.
             open(os.path.join(workdir, "grow"), "w").write("1")
-        if state.step >= 6 and hvd.size() == 1:
+        if state.step >= 6 and world == 1:
             # Hold here until the join lands (discovery polls every
             # ~1s; commit() checks the topology channel and raises
             # HostsUpdatedInterrupt). Bounded so a driver bug fails the
@@ -197,7 +232,7 @@ def train(state):
         if rank == 0:
             store.put("state", dict(state.committed_items()))
         print(f"PROGRESS {host} rank={rank} step={state.step} "
-              f"size={hvd.size()}", file=log, flush=True)
+              f"size={world}", file=log, flush=True)
 
 
 train(state)

@@ -1,0 +1,375 @@
+"""Chaos subsystem + hardened-recovery unit tests: fault-plan parsing and
+determinism, failure classification of runtime-shaped injected errors,
+Backoff policy (seeded jitter, ceilings, deadline), blacklist TTL
+expiry/re-probe, rendezvous retry-on-5xx, and preemption-aware commit."""
+
+import json
+import os
+import random
+import signal
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import elastic as elastic_lib
+from horovod_tpu.common import faults
+from horovod_tpu.common.elastic import _is_comm_failure
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.runner.elastic_driver import (FixedHostDiscovery,
+                                               HostManager,
+                                               ScriptHostDiscovery)
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state():
+    yield
+    faults.uninstall()
+    elastic_lib._reset_preemption_for_tests()
+
+
+# -- plan parsing ------------------------------------------------------------
+
+def test_plan_parsing_forms():
+    p = faults.FaultPlan.from_json(
+        '{"seed": 3, "faults": [{"site": "collective", "step": 1}]}')
+    assert p.seed == 3 and p.faults[0].site == "collective"
+    bare = faults.FaultPlan.from_json('[{"site": "rendezvous", "step": 2}]')
+    assert bare.seed == 0 and bare.faults[0].step == 2
+
+
+def test_plan_rejects_typos_loudly():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultPlan.from_json('[{"site": "colective", "step": 1}]')
+    with pytest.raises(ValueError, match="unknown keys"):
+        faults.FaultPlan.from_json(
+            '[{"site": "crash", "step": 1, "stpe": 2}]')
+    with pytest.raises(ValueError, match="step"):
+        faults.FaultPlan.from_json('[{"site": "crash"}]')
+
+
+# -- injector determinism ----------------------------------------------------
+
+def test_step_mode_fires_exactly_once():
+    inj = faults.FaultInjector(faults.FaultPlan.from_json(
+        '[{"site": "collective", "step": 3}]'))
+    fired = [inj.check("collective") is not None for _ in range(6)]
+    assert fired == [False, False, True, False, False, False]
+
+
+def test_probability_mode_deterministic_under_seed():
+    plan = ('{"seed": 123, "faults": [{"site": "collective", '
+            '"probability": 0.3, "times": 0}]}')
+
+    def seq(p):
+        inj = faults.FaultInjector(faults.FaultPlan.from_json(p))
+        return [inj.check("collective") is not None for _ in range(200)]
+
+    a, b = seq(plan), seq(plan)
+    assert a == b
+    assert any(a) and not all(a)
+    assert seq(plan.replace("123", "124")) != a
+
+
+def test_rank_and_host_restrictions(monkeypatch):
+    plan = '[{"site": "crash", "step": 1, "rank": 1, "host": "hostB"}]'
+    monkeypatch.setenv("HVD_TPU_PROC_ID", "0")
+    monkeypatch.setenv("HVD_TPU_HOSTNAME", "hostB")
+    assert faults.FaultInjector(
+        faults.FaultPlan.from_json(plan)).check("crash") is None
+    monkeypatch.setenv("HVD_TPU_PROC_ID", "1")
+    assert faults.FaultInjector(
+        faults.FaultPlan.from_json(plan)).check("crash") is not None
+
+
+def test_refresh_from_env_install_and_remove(monkeypatch):
+    monkeypatch.setenv(faults.ENV_PLAN,
+                       '[{"site": "collective", "step": 1}]')
+    assert faults.refresh_from_env() is not None and faults.active()
+    monkeypatch.delenv(faults.ENV_PLAN)
+    assert faults.refresh_from_env() is None and not faults.active()
+
+
+def test_no_plan_sites_are_noops():
+    faults.uninstall()
+    faults.maybe_collective_fault()
+    faults.maybe_collective_stall()
+    faults.maybe_rendezvous_fault()
+    faults.maybe_worker_fault()
+    assert faults.maybe_discovery_flap({"a": 1}) == {"a": 1}
+
+
+def test_injection_log_written(tmp_path):
+    log = str(tmp_path / "faults.jsonl")
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "collective", "step": 1}]'), log_path=log)
+    with pytest.raises(faults.XlaRuntimeError):
+        faults.maybe_collective_fault()
+    recs = [json.loads(l) for l in open(log) if l.strip()]
+    assert recs and recs[0]["site"] == "collective" and recs[0]["hit"] == 1
+
+
+# -- failure classification --------------------------------------------------
+
+def test_injected_collective_fault_is_classified_comm_failure():
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "collective", "step": 1}]'))
+    with pytest.raises(faults.XlaRuntimeError) as ei:
+        faults.maybe_collective_fault()
+    assert _is_comm_failure(ei.value)
+
+
+def test_is_comm_failure_runtime_shaped_matrix():
+    # Runtime-shaped name + comm marker -> classified.
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    assert _is_comm_failure(XlaRuntimeError("connection to peer lost"))
+    assert _is_comm_failure(XlaRuntimeError("DEADLINE_EXCEEDED: barrier"))
+    # Runtime-shaped name, NO comm marker -> a compile bug must surface.
+    assert not _is_comm_failure(XlaRuntimeError("mosaic lowering failed"))
+    # Comm-sounding USER exceptions must surface, not be retried.
+    assert not _is_comm_failure(ValueError("I/O on closed file"))
+    assert not _is_comm_failure(ConnectionResetError("connection reset"))
+    assert _is_comm_failure(HorovodInternalError("peer down"))
+
+
+# -- Backoff -----------------------------------------------------------------
+
+def test_backoff_deterministic_and_bounded():
+    def delays():
+        bo = faults.Backoff(base_s=0.1, factor=2.0, cap_s=5.0,
+                            rng=random.Random(7))
+        return [bo.next_delay() for _ in range(12)]
+
+    a, b = delays(), delays()
+    assert a == b
+    for n, d in enumerate(a):
+        assert 0.0 <= d <= min(5.0, 0.1 * 2.0 ** n)
+
+
+def test_backoff_deadline_stops_retries():
+    t = {"now": 0.0}
+    bo = faults.Backoff(base_s=1.0, factor=2.0, cap_s=10.0, deadline_s=3.0,
+                        rng=random.Random(1), clock=lambda: t["now"],
+                        sleep_fn=lambda s: t.__setitem__("now",
+                                                         t["now"] + s))
+    rounds = 0
+    while bo.sleep():
+        rounds += 1
+        assert rounds < 100, "deadline never enforced"
+    assert t["now"] <= 3.0 + 1e-9
+
+
+def test_backoff_from_env_knobs(monkeypatch):
+    monkeypatch.setenv("TBO_BASE_S", "0.5")
+    monkeypatch.setenv("TBO_MAX_S", "9")
+    monkeypatch.setenv("TBO_DEADLINE_S", "0")  # non-positive -> disabled
+    bo = faults.Backoff.from_env("TBO", base_s=0.1, cap_s=1.0,
+                                 deadline_s=5.0)
+    assert bo.base_s == 0.5 and bo.cap_s == 9.0 and bo.deadline_s is None
+
+
+# -- blacklist TTL / recovery probe ------------------------------------------
+
+def test_blacklist_ttl_expiry_and_reprobe():
+    t = {"now": 100.0}
+    hm = HostManager(FixedHostDiscovery({"a": 1, "b": 1}),
+                     blacklist_ttl_s=50.0, clock=lambda: t["now"])
+    assert hm.update_available_hosts()
+    before = faults.recovery_stats()["blacklist_recoveries"]
+    hm.blacklist("b")
+    assert hm.update_available_hosts()  # usable set shrank
+    assert hm.current_hosts() == {"a": 1}
+    t["now"] += 49.0
+    assert hm.is_blacklisted("b")
+    t["now"] += 2.0  # TTL expired -> recovery probe
+    assert hm.update_available_hosts()  # usable set grew back
+    assert hm.current_hosts() == {"a": 1, "b": 1}
+    assert faults.recovery_stats()["blacklist_recoveries"] == before + 1
+    # Re-failure doubles the exile (strike 2 -> 2*TTL).
+    hm.blacklist("b")
+    t["now"] += 51.0
+    assert hm.is_blacklisted("b"), "second strike must exile longer"
+    t["now"] += 50.0
+    assert not hm.is_blacklisted("b")
+
+
+def test_blacklist_permanent_when_ttl_nonpositive():
+    t = {"now": 0.0}
+    hm = HostManager(FixedHostDiscovery({"a": 1}), blacklist_ttl_s=0.0,
+                     clock=lambda: t["now"])
+    hm.update_available_hosts()
+    hm.blacklist("a")
+    t["now"] += 1e9
+    assert hm.is_blacklisted("a")
+    assert hm.current_hosts() == {}
+
+
+def test_discovery_flap_injection_changes_usable_set():
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "discovery", "step": 2}]'))
+    hm = HostManager(FixedHostDiscovery({"a": 1}), blacklist_ttl_s=300.0)
+    assert hm.update_available_hosts()       # hit 1: intact
+    assert hm.update_available_hosts()       # hit 2: flap -> {}
+    assert hm.current_hosts() == {}
+    assert hm.update_available_hosts()       # hit 3: back
+    assert hm.current_hosts() == {"a": 1}
+
+
+def test_script_discovery_backs_off_after_failure(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DISCOVERY_BACKOFF_BASE_S", "60")
+    monkeypatch.setenv("HVD_TPU_DISCOVERY_BACKOFF_MAX_S", "60")
+    marker = tmp_path / "fail"
+    runs = tmp_path / "runs"
+    script = tmp_path / "disco.sh"
+    script.write_text(
+        "#!/bin/bash\n"
+        f"echo x >> {runs}\n"
+        f"if [ -f {marker} ]; then exit 1; fi\n"
+        "echo hostA:1\n")
+    script.chmod(0o755)
+    d = ScriptHostDiscovery(str(script))
+    assert d.find_available_hosts_and_slots() == {"hostA": 1}
+    marker.write_text("1")
+    before = faults.recovery_stats()["discovery_retries"]
+    # Failure: falls back to last good answer, schedules a backoff.
+    assert d.find_available_hosts_and_slots() == {"hostA": 1}
+    assert faults.recovery_stats()["discovery_retries"] == before + 1
+    # Inside the backoff window the script is NOT re-run.
+    assert d.find_available_hosts_and_slots() == {"hostA": 1}
+    assert len(runs.read_text().splitlines()) == 2
+
+
+# -- rendezvous client retry/backoff -----------------------------------------
+
+@pytest.fixture()
+def rdv_server(monkeypatch):
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    monkeypatch.delenv("HVD_TPU_RENDEZVOUS_SECRET", raising=False)
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_BACKOFF_MAX_S", "0.02")
+    srv = RendezvousServer("127.0.0.1")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_rendezvous_client_retries_injected_5xx(rdv_server):
+    from horovod_tpu.runner.rendezvous import RendezvousClient
+
+    rdv_server.put("s", "k", b"v")
+    c = RendezvousClient("127.0.0.1", rdv_server.port, timeout_s=5.0)
+    before = faults.recovery_stats()["rendezvous_retries"]
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "rendezvous", "step": 1, "mode": "5xx"}]'))
+    assert c.get("s", "k") == b"v"  # 503 on attempt 1 absorbed
+    assert faults.recovery_stats()["rendezvous_retries"] == before + 1
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "rendezvous", "step": 1, "mode": "drop"}]'))
+    assert c.get("s", "k") == b"v"  # connection error absorbed too
+
+
+def test_rendezvous_client_exhausts_retries(rdv_server):
+    import urllib.error
+
+    from horovod_tpu.runner.rendezvous import RendezvousClient
+
+    c = RendezvousClient("127.0.0.1", rdv_server.port, timeout_s=5.0,
+                         retries=2)
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "rendezvous", "probability": 1.0, "times": 0, '
+        '"mode": "5xx"}]'))
+    with pytest.raises(urllib.error.HTTPError):
+        c.get("s", "missing")
+
+
+def test_rendezvous_404_is_not_retried(rdv_server):
+    from horovod_tpu.runner.rendezvous import RendezvousClient
+
+    c = RendezvousClient("127.0.0.1", rdv_server.port, timeout_s=5.0)
+    before = faults.recovery_stats()["rendezvous_retries"]
+    assert c.get("s", "absent") is None
+    assert faults.recovery_stats()["rendezvous_retries"] == before
+
+
+def test_rendezvous_wait_backoff_respects_deadline(rdv_server):
+    import time
+
+    from horovod_tpu.runner.rendezvous import RendezvousClient
+
+    c = RendezvousClient("127.0.0.1", rdv_server.port, timeout_s=5.0)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        c.wait("s", "never", timeout_s=0.3)
+    assert time.monotonic() - t0 < 3.0
+
+
+# -- preemption-aware commit -------------------------------------------------
+
+def test_preemption_latch_saves_and_exits_cleanly():
+    assert elastic_lib.install_preemption_handler()
+    state = elastic_lib.ObjectState(step=4)
+    persisted = []
+    elastic_lib.on_preemption(
+        lambda st: persisted.append(dict(st.committed_items())))
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert elastic_lib.preemption_requested()
+    state.step = 5
+    with pytest.raises(SystemExit) as ei:
+        state.commit()
+    assert ei.value.code == elastic_lib.HOSTS_UPDATED_EXIT_CODE
+    # commit() saved BEFORE exiting: the callback saw step 5 committed.
+    assert persisted == [{"step": 5}]
+
+
+def test_preempt_injection_site_delivers_sigterm():
+    assert elastic_lib.install_preemption_handler()
+    faults.install(faults.FaultPlan.from_json(
+        '[{"site": "preempt", "step": 2}]'))
+    state = elastic_lib.ObjectState(x=0)
+    state.commit()  # hit 1: nothing
+    assert not elastic_lib.preemption_requested()
+    with pytest.raises(SystemExit) as ei:
+        state.commit()  # hit 2: SIGTERM -> latched -> clean exit
+    assert ei.value.code == elastic_lib.HOSTS_UPDATED_EXIT_CODE
+    assert elastic_lib.preemption_requested()
+
+
+def test_preemption_callback_failure_does_not_block_exit():
+    assert elastic_lib.install_preemption_handler()
+    elastic_lib.on_preemption(
+        lambda st: (_ for _ in ()).throw(RuntimeError("disk full")))
+    os.kill(os.getpid(), signal.SIGTERM)
+    state = elastic_lib.ObjectState(step=1)
+    with pytest.raises(SystemExit) as ei:
+        state.commit()
+    assert ei.value.code == elastic_lib.HOSTS_UPDATED_EXIT_CODE
+
+
+# -- in-process chaos: elastic run under an injected collective failure ------
+
+def test_elastic_run_survives_injected_collective_failure(hvd, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_ELASTIC_RESET_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("HVD_TPU_ELASTIC_RESET_BACKOFF_MAX_S", "0.02")
+    monkeypatch.delenv("HVD_TPU_RENDEZVOUS", raising=False)
+    faults.install(faults.FaultPlan.from_json(
+        '{"seed": 1, "faults": [{"site": "collective", "step": 3}]}'))
+    before = faults.recovery_stats()["restores"]
+    state = elastic_lib.JaxState(w=np.zeros(2, np.float32), step=0)
+
+    @elastic_lib.run
+    def train(st):
+        while int(st.step) < 5:
+            out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                                name="chaos_ar")
+            st.w = st.w + np.asarray(out.addressable_data(0)).reshape(-1)
+            st.step = int(st.step) + 1
+            st.commit()
+        return int(st.step)
+
+    assert train(state) == 5
+    assert faults.recovery_stats()["restores"] == before + 1
+    # Rolled back to the last commit and re-trained: totals consistent.
+    np.testing.assert_allclose(np.asarray(state.w),
+                               np.full(2, 5.0 * hvd.size()))

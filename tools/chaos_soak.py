@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Seeded chaos soak for the elastic recovery stack.
+
+Drives a REAL elastic job (``hvdtpurun --elastic`` codepath, virtual
+local hosts) under a deterministic ``HVD_TPU_FAULT_PLAN`` that injects
+the three canonical failure families:
+
+* a runtime-shaped **collective comm failure** on hostB (classified by
+  ``_is_comm_failure``, worker exits ``PEER_FAILURE_EXIT_CODE``);
+* a **rendezvous 5xx** on hostA (absorbed transparently by the client's
+  retry/backoff — the training loop never notices);
+* a **preemption SIGTERM** on rank 0 (latched by the handler, honored at
+  the next ``state.commit()``: final persistence callback + clean
+  ``HOSTS_UPDATED_EXIT_CODE`` exit).
+
+The run must complete all steps with the persisted state EQUAL to the
+last commit: ``w == sum(sizes)`` elementwise, where ``sizes`` is the
+committed per-step contribution log — any torn/uncommitted progress that
+leaked to disk breaks the invariant. Every injection is appended to a
+JSON-lines fault log; ``--repeat N`` reruns the identical seed and
+asserts the per-worker injection sequences match exactly (the
+determinism contract: same seed ⇒ same chaos).
+
+Usage:
+  python tools/chaos_soak.py [--steps 12] [--seed 42] [--repeat 1]
+                             [--workdir DIR (kept)]
+
+Exit 0 and one JSON record line on success (the repo's tool contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TRAIN_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+from horovod_tpu.checkpoint import ObjectStore
+from horovod_tpu.common.elastic import JaxState
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+hvd.init(force_cpu_devices=1)
+rank = int(os.environ["HVD_TPU_PROC_ID"])
+store = ObjectStore(os.path.join(workdir, "ckpt"))
+
+# sizes logs each step's summed contribution INSIDE the committed state:
+# the consistency oracle is w == sum(sizes) — only commit-atomic
+# persistence keeps it true across crashes/preemptions.
+state = JaxState(w=np.zeros(2, np.float32), step=0, sizes=[])
+saved = store.get("state")
+if saved is not None:
+    for k, v in saved.items():
+        setattr(state, k, v)
+    state.save()
+
+
+def persist(st):
+    if rank == 0:
+        store.put("state", dict(st.committed_items()))
+
+
+# Preemption-aware checkpointing: on SIGTERM the next commit() runs this
+# (after its save()) and exits HOSTS_UPDATED_EXIT_CODE for reschedule.
+elastic.on_preemption(persist)
+
+
+@elastic.run
+def train(state):
+    while int(state.step) < TOTAL:
+        out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                            name="grad")
+        w = np.asarray(out.addressable_data(0)).reshape(-1)
+        state.w = state.w + w
+        state.sizes = list(state.sizes) + [float(w[0])]
+        state.step = int(state.step) + 1
+        state.commit()
+        persist(state)
+
+
+train(state)
+"""
+
+
+def default_plan(seed: int) -> dict:
+    return {"seed": seed, "faults": [
+        # Transparent: the client's retry/backoff absorbs the 503.
+        {"site": "rendezvous", "step": 2, "mode": "5xx", "host": "hostA"},
+        # Mid-step comm failure: restore-to-commit + driver restart.
+        {"site": "collective", "step": 4, "host": "hostB"},
+        # Preemption: SIGTERM latched, commit saves + exits cleanly.
+        {"site": "preempt", "step": 7, "rank": 0},
+    ]}
+
+
+def _load_fault_log(path: str):
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    recs.append(json.loads(line))
+    except OSError:
+        pass
+    return recs
+
+
+def injection_sequences(fault_log):
+    """Per-worker ordered injection signature: {(rank, host): [(site,
+    hit, spec), ...]} — cross-worker interleaving in the shared log file
+    is timing noise; per-worker order is the determinism contract."""
+    seqs = {}
+    for r in fault_log:
+        seqs.setdefault((r.get("rank"), r.get("host")), []).append(
+            (r["site"], r["hit"], r["spec"]))
+    return seqs
+
+
+def run_soak(workdir: str, steps: int = 12, seed: int = 42,
+             plan: dict | None = None) -> dict:
+    """One seeded chaos run; returns the validated record. Raises
+    AssertionError with evidence on any acceptance failure."""
+    import numpy as np
+
+    from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.runner import launch as launch_lib
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train.py")
+    with open(train_py, "w") as f:
+        f.write(TRAIN_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    plan = plan if plan is not None else default_plan(seed)
+
+    overrides = {
+        "HVD_TPU_ELASTIC_FORCE_LOCAL": "1",
+        "HVD_TPU_ELASTIC_RESET_LIMIT": "20",
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_FAULT_LOG": fault_log,
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    saved_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        rc = launch_lib.run_commandline(
+            ["-np", "2", "--elastic", "--min-np", "1", "--max-np", "2",
+             "-H", "hostA:1,hostB:1", "--",
+             sys.executable, train_py, workdir, str(steps)])
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults_lib.uninstall()  # the driver-side injector dies with the run
+
+    assert rc == 0, f"chaos soak: elastic run failed rc={rc}"
+
+    with open(os.path.join(workdir, "ckpt", "state.pkl"), "rb") as f:
+        final = pickle.load(f)
+    step = int(np.asarray(final["step"]))
+    w = np.asarray(final["w"], dtype=np.float64)
+    sizes = [float(np.asarray(s)) for s in final["sizes"]]
+    assert step == steps, f"finished at step {step}, wanted {steps}"
+    # State == last commit: every persisted byte came from a committed
+    # snapshot, so the contribution ledger must reproduce w exactly.
+    assert np.allclose(w, np.full_like(w, sum(sizes))), \
+        f"committed-state inconsistency: w={w.tolist()} vs " \
+        f"sum(sizes)={sum(sizes)} over {len(sizes)} committed steps"
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    want = {s["site"] for s in plan["faults"]}
+    assert len(log) >= 3 and want <= sites, \
+        f"expected >=3 injections covering {sorted(want)}, got " \
+        f"{len(log)}: {sorted(sites)}"
+    return {
+        "metric": "chaos_soak",
+        "seed": seed,
+        "steps": steps,
+        "rc": rc,
+        "final_step": step,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "sequences": {f"{k[0]}@{k[1]}": v
+                      for k, v in injection_sequences(log).items()},
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--repeat", type=int, default=1,
+                    help=">1: rerun the same seed and assert identical "
+                         "per-worker injection sequences")
+    ap.add_argument("--workdir", default=None,
+                    help="kept for inspection; default: fresh temp dirs")
+    args = ap.parse_args()
+
+    records = []
+    for i in range(max(1, args.repeat)):
+        if args.workdir:
+            wd = os.path.join(args.workdir, f"run{i}")
+        else:
+            wd = tempfile.mkdtemp(prefix=f"chaos_soak_{i}_")
+        rec = run_soak(wd, steps=args.steps, seed=args.seed)
+        print(f"chaos_soak: run {i} ok — {rec['injections']} injections "
+              f"over {rec['injected_sites']}", file=sys.stderr)
+        records.append(rec)
+    if len(records) > 1:
+        first = records[0]["sequences"]
+        for i, rec in enumerate(records[1:], start=1):
+            assert rec["sequences"] == first, \
+                f"seed {args.seed} not reproducible: run 0 " \
+                f"{first} vs run {i} {rec['sequences']}"
+        print(f"chaos_soak: {len(records)} runs reproduced identical "
+              "injection sequences", file=sys.stderr)
+    out = dict(records[0])
+    out["repeats"] = len(records)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
