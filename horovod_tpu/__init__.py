@@ -15,7 +15,9 @@ function.
 Quick start (single-controller SPMD, the idiomatic TPU path)::
 
     import horovod_tpu as hvd
-    hvd.init()
+    hvd.init()                     # or init(compression="int8_ef") to put
+                                   # int8 gradients on every reduce hop
+                                   # (HVD_TPU_COMPRESSION; docs/compression.md)
     tx = hvd.DistributedOptimizer(optax.adam(1e-3), axis_name=hvd.rank_axis())
 
     @hvd.spmd_step                       # shard_map over the rank mesh
@@ -173,7 +175,13 @@ def allreduce(x, op: ReduceOp = ReduceOp.AVERAGE, name: Optional[str] = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
               compression=None, process_set=None):
     """``compression=None`` uses the configured default
-    (HOROVOD_COMPRESSION_DTYPE env / compression_dtype knob)."""
+    (``HVD_TPU_COMPRESSION`` / ``init(compression=)``, falling back to
+    the legacy ``HVD_TPU_COMPRESSION_DTYPE`` wire knob).
+    ``Compression.int8_ef`` runs the reduction as a reduce-safe
+    quantized allreduce — int8 payload on every hop, error bounded per
+    block (docs/compression.md); stateless here, so rounding is
+    round-to-nearest (the error-feedback residual lives on the
+    DistributedOptimizer surfaces)."""
     return _engine(process_set).allreduce(x, op, name, prescale_factor,
                                           postscale_factor, compression)
 

@@ -98,28 +98,42 @@ class Autotuner:
                  steps_per_sample: int = 10,
                  log_file: Optional[str] = None,
                  tune_hierarchical: bool = False,
-                 tune_overlap: bool = False):
+                 tune_overlap: bool = False,
+                 tune_compression: bool = False,
+                 compression_candidates: Sequence[str] = (
+                     "none", "bf16", "int8_ef")):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
         self.steps_per_sample = steps_per_sample
         self.log_file = log_file
-        # Joint (threshold, hierarchical, overlap) space when asked — the
-        # reference's ParameterManager tunes the hierarchical toggle
-        # alongside the fusion threshold (parameter_manager.cc); the
-        # overlap toggle (readiness-ordered buckets + issue chaining,
-        # common/overlap.py) is this rebuild's addition. Points are
-        # always internal 3-tuples; untuned axes stay pinned at 0.
+        # Joint (threshold, hierarchical, overlap, compression) space
+        # when asked — the reference's ParameterManager tunes the
+        # hierarchical toggle alongside the fusion threshold
+        # (parameter_manager.cc); the overlap toggle (readiness-ordered
+        # buckets + issue chaining, common/overlap.py) and the
+        # compression axis (reduction wire format: none / bf16 cast /
+        # int8_ef quantized allreduce — whether 4x fewer wire bytes beat
+        # the quantize/dequant overhead is topology- and model-
+        # dependent, so measured, not guessed) are this rebuild's
+        # additions. Points are always internal 4-tuples (threshold,
+        # hierarchical, overlap, compression_index); untuned axes stay
+        # pinned at 0.
         self.tune_hierarchical = tune_hierarchical
         self.tune_overlap = tune_overlap
+        self.tune_compression = tune_compression
+        self.compression_candidates = (tuple(compression_candidates)
+                                       if tune_compression else ("none",))
         hs = (0, 1) if tune_hierarchical else (0,)
         ovs = (0, 1) if tune_overlap else (0,)
-        self._space: List[Tuple[int, int, int]] = [
-            (t, h, o) for t in self.candidates for h in hs for o in ovs]
+        cs = tuple(range(len(self.compression_candidates)))
+        self._space: List[Tuple[int, int, int, int]] = [
+            (t, h, o, c) for t in self.candidates for h in hs
+            for o in ovs for c in cs]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
         self._secs = 0.0
-        self._samples: Dict[Tuple[int, int, int], List[float]] = {}
+        self._samples: Dict[Tuple[int, int, int, int], List[float]] = {}
         self._cur = self._space[len(self._space) // 2]
         self._done = False
         # Samples arrive from finalizer-pool threads (eager engine) and
@@ -133,6 +147,8 @@ class Autotuner:
             cols.append("hierarchical")
         if tune_overlap:
             cols.append("overlap")
+        if tune_compression:
+            cols.append("compression")
         self._columns = tuple(cols)
         if log_file:
             # Decision trace (reference HOROVOD_AUTOTUNE_LOG,
@@ -173,6 +189,19 @@ class Autotuner:
             return self._cur[0], bool(self._cur[1]), bool(self._cur[2])
 
     @property
+    def current_compression(self) -> str:
+        with self._tlock:
+            return self.compression_candidates[self._cur[3]]
+
+    @property
+    def current_quad(self) -> Tuple[int, bool, bool, str]:
+        """Atomic (threshold, hierarchical, overlap, compression)
+        snapshot."""
+        with self._tlock:
+            return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
+                    self.compression_candidates[self._cur[3]])
+
+    @property
     def done(self) -> bool:
         with self._tlock:
             return self._done
@@ -208,21 +237,30 @@ class Autotuner:
                     seconds: float) -> Tuple[int, bool, bool]:
         """Like feed() but returns the full (threshold, hierarchical,
         overlap) point under ONE lock acquisition."""
+        return self.feed_quad(nbytes, seconds)[:3]
+
+    def feed_quad(self, nbytes: float,
+                  seconds: float) -> Tuple[int, bool, bool, str]:
+        """Like feed() but returns the full (threshold, hierarchical,
+        overlap, compression) point under ONE lock acquisition."""
         with self._tlock:
             self.record(nbytes, seconds)
             if self.ready():
                 self.suggest()
-            return self._cur[0], bool(self._cur[1]), bool(self._cur[2])
+            return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
+                    self.compression_candidates[self._cur[3]])
 
-    def _row(self, point: Tuple[int, int, int]) -> List[int]:
+    def _row(self, point: Tuple[int, int, int, int]) -> List:
         """CSV row values matching _columns: the threshold always, each
         toggle only when tuned (an untuned axis would log a constant 0
         column that the header doesn't declare)."""
-        row = [point[0]]
+        row: List = [point[0]]
         if self.tune_hierarchical:
             row.append(point[1])
         if self.tune_overlap:
             row.append(point[2])
+        if self.tune_compression:
+            row.append(self.compression_candidates[point[3]])
         return row
 
     def _log(self, point: Tuple[int, int, int], score: float) -> None:
@@ -241,10 +279,12 @@ class Autotuner:
             return self._suggest_locked()
 
     @staticmethod
-    def _features(point: Tuple[int, int, int]) -> List[float]:
-        # log2(threshold) spans ~20-28; scale the binary toggles so the
-        # RBF kernel treats "other branch" as a real distance.
-        return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2]]
+    def _features(point: Tuple[int, int, int, int]) -> List[float]:
+        # log2(threshold) spans ~20-28; scale the binary toggles (and the
+        # categorical compression index) so the RBF kernel treats "other
+        # branch" as a real distance.
+        return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2],
+                2.0 * point[3]]
 
     def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
@@ -292,7 +332,10 @@ class Autotuner:
                     + (", hierarchical=%s" % bool(best[1])
                        if self.tune_hierarchical else "")
                     + (", overlap=%s" % bool(best[2])
-                       if self.tune_overlap else ""),
+                       if self.tune_overlap else "")
+                    + (", compression=%s"
+                       % self.compression_candidates[best[3]]
+                       if self.tune_compression else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

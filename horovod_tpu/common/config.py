@@ -98,6 +98,18 @@ class Config:
     adasum_scalar_dtype: str = "float32"
     # Compression for the wire format of eager collectives.
     compression_dtype: Optional[str] = None  # e.g. "bfloat16"/"float16"
+    # Default REDUCTION compression (HVD_TPU_COMPRESSION): the compressor
+    # DistributedOptimizer/DistributedGradFn and the eager engine use when
+    # none is passed explicitly. Must be reduce-safe: "bf16"/"fp16"
+    # (cast) or "int8_ef" (reduce-safe quantized allreduce with error
+    # feedback — ops/compression.Int8EFCompressor). Wins over
+    # compression_dtype for the engine default when both are set.
+    compression: Optional[str] = None
+    # Smallest fused-bucket byte size the quantized (int8) reduce path
+    # quantizes; smaller float buckets ride bf16 (common/fusion.py
+    # assign_wire_dtypes — the per-bucket overhead of quantize/dequant +
+    # scales only amortizes on large buckets).
+    quantize_min_bucket_bytes: int = 64 * 1024
     # Elastic mode (reference: HOROVOD_ELASTIC).
     elastic: bool = False
     # Join mode: multi-process programs that call hvd.join() must enable
@@ -147,6 +159,9 @@ class Config:
         c.adasum_scalar_dtype = _env(
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")
+        c.compression = _env("COMPRESSION")
+        c.quantize_min_bucket_bytes = _env_int(
+            "QUANTIZE_MIN_BYTES", cls.quantize_min_bucket_bytes)
         c.elastic = _env_bool("ELASTIC", False)
         c.join_mode = _env_bool("JOIN_MODE", False)
         c.thread_affinity = _env("THREAD_AFFINITY")

@@ -63,6 +63,12 @@ class FusionPlan:
     # "reverse" bucket 0 covers the LAST leaves — the first gradients
     # backprop completes.
     order: str = ORDER_FLATTEN
+    # Per-bucket wire format for quantized reduction, parallel to
+    # ``buckets`` ("int8"/"bf16"/"none"); None until
+    # :func:`assign_wire_dtypes` stamps the plan. Part of the plan (not
+    # recomputed at the call site) so every rank's compiled program
+    # carries the identical bucket->wire mapping.
+    wire_dtypes: Optional[Tuple[str, ...]] = None
 
 
 def _resolve_order(num_leaves: int,
@@ -197,6 +203,42 @@ def plan_fusion(tree, threshold_bytes: int,
         ORDER_FLATTEN, ORDER_REVERSE) else "explicit"
     return FusionPlan(tuple(buckets), treedef, len(leaves),
                       order=order_tag)
+
+
+# Wire formats a bucket can ride in a quantized reduction.
+WIRE_NONE = "none"    # native dtype (ints, half-precision small buckets)
+WIRE_BF16 = "bf16"    # cast to bf16 around the collective (2x over fp32)
+WIRE_INT8 = "int8"    # block-scaled int8 quantized allreduce (4x)
+
+
+def assign_wire_dtypes(plan: FusionPlan, quantize_min_bytes: int,
+                       small_wire: str = WIRE_BF16) -> FusionPlan:
+    """Stamp per-bucket compression decisions onto a plan.
+
+    Quantization has fixed per-bucket costs (quantize/dequant kernels,
+    one fp32 scale per 4096-element block, chunk padding to n*4096) that
+    only amortize on large buckets, and the bandwidth win only matters
+    where the bytes are. So: float buckets of at least
+    ``quantize_min_bytes`` ride int8 (the quantized allreduce); smaller
+    fp32/fp64 buckets ride ``small_wire`` (bf16 cast — free, still 2x);
+    half-precision buckets below the threshold and integer buckets ride
+    uncompressed. Deterministic in (plan, threshold) — every rank stamps
+    the identical mapping without negotiation, the same property the
+    bucket plan itself has.
+    """
+    wires = []
+    for b in plan.buckets:
+        dt = np.dtype(b.dtype)
+        if not jnp.issubdtype(dt, jnp.floating):
+            wires.append(WIRE_NONE)
+            continue
+        if b.total_elems * dt.itemsize >= quantize_min_bytes:
+            wires.append(WIRE_INT8)
+        elif dt.itemsize > 2 and small_wire:
+            wires.append(small_wire)
+        else:
+            wires.append(WIRE_NONE)
+    return dataclasses.replace(plan, wire_dtypes=tuple(wires))
 
 
 def fuse(tree, plan: FusionPlan) -> List[jnp.ndarray]:

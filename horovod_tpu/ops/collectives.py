@@ -463,6 +463,193 @@ def quantized_hierarchical_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
     return y
 
 
+# ---------------------------------------------------------------------------
+# Reduce-safe quantized allreduce — int8 gradients on the hot path.
+#
+# A quantized payload cannot ride lax.psum directly (per-block absmax
+# scales don't commute with summation), so the allreduce is decomposed
+# the EQuARX way (PAPERS.md, arXiv:2506.17615): reduce-scatter the
+# quantized chunks (realized as an int8 all_to_all — the scales must
+# travel WITH their blocks, which a psum_scatter cannot express), each
+# rank dequant-accumulates its owned chunk in fp32, requantizes the
+# reduced chunk, and all_gathers the int8 result. Every gradient byte on
+# the wire is int8 + one fp32 scale per 4096-element block: ~4x fewer
+# bytes than fp32 at any world size, paid for with two bounded
+# roundings. With a `key`, both roundings are stochastic (unbiased —
+# ops/pallas_kernels.quantize_int8_stochastic), and `return_residual`
+# hands back the LOCAL quantization error for the optimizer's
+# error-feedback state (optim.py `compression="int8_ef"`).
+# ---------------------------------------------------------------------------
+
+# One absmax scale per 32x128 int8 block (pallas_kernels._Q_ROWS*_LANES);
+# chunks are aligned to whole blocks so per-chunk q/scale arrays split
+# cleanly along the rank axis.
+_Q_BLOCK = 32 * 128
+
+
+def _int8_chunks(flat_pad, n, key, use_pallas):
+    """Quantize a (n*chunk,) fp32 buffer, chunk%4096==0, into per-rank
+    stacks: q (n, rows, 128) int8 + scales (n, nblocks) fp32."""
+    from .pallas_kernels import quantize_int8, quantize_int8_stochastic
+
+    if key is None:
+        q, s, _ = quantize_int8(flat_pad, use_pallas=use_pallas)
+    else:
+        q, s, _ = quantize_int8_stochastic(flat_pad, key,
+                                           use_pallas=use_pallas)
+    chunk = flat_pad.shape[0] // n
+    return (q.reshape(n, chunk // 128, 128),
+            s.reshape(n, chunk // _Q_BLOCK))
+
+
+def _deq(q, s):
+    """Dequantize a stacked (…, rows, 128) int8 + (…, nblocks) scale pair
+    to fp32 of shape (…, nblocks*4096) — the vectorized inverse of
+    :func:`_int8_chunks` (XLA fuses this into the surrounding consumer;
+    the standalone Pallas dequant kernel serves the host-staged paths)."""
+    nb = s.shape[-1]
+    lead = q.shape[:-2]
+    blocks = q.reshape(lead + (nb, _Q_BLOCK)).astype(jnp.float32)
+    return (blocks * s[..., None]).reshape(lead + (nb * _Q_BLOCK,))
+
+
+def quantized_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
+                            axis_name: str = "hvd", key=None,
+                            use_pallas=None, return_residual: bool = False):
+    """Reduce-scatter of a flat buffer with int8 payload on the wire.
+
+    ``x`` is 1-D with ``x.shape[0] % (n * 4096) == 0`` (pad with zeros —
+    they quantize to exact 0). Returns this rank's reduced chunk of
+    ``x.shape[0] // n`` elements in ``x.dtype``; with
+    ``return_residual=True`` additionally returns the full-length fp32
+    LOCAL quantization error ``x - dequant(quant(x))`` — the
+    error-feedback residual (added to the next step's input, it cancels
+    this step's rounding loss; "Scaling Distributed Training with
+    Adaptive Summation" / 1-bit-Adam lineage, PAPERS.md).
+
+    This is the single-quantization half of :func:`quantized_allreduce`
+    and the gradient hop of the ZeRO-1 ``sharded_update`` path
+    (optim.py): (n-1)/n · B/4 bytes per device versus the fp32
+    psum_scatter's (n-1)/n · B.
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("quantized reducescatter supports SUM/AVERAGE")
+    n = lax.axis_size(axis_name)
+    if x.ndim != 1 or x.shape[0] % (n * _Q_BLOCK):
+        raise ValueError(
+            f"quantized_reducescatter needs a 1-D buffer with length "
+            f"divisible by n*4096 = {n * _Q_BLOCK}; got {x.shape} "
+            "(zero-pad — pads quantize to exact 0)")
+    flat = x.astype(jnp.float32)
+    q, s = _int8_chunks(flat, n, key, use_pallas)
+    if n == 1:
+        own = _deq(q[0], s[0])
+    else:
+        # int8 reduce-scatter: rank j receives chunk j from every rank
+        # (the scales ride alongside their blocks), then dequant-sums.
+        qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+        own = jnp.sum(_deq(qx, sx), axis=0)
+    if op == ReduceOp.AVERAGE:
+        own = own / jnp.asarray(n, own.dtype)
+    if not return_residual:
+        return own.astype(x.dtype)
+    residual = flat - _deq(q, s).reshape(flat.shape)
+    return own.astype(x.dtype), residual
+
+
+def quantized_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
+                        axis_name: str = "hvd", wire: str = "int8",
+                        key=None, use_pallas=None,
+                        return_residual: bool = False):
+    """Reduce-safe quantized allreduce: block-scaled int8 on every hop.
+
+    Decomposition (any shape/dtype ``x``; works on a flat 1-D mesh axis):
+
+    1. flatten, zero-pad so the buffer splits into ``n`` block-aligned
+       chunks, quantize (stochastic when ``key`` is given — unbiased),
+    2. int8 reduce-scatter (:func:`quantized_reducescatter`): chunk
+       ``j``'s quantized contributions land on rank ``j``, which
+       dequant-accumulates them in fp32,
+    3. requantize the reduced chunk, ``all_gather`` the int8 chunks +
+       scales, dequantize, unpad, reshape.
+
+    Per-device wire bytes ≈ 2·(n-1)/n · B/4 (+ one fp32 scale per 4096
+    elements, a 0.1% overhead) versus the fp32 ring-psum's
+    2·(n-1)/n · B — ~4x at any world size.
+
+    **Error bound** (documented, fuzz-tested): with per-block scales
+    ``s = absmax/127``, each element of the result differs from the
+    exact fp32 sum by at most ``r·(Σ_ranks s_rank + s_reduced)`` where
+    ``r = 1/2`` for round-to-nearest (``key=None``) and ``r = 1`` for
+    stochastic rounding — the contribution roundings plus one
+    requantization of the reduced chunk. For AVERAGE divide by ``n``.
+
+    ``return_residual=True`` additionally returns the fp32 LOCAL error
+    (this rank's contribution rounding over the whole buffer, plus the
+    requantize error of the chunk this rank owns): summed over ranks and
+    steps through the reduction, feeding it back into the next step's
+    input cancels the loss — the error-feedback state
+    ``compression="int8_ef"`` carries (optim.py).
+
+    ``op`` must be SUM/AVERAGE (scaled-block payloads only compose with
+    linear reductions); ``wire`` names the payload dtype — only
+    ``"int8"`` exists today (tiny buckets ride bf16 via the fusion
+    planner's ``wire_dtypes``, common/fusion.py, not through here).
+    """
+    if wire != "int8":
+        raise ValueError(f"unsupported wire format {wire!r}; only 'int8'")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("quantized allreduce supports SUM/AVERAGE "
+                         "(per-block scales only compose with linear "
+                         "reductions)")
+    n = lax.axis_size(axis_name)
+    orig_dtype = x.dtype
+    size = int(x.size)
+    if n == 1:
+        # No wire at all — quantizing would add pure rounding loss.
+        y = x if op == ReduceOp.SUM else x / jnp.asarray(1, x.dtype)
+        if return_residual:
+            return y, jnp.zeros(x.shape, jnp.float32)
+        return y
+    flat = x.astype(jnp.float32).reshape(-1)
+    # Per-rank chunks of whole 32x128 blocks: pad to a multiple of
+    # n*_Q_BLOCK (== ceil-align of the per-rank chunk).
+    chunk = -(-size // (n * _Q_BLOCK)) * _Q_BLOCK
+    flat = jnp.pad(flat, (0, n * chunk - size))
+
+    kc = None if key is None else jax.random.fold_in(key, 0)
+    rs = quantized_reducescatter(flat, ReduceOp.SUM, axis_name, key=kc,
+                                 use_pallas=use_pallas,
+                                 return_residual=return_residual)
+    own, residual = rs if return_residual else (rs, None)
+    own = own.astype(jnp.float32)
+
+    # Requantize the reduced chunk and all-gather it back (hop 2).
+    kr = None if key is None else jax.random.fold_in(key, 1)
+    qr, sr = _int8_chunks(own, 1, kr, use_pallas)
+    qg = lax.all_gather(qr[0], axis_name)           # (n, rows, 128)
+    sg = lax.all_gather(sr[0], axis_name)           # (n, nblocks)
+    red = _deq(qg, sg).reshape(-1)[:size]
+    y = red.reshape(x.shape)
+    if op == ReduceOp.AVERAGE:
+        y = y / jnp.asarray(n, y.dtype)
+    y = y.astype(orig_dtype)
+    if not return_residual:
+        return y
+    # Fold the requantize error of the chunk this rank owns into its
+    # residual: the error belongs to the SUM, but residuals are summed
+    # across ranks through next step's reduction, so the owner carrying
+    # it corrects the global value just the same.
+    me = lax.axis_index(axis_name)
+    err_own = own - _deq(qr[0], sr[0])
+    cur = lax.dynamic_slice_in_dim(residual, me * chunk, chunk)
+    residual = lax.dynamic_update_slice_in_dim(
+        residual, cur + err_own, me * chunk, 0)
+    residual = residual[:size].reshape(x.shape)
+    return y, residual
+
+
 def hierarchical_allreduce_staged(x, op: ReduceOp = ReduceOp.AVERAGE,
                                   local_axis: str = "local",
                                   cross_axis: str = "cross"):

@@ -70,7 +70,9 @@ class Int8Compressor(Compressor):
     quantization kernel (ops/pallas_kernels.py). Capability extension over
     the reference's cast-only compressors for DCN-bound traffic
     (broadcast/allgather/parameter sync); NOT reduce-safe — per-block
-    scales don't commute with summation."""
+    scales don't commute with summation. For int8 on the REDUCE path use
+    :class:`Int8EFCompressor` (``int8_ef``), whose quantized-allreduce
+    decomposition keeps the sum exact up to bounded rounding."""
 
     reduce_safe = False
 
@@ -90,6 +92,44 @@ class Int8Compressor(Compressor):
         return dequantize_int8(q, scales, n, shape, dtype)
 
 
+class Int8EFCompressor(Int8Compressor):
+    """Reduce-safe int8 with error feedback — int8 gradients on the HOT
+    path, not just the broadcast/allgather wire format.
+
+    Unlike :class:`Int8Compressor` (whose per-block scales bar it from
+    sum/avg collectives), this compressor declares a QUANTIZED REDUCTION:
+    the reduction itself is re-expressed as
+    ``ops.collectives.quantized_allreduce`` — reduce-scatter of
+    stochastically-rounded int8 chunks → fp32 dequant-accumulate →
+    requantize → all_gather — so every gradient byte on the wire is int8
+    (~4x fewer bytes than fp32) while the math stays a true sum. The
+    per-step rounding loss is captured as a LOCAL residual
+    (``error_feedback``) that the optimizer carries in its state and
+    adds back before the next step's quantize, so training converges
+    like fp32 (tests/test_compression_e2e.py pins the 20-step MLP within
+    2% of the fp32 loss).
+
+    ``compress``/``decompress`` (inherited) remain the plain block-scaled
+    wire format for broadcast/allgather/object sync. The reduce path
+    never calls them — optim.py / ops/eager.py dispatch on the class
+    attributes below instead:
+
+    - ``reduce_safe = True`` — accepted by DistributedOptimizer et al.
+    - ``quantized_reduce = True`` — reductions route through
+      ``quantized_allreduce`` (SUM/AVERAGE, float inputs; anything else
+      rides uncompressed).
+    - ``error_feedback = True`` — the optimizer carries the residual +
+      stochastic-rounding step counter in its state.
+    - ``wire = "int8"`` — the payload dtype, part of the eager engine's
+      signature-cache key.
+    """
+
+    reduce_safe = True
+    quantized_reduce = True
+    error_feedback = True
+    wire = "int8"
+
+
 class Compression:
     """Namespace mirroring reference ``hvd.Compression`` usage."""
 
@@ -97,6 +137,7 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int8_ef = Int8EFCompressor
 
     @staticmethod
     def by_name(name):
@@ -108,4 +149,6 @@ class Compression:
             return BF16Compressor
         if name in ("int8",):
             return Int8Compressor
+        if name in ("int8_ef", "int8ef"):
+            return Int8EFCompressor
         raise ValueError(f"unknown compression: {name}")

@@ -180,16 +180,21 @@ class EagerEngine:
         # becomes RS(local/ICI) → AR(cross/DCN) → AG(local/ICI).
         self.hier_mesh = hier_mesh
         self._default_compression = NoneCompressor
-        if config.compression_dtype:
+        # HVD_TPU_COMPRESSION (reduction compression: bf16/fp16 cast or
+        # the reduce-safe int8_ef quantized allreduce) wins over the
+        # legacy HVD_TPU_COMPRESSION_DTYPE wire-format knob.
+        default_name = config.compression or config.compression_dtype
+        if default_name:
             from .compression import Compression
 
-            comp = Compression.by_name(config.compression_dtype)
+            comp = Compression.by_name(default_name)
             if not getattr(comp, "reduce_safe", True):
                 raise ValueError(
-                    f"HVD_TPU_COMPRESSION_DTYPE={config.compression_dtype} "
-                    "is a wire-format compressor (per-block scales don't "
-                    "commute with summation) and cannot be the default "
-                    "reduction compression; use fp16/bf16")
+                    f"compression={default_name} is a wire-format "
+                    "compressor (per-block scales don't commute with "
+                    "summation) and cannot be the default reduction "
+                    "compression; use fp16/bf16 (cast) or int8_ef "
+                    "(reduce-safe quantized allreduce)")
             self._default_compression = comp
         # Multi-process guard rail (reference controller.cc:63-358): set in
         # multi-process worlds; negotiate() runs on every compile-cache
@@ -489,6 +494,12 @@ class EagerEngine:
         dt = self.replicate(x)  # local rows = this process's value
         joined_t = tuple(sorted(joined_ranks))
         compression = self._default_compression  # engine-wide, every rank
+        if getattr(compression, "quantized_reduce", False):
+            # Join rounds replay collectives with zero stand-ins; the
+            # quantized decomposition offers no residual state here, so
+            # join-mode traffic rides uncompressed (wire savings resume
+            # once every process has joined or left join mode).
+            compression = NoneCompressor
         key = ("join_ar", shape, dtype, int(op), joined_t, prescale,
                postscale, compression.__name__)
 
@@ -658,21 +669,84 @@ class EagerEngine:
             hier = (self.config.hierarchical_allreduce
                     and self.hier_mesh is not None
                     and op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE))
+            # Quantized reduction (int8_ef): the reduce itself becomes
+            # collectives.quantized_allreduce — int8 payload on every
+            # hop. Only linear ops over float payloads of at least
+            # quantize_min_bucket_bytes qualify (a padded-to-n*4096
+            # quantized scalar would cost MORE wire than fp32); small
+            # float payloads ride a bf16 cast, everything else rides
+            # uncompressed (matching the cast compressors' skip-non-f32
+            # behavior). Eager calls are stateless, so the rounding is
+            # round-to-nearest (no error-feedback residual to carry —
+            # that lives in DistributedOptimizer state); the per-call
+            # error is bounded by the documented per-block scale bound.
+            quantized_comp = getattr(compression, "quantized_reduce",
+                                     False)
+            linear_float = (op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE)
+                            and jnp.issubdtype(dt.dtype, jnp.floating))
+            nbytes = int(np.prod(dt.shape[1:]) or 1) * dt.dtype.itemsize
+            quant = (quantized_comp and linear_float
+                     and nbytes >= self.config.quantize_min_bucket_bytes)
+            if quantized_comp and linear_float and hier:
+                # The optimizer surface raises for ef+hierarchical; the
+                # eager engine must not silently pick one of the two
+                # configured reductions either (a flat quantized
+                # exchange across the slow DCN axis, or an unquantized
+                # staged one, are both surprising).
+                raise ValueError(
+                    "hierarchical_allreduce and a quantized default "
+                    "compression cannot combine on the eager allreduce "
+                    "path; use quantized_cross=True on the optimizer "
+                    "surface for int8 DCN hops, or drop one of the two "
+                    "knobs")
+            small_bf16 = (quantized_comp and linear_float and not quant
+                          and dt.dtype.itemsize > 2)
+            wire = (getattr(compression, "wire", None) if quant
+                    else ("bf16" if small_bf16 else None))
             key = ("ar", dt.shape, str(dt.dtype), int(op), prescale_factor,
-                   postscale_factor, compression.__name__, hier)
+                   postscale_factor, compression.__name__, wire, hier)
 
             def build():
                 scalar_dt = jnp.dtype(self.config.adasum_scalar_dtype)
+
+                if quant:
+                    def per_rank_q(v):
+                        w = C._apply_scale(v, prescale_factor)
+                        w = C.quantized_allreduce(w, op, self.axis,
+                                                  wire=wire)
+                        return C._apply_scale(w, postscale_factor)
+
+                    return self._shard_mapped(per_rank_q)
+
+                if small_bf16:
+                    # Below the quantize threshold: the bf16 cast wire
+                    # (same per-bucket decision assign_wire_dtypes makes
+                    # on the fused path).
+                    def per_rank_b(v):
+                        w = C.allreduce(v.astype(jnp.bfloat16), op,
+                                        self.axis, prescale_factor,
+                                        postscale_factor)
+                        return w.astype(v.dtype)
+
+                    return self._shard_mapped(per_rank_b)
+
+                # A quantized compressor that did NOT qualify for the
+                # quantized path (integer payload / nonlinear op) rides
+                # uncompressed — its compress() is the block-scale WIRE
+                # format whose (q, scales) tuple cannot enter a psum.
+                cast_comp = (NoneCompressor
+                             if getattr(compression, "quantized_reduce",
+                                        False) else compression)
 
                 if hier:
                     ca, la = self.hier_mesh.axis_names
 
                     def per_rank_h(v):
-                        w, ctx = compression.compress(v)
+                        w, ctx = cast_comp.compress(v)
                         w = C._apply_scale(w, prescale_factor)
                         w = C.hierarchical_allreduce(w, op, la, ca)
                         w = C._apply_scale(w, postscale_factor)
-                        return compression.decompress(w, ctx)
+                        return cast_comp.decompress(w, ctx)
 
                     spec = P((ca, la))
                     f = jax.shard_map(per_rank_h, mesh=self.hier_mesh,
@@ -681,11 +755,11 @@ class EagerEngine:
 
                 def per_rank(v):
                     # v: (1, *shape) block per rank
-                    w, ctx = compression.compress(v)
+                    w, ctx = cast_comp.compress(v)
                     w = C.allreduce(w, op, self.axis, prescale_factor,
                                     postscale_factor,
                                     adasum_scalar_dtype=scalar_dt)
-                    return compression.decompress(w, ctx)
+                    return cast_comp.decompress(w, ctx)
                 return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
@@ -771,19 +845,50 @@ class EagerEngine:
             # cache key changes and the bucket plan recompiles (the
             # reference re-fuses each cycle with the tuned threshold).
             threshold = self.fusion_threshold()
-            key = ("art", shapes, int(op), compression.__name__, threshold,
-                   prescale_factor, postscale_factor)
+            quant = (getattr(compression, "quantized_reduce", False)
+                     and op in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE))
+            # Per-bucket wire decisions (fusion.assign_wire_dtypes): the
+            # quantize-min knob is part of the signature — a knob change
+            # re-buckets the wire formats, i.e. a different program.
+            qmin = self.config.quantize_min_bucket_bytes if quant else None
+            key = ("art", shapes, int(op), compression.__name__,
+                   getattr(compression, "wire", None) if quant else None,
+                   qmin, threshold, prescale_factor, postscale_factor)
 
             def build():
+                cast_comp = (NoneCompressor if getattr(
+                    compression, "quantized_reduce", False)
+                    else compression)
+
                 def per_rank(*ls):
-                    def one(flat):
-                        w, ctx = compression.compress(flat)
+                    def one(flat, wire=None):
+                        if wire == fusion_lib.WIRE_INT8 and \
+                                jnp.issubdtype(flat.dtype, jnp.floating):
+                            w = C._apply_scale(flat, prescale_factor)
+                            w = C.quantized_allreduce(w, op, self.axis)
+                            return C._apply_scale(w, postscale_factor)
+                        if wire == fusion_lib.WIRE_BF16 and \
+                                jnp.issubdtype(flat.dtype, jnp.floating):
+                            w = C.allreduce(
+                                flat.astype(jnp.bfloat16), op, self.axis,
+                                prescale_factor, postscale_factor)
+                            return w.astype(flat.dtype)
+                        w, ctx = cast_comp.compress(flat)
                         w = C.allreduce(w, op, self.axis,
                                         prescale_factor, postscale_factor)
-                        return compression.decompress(w, ctx)
+                        return cast_comp.decompress(w, ctx)
                     squeezed = [l.reshape(l.shape[1:]) for l in ls]
-                    out = fusion_lib.fused_apply(
-                        list(squeezed), one, threshold)
+                    if quant:
+                        plan = fusion_lib.plan_fusion(list(squeezed),
+                                                      threshold)
+                        plan = fusion_lib.assign_wire_dtypes(plan, qmin)
+                        flats = fusion_lib.fuse(list(squeezed), plan)
+                        reduced = [one(f, plan.wire_dtypes[i])
+                                   for i, f in enumerate(flats)]
+                        out = fusion_lib.unfuse(reduced, plan)
+                    else:
+                        out = fusion_lib.fused_apply(
+                            list(squeezed), one, threshold)
                     return tuple(o[None] for o in out)
 
                 spec = P(self.axis)

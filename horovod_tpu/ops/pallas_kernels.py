@@ -272,6 +272,78 @@ def quantize_int8(x, use_pallas: Optional[bool] = None):
     return q, scales, n
 
 
+def _quant_sr_kernel(x_ref, u_ref, q_ref, s_ref):
+    xf = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf))
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    scaled = xf / scale
+    fl = jnp.floor(scaled)
+    q = fl + (u_ref[:] < (scaled - fl)).astype(jnp.float32)
+    q_ref[:] = jnp.clip(q, -127, 127).astype(jnp.int8)
+    s_ref[0] = scale
+
+
+def quantize_int8_stochastic(x, key, use_pallas: Optional[bool] = None):
+    """Block-scaled int8 quantization with UNBIASED stochastic rounding —
+    the reduce-path variant of :func:`quantize_int8`.
+
+    Round-to-nearest has a deterministic per-element bias of up to
+    scale/2, which SUMS coherently across ranks in a quantized allreduce
+    and across steps in training; stochastic rounding (round up with
+    probability equal to the fractional part) makes the expected wire
+    value exactly the input, so quantization error averages out instead
+    of accumulating (the EQuARX/error-feedback convergence requirement —
+    PAPERS.md).
+
+    ``key`` is a ``jax.random`` PRNGKey; the rounding thresholds are
+    ``jax.random.uniform(key, ...)`` drawn OUTSIDE the kernel and fed in
+    as an operand, so (a) the result is a deterministic function of
+    ``(x, key)`` on every backend, and (b) the Pallas body and the jnp
+    fallback are bitwise-identical (the parity tests rely on this).
+    Fold the step counter / bucket index into ``key`` for per-step
+    determinism (optim.py does).
+
+    Returns ``(q, scales, n)`` — same contract as :func:`quantize_int8`
+    (one fp32 absmax scale per 32x128 block); invert with
+    :func:`dequantize_int8`.
+    """
+    use, interpret = _decide(use_pallas)
+    x2, n = _to_rows(x, sublane=_Q_ROWS)
+    nblocks = x2.shape[0] // _Q_ROWS
+    u = jax.random.uniform(key, x2.shape, jnp.float32)
+    if not use:
+        blocks = x2.reshape(nblocks, _Q_ROWS * _LANES).astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(blocks), axis=1)
+        scales = jnp.maximum(absmax, 1e-30) / 127.0
+        scaled = blocks / scales[:, None]
+        fl = jnp.floor(scaled)
+        ub = u.reshape(nblocks, _Q_ROWS * _LANES)
+        q = fl + (ub < (scaled - fl)).astype(jnp.float32)
+        q = jnp.clip(q, -127, 127)
+        return q.astype(jnp.int8).reshape(x2.shape), scales, n
+    q, scales = pl.pallas_call(
+        _quant_sr_kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((_Q_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+            jax.ShapeDtypeStruct((nblocks,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, u)
+    return q, scales, n
+
+
 def dequantize_int8(q, scales, n, shape, dtype=jnp.float32,
                     use_pallas: Optional[bool] = None):
     """Inverse of :func:`quantize_int8`."""

@@ -35,8 +35,48 @@ def _check_reduce_safe(compression) -> None:
     if not getattr(compression, "reduce_safe", True):
         raise ValueError(
             f"{compression.__name__} is a wire-format compressor (per-block "
-            "scales don't commute with summation); use Compression.fp16 / "
-            "bf16 for gradient reduction")
+            "scales don't commute with summation) and cannot ride the "
+            "gradient reduction directly; use a reduce-safe compression "
+            "instead — Compression.int8_ef (quantized allreduce with error "
+            "feedback, same 4x wire win) or Compression.fp16 / bf16 (cast)")
+
+
+def _resolve_compression(compression):
+    """Accept a Compressor class, a name ("bf16"/"int8_ef"/...), or None
+    (=> the configured default, HVD_TPU_COMPRESSION / init(compression=),
+    falling back to no compression). Pre-init, the env knob is read
+    directly — an optimizer built at module scope before hvd.init()
+    must not silently discard HVD_TPU_COMPRESSION (an init(compression=)
+    override can only be seen after init, by construction)."""
+    from .ops.compression import Compression
+
+    if compression is None:
+        from .common import basics
+
+        if basics.is_initialized():
+            name = basics.context().config.compression
+        else:
+            from .common.config import _env
+
+            name = _env("COMPRESSION")
+        if name:
+            return Compression.by_name(name)
+        return NoneCompressor
+    if isinstance(compression, str):
+        return Compression.by_name(compression)
+    return compression
+
+
+def _resolve_quantize_min_bytes(explicit: Optional[int] = None) -> int:
+    if explicit is not None:
+        return explicit
+    from .common import basics
+
+    if basics.is_initialized():
+        return basics.context().config.quantize_min_bucket_bytes
+    from .common.config import Config, _env_int
+
+    return _env_int("QUANTIZE_MIN_BYTES", Config.quantize_min_bucket_bytes)
 
 
 def _axes_bound(*axes) -> bool:
@@ -130,6 +170,112 @@ class _AggState(NamedTuple):
     counter: jnp.ndarray
 
 
+# -- error-feedback quantized reduction (compression="int8_ef") -------------
+
+class _EFState(NamedTuple):
+    """Optimizer-state wrapper carried by the error-feedback compressors:
+    the inner transform's state, the fp32 residual pytree (this rank's
+    accumulated quantization error — LOCAL, like the reference's per-rank
+    gradient state), and the step counter that seeds the deterministic
+    per-step stochastic rounding."""
+
+    inner: Any
+    residual: Any
+    step: jnp.ndarray
+
+
+# Base seed for the stochastic-rounding PRNG. The effective key is
+# fold_in(fold_in(PRNGKey(_EF_SEED), step), bucket_index): deterministic
+# per (step, bucket) — identical across ranks (SPMD traces one program)
+# and across reruns, so elastic replays and bitwise-repro debugging hold.
+_EF_SEED = 0x5EED
+
+
+def _zeros_residual(tree):
+    return jax.tree.map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), tree)
+
+
+def _ef_key(step, bucket_index: int):
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(_EF_SEED), step),
+        bucket_index)
+
+
+def _reduce_tree_ef(grads, residual, step, op: C.ReduceOp, axis_name: str,
+                    fusion_threshold: int, prescale: float = 1.0,
+                    postscale: float = 1.0, overlap: bool = False,
+                    bucket_order=None,
+                    quantize_min_bytes: Optional[int] = None):
+    """Fused QUANTIZED allreduce of a gradient pytree with error
+    feedback. Returns ``(reduced_tree, new_residual_tree)``.
+
+    Buckets are planned exactly like :func:`_reduce_tree` (same
+    threshold; reverse/readiness order under ``overlap``) and then
+    stamped with per-bucket wire decisions
+    (``fusion.assign_wire_dtypes``): large float buckets go through
+    ``collectives.quantized_allreduce`` with this step's corrected
+    gradient ``g + residual`` and a per-(step, bucket) stochastic-
+    rounding key; their returned local quantization error becomes the
+    next residual. Small float buckets ride a bf16 cast (no residual —
+    bf16 keeps fp32's exponent range and the cast error is far below the
+    int8 rounding floor); integer buckets ride untouched. ``overlap``
+    chains the per-bucket collectives in issue order (common/overlap.py)
+    exactly like the unquantized path.
+
+    Outside an SPMD region the reduction degenerates to size-1 semantics
+    (scales applied, residual unchanged) — matching :func:`_reduce_tree`.
+    """
+    qmin = _resolve_quantize_min_bytes(quantize_min_bytes)
+    bound = _axes_bound(axis_name)
+    order = (bucket_order if bucket_order is not None
+             else (fusion_lib.ORDER_REVERSE if overlap
+                   else fusion_lib.ORDER_FLATTEN))
+    plan = fusion_lib.plan_fusion(grads, fusion_threshold, order=order)
+    plan = fusion_lib.assign_wire_dtypes(plan, qmin)
+    g_flats = fusion_lib.fuse(grads, plan)
+    r_flats = fusion_lib.fuse(residual, plan)
+
+    def one(i, g, r):
+        wire = plan.wire_dtypes[i]
+        if not bound:
+            w = C._apply_scale(g, prescale)
+            return C._apply_scale(w, postscale), r
+        if wire == fusion_lib.WIRE_INT8 and op in (C.ReduceOp.SUM,
+                                                   C.ReduceOp.AVERAGE):
+            corrected = g.astype(jnp.float32) + r
+            if prescale not in (None, 1.0):
+                corrected = corrected * prescale
+            y, res = C.quantized_allreduce(
+                corrected, op, axis_name, key=_ef_key(step, i),
+                return_residual=True)
+            if prescale not in (None, 1.0):
+                # Residual lives in UNSCALED gradient units (it is added
+                # to raw grads next step, before this prescale reapplies).
+                res = res / prescale
+            y = C._apply_scale(y, postscale)
+            return y.astype(g.dtype), res
+        if wire == fusion_lib.WIRE_BF16 and op in (C.ReduceOp.SUM,
+                                                   C.ReduceOp.AVERAGE):
+            w = C.allreduce(g.astype(jnp.bfloat16), op, axis_name,
+                            prescale, postscale)
+            return w.astype(g.dtype), r
+        return C.allreduce(g, op, axis_name, prescale, postscale), r
+
+    outs = []
+    token = None
+    for i, (g, r) in enumerate(zip(g_flats, r_flats)):
+        if overlap and bound and token is not None:
+            g, token = jax.lax.optimization_barrier((g, token))
+        y, res = one(i, g, r)
+        outs.append((y, res))
+        if overlap and bound:
+            token = y
+    reduced = fusion_lib.unfuse([y for y, _ in outs], plan)
+    new_residual = fusion_lib.unfuse([res for _, res in outs], plan)
+    return reduced, new_residual
+
+
 def _resolve_fusion_threshold(explicit: Optional[int]) -> int:
     """None → the live runtime value (autotuner's current suggestion when
     tuning, else the configured knob); an explicit value always wins."""
@@ -145,7 +291,7 @@ def _resolve_fusion_threshold(explicit: Optional[int]) -> int:
 def DistributedOptimizer(optimizer,
                          op: C.ReduceOp = C.ReduceOp.AVERAGE,
                          axis_name: str = "hvd",
-                         compression=NoneCompressor,
+                         compression=None,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = True,
                          prescale_factor: float = 1.0,
@@ -156,7 +302,8 @@ def DistributedOptimizer(optimizer,
                          cross_axis: str = "cross",
                          quantized_cross: bool = False,
                          overlap: bool = False,
-                         bucket_order=None):
+                         bucket_order=None,
+                         quantize_min_bucket_bytes: Optional[int] = None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -184,21 +331,49 @@ def DistributedOptimizer(optimizer,
     (``init(overlap_xla_flags=True)`` / common/xla_tuning.py) on TPU.
     ``bucket_order`` optionally pins a measured leaf permutation
     (``fusion.measured_order``) instead of the reverse-flatten proxy.
+
+    ``compression`` accepts a Compressor class, a name
+    (``"bf16"``/``"int8_ef"``/...), or None — the configured default
+    (``HVD_TPU_COMPRESSION`` / ``init(compression=)``). With
+    ``compression="int8_ef"`` the reduction runs as a REDUCE-SAFE
+    QUANTIZED ALLREDUCE (collectives.quantized_allreduce: int8 payload
+    on every hop, ~4x fewer wire bytes) with an ERROR-FEEDBACK residual
+    carried in the optimizer state: each step reduces ``grad +
+    residual``, and the local quantization error becomes the next
+    residual, so training converges like fp32 (docs/compression.md).
+    Only fused buckets of at least ``quantize_min_bucket_bytes``
+    (default: the HVD_TPU_QUANTIZE_MIN_BYTES knob, 64 KiB) are
+    quantized — smaller float buckets ride bf16. Requires a SUM/AVERAGE
+    op; composes with ``overlap`` but not with ``hierarchical`` (use
+    ``quantized_cross`` for the int8 DCN hop of the staged pipeline).
     """
     try:
         import optax
     except ImportError as e:  # pragma: no cover
         raise ImportError("DistributedOptimizer requires optax") from e
 
+    compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
+    ef = getattr(compression, "error_feedback", False)
     if quantized_cross and (not hierarchical or op not in (
             C.ReduceOp.SUM, C.ReduceOp.AVERAGE)):
         raise ValueError("quantized_cross requires hierarchical=True and "
                          "a SUM/AVERAGE op (the int8 hop rides the "
                          "staged RS->AR->AG pipeline)")
+    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+        raise ValueError(
+            f"compression={compression.__name__} needs a SUM/AVERAGE op "
+            "(block-scaled payloads only compose with linear reductions)")
+    if ef and hierarchical:
+        raise ValueError(
+            "int8_ef composes with the flat rank axis; for hierarchical "
+            "(ICI/DCN) reduction use quantized_cross=True, which carries "
+            "the DCN hop as int8 inside the staged RS->AR->AG pipeline")
 
     k = int(backward_passes_per_step)
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
+    quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
+        quantize_min_bucket_bytes)
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
@@ -207,19 +382,35 @@ def DistributedOptimizer(optimizer,
                             cross_axis, quantized_cross, overlap,
                             bucket_order)
 
-    if k <= 1:
-        def init_fn(params):
-            return optimizer.init(params)
+    # Core transformation: reduce + inner update (+ the error-feedback
+    # residual/step state when the compressor declares it). The k>1
+    # aggregation below wraps THIS, so backward_passes_per_step composes
+    # with error feedback unchanged.
+    def core_init(params):
+        inner = optimizer.init(params)
+        if not ef:
+            return inner
+        return _EFState(inner=inner, residual=_zeros_residual(params),
+                        step=jnp.zeros((), jnp.int32))
 
-        def update_fn(grads, state, params=None, **extra):
+    def core_update(grads, state, params=None, **extra):
+        if not ef:
             reduced = reduce_grads(grads)
             return optimizer.update(reduced, state, params, **extra)
+        reduced, new_res = _reduce_tree_ef(
+            grads, state.residual, state.step, op, axis_name,
+            fusion_threshold_bytes, prescale_factor, postscale_factor,
+            overlap, bucket_order, quantize_min_bucket_bytes)
+        updates, new_inner = optimizer.update(reduced, state.inner,
+                                              params, **extra)
+        return updates, _EFState(new_inner, new_res, state.step + 1)
 
-        return optax.GradientTransformation(init_fn, update_fn)
+    if k <= 1:
+        return optax.GradientTransformation(core_init, core_update)
 
     def init_fn(params):
         acc = jax.tree.map(jnp.zeros_like, params)
-        return _AggState(inner=optimizer.init(params), acc=acc,
+        return _AggState(inner=core_init(params), acc=acc,
                          counter=jnp.zeros((), jnp.int32))
 
     def update_fn(grads, state, params=None, **extra):
@@ -232,9 +423,8 @@ def DistributedOptimizer(optimizer,
             scale = (1.0 / k) if average_aggregated_gradients else 1.0
             scaled = jax.tree.map(lambda g: g * scale, acc) \
                 if scale != 1.0 else acc
-            reduced = reduce_grads(scaled)
-            updates, new_inner = optimizer.update(reduced, inner, params,
-                                                  **extra)
+            updates, new_inner = core_update(scaled, inner, params,
+                                             **extra)
             zeroed = jax.tree.map(jnp.zeros_like, acc)
             return updates, new_inner, zeroed
 
@@ -254,12 +444,13 @@ def DistributedOptimizer(optimizer,
 def DistributedGradFn(grad_fn: Callable,
                       op: C.ReduceOp = C.ReduceOp.AVERAGE,
                       axis_name: str = "hvd",
-                      compression=NoneCompressor,
+                      compression=None,
                       fusion_threshold_bytes: Optional[int] = None,
                       has_value: bool = False,
                       reduce_value: bool = True,
                       overlap: bool = False,
-                      bucket_order=None):
+                      bucket_order=None,
+                      quantize_min_bucket_bytes: Optional[int] = None):
     """DistributedGradientTape analog (reference
     tensorflow/__init__.py:564-629): wraps a function returning gradients
     (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
@@ -273,25 +464,72 @@ def DistributedGradFn(grad_fn: Callable,
     ``overlap``/``bucket_order``: readiness-ordered buckets + issue-order
     chaining, as on :func:`DistributedOptimizer` — scheduling only,
     identical numerics.
+
+    With an error-feedback compression (``"int8_ef"``) the wrapper is
+    STATEFUL in the functional style: the wrapped function grows an
+    ``ef_state`` keyword and returns ``(result, new_ef_state)`` — thread
+    the state through your training loop like optimizer state::
+
+        gfn = hvd.DistributedGradFn(jax.grad(loss), compression="int8_ef")
+        ef = gfn.init_ef_state(params)        # zeros residual + step 0
+        grads, ef = gfn(params, batch, ef_state=ef)
+
+    ``ef_state=None`` starts from a zero residual (valid, but the
+    residual is then discarded each call — quantization error no longer
+    cancels across steps; thread the state for fp32-like convergence).
     """
+    compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
+    ef = getattr(compression, "error_feedback", False)
+    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+        raise ValueError(
+            f"compression={compression.__name__} needs a SUM/AVERAGE op")
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
+    quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
+        quantize_min_bucket_bytes)
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
                             fusion_threshold_bytes, overlap=overlap,
                             bucket_order=bucket_order)
 
+    def _reduce_value(val):
+        if reduce_value and _axes_bound(axis_name):
+            return jax.tree.map(
+                lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
+                val)
+        return val
+
+    if ef:
+        def wrapped(*args, ef_state=None, **kwargs):
+            out = grad_fn(*args, **kwargs)
+            val, grads = out if has_value else (None, out)
+            if ef_state is None:
+                residual = _zeros_residual(grads)
+                step = jnp.zeros((), jnp.int32)
+            else:
+                residual, step = ef_state.residual, ef_state.step
+            reduced, new_res = _reduce_tree_ef(
+                grads, residual, step, op, axis_name,
+                fusion_threshold_bytes, overlap=overlap,
+                bucket_order=bucket_order,
+                quantize_min_bytes=quantize_min_bucket_bytes)
+            new_state = _EFState(inner=None, residual=new_res,
+                                 step=step + 1)
+            if has_value:
+                return (_reduce_value(val), reduced), new_state
+            return reduced, new_state
+
+        wrapped.init_ef_state = lambda grads_template: _EFState(
+            inner=None, residual=_zeros_residual(grads_template),
+            step=jnp.zeros((), jnp.int32))
+        return wrapped
+
     def wrapped(*args, **kwargs):
         out = grad_fn(*args, **kwargs)
         if has_value:
             val, grads = out
-            grads = reduce_grads(grads)
-            if reduce_value and _axes_bound(axis_name):
-                val = jax.tree.map(
-                    lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
-                    val)
-            return val, grads
+            return _reduce_value(val), reduce_grads(grads)
         return reduce_grads(out)
 
     return wrapped
@@ -350,17 +588,24 @@ class AutotunedStepper:
         # Joint tuning (reference ParameterManager's hierarchical toggle):
         # build_step then takes (threshold, hierarchical). With a
         # tune_overlap tuner the signature widens once more to
-        # (threshold, hierarchical, overlap) — the full triple the
-        # (re)built step must agree on across ranks.
+        # (threshold, hierarchical, overlap), and with tune_compression
+        # to (threshold, hierarchical, overlap, compression) — the full
+        # point the (re)built step must agree on across ranks.
         self._joint = getattr(tuner, "tune_hierarchical", False)
         self._joint_overlap = getattr(tuner, "tune_overlap", False)
+        self._joint_comp = getattr(tuner, "tune_compression", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
         self._ovl = (tuner.current_overlap if self._joint_overlap
                      else False)
+        self._comp = (tuner.current_compression if self._joint_comp
+                      else "none")
         self._step = self._rebuild()
         self.rebuilds = 0
 
     def _rebuild(self):
+        if self._joint_comp:
+            return self._build(self._threshold, self._hier, self._ovl,
+                               self._comp)
         if self._joint_overlap:
             return self._build(self._threshold, self._hier, self._ovl)
         if self._joint:
@@ -379,6 +624,10 @@ class AutotunedStepper:
     def overlap(self) -> bool:
         return self._ovl
 
+    @property
+    def compression(self) -> str:
+        return self._comp
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -389,15 +638,17 @@ class AutotunedStepper:
         dt = time.perf_counter() - t0
         c = self._controller
         if c is None or c.size == 1:
-            new, tuner_h, tuner_o = self.tuner.feed_triple(
+            new, tuner_h, tuner_o, tuner_c = self.tuner.feed_quad(
                 self.grad_bytes, dt)
             new_h = tuner_h if self._joint else self._hier
             new_o = tuner_o if self._joint_overlap else self._ovl
+            new_c = tuner_c if self._joint_comp else self._comp
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new, new_h, new_o = self._threshold, self._hier, self._ovl
+            new, new_h, new_o, new_c = (self._threshold, self._hier,
+                                        self._ovl, self._comp)
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -405,9 +656,11 @@ class AutotunedStepper:
                 # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                cur_t, cur_h, cur_o = self.tuner.current_triple  # atomic
+                cur_t, cur_h, cur_o, cur_c = \
+                    self.tuner.current_quad  # atomic
                 mine = (f"{cur_t}|{int(cur_h) if self._joint else 0}"
                         f"|{int(cur_o) if self._joint_overlap else 0}"
+                        f"|{cur_c if self._joint_comp else 'none'}"
                         + (":done" if c.rank == 0 and self.tuner.done
                            else ""))
                 vals = c.exchange("autotune_threshold", mine)
@@ -415,14 +668,16 @@ class AutotunedStepper:
                 if v0.endswith(":done"):
                     self._tuner_done = True
                     v0 = v0[:-5]
-                t_str, h_str, o_str = v0.split("|")
+                t_str, h_str, o_str, c_str = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
                 new_o = bool(int(o_str)) if self._joint_overlap \
                     else self._ovl
+                new_c = c_str if self._joint_comp else self._comp
         if (new != self._threshold or new_h != self._hier
-                or new_o != self._ovl):
-            self._threshold, self._hier, self._ovl = new, new_h, new_o
+                or new_o != self._ovl or new_c != self._comp):
+            self._threshold, self._hier, self._ovl, self._comp = \
+                new, new_h, new_o, new_c
             self._step = self._rebuild()
             self.rebuilds += 1
         return out
@@ -502,40 +757,104 @@ def _require_axis(axis_name: str, what: str) -> None:
             f"spmd_step (see ShardedOptimizer docstring).")
 
 
-def _shard_flat(flat, axis_name: str):
-    """(1-D bucket) -> this rank's padded 1/n slice."""
+def _shard_flat(flat, axis_name: str, align: int = 1):
+    """(1-D bucket) -> this rank's padded 1/n slice. ``align`` rounds the
+    per-rank chunk up to a multiple (the quantized RS path needs whole
+    32x128 int8 blocks per chunk, align=4096); align=1 is the historical
+    layout and MUST stay the default — sharded state is positionally
+    indexed by these shapes."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    flat, _ = fusion_lib.pad_to_multiple(flat, n)
+    # pad-to-multiple-of(n*align) == per-rank chunks of ceil-aligned
+    # size: ceil(ceil(L/n)/a)*a == ceil(L/(n*a))*a.
+    flat, _ = fusion_lib.pad_to_multiple(flat, n * align)
     chunk = flat.shape[0] // n
     return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
 
 
+class _EFShardState(NamedTuple):
+    """ZeRO-1 (sharded_update) analog of :class:`_EFState`: the inner
+    state over bucket shards, plus this rank's full-length fp32
+    quantization residual per bucket (padded to the quantized chunk
+    grid) and the stochastic-rounding step counter."""
+
+    inner: Any
+    residual: Any            # list of (n*chunk,) fp32 arrays per bucket
+    step: jnp.ndarray
+
+
+def _qpad_len(total_elems: int, n: int) -> int:
+    """Padded bucket length on the quantized-RS chunk grid — the static
+    twin of ``_shard_flat(..., align=_Q_BLOCK)``'s padding."""
+    from .ops.collectives import _Q_BLOCK
+
+    grid = n * _Q_BLOCK
+    return -(-total_elems // grid) * grid
+
+
 def sharded_init(tx, params, axis_name: str = "hvd",
-                 fusion_threshold_bytes: Optional[int] = None):
+                 fusion_threshold_bytes: Optional[int] = None,
+                 compression=None):
     """Inner-optimizer state over FUSED-BUCKET SHARDS — call inside the
     same shard_map/jit region as :func:`sharded_update` (the shard
     shapes depend on the bound axis). State structure = the inner
-    transform's state over a list of per-bucket shard arrays."""
+    transform's state over a list of per-bucket shard arrays.
+
+    With ``compression="int8_ef"`` the gradient reduce-scatter runs
+    quantized (collectives.quantized_reducescatter) and the state gains
+    the error-feedback residual + step counter (:class:`_EFShardState`);
+    shard chunks align to the 4096-element int8 block grid, so a state
+    built with compression can only be consumed by an update using the
+    SAME compression (and vice versa)."""
     _require_axis(axis_name, "sharded_init")
+    compression = _resolve_compression(compression)
+    _check_reduce_safe(compression)
+    ef = getattr(compression, "error_feedback", False)
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
     plan = fusion_lib.plan_fusion(params, threshold)
     flats = fusion_lib.fuse(params, plan)
-    return tx.init([_shard_flat(f, axis_name) for f in flats])
+    from .ops.collectives import _Q_BLOCK
+
+    align = _Q_BLOCK if ef else 1
+    inner = tx.init([_shard_flat(f, axis_name, align) for f in flats])
+    if not ef:
+        return inner
+    n = jax.lax.axis_size(axis_name)
+    residual = [jnp.zeros((_qpad_len(b.total_elems, n),), jnp.float32)
+                for b in plan.buckets]
+    return _EFShardState(inner=inner, residual=residual,
+                         step=jnp.zeros((), jnp.int32))
 
 
 def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
                    grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                    fusion_threshold_bytes: Optional[int] = None,
-                   **extra):
+                   compression=None, **extra):
     """ZeRO-1 step over fused buckets: RS(bucket grads) -> inner update
     on this rank's shards -> AG(bucket updates). A few large collectives
     instead of one pair per leaf (same bucketing as the replicated
     path). Returns ``(updates, new_state)`` with ``updates`` shaped like
-    ``params`` (apply with ``optax.apply_updates``)."""
+    ``params`` (apply with ``optax.apply_updates``).
+
+    ``compression="int8_ef"`` (state from ``sharded_init`` with the same
+    compression) carries the gradient reduce-scatter — the hop that
+    moves (n-1)/n of every gradient byte — as block-scaled int8 with
+    stochastic rounding, folding each step's quantization error into the
+    carried residual. The update all-gather stays in the params' dtype:
+    updates are small relative to gradients' dynamic range and have no
+    residual state to absorb a second rounding."""
     if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
         raise ValueError("sharded_update supports SUM/AVERAGE")
     _require_axis(axis_name, "sharded_update")
+    compression = _resolve_compression(compression)
+    ef = getattr(compression, "error_feedback", False)
+    if ef != isinstance(state, _EFShardState):
+        raise ValueError(
+            "sharded_update compression= must match the sharded_init that "
+            "built this state (error-feedback state and shard alignment "
+            f"differ): compression={compression.__name__}, state "
+            f"{'has' if isinstance(state, _EFShardState) else 'lacks'} "
+            "an error-feedback residual")
     n = jax.lax.axis_size(axis_name)
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
     # Plan over PARAMS (grads share the treedef): the state was built
@@ -547,15 +866,36 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
         plan)
     p_flats = fusion_lib.fuse(params, plan)
 
-    def rs(f):
-        padded, _ = fusion_lib.pad_to_multiple(f, n)
-        return C.reducescatter(padded, grad_op, axis_name)
+    if not ef:
+        def rs(f):
+            padded, _ = fusion_lib.pad_to_multiple(f, n)
+            return C.reducescatter(padded, grad_op, axis_name)
 
-    g_shards = [rs(f) for f in g_flats]
-    p_shards = [_shard_flat(f, axis_name) for f in p_flats]
-    u_shards, new_state = tx.update(g_shards, state, p_shards, **extra)
+        g_shards = [rs(f) for f in g_flats]
+        p_shards = [_shard_flat(f, axis_name) for f in p_flats]
+        u_shards, new_state = tx.update(g_shards, state, p_shards, **extra)
+        u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
+                   for u, f in zip(u_shards, g_flats)]
+        return fusion_lib.unfuse(u_flats, plan), new_state
+
+    from .ops.collectives import _Q_BLOCK
+
+    g_shards, new_residual = [], []
+    for i, (f, res) in enumerate(zip(g_flats, state.residual)):
+        pad = res.shape[0] - f.shape[0]
+        corrected = jnp.pad(f.astype(jnp.float32), (0, pad)) + res
+        shard, r = C.quantized_reducescatter(
+            corrected, grad_op, axis_name,
+            key=_ef_key(state.step, i), return_residual=True)
+        g_shards.append(shard.astype(f.dtype))
+        new_residual.append(r)
+    p_shards = [_shard_flat(f, axis_name, _Q_BLOCK) for f in p_flats]
+    u_shards, new_inner = tx.update(g_shards, state.inner, p_shards,
+                                    **extra)
     u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
                for u, f in zip(u_shards, g_flats)]
+    new_state = _EFShardState(inner=new_inner, residual=new_residual,
+                              step=state.step + 1)
     return fusion_lib.unfuse(u_flats, plan), new_state
 
 
@@ -571,20 +911,26 @@ class ShardedOptimizer:
 
     def __init__(self, inner, axis_name: str = "hvd",
                  grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
-                 fusion_threshold_bytes: Optional[int] = None):
+                 fusion_threshold_bytes: Optional[int] = None,
+                 compression=None):
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
         # Pinned ONCE (like the DistributedOptimizer factory): the state
         # layout is one shard per bucket, so a live autotuner moving the
         # threshold between traces must not replan the buckets out from
-        # under the carried state.
+        # under the carried state. Same for the compression: it decides
+        # the shard alignment and the state structure (_EFShardState).
         self.fusion_threshold_bytes = _resolve_fusion_threshold(
             fusion_threshold_bytes)
+        self.compression = _resolve_compression(compression)
+        _check_reduce_safe(self.compression)
+        self._ef = getattr(self.compression, "error_feedback", False)
 
     def init(self, params):
         return sharded_init(self.inner, params, self.axis_name,
-                            self.fusion_threshold_bytes)
+                            self.fusion_threshold_bytes,
+                            compression=self.compression)
 
     def update(self, grads, state, params=None, **extra):
         if params is None:
@@ -592,7 +938,8 @@ class ShardedOptimizer:
                              "(the shard slices come from them)")
         return sharded_update(self.inner, grads, state, params,
                               self.axis_name, self.grad_op,
-                              self.fusion_threshold_bytes, **extra)
+                              self.fusion_threshold_bytes,
+                              compression=self.compression, **extra)
 
     def state_specs(self, params):
         """PartitionSpecs for carrying the sharded state through
@@ -600,10 +947,22 @@ class ShardedOptimizer:
         the global array is the shard concatenation), scalar leaves
         (step counters) replicate. The probe uses the same fusion plan
         as init/update so the state STRUCTURE (one shard per bucket)
-        matches — callable before init()."""
+        matches — callable before init(). With an error-feedback
+        compression the residual leaves are per-rank LOCAL (each rank's
+        own quantization error), carried as P(axis) shards of the
+        rank-stacked global view; the step counter replicates."""
+        from jax.sharding import PartitionSpec as P
+
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
-        return _sharded_state_specs(self.inner, plan, self.axis_name)
+        inner_specs = _sharded_state_specs(self.inner, plan,
+                                           self.axis_name)
+        if not self._ef:
+            return inner_specs
+        return _EFShardState(
+            inner=inner_specs,
+            residual=[P(self.axis_name)] * len(plan.buckets),
+            step=P())
 
     def gather_state(self, state, params):
         """Sharded state -> world-size-independent full state (inside
@@ -616,18 +975,48 @@ class ShardedOptimizer:
         ``fusion_threshold_bytes`` explicitly in elastic jobs — a
         live autotuner or changed env knob in the restarted process
         would re-bucket and silently misalign the per-bucket mu/nu
-        vectors)."""
+        vectors).
+
+        Error-feedback states carry the residual across the resize as
+        its PSUM: Σ_r residual_r is the total pending correction and is
+        world-size-independent; :meth:`reshard_state` hands it to the
+        new world's rank 0 (zeros elsewhere) — the next reduction sums
+        residuals across ranks anyway, so placement is arbitrary."""
         _require_axis(self.axis_name, "ShardedOptimizer.gather_state")
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
-        return _gather_sharded_state(self.inner, plan, state,
-                                     self.axis_name)
+        if not self._ef:
+            return _gather_sharded_state(self.inner, plan, state,
+                                         self.axis_name)
+        inner_full = _gather_sharded_state(self.inner, plan, state.inner,
+                                           self.axis_name)
+        residual_full = [
+            jax.lax.psum(r, self.axis_name)[:b.total_elems]
+            for r, b in zip(state.residual, plan.buckets)]
+        return _EFShardState(inner=inner_full, residual=residual_full,
+                             step=state.step)
 
     def reshard_state(self, state_full):
         """Full (gathered) state -> this world's 1/n shards (inside the
         NEW world's SPMD region, whatever its size)."""
         _require_axis(self.axis_name, "ShardedOptimizer.reshard_state")
-        return _reshard_state(state_full, self.axis_name)
+        if not self._ef:
+            return _reshard_state(state_full, self.axis_name)
+        from .ops.collectives import _Q_BLOCK
+
+        n = jax.lax.axis_size(self.axis_name)
+        me = jax.lax.axis_index(self.axis_name)
+        inner = jax.tree.map(
+            lambda v: _shard_flat(v, self.axis_name, _Q_BLOCK)
+            if v.ndim else v,
+            state_full.inner)
+        residual = []
+        for r in state_full.residual:
+            pad = _qpad_len(r.shape[0], n) - r.shape[0]
+            r = jnp.pad(r, (0, pad))
+            residual.append(jnp.where(me == 0, r, jnp.zeros_like(r)))
+        return _EFShardState(inner=inner, residual=residual,
+                             step=state_full.step)
 
 
 # -- FSDP / ZeRO-3: fully-sharded parameters (beyond the reference) ---------

@@ -289,3 +289,65 @@ def test_stepper_joint_rebuilds_on_hierarchical_change():
     assert stepper.rebuilds >= 1
     assert any(h for _, h in seen) and any(not h for _, h in seen), seen
     assert stepper.hierarchical in (True, False)
+
+
+def test_autotuner_joint_compression():
+    """Joint compression axis: synthetic objective where int8_ef (4x
+    fewer wire bytes) is fastest at the 16 MiB threshold — the tuner
+    must converge on that pair and expose it via current_quad."""
+    mb = 1024 * 1024
+    candidates = [4 * mb, 16 * mb]
+    base = {4 * mb: 300.0, 16 * mb: 1000.0}
+    comp_gain = {"none": 1.0, "bf16": 1.8, "int8_ef": 3.2}
+    t = Autotuner(candidates_bytes=candidates, warmup_samples=0,
+                  steps_per_sample=2, tune_compression=True)
+    assert "compression" in t._columns or not t.log_file
+    for _ in range(120):
+        for _ in range(t.steps_per_sample):
+            score = base[t.current] * comp_gain[t.current_compression]
+            t.record(score, 1.0)
+        if t.ready():
+            t.suggest()
+        if t.done:
+            break
+    assert t.done
+    thr, hier, ovl, comp = t.current_quad
+    assert thr == 16 * mb and comp == "int8_ef"
+    assert hier is False and ovl is False  # untuned axes stay pinned
+
+
+def test_autotuner_compression_logged_csv(tmp_path):
+    log = str(tmp_path / "autotune.csv")
+    t = Autotuner(candidates_bytes=[1024], warmup_samples=0,
+                  steps_per_sample=1, log_file=log,
+                  tune_compression=True)
+    t.record(100.0, 1.0)
+    t.suggest()
+    lines = open(log).read().strip().splitlines()
+    assert lines[0] == ("unix_time,threshold_bytes,compression,"
+                        "score_bytes_per_sec,steps")
+    assert lines[1].split(",")[2] in ("none", "bf16", "int8_ef")
+
+
+def test_stepper_joint_compression_rebuilds():
+    """AutotunedStepper with tune_compression passes the full
+    (threshold, hierarchical, overlap, compression) point to build and
+    rebuilds when the compression moves."""
+    from horovod_tpu.optim import AutotunedStepper
+
+    t = Autotuner(candidates_bytes=[1024], warmup_samples=0,
+                  steps_per_sample=1, tune_compression=True)
+    seen = []
+
+    def build(threshold, hierarchical, overlap, compression):
+        seen.append((threshold, hierarchical, overlap, compression))
+        return lambda x: x + 1
+
+    stepper = AutotunedStepper(build, grad_bytes=1000, tuner=t,
+                               block=False)
+    for i in range(8):
+        stepper(i)
+    assert stepper.rebuilds >= 1
+    comps = {c for _, _, _, c in seen}
+    assert len(comps) >= 2, seen  # the compression axis was explored
+    assert stepper.compression in ("none", "bf16", "int8_ef")

@@ -71,3 +71,42 @@ def test_pad_to_multiple():
     assert padded.shape[0] == 16 and n == 10
     padded2, n2 = fusion.pad_to_multiple(jnp.arange(16.0), 8)
     assert padded2.shape[0] == 16 and n2 == 16
+
+
+def test_assign_wire_dtypes():
+    """Per-bucket compression decisions (the int8_ef planner hook):
+    large float buckets quantize, small fp32 buckets ride bf16, small
+    half-precision and integer buckets ride untouched; deterministic in
+    (plan, threshold)."""
+    tree = {
+        "big": jnp.zeros((64 * 1024,), jnp.float32),      # 256 KiB
+        "small": jnp.zeros((128,), jnp.float32),          # 512 B
+        "half": jnp.zeros((64,), jnp.bfloat16),           # 128 B
+        "ints": jnp.zeros((2048,), jnp.int32),
+    }
+    plan = fusion.plan_fusion(tree, threshold_bytes=1 << 20)
+    assert plan.wire_dtypes is None  # not stamped until asked
+    plan = fusion.assign_wire_dtypes(plan, quantize_min_bytes=64 * 1024)
+    assert plan.wire_dtypes is not None
+    assert len(plan.wire_dtypes) == len(plan.buckets)
+    by_dtype = {str(b.dtype): w
+                for b, w in zip(plan.buckets, plan.wire_dtypes)}
+    assert by_dtype["float32"] in (fusion.WIRE_INT8,)  # big dominates
+    assert by_dtype["bfloat16"] == fusion.WIRE_NONE
+    assert by_dtype["int32"] == fusion.WIRE_NONE
+    # With the threshold at 0, every float bucket quantizes.
+    plan0 = fusion.assign_wire_dtypes(
+        fusion.plan_fusion(tree, threshold_bytes=1 << 20),
+        quantize_min_bytes=0)
+    for b, w in zip(plan0.buckets, plan0.wire_dtypes):
+        want = fusion.WIRE_INT8 if "float" in str(b.dtype) \
+            or "bfloat" in str(b.dtype) else fusion.WIRE_NONE
+        assert w == want, (b.dtype, w)
+    # Small-but-separate fp32 bucket rides bf16 under a tiny bucket
+    # threshold (each leaf its own bucket).
+    plan_s = fusion.assign_wire_dtypes(
+        fusion.plan_fusion(tree, threshold_bytes=1024),
+        quantize_min_bytes=64 * 1024)
+    small_idx = [i for i, b in enumerate(plan_s.buckets)
+                 if b.total_elems == 128][0]
+    assert plan_s.wire_dtypes[small_idx] == fusion.WIRE_BF16

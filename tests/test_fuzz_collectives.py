@@ -57,6 +57,130 @@ def test_fuzz_allreduce_scaled(hvd, seed):
                                rtol=3e-5, atol=1e-4)
 
 
+# -- quantized allreduce properties (the int8_ef reduce path) --------------
+#
+# quantized_allreduce is an in-jit primitive (shard_map), so these fuzz
+# it over sub-meshes of the 8 virtual devices directly — world-size
+# invariance needs meshes of different sizes, which the eager engine's
+# fixed world can't express.
+
+def _run_quantized(x_stacked, op, k, key=None, residual=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops import collectives as C
+
+    mesh = Mesh(np.array(jax.devices()[:k]), ("q",))
+
+    def f(v):
+        out = C.quantized_allreduce(v.reshape(v.shape[1:]), op, "q",
+                                    key=key, return_residual=residual)
+        if residual:
+            return out[0][None], out[1][None]
+        return out[None]
+
+    outs = P("q") if not residual else (P("q"), P("q"))
+    g = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("q"),
+                              out_specs=outs))
+    out = g(jnp.asarray(x_stacked))
+    if residual:
+        return np.asarray(out[0]), np.asarray(out[1])
+    return np.asarray(out)
+
+
+def _error_bound(x, r=0.5):
+    """Documented per-element bound: r*(sum of per-rank max block scales
+    + reduced-chunk scale); block scales <= global absmax/127, so this
+    per-rank-absmax form is a (slightly loose) upper envelope."""
+    n = x.shape[0]
+    per_rank = sum(np.abs(x[i]).max() for i in range(n))
+    reduced = np.abs(x.astype(np.float64).sum(0)).max()
+    return r * (per_rank + reduced) / 127.0 + 1e-6
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_quantized_allreduce_error_bound(hvd, seed):
+    """quantized_allreduce vs the fp64 oracle, across dtypes/shapes/ops,
+    within the documented per-block error bound (docs/compression.md)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5000 + seed)
+    ndim = int(rng.integers(1, 4))
+    shape = (8,) + tuple(int(rng.integers(1, 40)) for _ in range(ndim))
+    dtype = [np.float32, jnp.bfloat16][seed % 2]
+    x = (rng.standard_normal(shape) * rng.uniform(0.1, 30)).astype(dtype)
+    xf = np.asarray(x, np.float64)
+    op = ["sum", "avg"][seed % 2]
+    from horovod_tpu.ops import collectives as C
+
+    out = _run_quantized(x, {"sum": C.ReduceOp.SUM,
+                             "avg": C.ReduceOp.AVERAGE}[op], 8)
+    want = xf.sum(0) if op == "sum" else xf.mean(0)
+    bound = _error_bound(np.asarray(x, np.float32))
+    if op == "avg":
+        bound /= 8
+    if dtype is not np.float32:
+        # bf16 in/out adds a cast rounding on top of the int8 bound.
+        bound += np.abs(want).max() * 2 ** -7
+    err = np.abs(out[0].astype(np.float64) - want).max()
+    assert err <= bound, (err, bound, shape, dtype, op)
+    for r in range(1, 8):
+        np.testing.assert_array_equal(out[r], out[0])
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_fuzz_quantized_allreduce_world_size_invariance(hvd, k):
+    """The documented bound (and exactness of replication) holds at any
+    world size — the decomposition has no hidden n dependence."""
+    from horovod_tpu.ops import collectives as C
+
+    rng = np.random.default_rng(7000 + k)
+    x = (rng.standard_normal((k, 300)) * 4).astype(np.float32)
+    out = _run_quantized(x, C.ReduceOp.SUM, k)
+    want = x.astype(np.float64).sum(0)
+    assert np.abs(out[0] - want).max() <= _error_bound(x)
+    for r in range(1, k):
+        np.testing.assert_array_equal(out[r], out[0])
+
+
+def test_fuzz_quantized_allreduce_stochastic_deterministic(hvd):
+    """Seeded stochastic rounding: same key -> identical result (the
+    per-step determinism the EF optimizer relies on); different key ->
+    different roundings; error within the stochastic bound (r=1)."""
+    import jax
+
+    from horovod_tpu.ops import collectives as C
+
+    rng = np.random.default_rng(81)
+    x = (rng.standard_normal((8, 2000)) * 3).astype(np.float32)
+    k1 = jax.random.PRNGKey(1)
+    out1 = _run_quantized(x, C.ReduceOp.SUM, 8, key=k1)
+    out2 = _run_quantized(x, C.ReduceOp.SUM, 8, key=k1)
+    np.testing.assert_array_equal(out1, out2)
+    out3 = _run_quantized(x, C.ReduceOp.SUM, 8, key=jax.random.PRNGKey(2))
+    assert not np.array_equal(out3, out1)
+    want = x.astype(np.float64).sum(0)
+    assert np.abs(out1[0] - want).max() <= _error_bound(x, r=1.0)
+
+
+def test_fuzz_quantized_allreduce_residual_telescopes(hvd):
+    """Error-feedback contract: the residuals summed over ranks equal
+    exactly what the quantized result is missing versus the true sum —
+    feeding them back next step restores it."""
+    import jax
+
+    from horovod_tpu.ops import collectives as C
+
+    rng = np.random.default_rng(82)
+    x = (rng.standard_normal((8, 531)) * 6).astype(np.float32)
+    y, res = _run_quantized(x, C.ReduceOp.SUM, 8,
+                            key=jax.random.PRNGKey(3), residual=True)
+    missing = x.astype(np.float64).sum(0) - y[0]
+    np.testing.assert_allclose(res.astype(np.float64).sum(0), missing,
+                               rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_fuzz_other_collectives(hvd, seed):
     rng = np.random.default_rng(3000 + seed)

@@ -94,6 +94,77 @@ def test_quantize_pallas_matches_fallback(rng):
     np.testing.assert_array_equal(np.asarray(q1), np.asarray(q0))
 
 
+# -- stochastic-rounding quantize kernel (the int8_ef reduce path) ---------
+
+def test_stochastic_quantize_pallas_matches_fallback(rng):
+    """The rounding thresholds are drawn OUTSIDE the kernel from the
+    jax.random key, so the Pallas body (interpret mode on CPU) and the
+    jnp fallback must agree BITWISE — q and scales both."""
+    import jax
+
+    x = jnp.asarray(rng.standard_normal(8192) * 7, jnp.float32)
+    key = jax.random.PRNGKey(11)
+    q1, s1, n1 = pk.quantize_int8_stochastic(x, key, use_pallas=True)
+    q0, s0, n0 = pk.quantize_int8_stochastic(x, key, use_pallas=False)
+    assert n1 == n0 == 8192
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q0))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s0))
+
+
+def test_stochastic_quantize_deterministic_per_key(rng):
+    import jax
+
+    x = jnp.asarray(rng.standard_normal(5000), jnp.float32)
+    key = jax.random.PRNGKey(5)
+    q1, _, _ = pk.quantize_int8_stochastic(x, key, use_pallas=True)
+    q2, _, _ = pk.quantize_int8_stochastic(x, key, use_pallas=True)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    q3, _, _ = pk.quantize_int8_stochastic(x, jax.random.PRNGKey(6),
+                                           use_pallas=True)
+    assert not np.array_equal(np.asarray(q3), np.asarray(q1)), \
+        "different keys must draw different roundings"
+
+
+@pytest.mark.parametrize("n", [100, 4096, 9001])
+def test_stochastic_quantize_rounds_to_neighbor(rng, n):
+    """Every element rounds to an adjacent int8 level: |deq - x| < scale
+    (one full step — stochastic rounding may go either way, unlike
+    nearest's half step)."""
+    import jax
+
+    x = jnp.asarray(rng.standard_normal(n) * 10, jnp.float32)
+    q, scales, cnt = pk.quantize_int8_stochastic(
+        x, jax.random.PRNGKey(0), use_pallas=True)
+    assert q.dtype == jnp.int8 and cnt == n
+    out = pk.dequantize_int8(q, scales, cnt, x.shape, use_pallas=True)
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    assert err.max() <= np.asarray(scales).max() + 1e-6
+
+
+def test_stochastic_quantize_unbiased(rng):
+    """E[dequant(quant(x))] = x: averaging the roundtrip over many keys
+    must beat any single draw's error by ~sqrt(K) — the property that
+    makes quantization error cancel instead of accumulate across ranks
+    and steps."""
+    import jax
+
+    x = jnp.asarray(rng.standard_normal(4096) * 3, jnp.float32)
+    K = 64
+    acc = np.zeros(4096, np.float64)
+    for k in range(K):
+        q, s, n = pk.quantize_int8_stochastic(
+            x, jax.random.PRNGKey(k), use_pallas=False)
+        acc += np.asarray(pk.dequantize_int8(q, s, n, x.shape,
+                                             use_pallas=False),
+                          np.float64)
+    mean_err = acc / K - np.asarray(x, np.float64)
+    scale = float(np.asarray(s).max())
+    # per-element stderr <= scale/2/sqrt(K); 5 sigma over 4096 elements.
+    assert np.abs(mean_err).max() < 5 * 0.5 * scale / np.sqrt(K)
+    # ...and the MEAN bias across elements is far tighter.
+    assert abs(mean_err.mean()) < scale / np.sqrt(K)
+
+
 def test_int8_compressor_roundtrip(rng):
     from horovod_tpu.ops.compression import Compression
 
@@ -112,6 +183,25 @@ def test_int8_rejected_for_reduction():
     with pytest.raises(ValueError, match="wire-format"):
         hvd.DistributedOptimizer(optax.sgd(0.1),
                                  compression=Compression.int8)
+
+
+def test_int8_ef_compressor_surface():
+    """int8_ef is the reduce-safe int8: accepted by the optimizer, wire
+    format inherited from the block-scale machinery."""
+    from horovod_tpu.ops.compression import Compression, Int8EFCompressor
+
+    assert Compression.by_name("int8_ef") is Int8EFCompressor
+    assert Int8EFCompressor.reduce_safe
+    assert Int8EFCompressor.quantized_reduce
+    assert Int8EFCompressor.error_feedback
+    assert Int8EFCompressor.wire == "int8"
+    # compress/decompress stay the plain wire format (broadcast/
+    # allgather) — same roundtrip contract as Compression.int8.
+    x = jnp.asarray(np.linspace(-2, 2, 512, dtype=np.float32))
+    wire, ctx = Int8EFCompressor.compress(x)
+    out = Int8EFCompressor.decompress(wire, ctx)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    assert np.abs(np.asarray(out) - np.asarray(x)).max() < 0.05
 
 
 def test_pairwise_combine_uses_kernels(rng):

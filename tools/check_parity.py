@@ -1,7 +1,11 @@
 #!/usr/bin/env python
 """Audit docs/parity.md: every file path and test-module mentioned must
 exist, so the component map the judge reads can't silently rot as the
-tree moves. Exits non-zero listing dangling references.
+tree moves. Also audits the Compression surface: every compressor
+exposed on the ``Compression`` namespace (ops/compression.py) must be
+documented in docs/api.md and docs/compression.md — a new wire format
+(e.g. ``int8_ef``) that ships undocumented is invisible to users. Exits
+non-zero listing dangling references.
 
 Run: python tools/check_parity.py
 """
@@ -14,6 +18,33 @@ import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 DOC = REPO / "docs" / "parity.md"
+
+
+def check_compression_surface(missing: list) -> None:
+    """Names on the Compression namespace <-> docs. Parsed textually
+    (no package import — this tool must run without jax installed)."""
+    src = (REPO / "horovod_tpu" / "ops" / "compression.py").read_text()
+    if "class Compression:" not in src:
+        missing.append("compression: Compression namespace not found")
+        return
+    # `name = SomeCompressor` class-level assignments only occur on the
+    # Compression namespace.
+    names = re.findall(r"^    (\w+) = \w+Compressor$", src, re.M)
+    if not names:
+        missing.append("compression: no compressors on the namespace")
+    api = (REPO / "docs" / "api.md")
+    comp_doc = (REPO / "docs" / "compression.md")
+    if not comp_doc.exists():
+        missing.append("path: docs/compression.md")
+    api_text = api.read_text() if api.exists() else ""
+    comp_text = comp_doc.read_text() if comp_doc.exists() else ""
+    for name in names:
+        if name not in api_text:
+            missing.append(f"compression {name}: undocumented in "
+                           "docs/api.md")
+        if name not in comp_text:
+            missing.append(f"compression {name}: undocumented in "
+                           "docs/compression.md")
 
 
 def main() -> int:
@@ -50,6 +81,8 @@ def main() -> int:
             else:
                 missing.append(f"module: {dotted.strip('`')}")
                 break
+
+    check_compression_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")

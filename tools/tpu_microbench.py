@@ -475,9 +475,102 @@ def kernels_section():
     return out
 
 
+def compression_section():
+    """Ground truth for the autotuner's compression dimension (the
+    ISSUE-3 tentpole): payload sizes × {fp32, bf16, int8, int8_ef}
+    allreduce, reporting (a) analytic bytes-on-wire per device for a
+    ring/ICI schedule, (b) quantize/dequantize kernel overhead in
+    isolation, and (c) end-to-end in-jit allreduce latency. int8 is the
+    round-to-nearest quantized allreduce (the eager/stateless form);
+    int8_ef adds seeded stochastic rounding (the optimizer's
+    error-feedback form — same wire bytes, slightly more VPU work).
+    On CPU the collective is a memcpy, so the latency columns only
+    prove dispatch correctness; the chip run gives the real curve."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops import pallas_kernels as pk
+
+    ctx = hvd.init()
+    n = hvd.size()
+    ax = hvd.rank_axis()
+    mesh = ctx.mesh
+    rng = jax.random.PRNGKey(13)
+    sizes = (1 << 14,) if SMALL else (1 << 18, 1 << 20, 1 << 22)
+
+    def spmd(fn):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P(ax),
+                                     out_specs=P(ax)))
+
+    key = jax.random.PRNGKey(99)
+    out = {"world_size": n}
+    for nelem in sizes:
+        x = jax.random.normal(rng, (n, nelem), jnp.float32) * 3
+        mib = nelem * 4 / 2**20
+
+        forms = {
+            "fp32": spmd(lambda v: jax.lax.psum(v, ax)),
+            "bf16": spmd(lambda v: jax.lax.psum(
+                v.astype(jnp.bfloat16), ax).astype(v.dtype)),
+            "int8": spmd(lambda v: C.quantized_allreduce(
+                v.reshape(v.shape[1:]), C.ReduceOp.SUM, ax)[None]),
+            "int8_ef": spmd(lambda v: C.quantized_allreduce(
+                v.reshape(v.shape[1:]), C.ReduceOp.SUM, ax,
+                key=key)[None]),
+        }
+        # Ring allreduce moves 2*(n-1)/n of the buffer per device; the
+        # quantized form carries int8 payload + one fp32 scale per 4096
+        # elements on both hops.
+        ring = 2 * (n - 1) / max(n, 1)
+        wire = {
+            "fp32": ring * nelem * 4,
+            "bf16": ring * nelem * 2,
+            "int8": ring * (nelem + 4 * nelem / 4096),
+            "int8_ef": ring * (nelem + 4 * nelem / 4096),
+        }
+
+        row = {"mib": round(mib, 3)}
+        for name, fn in forms.items():
+            try:
+                row[f"{name}_ms"] = round(_time_ms(lambda: fn(x)), 3)
+            except Exception as e:  # noqa: BLE001 — evidence collection
+                row[f"{name}_ms"] = (
+                    f"failed: {(str(e) or repr(e)).splitlines()[0][:120]}")
+            row[f"{name}_wire_mib"] = round(wire[name] / 2**20, 3)
+        if isinstance(row.get("fp32_ms"), float):
+            for name in ("bf16", "int8", "int8_ef"):
+                v = row.get(f"{name}_ms")
+                if isinstance(v, float) and v:
+                    row[f"{name}_speedup"] = round(row["fp32_ms"] / v, 2)
+        # The ring factor 2*(n-1)/n cancels in the ratio (and is 0 on a
+        # single device, where nothing touches the wire) — report the
+        # payload ratio, which holds at any world size.
+        row["int8_wire_reduction_vs_fp32"] = round(
+            (nelem * 4) / (nelem + 4 * nelem / 4096), 2)
+
+        # Quantize/dequant overhead in isolation (the cost the wire win
+        # must beat): one flat buffer, jitted kernel round trips.
+        flat = x[0]
+        qfn = jax.jit(lambda v: pk.quantize_int8(v)[0])
+        qsr = jax.jit(lambda v: pk.quantize_int8_stochastic(v, key)[0])
+        q, s, cnt = pk.quantize_int8(flat)
+        dq = jax.jit(lambda q, s: pk.dequantize_int8(
+            q, s, cnt, flat.shape))
+        row["quantize_ms"] = round(_time_ms(lambda: qfn(flat)), 3)
+        row["quantize_sr_ms"] = round(_time_ms(lambda: qsr(flat)), 3)
+        row["dequantize_ms"] = round(_time_ms(lambda: dq(q, s)), 3)
+        out[f"{round(mib, 2)}MiB"] = row
+        _log(f"compression {mib:.2f}MiB: {row}")
+    return out
+
+
 SECTIONS = {"flash": flash_section, "striped": striped_section,
             "overlap": overlap_section, "grad_overlap": grad_overlap_section,
-            "fusion": fusion_section, "kernels": kernels_section}
+            "fusion": fusion_section, "kernels": kernels_section,
+            "compression": compression_section}
 
 
 def main():
