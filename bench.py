@@ -455,7 +455,60 @@ def _run_benchmark(args, n):
         result["model_flops_per_sample_g"] = round(model_flops / 1e9, 2)
         result["mfu_model_pct"] = round(100.0 * val * model_flops / peak,
                                         1)
+    mx = _metrics_summary()
+    if mx:
+        # WHY a round got faster, not just how fast: the wire-byte mix,
+        # cache behavior, and fusion fill that produced this step time
+        # (docs/metrics.md; hvd.metrics() is the full registry).
+        result["metrics"] = mx
     return result
+
+
+def _metrics_summary():
+    """Condensed hvd.metrics() snapshot for the BENCH_*.json record:
+    bytes-on-wire mix, eager cache hit rate, fusion fill efficiency."""
+    try:
+        import horovod_tpu as hvd
+
+        snap = hvd.metrics()
+    except Exception:  # noqa: BLE001 — telemetry must never fail a bench
+        return None
+    if not snap:
+        return None
+
+    def samples(name):
+        return snap.get(name, {}).get("samples", [])
+
+    out = {}
+    wire = {s["labels"].get("wire", "?"): s["value"]
+            for s in samples("hvd_tpu_allreduce_bytes_total")
+            if s["value"]}
+    planned = {s["labels"].get("wire", "?"): s["value"]
+               for s in samples("hvd_tpu_fusion_wire_bytes_total")
+               if s["value"]}
+    if wire:
+        # Eager-path truth when the eager engine ran; in-jit steps only
+        # leave the trace-time plan, so fall back to the planned mix.
+        out["bytes_on_wire"] = wire
+        out["bytes_basis"] = "eager"
+    elif planned:
+        out["bytes_on_wire"] = planned
+        out["bytes_basis"] = "planned_per_compile"
+    cache = {s["labels"].get("result", "?"): s["value"]
+             for s in samples("hvd_tpu_eager_cache_total")}
+    lookups = sum(cache.values())
+    if lookups:
+        out["cache"] = {"hits": int(cache.get("hit", 0)),
+                        "misses": int(cache.get("miss", 0)),
+                        "hit_rate": round(cache.get("hit", 0) / lookups,
+                                          3)}
+    for key, name in (("fusion_fill_efficiency",
+                       "hvd_tpu_fusion_fill_efficiency"),
+                      ("fusion_buckets", "hvd_tpu_fusion_buckets")):
+        vals = samples(name)
+        if vals:
+            out[key] = round(vals[0]["value"], 6)
+    return out or None
 
 
 _LAST_LOWERED = {"lowered": None, "compiled": None}

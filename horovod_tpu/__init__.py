@@ -53,7 +53,9 @@ from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
 from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
-                    broadcast_parameters, sharded_init, sharded_update)
+                    StepTimer, broadcast_parameters, observe_ef_residual,
+                    sharded_init, sharded_update)
+from .common import metrics as _metrics_lib
 from .common.faults import recovery_stats
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
@@ -348,6 +350,33 @@ def synchronize(handle: int):
     return _ctx().engine.synchronize(handle)
 
 
+# -- unified telemetry (docs/metrics.md) -----------------------------------
+
+def metrics() -> dict:
+    """Snapshot of the process-wide metrics registry: every counter,
+    gauge, and histogram each layer reports (dispatch latency, raw-vs-
+    wire bytes, cache hits, fusion fill, autotune state, recovery
+    counters...). Empty when disabled via ``HVD_TPU_METRICS=0``. The
+    same data is exportable as a JSON-lines file
+    (``HVD_TPU_METRICS_FILE``) and a Prometheus ``/metrics`` endpoint
+    (``HVD_TPU_METRICS_PORT`` / :func:`start_metrics_server`)."""
+    return _metrics_lib.snapshot()
+
+
+def start_metrics_server(port: int = 0) -> int:
+    """Start (or return) the Prometheus ``/metrics`` endpoint on a
+    stdlib HTTP background thread; returns the bound port (``port=0``
+    binds an ephemeral one). Also serves the raw snapshot at
+    ``/metrics.json``. Samples carry ``rank=``/``size=`` labels once
+    ``init()`` has run, so rank 0 (or any scraper) can aggregate a pod
+    view across workers."""
+    return _metrics_lib.serve(port)
+
+
+def stop_metrics_server() -> None:
+    _metrics_lib.stop_serving()
+
+
 # -- timeline (reference operations.cc:720-746) ----------------------------
 
 def start_timeline(filename: str, mark_cycles: bool = False,
@@ -423,5 +452,6 @@ __all__ = [
     "gloo_enabled", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built", "xla_built", "tpu_available",
     "ProcessSet", "add_process_set", "remove_process_set", "run",
-    "recovery_stats",
+    "recovery_stats", "metrics", "start_metrics_server",
+    "stop_metrics_server", "StepTimer", "observe_ef_residual",
 ]

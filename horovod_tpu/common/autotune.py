@@ -23,7 +23,32 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import metrics as metrics_lib
+
 logger = logging.getLogger("horovod_tpu")
+
+# Telemetry (docs/metrics.md): the live autotune point + per-config
+# sample counts, on the same scrape as the step/collective metrics —
+# "why did this round get faster" is answerable only when the tuner's
+# decisions are recorded next to the throughput they produced.
+_M_THRESHOLD = metrics_lib.gauge(
+    "hvd_tpu_autotune_threshold_bytes",
+    "current fusion threshold the autotuner is running")
+_M_HIER = metrics_lib.gauge(
+    "hvd_tpu_autotune_hierarchical", "current hierarchical toggle (0/1)")
+_M_OVERLAP = metrics_lib.gauge(
+    "hvd_tpu_autotune_overlap", "current overlap toggle (0/1)")
+_M_COMP_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_compression_index",
+    "index of the current compression candidate "
+    "(see compression_candidates order; 0 = none)")
+_M_CONVERGED = metrics_lib.gauge(
+    "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
+_M_SAMPLES = metrics_lib.counter(
+    "hvd_tpu_autotune_samples_total",
+    "scored samples per configuration "
+    "(config = threshold|hierarchical|overlap|compression)",
+    labels=("config",))
 
 _MB = 1024 * 1024
 DEFAULT_CANDIDATES = tuple(int(x * _MB) for x in
@@ -150,6 +175,7 @@ class Autotuner:
         if tune_compression:
             cols.append("compression")
         self._columns = tuple(cols)
+        self._publish_metrics()
         if log_file:
             # Decision trace (reference HOROVOD_AUTOTUNE_LOG,
             # parameter_manager.cc LogParameters): when + what was
@@ -250,6 +276,19 @@ class Autotuner:
             return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
                     self.compression_candidates[self._cur[3]])
 
+    def _config_label(self, point: Tuple[int, int, int, int]) -> str:
+        return (f"{point[0]}|{int(point[1])}|{int(point[2])}"
+                f"|{self.compression_candidates[point[3]]}")
+
+    def _publish_metrics(self) -> None:
+        """Mirror the live point into the metrics registry (called with
+        the tuner lock held or from __init__ before threads exist)."""
+        _M_THRESHOLD.set(self._cur[0])
+        _M_HIER.set(self._cur[1])
+        _M_OVERLAP.set(self._cur[2])
+        _M_COMP_IDX.set(self._cur[3])
+        _M_CONVERGED.set(1.0 if self._done else 0.0)
+
     def _row(self, point: Tuple[int, int, int, int]) -> List:
         """CSV row values matching _columns: the threshold always, each
         toggle only when tuned (an untuned axis would log a constant 0
@@ -289,6 +328,7 @@ class Autotuner:
     def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
         self._samples.setdefault(self._cur, []).append(score)
+        _M_SAMPLES.labels(config=self._config_label(self._cur)).inc()
         self._log(self._cur, score)
         self._bytes = self._secs = 0.0
         self._steps = 0
@@ -327,6 +367,7 @@ class Autotuner:
                            key=lambda p: float(np.mean(self._samples[p])))
                 self._cur = best
                 self._done = True
+                self._publish_metrics()
                 logger.info(
                     "autotune converged: fusion threshold %d MiB"
                     + (", hierarchical=%s" % bool(best[1])
@@ -339,4 +380,5 @@ class Autotuner:
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]
+        self._publish_metrics()
         return self._cur[0]

@@ -148,6 +148,53 @@ class Context:
                                   hier_mesh=self.hier_mesh,
                                   controller=self.controller,
                                   autotuner=self.autotuner)
+        # Unified telemetry (docs/metrics.md): stamp the rank identity
+        # onto every exported sample (rank 0 aggregates a pod view by
+        # scraping each worker's /metrics), then wire the export
+        # surfaces the config asks for. Registry enable/disable itself
+        # is env-only (HVD_TPU_METRICS — bound at import by the
+        # instrumented modules).
+        from . import metrics as metrics_lib
+
+        self.metrics_port: Optional[int] = None
+        self._owns_metrics_server = False
+        self._owns_metrics_dump = False
+        if metrics_lib.enabled():
+            metrics_lib.set_global_labels(rank=str(self.rank()),
+                                          size=str(self.size()))
+            if config.metrics_trace_bridge:
+                metrics_lib.enable_trace_bridge(True)
+            if config.metrics_file:
+                # Ownership like the server below: a dump the user
+                # started explicitly outlives this context's shutdown.
+                self._owns_metrics_dump = \
+                    metrics_lib.dumping_path() is None
+                metrics_lib.start_file_dump(config.metrics_file,
+                                            config.metrics_interval_s)
+            if config.metrics_port >= 0:
+                already = metrics_lib.serving_port()
+                try:
+                    self.metrics_port = metrics_lib.serve(
+                        config.metrics_port)
+                except OSError as e:
+                    # Telemetry is best-effort, never fatal to init: a
+                    # fixed-port collision (several workers per host)
+                    # falls back to an ephemeral port.
+                    logger.warning(
+                        "metrics: port %d unavailable (%s); binding an "
+                        "ephemeral port instead — pass --metrics-port 0 "
+                        "with multiple workers per host",
+                        config.metrics_port, e)
+                    try:
+                        self.metrics_port = metrics_lib.serve(0)
+                    except OSError as e2:
+                        logger.warning(
+                            "metrics: /metrics endpoint disabled (%s)",
+                            e2)
+                if self.metrics_port is not None:
+                    self._owns_metrics_server = already is None
+                    logger.info("metrics: Prometheus /metrics endpoint "
+                                "on port %d", self.metrics_port)
         # Elastic host-update channel: poll the driver's rendezvous KV
         # topology version (reference: WorkerNotificationClient,
         # elastic/worker.py). Consumed by State.check_host_updates().
@@ -295,6 +342,16 @@ class Context:
         self._process_sets = []
         self.stall.stop_watchdog()
         self.timeline.stop()
+        from . import metrics as metrics_lib
+
+        # Stop only what THIS context started (ownership-checked for
+        # both surfaces): a dump/server the user started explicitly
+        # outlives re-init cycles. Stopping the dump drains a final
+        # snapshot line.
+        if self._owns_metrics_dump:
+            metrics_lib.stop_file_dump()
+        if self._owns_metrics_server:
+            metrics_lib.stop_serving()
         self._shutdown = True
 
 

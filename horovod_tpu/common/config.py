@@ -127,6 +127,19 @@ class Config:
     # elastic reset IS a process restart, so warm-starting compiles
     # from disk directly shortens every reset and relaunch).
     compilation_cache_dir: Optional[str] = None
+    # Unified telemetry (docs/metrics.md). Registry enable/disable is
+    # env-only (HVD_TPU_METRICS=0 — read at import so instrumented hot
+    # paths can bind no-op singletons before init() ever runs); these
+    # knobs wire the EXPORT surfaces at init():
+    # JSON-lines snapshot dump path (the timeline-writer-thread pattern).
+    metrics_file: Optional[str] = None
+    # Dump interval in seconds.
+    metrics_interval_s: float = 10.0
+    # Prometheus /metrics endpoint port: -1 = off, 0 = ephemeral.
+    metrics_port: int = -1
+    # metrics<->timeline bridge: histogram spans + step annotations also
+    # emit jax.profiler Trace/StepTraceAnnotations.
+    metrics_trace_bridge: bool = False
     # Logging level.
     log_level: str = "warning"
     # Mesh axis name used for the data-parallel "ranks" axis.
@@ -166,6 +179,11 @@ class Config:
         c.join_mode = _env_bool("JOIN_MODE", False)
         c.thread_affinity = _env("THREAD_AFFINITY")
         c.compilation_cache_dir = _env("COMPILATION_CACHE_DIR")
+        c.metrics_file = _env("METRICS_FILE")
+        c.metrics_interval_s = _env_float("METRICS_INTERVAL_S",
+                                          cls.metrics_interval_s)
+        c.metrics_port = _env_int("METRICS_PORT", cls.metrics_port)
+        c.metrics_trace_bridge = _env_bool("METRICS_TRACE", False)
         c.log_level = _env("LOG_LEVEL", "warning") or "warning"
         c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
         c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)

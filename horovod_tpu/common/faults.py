@@ -67,6 +67,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from . import metrics as metrics_lib
+
 logger = logging.getLogger("horovod_tpu")
 
 ENV_PLAN = "HVD_TPU_FAULT_PLAN"
@@ -424,6 +426,22 @@ class RecoveryStats:
                 "discovery_retries", "blacklist_events",
                 "blacklist_recoveries", "preemptions", "injections")
 
+    # Mirrored into the unified metrics registry (docs/metrics.md) so
+    # recovery counters land on the same /metrics scrape as the perf
+    # metrics — "how often did we reset and how long were we down" IS
+    # the SLO. Pre-seeding every known counter at 0 makes absence
+    # distinguishable from silence on the very first scrape.
+    _METRIC = metrics_lib.counter(
+        "hvd_tpu_recovery_total",
+        "recovery events (RecoveryStats) by counter name",
+        labels=("counter",))
+    _METRIC_DOWNTIME = metrics_lib.gauge(
+        "hvd_tpu_recovery_downtime_seconds",
+        "accumulated recovery downtime")
+    for _c in COUNTERS:
+        _METRIC.labels(counter=_c)
+    del _c
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
@@ -437,6 +455,9 @@ class RecoveryStats:
                 # "retries" aggregates every retry family
                 # (rendezvous_retries, discovery_retries, ...).
                 self._counts["retries"] = self._counts.get("retries", 0) + n
+        self._METRIC.labels(counter=name).inc(n)
+        if name.endswith("_retries"):
+            self._METRIC.labels(counter="retries").inc(n)
         self._register_exit_hook()
         self._emit_timeline(name)
 
@@ -445,6 +466,7 @@ class RecoveryStats:
             return
         with self._lock:
             self.downtime_seconds += seconds
+            self._METRIC_DOWNTIME.set(self.downtime_seconds)
         self._register_exit_hook()
 
     def snapshot(self) -> Dict[str, Any]:

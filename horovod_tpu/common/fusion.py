@@ -35,11 +35,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import metrics as metrics_lib
+
 # Leaf-visit orders understood by plan_fusion (besides an explicit
 # permutation): flatten order (the historical default) and reverse
 # flatten order (the readiness proxy used by overlap=True).
 ORDER_FLATTEN = "flatten"
 ORDER_REVERSE = "reverse"
+
+# Telemetry (docs/metrics.md): plan/assign run at trace time (host
+# Python), so these record per compiled program, not per step. Guarded
+# by one module-level bool so the disabled path costs a single check.
+_METRICS_ON = metrics_lib.enabled()
+_M_PLANS = metrics_lib.counter(
+    "hvd_tpu_fusion_plans_total", "fusion bucket plans computed")
+_M_BUCKETS = metrics_lib.gauge(
+    "hvd_tpu_fusion_buckets", "bucket count of the most recent plan")
+_M_FILL = metrics_lib.gauge(
+    "hvd_tpu_fusion_fill_efficiency",
+    "mean bucket fill fraction (bucket bytes / threshold) of the most "
+    "recent plan")
+_M_WIRE_BUCKETS = metrics_lib.counter(
+    "hvd_tpu_fusion_bucket_wire_total",
+    "fusion buckets by the wire format assign_wire_dtypes stamped",
+    labels=("wire",))
+_M_WIRE_BYTES = metrics_lib.counter(
+    "hvd_tpu_fusion_wire_bytes_total",
+    "bytes planned onto each wire format (per compiled plan, raw-dtype "
+    "bytes of the buckets routed there)",
+    labels=("wire",))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,7 +139,7 @@ def measured_order(tree, ready_names: Sequence[str]) -> List[int]:
 
 def plan_fusion(tree, threshold_bytes: int,
                 order: Union[str, Sequence[int], None] = ORDER_FLATTEN,
-                ) -> FusionPlan:
+                _telemetry: bool = True) -> FusionPlan:
     """Greedy same-dtype bucketing in ``order`` (reference fuses in
     response order up to the threshold, controller.cc:686-809).
 
@@ -201,6 +225,17 @@ def plan_fusion(tree, threshold_bytes: int,
     ]
     order_tag = order if isinstance(order, str) and order in (
         ORDER_FLATTEN, ORDER_REVERSE) else "explicit"
+    # ``_telemetry=False`` suppresses the metric bumps for plans built
+    # purely to PRICE an already-planned program (the eager engine's
+    # byte accounting) — otherwise every grouped signature counts twice.
+    if _METRICS_ON and _telemetry:
+        _M_PLANS.inc()
+        _M_BUCKETS.set(len(buckets))
+        if buckets and threshold_bytes > 0:
+            fills = [min(1.0, b.total_elems
+                         * np.dtype(b.dtype).itemsize / threshold_bytes)
+                     for b in buckets]
+            _M_FILL.set(sum(fills) / len(fills))
     return FusionPlan(tuple(buckets), treedef, len(leaves),
                       order=order_tag)
 
@@ -212,7 +247,8 @@ WIRE_INT8 = "int8"    # block-scaled int8 quantized allreduce (4x)
 
 
 def assign_wire_dtypes(plan: FusionPlan, quantize_min_bytes: int,
-                       small_wire: str = WIRE_BF16) -> FusionPlan:
+                       small_wire: str = WIRE_BF16,
+                       _telemetry: bool = True) -> FusionPlan:
     """Stamp per-bucket compression decisions onto a plan.
 
     Quantization has fixed per-bucket costs (quantize/dequant kernels,
@@ -238,6 +274,11 @@ def assign_wire_dtypes(plan: FusionPlan, quantize_min_bytes: int,
             wires.append(small_wire)
         else:
             wires.append(WIRE_NONE)
+    if _METRICS_ON and _telemetry:
+        for b, w in zip(plan.buckets, wires):
+            _M_WIRE_BUCKETS.labels(wire=w).inc()
+            _M_WIRE_BYTES.labels(wire=w).inc(
+                b.total_elems * np.dtype(b.dtype).itemsize)
     return dataclasses.replace(plan, wire_dtypes=tuple(wires))
 
 

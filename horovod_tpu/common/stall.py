@@ -20,9 +20,25 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import metrics as metrics_lib
 from .exceptions import StallError
 
 logger = logging.getLogger("horovod_tpu")
+
+# Telemetry (docs/metrics.md): in-flight depth + stall events on the
+# same scrape as everything else. Process-wide — multiple inspectors
+# (world engine + process-set engines) share the gauge; last writer
+# wins, which is fine because submits are serialized per engine and a
+# pod-level scrape cares about "is anything stuck", not which engine.
+_M_INFLIGHT = metrics_lib.gauge(
+    "hvd_tpu_stall_inflight",
+    "collectives submitted but not yet completed")
+_M_WARNINGS = metrics_lib.counter(
+    "hvd_tpu_stall_warnings_total",
+    "collectives that aged past the stall check threshold")
+_M_FATAL = metrics_lib.counter(
+    "hvd_tpu_stall_fatal_total",
+    "stalls past the shutdown threshold (StallError raised/latched)")
 
 
 class StallInspector:
@@ -45,6 +61,7 @@ class StallInspector:
         self.raise_if_fatal()
         with self._lock:
             self._inflight[name] = time.monotonic()
+            _M_INFLIGHT.set(len(self._inflight))
 
     def record_complete(self, name: str) -> None:
         if self.disabled:
@@ -52,6 +69,7 @@ class StallInspector:
         with self._lock:
             self._inflight.pop(name, None)
             self._warned.discard(name)
+            _M_INFLIGHT.set(len(self._inflight))
 
     def check(self) -> bool:
         """Poll for stalls; returns True if any stalled tensor was found.
@@ -67,12 +85,14 @@ class StallInspector:
         for name, t0 in items:
             age = now - t0
             if self.shutdown_time > 0 and age > self.shutdown_time:
+                _M_FATAL.inc()
                 raise StallError(
                     f"collective {name} stalled for {age:.0f}s "
                     f"(> shutdown threshold {self.shutdown_time:.0f}s)")
             if age > self.check_time:
                 stalled = True
                 if name not in self._warned:
+                    _M_WARNINGS.inc()
                     logger.warning(
                         "One or more collectives submitted but not "
                         "completed for >%.0fs: %s (reference analog: "

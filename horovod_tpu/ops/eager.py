@@ -44,10 +44,55 @@ logger = logging.getLogger("horovod_tpu")
 
 from ..common import faults as faults_lib
 from ..common import fusion as fusion_lib
+from ..common import metrics as metrics_lib
 from ..common.exceptions import (DuplicateTensorNameError,
                                  TensorShapeMismatchError)
 from . import collectives as C
 from .compression import Compression, NoneCompressor
+
+# Unified telemetry (docs/metrics.md). _METRICS_ON freezes the enable
+# state at import so every disabled hot-path site is one bool check —
+# no label dicts, no lookups (the families below are the NOOP singleton
+# under HVD_TPU_METRICS=0).
+_METRICS_ON = metrics_lib.enabled()
+_M_DISPATCH = metrics_lib.histogram(
+    "hvd_tpu_dispatch_seconds",
+    "host-side dispatch latency of eager collectives (submit to async "
+    "dispatch return, per op kind)",
+    labels=("op",))
+_M_COMPLETE = metrics_lib.histogram(
+    "hvd_tpu_collective_seconds",
+    "submit-to-buffer-ready latency of eager collectives (completion "
+    "recorded by the finalizer pool, per op kind)",
+    labels=("op",))
+_M_CACHE = metrics_lib.counter(
+    "hvd_tpu_eager_cache_total",
+    "eager signature (compile) cache lookups by result",
+    labels=("result",))
+# Pre-bound children: the static-label hot paths stay allocation-free.
+_M_CACHE_HIT = _M_CACHE.labels(result="hit")
+_M_CACHE_MISS = _M_CACHE.labels(result="miss")
+_M_BYTES = metrics_lib.counter(
+    "hvd_tpu_collective_bytes_total",
+    "per-process payload bytes per eager collective: raw (caller "
+    "dtype) vs wire (what actually crosses the interconnect)",
+    labels=("op", "kind"))
+_M_AR_WIRE = metrics_lib.counter(
+    "hvd_tpu_allreduce_bytes_total",
+    "eager allreduce bytes on the wire by wire format (int8 includes "
+    "the per-4096-block fp32 scales)",
+    labels=("wire",))
+
+
+def _wire_bytes_int8(elems: int) -> int:
+    """int8 wire cost: 1 byte/element + one fp32 scale per 4096-block."""
+    return elems + 4 * ((elems + 4095) // 4096)
+
+
+def _count_simple_bytes(op: str, nbytes: int) -> None:
+    """Raw == wire accounting for the uncompressed collective ops."""
+    _M_BYTES.labels(op=op, kind="raw").inc(nbytes)
+    _M_BYTES.labels(op=op, kind="wire").inc(nbytes)
 
 
 class HandleManager:
@@ -217,6 +262,11 @@ class EagerEngine:
         self._inflight_names: set = set()
         self._names_lock = threading.Lock()
         self._noname_seq = 0
+        # Telemetry bookkeeping: submit timestamps (dispatch/completion
+        # latency histograms) and per-signature wire-byte plans for the
+        # fused path (computed once per cache key, charged per call).
+        self._submit_ts: Dict[str, float] = {}
+        self._wire_plan_bytes: Dict[str, Dict[str, int]] = {}
         # Finalizer pool: completion (stall tracking, timeline end, name
         # release) is tied to *buffer readiness*, not dispatch return —
         # the reference's async-completion model, where FinalizeGPUQueue
@@ -284,6 +334,8 @@ class EagerEngine:
             fn = self._cache.get(skey)
             if fn is not None:
                 self._lru.lookup(skey)  # touch
+        if _METRICS_ON:
+            (_M_CACHE_HIT if fn is not None else _M_CACHE_MISS).inc()
         if fn is None:
             fn = builder()
             with self._cache_lock:
@@ -602,6 +654,8 @@ class EagerEngine:
             time.sleep(0.001)
         if self.stall is not None:
             self.stall.record_submit(full)
+        if _METRICS_ON:
+            self._submit_ts[full] = time.perf_counter()
         # Chaos site "collective_stall": delay AFTER record_submit so the
         # stall inspector sees a genuinely in-flight collective age past
         # its thresholds (trips the watchdog, not a synthetic error).
@@ -615,6 +669,11 @@ class EagerEngine:
             return
         with self._names_lock:
             self._inflight_names.discard(full)
+        if _METRICS_ON:
+            t0 = self._submit_ts.pop(full, None)
+            if t0 is not None:
+                _M_COMPLETE.labels(op=full.split(".", 1)[0]).observe(
+                    time.perf_counter() - t0)
         if self.stall is not None:
             self.stall.record_complete(full)
         if self.timeline is not None:
@@ -626,6 +685,14 @@ class EagerEngine:
         actually ready on device (finalizer-thread model, see __init__)."""
         if full is None:
             return result
+        if _METRICS_ON:
+            # Dispatch latency: submit to async-dispatch return (the
+            # host-side cost of the call; completion latency is observed
+            # by _end once the finalizer sees the buffers ready).
+            t0 = self._submit_ts.get(full)
+            if t0 is not None:
+                _M_DISPATCH.labels(op=full.split(".", 1)[0]).observe(
+                    time.perf_counter() - t0)
 
         def waiter():
             try:
@@ -649,6 +716,81 @@ class EagerEngine:
         if self.autotuner is not None:
             return self.autotuner.current
         return self.config.fusion_threshold_bytes
+
+    # -- telemetry: raw-vs-wire byte accounting ----------------------------
+
+    def _count_allreduce_bytes(self, dt, compression, quant, small_bf16,
+                               wire, nbytes: int) -> None:
+        """Per-process payload bytes for one eager allreduce, raw vs
+        what actually crosses the wire (mirrors the dispatch path's
+        wire decision, including the cast compressors)."""
+        elems = int(np.prod(dt.shape[1:]) or 1)
+        if quant:
+            label = wire or fusion_lib.WIRE_INT8
+            wire_bytes = _wire_bytes_int8(elems)
+        elif small_bf16:
+            label, wire_bytes = fusion_lib.WIRE_BF16, elems * 2
+        else:
+            wd = getattr(compression, "wire_dtype", None)
+            if wd is not None and dt.dtype in (jnp.float32, jnp.float64):
+                label = ("fp16" if wd == jnp.float16
+                         else fusion_lib.WIRE_BF16)
+                wire_bytes = elems * jnp.dtype(wd).itemsize
+            else:
+                label, wire_bytes = fusion_lib.WIRE_NONE, nbytes
+        _M_BYTES.labels(op="allreduce", kind="raw").inc(nbytes)
+        _M_BYTES.labels(op="allreduce", kind="wire").inc(wire_bytes)
+        _M_AR_WIRE.labels(wire=label).inc(wire_bytes)
+
+    def _count_grouped_bytes(self, skey: str, leaves, threshold: int,
+                             quant: bool, qmin, compression) -> None:
+        """Fused-path byte accounting: the per-bucket wire decision is a
+        pure function of the cache key, so it is computed ONCE per
+        signature (over ShapeDtypeStructs — no device work) and charged
+        per call."""
+        totals = self._wire_plan_bytes.get(skey)
+        if totals is None:
+            tmpl = [jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+                    for l in leaves]
+            # _telemetry=False: this plan only PRICES the program the
+            # build traces (which plans — and is counted — itself).
+            plan = fusion_lib.plan_fusion(tmpl, threshold,
+                                          _telemetry=False)
+            if quant:
+                plan = fusion_lib.assign_wire_dtypes(plan, qmin,
+                                                     _telemetry=False)
+                wires = plan.wire_dtypes
+            else:
+                wd = getattr(compression, "wire_dtype", None)
+                cast = ("fp16" if wd == jnp.float16
+                        else fusion_lib.WIRE_BF16) if wd is not None \
+                    else fusion_lib.WIRE_NONE
+                wires = tuple(
+                    cast if np.dtype(b.dtype) in (np.float32, np.float64)
+                    else fusion_lib.WIRE_NONE for b in plan.buckets)
+            per_wire: Dict[str, int] = {}
+            raw_total = 0
+            for b, w in zip(plan.buckets, wires):
+                dtb = np.dtype(b.dtype)
+                raw = b.total_elems * dtb.itemsize
+                raw_total += raw
+                if w == fusion_lib.WIRE_INT8:
+                    wb = _wire_bytes_int8(b.total_elems)
+                elif w in (fusion_lib.WIRE_BF16, "fp16"):
+                    wb = b.total_elems * 2
+                else:
+                    wb = raw
+                per_wire[w] = per_wire.get(w, 0) + wb
+            totals = {"raw": raw_total, "per_wire": per_wire}
+            if len(self._wire_plan_bytes) > 4096:  # parallel to the LRU
+                self._wire_plan_bytes.clear()
+            self._wire_plan_bytes[skey] = totals
+        _M_BYTES.labels(op="grouped_allreduce", kind="raw").inc(
+            totals["raw"])
+        _M_BYTES.labels(op="grouped_allreduce", kind="wire").inc(
+            sum(totals["per_wire"].values()))
+        for label, wb in totals["per_wire"].items():
+            _M_AR_WIRE.labels(wire=label).inc(wb)
 
     # -- collectives -------------------------------------------------------
 
@@ -703,6 +845,9 @@ class EagerEngine:
                           and dt.dtype.itemsize > 2)
             wire = (getattr(compression, "wire", None) if quant
                     else ("bf16" if small_bf16 else None))
+            if _METRICS_ON:
+                self._count_allreduce_bytes(dt, compression, quant,
+                                            small_bf16, wire, nbytes)
             key = ("ar", dt.shape, str(dt.dtype), int(op), prescale_factor,
                    postscale_factor, compression.__name__, wire, hier)
 
@@ -854,6 +999,9 @@ class EagerEngine:
             key = ("art", shapes, int(op), compression.__name__,
                    getattr(compression, "wire", None) if quant else None,
                    qmin, threshold, prescale_factor, postscale_factor)
+            if _METRICS_ON:
+                self._count_grouped_bytes(repr(key), leaves, threshold,
+                                          quant, qmin, compression)
 
             def build():
                 cast_comp = (NoneCompressor if getattr(
@@ -960,6 +1108,10 @@ class EagerEngine:
                 dt = self._as_distributed(x)
                 hier = (self.config.hierarchical_allgather
                         and self.hier_mesh is not None)
+                if _METRICS_ON:
+                    _count_simple_bytes(
+                        "allgather",
+                        int(np.prod(dt.shape[1:]) or 1) * dt.dtype.itemsize)
                 key = ("ag", dt.shape, str(dt.dtype), hier)
 
                 if hier:
@@ -1045,6 +1197,10 @@ class EagerEngine:
         try:
             self._negotiate("broadcast", full, x, root_rank=root_rank)
             dt = self._as_distributed(x)
+            if _METRICS_ON:
+                _count_simple_bytes(
+                    "broadcast",
+                    int(np.prod(dt.shape[1:]) or 1) * dt.dtype.itemsize)
             key = ("bc", dt.shape, str(dt.dtype), root_rank)
 
             def build():
@@ -1070,6 +1226,10 @@ class EagerEngine:
         try:
             self._negotiate("alltoall", full, x)
             dt = self._as_distributed(x)
+            if _METRICS_ON:
+                _count_simple_bytes(
+                    "alltoall",
+                    int(np.prod(dt.shape[1:]) or 1) * dt.dtype.itemsize)
             key = ("a2a", dt.shape, str(dt.dtype))
 
             def build():
@@ -1268,6 +1428,10 @@ class EagerEngine:
         try:
             self._negotiate("reducescatter", full, x, reduce_op=int(op))
             dt = self._as_distributed(x)
+            if _METRICS_ON:
+                _count_simple_bytes(
+                    "reducescatter",
+                    int(np.prod(dt.shape[1:]) or 1) * dt.dtype.itemsize)
             key = ("rs", dt.shape, str(dt.dtype), int(op))
 
             def build():

@@ -27,8 +27,90 @@ import jax
 import jax.numpy as jnp
 
 from .common import fusion as fusion_lib
+from .common import metrics as metrics_lib
 from .ops import collectives as C
 from .ops.compression import NoneCompressor
+
+# Unified telemetry (docs/metrics.md): host-side step timing. The
+# grad/comm/apply split cannot be observed from inside one jitted step
+# (XLA owns the schedule) — StepTimer below times phases at dispatch
+# boundaries and bridges them into jax.profiler traces; AutotunedStepper
+# records the end-to-end step wall time it already measures for tuning.
+_METRICS_ON = metrics_lib.enabled()
+_M_STEP = metrics_lib.histogram(
+    "hvd_tpu_step_seconds",
+    "end-to-end training step wall time (AutotunedStepper, blocked)")
+_M_PHASE = metrics_lib.histogram(
+    "hvd_tpu_step_phase_seconds",
+    "per-phase step wall time from StepTimer (grad/comm/apply/...)",
+    labels=("phase",))
+_M_EF_NORM = metrics_lib.gauge(
+    "hvd_tpu_ef_residual_norm",
+    "global L2 norm of the error-feedback quantization residual "
+    "(observe_ef_residual)")
+_M_REBUILDS = metrics_lib.counter(
+    "hvd_tpu_autotune_rebuilds_total",
+    "step-function rebuilds triggered by autotuner point moves")
+
+
+class StepTimer:
+    """Host-side step-phase breakdown — the grad/comm/apply split of
+    docs/metrics.md. Each phase records into the
+    ``hvd_tpu_step_phase_seconds`` histogram and, when the
+    metrics↔timeline bridge is on (``HVD_TPU_METRICS_TRACE=1``), the
+    same span is emitted as a ``jax.profiler.TraceAnnotation`` so it
+    lines up with the device-side XLA trace.
+
+    Because JAX dispatch is async, a phase only measures real work if
+    its outputs are forced before the block exits — use :meth:`timed`
+    (which blocks on the result) or block yourself inside ``phase``::
+
+        st = hvd.StepTimer()
+        grads = st.timed("grad", grad_fn, params, batch)
+        reduced = st.timed("comm", hvd.grouped_allreduce, grads)
+        with st.phase("apply"):
+            params = optax.apply_updates(params, updates)
+            jax.block_until_ready(params)
+
+    Zero-cost when metrics are disabled (every call lands on the no-op
+    singleton)."""
+
+    def __init__(self, name: str = "hvd_step"):
+        self.name = name
+
+    def phase(self, phase: str):
+        """Context manager timing one named phase."""
+        return _M_PHASE.labels(phase=phase).time(
+            annotation=f"{self.name}/{phase}"
+            if metrics_lib.registry().trace_bridge else None)
+
+    def timed(self, phase: str, fn, *args, **kwargs):
+        """Run ``fn`` and block until its outputs are ready, recording
+        the elapsed wall time under ``phase``."""
+        with self.phase(phase):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        return out
+
+
+def observe_ef_residual(state) -> Optional[float]:
+    """Global L2 norm of an error-feedback residual (the ``_EFState`` /
+    ``_EFShardState`` carried by the ``int8_ef`` surfaces), published as
+    the ``hvd_tpu_ef_residual_norm`` gauge. Host-side — fetches the
+    residual leaves, so call it at checkpoint/eval cadence, not every
+    step. Returns the norm, or None if ``state`` carries no residual."""
+    residual = getattr(state, "residual", None)
+    if residual is None:
+        return None
+    import numpy as np
+
+    total = 0.0
+    for leaf in jax.tree.leaves(residual):
+        a = np.asarray(jax.device_get(leaf)).astype(np.float64)
+        total += float((a * a).sum())
+    norm = float(total ** 0.5)
+    _M_EF_NORM.set(norm)
+    return norm
 
 
 def _check_reduce_safe(compression) -> None:
@@ -601,6 +683,7 @@ class AutotunedStepper:
                       else "none")
         self._step = self._rebuild()
         self.rebuilds = 0
+        self._step_count = 0  # metrics/profiler step numbering
 
     def _rebuild(self):
         if self._joint_comp:
@@ -631,11 +714,17 @@ class AutotunedStepper:
     def __call__(self, *args, **kwargs):
         import time
 
+        self._step_count += 1
         t0 = time.perf_counter()
-        out = self._step(*args, **kwargs)
-        if self.block:
-            jax.block_until_ready(out)
+        # metrics<->timeline bridge: a StepTraceAnnotation per step when
+        # HVD_TPU_METRICS_TRACE=1, so device-side traces group by step.
+        with metrics_lib.step_annotation(self._step_count):
+            out = self._step(*args, **kwargs)
+            if self.block:
+                jax.block_until_ready(out)
         dt = time.perf_counter() - t0
+        if _METRICS_ON:
+            _M_STEP.observe(dt)
         c = self._controller
         if c is None or c.size == 1:
             new, tuner_h, tuner_o, tuner_c = self.tuner.feed_quad(
@@ -680,6 +769,7 @@ class AutotunedStepper:
                 new, new_h, new_o, new_c
             self._step = self._rebuild()
             self.rebuilds += 1
+            _M_REBUILDS.inc()
         return out
 
 

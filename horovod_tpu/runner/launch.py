@@ -42,6 +42,11 @@ def build_env_for_slot(base_env: Dict[str, str], coordinator: str,
     env["HVD_TPU_COORDINATOR"] = coordinator
     env["HVD_TPU_NUM_PROC"] = str(num_proc)
     env["HVD_TPU_PROC_ID"] = str(proc_id)
+    if num_proc > 1 and env.get("HVD_TPU_METRICS_FILE"):
+        # One JSON-lines dump per worker: N processes appending
+        # snapshots to one file would interleave rank states.
+        env["HVD_TPU_METRICS_FILE"] = \
+            f"{env['HVD_TPU_METRICS_FILE']}.{proc_id}"
     if extra:
         env.update(extra)
     return env
@@ -325,6 +330,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    choices=["none", "fp16", "bf16"])
     p.add_argument("--autotune", action="store_true")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve the Prometheus /metrics endpoint on each "
+                        "worker (0 = ephemeral, logged at init; exported "
+                        "as HVD_TPU_METRICS_PORT — docs/metrics.md). "
+                        "With >1 worker per host pass 0: a fixed port "
+                        "would collide")
+    p.add_argument("--metrics-file", default=None,
+                   help="per-worker metrics JSON-lines dump path "
+                        "(.<rank> is appended in multi-proc runs; "
+                        "HVD_TPU_METRICS_FILE)")
     p.add_argument("--log-level", default=None)
     # Elastic (reference launch.py elastic flags).
     p.add_argument("--elastic", action="store_true")
@@ -428,6 +443,10 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_AUTOTUNE"] = "1"
     if args.autotune_log_file:
         env["HVD_TPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.metrics_port is not None:
+        env["HVD_TPU_METRICS_PORT"] = str(args.metrics_port)
+    if args.metrics_file:
+        env["HVD_TPU_METRICS_FILE"] = args.metrics_file
     if args.log_level:
         env["HVD_TPU_LOG_LEVEL"] = args.log_level
     if args.elastic:

@@ -27,9 +27,11 @@ import json
 import logging
 import os
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
+
+from ..common.httpd import BackgroundHTTPServer
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -137,46 +139,37 @@ class _Handler(BaseHTTPRequestHandler):
 
 class RendezvousServer:
     """Reference: http/http_server.py RendezvousServer (start/stop,
-    ephemeral port)."""
+    ephemeral port). The serve-forever-on-a-daemon-thread lifecycle is
+    the shared ``common/httpd.BackgroundHTTPServer`` (the metrics
+    ``/metrics`` endpoint rides the same plumbing)."""
 
     def __init__(self, host: str = "0.0.0.0",
                  secret: Optional[bytes] = None):
-        self._host = host
         self._secret = secret if secret is not None else _env_secret()
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._http = BackgroundHTTPServer(_Handler, host=host)
 
     def start(self, port: int = 0) -> int:
-        self._server = ThreadingHTTPServer((self._host, port), _Handler)
-        self._server.kv_store = {}          # type: ignore[attr-defined]
-        self._server.kv_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._server.kv_secret = self._secret  # type: ignore[attr-defined]
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self._server.server_address[1]
+        return self._http.start(port, kv_store={},
+                                kv_lock=threading.Lock(),
+                                kv_secret=self._secret)
 
     @property
     def port(self) -> int:
-        assert self._server is not None
-        return self._server.server_address[1]
+        return self._http.port
 
     def stop(self) -> None:
-        if self._server:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        self._http.stop()
 
     # Direct (in-process) access for the driver side.
     def put(self, scope: str, key: str, value: bytes) -> None:
-        assert self._server is not None
-        with self._server.kv_lock:  # type: ignore[attr-defined]
-            self._server.kv_store[f"/kv/{scope}/{key}"] = value  # type: ignore
+        srv = self._http.server
+        with srv.kv_lock:  # type: ignore[attr-defined]
+            srv.kv_store[f"/kv/{scope}/{key}"] = value  # type: ignore
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
-        assert self._server is not None
-        with self._server.kv_lock:  # type: ignore[attr-defined]
-            return self._server.kv_store.get(f"/kv/{scope}/{key}")  # type: ignore
+        srv = self._http.server
+        with srv.kv_lock:  # type: ignore[attr-defined]
+            return srv.kv_store.get(f"/kv/{scope}/{key}")  # type: ignore
 
 
 class RendezvousClient:

@@ -1,0 +1,114 @@
+"""Timeline writer-thread lifecycle (the flush contract the top-level
+start_timeline/stop_timeline surface promises): begin/end pairing in
+the emitted chrome-trace JSON, drain-on-stop (no dropped tail events),
+double-stop idempotence, and restartability. Forces the Python
+queue+thread writer (use_native=False) — the path these guarantees
+live in."""
+
+import json
+import threading
+
+import pytest
+
+from horovod_tpu.common.timeline import Timeline
+
+
+def _make(tmp_path, name="tl.json"):
+    t = Timeline(use_native=False)
+    path = str(tmp_path / name)
+    t.start(path)
+    return t, path
+
+
+def _load(path):
+    with open(path) as f:
+        data = json.load(f)  # file must be valid JSON after stop()
+    return data["traceEvents"]
+
+
+def test_begin_end_pairing(tmp_path):
+    t, path = _make(tmp_path)
+    t.begin("allreduce.x", "ALLREDUCE")
+    t.end("allreduce.x", "ALLREDUCE")
+    t.instant("MARK")
+    t.stop()
+    events = _load(path)
+    b = [e for e in events if e["ph"] == "B"]
+    e = [e for e in events if e["ph"] == "E"]
+    assert len(b) == 1 and len(e) == 1
+    assert b[0]["cat"] == e[0]["cat"] == "allreduce.x"
+    assert b[0]["name"] == "ALLREDUCE"
+    assert b[0]["ts"] <= e[0]["ts"]
+    assert [ev["name"] for ev in events if ev["ph"] == "i"] == ["MARK"]
+
+
+def test_drain_on_stop_no_dropped_tail(tmp_path):
+    """Every event enqueued before stop() must reach the file: stop()
+    sends the writer sentinel AFTER the tail events (FIFO), and the
+    join waits for the writer to drain the queue."""
+    t, path = _make(tmp_path)
+    n = 500
+    for i in range(n):
+        t.begin(f"t{i}", "QUEUE")
+        t.end(f"t{i}", "QUEUE")
+    t.stop()  # immediately — the writer must still drain all 2n events
+    events = _load(path)
+    assert len(events) == 2 * n
+    # Pairing survives the drain: one B and one E per tensor.
+    per = {}
+    for ev in events:
+        per.setdefault(ev["cat"], []).append(ev["ph"])
+    assert all(phs == ["B", "E"] for phs in per.values())
+
+
+def test_double_stop_idempotent(tmp_path):
+    t, path = _make(tmp_path)
+    t.begin("x", "QUEUE")
+    t.end("x", "QUEUE")
+    t.stop()
+    events_first = _load(path)
+    t.stop()  # second stop: no error, no file corruption
+    assert _load(path) == events_first
+    assert not t.active
+    # Stop on a never-started timeline is also a no-op.
+    t2 = Timeline(use_native=False)
+    t2.stop()
+
+
+def test_concurrent_stops_single_drain(tmp_path):
+    """stop() racing from two threads (user thread + Context.shutdown)
+    must not double-send the sentinel or corrupt the tail."""
+    t, path = _make(tmp_path)
+    for i in range(100):
+        t.begin(f"c{i}", "QUEUE")
+        t.end(f"c{i}", "QUEUE")
+    threads = [threading.Thread(target=t.stop) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(_load(path)) == 200
+
+
+def test_restart_after_stop_writes_new_file(tmp_path):
+    t, p1 = _make(tmp_path, "first.json")
+    t.begin("a", "QUEUE")
+    t.end("a", "QUEUE")
+    t.stop()
+    p2 = str(tmp_path / "second.json")
+    t.start(p2)
+    t.begin("b", "QUEUE")
+    t.end("b", "QUEUE")
+    t.stop()
+    assert {e["cat"] for e in _load(p1)} == {"a"}
+    assert {e["cat"] for e in _load(p2)} == {"b"}
+
+
+def test_events_after_stop_are_dropped(tmp_path):
+    t, path = _make(tmp_path)
+    t.begin("x", "QUEUE")
+    t.end("x", "QUEUE")
+    t.stop()
+    t.begin("late", "QUEUE")  # inactive: silently ignored
+    t.end("late", "QUEUE")
+    assert {e["cat"] for e in _load(path)} == {"x"}

@@ -12,13 +12,26 @@ track (``thread_name`` metadata): TPU device processes expose separate
 the historical cross-track aggregation (consumers:
 ``perf_evidence.py`` looks up ``jit_train_step`` there — a MODULES
 track event); the sharper per-HLO-op breakdown the r03 summary lacked
-is emitted separately as ``device_top_xla_ops``. Usage:
+is emitted separately as ``device_top_xla_ops``. Captures without an
+ops track degrade gracefully (a ``note`` in the output, rc 0) instead
+of being assumed to have one.
 
-    python tools/analyze_trace.py results/tpu_r05/trace_resnet50
+``--metrics FILE`` additionally merges a metrics JSON-lines dump
+(``HVD_TPU_METRICS_FILE`` — the unified-telemetry registry,
+docs/metrics.md): the last snapshot's step-time histogram, wire-byte
+mix, cache hit rate, and fusion fill land next to the device-trace
+numbers, and a merged ``per_step`` report compares the host-side step
+histogram against the device Steps track. With ``--metrics`` the trace
+itself is optional — a metrics-only report still prints (message,
+rc 0). Usage:
+
+    python tools/analyze_trace.py results/tpu_r05/trace_resnet50 \
+        [--metrics results/metrics.jsonl]
 
 Prints ONE JSON object.
 """
 
+import argparse
 import glob
 import gzip
 import json
@@ -28,15 +41,79 @@ import sys
 from collections import defaultdict
 
 
-def find_trace(root: str) -> str:
+def find_trace(root: str):
     cands = sorted(glob.glob(os.path.join(
         root, "plugins", "profile", "*", "*.trace.json.gz")))
     if not cands:
         cands = sorted(glob.glob(os.path.join(root,
                                               "*.trace.json.gz")))
-    if not cands:
-        raise SystemExit(f"no *.trace.json.gz under {root}")
-    return cands[-1]  # newest capture
+    return cands[-1] if cands else None  # newest capture
+
+
+def load_metrics_snapshot(path: str):
+    """Last snapshot from a metrics JSON-lines dump ({"t":..,
+    "metrics": {...}} per line; malformed lines skipped)."""
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "metrics" in rec:
+                    last = rec
+    except OSError:
+        return None
+    return last
+
+
+def summarize_metrics(rec: dict) -> dict:
+    """Condense one registry snapshot to the trace-adjacent numbers."""
+    snap = rec.get("metrics", {})
+
+    def samples(name):
+        return snap.get(name, {}).get("samples", [])
+
+    out = {"snapshot_unix": rec.get("t")}
+    hist = next(iter(samples("hvd_tpu_step_seconds")), None)
+    if hist and isinstance(hist.get("value"), dict) \
+            and hist["value"].get("count"):
+        v = hist["value"]
+        out["step_seconds"] = {
+            "count": v["count"],
+            "mean_ms": round(1000.0 * v["sum"] / v["count"], 3),
+        }
+    phases = {}
+    for s in samples("hvd_tpu_step_phase_seconds"):
+        v = s.get("value")
+        if isinstance(v, dict) and v.get("count"):
+            phases[s["labels"].get("phase", "?")] = round(
+                1000.0 * v["sum"] / v["count"], 3)
+    if phases:
+        out["step_phase_mean_ms"] = phases
+    wire = {s["labels"].get("wire", "?"): s["value"]
+            for s in samples("hvd_tpu_allreduce_bytes_total")
+            if s["value"]}
+    if wire:
+        out["allreduce_bytes_on_wire"] = wire
+    cache = {s["labels"].get("result", "?"): s["value"]
+             for s in samples("hvd_tpu_eager_cache_total")}
+    if sum(cache.values()):
+        out["cache_hit_rate"] = round(
+            cache.get("hit", 0) / sum(cache.values()), 3)
+    fill = samples("hvd_tpu_fusion_fill_efficiency")
+    if fill:
+        out["fusion_fill_efficiency"] = fill[0]["value"]
+    rec_counts = {s["labels"].get("counter", "?"): int(s["value"])
+                  for s in samples("hvd_tpu_recovery_total")
+                  if s["value"]}
+    if rec_counts:
+        out["recovery"] = rec_counts
+    return out
 
 
 def _track_kind(thread_name: str) -> str:
@@ -51,8 +128,22 @@ def _track_kind(thread_name: str) -> str:
     return "other"
 
 
-def main(root: str) -> int:
+def main(root: str, metrics_path: str = None) -> int:
+    metrics_rec = (load_metrics_snapshot(metrics_path)
+                   if metrics_path else None)
     path = find_trace(root)
+    if path is None:
+        if metrics_rec is not None:
+            # Metrics-only degrade: the dump still answers "where did
+            # time/bytes go" even when no device capture exists.
+            out = {"note": f"no *.trace.json.gz under {root}; "
+                           "metrics-only report",
+                   "metrics": summarize_metrics(metrics_rec)}
+            print(json.dumps(out, indent=2))
+            return 0
+        print(json.dumps({"note": f"no *.trace.json.gz under {root} "
+                                  "and no --metrics file"}, indent=2))
+        return 0
     with gzip.open(path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
@@ -171,6 +262,12 @@ def main(root: str) -> int:
              "count": op_count["ops"][n],
              "pct_of_ops_track": round(100 * t / ops_total, 1)}
             for n, t in sorted(ops.items(), key=lambda kv: -kv[1])[:20]]
+    else:
+        # Graceful degrade: unnamed-track captures have no "XLA Ops"
+        # track; say so instead of pretending the per-op view exists.
+        out["note"] = ("no XLA Ops track in this capture; per-HLO-op "
+                       "breakdown unavailable (busy/infeed shares use "
+                       "the flagged fallback bases)")
     if step_durs:
         step_durs.sort()
         n = len(step_durs)
@@ -182,9 +279,36 @@ def main(root: str) -> int:
             "p50_ms": round(statistics.median(step_durs) / 1000, 3),
             "max_ms": round(step_durs[-1] / 1000, 3),
         }
+    if metrics_rec is not None:
+        mx = summarize_metrics(metrics_rec)
+        out["metrics"] = mx
+        # Merged per-step report: host-side step histogram (registry)
+        # next to the device Steps track — a gap between them is host
+        # overhead / dispatch serialization the device trace can't see.
+        per_step = {}
+        if "steps" in out:
+            per_step["trace_p50_ms"] = out["steps"]["p50_ms"]
+            per_step["trace_mean_ms"] = out["steps"]["mean_ms"]
+        if "step_seconds" in mx:
+            per_step["metrics_mean_ms"] = mx["step_seconds"]["mean_ms"]
+        if "step_phase_mean_ms" in mx:
+            per_step["phase_mean_ms"] = mx["step_phase_mean_ms"]
+        if "trace_mean_ms" in per_step and "metrics_mean_ms" in per_step:
+            per_step["host_overhead_ms"] = round(
+                per_step["metrics_mean_ms"] - per_step["trace_mean_ms"],
+                3)
+        if per_step:
+            out["per_step"] = per_step
     print(json.dumps(out, indent=2))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "."))
+    p = argparse.ArgumentParser()
+    p.add_argument("root", nargs="?", default=".",
+                   help="profile dir from bench.py --profile-dir")
+    p.add_argument("--metrics", default=None,
+                   help="metrics JSON-lines file (HVD_TPU_METRICS_FILE) "
+                        "to merge into the report")
+    args = p.parse_args()
+    sys.exit(main(args.root, metrics_path=args.metrics))
