@@ -11,6 +11,15 @@ Demonstrates the canonical single-controller SPMD recipe:
 Run on anything: real TPU (1+ chips) or the CPU loopback mesh:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   JAX_PLATFORMS=cpu python examples/mnist_train.py --epochs 2
+
+``--guard`` additionally demonstrates the training-integrity guard
+(docs/integrity.md): the loss is computed through a deliberately
+overflow-prone fp16 cast scaled by the guard's dynamic loss scale
+(``scale_backoff`` policy — the first steps overflow fp16 and the scale
+backs off until gradients fit), and a seeded fault plan injects a NaN
+batch mid-run that the ``skip_step``-style cond skips identically on
+every rank with optimizer state untouched. The recovery is visible in
+the final metrics snapshot (``hvd_tpu_nonfinite_steps_total``).
 """
 
 import argparse
@@ -53,15 +62,35 @@ def main():
                     help="global batch (must divide by world size)")
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_mnist_ckpt")
+    ap.add_argument("--guard", action="store_true",
+                    help="demo the training-integrity guard: "
+                         "scale_backoff dynamic loss scaling over an "
+                         "overflow-prone fp16 loss + one injected NaN "
+                         "batch (docs/integrity.md)")
     args = ap.parse_args()
 
+    if args.guard:
+        import os as os_mod
+
+        # Seeded chaos: poison ONE batch with a NaN mid-run; the guard
+        # must skip that step identically on every rank.
+        os_mod.environ.setdefault(
+            "HVD_TPU_FAULT_PLAN",
+            '{"seed": 0, "faults": [{"site": "nonfinite", "step": 5}]}')
+        # Start the backoff at 2^17: with a ~2.3 nats initial loss the
+        # fp16 product overflows (inf), so the first steps SKIP and the
+        # scale halves until gradients fit — the backoff is visible in
+        # the log below.
+        os_mod.environ.setdefault("HVD_TPU_SCALE_INIT", str(2.0 ** 17))
     hvd.init()
     n, ax = hvd.size(), hvd.rank_axis()
     x, y = synthetic_mnist()
 
     model = ConvNet(num_classes=10)
     params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
-    tx = hvd.DistributedOptimizer(optax.adam(args.lr), axis_name=ax)
+    tx = hvd.DistributedOptimizer(
+        optax.adam(args.lr), axis_name=ax,
+        nonfinite_policy="scale_backoff" if args.guard else None)
     opt_state = tx.init(params)
 
     @hvd.spmd_step(in_specs=(P(), P(), P(), P(ax), P(ax)),
@@ -69,14 +98,26 @@ def main():
     def train_step(p, st, lr_scale, xb, yb):
         def loss_fn(p):
             logits = model.apply({"params": p}, xb)
-            return optax.softmax_cross_entropy_with_integer_labels(
+            l = optax.softmax_cross_entropy_with_integer_labels(
                 logits, yb).mean()
+            if args.guard:
+                # Deliberately overflow-prone fp16-ish loss scaling:
+                # the guard unscales the gradients by the SAME dynamic
+                # scale it carries, skips the overflowed steps, and
+                # backs the scale off until the product fits fp16.
+                scale = hvd.current_loss_scale(st)
+                return (l.astype(jnp.float16)
+                        * scale.astype(jnp.float16)).astype(jnp.float32)
+            return l
 
+        scale0 = hvd.current_loss_scale(st)  # pre-update scale
         l, g = jax.value_and_grad(loss_fn)(p)
         updates, st = tx.update(g, st, p)
         # Scale the *updates*, not the gradients: Adam is invariant to
         # uniform gradient scaling, so warmup must act after the optimizer.
         updates = jax.tree.map(lambda u: u * lr_scale, updates)
+        if args.guard:
+            l = l / scale0  # log the UNSCALED loss (inf on overflow)
         return optax.apply_updates(p, updates), st, jax.lax.pmean(l, ax)
 
     trainer = types.SimpleNamespace(params=params, opt_state=opt_state,
@@ -97,19 +138,39 @@ def main():
         for b in range(steps_per_epoch):
             callbacks.on_batch_begin(b)
             sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            xb = jnp.asarray(x[sl])
+            if args.guard:
+                # Chaos site "nonfinite": the seeded plan poisons ONE
+                # batch; the guard skips that step on every rank.
+                xb = hvd.integrity.chaos_poison(xb)
             # lr_scale steers the compiled step from the host — no
             # recompile (the callback mutates trainer.lr each batch).
             lr_scale = jnp.float32(trainer.lr / args.lr)
             trainer.params, trainer.opt_state, loss = train_step(
-                trainer.params, trainer.opt_state, lr_scale, x[sl], y[sl])
-            losses.append(float(loss))
+                trainer.params, trainer.opt_state, lr_scale, xb, y[sl])
+            loss = float(loss)
+            if np.isfinite(loss):  # overflowed/skipped steps log no loss
+                losses.append(loss)
             callbacks.on_batch_end(b)
-        logs = {"loss": float(np.mean(losses))}
+        logs = {"loss": float(np.mean(losses)) if losses else float("nan")}
         callbacks.on_epoch_end(epoch, logs)
         if hvd.rank() == 0:
-            print(f"epoch {epoch}: loss={logs['loss']:.4f} "
-                  f"({time.perf_counter() - t0:.1f}s, {n} ranks)")
+            msg = (f"epoch {epoch}: loss={logs['loss']:.4f} "
+                   f"({time.perf_counter() - t0:.1f}s, {n} ranks)")
+            if args.guard:
+                snap = hvd.observe_guard(trainer.opt_state)
+                msg += (f" guard[skipped={snap['nonfinite_steps']} "
+                        f"loss_scale={snap['loss_scale']:.0f}]")
+            print(msg)
     callbacks.on_train_end()
+    if args.guard and hvd.rank() == 0:
+        # The injected-NaN recovery on the metrics surface: observe_guard
+        # published the skip count into the registry.
+        snap = hvd.observe_guard(trainer.opt_state)
+        nf = hvd.metrics().get("hvd_tpu_nonfinite_steps_total", {})
+        print(f"guard summary: {snap}")
+        print(f"hvd_tpu_nonfinite_steps_total: "
+              f"{[s for s in nf.get('samples', []) if s['value']]}")
 
 
 if __name__ == "__main__":

@@ -44,8 +44,11 @@ from .common.basics import (ccl_built, cuda_built, ddl_built, gloo_built,
                             gloo_enabled, init, is_initialized, mpi_built,
                             mpi_enabled, mpi_threads_supported, nccl_built,
                             rocm_built, shutdown, tpu_available, xla_built)
-from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+from .common.exceptions import (CheckpointCorruptError, DivergenceError,
+                                HorovodInternalError, HostsUpdatedInterrupt,
+                                MismatchError, NonFiniteError,
                                 NotInitializedError, StallError,
+                                StallTimeoutError,
                                 TensorShapeMismatchError)
 from .ops import collectives as collective_ops
 from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
@@ -55,8 +58,11 @@ from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
                     StepTimer, broadcast_parameters, observe_ef_residual,
                     sharded_init, sharded_update)
+from .common import integrity
 from .common import metrics as _metrics_lib
 from .common.faults import recovery_stats
+from .common.integrity import (DivergenceDetector, current_loss_scale,
+                               observe_guard)
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
 
@@ -454,4 +460,7 @@ __all__ = [
     "ProcessSet", "add_process_set", "remove_process_set", "run",
     "recovery_stats", "metrics", "start_metrics_server",
     "stop_metrics_server", "StepTimer", "observe_ef_residual",
+    "integrity", "observe_guard", "current_loss_scale",
+    "DivergenceDetector", "MismatchError", "NonFiniteError",
+    "DivergenceError", "CheckpointCorruptError", "StallTimeoutError",
 ]

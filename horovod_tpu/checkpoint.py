@@ -16,15 +16,68 @@ Design notes (TPU-first):
 - In multi-process jobs every process participates (orbax coordinates
   per-shard writes); the ``rank0_only`` flag exists for the reference's
   single-writer semantics when saving replicated trees.
+- **Verified checkpoints** (docs/integrity.md): each finalized step
+  gets a CRC32C+size sidecar manifest (``hvd_integrity.json``, written
+  atomically via tmp + ``os.replace`` inside the step directory — orbax
+  itself already commits the step via atomic rename). ``restore()``
+  verifies against the sidecar and, on corruption (a torn write, a
+  flipped bit, a truncated payload), walks back through the last-good
+  chain instead of silently loading garbage; the SIGTERM preemption
+  commit (common/elastic.py → save_state) rides the same path. Results
+  land on ``hvd_tpu_checkpoint_verify_total{result=}`` and corruptions
+  bump RecoveryStats. The ``checkpoint_corrupt`` chaos site
+  (common/faults.py) corrupts a just-written step so the whole chain is
+  testable end to end.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import pickle
-from typing import Any, Optional
+import zlib
+from typing import Any, Dict, Optional
 
 import jax
+
+from .common import faults as faults_lib
+from .common import metrics as metrics_lib
+from .common.exceptions import CheckpointCorruptError
+
+logger = logging.getLogger("horovod_tpu")
+
+SIDECAR_NAME = "hvd_integrity.json"
+
+_M_VERIFY = metrics_lib.counter(
+    "hvd_tpu_checkpoint_verify_total",
+    "checkpoint integrity verifications by result (ok / corrupt / "
+    "missing sidecar)",
+    labels=("result",))
+for _r in ("ok", "corrupt", "missing"):
+    _M_VERIFY.labels(result=_r)
+del _r
+
+try:  # true CRC32C (the GCS/tensorstore checksum) when available
+    import google_crc32c as _crc32c_mod
+
+    _CRC_ALGO = "crc32c"
+
+    def _crc_file(path: str) -> str:
+        h = _crc32c_mod.Checksum()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.digest().hex()
+except ImportError:  # pragma: no cover — stdlib fallback
+    _CRC_ALGO = "crc32"
+
+    def _crc_file(path: str) -> str:
+        crc = 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = zlib.crc32(chunk, crc)
+        return f"{crc & 0xFFFFFFFF:08x}"
 
 
 class CheckpointManager:
@@ -42,10 +95,27 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 5,
                  save_interval_steps: int = 1,
-                 rank0_only: bool = False):
+                 rank0_only: bool = False,
+                 verify: Optional[bool] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        # Verified checkpoints (docs/integrity.md): None resolves the
+        # HVD_TPU_CHECKPOINT_VERIFY knob / init(checkpoint_verify=)
+        # default (True).
+        if verify is None:
+            from .common import basics
+
+            if basics.is_initialized():
+                verify = basics.context().config.checkpoint_verify
+            else:
+                from .common.config import _env_bool
+
+                verify = _env_bool("CHECKPOINT_VERIFY", True)
+        self.verify = bool(verify)
+        # Step chosen by the most recent restore() (after any verified
+        # walk-back) — lets callers pair host-side objects with it.
+        self.last_restored_step: Optional[int] = None
         self.directory = os.path.abspath(directory)
         if rank0_only:
             import warnings
@@ -82,13 +152,151 @@ class CheckpointManager:
         guarantees each shard is written exactly once (and replicated
         trees are written by their primary replica only). Restore is
         symmetric: every process calls restore() and receives the data,
-        covering the reference's broadcast-after-rank0-restore pattern."""
-        return self._mgr.save(
+        covering the reference's broadcast-after-rank0-restore pattern.
+
+        With ``verify`` on, every FINALIZED step additionally gets its
+        CRC+size sidecar manifest (written here for previously completed
+        async saves, and in :meth:`wait` once the in-flight ones land) —
+        saves stay async; only the cheap manifest write trails them."""
+        saved = self._mgr.save(
             step, args=self._ocp.args.StandardSave(tree), force=force)
+        if self.verify:
+            self._finalize_sidecars()
+            if saved and faults_lib.active():
+                spec = faults_lib.maybe_checkpoint_corrupt()
+                if spec is not None:
+                    # Chaos site "checkpoint_corrupt": finalize THIS
+                    # step, then corrupt it — the torn-write the
+                    # verified restore path must survive.
+                    self._mgr.wait_until_finished()
+                    self._finalize_sidecars()
+                    self._corrupt_step(step, spec.mode or "bitflip")
+        return saved
 
     def wait(self) -> None:
-        """Block until all in-flight async saves hit disk."""
+        """Block until all in-flight async saves hit disk (and, with
+        ``verify`` on, their integrity sidecars are written)."""
         self._mgr.wait_until_finished()
+        if self.verify:
+            self._finalize_sidecars()
+
+    # -- integrity sidecars (docs/integrity.md) ----------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _sidecar_path(self, step: int) -> str:
+        return os.path.join(self._step_dir(step), SIDECAR_NAME)
+
+    def _manifest(self, step: int) -> Dict[str, Dict[str, Any]]:
+        root = self._step_dir(step)
+        files: Dict[str, Dict[str, Any]] = {}
+        for dirpath, _dirs, names in os.walk(root):
+            for name in sorted(names):
+                if name == SIDECAR_NAME or name.endswith(".tmp"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root)
+                files[rel] = {"size": os.path.getsize(path),
+                              "crc": _crc_file(path)}
+        return files
+
+    def _finalize_sidecars(self) -> None:
+        """Write the CRC+size sidecar for every finalized step that
+        lacks one (orbax lists a step only after its atomic
+        rename-commit, so everything here is complete). Atomic: tmp +
+        os.replace — a crash mid-write leaves no half sidecar.
+        Multi-process: process 0 alone computes the manifests (one
+        CRC pass over the step, not N racing redundant ones)."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
+        for step in self._mgr.all_steps():
+            sidecar = self._sidecar_path(step)
+            if os.path.exists(sidecar):
+                continue
+            try:
+                payload = {"algo": _CRC_ALGO, "step": int(step),
+                           "files": self._manifest(step)}
+                tmp = sidecar + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, sidecar)
+            except OSError:  # sidecars are best-effort at write time;
+                pass         # restore treats a missing one as "missing"
+
+    def verify_step(self, step: int) -> str:
+        """Verify one step against its sidecar: ``"ok"`` | ``"corrupt"``
+        (size/CRC mismatch, missing payload file) | ``"missing"`` (no
+        sidecar — e.g. a pre-verification checkpoint; accepted with a
+        warning on restore). Emits
+        ``hvd_tpu_checkpoint_verify_total{result=}``."""
+        result = self._verify_quiet(step)
+        _M_VERIFY.labels(result=result).inc()
+        if result == "corrupt":
+            faults_lib.stats.bump("checkpoint_corruptions")
+        return result
+
+    def _verify_quiet(self, step: int) -> str:
+        sidecar = self._sidecar_path(step)
+        try:
+            with open(sidecar) as f:
+                payload = json.load(f)
+        except FileNotFoundError:
+            return "missing"
+        except (OSError, ValueError):
+            return "corrupt"
+        if payload.get("algo") != _CRC_ALGO:
+            # Mixed-algorithm directories (crc32c writer, zlib reader)
+            # cannot be verified — treat like a missing sidecar rather
+            # than flagging a healthy checkpoint corrupt.
+            return "missing"
+        root = self._step_dir(step)
+        for rel, meta in payload.get("files", {}).items():
+            path = os.path.join(root, rel)
+            try:
+                if os.path.getsize(path) != meta["size"]:
+                    return "corrupt"
+                if _crc_file(path) != meta["crc"]:
+                    return "corrupt"
+            except OSError:
+                return "corrupt"
+        return "ok"
+
+    def _corrupt_step(self, step: int, mode: str = "bitflip") -> None:
+        """Chaos helper (the ``checkpoint_corrupt`` injection site):
+        damage a finalized step — ``bitflip`` flips a byte in the
+        largest payload file, ``truncate`` halves it, ``sidecar``
+        corrupts the manifest itself."""
+        root = self._step_dir(step)
+        if mode == "sidecar":
+            try:
+                with open(self._sidecar_path(step), "w") as f:
+                    f.write("{corrupt")
+            except OSError:
+                pass
+            return
+        best, best_size = None, -1
+        for dirpath, _dirs, names in os.walk(root):
+            for name in names:
+                if name == SIDECAR_NAME:
+                    continue
+                p = os.path.join(dirpath, name)
+                s = os.path.getsize(p)
+                if s > best_size:
+                    best, best_size = p, s
+        if best is None:
+            return
+        logger.warning("chaos: corrupting checkpoint step %d (%s, %s)",
+                       step, mode, os.path.relpath(best, root))
+        if mode == "truncate":
+            with open(best, "r+b") as f:
+                f.truncate(max(best_size // 2, 0))
+        else:  # bitflip
+            with open(best, "r+b") as f:
+                f.seek(best_size // 2)
+                b = f.read(1)
+                f.seek(best_size // 2)
+                f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
 
     # -- read side ---------------------------------------------------------
 
@@ -103,12 +311,29 @@ class CheckpointManager:
         """Restore ``step`` (default: latest). ``target`` — an example tree
         (or abstract tree of jax.ShapeDtypeStruct) used to restore with
         matching shardings/dtypes; without it, arrays come back as numpy.
+
+        With ``verify`` on: the step is checked against its CRC+size
+        sidecar first. A corrupt LATEST step (torn write, bit rot) makes
+        the default restore walk back through the last-good chain —
+        oldest corruption logged, ``checkpoint_verify_total{result=
+        "corrupt"}`` bumped — and raises
+        :class:`CheckpointCorruptError` only when NO verified step
+        remains. An explicitly pinned ``step`` that fails verification
+        raises immediately (no silent substitution). Steps without a
+        sidecar (pre-verification checkpoints) restore with a warning.
         """
         if step is None:
-            step = self.latest_step()
+            step = self._latest_verified_step()
+        elif self.verify and self.verify_step(step) == "corrupt":
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} under {self.directory} failed "
+                f"integrity verification ({_CRC_ALGO}+size sidecar "
+                "mismatch); refusing to load a corrupt checkpoint that "
+                "was pinned explicitly")
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
+        self.last_restored_step = step
         if target is not None:
             abstract = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
@@ -123,6 +348,46 @@ class CheckpointManager:
         # CheckpointArgs (API drift in orbax >= 0.5).
         return self._mgr.restore(
             step, args=self._ocp.args.StandardRestore())
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Public twin of the walk-back resolver: the step a default
+        ``restore()`` would load. With ``verify`` off this is simply
+        the latest step."""
+        return self._latest_verified_step()
+
+    def _latest_verified_step(self) -> Optional[int]:
+        """Newest step that passes verification — the walk-back through
+        the last-good chain (corrupt steps are skipped with a warning,
+        never deleted: the operator may want forensics)."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            return None
+        if not self.verify:
+            return steps[0]
+        corrupt = []
+        for step in steps:
+            result = self.verify_step(step)
+            if result == "corrupt":
+                corrupt.append(step)
+                logger.warning(
+                    "checkpoint step %d failed integrity verification "
+                    "(%s+size sidecar mismatch); walking back to the "
+                    "previous verified step", step, _CRC_ALGO)
+                continue
+            if result == "missing":
+                logger.warning(
+                    "checkpoint step %d has no integrity sidecar "
+                    "(pre-verification checkpoint?); restoring "
+                    "unverified", step)
+            if corrupt:
+                logger.warning(
+                    "checkpoint: restored step %d after skipping "
+                    "corrupt step(s) %s", step, corrupt)
+            return step
+        raise CheckpointCorruptError(
+            f"every checkpoint under {self.directory} failed integrity "
+            f"verification (corrupt steps: {corrupt}); no last-good "
+            "step to fall back to")
 
     def close(self) -> None:
         self._mgr.close()
@@ -189,22 +454,53 @@ def save_state(state, directory: str, step: int,
     try:
         mgr.save(step, {"arrays": arrays}, force=True)
         mgr.wait()
+        kept_steps = mgr.all_steps()
     finally:
         mgr.close()
-    ObjectStore(directory).put("state_objects", {"step": step, **objects})
+    store = ObjectStore(directory)
+    # Step-scoped objects so the verified walk-back (a corrupt latest
+    # array step falling back to an earlier one) can pick up the
+    # MATCHING host objects; the unscoped name stays for compatibility.
+    store.put(f"state_objects_{step}", {"step": step, **objects})
+    store.put("state_objects", {"step": step, **objects})
+    # Prune step-scoped pickles alongside orbax's step GC — only steps
+    # that can still be walk-back targets are worth keeping.
+    import glob
+    import re as re_mod
+
+    live = {int(s) for s in kept_steps}
+    for path in glob.glob(os.path.join(store.directory,
+                                       "state_objects_*.pkl")):
+        m = re_mod.fullmatch(r"state_objects_(\d+)\.pkl",
+                             os.path.basename(path))
+        if m and int(m.group(1)) not in live:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
 
 def restore_state(state, directory: str) -> int:
-    """Inverse of :func:`save_state`; loads the latest step into ``state``
-    attributes and returns the step number."""
+    """Inverse of :func:`save_state`; loads the latest VERIFIED step
+    (docs/integrity.md walk-back) into ``state`` attributes and returns
+    the step number. On a walk-back the step-scoped host objects
+    matching the restored array step are loaded too, so arrays and
+    objects never mix commits."""
     mgr = CheckpointManager(directory)
     try:
+        # One restore call: the default path resolves (and verifies —
+        # once) the latest good step and records it on the manager.
         restored = mgr.restore()
+        target = getattr(mgr, "last_restored_step", None)
     finally:
         mgr.close()
     for k, v in restored["arrays"].items():
         setattr(state, k, v)
-    objs = ObjectStore(directory).get("state_objects", {})
+    store = ObjectStore(directory)
+    objs = store.get(f"state_objects_{target}") \
+        if target is not None else None
+    if objs is None:
+        objs = store.get("state_objects", {})
     step = objs.pop("step", 0)
     for k, v in objs.items():
         setattr(state, k, v)

@@ -105,7 +105,8 @@ class Context:
                                  config.timeline_mark_cycles)
         self.stall = StallInspector(config.stall_check_time_seconds,
                                     config.stall_shutdown_time_seconds,
-                                    config.stall_check_disable)
+                                    config.stall_check_disable,
+                                    fatal_mode=config.stall_fatal)
         # Reference polls CheckForStalledTensors each background cycle
         # (stall_inspector.cc:28+); here a daemon watchdog thread polls.
         self.stall.start_watchdog()

@@ -78,6 +78,26 @@ class Config:
     stall_check_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
     stall_check_disable: bool = False
+    # Fatal-stall escalation (docs/integrity.md): "raise" promotes a
+    # tripped shutdown threshold from the latched StallError to a typed
+    # StallTimeoutError that the elastic loop classifies as a comm
+    # failure — a hung collective aborts into elastic reset instead of
+    # wedging the run. Default None keeps the historical behavior.
+    stall_fatal: Optional[str] = None
+    # Training-integrity guard (common/integrity.py; docs/integrity.md).
+    # Non-finite gradient policy on the optimizer surfaces: None/"off"
+    # disables; "warn" | "skip_step" | "zero" | "scale_backoff" |
+    # "abort" select the globally-agreed reaction to a NaN/Inf gradient.
+    nonfinite_policy: Optional[str] = None
+    # Divergence detector cadence: check parameter fingerprints across
+    # ranks every N steps (0 = off).
+    diverge_check_steps: int = 0
+    # Divergence policy: "warn" | "abort" | "resync" (resync =
+    # broadcast params from rank 0, counted in RecoveryStats).
+    diverge_policy: str = "warn"
+    # Verified checkpoints: CRC+size sidecar written at save, verified
+    # at restore with walk-back through the last-good chain.
+    checkpoint_verify: bool = True
     # Timeline profiler (reference: HOROVOD_TIMELINE env).
     timeline_filename: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -160,6 +180,11 @@ class Config:
         c.stall_shutdown_time_seconds = _env_float(
             "STALL_SHUTDOWN_TIME_SECONDS", cls.stall_shutdown_time_seconds)
         c.stall_check_disable = _env_bool("STALL_CHECK_DISABLE", False)
+        c.stall_fatal = _env("STALL_FATAL")
+        c.nonfinite_policy = _env("NONFINITE_POLICY")
+        c.diverge_check_steps = _env_int("DIVERGE_CHECK_STEPS", 0)
+        c.diverge_policy = _env("DIVERGE_POLICY", "warn") or "warn"
+        c.checkpoint_verify = _env_bool("CHECKPOINT_VERIFY", True)
         c.timeline_filename = _env("TIMELINE")
         c.timeline_mark_cycles = _env_bool("TIMELINE_MARK_CYCLES", False)
         c.autotune = _env_bool("AUTOTUNE", False)

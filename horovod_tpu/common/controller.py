@@ -34,13 +34,19 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .exceptions import HorovodInternalError, TensorShapeMismatchError
+from .exceptions import (HorovodInternalError, MismatchError,
+                         TensorShapeMismatchError)
 
 
 @dataclasses.dataclass(frozen=True)
 class Request:
     """Reference: message.h:48-113 (Request: rank, type, dtype, shape,
-    name, root_rank, ...)."""
+    name, root_rank, ...). ``wire_dtype`` and ``process_set`` extend
+    the reference contract for this framework's integrity layer
+    (docs/integrity.md): two ranks agreeing on shape/dtype/op but
+    configured with different reduction compressions (or submitting
+    against different process sets) would compile different XLA
+    programs and hang just the same — so they negotiate too."""
 
     rank: int
     op_type: str          # "allreduce" | "allgather" | ...
@@ -49,21 +55,28 @@ class Request:
     shape: Tuple[int, ...]
     reduce_op: int = 0
     root_rank: int = -1
+    wire_dtype: str = ""   # reduction compression / wire decision tag
+    process_set: str = ""  # engine scope ("" == world)
 
     def signature(self) -> str:
         return json.dumps([self.op_type, self.tensor_name, self.dtype,
-                           list(self.shape), self.reduce_op, self.root_rank])
+                           list(self.shape), self.reduce_op,
+                           self.root_rank, self.wire_dtype,
+                           self.process_set])
 
     def encode(self) -> str:
         """Wire format for the KV round: the native codec (wire.cc) when
         built and the dtype/op are in its tables, else JSON. A one-char
         prefix tags the format so mixed availability across ranks still
-        interops (the decoder dispatches on it)."""
+        interops (the decoder dispatches on it). The integrity-contract
+        extension fields (wire_dtype / process_set) are not in the
+        native tables, so a request carrying them rides JSON."""
         import os
 
         from .. import native
 
         if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+                and not self.wire_dtype and not self.process_set
                 and native.available() and self.op_type in native.OP_CODES
                 and self.dtype in native.DTYPE_CODES):
             data = native.encode_request(
@@ -98,11 +111,17 @@ class Request:
 
 @dataclasses.dataclass
 class Response:
-    """Reference: message.h:145-244 (Response: type, names, error)."""
+    """Reference: message.h:145-244 (Response: type, names, error).
+    ``kind`` distinguishes the failure family ("mismatch" vs "timeout")
+    and ``ranks`` names the offending global ranks for mismatches —
+    both ride the JSON wire form only (the native codec carries the
+    reference triple; a response using them skips it)."""
 
     ok: bool
     tensor_name: str
     error: str = ""
+    kind: str = ""
+    ranks: Tuple[int, ...] = ()
 
     def encode(self) -> str:
         import os
@@ -110,12 +129,15 @@ class Response:
         from .. import native
 
         if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+                and not self.kind and not self.ranks
                 and native.available()):
             data = native.encode_response(self.ok, self.tensor_name,
                                           self.error)
             if data is not None:
                 return "w:" + base64.b64encode(data).decode()
-        return "j:" + json.dumps(dataclasses.asdict(self))
+        d = dataclasses.asdict(self)
+        d["ranks"] = list(self.ranks)
+        return "j:" + json.dumps(d)
 
     @classmethod
     def decode(cls, raw: str) -> "Response":
@@ -134,7 +156,8 @@ class Response:
                     f"undecodable wire response: {raw[:80]!r}")
             return cls(*tup)
         d = json.loads(raw[2:])
-        return cls(d["ok"], d["tensor_name"], d.get("error", ""))
+        return cls(d["ok"], d["tensor_name"], d.get("error", ""),
+                   d.get("kind", ""), tuple(d.get("ranks", ())))
 
 
 class KVTransport:
@@ -280,9 +303,14 @@ class Controller:
             # Coordinator: gather all requests (MPI_Gatherv analog,
             # mpi_controller.cc:134), track arrivals in the NegotiationTable
             # (IncrementTensorCount analog), validate field-by-field,
-            # publish the response (MPI_Bcast analog, :158).
+            # publish the response (MPI_Bcast analog, :158). The gather
+            # runs to COMPLETION before validating so the report names
+            # EVERY offending rank, not just the first — at pod scale
+            # "which workers diverged" is the actionable bit.
             mine = dataclasses.replace(req, rank=0)
-            error = ""
+            error, kind = "", ""
+            offenders: List[int] = []
+            first_bad: Optional[Request] = None
             for r in range(self.size):
                 raw = self.transport.get(f"{key_base}/req/{r}",
                                          self.timeout_s)
@@ -301,15 +329,23 @@ class Controller:
                     error = (f"ranks {missing} did not submit a collective "
                              f"within {self.timeout_s}s (stalled or "
                              "diverged program order)")
+                    kind = "timeout"
+                    offenders = list(missing)
                     break
                 self._table.increment(key_base, r)
                 other = Request.decode(raw)
                 if dataclasses.replace(other, rank=0) != mine:
-                    error = (f"rank {r} submitted a mismatched collective: "
-                             f"expected {mine}, got {other} (reference: "
-                             "controller.cc:390-621 validation)")
-                    break
-            resp = Response(not error, req.tensor_name, error)
+                    offenders.append(r)
+                    if first_bad is None:
+                        first_bad = other
+            if not error and offenders:
+                kind = "mismatch"
+                error = (f"ranks {offenders} submitted a mismatched "
+                         f"collective: expected {mine}, e.g. rank "
+                         f"{offenders[0]} sent {first_bad} (reference: "
+                         "controller.cc:390-621 validation)")
+            resp = Response(not error, req.tensor_name, error, kind,
+                            tuple(offenders))
             self.transport.set(f"{key_base}/resp", resp.encode())
         else:
             raw = self.transport.get(f"{key_base}/resp", self.timeout_s)
@@ -322,6 +358,16 @@ class Controller:
         if resp.ok:
             with self._lock:
                 self._cache.add(sig)
+        elif resp.kind == "mismatch":
+            # Typed, named-rank contract failure (docs/integrity.md) —
+            # same exception on every rank instead of a deadlocked
+            # collective.
+            raise MismatchError(resp.error, ranks=resp.ranks)
+        elif resp.kind == "timeout":
+            # A missing rank is a RUNTIME failure (dead/hung peer), not
+            # a program bug: HorovodInternalError so elastic recovery
+            # retries it — same classification as the join-round path.
+            raise HorovodInternalError(resp.error)
         else:
             raise TensorShapeMismatchError(resp.error)
         return resp

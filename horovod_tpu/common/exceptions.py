@@ -48,6 +48,23 @@ class TensorShapeMismatchError(HorovodTpuError):
     """
 
 
+class MismatchError(TensorShapeMismatchError):
+    """Cross-rank contract check failed: ranks submitted different
+    collective signatures (shape/dtype/op/wire_dtype/process_set) for
+    the same tensor name. Carries the offending global ranks in
+    ``ranks`` so operators know *which* workers diverged instead of
+    debugging a hang (reference: the coordinator's named-rank
+    ConstructResponse errors, controller.cc:390-621).
+
+    Subclasses :class:`TensorShapeMismatchError` so pre-existing
+    handlers keep working.
+    """
+
+    def __init__(self, message: str, ranks=()):
+        super().__init__(message)
+        self.ranks = tuple(ranks)
+
+
 class DuplicateTensorNameError(HorovodTpuError):
     """Same tensor name submitted twice concurrently.
 
@@ -57,3 +74,37 @@ class DuplicateTensorNameError(HorovodTpuError):
 
 class StallError(HorovodTpuError):
     """A rank stalled past the shutdown threshold (stall_inspector.h:80)."""
+
+
+class StallTimeoutError(StallError, HorovodInternalError):
+    """A collective stalled past the shutdown threshold with
+    ``HVD_TPU_STALL_FATAL=raise``: typed, and — because it also
+    subclasses :class:`HorovodInternalError` — classified as a
+    runtime/comm failure by the elastic retry loop, so a hung
+    collective aborts into an elastic reset instead of wedging the run
+    (docs/integrity.md)."""
+
+
+class NonFiniteError(HorovodTpuError):
+    """A non-finite (NaN/Inf) gradient step was observed under the
+    ``abort`` non-finite policy (``HVD_TPU_NONFINITE_POLICY=abort``).
+    Raised host-side by :func:`horovod_tpu.observe_guard` /
+    ``integrity.check_abort`` — in-trace the step is skipped first, so
+    optimizer state is never poisoned (docs/integrity.md)."""
+
+
+class DivergenceError(HorovodTpuError):
+    """Replica parameters diverged across ranks past tolerance under
+    the ``abort`` divergence policy (``HVD_TPU_DIVERGE_POLICY=abort``).
+    ``ranks`` names the diverged ranks when the host-side detector
+    identified them."""
+
+    def __init__(self, message: str, ranks=()):
+        super().__init__(message)
+        self.ranks = tuple(ranks)
+
+
+class CheckpointCorruptError(HorovodTpuError):
+    """Checkpoint integrity verification failed (CRC/size mismatch
+    against the sidecar manifest) and no earlier verified step exists
+    to fall back to (horovod_tpu/checkpoint.py; docs/integrity.md)."""

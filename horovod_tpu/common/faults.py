@@ -42,6 +42,18 @@ site                 where it fires / what it does
 ``preempt``          ``State.commit()`` entry: ``SIGTERM`` to self — the
                      preemption handler latches, commit saves and exits
                      ``HOSTS_UPDATED_EXIT_CODE``
+``nonfinite``        integrity layer (``integrity.chaos_poison``, wired
+                     into the eager allreduce input path): poison one
+                     float lane with NaN (mode ``inf``: +Inf) so the
+                     non-finite gradient guard must react
+``diverge``          integrity layer (``integrity.chaos_perturb``): add
+                     ``scale`` noise to one rank's slice of a rank-
+                     stacked pytree — a silently diverged replica for
+                     the divergence detector
+``checkpoint_corrupt``  ``CheckpointManager.save`` exit: corrupt the
+                     just-written step (mode ``bitflip`` default /
+                     ``truncate`` / ``sidecar``) so restore must detect
+                     it and walk back to the last verified step
 ===================  =====================================================
 
 Plan JSON: ``{"seed": 42, "faults": [{"site": ..., "step": N |
@@ -75,10 +87,11 @@ ENV_PLAN = "HVD_TPU_FAULT_PLAN"
 ENV_LOG = "HVD_TPU_FAULT_LOG"
 
 SITES = ("collective", "collective_stall", "rendezvous", "discovery",
-         "crash", "preempt")
+         "crash", "preempt", "nonfinite", "diverge", "checkpoint_corrupt")
 
 _SPEC_FIELDS = ("site", "step", "probability", "times", "mode", "delay_s",
-                "code", "exit_code", "message", "rank", "host", "target")
+                "code", "exit_code", "message", "rank", "host", "target",
+                "scale")
 
 
 class XlaRuntimeError(RuntimeError):
@@ -105,6 +118,7 @@ class FaultSpec:
     rank: Optional[int] = None      # restrict to HVD_TPU_PROC_ID
     host: Optional[str] = None      # restrict to HVD_TPU_HOSTNAME
     target: Optional[str] = None    # e.g. hostname for discovery drop_host
+    scale: float = 0.0              # magnitude for the diverge perturbation
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -337,6 +351,38 @@ def maybe_worker_fault() -> None:
         os.kill(os.getpid(), signal.SIGTERM)
 
 
+def maybe_nonfinite() -> Optional["FaultSpec"]:
+    """Integrity layer (one hit per consulted step/collective): when the
+    plan fires, the caller (integrity.chaos_poison — wired into the
+    eager allreduce path and usable on host batches/grads) poisons one
+    float lane with NaN/Inf."""
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.check("nonfinite")
+
+
+def maybe_diverge() -> Optional["FaultSpec"]:
+    """Integrity layer: when the plan fires, the caller
+    (integrity.chaos_perturb) perturbs one rank's parameter slice by
+    ``scale`` noise — a silently diverged replica."""
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.check("diverge")
+
+
+def maybe_checkpoint_corrupt() -> Optional["FaultSpec"]:
+    """CheckpointManager.save exit (one hit per completed save): when
+    the plan fires, the just-written step payload/sidecar is corrupted
+    (mode ``bitflip``/``truncate``/``sidecar``) so the verified-restore
+    walk-back path is exercised end to end."""
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.check("checkpoint_corrupt")
+
+
 # -- shared retry/backoff policy ---------------------------------------------
 
 class Backoff:
@@ -424,7 +470,8 @@ class RecoveryStats:
 
     COUNTERS = ("resets", "restores", "retries", "rendezvous_retries",
                 "discovery_retries", "blacklist_events",
-                "blacklist_recoveries", "preemptions", "injections")
+                "blacklist_recoveries", "preemptions", "injections",
+                "divergence_resyncs", "checkpoint_corruptions")
 
     # Mirrored into the unified metrics registry (docs/metrics.md) so
     # recovery counters land on the same /metrics scrape as the perf

@@ -1,0 +1,675 @@
+"""Training-integrity guard — protecting the *numbers*, not just the
+processes.
+
+The reference coordinator does more than schedule collectives: it
+*validates* that every rank submitted the same tensor and fails fast
+with a named-rank error instead of deadlocking (Sergeev & Del Balso,
+arXiv:1802.05799, controller.cc:390-621). PR 2 hardened this framework
+against process failures; this module is the data-integrity layer on
+top — because at pod scale a single NaN gradient, a silently diverged
+replica, or a torn checkpoint poisons a run for millions of steps, and
+with int8_ef quantization on the hot path (EQuARX, arXiv:2506.17615)
+the numeric failure modes are a first-class citizen:
+
+* **Non-finite gradient guard** (:func:`guarded_apply`): an all-finite
+  flag computed over the gradient pytree (one AND across the fused
+  buckets), globally agreed via a min-allreduce — ONE extra scalar on
+  the wire per step — and a jit-safe ``lax.cond`` so every rank takes
+  the same branch. Policies (``HVD_TPU_NONFINITE_POLICY``):
+
+  =================  ======================================================
+  policy             reaction to a globally-agreed non-finite gradient
+  =================  ======================================================
+  ``warn``           apply the update anyway; count the step
+  ``skip_step``      zero updates, optimizer state (incl. the int8_ef
+                     error-feedback residual) untouched
+  ``zero``           replace non-finite gradient entries with 0, proceed
+  ``scale_backoff``  dynamic loss scaling: gradients are unscaled by the
+                     carried ``loss_scale``; a bad step skips + backs the
+                     scale off; ``growth_steps`` consecutive good steps
+                     grow it back
+  ``abort``          skip in-trace (state protected), then
+                     :func:`check_abort` / ``hvd.observe_guard`` raises
+                     :class:`~.exceptions.NonFiniteError` host-side
+  =================  ======================================================
+
+* **Divergence detector** (:func:`divergence_guard` in-trace /
+  :class:`DivergenceDetector` host-side): every
+  ``HVD_TPU_DIVERGE_CHECK_STEPS`` steps, a cheap parameter fingerprint
+  (chunked L2 norms + a fixed strided sample; the host detector hashes
+  it) is psum-compared across ranks; policy ``HVD_TPU_DIVERGE_POLICY``
+  = ``warn`` | ``abort`` | ``resync`` (resync broadcasts parameters
+  from rank 0 and is counted in RecoveryStats).
+
+* **Chaos hooks**: :func:`chaos_poison` / :func:`chaos_perturb` consume
+  the ``nonfinite`` / ``diverge`` fault-injection sites
+  (common/faults.py) so the whole layer is testable end to end under a
+  seeded ``HVD_TPU_FAULT_PLAN``; the ``checkpoint_corrupt`` site lives
+  in horovod_tpu/checkpoint.py next to the verified-checkpoint path.
+
+Metrics (docs/metrics.md): ``hvd_tpu_nonfinite_steps_total{policy=}``
+(published by ``hvd.observe_guard``), ``hvd_tpu_divergence_checks_total
+{result=}``; the checkpoint layer adds
+``hvd_tpu_checkpoint_verify_total{result=}``. Resyncs additionally bump
+``RecoveryStats`` (timeline instants + the recovery scrape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import zlib
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import faults as faults_lib
+from . import metrics as metrics_lib
+from .exceptions import DivergenceError, NonFiniteError
+
+logger = logging.getLogger("horovod_tpu")
+
+NONFINITE_POLICIES = ("warn", "skip_step", "zero", "scale_backoff",
+                      "abort")
+DIVERGE_POLICIES = ("warn", "abort", "resync")
+
+# Integer policy codes so the policy rides INSIDE the guard state (a
+# jit-carried NamedTuple can only hold arrays): host observers recover
+# the policy from the state alone, e.g. to raise under ``abort``.
+POLICY_CODES = {p: i for i, p in enumerate(NONFINITE_POLICIES)}
+POLICY_NAMES = {i: p for p, i in POLICY_CODES.items()}
+
+_M_NONFINITE = metrics_lib.counter(
+    "hvd_tpu_nonfinite_steps_total",
+    "training steps whose global all-finite gradient flag was false, "
+    "by non-finite policy (published by hvd.observe_guard)",
+    labels=("policy",))
+_M_DIVERGE = metrics_lib.counter(
+    "hvd_tpu_divergence_checks_total",
+    "cross-rank parameter-fingerprint divergence checks by result "
+    "(ok / diverged / resync)",
+    labels=("result",))
+# Pre-seed so absence is distinguishable from silence on the first
+# scrape (the RecoveryStats pattern).
+for _p in NONFINITE_POLICIES:
+    _M_NONFINITE.labels(policy=_p)
+for _r in ("ok", "diverged", "resync"):
+    _M_DIVERGE.labels(result=_r)
+del _p, _r
+
+
+def resolve_nonfinite_policy(policy: Optional[str] = None) -> Optional[str]:
+    """None -> the configured default (``HVD_TPU_NONFINITE_POLICY`` /
+    ``init(nonfinite_policy=)``); ""/"off" -> disabled (None). An
+    unknown policy raises — a typo'd knob must not silently disable the
+    guard it was meant to configure."""
+    if policy is None:
+        from . import basics
+
+        if basics.is_initialized():
+            policy = basics.context().config.nonfinite_policy
+        else:
+            from .config import _env
+
+            policy = _env("NONFINITE_POLICY")
+    if not policy or policy == "off":
+        return None
+    if policy not in NONFINITE_POLICIES:
+        raise ValueError(
+            f"unknown non-finite policy {policy!r}; known: "
+            f"{('off',) + NONFINITE_POLICIES}")
+    return policy
+
+
+def resolve_diverge_policy(policy: Optional[str] = None) -> str:
+    if policy is None:
+        from . import basics
+
+        if basics.is_initialized():
+            policy = basics.context().config.diverge_policy
+        else:
+            from .config import _env
+
+            policy = _env("DIVERGE_POLICY", "warn")
+    policy = policy or "warn"
+    if policy not in DIVERGE_POLICIES:
+        raise ValueError(f"unknown divergence policy {policy!r}; known: "
+                         f"{DIVERGE_POLICIES}")
+    return policy
+
+
+# -- dynamic loss scaling knobs (scale_backoff) ------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ScaleConfig:
+    """Dynamic loss-scaling schedule for the ``scale_backoff`` policy —
+    the classic mixed-precision recipe: back off multiplicatively on a
+    bad step, grow back after a streak of good ones."""
+
+    init: float = 2.0 ** 15
+    backoff: float = 0.5
+    growth: float = 2.0
+    growth_steps: int = 200
+    min: float = 1.0
+    max: float = 2.0 ** 24
+
+    @classmethod
+    def from_env(cls) -> "ScaleConfig":
+        from .config import _env_float, _env_int
+
+        return cls(
+            init=_env_float("SCALE_INIT", cls.init),
+            backoff=_env_float("SCALE_BACKOFF_FACTOR", cls.backoff),
+            growth=_env_float("SCALE_GROWTH_FACTOR", cls.growth),
+            growth_steps=_env_int("SCALE_GROWTH_STEPS", cls.growth_steps),
+            min=_env_float("SCALE_MIN", cls.min),
+            max=_env_float("SCALE_MAX", cls.max))
+
+
+class GuardState(NamedTuple):
+    """Carried guard state (all scalar arrays, jit-safe): the policy
+    code, the count of globally-non-finite steps seen, the current
+    consecutive-good-step streak, the dynamic loss scale (1.0 unless
+    ``scale_backoff``), and whether the LAST step was finite."""
+
+    policy: jnp.ndarray          # int32 POLICY_CODES value
+    nonfinite_steps: jnp.ndarray  # int32
+    good_steps: jnp.ndarray       # int32 consecutive good streak
+    loss_scale: jnp.ndarray       # float32
+    last_ok: jnp.ndarray          # int32 (bool)
+
+
+def init_guard_state(policy: str,
+                     scale: Optional[ScaleConfig] = None) -> GuardState:
+    scale = scale if scale is not None else ScaleConfig.from_env()
+    init_scale = scale.init if policy == "scale_backoff" else 1.0
+    return GuardState(
+        policy=jnp.asarray(POLICY_CODES[policy], jnp.int32),
+        nonfinite_steps=jnp.zeros((), jnp.int32),
+        good_steps=jnp.zeros((), jnp.int32),
+        loss_scale=jnp.asarray(init_scale, jnp.float32),
+        last_ok=jnp.ones((), jnp.int32))
+
+
+def guard_state_specs():
+    """PartitionSpecs for carrying a GuardState through shard_map: every
+    field is a replicated scalar (the flag is globally agreed)."""
+    from jax.sharding import PartitionSpec as P
+
+    return GuardState(P(), P(), P(), P(), P())
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every float leaf of ``tree`` is finite. One AND
+    across the (fused-bucket) leaves — integer leaves are finite by
+    construction and skipped."""
+    ok = jnp.ones((), jnp.bool_)
+    for leaf in jax.tree.leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def _axis_bound(axis_name: str) -> bool:
+    try:
+        jax.lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def global_all_finite(tree, axis_name: str) -> jnp.ndarray:
+    """The globally-agreed all-finite flag: local AND over the tree,
+    then a min-allreduce of ONE scalar over the rank axis (outside an
+    SPMD region the local flag already is the global one). Every rank
+    computes the identical value, so a ``lax.cond`` on it takes the
+    same branch everywhere — the property that keeps skip/backoff steps
+    deadlock-free."""
+    ok = all_finite(tree)
+    if _axis_bound(axis_name):
+        ok = jax.lax.pmin(ok.astype(jnp.float32), axis_name) > 0.5
+    return ok
+
+
+def sanitize(tree):
+    """The ``zero`` policy's transform: non-finite entries of float
+    leaves become 0 (finite entries and integer leaves untouched)."""
+    def one(leaf):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return jnp.where(jnp.isfinite(leaf), leaf,
+                             jnp.zeros_like(leaf))
+        return leaf
+
+    return jax.tree.map(one, tree)
+
+
+def guarded_apply(policy: str, fn: Callable, grads, carry,
+                  guard: GuardState, axis_name: str,
+                  scale: Optional[ScaleConfig] = None):
+    """Run ``fn(grads, carry) -> (out, new_carry)`` under the non-finite
+    policy. ``out`` must be shaped like ``grads`` (updates or reduced
+    gradients — true for every optimizer surface here), because the
+    skip branch substitutes ``zeros_like(grads)``.
+
+    Returns ``(out, new_carry, new_guard)``. Under ``skip_step`` /
+    ``scale_backoff`` / ``abort`` the whole ``fn`` — reduction AND
+    update — sits inside the ``lax.cond``, so on a skipped step nothing
+    downstream moves: inner optimizer state, step counters, and the
+    int8_ef error-feedback residual all stay untouched.
+    """
+    if policy not in NONFINITE_POLICIES:
+        raise ValueError(f"unknown non-finite policy {policy!r}")
+    scale = scale if scale is not None else ScaleConfig.from_env()
+    if policy == "scale_backoff":
+        inv = (1.0 / guard.loss_scale).astype(jnp.float32)
+        grads = jax.tree.map(
+            lambda g: (g * inv.astype(g.dtype))
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+            grads)
+    ok = global_all_finite(grads, axis_name)
+    bad_i = (~ok).astype(jnp.int32)
+
+    if policy == "warn":
+        out, new_carry = fn(grads, carry)
+    elif policy == "zero":
+        out, new_carry = fn(sanitize(grads), carry)
+    else:  # skip_step / scale_backoff / abort: branch identically on
+        # every rank (ok is globally agreed).
+        def take(args):
+            g, c = args
+            return fn(g, c)
+
+        def skip(args):
+            g, c = args
+            return jax.tree.map(jnp.zeros_like, g), c
+
+        out, new_carry = jax.lax.cond(ok, take, skip, (grads, carry))
+
+    good = jnp.where(ok, guard.good_steps + 1, 0)
+    loss_scale = guard.loss_scale
+    if policy == "scale_backoff":
+        grown = jnp.minimum(loss_scale * scale.growth, scale.max)
+        backed = jnp.maximum(loss_scale * scale.backoff, scale.min)
+        grow_now = good >= scale.growth_steps
+        loss_scale = jnp.where(~ok, backed,
+                               jnp.where(grow_now, grown, loss_scale))
+        good = jnp.where(grow_now, 0, good)
+    new_guard = GuardState(
+        policy=guard.policy,
+        nonfinite_steps=guard.nonfinite_steps + bad_i,
+        good_steps=good,
+        loss_scale=loss_scale,
+        last_ok=ok.astype(jnp.int32))
+    return out, new_carry, new_guard
+
+
+def current_loss_scale(state):
+    """The live dynamic loss scale carried by a guarded optimizer state
+    (1.0 unless the ``scale_backoff`` policy is active). Usable
+    IN-TRACE — multiply your loss by it before ``jax.grad``::
+
+        loss = loss_fn(params, batch) * hvd.current_loss_scale(opt_state)
+
+    Accepts the guarded optimizer state, a GuardState, or anything with
+    a ``.guard`` attribute."""
+    g = find_guard(state)
+    if g is None:
+        return jnp.ones((), jnp.float32)
+    return g.loss_scale
+
+
+def find_guard(state) -> Optional[GuardState]:
+    """Locate the GuardState inside (possibly nested) optimizer state —
+    walks ``.inner`` wrappers, so a guard buried under the
+    backward_passes_per_step aggregation state (``_AggState(inner=
+    _GuardedState(...))``) is still found."""
+    seen = 0
+    while state is not None and seen < 8:  # nesting is tiny; stay safe
+        if isinstance(state, GuardState):
+            return state
+        g = getattr(state, "guard", None)
+        if isinstance(g, GuardState):
+            return g
+        state = getattr(state, "inner", None)
+        seen += 1
+    return None
+
+
+# Per-(policy, name) high-water marks for delta publishing. One
+# guarded optimizer per policy needs no name; processes running SEVERAL
+# guarded states under the same policy must pass distinct ``name=``s to
+# observe_guard or the shared high-water mark under-counts the metric.
+_published_nonfinite = {}
+
+
+def observe_guard(state, raise_on_abort: bool = True,
+                  name: str = "default") -> Optional[dict]:
+    """Host-side guard observation (call at checkpoint/eval cadence,
+    like ``observe_ef_residual``): fetches the carried counters,
+    publishes the delta into ``hvd_tpu_nonfinite_steps_total{policy=}``
+    and — under the ``abort`` policy with non-finite steps on record —
+    raises :class:`NonFiniteError` (the in-trace guard has already
+    skipped those steps, so optimizer state is intact at the raise).
+    ``name`` keys the delta stream: pass distinct names when observing
+    MULTIPLE guarded states under the same policy. Returns the snapshot
+    dict, or None if ``state`` carries no guard."""
+    g = find_guard(state)
+    if g is None:
+        return None
+    policy = POLICY_NAMES.get(int(np.asarray(jax.device_get(g.policy))
+                                  .reshape(-1)[0]), "?")
+    snap = {
+        "policy": policy,
+        "nonfinite_steps": int(np.asarray(
+            jax.device_get(g.nonfinite_steps)).reshape(-1)[0]),
+        "good_steps": int(np.asarray(
+            jax.device_get(g.good_steps)).reshape(-1)[0]),
+        "loss_scale": float(np.asarray(
+            jax.device_get(g.loss_scale)).reshape(-1)[0]),
+        "last_ok": bool(np.asarray(
+            jax.device_get(g.last_ok)).reshape(-1)[0]),
+    }
+    stream = (policy, name)
+    prev = _published_nonfinite.get(stream, 0)
+    if snap["nonfinite_steps"] < prev:
+        # The carried counter rewound (checkpoint restore, elastic
+        # reset, a fresh optimizer under the same stream): re-anchor
+        # the high-water mark so subsequent increments publish again.
+        _published_nonfinite[stream] = prev = snap["nonfinite_steps"]
+    if snap["nonfinite_steps"] > prev:
+        _M_NONFINITE.labels(policy=policy).inc(
+            snap["nonfinite_steps"] - prev)
+        _published_nonfinite[stream] = snap["nonfinite_steps"]
+    if raise_on_abort:
+        check_abort(snap)
+    return snap
+
+
+def check_abort(snapshot: dict) -> None:
+    """Raise NonFiniteError for an ``abort``-policy guard that has seen
+    non-finite steps (takes an :func:`observe_guard` snapshot)."""
+    if snapshot.get("policy") == "abort" and \
+            snapshot.get("nonfinite_steps", 0) > 0:
+        raise NonFiniteError(
+            f"non-finite gradients on {snapshot['nonfinite_steps']} "
+            "step(s) under HVD_TPU_NONFINITE_POLICY=abort (the steps "
+            "were skipped in-trace; optimizer state is intact)")
+
+
+# -- divergence detection ----------------------------------------------------
+
+_FP_CHUNKS = 4
+_FP_SAMPLE = 8
+
+
+def fingerprint(tree, chunks: int = _FP_CHUNKS,
+                sample: int = _FP_SAMPLE) -> jnp.ndarray:
+    """Cheap parameter fingerprint: a fixed-size f32 vector of chunked
+    L2 norms over the concatenated flattened parameters plus a fixed
+    strided sample of raw values. Deterministic in (tree, chunks,
+    sample); identical replicas produce bitwise-identical vectors, and
+    a perturbation moves its chunk norm and/or a sampled value.
+    Sensitivity is fp32-resolution-bounded: a deviation below one ulp
+    of its chunk's norm is invisible — the detector targets real
+    replica drift (a missed update, a corrupted buffer), not last-bit
+    noise. Works in-trace and on host trees."""
+    leaves = [jnp.ravel(jnp.asarray(l)).astype(jnp.float32)
+              for l in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.zeros((chunks + sample,), jnp.float32)
+    flat = leaves[0] if len(leaves) == 1 else jnp.concatenate(leaves)
+    n = flat.shape[0]
+    pad = (-n) % chunks
+    if pad:
+        flat_p = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    else:
+        flat_p = flat
+    norms = jnp.sqrt(
+        jnp.sum(flat_p.reshape(chunks, -1) ** 2, axis=1) + 0.0)
+    idx = np.linspace(0, max(n - 1, 0), num=sample).astype(np.int64)
+    sampled = flat[jnp.asarray(idx)] if n else jnp.zeros((sample,),
+                                                         jnp.float32)
+    return jnp.concatenate([norms, sampled])
+
+
+def fingerprint_digest(tree) -> str:
+    """Host-side hash of the fingerprint (crc32 over the f32 bytes) —
+    the exact-comparison form the cross-process detector exchanges
+    through the controller KV."""
+    fp = np.asarray(jax.device_get(fingerprint(tree)), np.float32)
+    return f"{zlib.crc32(fp.tobytes()) & 0xFFFFFFFF:08x}"
+
+
+def check_divergence(params, axis_name: str,
+                     tol: float = 0.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """In-trace cross-rank comparison: the fingerprint's elementwise
+    spread across ranks, ``max(pmax(fp) - pmin(fp))``. pmax/pmin are
+    SELECTIONS, not arithmetic — bitwise-identical replicas yield
+    exactly 0 (a pmean-based compare would round at ~n·eps and
+    false-positive at tol=0), so the default tolerance is exact.
+    Non-finite fingerprints (a NaN-poisoned replica) count as diverged.
+    Both returns are replicated, so the ``diverged`` flag agrees on
+    every rank. Returns ``(diverged, max_deviation)``."""
+    fp = fingerprint(params)
+    hi = jax.lax.pmax(fp, axis_name)
+    lo = jax.lax.pmin(fp, axis_name)
+    max_dev = jnp.max(hi - lo)
+    diverged = jnp.logical_or(max_dev > tol,
+                              jnp.logical_not(jnp.isfinite(max_dev)))
+    return diverged, max_dev
+
+
+def resync_params(params, axis_name: str, root: int = 0):
+    """The ``resync`` policy: broadcast every parameter leaf from
+    ``root`` (rank 0 by default) — the healed replicas are bitwise
+    rank-0's."""
+    from ..ops import collectives as C
+
+    return jax.tree.map(lambda p: C.broadcast(p, root, axis_name), params)
+
+
+def divergence_guard(params, step, axis_name: str, every: int,
+                     policy: str = "warn", tol: float = 0.0):
+    """In-trace periodic divergence check + policy application. Call at
+    the TOP of the step (before gradients) so a resync heals replicas
+    before they contaminate the reduction::
+
+        params, checked, diverged = integrity.divergence_guard(
+            params, step_no, ax, every=5, policy="resync")
+
+    ``every <= 0`` disables (params returned untouched). ``abort``
+    behaves like ``warn`` in-trace (the host observes the returned flag
+    via :func:`record_divergence` / :func:`maybe_raise_divergence`).
+    Returns ``(params, checked, diverged)`` — the flags are replicated
+    scalars for host-side accounting."""
+    if policy not in DIVERGE_POLICIES:
+        raise ValueError(f"unknown divergence policy {policy!r}; known: "
+                         f"{DIVERGE_POLICIES}")
+    if every <= 0 or not _axis_bound(axis_name):
+        false = jnp.zeros((), jnp.bool_)
+        return params, false, false
+    step = jnp.asarray(step, jnp.int32)
+    do = (step % every) == 0
+
+    def checked_branch(p):
+        diverged, _dev = check_divergence(p, axis_name, tol)
+        if policy == "resync":
+            p = jax.lax.cond(
+                diverged, lambda q: resync_params(q, axis_name),
+                lambda q: q, p)
+        return p, diverged
+
+    def skip_branch(p):
+        return p, jnp.zeros((), jnp.bool_)
+
+    params, diverged = jax.lax.cond(do, checked_branch, skip_branch,
+                                    params)
+    return params, do, diverged
+
+
+def record_divergence(checked, diverged, policy: str = "warn") -> bool:
+    """Host-side accounting for one step's divergence-guard flags:
+    bumps ``hvd_tpu_divergence_checks_total{result=}`` (and, for a
+    resync, the RecoveryStats ``divergence_resyncs`` counter → timeline
+    instant). Returns whether a divergence was recorded."""
+    if not bool(np.asarray(jax.device_get(checked)).reshape(-1)[0]):
+        return False
+    div = bool(np.asarray(jax.device_get(diverged)).reshape(-1)[0])
+    _M_DIVERGE.labels(result="diverged" if div else "ok").inc()
+    if div:
+        logger.warning("integrity: replica parameter divergence "
+                       "detected (policy=%s)", policy)
+        if policy == "resync":
+            _M_DIVERGE.labels(result="resync").inc()
+            faults_lib.stats.bump("divergence_resyncs")
+    return div
+
+
+def maybe_raise_divergence(diverged, policy: str,
+                           ranks=(), detail: str = "") -> None:
+    if policy != "abort":
+        return
+    if bool(np.asarray(jax.device_get(diverged)).reshape(-1)[0]):
+        raise DivergenceError(
+            "replica parameters diverged across ranks "
+            f"(HVD_TPU_DIVERGE_POLICY=abort){': ' + detail if detail else ''}",
+            ranks=ranks)
+
+
+class DivergenceDetector:
+    """Host-side cross-PROCESS divergence detector for eager / multi-
+    process training loops: every ``every_steps`` steps each process
+    computes a fingerprint digest of its parameter tree and exchanges
+    it through the controller KV transport; a minority digest names the
+    offending ranks (majority wins — the same call the operator would
+    make). Policies: ``warn`` logs, ``abort`` raises
+    :class:`DivergenceError` naming the ranks, ``resync`` reports
+    ``needs_resync`` so the caller re-broadcasts (e.g.
+    ``hvd.broadcast_object`` / ``broadcast_parameters`` from rank 0)
+    and is counted in RecoveryStats."""
+
+    def __init__(self, every_steps: Optional[int] = None,
+                 policy: Optional[str] = None, controller=None):
+        from . import basics
+
+        if every_steps is None:
+            if basics.is_initialized():
+                every_steps = basics.context().config.diverge_check_steps
+            else:
+                from .config import _env_int
+
+                every_steps = _env_int("DIVERGE_CHECK_STEPS", 0)
+        self.every_steps = int(every_steps)
+        self.policy = resolve_diverge_policy(policy)
+        if controller is None and basics.is_initialized():
+            controller = basics.context().controller
+        self.controller = controller
+        self.checks = 0
+        self.divergences = 0
+
+    def check(self, params, step: int) -> Optional[dict]:
+        """Returns None off-cadence; else a report dict with ``ok``,
+        ``ranks`` (offenders), and ``needs_resync``."""
+        if self.every_steps <= 0 or step % self.every_steps:
+            return None
+        self.checks += 1
+        digest = fingerprint_digest(params)
+        c = self.controller
+        if c is None or c.size == 1:
+            # Single process: replicas live inside the SPMD program —
+            # use divergence_guard in-trace there; host-side the tree
+            # is trivially self-consistent.
+            _M_DIVERGE.labels(result="ok").inc()
+            return {"ok": True, "ranks": (), "digest": digest,
+                    "needs_resync": False}
+        vals = c.exchange("integrity_fp", digest)
+        counts = {}
+        for v in vals:
+            counts[v] = counts.get(v, 0) + 1
+        # Deterministic tie-break (lexicographic digest): every process
+        # must compute the SAME majority, or a 50/50 split would have
+        # each side naming the other as offenders.
+        majority = max(counts, key=lambda k: (counts[k], k))
+        offenders = tuple(r for r, v in enumerate(vals) if v != majority)
+        ok = not offenders
+        _M_DIVERGE.labels(result="ok" if ok else "diverged").inc()
+        if ok:
+            return {"ok": True, "ranks": (), "digest": digest,
+                    "needs_resync": False}
+        self.divergences += 1
+        logger.warning(
+            "integrity: parameter fingerprints diverged — ranks %s "
+            "disagree with the majority (policy=%s)",
+            list(offenders), self.policy)
+        if self.policy == "abort":
+            raise DivergenceError(
+                f"ranks {list(offenders)} hold diverged parameters "
+                f"(fingerprint {digest} vs majority {majority})",
+                ranks=offenders)
+        needs_resync = self.policy == "resync"
+        if needs_resync:
+            _M_DIVERGE.labels(result="resync").inc()
+            faults_lib.stats.bump("divergence_resyncs")
+        return {"ok": False, "ranks": offenders, "digest": digest,
+                "majority": majority, "needs_resync": needs_resync}
+
+
+# -- chaos hooks (fault-plan consumers; docs/integrity.md) -------------------
+
+def chaos_poison(tree):
+    """Consume the ``nonfinite`` injection site: when the installed
+    fault plan fires, poison the first float leaf's first element with
+    NaN (``mode="inf"`` injects +Inf instead) — the minimal realistic
+    corruption: ONE bad lane on ONE rank, which the global min-
+    allreduce must still catch. No-op (one global load) without a
+    plan."""
+    spec = faults_lib.maybe_nonfinite()
+    if spec is None:
+        return tree
+    bad = jnp.inf if (spec.mode or "nan") == "inf" else jnp.nan
+    leaves, treedef = jax.tree.flatten(tree)
+    for i, leaf in enumerate(leaves):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating) and arr.size:
+            flat = jnp.ravel(arr).at[0].set(jnp.asarray(bad, arr.dtype))
+            leaves[i] = flat.reshape(arr.shape)
+            break
+    logger.warning("chaos: poisoned a gradient/batch leaf with %s",
+                   "inf" if bad == jnp.inf else "nan")
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def chaos_perturb(stacked_tree):
+    """Consume the ``diverge`` injection site on a RANK-STACKED pytree
+    (leading dim = world size, the eager/e2e layout): when the plan
+    fires, add ``spec.scale`` noise to the slice of the rank named by
+    ``spec.target`` (default rank ``size-1``) — one silently diverged
+    replica for the detector to catch. Deterministic: the perturbation
+    is seeded from the fault-plan seed."""
+    spec = faults_lib.maybe_diverge()
+    if spec is None:
+        return stacked_tree
+    scale = spec.scale if spec.scale else 1.0
+
+    def one(leaf):
+        arr = np.array(jax.device_get(leaf))
+        if arr.ndim < 1 or not np.issubdtype(arr.dtype, np.floating):
+            return leaf
+        # `is not None`: target 0 (rank 0) is a valid, falsy choice.
+        r = int(spec.target) if spec.target not in (None, "") \
+            else arr.shape[0] - 1
+        rng = np.random.default_rng(
+            faults_lib.injector().plan.seed if faults_lib.injector()
+            else 0)
+        arr[r] = arr[r] + scale * rng.standard_normal(
+            arr[r].shape).astype(arr.dtype)
+        return jnp.asarray(arr)
+
+    logger.warning("chaos: perturbed one replica's parameters "
+                   "(scale=%s)", scale)
+    return jax.tree.map(one, stacked_tree)

@@ -21,7 +21,7 @@ import time
 from typing import Dict, Optional
 
 from . import metrics as metrics_lib
-from .exceptions import StallError
+from .exceptions import StallError, StallTimeoutError
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -44,10 +44,26 @@ _M_FATAL = metrics_lib.counter(
 class StallInspector:
     def __init__(self, check_time_seconds: float = 60.0,
                  shutdown_time_seconds: float = 0.0,
-                 disabled: bool = False):
+                 disabled: bool = False,
+                 fatal_mode: Optional[str] = None):
         self.check_time = check_time_seconds
         self.shutdown_time = shutdown_time_seconds
         self.disabled = disabled
+        # HVD_TPU_STALL_FATAL=raise (docs/integrity.md): the fatal path
+        # raises a typed StallTimeoutError, which — as a
+        # HorovodInternalError subclass — the elastic retry loop
+        # classifies as a comm failure, so a hung collective aborts into
+        # an elastic reset instead of wedging the run. Default keeps the
+        # historical StallError (escapes the retry loop). Warning
+        # counters are identical in both modes. Unknown values raise —
+        # a typo'd knob must not silently disable the escalation it was
+        # meant to configure (same contract as the integrity policies).
+        self.fatal_mode = (fatal_mode or "").strip().lower() or None
+        if self.fatal_mode not in (None, "raise"):
+            raise ValueError(
+                f"unknown HVD_TPU_STALL_FATAL mode {fatal_mode!r}; "
+                "known: 'raise' (or unset for the historical latched "
+                "StallError)")
         self.fatal: Optional[StallError] = None
         self._inflight: Dict[str, float] = {}
         self._warned: set = set()
@@ -86,7 +102,9 @@ class StallInspector:
             age = now - t0
             if self.shutdown_time > 0 and age > self.shutdown_time:
                 _M_FATAL.inc()
-                raise StallError(
+                exc_type = (StallTimeoutError
+                            if self.fatal_mode == "raise" else StallError)
+                raise exc_type(
                     f"collective {name} stalled for {age:.0f}s "
                     f"(> shutdown threshold {self.shutdown_time:.0f}s)")
             if age > self.check_time:

@@ -45,7 +45,7 @@ logger = logging.getLogger("horovod_tpu")
 from ..common import faults as faults_lib
 from ..common import fusion as fusion_lib
 from ..common import metrics as metrics_lib
-from ..common.exceptions import (DuplicateTensorNameError,
+from ..common.exceptions import (DuplicateTensorNameError, MismatchError,
                                  TensorShapeMismatchError)
 from . import collectives as C
 from .compression import Compression, NoneCompressor
@@ -214,12 +214,17 @@ class EagerEngine:
 
     def __init__(self, mesh: Mesh, axis_name: str, config, timeline=None,
                  stall_inspector=None, hier_mesh: Optional[Mesh] = None,
-                 controller=None, autotuner=None):
+                 controller=None, autotuner=None, ps_tag: str = ""):
         self.mesh = mesh
         self.axis = axis_name
         self.config = config
         self.timeline = timeline
         self.stall = stall_inspector
+        # Contract-check scope tag (docs/integrity.md): "" is the world
+        # engine; process-set engines carry their rank tuple so a
+        # collective submitted against different sets on different
+        # processes is a named mismatch, not a hang.
+        self.ps_tag = ps_tag
         # 2-D (cross, local) mesh for HOROVOD_HIERARCHICAL_ALLREDUCE: the
         # NCCL-intra-node + MPI-inter-node analog (nccl_operations.cc:190+)
         # becomes RS(local/ICI) → AR(cross/DCN) → AG(local/ICI).
@@ -347,16 +352,23 @@ class EagerEngine:
         return fn
 
     def _negotiate(self, op_type: str, name: str, x, reduce_op: int = 0,
-                   root_rank: int = -1, shape=None, dtype=None):
+                   root_rank: int = -1, shape=None, dtype=None,
+                   wire: Optional[str] = None):
         """Multi-process guard rail: validate that every process submitted
         the same collective BEFORE any device placement or dispatch — a
-        mismatch raises TensorShapeMismatchError naming the diverged rank
+        mismatch raises MismatchError naming the diverged rank(s)
         instead of deadlocking (or aborting) the cross-process transfer
-        (reference controller.cc:390-621). Runs on the *raw input*
-        signature because even jax.device_put of a diverged global shape
-        crashes the multi-process runtime. No-op in single-process worlds;
-        repeats of a seen signature return via the controller's cache
-        without KV traffic.
+        (reference controller.cc:390-621). The contract covers (shape,
+        dtype, op, wire_dtype, process_set): ``wire`` carries the
+        reduction-compression / wire decision (ranks configured with
+        different HVD_TPU_COMPRESSION compile different programs — the
+        integrity layer makes that a named error, docs/integrity.md)
+        and the engine's ``ps_tag`` scopes the round to its process
+        set. Runs on the *raw input* signature because even
+        jax.device_put of a diverged global shape crashes the
+        multi-process runtime. No-op in single-process worlds; repeats
+        of a seen signature return via the controller's cache without
+        KV traffic.
 
         Auto-named ("noname.N") tensors are renamed to a digest of their
         signature: a per-call-unique name would make every unnamed op a
@@ -376,11 +388,13 @@ class EagerEngine:
         if ".noname." in name:
             import hashlib
 
-            sig = repr((op_type, shape, dtype, reduce_op, root_rank))
+            sig = repr((op_type, shape, dtype, reduce_op, root_rank,
+                        wire, self.ps_tag))
             name = (f"{op_type}.auto."
                     f"{hashlib.sha1(sig.encode()).hexdigest()[:16]}")
         req = Request(self.controller.rank, op_type, name, dtype,
-                      tuple(shape), reduce_op, root_rank)
+                      tuple(shape), reduce_op, root_rank,
+                      wire_dtype=wire or "", process_set=self.ps_tag)
         if self.join_active():
             # Join mode: every collective is a lockstep round so joined
             # processes stay in sync; the round also enforces the
@@ -464,6 +478,7 @@ class EagerEngine:
                 if error:
                     break
             decoded = {}
+            error_ranks: List[int] = []
             if not error:
                 for r in sorted(reqs):
                     if reqs[r] == self._JOIN_SENTINEL:
@@ -483,6 +498,7 @@ class EagerEngine:
                                      f"got {d} (reference: "
                                      "controller.cc:390-621)")
                             error_kind = "mismatch"
+                            error_ranks.append(r)
                             break
                     if (not error and self._coord_joined
                             and base_req.op_type != "allreduce"):
@@ -492,6 +508,7 @@ class EagerEngine:
                         error_kind = "mismatch"
             desc = reqs[min(decoded)] if (not error and decoded) else None
             resp = {"ok": not error, "error": error, "kind": error_kind,
+                    "ranks": error_ranks,
                     "desc": desc, "joined": list(self._coord_joined),
                     "all_joined": len(self._coord_joined) == c.size,
                     "last": (self._coord_joined[-1]
@@ -525,12 +542,14 @@ class EagerEngine:
 
         if not resp["ok"]:
             # Same failure → same exception type on every rank: shape/op
-            # divergence is a user bug (TensorShapeMismatchError); a
+            # divergence is a user bug (MismatchError, naming the
+            # offending ranks — a TensorShapeMismatchError subclass); a
             # missing rank is a runtime failure (HorovodInternalError,
             # which elastic recovery catches).
             if resp.get("kind") == "timeout":
                 raise HorovodInternalError(resp["error"])
-            raise TensorShapeMismatchError(resp["error"])
+            raise MismatchError(resp["error"],
+                                ranks=resp.get("ranks", ()))
         return resp
 
     def _join_dispatch(self, req, joined_ranks, x=None,
@@ -717,6 +736,22 @@ class EagerEngine:
             return self.autotuner.current
         return self.config.fusion_threshold_bytes
 
+    def _wire_contract(self, compression) -> str:
+        """Host-side wire tag for the cross-rank contract check: the
+        compressor name plus (for quantized reductions) the
+        quantize-min knob — the configuration bits that change the
+        compiled reduction program, so ranks diverging on them get a
+        named MismatchError instead of a hang (docs/integrity.md). The
+        DEFAULT (no compression) maps to "" so default requests keep
+        the native wire-codec fast path — a peer running any non-default
+        compressor still mismatches on its non-empty tag."""
+        name = compression.__name__
+        if name == "NoneCompressor":
+            return ""
+        if getattr(compression, "quantized_reduce", False):
+            return f"{name}/qmin{self.config.quantize_min_bucket_bytes}"
+        return name
+
     # -- telemetry: raw-vs-wire byte accounting ----------------------------
 
     def _count_allreduce_bytes(self, dt, compression, quant, small_bf16,
@@ -801,12 +836,20 @@ class EagerEngine:
                   compression=None):
         if compression is None:
             compression = self._default_compression
+        if faults_lib.active():
+            # Chaos site "nonfinite" (docs/integrity.md): poison one
+            # float lane of the input so the integrity layer's guard /
+            # detectors must react downstream.
+            from ..common import integrity as integrity_lib
+
+            x = integrity_lib.chaos_poison(x)
         if self.join_active():
             return self._allreduce_join_mode(x, op, name, prescale_factor,
                                              postscale_factor, compression)
         full = self._begin(name, "allreduce")
         try:
-            self._negotiate("allreduce", full, x, reduce_op=int(op))
+            self._negotiate("allreduce", full, x, reduce_op=int(op),
+                            wire=self._wire_contract(compression))
             dt = self._as_distributed(x)
             hier = (self.config.hierarchical_allreduce
                     and self.hier_mesh is not None
@@ -951,6 +994,10 @@ class EagerEngine:
         carries the same factors, EnqueueTensorAllreduces)."""
         if compression is None:
             compression = self._default_compression
+        if faults_lib.active():
+            from ..common import integrity as integrity_lib
+
+            tree = integrity_lib.chaos_poison(tree)
         if self.join_active():
             # Join mode: decompose into per-leaf join-aware allreduces so
             # a joined process can replay each one with zero tensors (the
@@ -982,7 +1029,8 @@ class EagerEngine:
                 self._negotiate(
                     "allreduce", full, raw_leaves[0], reduce_op=int(op),
                     shape=(len(raw_leaves), total,
-                           zlib.crc32(meta.encode())))
+                           zlib.crc32(meta.encode())),
+                    wire=self._wire_contract(compression))
             dts = jax.tree.map(self._as_distributed, tree)
             leaves, treedef = jax.tree.flatten(dts)
             shapes = tuple((l.shape, str(l.dtype)) for l in leaves)

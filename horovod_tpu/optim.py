@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from .common import fusion as fusion_lib
+from .common import integrity as integrity_lib
 from .common import metrics as metrics_lib
+from .common.integrity import (current_loss_scale, observe_guard)  # noqa: F401 — re-exported API
 from .ops import collectives as C
 from .ops.compression import NoneCompressor
 
@@ -98,8 +100,17 @@ def observe_ef_residual(state) -> Optional[float]:
     ``_EFShardState`` carried by the ``int8_ef`` surfaces), published as
     the ``hvd_tpu_ef_residual_norm`` gauge. Host-side — fetches the
     residual leaves, so call it at checkpoint/eval cadence, not every
-    step. Returns the norm, or None if ``state`` carries no residual."""
-    residual = getattr(state, "residual", None)
+    step. Walks ``.inner`` wrappers (the integrity ``_GuardedState``,
+    the k>1 ``_AggState``) so arming the non-finite guard does not make
+    the gauge go dark. Returns the norm, or None if ``state`` carries
+    no residual."""
+    residual, probe, hops = None, state, 0
+    while probe is not None and hops < 8:
+        residual = getattr(probe, "residual", None)
+        if residual is not None:
+            break
+        probe = getattr(probe, "inner", None)
+        hops += 1
     if residual is None:
         return None
     import numpy as np
@@ -252,6 +263,17 @@ class _AggState(NamedTuple):
     counter: jnp.ndarray
 
 
+class _GuardedState(NamedTuple):
+    """Optimizer-state wrapper carried when a non-finite policy is
+    active (docs/integrity.md): the wrapped surface's state (possibly
+    itself an :class:`_EFState` / :class:`_EFShardState`) plus the
+    integrity :class:`~.common.integrity.GuardState` — policy code,
+    non-finite step count, good-step streak, dynamic loss scale."""
+
+    inner: Any
+    guard: integrity_lib.GuardState
+
+
 # -- error-feedback quantized reduction (compression="int8_ef") -------------
 
 class _EFState(NamedTuple):
@@ -385,7 +407,8 @@ def DistributedOptimizer(optimizer,
                          quantized_cross: bool = False,
                          overlap: bool = False,
                          bucket_order=None,
-                         quantize_min_bucket_bytes: Optional[int] = None):
+                         quantize_min_bucket_bytes: Optional[int] = None,
+                         nonfinite_policy: Optional[str] = None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -428,6 +451,19 @@ def DistributedOptimizer(optimizer,
     quantized — smaller float buckets ride bf16. Requires a SUM/AVERAGE
     op; composes with ``overlap`` but not with ``hierarchical`` (use
     ``quantized_cross`` for the int8 DCN hop of the staged pipeline).
+
+    ``nonfinite_policy`` (None → ``HVD_TPU_NONFINITE_POLICY`` /
+    ``init(nonfinite_policy=)``; docs/integrity.md) arms the
+    training-integrity guard: an all-finite flag over the gradients is
+    globally agreed via a one-scalar min-allreduce and a jit-safe
+    ``lax.cond`` reacts identically on every rank — ``warn`` |
+    ``skip_step`` (zero updates, optimizer state AND the int8_ef
+    error-feedback residual untouched) | ``zero`` | ``scale_backoff``
+    (dynamic loss scaling: multiply your loss by
+    ``hvd.current_loss_scale(opt_state)``) | ``abort`` (skip in-trace,
+    ``hvd.observe_guard(opt_state)`` raises host-side). The state is
+    wrapped in :class:`_GuardedState`; observe with
+    ``hvd.observe_guard``.
     """
     try:
         import optax
@@ -456,6 +492,9 @@ def DistributedOptimizer(optimizer,
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
     quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
         quantize_min_bucket_bytes)
+    nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+        nonfinite_policy)
+    scale_cfg = integrity_lib.ScaleConfig.from_env()
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
@@ -487,12 +526,35 @@ def DistributedOptimizer(optimizer,
                                               params, **extra)
         return updates, _EFState(new_inner, new_res, state.step + 1)
 
+    # Non-finite guard (docs/integrity.md): wraps the WHOLE core —
+    # reduction + inner update — in the globally-agreed lax.cond, so a
+    # skipped step leaves inner state, EF residual, and EF step counter
+    # untouched. The k>1 aggregation below wraps THIS, so each
+    # effective (post-accumulation) step is what gets guarded.
+    if nonfinite_policy is None:
+        u_init, u_update = core_init, core_update
+    else:
+        def u_init(params):
+            return _GuardedState(
+                inner=core_init(params),
+                guard=integrity_lib.init_guard_state(nonfinite_policy,
+                                                     scale_cfg))
+
+        def u_update(grads, state, params=None, **extra):
+            def fn(g, c):
+                return core_update(g, c, params, **extra)
+
+            updates, new_inner, new_guard = integrity_lib.guarded_apply(
+                nonfinite_policy, fn, grads, state.inner, state.guard,
+                axis_name, scale_cfg)
+            return updates, _GuardedState(new_inner, new_guard)
+
     if k <= 1:
-        return optax.GradientTransformation(core_init, core_update)
+        return optax.GradientTransformation(u_init, u_update)
 
     def init_fn(params):
         acc = jax.tree.map(jnp.zeros_like, params)
-        return _AggState(inner=core_init(params), acc=acc,
+        return _AggState(inner=u_init(params), acc=acc,
                          counter=jnp.zeros((), jnp.int32))
 
     def update_fn(grads, state, params=None, **extra):
@@ -505,8 +567,8 @@ def DistributedOptimizer(optimizer,
             scale = (1.0 / k) if average_aggregated_gradients else 1.0
             scaled = jax.tree.map(lambda g: g * scale, acc) \
                 if scale != 1.0 else acc
-            updates, new_inner = core_update(scaled, inner, params,
-                                             **extra)
+            updates, new_inner = u_update(scaled, inner, params,
+                                          **extra)
             zeroed = jax.tree.map(jnp.zeros_like, acc)
             return updates, new_inner, zeroed
 
@@ -532,7 +594,8 @@ def DistributedGradFn(grad_fn: Callable,
                       reduce_value: bool = True,
                       overlap: bool = False,
                       bucket_order=None,
-                      quantize_min_bucket_bytes: Optional[int] = None):
+                      quantize_min_bucket_bytes: Optional[int] = None,
+                      nonfinite_policy: Optional[str] = None):
     """DistributedGradientTape analog (reference
     tensorflow/__init__.py:564-629): wraps a function returning gradients
     (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
@@ -559,6 +622,20 @@ def DistributedGradFn(grad_fn: Callable,
     ``ef_state=None`` starts from a zero residual (valid, but the
     residual is then discarded each call — quantization error no longer
     cancels across steps; thread the state for fp32-like convergence).
+
+    ``nonfinite_policy`` (docs/integrity.md) arms the non-finite guard:
+    the wrapped function grows a ``guard_state`` keyword and APPENDS
+    the new guard state to its returns — ``(grads, guard)``, or
+    ``(grads, ef_state, guard)`` with an error-feedback compression.
+    On a globally-agreed non-finite step the returned gradients are
+    zeros and (under ``skip_step``/``scale_backoff``/``abort``) the EF
+    residual is NOT updated; gate your own optimizer update on
+    ``guard.last_ok`` if zero gradients are not a no-op for it. Seed
+    with ``wrapped.init_guard_state()``. EXPLICIT-ONLY on this surface:
+    the ``HVD_TPU_NONFINITE_POLICY`` env default is deliberately NOT
+    consulted here — the guard changes the wrapped function's return
+    arity, and an env knob must never silently break existing call
+    sites (the optimizer surfaces, whose state is opaque, do honor it).
     """
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
@@ -569,6 +646,9 @@ def DistributedGradFn(grad_fn: Callable,
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
     quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
         quantize_min_bucket_bytes)
+    nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+        nonfinite_policy) if nonfinite_policy is not None else None
+    scale_cfg = integrity_lib.ScaleConfig.from_env()
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
@@ -582,8 +662,13 @@ def DistributedGradFn(grad_fn: Callable,
                 val)
         return val
 
+    def _guard_or_init(guard_state):
+        if guard_state is not None:
+            return guard_state
+        return integrity_lib.init_guard_state(nonfinite_policy, scale_cfg)
+
     if ef:
-        def wrapped(*args, ef_state=None, **kwargs):
+        def wrapped(*args, ef_state=None, guard_state=None, **kwargs):
             out = grad_fn(*args, **kwargs)
             val, grads = out if has_value else (None, out)
             if ef_state is None:
@@ -591,29 +676,65 @@ def DistributedGradFn(grad_fn: Callable,
                 step = jnp.zeros((), jnp.int32)
             else:
                 residual, step = ef_state.residual, ef_state.step
-            reduced, new_res = _reduce_tree_ef(
-                grads, residual, step, op, axis_name,
-                fusion_threshold_bytes, overlap=overlap,
-                bucket_order=bucket_order,
-                quantize_min_bytes=quantize_min_bucket_bytes)
+
+            def reduce_ef(g, carry):
+                res, stp = carry
+                red, new_res = _reduce_tree_ef(
+                    g, res, stp, op, axis_name,
+                    fusion_threshold_bytes, overlap=overlap,
+                    bucket_order=bucket_order,
+                    quantize_min_bytes=quantize_min_bucket_bytes)
+                return red, (new_res, stp + 1)
+
+            if nonfinite_policy is None:
+                reduced, (new_res, new_step) = reduce_ef(
+                    grads, (residual, step))
+                new_state = _EFState(inner=None, residual=new_res,
+                                     step=new_step)
+                if has_value:
+                    return (_reduce_value(val), reduced), new_state
+                return reduced, new_state
+            # Guarded: the cond wraps the whole quantized reduction, so
+            # a skipped step leaves residual AND step counter untouched
+            # (the error-feedback telescoping stays exact).
+            reduced, (new_res, new_step), new_guard = \
+                integrity_lib.guarded_apply(
+                    nonfinite_policy, reduce_ef, grads, (residual, step),
+                    _guard_or_init(guard_state), axis_name, scale_cfg)
             new_state = _EFState(inner=None, residual=new_res,
-                                 step=step + 1)
+                                 step=new_step)
             if has_value:
-                return (_reduce_value(val), reduced), new_state
-            return reduced, new_state
+                return (_reduce_value(val), reduced), new_state, new_guard
+            return reduced, new_state, new_guard
 
         wrapped.init_ef_state = lambda grads_template: _EFState(
             inner=None, residual=_zeros_residual(grads_template),
             step=jnp.zeros((), jnp.int32))
+        if nonfinite_policy is not None:
+            wrapped.init_guard_state = lambda: integrity_lib. \
+                init_guard_state(nonfinite_policy, scale_cfg)
         return wrapped
 
-    def wrapped(*args, **kwargs):
+    def wrapped(*args, guard_state=None, **kwargs):
         out = grad_fn(*args, **kwargs)
         if has_value:
             val, grads = out
-            return _reduce_value(val), reduce_grads(grads)
-        return reduce_grads(out)
+        else:
+            val, grads = None, out
+        if nonfinite_policy is None:
+            if has_value:
+                return _reduce_value(val), reduce_grads(grads)
+            return reduce_grads(grads)
+        reduced, _, new_guard = integrity_lib.guarded_apply(
+            nonfinite_policy, lambda g, c: (reduce_grads(g), c), grads,
+            (), _guard_or_init(guard_state), axis_name, scale_cfg)
+        if has_value:
+            return (_reduce_value(val), reduced), new_guard
+        return reduced, new_guard
 
+    if nonfinite_policy is not None:
+        wrapped.init_guard_state = lambda: integrity_lib. \
+            init_guard_state(nonfinite_policy, scale_cfg)
     return wrapped
 
 
@@ -884,7 +1005,7 @@ def _qpad_len(total_elems: int, n: int) -> int:
 
 def sharded_init(tx, params, axis_name: str = "hvd",
                  fusion_threshold_bytes: Optional[int] = None,
-                 compression=None):
+                 compression=None, nonfinite_policy: Optional[str] = None):
     """Inner-optimizer state over FUSED-BUCKET SHARDS — call inside the
     same shard_map/jit region as :func:`sharded_update` (the shard
     shapes depend on the bound axis). State structure = the inner
@@ -895,11 +1016,15 @@ def sharded_init(tx, params, axis_name: str = "hvd",
     the error-feedback residual + step counter (:class:`_EFShardState`);
     shard chunks align to the 4096-element int8 block grid, so a state
     built with compression can only be consumed by an update using the
-    SAME compression (and vice versa)."""
+    SAME compression (and vice versa). ``nonfinite_policy`` likewise
+    wraps the state in :class:`_GuardedState` (docs/integrity.md) —
+    init and update must agree on it."""
     _require_axis(axis_name, "sharded_init")
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
     ef = getattr(compression, "error_feedback", False)
+    nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+        nonfinite_policy)
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
     plan = fusion_lib.plan_fusion(params, threshold)
     flats = fusion_lib.fuse(params, plan)
@@ -907,19 +1032,24 @@ def sharded_init(tx, params, axis_name: str = "hvd",
 
     align = _Q_BLOCK if ef else 1
     inner = tx.init([_shard_flat(f, axis_name, align) for f in flats])
-    if not ef:
+    if ef:
+        n = jax.lax.axis_size(axis_name)
+        residual = [jnp.zeros((_qpad_len(b.total_elems, n),), jnp.float32)
+                    for b in plan.buckets]
+        inner = _EFShardState(inner=inner, residual=residual,
+                              step=jnp.zeros((), jnp.int32))
+    if nonfinite_policy is None:
         return inner
-    n = jax.lax.axis_size(axis_name)
-    residual = [jnp.zeros((_qpad_len(b.total_elems, n),), jnp.float32)
-                for b in plan.buckets]
-    return _EFShardState(inner=inner, residual=residual,
-                         step=jnp.zeros((), jnp.int32))
+    return _GuardedState(
+        inner=inner,
+        guard=integrity_lib.init_guard_state(nonfinite_policy))
 
 
 def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
                    grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                    fusion_threshold_bytes: Optional[int] = None,
-                   compression=None, **extra):
+                   compression=None,
+                   nonfinite_policy: Optional[str] = None, **extra):
     """ZeRO-1 step over fused buckets: RS(bucket grads) -> inner update
     on this rank's shards -> AG(bucket updates). A few large collectives
     instead of one pair per leaf (same bucketing as the replicated
@@ -938,12 +1068,22 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
     _require_axis(axis_name, "sharded_update")
     compression = _resolve_compression(compression)
     ef = getattr(compression, "error_feedback", False)
-    if ef != isinstance(state, _EFShardState):
+    nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+        nonfinite_policy)
+    guarded = isinstance(state, _GuardedState)
+    if (nonfinite_policy is not None) != guarded:
+        raise ValueError(
+            "sharded_update nonfinite_policy= must match the "
+            "sharded_init that built this state: policy="
+            f"{nonfinite_policy}, state "
+            f"{'carries' if guarded else 'lacks'} a guard")
+    inner_state = state.inner if guarded else state
+    if ef != isinstance(inner_state, _EFShardState):
         raise ValueError(
             "sharded_update compression= must match the sharded_init that "
             "built this state (error-feedback state and shard alignment "
             f"differ): compression={compression.__name__}, state "
-            f"{'has' if isinstance(state, _EFShardState) else 'lacks'} "
+            f"{'has' if isinstance(inner_state, _EFShardState) else 'lacks'} "
             "an error-feedback residual")
     n = jax.lax.axis_size(axis_name)
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
@@ -951,42 +1091,54 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
     # over the params plan, and a grad leaf cast to another dtype must
     # not change the bucket structure out from under the carried state.
     plan = fusion_lib.plan_fusion(params, threshold)
-    g_flats = fusion_lib.fuse(
-        jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params),
-        plan)
     p_flats = fusion_lib.fuse(params, plan)
 
-    if not ef:
-        def rs(f):
-            padded, _ = fusion_lib.pad_to_multiple(f, n)
-            return C.reducescatter(padded, grad_op, axis_name)
+    def core(g, st):
+        """RS -> shard-local inner update -> AG; returns
+        (updates_tree ≅ grads, new_inner_state)."""
+        g_flats = fusion_lib.fuse(
+            jax.tree.map(lambda gg, p: gg.astype(p.dtype), g, params),
+            plan)
+        if not ef:
+            def rs(f):
+                padded, _ = fusion_lib.pad_to_multiple(f, n)
+                return C.reducescatter(padded, grad_op, axis_name)
 
-        g_shards = [rs(f) for f in g_flats]
-        p_shards = [_shard_flat(f, axis_name) for f in p_flats]
-        u_shards, new_state = tx.update(g_shards, state, p_shards, **extra)
+            g_shards = [rs(f) for f in g_flats]
+            p_shards = [_shard_flat(f, axis_name) for f in p_flats]
+            u_shards, new_st = tx.update(g_shards, st, p_shards, **extra)
+            u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
+                       for u, f in zip(u_shards, g_flats)]
+            return fusion_lib.unfuse(u_flats, plan), new_st
+
+        from .ops.collectives import _Q_BLOCK
+
+        g_shards, new_residual = [], []
+        for i, (f, res) in enumerate(zip(g_flats, st.residual)):
+            pad = res.shape[0] - f.shape[0]
+            corrected = jnp.pad(f.astype(jnp.float32), (0, pad)) + res
+            shard, r = C.quantized_reducescatter(
+                corrected, grad_op, axis_name,
+                key=_ef_key(st.step, i), return_residual=True)
+            g_shards.append(shard.astype(f.dtype))
+            new_residual.append(r)
+        p_shards = [_shard_flat(f, axis_name, _Q_BLOCK) for f in p_flats]
+        u_shards, new_inner = tx.update(g_shards, st.inner, p_shards,
+                                        **extra)
         u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
                    for u, f in zip(u_shards, g_flats)]
-        return fusion_lib.unfuse(u_flats, plan), new_state
+        new_st = _EFShardState(inner=new_inner, residual=new_residual,
+                               step=st.step + 1)
+        return fusion_lib.unfuse(u_flats, plan), new_st
 
-    from .ops.collectives import _Q_BLOCK
-
-    g_shards, new_residual = [], []
-    for i, (f, res) in enumerate(zip(g_flats, state.residual)):
-        pad = res.shape[0] - f.shape[0]
-        corrected = jnp.pad(f.astype(jnp.float32), (0, pad)) + res
-        shard, r = C.quantized_reducescatter(
-            corrected, grad_op, axis_name,
-            key=_ef_key(state.step, i), return_residual=True)
-        g_shards.append(shard.astype(f.dtype))
-        new_residual.append(r)
-    p_shards = [_shard_flat(f, axis_name, _Q_BLOCK) for f in p_flats]
-    u_shards, new_inner = tx.update(g_shards, state.inner, p_shards,
-                                    **extra)
-    u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
-               for u, f in zip(u_shards, g_flats)]
-    new_state = _EFShardState(inner=new_inner, residual=new_residual,
-                              step=state.step + 1)
-    return fusion_lib.unfuse(u_flats, plan), new_state
+    if not guarded:
+        return core(grads, inner_state)
+    # Guarded (docs/integrity.md): the cond wraps RS + update + AG, so
+    # a skipped step leaves shards, EF residual and step untouched.
+    updates, new_inner, new_guard = integrity_lib.guarded_apply(
+        nonfinite_policy, core, grads, inner_state, state.guard,
+        axis_name)
+    return updates, _GuardedState(new_inner, new_guard)
 
 
 class ShardedOptimizer:
@@ -1002,7 +1154,7 @@ class ShardedOptimizer:
     def __init__(self, inner, axis_name: str = "hvd",
                  grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                  fusion_threshold_bytes: Optional[int] = None,
-                 compression=None):
+                 compression=None, nonfinite_policy: Optional[str] = None):
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
@@ -1011,16 +1163,21 @@ class ShardedOptimizer:
         # threshold between traces must not replan the buckets out from
         # under the carried state. Same for the compression: it decides
         # the shard alignment and the state structure (_EFShardState).
+        # And the non-finite policy: it decides whether the state is
+        # _GuardedState-wrapped (docs/integrity.md).
         self.fusion_threshold_bytes = _resolve_fusion_threshold(
             fusion_threshold_bytes)
         self.compression = _resolve_compression(compression)
         _check_reduce_safe(self.compression)
         self._ef = getattr(self.compression, "error_feedback", False)
+        self.nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+            nonfinite_policy)
 
     def init(self, params):
         return sharded_init(self.inner, params, self.axis_name,
                             self.fusion_threshold_bytes,
-                            compression=self.compression)
+                            compression=self.compression,
+                            nonfinite_policy=self.nonfinite_policy)
 
     def update(self, grads, state, params=None, **extra):
         if params is None:
@@ -1029,7 +1186,9 @@ class ShardedOptimizer:
         return sharded_update(self.inner, grads, state, params,
                               self.axis_name, self.grad_op,
                               self.fusion_threshold_bytes,
-                              compression=self.compression, **extra)
+                              compression=self.compression,
+                              nonfinite_policy=self.nonfinite_policy,
+                              **extra)
 
     def state_specs(self, params):
         """PartitionSpecs for carrying the sharded state through
@@ -1047,12 +1206,16 @@ class ShardedOptimizer:
         plan = fusion_lib.plan_fusion(params, threshold)
         inner_specs = _sharded_state_specs(self.inner, plan,
                                            self.axis_name)
-        if not self._ef:
+        if self._ef:
+            inner_specs = _EFShardState(
+                inner=inner_specs,
+                residual=[P(self.axis_name)] * len(plan.buckets),
+                step=P())
+        if self.nonfinite_policy is None:
             return inner_specs
-        return _EFShardState(
-            inner=inner_specs,
-            residual=[P(self.axis_name)] * len(plan.buckets),
-            step=P())
+        # Guard scalars are globally agreed -> replicated.
+        return _GuardedState(inner=inner_specs,
+                             guard=integrity_lib.guard_state_specs())
 
     def gather_state(self, state, params):
         """Sharded state -> world-size-independent full state (inside
@@ -1075,23 +1238,39 @@ class ShardedOptimizer:
         _require_axis(self.axis_name, "ShardedOptimizer.gather_state")
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
+        guard = state.guard if isinstance(state, _GuardedState) else None
+        if guard is not None:
+            state = state.inner
         if not self._ef:
-            return _gather_sharded_state(self.inner, plan, state,
+            full = _gather_sharded_state(self.inner, plan, state,
                                          self.axis_name)
-        inner_full = _gather_sharded_state(self.inner, plan, state.inner,
-                                           self.axis_name)
-        residual_full = [
-            jax.lax.psum(r, self.axis_name)[:b.total_elems]
-            for r, b in zip(state.residual, plan.buckets)]
-        return _EFShardState(inner=inner_full, residual=residual_full,
-                             step=state.step)
+        else:
+            inner_full = _gather_sharded_state(self.inner, plan,
+                                               state.inner,
+                                               self.axis_name)
+            residual_full = [
+                jax.lax.psum(r, self.axis_name)[:b.total_elems]
+                for r, b in zip(state.residual, plan.buckets)]
+            full = _EFShardState(inner=inner_full, residual=residual_full,
+                                 step=state.step)
+        if guard is None:
+            return full
+        # Guard scalars are replicated/world-size-independent — carried
+        # across the resize verbatim.
+        return _GuardedState(inner=full, guard=guard)
 
     def reshard_state(self, state_full):
         """Full (gathered) state -> this world's 1/n shards (inside the
         NEW world's SPMD region, whatever its size)."""
         _require_axis(self.axis_name, "ShardedOptimizer.reshard_state")
+        guard = state_full.guard \
+            if isinstance(state_full, _GuardedState) else None
+        if guard is not None:
+            state_full = state_full.inner
         if not self._ef:
-            return _reshard_state(state_full, self.axis_name)
+            sharded = _reshard_state(state_full, self.axis_name)
+            return sharded if guard is None else \
+                _GuardedState(inner=sharded, guard=guard)
         from .ops.collectives import _Q_BLOCK
 
         n = jax.lax.axis_size(self.axis_name)
@@ -1105,8 +1284,10 @@ class ShardedOptimizer:
             pad = _qpad_len(r.shape[0], n) - r.shape[0]
             r = jnp.pad(r, (0, pad))
             residual.append(jnp.where(me == 0, r, jnp.zeros_like(r)))
-        return _EFShardState(inner=inner, residual=residual,
-                             step=state_full.step)
+        sharded = _EFShardState(inner=inner, residual=residual,
+                                step=state_full.step)
+        return sharded if guard is None else \
+            _GuardedState(inner=sharded, guard=guard)
 
 
 # -- FSDP / ZeRO-3: fully-sharded parameters (beyond the reference) ---------

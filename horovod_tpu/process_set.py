@@ -96,5 +96,7 @@ def _build_engine(ctx, ps: ProcessSet):
                              timeline=ctx.timeline,
                              stall_inspector=ctx.stall,
                              hier_mesh=None, controller=None,
-                             autotuner=None)
+                             autotuner=None,
+                             ps_tag="ps:" + ",".join(
+                                 str(r) for r in ps.ranks))
     return ps

@@ -86,8 +86,11 @@ def test_missing_rank_times_out():
     transport = InMemoryTransport()
     n = 2
     c0 = Controller(0, n, transport, timeout_s=0.2)
-    # Rank 1 never submits; coordinator must error, not hang.
-    with pytest.raises(TensorShapeMismatchError):
+    # Rank 1 never submits; coordinator must error, not hang — and a
+    # missing rank is a RUNTIME failure (dead/hung peer), so it raises
+    # the comm-classified HorovodInternalError that elastic recovery
+    # retries, not the program-bug TensorShapeMismatchError.
+    with pytest.raises(HorovodInternalError, match="did not submit"):
         c0.negotiate(_req(0))
 
 

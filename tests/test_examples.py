@@ -31,6 +31,18 @@ def test_mnist_example(tmp_path):
     assert "loss" in out.lower()
 
 
+def test_mnist_guard_example(tmp_path):
+    """--guard: scale_backoff over the overflow-prone fp16 loss + one
+    injected NaN batch, recovery visible in the metrics snapshot
+    (docs/integrity.md)."""
+    out = _run(["examples/mnist_train.py", "--epochs", "1",
+                "--batch-size", "64", "--guard",
+                "--ckpt-dir", str(tmp_path / "ckpt")])
+    assert "guard summary" in out
+    assert "hvd_tpu_nonfinite_steps_total" in out
+    assert "'nonfinite_steps': 0" not in out  # the injection was seen
+
+
 def test_keras_mnist_example(tmp_path):
     pytest.importorskip("keras")
     out = _run(["examples/keras_mnist.py", "--epochs", "1",

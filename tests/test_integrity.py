@@ -1,0 +1,703 @@
+"""Training-integrity guard (common/integrity.py; docs/integrity.md):
+non-finite gradient policies on every optimizer surface, cross-rank
+divergence detection + resync, the named-rank contract check
+(MismatchError), and the chaos e2e acceptance run — a seeded FaultPlan
+injecting a NaN gradient, a diverged replica, and a corrupted latest
+checkpoint into one guarded int8_ef MLP run that must finish healthy."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd_mod
+from horovod_tpu.common import faults as faults_lib
+from horovod_tpu.common import integrity
+from horovod_tpu.common.exceptions import (DivergenceError, MismatchError,
+                                           NonFiniteError, StallError,
+                                           StallTimeoutError,
+                                           TensorShapeMismatchError)
+from horovod_tpu.optim import _EFState, _GuardedState
+
+
+# -- policy resolution / plumbing -------------------------------------------
+
+def test_resolve_policy_validates():
+    assert integrity.resolve_nonfinite_policy("skip_step") == "skip_step"
+    assert integrity.resolve_nonfinite_policy("off") is None
+    with pytest.raises(ValueError, match="unknown non-finite policy"):
+        integrity.resolve_nonfinite_policy("exploded")
+    with pytest.raises(ValueError, match="unknown divergence policy"):
+        integrity.resolve_diverge_policy("yolo")
+
+
+def test_fault_plan_parses_new_sites():
+    plan = faults_lib.FaultPlan.from_json(json.dumps({
+        "seed": 3, "faults": [
+            {"site": "nonfinite", "step": 2, "mode": "inf"},
+            {"site": "diverge", "step": 4, "target": "1", "scale": 2.5},
+            {"site": "checkpoint_corrupt", "step": 1,
+             "mode": "truncate"},
+        ]}))
+    assert [f.site for f in plan.faults] == [
+        "nonfinite", "diverge", "checkpoint_corrupt"]
+    assert plan.faults[1].scale == 2.5
+
+
+def test_all_finite_and_sanitize():
+    tree = {"a": jnp.asarray([1.0, np.nan]), "b": jnp.asarray([1, 2]),
+            "c": jnp.asarray([np.inf, 3.0])}
+    assert not bool(integrity.all_finite(tree))
+    clean = integrity.sanitize(tree)
+    assert bool(integrity.all_finite(clean))
+    np.testing.assert_array_equal(np.asarray(clean["a"]), [1.0, 0.0])
+    np.testing.assert_array_equal(np.asarray(clean["b"]), [1, 2])
+    assert bool(integrity.all_finite({"ok": jnp.ones(3)}))
+
+
+# -- guard on the optimizer surfaces ----------------------------------------
+
+def _stacked_grads(hvd, shape=(4, 3), bad_rank=None, bad=np.nan):
+    g = np.ones((hvd.size(),) + shape, np.float32)
+    if bad_rank is not None:
+        g[bad_rank].flat[0] = bad
+    return {"w": jnp.asarray(g)}
+
+
+def _guarded_sgd(hvd, policy, **kw):
+    return hvd_mod.DistributedOptimizer(
+        optax.sgd(0.1), axis_name=hvd.rank_axis(),
+        nonfinite_policy=policy, **kw)
+
+
+def _step_fn(hvd, tx):
+    @hvd_mod.spmd_step(in_specs=(P(), P(), P(hvd.rank_axis())),
+                       out_specs=(P(), P()))
+    def step(p, st, gs):
+        g = jax.tree.map(lambda v: v[0], gs)
+        u, st = tx.update(g, st, p)
+        return optax.apply_updates(p, u), st
+
+    return step
+
+
+def test_skip_step_protects_state_and_params(hvd):
+    params = {"w": jnp.ones((4, 3), jnp.float32)}
+    tx = _guarded_sgd(hvd, "skip_step")
+    s = tx.init(params)
+    assert isinstance(s, _GuardedState)
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, _stacked_grads(hvd))
+    assert not np.array_equal(np.asarray(p1["w"]),
+                              np.asarray(params["w"]))
+    # One rank's single NaN lane -> globally-agreed skip everywhere.
+    p2, s2 = step(p1, s1, _stacked_grads(hvd, bad_rank=5))
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.asarray(p1["w"]))
+    snap = hvd.observe_guard(s2)
+    assert snap["nonfinite_steps"] == 1 and not snap["last_ok"]
+    # A good step resumes normally.
+    p3, s3 = step(p2, s2, _stacked_grads(hvd))
+    assert not np.array_equal(np.asarray(p3["w"]), np.asarray(p2["w"]))
+    assert hvd.observe_guard(s3)["last_ok"]
+
+
+def test_warn_and_zero_policies(hvd):
+    params = {"w": jnp.ones((4, 3), jnp.float32)}
+    # warn: the poisoned update goes through (params poisoned) but the
+    # step is counted.
+    tx = _guarded_sgd(hvd, "warn")
+    s = tx.init(params)
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, _stacked_grads(hvd, bad_rank=0))
+    assert not np.isfinite(np.asarray(p1["w"])).all()
+    assert hvd.observe_guard(s1)["nonfinite_steps"] == 1
+    # zero: non-finite entries dropped, the rest of the update applies.
+    tx = _guarded_sgd(hvd, "zero")
+    s = tx.init(params)
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, _stacked_grads(hvd, bad_rank=0))
+    w = np.asarray(p1["w"])
+    assert np.isfinite(w).all()
+    assert hvd.observe_guard(s1)["nonfinite_steps"] == 1
+
+
+def test_scale_backoff_dynamics(hvd):
+    params = {"w": jnp.ones((4, 3), jnp.float32)}
+    tx = _guarded_sgd(hvd, "scale_backoff")
+    s = tx.init(params)
+    scale0 = float(np.asarray(s.guard.loss_scale))
+    assert scale0 > 1.0
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, _stacked_grads(hvd, bad_rank=2, bad=np.inf))
+    # Bad step: skipped + scale backed off.
+    np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                  np.asarray(params["w"]))
+    assert float(np.asarray(s1.guard.loss_scale)) == scale0 * 0.5
+    # Good step: gradients are UNSCALED by the carried scale before the
+    # update — grads of (loss * scale) land as if unscaled.
+    half = scale0 * 0.5
+    gs = {"w": jnp.asarray(
+        np.full((hvd.size(), 4, 3), half, np.float32))}
+    p2, _s2 = step(p1, s1, gs)
+    np.testing.assert_allclose(np.asarray(p2["w"]),
+                               np.asarray(p1["w"]) - 0.1, rtol=1e-5)
+
+
+def test_abort_policy_raises_on_observe(hvd):
+    params = {"w": jnp.ones((2, 2), jnp.float32)}
+    tx = _guarded_sgd(hvd, "abort")
+    s = tx.init(params)
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, _stacked_grads(hvd, shape=(2, 2),
+                                            bad_rank=1))
+    # In-trace the step was skipped (state protected)...
+    np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                  np.asarray(params["w"]))
+    # ...and the host observation raises.
+    with pytest.raises(NonFiniteError, match="abort"):
+        hvd.observe_guard(s1)
+
+
+def test_skip_step_leaves_ef_residual_untouched(hvd):
+    """int8_ef composition: on a skipped step the error-feedback
+    residual AND its stochastic-rounding step counter stay untouched
+    (the telescoping stays exact)."""
+    params = {"w": jnp.ones((64, 8), jnp.float32)}
+    tx = _guarded_sgd(hvd, "skip_step", compression="int8_ef",
+                      quantize_min_bucket_bytes=0)
+    s = tx.init(params)
+    step = _step_fn(hvd, tx)
+    p1, s1 = step(params, s, {"w": jnp.asarray(
+        np.random.default_rng(0).standard_normal(
+            (hvd.size(), 64, 8)).astype(np.float32))})
+    assert isinstance(s1.inner, _EFState)
+    ef_step_before = int(np.asarray(s1.inner.step))
+    res_before = [np.asarray(l) for l in jax.tree.leaves(
+        s1.inner.residual)]
+    p2, s2 = step(p1, s1, _stacked_grads(hvd, shape=(64, 8),
+                                         bad_rank=4))
+    assert int(np.asarray(s2.inner.step)) == ef_step_before
+    for a, b in zip(res_before, jax.tree.leaves(s2.inner.residual)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                  np.asarray(p1["w"]))
+
+
+def test_gradfn_guard_appends_state(hvd):
+    gfn = hvd_mod.DistributedGradFn(
+        jax.grad(lambda p, x: jnp.sum(p["w"] * x)),
+        axis_name=hvd.rank_axis(), nonfinite_policy="skip_step")
+    gs = gfn.init_guard_state()
+    specs = integrity.guard_state_specs()
+
+    @hvd_mod.spmd_step(in_specs=(P(), P(hvd.rank_axis()), specs),
+                       out_specs=(P(), specs))
+    def gstep(p, x, gu):
+        return gfn(p, x[0], guard_state=gu)
+
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    x = np.ones((hvd.size(), 4, 4), np.float32)
+    g, gs = gstep(params, jnp.asarray(x), gs)
+    np.testing.assert_allclose(np.asarray(g["w"]), 1.0)
+    x[3, 1, 1] = np.inf
+    g, gs = gstep(params, jnp.asarray(x), gs)
+    np.testing.assert_array_equal(np.asarray(g["w"]), 0.0)
+    assert int(np.asarray(gs.nonfinite_steps)) == 1
+
+
+def test_zero1_guard_mismatch_raises(hvd):
+    from horovod_tpu import sharded_init, sharded_update
+
+    ax = hvd.rank_axis()
+    p0 = {"w": jnp.zeros((64,), jnp.float32)}
+
+    @hvd_mod.spmd_step(in_specs=(P(),), out_specs=P())
+    def go(xb):
+        s = sharded_init(optax.sgd(0.1), p0, ax)  # no guard
+        u, _ = sharded_update(optax.sgd(0.1), p0, s, p0, ax,
+                              nonfinite_policy="skip_step")
+        return xb
+
+    with pytest.raises(ValueError, match="nonfinite_policy"):
+        go(jnp.zeros((8, 1), jnp.float32))
+
+
+# -- divergence detection ----------------------------------------------------
+
+def test_fingerprint_moves_on_perturbation():
+    tree = {"w": jnp.arange(1000, dtype=jnp.float32)}
+    a = np.asarray(integrity.fingerprint(tree))
+    perturbed = {"w": jnp.arange(1000, dtype=jnp.float32)
+                 .at[500].add(0.1)}
+    b = np.asarray(integrity.fingerprint(perturbed))
+    assert not np.array_equal(a, b)
+    assert integrity.fingerprint_digest(tree) != \
+        integrity.fingerprint_digest(perturbed)
+    assert integrity.fingerprint_digest(tree) == \
+        integrity.fingerprint_digest({"w": jnp.arange(
+            1000, dtype=jnp.float32)})
+
+
+def test_divergence_guard_resyncs_from_rank0(hvd):
+    ax = hvd.rank_axis()
+    w = np.ones((hvd.size(), 6), np.float32)
+    w[3] += 0.5  # one silently diverged replica
+
+    @hvd_mod.spmd_step(in_specs=(P(ax), P()), out_specs=(P(ax), P(), P()))
+    def dstep(ps, i):
+        p = jax.tree.map(lambda v: v[0], ps)
+        p, checked, div = integrity.divergence_guard(
+            p, i, ax, every=2, policy="resync")
+        return jax.tree.map(lambda v: v[None], p), checked, div
+
+    # Off-cadence step: no check, divergence survives.
+    ps, checked, div = dstep({"w": jnp.asarray(w)},
+                             jnp.asarray(1, jnp.int32))
+    assert not bool(checked) and not bool(div)
+    assert not np.array_equal(np.asarray(ps["w"])[3],
+                              np.asarray(ps["w"])[0])
+    # On-cadence: detected + healed to rank 0's replica everywhere.
+    ps, checked, div = dstep(ps, jnp.asarray(2, jnp.int32))
+    assert bool(checked) and bool(div)
+    out = np.asarray(ps["w"])
+    for r in range(hvd.size()):
+        np.testing.assert_array_equal(out[r], out[0])
+    before = faults_lib.stats.snapshot()["divergence_resyncs"]
+    assert integrity.record_divergence(checked, div, policy="resync")
+    assert faults_lib.stats.snapshot()["divergence_resyncs"] == before + 1
+
+
+def test_divergence_detector_names_offenders():
+    """Host-side cross-process detector over the controller KV: the
+    minority digest names the offending ranks; abort raises."""
+    from horovod_tpu.common.controller import Controller, InMemoryTransport
+
+    transport = InMemoryTransport()
+    results = {}
+
+    def worker(rank, tree, policy):
+        c = Controller(rank, 3, transport, timeout_s=10.0)
+        det = integrity.DivergenceDetector(every_steps=1, policy=policy,
+                                           controller=c)
+        try:
+            results[rank] = det.check(tree, step=0)
+        except DivergenceError as e:
+            results[rank] = e
+
+    good = {"w": jnp.arange(8.0)}
+    bad = {"w": jnp.arange(8.0).at[0].add(1.0)}
+    threads = [threading.Thread(target=worker, args=(r, t, "warn"))
+               for r, t in enumerate([good, good, bad])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in range(3):
+        assert results[r]["ranks"] == (2,), results
+        assert not results[r]["ok"]
+
+    results.clear()
+    threads = [threading.Thread(target=worker, args=(r, t, "abort"))
+               for r, t in enumerate([good, good, bad])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in range(3):
+        assert isinstance(results[r], DivergenceError), results
+        assert results[r].ranks == (2,)
+
+
+# -- contract check (MismatchError naming ranks) -----------------------------
+
+def test_mismatch_error_is_typed_and_named():
+    from horovod_tpu.common.controller import (Controller,
+                                               InMemoryTransport, Request)
+
+    transport = InMemoryTransport()
+    errors = {}
+
+    def worker(rank, shape):
+        c = Controller(rank, 3, transport, timeout_s=10.0)
+        try:
+            c.negotiate(Request(rank, "allreduce", "grad", "float32",
+                                shape, 0))
+            errors[rank] = None
+        except TensorShapeMismatchError as e:
+            errors[rank] = e
+
+    shapes = [(4, 4), (4, 4), (8,)]  # rank 2 diverged
+    threads = [threading.Thread(target=worker, args=(r, s))
+               for r, s in enumerate(shapes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in range(3):
+        assert isinstance(errors[r], MismatchError), errors
+        assert errors[r].ranks == (2,)
+        assert "[2]" in str(errors[r])
+
+
+def test_mismatch_names_every_offender():
+    """The gather runs to completion: BOTH diverged ranks are named,
+    not just the first."""
+    from horovod_tpu.common.controller import (Controller,
+                                               InMemoryTransport, Request)
+
+    transport = InMemoryTransport()
+    errors = {}
+
+    def worker(rank, dtype):
+        c = Controller(rank, 4, transport, timeout_s=10.0)
+        try:
+            c.negotiate(Request(rank, "allreduce", "g", dtype, (4,), 0))
+        except TensorShapeMismatchError as e:
+            errors[rank] = e
+
+    dtypes = ["float32", "bfloat16", "float32", "float16"]
+    threads = [threading.Thread(target=worker, args=(r, d))
+               for r, d in enumerate(dtypes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors[0].ranks == (1, 3)
+
+
+def test_wire_dtype_divergence_is_a_contract_breach():
+    """Same shape/dtype/op but different reduction compression — the
+    int8_ef-vs-none config split that would compile diverged programs —
+    must be a named MismatchError, not a hang."""
+    from horovod_tpu.common.controller import (Controller,
+                                               InMemoryTransport, Request)
+
+    transport = InMemoryTransport()
+    errors = {}
+
+    def worker(rank, wire):
+        c = Controller(rank, 2, transport, timeout_s=10.0)
+        try:
+            c.negotiate(Request(rank, "allreduce", "g", "float32", (4,),
+                                0, wire_dtype=wire))
+            errors[rank] = None
+        except TensorShapeMismatchError as e:
+            errors[rank] = e
+
+    threads = [threading.Thread(target=worker, args=(r, w))
+               for r, w in enumerate(["Int8EFCompressor/qmin0",
+                                      "NoneCompressor"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert isinstance(errors[0], MismatchError)
+    assert errors[0].ranks == (1,)
+    assert "wire_dtype" in str(errors[0])
+
+
+_MISMATCH_SUBPROC = """
+import sys, threading, time
+sys.path.insert(0, {repo!r})
+from horovod_tpu.common.controller import (Controller, InMemoryTransport,
+                                           Request)
+from horovod_tpu.common.exceptions import MismatchError
+
+WINDOW_S = 5.0  # the stall-warning window the error must beat
+transport = InMemoryTransport()
+errors = {{}}
+
+
+def worker(rank, shape):
+    c = Controller(rank, 2, transport, timeout_s=WINDOW_S)
+    try:
+        c.negotiate(Request(rank, "allreduce", "grad", "float32",
+                            shape, 0))
+    except MismatchError as e:
+        errors[rank] = e
+
+
+t0 = time.monotonic()
+threads = [threading.Thread(target=worker, args=(r, s))
+           for r, s in enumerate([(4, 4), (2,)])]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+elapsed = time.monotonic() - t0
+assert elapsed < WINDOW_S, f"took {{elapsed}}s — hung past the window"
+assert set(errors) == {{0, 1}}, errors
+for e in errors.values():
+    assert e.ranks == (1,), e
+print(f"OK {{elapsed:.3f}}s ranks={{errors[0].ranks}}")
+"""
+
+
+def test_mismatch_subprocess_raises_within_stall_window():
+    """Acceptance: a signature mismatch across ranks raises
+    MismatchError naming the mismatching rank WITHIN the stall-warning
+    window instead of hanging (hermetic subprocess)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _MISMATCH_SUBPROC.format(repo=repo)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
+# -- stall fatal escalation --------------------------------------------------
+
+def test_stall_fatal_raise_mode_is_comm_classified():
+    from horovod_tpu.common.elastic import _is_comm_failure
+    from horovod_tpu.common.stall import StallInspector
+
+    insp = StallInspector(check_time_seconds=0.01,
+                          shutdown_time_seconds=0.02,
+                          fatal_mode="raise")
+    insp.record_submit("wedged")
+    time.sleep(0.05)
+    with pytest.raises(StallTimeoutError) as ei:
+        insp.check()
+    # Typed: still a StallError for existing handlers, AND a comm
+    # failure for the elastic retry loop (the promotion's whole point).
+    assert isinstance(ei.value, StallError)
+    assert _is_comm_failure(ei.value)
+
+    # Default mode keeps the historical StallError (not comm-classified).
+    insp2 = StallInspector(check_time_seconds=0.01,
+                           shutdown_time_seconds=0.02)
+    insp2.record_submit("wedged2")
+    time.sleep(0.05)
+    with pytest.raises(StallError) as ei2:
+        insp2.check()
+    assert not isinstance(ei2.value, StallTimeoutError)
+    assert not _is_comm_failure(ei2.value)
+
+
+# -- chaos e2e (the acceptance run) ------------------------------------------
+
+def _mlp_integrity_run(hvd, tmp_path, iters, inject, every=4):
+    """Guarded int8_ef MLP training with per-step verified checkpoints.
+    ``inject=True`` runs under the seeded plan (NaN at iter 2, diverged
+    replica at iter 8, corrupted final checkpoint) and one EXTRA
+    iteration — the skipped NaN step contributes nothing, so effective
+    updates equal the uninjected run's."""
+    from horovod_tpu import checkpoint as ckpt_lib
+
+    ax, n = hvd.rank_axis(), hvd.size()
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((n, 16, 32)).astype(np.float32)
+    W = rng.standard_normal((32, 4)).astype(np.float32)
+    Y = (X.reshape(-1, 32) @ W).reshape(n, 16, 4).astype(np.float32)
+    p0 = {"w": jnp.zeros((32, 4), jnp.float32)}
+    tx = hvd_mod.DistributedOptimizer(
+        optax.sgd(0.05), axis_name=ax, compression="int8_ef",
+        quantize_min_bucket_bytes=0, nonfinite_policy="skip_step")
+
+    def loss_fn(p, xb, yb):
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    @hvd_mod.spmd_step(in_specs=(P(ax), P(), P(ax), P(ax), P()),
+                       out_specs=(P(ax), P(), P(), P(), P()))
+    def step(ps, s, xb, yb, i):
+        p = jax.tree.map(lambda v: v[0], ps)
+        p, checked, div = integrity.divergence_guard(
+            p, i, ax, every=every, policy="resync")
+        l, g = jax.value_and_grad(loss_fn)(p, xb[0], yb[0])
+        u, s = tx.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        return (jax.tree.map(lambda v: v[None], p), s,
+                jax.lax.pmean(l, ax), checked, div)
+
+    total = iters + (1 if inject else 0)
+    nan_iter, diverge_iter = 2, every * 2  # diverge ON a check iter
+    if inject:
+        faults_lib.install(faults_lib.FaultPlan.from_json(json.dumps({
+            "seed": 9, "faults": [
+                {"site": "nonfinite", "step": nan_iter + 1},
+                {"site": "diverge", "step": diverge_iter + 1,
+                 "target": "3", "scale": 5.0},
+                {"site": "checkpoint_corrupt", "step": total,
+                 "mode": "bitflip"},
+            ]})))
+    mgr = ckpt_lib.CheckpointManager(str(tmp_path / "ckpt"),
+                                     max_to_keep=total + 1) \
+        if inject else None
+    try:
+        ps = {"w": jnp.broadcast_to(p0["w"][None], (n,) + p0["w"].shape)}
+        s = tx.init(p0)
+        loss = None
+        skip_evidence = {}
+        resyncs0 = faults_lib.stats.snapshot()["divergence_resyncs"]
+        for i in range(total):
+            xb = jnp.asarray(X)
+            if inject:
+                xb = integrity.chaos_poison(xb)      # nonfinite site
+                ps = integrity.chaos_perturb(ps)     # diverge site
+            if inject and i == nan_iter:
+                pre = (np.asarray(ps["w"]).copy(),
+                       jax.tree.map(lambda v: np.asarray(v), s.inner))
+            ps, s, loss, checked, div = step(ps, s, xb, jnp.asarray(Y),
+                                             jnp.asarray(i, jnp.int32))
+            integrity.record_divergence(checked, div, policy="resync")
+            if inject and i == nan_iter:
+                # (a) the NaN step skipped IDENTICALLY on all ranks:
+                # params, inner optimizer state, and EF residual/step
+                # all bitwise-untouched.
+                post_w = np.asarray(ps["w"])
+                np.testing.assert_array_equal(post_w, pre[0])
+                for a, b in zip(jax.tree.leaves(pre[1]),
+                                jax.tree.leaves(jax.tree.map(
+                                    lambda v: np.asarray(v), s.inner))):
+                    np.testing.assert_array_equal(a, b)
+                skip_evidence["skipped"] = True
+            if inject and i == diverge_iter:
+                # (b) the perturbed replica was healed on this very
+                # step (check runs before gradients).
+                w = np.asarray(ps["w"])
+                for r in range(n):
+                    np.testing.assert_array_equal(w[r], w[0])
+                skip_evidence["resynced"] = True
+            if mgr is not None:
+                mgr.save(i, {"w": np.asarray(ps["w"])[0], "step": i},
+                         force=True)
+        if mgr is not None:
+            mgr.wait()
+        snap = hvd_mod.observe_guard(s)
+        resyncs = faults_lib.stats.snapshot()["divergence_resyncs"] \
+            - resyncs0
+        return {"loss": float(np.asarray(loss)), "mgr": mgr,
+                "guard": snap, "resyncs": resyncs,
+                "evidence": skip_evidence, "total": total}
+    finally:
+        faults_lib.uninstall()
+
+
+def test_chaos_e2e_nan_divergence_corruption(hvd, tmp_path):
+    """THE acceptance run (docs/integrity.md): under one seeded
+    FaultPlan a guarded int8_ef MLP (a) skips the NaN step identically
+    on all ranks with optimizer state + EF residual untouched, (b)
+    detects and resyncs the diverged replica (RecoveryStats counted),
+    (c) restores from the last VERIFIED checkpoint after the latest was
+    corrupted — and the final loss matches an uninjected run within the
+    documented int8_ef bound (2%, docs/compression.md)."""
+    iters = 12
+    clean = _mlp_integrity_run(hvd, tmp_path, iters, inject=False)
+    chaos = _mlp_integrity_run(hvd, tmp_path, iters, inject=True)
+
+    assert chaos["evidence"] == {"skipped": True, "resynced": True}
+    assert chaos["guard"]["nonfinite_steps"] == 1
+    assert chaos["resyncs"] >= 1
+
+    # (c) corrupted LATEST checkpoint -> restore walks back to the
+    # previous verified step.
+    mgr = chaos["mgr"]
+    restored = mgr.restore()
+    assert int(np.asarray(restored["step"])) == chaos["total"] - 2
+    mgr.close()
+
+    # Final-loss parity: the skipped step contributed nothing and the
+    # resync healed bitwise, so the injected run (one extra iteration)
+    # matches the clean run within the int8_ef bound.
+    rel = abs(chaos["loss"] - clean["loss"]) / max(abs(clean["loss"]),
+                                                   1e-9)
+    assert rel < 0.02, (clean["loss"], chaos["loss"], rel)
+
+
+def test_chaos_soak_integrity_family(tmp_path):
+    """The tools/chaos_soak.py integrity family end to end (subprocess
+    training run under the seeded 3-fault plan)."""
+    import os
+    import sys as sys_mod
+
+    sys_mod.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import tools.chaos_soak as chaos_soak
+
+    rec = chaos_soak.run_integrity_soak(str(tmp_path), steps=8, seed=5)
+    assert rec["rc"] == 0
+    assert set(rec["injected_sites"]) == {"nonfinite", "diverge",
+                                          "checkpoint_corrupt"}
+    assert rec["result"]["final_finite"]
+    assert rec["result"]["replicas_identical"]
+
+
+# -- review regressions ------------------------------------------------------
+
+def test_find_guard_through_agg_state(hvd):
+    """backward_passes_per_step>1 wraps the guard under _AggState —
+    observe_guard / current_loss_scale must still see it (a
+    scale_backoff user reads the scale through the aggregated state)."""
+    params = {"w": jnp.ones((4, 3), jnp.float32)}
+    tx = hvd_mod.DistributedOptimizer(
+        optax.sgd(0.1), axis_name=hvd.rank_axis(),
+        nonfinite_policy="scale_backoff", backward_passes_per_step=2)
+    s = tx.init(params)
+    snap = hvd.observe_guard(s, name="agg")
+    assert snap is not None and snap["policy"] == "scale_backoff"
+    assert float(np.asarray(hvd.current_loss_scale(s))) == \
+        snap["loss_scale"] > 1.0
+
+
+def test_observe_ef_residual_through_guard(hvd):
+    """Arming the guard must not make the EF-residual gauge go dark."""
+    params = {"w": jnp.ones((64, 8), jnp.float32)}
+    tx = _guarded_sgd(hvd, "skip_step", compression="int8_ef",
+                      quantize_min_bucket_bytes=0)
+    s = tx.init(params)
+    norm = hvd_mod.observe_ef_residual(s)
+    assert norm == 0.0  # found (zeros residual), not None
+
+
+def test_chaos_perturb_target_zero():
+    """target 0 (rank 0) is valid and must not fall back to last rank."""
+    faults_lib.install(faults_lib.FaultPlan.from_json(json.dumps({
+        "seed": 1, "faults": [{"site": "diverge", "step": 1,
+                               "target": 0, "scale": 1.0}]})))
+    try:
+        tree = {"w": jnp.zeros((4, 3), jnp.float32)}
+        out = np.asarray(integrity.chaos_perturb(tree)["w"])
+        assert np.abs(out[0]).max() > 0, out
+        np.testing.assert_array_equal(out[1:], 0)
+    finally:
+        faults_lib.uninstall()
+
+
+def test_check_divergence_exact_on_identical_replicas(hvd):
+    """pmax/pmin fingerprint compare: bitwise-identical replicas give
+    EXACTLY zero deviation (a pmean-based compare rounds at ~n*eps and
+    false-positives at tol=0 — the /verify-caught bug)."""
+    ax = hvd.rank_axis()
+    w = np.broadcast_to(
+        np.random.default_rng(3).standard_normal((64, 8))
+        .astype(np.float32), (hvd.size(), 64, 8))
+
+    @hvd_mod.spmd_step(in_specs=(P(ax),), out_specs=(P(), P()))
+    def check(ps):
+        p = jax.tree.map(lambda v: v[0], ps)
+        return integrity.check_divergence(p, ax)
+
+    div, dev = check({"w": jnp.asarray(w.copy())})
+    assert float(dev) == 0.0 and not bool(div)
+
+
+def test_gradfn_env_default_does_not_change_arity(hvd, monkeypatch):
+    """HVD_TPU_NONFINITE_POLICY must NOT re-shape DistributedGradFn's
+    returns — the guard there is explicit-only."""
+    monkeypatch.setenv("HVD_TPU_NONFINITE_POLICY", "skip_step")
+    gfn = hvd_mod.DistributedGradFn(
+        jax.grad(lambda p: jnp.sum(p["w"] ** 2)),
+        axis_name=hvd.rank_axis())
+    out = gfn({"w": jnp.ones((3,), jnp.float32)})
+    assert set(out) == {"w"}  # plain grads dict, no appended guard

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Seeded chaos soak for the elastic recovery stack.
+"""Seeded chaos soak for the elastic recovery + training-integrity stacks.
 
-Drives a REAL elastic job (``hvdtpurun --elastic`` codepath, virtual
-local hosts) under a deterministic ``HVD_TPU_FAULT_PLAN`` that injects
-the three canonical failure families:
+Two failure families, both seeded and ``--repeat``-deterministic:
+
+``--family elastic`` (default) drives a REAL elastic job (``hvdtpurun
+--elastic`` codepath, virtual local hosts) under a deterministic
+``HVD_TPU_FAULT_PLAN`` that injects the three canonical process
+failures:
 
 * a runtime-shaped **collective comm failure** on hostB (classified by
   ``_is_comm_failure``, worker exits ``PEER_FAILURE_EXIT_CODE``);
@@ -16,14 +19,26 @@ the three canonical failure families:
 The run must complete all steps with the persisted state EQUAL to the
 last commit: ``w == sum(sizes)`` elementwise, where ``sizes`` is the
 committed per-step contribution log — any torn/uncommitted progress that
-leaked to disk breaks the invariant. Every injection is appended to a
-JSON-lines fault log; ``--repeat N`` reruns the identical seed and
-asserts the per-worker injection sequences match exactly (the
-determinism contract: same seed ⇒ same chaos).
+leaked to disk breaks the invariant.
+
+``--family integrity`` drives a guarded SPMD training run
+(docs/integrity.md) under the three DATA failure sites:
+
+* a **NaN-poisoned batch** (``nonfinite`` site) that the skip_step
+  non-finite guard must skip identically on every rank;
+* a **silently diverged replica** (``diverge`` site) that the in-trace
+  divergence detector must catch and resync from rank 0;
+* a **corrupted latest checkpoint** (``checkpoint_corrupt`` site) that
+  the verified restore path must detect and walk back from.
+
+Every injection is appended to a JSON-lines fault log; ``--repeat N``
+reruns the identical seed and asserts the per-worker injection
+sequences match exactly (the determinism contract: same seed ⇒ same
+chaos).
 
 Usage:
-  python tools/chaos_soak.py [--steps 12] [--seed 42] [--repeat 1]
-                             [--workdir DIR (kept)]
+  python tools/chaos_soak.py [--family elastic|integrity] [--steps 12]
+                             [--seed 42] [--repeat 1] [--workdir DIR]
 
 Exit 0 and one JSON record line on success (the repo's tool contract).
 """
@@ -106,6 +121,171 @@ def default_plan(seed: int) -> dict:
         # Preemption: SIGTERM latched, commit saves + exits cleanly.
         {"site": "preempt", "step": 7, "rank": 0},
     ]}
+
+
+def integrity_plan(seed: int, steps: int) -> dict:
+    """The integrity family (docs/integrity.md): one data fault per
+    subsystem — NaN batch for the non-finite guard, a perturbed replica
+    for the divergence detector, a torn final checkpoint for the
+    verified restore. Sites are consulted once per training step, so
+    ``step`` is a 1-based loop-iteration index."""
+    return {"seed": seed, "faults": [
+        {"site": "nonfinite", "step": 3},
+        # Perturb rank 2's replica by big noise mid-run; the in-trace
+        # detector (every 3 steps) resyncs from rank 0.
+        {"site": "diverge", "step": 5, "target": "2", "scale": 10.0},
+        # Corrupt the LAST step's finalized checkpoint; restore must
+        # walk back to the previous verified step.
+        {"site": "checkpoint_corrupt", "step": steps,
+         "mode": "bitflip"},
+    ]}
+
+
+INTEGRITY_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import json
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt_lib
+from horovod_tpu.common import faults as faults_lib
+from horovod_tpu.common import integrity
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+hvd.init(force_cpu_devices=4)
+ax, n = hvd.rank_axis(), hvd.size()
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((n, 8, 16)).astype(np.float32)
+W = rng.standard_normal((16, 4)).astype(np.float32)
+Y = (X.reshape(-1, 16) @ W).reshape(n, 8, 4).astype(np.float32)
+p0 = {"w": jnp.zeros((16, 4), jnp.float32)}
+tx = hvd.DistributedOptimizer(optax.sgd(0.05), axis_name=ax,
+                              compression="int8_ef",
+                              quantize_min_bucket_bytes=0,
+                              nonfinite_policy="skip_step")
+
+
+def loss_fn(p, xb, yb):
+    return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+
+@hvd.spmd_step(in_specs=(P(ax), P(), P(ax), P(ax), P()),
+               out_specs=(P(ax), P(), P(), P(), P()))
+def step(ps, s, xb, yb, i):
+    p = jax.tree.map(lambda v: v[0], ps)
+    # Divergence check FIRST: a resync heals a perturbed replica before
+    # its gradients can contaminate the reduction.
+    p, checked, div = integrity.divergence_guard(p, i, ax, every=3,
+                                                 policy="resync")
+    l, g = jax.value_and_grad(loss_fn)(p, xb[0], yb[0])
+    u, s = tx.update(g, s, p)
+    p = optax.apply_updates(p, u)
+    return (jax.tree.map(lambda v: v[None], p), s,
+            jax.lax.pmean(l, ax), checked, div)
+
+
+mgr = ckpt_lib.CheckpointManager(os.path.join(workdir, "ckpt"),
+                                 max_to_keep=TOTAL + 1)
+ps = {"w": jnp.broadcast_to(p0["w"][None], (n,) + p0["w"].shape)}
+s = tx.init(p0)
+loss = None
+for i in range(TOTAL):
+    xb = integrity.chaos_poison(jnp.asarray(X))       # "nonfinite" site
+    ps = integrity.chaos_perturb(ps)                  # "diverge" site
+    ps, s, loss, checked, div = step(ps, s, xb, jnp.asarray(Y),
+                                     jnp.asarray(i, jnp.int32))
+    integrity.record_divergence(checked, div, policy="resync")
+    # "checkpoint_corrupt" site fires inside save() on the final step.
+    mgr.save(i, {"w": np.asarray(ps["w"])[0], "step": i}, force=True)
+mgr.wait()
+
+restored = mgr.restore()
+snap = hvd.observe_guard(s)
+stats = hvd.recovery_stats()
+w = np.asarray(ps["w"])
+result = {
+    "final_loss": float(np.asarray(loss)),
+    "final_finite": bool(np.isfinite(w).all()),
+    "replicas_identical": bool(
+        all(np.array_equal(w[r], w[0]) for r in range(n))),
+    "nonfinite_steps": snap["nonfinite_steps"],
+    "restored_step": int(np.asarray(restored["step"])),
+    "divergence_resyncs": stats["divergence_resyncs"],
+    "checkpoint_corruptions": stats["checkpoint_corruptions"],
+}
+with open(os.path.join(workdir, "result.json"), "w") as f:
+    json.dump(result, f)
+mgr.close()
+"""
+
+
+def run_integrity_soak(workdir: str, steps: int = 10, seed: int = 42,
+                       plan: dict | None = None) -> dict:
+    """One seeded integrity-family run (subprocess, so the fault plan
+    env is hermetic); returns the validated record. Raises
+    AssertionError with evidence on any acceptance failure."""
+    import subprocess
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train_integrity.py")
+    with open(train_py, "w") as f:
+        f.write(INTEGRITY_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    plan = plan if plan is not None else integrity_plan(seed, steps)
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_FAULT_LOG": fault_log,
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run(
+        [sys.executable, train_py, workdir, str(steps)], env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"integrity soak rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+
+    with open(os.path.join(workdir, "result.json")) as f:
+        result = json.load(f)
+    # (a) the NaN step was skipped (guard counted it, training finished
+    # finite on every replica)...
+    assert result["nonfinite_steps"] >= 1, result
+    assert result["final_finite"], result
+    # (b) ...the perturbed replica was detected and resynced...
+    assert result["divergence_resyncs"] >= 1, result
+    assert result["replicas_identical"], result
+    # (c) ...and the corrupted final checkpoint forced a walk-back to
+    # the previous verified step.
+    assert result["checkpoint_corruptions"] >= 1, result
+    assert result["restored_step"] == steps - 2, result
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    want = {s["site"] for s in plan["faults"]}
+    assert len(log) >= 3 and want <= sites, \
+        f"expected >=3 injections covering {sorted(want)}, got " \
+        f"{len(log)}: {sorted(sites)}"
+    return {
+        "metric": "chaos_soak_integrity",
+        "seed": seed,
+        "steps": steps,
+        "rc": proc.returncode,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "result": result,
+        "sequences": {f"{k[0]}@{k[1]}": v
+                      for k, v in injection_sequences(log).items()},
+    }
 
 
 def _load_fault_log(path: str):
@@ -205,6 +385,11 @@ def run_soak(workdir: str, steps: int = 12, seed: int = 42,
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--family", choices=("elastic", "integrity"),
+                    default="elastic",
+                    help="elastic = process faults through the driver; "
+                         "integrity = data faults through the guard/"
+                         "detector/verified-checkpoint stack")
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--repeat", type=int, default=1,
@@ -214,13 +399,14 @@ def main() -> int:
                     help="kept for inspection; default: fresh temp dirs")
     args = ap.parse_args()
 
+    soak = run_soak if args.family == "elastic" else run_integrity_soak
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:
             wd = os.path.join(args.workdir, f"run{i}")
         else:
             wd = tempfile.mkdtemp(prefix=f"chaos_soak_{i}_")
-        rec = run_soak(wd, steps=args.steps, seed=args.seed)
+        rec = soak(wd, steps=args.steps, seed=args.seed)
         print(f"chaos_soak: run {i} ok — {rec['injections']} injections "
               f"over {rec['injected_sites']}", file=sys.stderr)
         records.append(rec)

@@ -80,6 +80,45 @@ def check_metrics_surface(missing: list) -> None:
             missing.append(f"api: {name} undocumented in docs/api.md")
 
 
+def check_integrity_surface(missing: list) -> None:
+    """Every knob and metric of the training-integrity layer must be
+    documented in docs/integrity.md: ``HVD_TPU_*`` env knobs are
+    recovered from the ``_env*("NAME")`` lookups in the layer's source
+    files (config.py prefixes the name), metrics from the registry
+    constructor calls. Parsed textually (runs without jax installed)."""
+    doc = REPO / "docs" / "integrity.md"
+    if not doc.exists():
+        missing.append("path: docs/integrity.md")
+        return
+    text = doc.read_text()
+    sources = [REPO / "horovod_tpu" / "common" / "integrity.py",
+               REPO / "horovod_tpu" / "checkpoint.py"]
+    env_call = re.compile(r'_env(?:_int|_float|_bool)?\(\s*"([A-Z0-9_]+)"')
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*"(hvd_tpu_[a-z0-9_]+)"')
+    knobs, metric_names = set(), set()
+    for path in sources:
+        src = path.read_text()
+        knobs |= {"HVD_TPU_" + n for n in env_call.findall(src)}
+        metric_names |= set(reg_call.findall(src))
+    # Wired through Config rather than a local _env lookup, but part of
+    # this layer's knob surface all the same.
+    knobs |= {"HVD_TPU_STALL_FATAL", "HVD_TPU_NONFINITE_POLICY",
+              "HVD_TPU_DIVERGE_CHECK_STEPS", "HVD_TPU_DIVERGE_POLICY",
+              "HVD_TPU_CHECKPOINT_VERIFY"}
+    if not metric_names:
+        missing.append("integrity: no hvd_tpu_* metrics registered by "
+                       "the integrity layer")
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"integrity knob {k}: undocumented in "
+                           "docs/integrity.md")
+    for m in sorted(metric_names):
+        if m not in text:
+            missing.append(f"integrity metric {m}: undocumented in "
+                           "docs/integrity.md")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -117,6 +156,7 @@ def main() -> int:
 
     check_compression_surface(missing)
     check_metrics_surface(missing)
+    check_integrity_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
