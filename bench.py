@@ -121,6 +121,10 @@ def _cached_tpu_record(argv, model):
                  (" (held as same-round stale fallback)"
                   if rdir == CURRENT else ""))
             continue
+        # The freshness decision must be as loud when it ACCEPTS as when
+        # it rejects (r05's 62.8h-old record was skipped silently).
+        _log(f"using cached chip record ({rdir}): {age / 3600:.1f}h old, "
+             "within the 48h freshness window")
         return payload
     if stale_same_round is not None:
         _log("no fresh chip record; emitting the SAME-ROUND stale "
@@ -202,6 +206,22 @@ def main():
                         "order chaining on the DistributedOptimizer "
                         "(overlap=True; pairs with the latency-hiding "
                         "XLA flags, HVD_TPU_OVERLAP_XLA_FLAGS=1)")
+    p.add_argument("--mesh-shape", default="",
+                   help="train over a simulated RxC (or RxMxC) device "
+                        "mesh with the topology-aware collective router "
+                        "(docs/topology.md), e.g. 2x4. On the CPU "
+                        "fallback the mesh is simulated via "
+                        "--xla_force_host_platform_device_count. "
+                        "Routing mode + per-axis wire mix land in the "
+                        "BENCH json")
+    p.add_argument("--route", default="staged_int8",
+                   choices=["staged", "staged_int8", "adasum",
+                            "adasum_int8"],
+                   help="routing/reduction mode for --mesh-shape: "
+                        "staged (fp32 per-axis RS/AG), staged_int8 "
+                        "(int8 on the slow cross hop), adasum "
+                        "(hierarchical Adasum across the cross axis), "
+                        "adasum_int8 (Adasum with int8 exchange)")
     p.add_argument("--compression", default="none",
                    choices=["none", "bf16", "int8_ef"],
                    help="gradient-reduction wire format on the "
@@ -249,6 +269,22 @@ def main():
         # Must happen before any backend init; overrides axon's
         # jax_platforms="axon,cpu" registration.
         jax.config.update("jax_platforms", "cpu")
+
+    if args.mesh_shape:
+        # Routing arm (docs/topology.md): export the shape so the
+        # runtime's mesh_axes discovery agrees, and on the CPU fallback
+        # force enough virtual devices to factor the mesh BEFORE the
+        # backend initializes (init() appends
+        # --xla_force_host_platform_device_count from this knob).
+        os.environ["HVD_TPU_MESH_SHAPE"] = args.mesh_shape
+        if args._platform == "cpu":
+            from horovod_tpu.common.topology import parse_mesh_shape
+
+            dims = parse_mesh_shape(args.mesh_shape)
+            if dims:
+                os.environ.setdefault(
+                    "HVD_TPU_FORCE_CPU_DEVICES",
+                    str(int(np.prod(dims))))
 
     import horovod_tpu as hvd
 
@@ -315,6 +351,51 @@ def main():
     if note:
         result["note"] = note
     _emit(result)
+
+
+def _routing(args):
+    """--mesh-shape routing config: {"mesh", "axes", "plan", "op",
+    "describe"} or None (flat axis). The mesh itself comes from the
+    RUNTIME's own discovery (hvd.route_mesh()/mesh_axes() — the worker
+    exports HVD_TPU_MESH_SHAPE before init), so bench can never drift
+    from the axis names the router expects; a shape that doesn't factor
+    the live device count falls back to flat with a log line rather
+    than failing the run. Memoized on the args namespace: the config is
+    consulted by both the model setup and the JSON record, and
+    rebuilding would double-log the fallback."""
+    if not args.mesh_shape:
+        return None
+    cached = getattr(args, "_routing_cfg", "unset")
+    if cached != "unset":
+        return cached
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.collectives import WirePlan
+
+    rmesh = hvd.route_mesh()
+    axes = hvd.mesh_axes()
+    if rmesh is None or axes is None or len(axes) < 2:
+        _log(f"mesh shape {args.mesh_shape!r} does not factor the live "
+             "device count into a supported multi-axis mesh; using the "
+             "flat axis")
+        args._routing_cfg = None
+        return None
+    fast_first = [a.name for a in axes]  # mesh_axes is fast-first
+    cross_wire = "int8" if args.route.endswith("int8") else "none"
+    plan = WirePlan.parse(
+        ",".join([f"{a}:none" for a in fast_first[:-1]]
+                 + [f"{fast_first[-1]}:{cross_wire}"]))
+    op = hvd.Adasum if args.route.startswith("adasum") else hvd.Average
+    args._routing_cfg = {
+        "mesh": rmesh, "axes": tuple(rmesh.axis_names),
+        "plan": plan, "op": op,
+        "describe": f"{args.route}[{plan.describe()}]"}
+    return args._routing_cfg
+
+
+def _route_kwargs(rt):
+    """DistributedOptimizer kwargs for a _routing() config (one place
+    to extend when the route grows more optimizer knobs)."""
+    return {"route": rt["plan"], "op": rt["op"]} if rt else {}
 
 
 def _guard_policy(args):
@@ -437,6 +518,9 @@ def _run_benchmark(args, n):
         "overlap": bool(args.overlap),
         "compression": args.compression,
         "guard": args.guard,
+        "mesh_shape": args.mesh_shape or None,
+        "route": ((_routing(args) or {}).get("describe")
+                  if args.mesh_shape else None),
     }
     if args.guard == "on":
         # Guard-overhead A/B (docs/integrity.md): rebuild the SAME
@@ -536,9 +620,20 @@ def _metrics_summary():
         return snap.get(name, {}).get("samples", [])
 
     out = {}
-    wire = {s["labels"].get("wire", "?"): s["value"]
-            for s in samples("hvd_tpu_allreduce_bytes_total")
-            if s["value"]}
+    # The allreduce byte family carries (wire, axis) labels: eager calls
+    # stamp axis=flat, the mesh router stamps its per-axis plan (at
+    # trace time). Aggregate by wire for the headline mix and keep the
+    # per-axis split — the routing arm's whole point is WHICH axis the
+    # bytes crossed.
+    wire, by_axis = {}, {}
+    for s in samples("hvd_tpu_allreduce_bytes_total"):
+        if not s["value"]:
+            continue
+        w = s["labels"].get("wire", "?")
+        ax = s["labels"].get("axis", "flat")
+        wire[w] = wire.get(w, 0) + s["value"]
+        by_axis.setdefault(ax, {})
+        by_axis[ax][w] = by_axis[ax].get(w, 0) + s["value"]
     planned = {s["labels"].get("wire", "?"): s["value"]
                for s in samples("hvd_tpu_fusion_wire_bytes_total")
                if s["value"]}
@@ -546,7 +641,10 @@ def _metrics_summary():
         # Eager-path truth when the eager engine ran; in-jit steps only
         # leave the trace-time plan, so fall back to the planned mix.
         out["bytes_on_wire"] = wire
-        out["bytes_basis"] = "eager"
+        out["bytes_basis"] = ("mesh_planned_per_compile"
+                              if set(by_axis) - {"flat"} else "eager")
+        if set(by_axis) - {"flat"}:
+            out["bytes_by_axis"] = by_axis
     elif planned:
         out["bytes_on_wire"] = planned
         out["bytes_basis"] = "planned_per_compile"
@@ -610,15 +708,35 @@ def _step_flops(n):
     return None
 
 
-def _make_stepper(model_apply_loss, params_and_state, n, extra_args):
-    """Shared step-loop builder: jit (n=1) or spmd_step shard_map (n>1)."""
+def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
+                  routing=None):
+    """Shared step-loop builder: jit (n=1) or spmd_step shard_map (n>1);
+    with ``routing`` (--mesh-shape) the step shards over the N-D route
+    mesh so the optimizer's WirePlan axes are bound."""
     import jax
 
     import horovod_tpu as hvd
 
     nstate = len(params_and_state)
     donate = tuple(range(nstate))  # update state in place in HBM
-    if n > 1:
+    if routing is not None and n > 1:
+        from jax.sharding import PartitionSpec as P
+
+        axes = routing["axes"]
+        spec = P(axes)
+        in_specs = tuple([P()] * nstate) + tuple([spec] * len(extra_args))
+        out_specs = tuple([P()] * nstate) + (P(),)
+
+        def _step(*all_args):
+            state, data = all_args[:nstate], all_args[nstate:]
+            return model_apply_loss(state, data, pmean_axis=axes)
+
+        train_step = jax.jit(
+            jax.shard_map(_step, mesh=routing["mesh"],
+                          in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False),
+            donate_argnums=donate)
+    elif n > 1:
         from jax.sharding import PartitionSpec as P
 
         ax = hvd.rank_axis()
@@ -734,12 +852,16 @@ def _setup_cnn(args, batch_size, n):
     dropout_rng = jax.random.PRNGKey(2)
 
     # Reference benchmark uses plain SGD lr=0.01 wrapped in
-    # DistributedOptimizer; same here (fused allreduce over the rank axis).
+    # DistributedOptimizer; same here (fused allreduce over the rank
+    # axis, or the mesh router's per-axis plan under --mesh-shape).
+    rt = _routing(args)
+    route_kw = _route_kwargs(rt)
     tx = hvd.DistributedOptimizer(optax.sgd(0.01),
                                   axis_name=hvd.rank_axis(),
                                   overlap=args.overlap,
                                   compression=args.compression,
-                                  nonfinite_policy=_guard_policy(args))
+                                  nonfinite_policy=_guard_policy(args),
+                                  **route_kw)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -765,7 +887,7 @@ def _setup_cnn(args, batch_size, n):
         return p, new_bs, st, l
 
     run = _make_stepper(apply_loss, (params, batch_stats, opt_state),
-                        n, (images, labels))
+                        n, (images, labels), routing=rt)
     return (run, "img/s", CNN_BASELINE_PER_DEVICE,
             _cnn_model_flops(args.model, image_size))
 
@@ -794,11 +916,13 @@ def _setup_bert(args, batch_size, n):
     # bf16 first moment: halves the Adam mu HBM traffic per step (the
     # "bf16-dominant optimizer path" lever; nu stays fp32 — optax only
     # exposes mu_dtype, and the second moment is scale-sensitive).
+    rt = _routing(args)
+    route_kw = _route_kwargs(rt)
     tx = hvd.DistributedOptimizer(
         optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
         axis_name=hvd.rank_axis(), overlap=args.overlap,
         compression=args.compression,
-        nonfinite_policy=_guard_policy(args))
+        nonfinite_policy=_guard_policy(args), **route_kw)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -820,7 +944,8 @@ def _setup_bert(args, batch_size, n):
         return p, st, l
 
     run = _make_stepper(apply_loss, (params, opt_state), n,
-                        (tokens, mask_positions.astype(jnp.float32), labels))
+                        (tokens, mask_positions.astype(jnp.float32), labels),
+                        routing=rt)
     return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
             _transformer_model_flops(params, model.num_layers,
                                      model.hidden_size, args.seq_len))
@@ -848,11 +973,13 @@ def _setup_gpt(args, batch_size, n):
     _log("model.init done")
     import jax.numpy as jnp
 
+    rt = _routing(args)
+    route_kw = _route_kwargs(rt)
     tx = hvd.DistributedOptimizer(
         optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
         axis_name=hvd.rank_axis(), overlap=args.overlap,
         compression=args.compression,
-        nonfinite_policy=_guard_policy(args))
+        nonfinite_policy=_guard_policy(args), **route_kw)
     opt_state = tx.init(params)
 
     def apply_loss(state, data, pmean_axis):
@@ -871,7 +998,8 @@ def _setup_gpt(args, batch_size, n):
         p = optax.apply_updates(p, updates)
         return p, st, l
 
-    run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,))
+    run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,),
+                        routing=rt)
     return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
             _transformer_model_flops(params, model.num_layers,
                                      model.hidden, args.seq_len))

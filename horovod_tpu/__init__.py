@@ -51,6 +51,7 @@ from .common.exceptions import (CheckpointCorruptError, DivergenceError,
                                 StallTimeoutError,
                                 TensorShapeMismatchError)
 from .ops import collectives as collective_ops
+from .ops.collectives import AxisPhase, WirePlan
 from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
                               Sum)
 from .ops.compression import Compression
@@ -129,6 +130,21 @@ def mesh():
 def hierarchical_mesh():
     """The 2-D (cross, local) mesh, if multi-host; else None."""
     return _ctx().hier_mesh
+
+
+def mesh_axes():
+    """Routing-axis factorization of the topology (fast axis first) —
+    pod metadata or the HVD_TPU_MESH_SHAPE / init(mesh_shape=) override;
+    the per-axis model the collective router keys on
+    (docs/topology.md). None when discovery failed."""
+    return _ctx().mesh_axes
+
+
+def route_mesh():
+    """The N-D jax Mesh matching :func:`mesh_axes` when the
+    factorization is multi-axis (shard over it to use route= plans);
+    else None."""
+    return _ctx().route_mesh
 
 
 def rank_axis() -> str:
@@ -440,7 +456,8 @@ def spmd_step(fn=None, *, in_specs=None, out_specs=None, check_vma=False,
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "cross_rank", "cross_size", "is_homogeneous", "mesh",
-    "hierarchical_mesh", "rank_axis", "scatter", "gather", "allreduce",
+    "hierarchical_mesh", "mesh_axes", "route_mesh", "WirePlan",
+    "AxisPhase", "rank_axis", "scatter", "gather", "allreduce",
     "grouped_allreduce", "allgather", "grouped_allgather", "broadcast",
     "alltoall", "reducescatter", "grouped_reducescatter", "barrier",
     "join", "allreduce_async",

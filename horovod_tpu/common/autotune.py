@@ -42,6 +42,10 @@ _M_COMP_IDX = metrics_lib.gauge(
     "hvd_tpu_autotune_compression_index",
     "index of the current compression candidate "
     "(see compression_candidates order; 0 = none)")
+_M_ROUTE_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_route_index",
+    "index of the current routing/reduction-mode candidate "
+    "(see route_candidates order; 0 = flat)")
 _M_CONVERGED = metrics_lib.gauge(
     "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
 _M_SAMPLES = metrics_lib.counter(
@@ -126,7 +130,10 @@ class Autotuner:
                  tune_overlap: bool = False,
                  tune_compression: bool = False,
                  compression_candidates: Sequence[str] = (
-                     "none", "bf16", "int8_ef")):
+                     "none", "bf16", "int8_ef"),
+                 tune_route: bool = False,
+                 route_candidates: Sequence[str] = (
+                     "flat", "staged", "staged_int8", "adasum")):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -146,14 +153,24 @@ class Autotuner:
         self.tune_hierarchical = tune_hierarchical
         self.tune_overlap = tune_overlap
         self.tune_compression = tune_compression
+        # Routing/reduction-mode axis (docs/topology.md): which WirePlan
+        # (and whether Adasum replaces SUM on the slow axis) the step
+        # builds with — "flat" | "staged" | "staged_int8" | "adasum".
+        # Whether staging (and per-axis int8) beats the flat ring is a
+        # topology-and-model question, so it is measured, not
+        # hand-picked, exactly like the compression axis.
+        self.tune_route = tune_route
+        self.route_candidates = (tuple(route_candidates)
+                                 if tune_route else ("flat",))
         self.compression_candidates = (tuple(compression_candidates)
                                        if tune_compression else ("none",))
         hs = (0, 1) if tune_hierarchical else (0,)
         ovs = (0, 1) if tune_overlap else (0,)
         cs = tuple(range(len(self.compression_candidates)))
-        self._space: List[Tuple[int, int, int, int]] = [
-            (t, h, o, c) for t in self.candidates for h in hs
-            for o in ovs for c in cs]
+        rs = tuple(range(len(self.route_candidates)))
+        self._space: List[Tuple[int, int, int, int, int]] = [
+            (t, h, o, c, rt) for t in self.candidates for h in hs
+            for o in ovs for c in cs for rt in rs]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
@@ -174,6 +191,8 @@ class Autotuner:
             cols.append("overlap")
         if tune_compression:
             cols.append("compression")
+        if tune_route:
+            cols.append("route")
         self._columns = tuple(cols)
         self._publish_metrics()
         if log_file:
@@ -220,12 +239,24 @@ class Autotuner:
             return self.compression_candidates[self._cur[3]]
 
     @property
+    def current_route(self) -> str:
+        with self._tlock:
+            return self.route_candidates[self._cur[4]]
+
+    @property
     def current_quad(self) -> Tuple[int, bool, bool, str]:
         """Atomic (threshold, hierarchical, overlap, compression)
         snapshot."""
+        return self.current_quint[:4]
+
+    @property
+    def current_quint(self) -> Tuple[int, bool, bool, str, str]:
+        """Atomic (threshold, hierarchical, overlap, compression,
+        route) snapshot — the full tuned point."""
         with self._tlock:
             return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
-                    self.compression_candidates[self._cur[3]])
+                    self.compression_candidates[self._cur[3]],
+                    self.route_candidates[self._cur[4]])
 
     @property
     def done(self) -> bool:
@@ -269,16 +300,25 @@ class Autotuner:
                   seconds: float) -> Tuple[int, bool, bool, str]:
         """Like feed() but returns the full (threshold, hierarchical,
         overlap, compression) point under ONE lock acquisition."""
+        return self.feed_quint(nbytes, seconds)[:4]
+
+    def feed_quint(self, nbytes: float,
+                   seconds: float) -> Tuple[int, bool, bool, str, str]:
+        """Like feed() but returns the full (threshold, hierarchical,
+        overlap, compression, route) point under ONE lock
+        acquisition."""
         with self._tlock:
             self.record(nbytes, seconds)
             if self.ready():
                 self.suggest()
             return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
-                    self.compression_candidates[self._cur[3]])
+                    self.compression_candidates[self._cur[3]],
+                    self.route_candidates[self._cur[4]])
 
-    def _config_label(self, point: Tuple[int, int, int, int]) -> str:
+    def _config_label(self, point: Tuple[int, ...]) -> str:
         return (f"{point[0]}|{int(point[1])}|{int(point[2])}"
-                f"|{self.compression_candidates[point[3]]}")
+                f"|{self.compression_candidates[point[3]]}"
+                f"|{self.route_candidates[point[4]]}")
 
     def _publish_metrics(self) -> None:
         """Mirror the live point into the metrics registry (called with
@@ -287,9 +327,10 @@ class Autotuner:
         _M_HIER.set(self._cur[1])
         _M_OVERLAP.set(self._cur[2])
         _M_COMP_IDX.set(self._cur[3])
+        _M_ROUTE_IDX.set(self._cur[4])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
-    def _row(self, point: Tuple[int, int, int, int]) -> List:
+    def _row(self, point: Tuple[int, ...]) -> List:
         """CSV row values matching _columns: the threshold always, each
         toggle only when tuned (an untuned axis would log a constant 0
         column that the header doesn't declare)."""
@@ -300,9 +341,11 @@ class Autotuner:
             row.append(point[2])
         if self.tune_compression:
             row.append(self.compression_candidates[point[3]])
+        if self.tune_route:
+            row.append(self.route_candidates[point[4]])
         return row
 
-    def _log(self, point: Tuple[int, int, int], score: float) -> None:
+    def _log(self, point: Tuple[int, ...], score: float) -> None:
         if self.log_file:
             import time as _time
 
@@ -318,12 +361,12 @@ class Autotuner:
             return self._suggest_locked()
 
     @staticmethod
-    def _features(point: Tuple[int, int, int, int]) -> List[float]:
+    def _features(point: Tuple[int, ...]) -> List[float]:
         # log2(threshold) spans ~20-28; scale the binary toggles (and the
-        # categorical compression index) so the RBF kernel treats "other
-        # branch" as a real distance.
+        # categorical compression/route indices) so the RBF kernel treats
+        # "other branch" as a real distance.
         return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2],
-                2.0 * point[3]]
+                2.0 * point[3], 2.0 * point[4]]
 
     def _suggest_locked(self) -> int:
         score = self._bytes / max(self._secs, 1e-9)
@@ -376,7 +419,9 @@ class Autotuner:
                        if self.tune_overlap else "")
                     + (", compression=%s"
                        % self.compression_candidates[best[3]]
-                       if self.tune_compression else ""),
+                       if self.tune_compression else "")
+                    + (", route=%s" % self.route_candidates[best[4]]
+                       if self.tune_route else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

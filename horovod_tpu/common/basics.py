@@ -100,6 +100,24 @@ class Context:
         if topo.is_homogeneous and topo.cross_size > 1:
             self.hier_mesh = topo_lib.build_hierarchical_mesh(
                 topo, "cross", "local")
+        # Routing-axis model (docs/topology.md): the per-axis
+        # factorization the collective router keys on — pod metadata,
+        # or the HVD_TPU_MESH_SHAPE / init(mesh_shape=) override for
+        # simulated meshes. route_mesh is the matching N-D jax Mesh
+        # when the factorization is multi-axis (else the flat mesh
+        # already covers it).
+        self.mesh_axes = None
+        self.route_mesh = None
+        try:
+            shape = topo_lib.parse_mesh_shape(config.mesh_shape)
+            self.mesh_axes = topo_lib.mesh_axes(topo, shape)
+            if len(self.mesh_axes) > 1:
+                self.route_mesh = topo_lib.build_mesh_from_axes(
+                    topo, self.mesh_axes)
+        except ValueError as e:
+            logger.warning(
+                "mesh shape invalid for this topology (%s); routing "
+                "falls back to the flat axis", e)
 
         self.timeline = Timeline(config.timeline_filename,
                                  config.timeline_mark_cycles)

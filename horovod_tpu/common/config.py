@@ -114,6 +114,17 @@ class Config:
     # default; applied ONLY with positive TPU evidence (platform env /
     # libtpu) — XLA aborts on unknown --xla_tpu_* flags elsewhere.
     overlap_xla_flags: bool = False
+    # Topology-aware collective routing (docs/topology.md). `route`
+    # names the default WirePlan for the optimizer surfaces: "flat"
+    # (1-D axis), "staged" (RS local -> reduce cross -> AG local),
+    # "staged_int8" (int8 on the slow cross hop), or a full spec like
+    # "local:none,cross:int8" (fast axis first). None keeps the flat
+    # axis unless the call site passes route= explicitly.
+    route: Optional[str] = None
+    # Simulated/override mesh factorization, slow axis first (e.g.
+    # "2x4" = 2 hosts x 4 chips; also read pre-init by
+    # topology.mesh_shape_from_env so tools can consume it directly).
+    mesh_shape: Optional[str] = None
     # Adasum scalar precision (reference keeps fp64 scalars, adasum.h).
     adasum_scalar_dtype: str = "float32"
     # Compression for the wire format of eager collectives.
@@ -194,6 +205,8 @@ class Config:
         c.autotune_steps_per_sample = _env_int(
             "AUTOTUNE_STEPS_PER_SAMPLE", cls.autotune_steps_per_sample)
         c.overlap_xla_flags = _env_bool("OVERLAP_XLA_FLAGS", False)
+        c.route = _env("ROUTE")
+        c.mesh_shape = _env("MESH_SHAPE")
         c.adasum_scalar_dtype = _env(
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")

@@ -132,6 +132,126 @@ def build_mesh(topology: Topology, axis_name: str):
     return jax.sharding.Mesh(np.array(topology.devices), (axis_name,))
 
 
+# ---------------------------------------------------------------------------
+# Mesh-axis model — the topology the per-axis collective router consumes
+# (ops/collectives.py mesh_allreduce; docs/topology.md).
+#
+# A TPU pod is a 2-D/3-D torus of links with very different bandwidths:
+# intra-host ICI is an order of magnitude faster than the cross-host hop
+# (DCN between slices; the slowest ICI dimension inside one slice). The
+# MLPerf TPU-v3 pod work (arXiv:1909.09756, PAPERS.md) scales allreduce
+# by staging it per torus axis — reduce-scatter along the fast axis
+# first so the slow axis only ever carries 1/fast_size of the bytes.
+# MeshAxis is the static per-axis record that routing decisions key on.
+# ---------------------------------------------------------------------------
+
+# Axis kinds, fastest first. "ici" = intra-host/slice torus links;
+# "dcn" = the cross-host/slice hop (data-center network between slices,
+# or the slowest torus dimension of a multi-host pod).
+AXIS_ICI = "ici"
+AXIS_DCN = "dcn"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxis:
+    """One routing axis of the device mesh: its shard_map axis name, the
+    number of ranks along it, and the link tier it maps onto. Ordered
+    fast -> slow in :func:`mesh_axes` output — the router reduces-
+    scatters along earlier (fast) axes first so later (slow) axes carry
+    the fewest bytes."""
+
+    name: str
+    size: int
+    kind: str = AXIS_ICI
+
+
+def parse_mesh_shape(raw: Optional[str]) -> Optional[tuple]:
+    """``"2x4"`` / ``"2,2,2"`` -> dim tuple (slow axis first, fast axis
+    LAST — row-major device order, matching
+    ``build_hierarchical_mesh``'s (cross, local) layout); None when
+    unset/invalid."""
+    if not raw:
+        return None
+    try:
+        dims = tuple(int(d) for d in str(raw).replace("x", ",").split(",")
+                     if d.strip())
+    except ValueError:
+        return None
+    if not dims or any(d < 1 for d in dims):
+        return None
+    return dims
+
+
+def mesh_shape_from_env() -> Optional[tuple]:
+    """The ``HVD_TPU_MESH_SHAPE`` override that simulates a multi-axis
+    mesh on any backend (the test suite's 8 virtual CPU devices stand in
+    for a 2x4 pod slice)."""
+    return parse_mesh_shape(os.environ.get("HVD_TPU_MESH_SHAPE")
+                            or os.environ.get("HOROVOD_MESH_SHAPE"))
+
+
+# Default axis names, slow -> fast, matching the historical
+# (cross, local) hierarchical mesh; 3-D meshes insert "middle".
+_AXIS_NAMES = {1: ("hvd",), 2: ("cross", "local"),
+               3: ("cross", "middle", "local")}
+
+
+def mesh_axes(topology: Topology,
+              shape: Optional[Sequence[int]] = None) -> tuple:
+    """The routing-axis factorization of a topology, FAST axis first.
+
+    Resolution order: an explicit ``shape`` argument, then the
+    ``HVD_TPU_MESH_SHAPE`` env override (simulated meshes), then the
+    pod metadata the Topology already carries (cross_size x local_size
+    when multi-host), else the flat 1-D axis. Shapes are given slow ->
+    fast (row-major device order, ``"2x4"`` = 2 hosts x 4 chips); the
+    returned tuple is reversed to fast -> slow because that is the
+    order the router stages phases in.
+    """
+    dims = tuple(shape) if shape is not None else mesh_shape_from_env()
+    if dims is None:
+        if topology.is_homogeneous and topology.cross_size > 1:
+            dims = (topology.cross_size,
+                    topology.size // topology.cross_size)
+        else:
+            dims = (topology.size,)
+    total = 1
+    for d in dims:
+        total *= d
+    if total != topology.size:
+        raise ValueError(
+            f"mesh shape {dims} covers {total} devices but the topology "
+            f"has {topology.size} (HVD_TPU_MESH_SHAPE must factor the "
+            "world size exactly)")
+    names = _AXIS_NAMES.get(len(dims))
+    if names is None:
+        raise ValueError(
+            f"mesh shapes of rank {len(dims)} are not supported "
+            "(1-D flat, 2-D cross x local, 3-D cross x middle x local)")
+    # Slow -> fast in `dims`/`names`; emit fast-first. The LAST (fastest)
+    # axis is the intra-host ICI dimension; every other axis is priced
+    # as a cross/DCN hop.
+    axes = []
+    for i, (n, d) in enumerate(zip(names, dims)):
+        kind = AXIS_ICI if i == len(dims) - 1 else AXIS_DCN
+        axes.append(MeshAxis(name=n, size=d, kind=kind))
+    return tuple(reversed(axes))
+
+
+def build_mesh_from_axes(topology: Topology, axes: Sequence[MeshAxis]):
+    """N-D jax Mesh over the topology's devices for a mesh_axes()
+    factorization (axes given fast -> slow; the device array is
+    reshaped slow-major, so the fastest axis is contiguous — matching
+    the (cross, local) hierarchical mesh layout and, on a real pod,
+    jax's device enumeration order within a host)."""
+    import jax
+
+    slow_first = list(reversed(list(axes)))
+    arr = np.array(topology.devices).reshape(
+        tuple(a.size for a in slow_first))
+    return jax.sharding.Mesh(arr, tuple(a.name for a in slow_first))
+
+
 def build_hierarchical_mesh(topology: Topology, cross_axis: str,
                             local_axis: str):
     """2-D (cross=hosts, local=per-host devices) mesh — the LOCAL/CROSS

@@ -21,19 +21,86 @@ layer (reduce-scatter staging) rather than hand-scheduled here. Scalars are
 accumulated in fp32 (the reference keeps fp64 scalar reductions for fp16
 payloads — adasum.h:427+; fp32 is the TPU-native equivalent for bf16).
 
+Vector-halving DOES exist here in its mesh-routed form
+(``scalar_axes``): when the collective router (collectives.mesh_allreduce,
+docs/topology.md) reduce-scatters over the fast ICI axes first, each rank
+runs the cross-axis recursion on its 1/local shard and the dot/norm
+scalars are additionally ``psum``-med over the fast axes — exactly the
+reference's "three scalars over the reduction communicator" step
+(adasum.h:195-337), so the combine coefficients are the FULL-vector
+coefficients even though only shards travel the slow axis.
+
+``wire="int8"`` carries each exchange hop as block-scaled int8 (+ one
+fp32 scale per 4096-element block): both partners dequantize BOTH sides
+of the pair (their own tensor included) before the combine, so the pair
+computes bit-identical results and replicas never diverge; per level the
+combined value differs from the exact recursion by at most one block
+rounding per operand (r·(s_a + s_b), r=1/2 round-to-nearest, r=1
+stochastic with a ``key``).
+
 Both partners compute the symmetric combine, so no "a vs b" role split is
 needed — the formula is symmetric in (a, b).
 """
 
 from __future__ import annotations
 
+from typing import Sequence
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common import metrics as metrics_lib
+
+# Telemetry (docs/metrics.md): combines are counted at TRACE time (the
+# recursion unrolls in Python), so this records combines per compiled
+# program, not per executed step — same basis as the fusion-plan
+# counters.
+_METRICS_ON = metrics_lib.enabled()
+_M_COMBINES = metrics_lib.counter(
+    "hvd_tpu_adasum_combines_total",
+    "Adasum pairwise-combine stages traced, by exchange wire format "
+    "(per compiled program — the recursion unrolls at trace time)",
+    labels=("wire",))
+
+
+def _dot_norms(a, b, scalar_dtype=jnp.float32,
+               scalar_axes: Sequence[str] = (), use_pallas=None):
+    """[dot(a,b), ||a||^2, ||b||^2] — psum-med over ``scalar_axes`` when
+    the operands are shards of a larger vector (the VHDD reduction-
+    communicator step, adasum.h:195-337)."""
+    if scalar_dtype == jnp.float32:
+        from . import pallas_kernels as pk
+
+        dn = pk.adasum_dot_norms(a, b, use_pallas=use_pallas)
+    else:
+        af = a.astype(scalar_dtype).ravel()
+        bf = b.astype(scalar_dtype).ravel()
+        dn = jnp.stack([jnp.dot(af, bf), jnp.dot(af, af),
+                        jnp.dot(bf, bf)])
+    if scalar_axes:
+        dn = lax.psum(dn, tuple(scalar_axes))
+    return dn
+
+
+def _combine_from_norms(a, b, dn, scalar_dtype=jnp.float32, eps=1e-30,
+                        use_pallas=None):
+    if scalar_dtype == jnp.float32:
+        from . import pallas_kernels as pk
+
+        return pk.adasum_combine(a, b, dn.astype(jnp.float32),
+                                 use_pallas=use_pallas, eps=eps)
+    dot, na2, nb2 = dn[0], dn[1], dn[2]
+    a_coef = 1.0 - dot / jnp.maximum(2.0 * na2, eps)
+    b_coef = 1.0 - dot / jnp.maximum(2.0 * nb2, eps)
+    a_coef = jnp.where(na2 > 0, a_coef, 1.0)
+    b_coef = jnp.where(nb2 > 0, b_coef, 1.0)
+    return (a_coef.astype(a.dtype) * a + b_coef.astype(b.dtype) * b)
+
 
 def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30,
-                      use_pallas=None):
+                      use_pallas=None, scalar_axes: Sequence[str] = ()):
     """The adaptive combine of two same-shaped tensors (adasum.h:371-390).
 
     When the gradients are orthogonal (dot=0) this is a plain sum; when they
@@ -45,43 +112,79 @@ def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30,
     coefficients derived in-kernel — the VPU equivalent of the reference's
     AVX loops (adasum.h:427-530). Zero-norm sides degenerate to a plain sum
     (coef 1.0), matching reference behavior (adasum.h:380-388).
-    """
-    if scalar_dtype == jnp.float32:
-        from . import pallas_kernels as pk
 
-        dn = pk.adasum_dot_norms(a, b, use_pallas=use_pallas)
-        return pk.adasum_combine(a, b, dn, use_pallas=use_pallas, eps=eps)
-    af = a.astype(scalar_dtype).ravel()
-    bf = b.astype(scalar_dtype).ravel()
-    dot = jnp.dot(af, bf)
-    na2 = jnp.dot(af, af)
-    nb2 = jnp.dot(bf, bf)
-    a_coef = 1.0 - dot / jnp.maximum(2.0 * na2, eps)
-    b_coef = 1.0 - dot / jnp.maximum(2.0 * nb2, eps)
-    a_coef = jnp.where(na2 > 0, a_coef, 1.0)
-    b_coef = jnp.where(nb2 > 0, b_coef, 1.0)
-    return (a_coef.astype(a.dtype) * a + b_coef.astype(b.dtype) * b)
+    ``scalar_axes``: mesh axes to psum the dot/norm scalars over, for
+    operands that are SHARDS of the logical vector (mesh routing) — the
+    coefficients then equal the full-vector coefficients.
+    """
+    dn = _dot_norms(a, b, scalar_dtype, scalar_axes, use_pallas)
+    return _combine_from_norms(a, b, dn, scalar_dtype, eps, use_pallas)
+
+
+def _exchange(x, perm, axis_name, wire: str, key, use_pallas):
+    """One pairwise exchange hop, in the level's wire format.
+
+    Returns ``(a, b)`` — the SELF and PARTNER views the combine should
+    consume. For the quantized wire both views come from the int8 form
+    (self included) so the two partners of a pair compute identical
+    combines and replicas stay bitwise-consistent.
+    """
+    if wire == "int8":
+        from .pallas_kernels import (dequantize_int8, quantize_int8,
+                                     quantize_int8_stochastic)
+
+        if key is None:
+            q, s, n = quantize_int8(x, use_pallas=use_pallas)
+        else:
+            q, s, n = quantize_int8_stochastic(x, key,
+                                               use_pallas=use_pallas)
+        qp = lax.ppermute(q, axis_name, perm)
+        sp = lax.ppermute(s, axis_name, perm)
+        a = dequantize_int8(q, s, n, x.shape, jnp.float32,
+                            use_pallas=use_pallas).astype(x.dtype)
+        b = dequantize_int8(qp, sp, n, x.shape, jnp.float32,
+                            use_pallas=use_pallas).astype(x.dtype)
+        return a, b
+    if wire == "bf16":
+        # Symmetric like int8: both sides of the pair see bf16 views.
+        xl = x.astype(jnp.bfloat16)
+        return (xl.astype(x.dtype),
+                lax.ppermute(xl, axis_name, perm).astype(x.dtype))
+    return x, lax.ppermute(x, axis_name, perm)
 
 
 def adasum_allreduce(x, axis_name: str = "hvd",
-                     scalar_dtype=jnp.float32):
+                     scalar_dtype=jnp.float32, wire: str = "none",
+                     key=None, scalar_axes: Sequence[str] = (),
+                     use_pallas=None):
     """Adasum-allreduce ``x`` over the mesh axis.
 
     Requires a power-of-two axis size (the reference's MPI VHDD setup makes
     the same assumption for the recursive-halving comm tree,
     adasum/adasum_mpi.cc). Works inside jit/shard_map.
+
+    ``wire`` selects the exchange payload per level: ``"none"`` (native
+    dtype), ``"bf16"``, or ``"int8"`` (block-scaled, one fp32 scale per
+    4096 elements — ~4x fewer bytes per hop; ``key`` makes the rounding
+    stochastic/unbiased, folded per level). ``scalar_axes`` psums the
+    dot/norm scalars over additional mesh axes — pass the fast axes when
+    ``x`` is a reduce-scattered shard (collectives.mesh_allreduce does).
     """
     n = lax.axis_size(axis_name)
     if n & (n - 1) != 0:
         raise ValueError(f"Adasum requires power-of-two ranks, got {n}")
     levels = int(np.log2(n))
-    rank = lax.axis_index(axis_name)
     for lvl in range(levels):
         dist = 1 << lvl
         # Pair permutation: r <-> r ^ dist (distance doubling).
         perm = [(r, r ^ dist) for r in range(n)]
-        y = lax.ppermute(x, axis_name, perm)
-        x = _pairwise_combine(x, y, scalar_dtype)
+        kl = None if key is None else jax.random.fold_in(key, lvl)
+        a, b = _exchange(x, perm, axis_name, wire, kl, use_pallas)
+        x = _pairwise_combine(a, b, scalar_dtype,
+                              use_pallas=use_pallas,
+                              scalar_axes=scalar_axes)
+        if _METRICS_ON:
+            _M_COMBINES.labels(wire=wire).inc()
     return x
 
 
@@ -118,6 +221,12 @@ def adasum_hierarchical(x, local_axis: str = "local",
     the slow domain (DCN; MPI in the reference), then allgather back.
     Averaging by local_size is folded in, as the reference folds it into
     postscale.
+
+    This is the full-vector form (every rank carries the whole locally-
+    averaged vector across the slow axis). The bandwidth-optimal SHARDED
+    form — RS over the fast axes, per-shard Adasum with fast-axis-psum-med
+    scalars, AG back — is ``collectives.mesh_allreduce(op=ADASUM)``
+    (docs/topology.md); both compute the same recursion.
     """
     nl = lax.axis_size(local_axis)
     # Average within the local (ICI) domain.

@@ -17,12 +17,15 @@ Reduce-op enum values match the reference C ABI
 
 from __future__ import annotations
 
+import dataclasses
 import enum
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..common import metrics as metrics_lib
 
 
 class ReduceOp(enum.IntEnum):
@@ -648,6 +651,510 @@ def quantized_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
         residual, cur + err_own, me * chunk, 0)
     residual = residual[:size].reshape(x.shape)
     return y, residual
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware collective router — per-axis phases with per-axis wire
+# dtypes (docs/topology.md).
+#
+# The MLPerf TPU-v3 pod recipe (arXiv:1909.09756, PAPERS.md) staged
+# allreduce per torus axis so the cost scales with the SLOWEST LINK, not
+# the world size: reduce-scatter along the fast ICI axis first, so the
+# slow cross-host hop only ever carries a 1/local_size shard. A WirePlan
+# generalizes that — and the former `quantized_cross` special case — to
+# any mesh: an ordered list of (axis, wire) phases, fast axis first,
+# where each axis independently chooses its payload format (fp32/bf16 on
+# fast ICI, block-scaled int8 on the slow DCN hop). mesh_allreduce
+# descends with reduce-scatters, reduces on the final (slowest) axis —
+# SUM/AVERAGE or ADASUM (the Maleki et al. hierarchical scheme,
+# arXiv:2006.02924: Adasum across the slow axis over locally-summed
+# shards, scalars psum-med over the fast axes) — and ascends with
+# all-gathers, each hop in its axis's wire format. With a `key` every
+# int8 rounding is stochastic (unbiased), and `return_residual` hands
+# back the error-feedback residual with the same sum-over-ranks contract
+# as quantized_allreduce, so the optimizer's int8_ef state composes
+# unchanged (optim.py).
+# ---------------------------------------------------------------------------
+
+# Wire formats an axis phase can carry (aligned with fusion.WIRE_*).
+_WIRES = ("none", "bf16", "int8")
+
+# Telemetry (docs/metrics.md): per-axis wire bytes are computed at TRACE
+# time (axis sizes and plans are static), so the counters record bytes
+# per compiled program — the `planned_per_compile` basis, same as the
+# fusion wire counters. Label schema matches the eager engine's
+# registration of this family (axis="flat" there).
+_METRICS_ON = metrics_lib.enabled()
+_M_AXIS_BYTES = metrics_lib.counter(
+    "hvd_tpu_allreduce_bytes_total",
+    "allreduce bytes on the wire by wire format and mesh axis "
+    "(axis=flat: eager per-call accounting; mesh axes: per compiled "
+    "routing plan; int8 includes the per-4096-block fp32 scales)",
+    labels=("wire", "axis"))
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisPhase:
+    """One phase of a routing plan: the shard_map axis it runs over and
+    the wire format its hops carry (``"none"`` native dtype / ``"bf16"``
+    cast / ``"int8"`` block-scaled quantized)."""
+
+    axis: str
+    wire: str = "none"
+
+    def __post_init__(self):
+        if self.wire == "fp32":  # alias
+            object.__setattr__(self, "wire", "none")
+        if self.wire not in _WIRES:
+            raise ValueError(
+                f"unknown wire format {self.wire!r} for axis "
+                f"{self.axis!r}; choose from {_WIRES}")
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePlan:
+    """Ordered per-axis routing plan, FAST axis first, slowest last.
+
+    The router reduce-scatters along ``phases[:-1]`` in order, runs the
+    reduction (SUM/AVERAGE/ADASUM) over ``phases[-1]``'s axis, and
+    all-gathers back in reverse — every hop in its phase's wire format.
+    Construct from a spec string (``"local:none,cross:int8"``; wires
+    default to ``none``), from :meth:`hierarchical`, or directly from
+    :class:`AxisPhase` tuples. Deterministic and static, so every rank
+    traces the identical schedule without negotiation.
+    """
+
+    phases: Tuple[AxisPhase, ...]
+
+    def __post_init__(self):
+        if not self.phases:
+            raise ValueError("WirePlan needs at least one axis phase")
+        names = [p.axis for p in self.phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axes in WirePlan: {names}")
+
+    @classmethod
+    def parse(cls, spec: str) -> "WirePlan":
+        """``"local:none,cross:int8"`` (fast -> slow; ``axis`` alone
+        means wire ``none``)."""
+        phases = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                axis, wire = part.split(":", 1)
+                phases.append(AxisPhase(axis.strip(), wire.strip()))
+            else:
+                phases.append(AxisPhase(part))
+        return cls(tuple(phases))
+
+    @classmethod
+    def hierarchical(cls, local_axis: str = "local",
+                     cross_axis: str = "cross",
+                     cross_wire: str = "none",
+                     local_wire: str = "none") -> "WirePlan":
+        """The 2-D ICI/DCN plan: fast local axis first, cross last.
+        ``cross_wire="int8"`` is the lifted `quantized_cross` special
+        case — int8 only where the slow bytes are."""
+        return cls((AxisPhase(local_axis, local_wire),
+                    AxisPhase(cross_axis, cross_wire)))
+
+    @classmethod
+    def resolve(cls, value, local_axis: str = "local",
+                cross_axis: str = "cross") -> Optional["WirePlan"]:
+        """Coerce a user-facing route value to a WirePlan (or None for
+        the flat axis): an existing plan, a spec string, or one of the
+        named routes ``"flat"`` / ``"staged"`` (hierarchical fp32) /
+        ``"staged_int8"`` (int8 cross hop)."""
+        if value is None:
+            return None
+        if isinstance(value, WirePlan):
+            return value
+        if isinstance(value, (list, tuple)):
+            return cls(tuple(p if isinstance(p, AxisPhase)
+                             else AxisPhase(*p) for p in value))
+        name = str(value).strip()
+        if name in ("", "flat", "none"):
+            return None
+        if name in ("staged", "hierarchical"):
+            return cls.hierarchical(local_axis, cross_axis)
+        if name in ("staged_int8", "quantized_cross", "mesh_int8"):
+            return cls.hierarchical(local_axis, cross_axis,
+                                    cross_wire="int8")
+        if ":" in name or "," in name:
+            return cls.parse(name)
+        raise ValueError(
+            f"unknown route {value!r}: pass a WirePlan, a spec like "
+            "'local:none,cross:int8', or one of "
+            "'flat'/'staged'/'staged_int8'")
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(p.axis for p in self.phases)
+
+    @property
+    def wires(self) -> Tuple[str, ...]:
+        return tuple(p.wire for p in self.phases)
+
+    def with_wires(self, wire: str) -> "WirePlan":
+        """Same axes, one wire format everywhere — e.g. the small-bucket
+        bf16/none downgrade of a quantized plan."""
+        return WirePlan(tuple(AxisPhase(p.axis, wire)
+                              for p in self.phases))
+
+    def reversed(self) -> "WirePlan":
+        """Phases in reverse order — the plan that inverts a
+        :func:`mesh_reducescatter` shard layout via
+        :func:`mesh_allgather` (RS descends fast->slow, so the gather
+        must ascend slow->fast)."""
+        return WirePlan(tuple(reversed(self.phases)))
+
+    def describe(self) -> str:
+        return ",".join(f"{p.axis}:{p.wire}" for p in self.phases)
+
+
+def _wire_elem_bytes(wire: str, itemsize: int) -> float:
+    """Per-element wire cost: int8 = 1 byte + one fp32 scale per
+    4096-element block; bf16 = 2; none = the native itemsize."""
+    if wire == "int8":
+        return 1.0 + 4.0 / _Q_BLOCK
+    if wire == "bf16":
+        return 2.0
+    return float(itemsize)
+
+
+def mesh_wire_cost(plan: WirePlan, nelems: int,
+                   axis_sizes: Sequence[int],
+                   op: ReduceOp = ReduceOp.SUM,
+                   itemsize: int = 4) -> dict:
+    """Static per-axis bytes-per-device model of a routed allreduce —
+    the number the router exists to minimize on the slowest axis.
+
+    Ring accounting: a reduce-scatter or all-gather over ``n`` ranks
+    moves ``(n-1)/n`` of the buffer per device; the final-axis
+    allreduce moves both (``2(n-1)/n``), except ADASUM's
+    distance-doubling exchange which moves the full shard once per
+    ``log2(n)`` level. Returns ``{axis: {"wire", "bytes", "size"}}``
+    plus ``"total"``; shard sizes shrink by each fast axis's size, which
+    is exactly how staging starves the slow axis of bytes.
+    """
+    sizes = list(axis_sizes)
+    if len(sizes) != len(plan.phases):
+        raise ValueError("axis_sizes must parallel plan.phases")
+    out = {}
+    length = float(nelems)
+    total = 0.0
+    # Descent + matching ascent for the fast axes.
+    for p, n in zip(plan.phases[:-1], sizes[:-1]):
+        eb = _wire_elem_bytes(p.wire, itemsize)
+        b = 2.0 * (n - 1) / n * length * eb  # RS down + AG back up
+        out[p.axis] = {"wire": p.wire, "bytes": b, "size": n}
+        total += b
+        length /= n
+    last, n = plan.phases[-1], sizes[-1]
+    eb = _wire_elem_bytes(last.wire, itemsize)
+    if op == ReduceOp.ADASUM:
+        import math
+
+        b = math.log2(n) * length * eb if n > 1 else 0.0
+    else:
+        b = 2.0 * (n - 1) / n * length * eb
+    out[last.axis] = {"wire": last.wire, "bytes": b, "size": n}
+    out["total"] = total + b
+    return out
+
+
+def _count_mesh_bytes(plan: WirePlan, nelems: int, ns, op) -> None:
+    if not _METRICS_ON:
+        return
+    cost = mesh_wire_cost(plan, nelems, ns, op)
+    for p in plan.phases:
+        _M_AXIS_BYTES.labels(wire=p.wire, axis=p.axis).inc(
+            cost[p.axis]["bytes"])
+
+
+def _cast_wire(x, wire: str):
+    """bf16 wire for an unquantized hop: cast down for the collective,
+    back up after (the caller restores)."""
+    return x.astype(jnp.bfloat16) if wire == "bf16" else x
+
+
+def _embed_residual(acc, piece, off):
+    """Accumulate ``piece`` into ``acc[off : off+len(piece)]`` (traced
+    offset)."""
+    cur = lax.dynamic_slice_in_dim(acc, off, piece.shape[0])
+    return lax.dynamic_update_slice_in_dim(acc, cur + piece, off, 0)
+
+
+def _quantized_allgather_1d(shard, axis_name: str, key, use_pallas):
+    """All-gather a 1-D fp32 shard (len % 4096 == 0) with int8 payload.
+    Returns ``(gathered fp32, local quantization error)`` — the error is
+    the REDUCED value's rounding, identical on every rank that holds
+    this shard (the caller masks duplicates before carrying it)."""
+    from .pallas_kernels import quantize_int8, quantize_int8_stochastic
+
+    if key is None:
+        q, s, _ = quantize_int8(shard, use_pallas=use_pallas)
+    else:
+        q, s, _ = quantize_int8_stochastic(shard, key,
+                                           use_pallas=use_pallas)
+    qg = lax.all_gather(q, axis_name)          # (n, rows, 128)
+    sg = lax.all_gather(s, axis_name)          # (n, nblocks)
+    gathered = _deq(qg, sg).reshape(-1)
+    err = shard - _deq(q, s).reshape(shard.shape)
+    return gathered, err
+
+
+def mesh_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
+                       plan: Optional[WirePlan] = None, key=None,
+                       use_pallas=None):
+    """Staged per-axis reduce-scatter of a flat buffer: RS along each
+    plan axis in order (fast first), each hop in its axis's wire format.
+    ``x`` is 1-D with length divisible by ``prod(sizes)`` (times 4096
+    per rank when any phase rides int8 — zero-pad; pads quantize to
+    exact 0). Returns this rank's reduced chunk. The descent assigns
+    chunks fast-axis-MAJOR (phase order), so the inverse gather is
+    ``mesh_allgather(shard, plan.reversed())`` — slow axis first.
+    """
+    plan = WirePlan.resolve(plan) or WirePlan.parse("hvd")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("mesh_reducescatter supports SUM/AVERAGE")
+    buf = x
+    total = 1
+    for i, p in enumerate(plan.phases):
+        n = lax.axis_size(p.axis)
+        total *= n
+        if p.wire == "int8":
+            kc = None if key is None else jax.random.fold_in(key, i)
+            buf = quantized_reducescatter(buf, ReduceOp.SUM, p.axis,
+                                          key=kc, use_pallas=use_pallas)
+            buf = buf.astype(x.dtype)
+        elif p.wire == "bf16":
+            buf = lax.psum_scatter(buf.astype(jnp.bfloat16), p.axis,
+                                   scatter_dimension=0,
+                                   tiled=True).astype(x.dtype)
+        else:
+            buf = lax.psum_scatter(buf, p.axis, scatter_dimension=0,
+                                   tiled=True)
+    if op == ReduceOp.AVERAGE:
+        buf = buf / jnp.asarray(total, buf.dtype)
+    return buf
+
+
+def mesh_allgather(x, plan: Optional[WirePlan] = None, key=None,
+                   use_pallas=None):
+    """Staged per-axis all-gather along dim 0: AG over each plan axis in
+    order (fast first), each hop in its axis's wire format. With the
+    global rank order slow-axis-major (the (cross, ..., local) mesh
+    layout), the result reproduces the flat allgather's row order —
+    :func:`hierarchical_allgather` generalized to any plan. int8 hops
+    quantize per 4096-element block (lossy, bounded by the block absmax
+    step; use on payloads that tolerate it, e.g. activations/grads)."""
+    plan = WirePlan.resolve(plan) or WirePlan.parse("hvd")
+    out = x
+    for i, p in enumerate(plan.phases):
+        if p.wire == "int8":
+            from .pallas_kernels import (quantize_int8,
+                                         quantize_int8_stochastic)
+
+            shape, size = out.shape, int(out.size)
+            flat = out.astype(jnp.float32).reshape(-1)
+            kc = None if key is None else jax.random.fold_in(key, i)
+            if kc is None:
+                q, s, _ = quantize_int8(flat, use_pallas=use_pallas)
+            else:
+                q, s, _ = quantize_int8_stochastic(
+                    flat, kc, use_pallas=use_pallas)
+            qg = lax.all_gather(q, p.axis)
+            sg = lax.all_gather(s, p.axis)
+            n = lax.axis_size(p.axis)
+            rows = _deq(qg, sg)[:, :size]      # (n, size)
+            out = rows.reshape((n * shape[0],) + shape[1:]).astype(
+                x.dtype)
+        elif p.wire == "bf16":
+            out = lax.all_gather(out.astype(jnp.bfloat16), p.axis,
+                                 axis=0, tiled=True).astype(x.dtype)
+        else:
+            out = lax.all_gather(out, p.axis, axis=0, tiled=True)
+    return out
+
+
+def mesh_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
+                   plan: Optional[WirePlan] = None, key=None,
+                   use_pallas=None, return_residual: bool = False,
+                   adasum_scalar_dtype=None):
+    """Topology-routed allreduce: per-axis RS descent -> final-axis
+    reduction -> per-axis AG ascent, with PER-AXIS WIRE DTYPES.
+
+    Any shape/dtype ``x``. Phases run fast axis first: each
+    reduce-scatter shrinks the working shard by that axis's size, so by
+    the time the slowest axis reduces, it carries ``1/prod(fast sizes)``
+    of the bytes — in its own wire format (the lifted `quantized_cross`
+    special case: fp32/bf16 on ICI, int8 on DCN). A 1-phase plan
+    degenerates to the flat allreduce.
+
+    ``op``:
+
+    - SUM / AVERAGE — linear reduction on every phase; AVERAGE divides
+      once at the end.
+    - ADASUM — the hierarchical Adasum scheme (Maleki et al.,
+      arXiv:2006.02924; reference adasum_gpu_operations.cc): fast axes
+      are summed (equivalently averaged — the final scale folds the
+      ``1/prod(fast)``), the SLOW axis runs the distance-doubling
+      adaptive recursion on shards with the dot/norm scalars psum-med
+      over the fast axes (true vector-halving VHDD: full-vector
+      coefficients, shard-sized wire traffic), in the slow phase's wire
+      format. Result = Adasum of the per-fast-group averages.
+
+    **Error bound** (int8 phases; docs/topology.md): each int8 hop
+    contributes at most ``r·s`` per element per participating rank
+    (``s`` = that block's absmax/127; ``r`` = 1/2 round-to-nearest, 1
+    stochastic) — the flat quantized_allreduce bound applied per phase.
+    ``key`` makes every rounding stochastic (unbiased), deterministic in
+    ``(x, key)``.
+
+    ``return_residual=True`` additionally returns this rank's fp32
+    error-feedback residual (same shape as ``x``): summed over ALL mesh
+    ranks it equals the pending correction, and feeding it back into the
+    next step's input telescopes the linear-phase quantization error
+    away exactly as the flat path does (for ADASUM the correction enters
+    the linear fast-axis sum — the Adasum recursion then consumes
+    corrected local sums). Ascent-hop errors are carried once (owner-
+    masked on the already-reduced axes).
+    """
+    plan = WirePlan.resolve(plan)
+    if plan is None:
+        raise ValueError("mesh_allreduce requires a WirePlan (route)")
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        raise ValueError("mesh_allreduce supports SUM/AVERAGE/ADASUM")
+    phases = plan.phases
+    ns = [lax.axis_size(p.axis) for p in phases]
+    N = 1
+    for n in ns:
+        N *= n
+    any_int8 = any(p.wire == "int8" for p in phases)
+    orig_dtype = x.dtype
+    shape, size = x.shape, int(x.size)
+
+    work_dtype = jnp.float32 if (any_int8 or return_residual) else x.dtype
+    flat = x.astype(work_dtype).reshape(-1)
+    align = _Q_BLOCK if any_int8 else 1
+    grid = N * align
+    L = -(-size // grid) * grid
+    flat = jnp.pad(flat, (0, L - size))
+    # Byte accounting over the PADDED length — the wire carries the
+    # whole block-aligned buffer, not the caller's element count.
+    _count_mesh_bytes(plan, L, ns, op)
+
+    residual = jnp.zeros((L,), jnp.float32) if return_residual else None
+    off = jnp.zeros((), jnp.int32)
+    desc = []  # (phase, pre_len, idx) stack for the ascent
+    buf = flat
+    kidx = 0
+
+    def fold(k):
+        return None if key is None else jax.random.fold_in(key, k)
+
+    # -- descent: RS over the fast axes, each in its wire ------------------
+    for p, n in zip(phases[:-1], ns[:-1]):
+        pre_len = buf.shape[0]
+        if p.wire == "int8":
+            rs = quantized_reducescatter(
+                buf.astype(jnp.float32), ReduceOp.SUM, p.axis,
+                key=fold(kidx), use_pallas=use_pallas,
+                return_residual=return_residual)
+            if return_residual:
+                shard, err = rs
+                residual = _embed_residual(residual, err, off)
+            else:
+                shard = rs
+            buf = shard.astype(work_dtype)
+        elif p.wire == "bf16":
+            buf = lax.psum_scatter(buf.astype(jnp.bfloat16), p.axis,
+                                   scatter_dimension=0,
+                                   tiled=True).astype(work_dtype)
+        else:
+            buf = lax.psum_scatter(buf, p.axis, scatter_dimension=0,
+                                   tiled=True)
+        kidx += 1
+        idx = lax.axis_index(p.axis)
+        desc.append((p, pre_len, idx))
+        off = off + (idx * buf.shape[0]).astype(jnp.int32)
+
+    # -- final (slowest) axis: the reduction -------------------------------
+    last, n_last = phases[-1], ns[-1]
+    if op == ReduceOp.ADASUM:
+        from . import adasum as adasum_lib
+
+        buf = adasum_lib.adasum_allreduce(
+            buf, last.axis,
+            scalar_dtype=adasum_scalar_dtype or jnp.float32,
+            wire=last.wire, key=fold(kidx),
+            scalar_axes=tuple(p.axis for p in phases[:-1]),
+            use_pallas=use_pallas)
+    elif last.wire == "int8":
+        ar = quantized_allreduce(
+            buf.astype(jnp.float32), ReduceOp.SUM, last.axis,
+            key=fold(kidx), use_pallas=use_pallas,
+            return_residual=return_residual)
+        if return_residual:
+            buf, err = ar
+            residual = _embed_residual(residual, err, off)
+        else:
+            buf = ar
+        buf = buf.astype(work_dtype)
+    elif last.wire == "bf16":
+        buf = lax.psum(buf.astype(jnp.bfloat16),
+                       last.axis).astype(work_dtype)
+    else:
+        buf = lax.psum(buf, last.axis)
+    kidx += 1
+
+    # -- ascent: AG back up the fast axes, in reverse ----------------------
+    for j in range(len(desc) - 1, -1, -1):
+        p, pre_len, idx = desc[j]
+        n_p = ns[j]
+        if p.wire == "int8":
+            gathered, err = _quantized_allgather_1d(
+                buf.astype(jnp.float32), p.axis, fold(kidx), use_pallas)
+            if return_residual:
+                # The quantized shard is identical on every rank of the
+                # axes already reduced below this point (phases[j+1:]) —
+                # carry its error once (owner-masked), so Σ_ranks
+                # residual counts it exactly once.
+                pred = jnp.asarray(True)
+                for q in phases[j + 1:]:
+                    pred = jnp.logical_and(pred,
+                                           lax.axis_index(q.axis) == 0)
+                residual = _embed_residual(
+                    residual, jnp.where(pred, err, 0.0), off)
+            buf = gathered.astype(work_dtype)
+        elif p.wire == "bf16":
+            buf = lax.all_gather(buf.astype(jnp.bfloat16), p.axis,
+                                 axis=0, tiled=True).astype(work_dtype)
+        else:
+            buf = lax.all_gather(buf, p.axis, axis=0, tiled=True)
+        kidx += 1
+        off = off - (idx * (pre_len // n_p)).astype(jnp.int32)
+
+    # -- final scale --------------------------------------------------------
+    if op == ReduceOp.AVERAGE:
+        buf = buf / jnp.asarray(N, buf.dtype)
+        if jnp.issubdtype(orig_dtype, jnp.integer):
+            # Match the flat allreduce: true-dividing an integer psum
+            # promotes to float, and casting back would floor-truncate.
+            orig_dtype = buf.dtype
+    elif op == ReduceOp.ADASUM and len(phases) > 1:
+        # Fast axes were SUMMED on descent; Adasum is homogeneous
+        # (adasum(αa, αb) = α·adasum(a, b)), so dividing by the fast-
+        # group size yields the Adasum of the per-group AVERAGES — the
+        # reference hierarchical semantics (adasum_gpu_operations.cc).
+        buf = buf / jnp.asarray(N // ns[-1], buf.dtype)
+    y = buf[:size].reshape(shape).astype(orig_dtype)
+    if not return_residual:
+        return y
+    return y, residual[:size].reshape(shape)
 
 
 def hierarchical_allreduce_staged(x, op: ReduceOp = ReduceOp.AVERAGE,

@@ -79,9 +79,10 @@ _M_BYTES = metrics_lib.counter(
     labels=("op", "kind"))
 _M_AR_WIRE = metrics_lib.counter(
     "hvd_tpu_allreduce_bytes_total",
-    "eager allreduce bytes on the wire by wire format (int8 includes "
-    "the per-4096-block fp32 scales)",
-    labels=("wire",))
+    "allreduce bytes on the wire by wire format and mesh axis "
+    "(axis=flat: eager per-call accounting; mesh axes: per compiled "
+    "routing plan; int8 includes the per-4096-block fp32 scales)",
+    labels=("wire", "axis"))
 
 
 def _wire_bytes_int8(elems: int) -> int:
@@ -775,7 +776,7 @@ class EagerEngine:
                 label, wire_bytes = fusion_lib.WIRE_NONE, nbytes
         _M_BYTES.labels(op="allreduce", kind="raw").inc(nbytes)
         _M_BYTES.labels(op="allreduce", kind="wire").inc(wire_bytes)
-        _M_AR_WIRE.labels(wire=label).inc(wire_bytes)
+        _M_AR_WIRE.labels(wire=label, axis="flat").inc(wire_bytes)
 
     def _count_grouped_bytes(self, skey: str, leaves, threshold: int,
                              quant: bool, qmin, compression) -> None:
@@ -825,7 +826,7 @@ class EagerEngine:
         _M_BYTES.labels(op="grouped_allreduce", kind="wire").inc(
             sum(totals["per_wire"].values()))
         for label, wb in totals["per_wire"].items():
-            _M_AR_WIRE.labels(wire=label).inc(wb)
+            _M_AR_WIRE.labels(wire=label, axis="flat").inc(wb)
 
     # -- collectives -------------------------------------------------------
 

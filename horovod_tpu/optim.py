@@ -172,6 +172,27 @@ def _resolve_quantize_min_bytes(explicit: Optional[int] = None) -> int:
     return _env_int("QUANTIZE_MIN_BYTES", Config.quantize_min_bucket_bytes)
 
 
+def _resolve_route(route, local_axis: str = "local",
+                   cross_axis: str = "cross"):
+    """Resolve a route value to a :class:`~.ops.collectives.WirePlan`
+    (or None = flat axis). ``None`` consults the configured default
+    (``HVD_TPU_ROUTE`` / ``init(route=)``); explicit values — a
+    WirePlan, a spec string like ``"local:none,cross:int8"``, or a
+    named route (``"flat"``/``"staged"``/``"staged_int8"``) — win."""
+    if route is None:
+        from .common import basics
+
+        if basics.is_initialized():
+            route = basics.context().config.route
+        else:
+            from .common.config import _env
+
+            route = _env("ROUTE")
+        if route is None:
+            return None
+    return C.WirePlan.resolve(route, local_axis, cross_axis)
+
+
 def _axes_bound(*axes) -> bool:
     """True iff all mesh axis names are bound in the current trace (i.e. we
     are inside shard_map/pmap over them). Probed once, narrowly, so a
@@ -189,7 +210,7 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
                  postscale: float = 1.0, hierarchical: bool = False,
                  local_axis: str = "local", cross_axis: str = "cross",
                  quantized_cross: bool = False, overlap: bool = False,
-                 bucket_order=None):
+                 bucket_order=None, route=None):
     """Fused (bucketed) allreduce of a gradient pytree over the mesh axis.
 
     ``overlap=True`` selects the latency-hiding schedule
@@ -200,6 +221,12 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
     while backprop still computes earlier layers' gradients. Scheduling
     only — results are bitwise-identical to ``overlap=False``.
 
+    ``route`` (a :class:`~.ops.collectives.WirePlan`) sends every bucket
+    through the topology-aware router (``collectives.mesh_allreduce``):
+    per-axis RS/AG phases with per-axis wire dtypes, SUM/AVERAGE/ADASUM
+    (docs/topology.md). It supersedes ``hierarchical``/``quantized_cross``
+    — those flags are the legacy 2-axis fp32/int8-cross special cases.
+
     Outside an SPMD region (axis names unbound) the reduction degenerates
     to size-1 reference semantics: no cross-rank sum, but pre/post scaling
     still applies (the reference applies ScaleBuffer regardless of world
@@ -207,13 +234,39 @@ def _reduce_tree(grads, op: C.ReduceOp, axis_name: str, compression,
     cross-device reduction itself — a manual psum there would
     double-reduce.
     """
-    needed_axes = ((local_axis, cross_axis) if hierarchical
+    if route is not None and not _axes_bound(*route.axis_names) \
+            and _axes_bound(axis_name):
+        # The program is tracing under the FLAT mesh (rank axis bound,
+        # plan axes not) — e.g. an HVD_TPU_ROUTE default reaching a
+        # flat-axis step. Reduce over the live axis; the identity
+        # (size-1) path below is only for fully-unbound traces, and
+        # silently NOT reducing would diverge replicas.
+        route = None
+    needed_axes = (route.axis_names if route is not None
+                   else (local_axis, cross_axis) if hierarchical
                    else (axis_name,))
     bound = _axes_bound(*needed_axes)
 
     def one(flat):
         w, ctx = compression.compress(flat)
-        if op == C.ReduceOp.ADASUM:
+        if route is not None:
+            if op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE,
+                          C.ReduceOp.ADASUM):
+                # MIN/MAX/PRODUCT have no staged decomposition (and no
+                # wire win to stage for) — reduce jointly over ALL plan
+                # axes, which lax accepts as an axis tuple.
+                return compression.decompress(
+                    C.allreduce(w, op, tuple(route.axis_names),
+                                prescale, postscale), ctx)
+            # Integer buckets must not ride lossy wires: same axes,
+            # native payload (psum of ints is exact on every phase).
+            rp = route if jnp.issubdtype(w.dtype, jnp.floating) \
+                else route.with_wires("none")
+            if op != C.ReduceOp.ADASUM:
+                w = C._apply_scale(w, prescale)
+            w = C.mesh_allreduce(w, op, rp)
+            w = C._apply_scale(w, postscale)
+        elif op == C.ReduceOp.ADASUM:
             from .ops import adasum as adasum_lib
 
             if hierarchical:
@@ -310,7 +363,8 @@ def _reduce_tree_ef(grads, residual, step, op: C.ReduceOp, axis_name: str,
                     fusion_threshold: int, prescale: float = 1.0,
                     postscale: float = 1.0, overlap: bool = False,
                     bucket_order=None,
-                    quantize_min_bytes: Optional[int] = None):
+                    quantize_min_bytes: Optional[int] = None,
+                    route=None):
     """Fused QUANTIZED allreduce of a gradient pytree with error
     feedback. Returns ``(reduced_tree, new_residual_tree)``.
 
@@ -327,11 +381,25 @@ def _reduce_tree_ef(grads, residual, step, op: C.ReduceOp, axis_name: str,
     chains the per-bucket collectives in issue order (common/overlap.py)
     exactly like the unquantized path.
 
+    ``route`` (a WirePlan) sends each bucket through the mesh router
+    instead of the flat axis: int8-eligible buckets run
+    ``collectives.mesh_allreduce`` with the plan's PER-AXIS wires and
+    carry its residual; small buckets ride the same axes bf16/native
+    (docs/topology.md). With ``op=ADASUM`` the router runs the
+    hierarchical Adasum scheme — the error-feedback residual corrects
+    the LINEAR fast-axis phases (the local sums the Adasum recursion
+    consumes); on a flat (1-phase) axis Adasum has no linear phase, so
+    the residual is consumed once and zeroed rather than telescoped.
+
     Outside an SPMD region the reduction degenerates to size-1 semantics
     (scales applied, residual unchanged) — matching :func:`_reduce_tree`.
     """
     qmin = _resolve_quantize_min_bytes(quantize_min_bytes)
-    bound = _axes_bound(axis_name)
+    if route is not None and not _axes_bound(*route.axis_names) \
+            and _axes_bound(axis_name):
+        route = None  # flat mesh is live — reduce flat (see _reduce_tree)
+    bound = _axes_bound(*(route.axis_names if route is not None
+                          else (axis_name,)))
     order = (bucket_order if bucket_order is not None
              else (fusion_lib.ORDER_REVERSE if overlap
                    else fusion_lib.ORDER_FLATTEN))
@@ -339,31 +407,56 @@ def _reduce_tree_ef(grads, residual, step, op: C.ReduceOp, axis_name: str,
     plan = fusion_lib.assign_wire_dtypes(plan, qmin)
     g_flats = fusion_lib.fuse(grads, plan)
     r_flats = fusion_lib.fuse(residual, plan)
+    reducible = (C.ReduceOp.SUM, C.ReduceOp.AVERAGE, C.ReduceOp.ADASUM)
+    adasum = op == C.ReduceOp.ADASUM
 
     def one(i, g, r):
         wire = plan.wire_dtypes[i]
         if not bound:
             w = C._apply_scale(g, prescale)
             return C._apply_scale(w, postscale), r
-        if wire == fusion_lib.WIRE_INT8 and op in (C.ReduceOp.SUM,
-                                                   C.ReduceOp.AVERAGE):
+        if wire == fusion_lib.WIRE_INT8 and op in reducible:
             corrected = g.astype(jnp.float32) + r
-            if prescale not in (None, 1.0):
+            if not adasum and prescale not in (None, 1.0):
                 corrected = corrected * prescale
-            y, res = C.quantized_allreduce(
-                corrected, op, axis_name, key=_ef_key(step, i),
-                return_residual=True)
-            if prescale not in (None, 1.0):
+            if route is not None:
+                y, res = C.mesh_allreduce(
+                    corrected, op, route, key=_ef_key(step, i),
+                    return_residual=True)
+            elif adasum:
+                # Flat-axis Adasum: quantized distance-doubling exchange
+                # (unbiased with the stochastic key); no linear phase, so
+                # the consumed residual zeroes instead of telescoping.
+                from .ops import adasum as adasum_lib
+
+                y = adasum_lib.adasum_allreduce(
+                    corrected, axis_name, wire="int8",
+                    key=_ef_key(step, i))
+                res = jnp.zeros_like(r)
+            else:
+                y, res = C.quantized_allreduce(
+                    corrected, op, axis_name, key=_ef_key(step, i),
+                    return_residual=True)
+            if not adasum and prescale not in (None, 1.0):
                 # Residual lives in UNSCALED gradient units (it is added
                 # to raw grads next step, before this prescale reapplies).
                 res = res / prescale
             y = C._apply_scale(y, postscale)
             return y.astype(g.dtype), res
-        if wire == fusion_lib.WIRE_BF16 and op in (C.ReduceOp.SUM,
-                                                   C.ReduceOp.AVERAGE):
-            w = C.allreduce(g.astype(jnp.bfloat16), op, axis_name,
-                            prescale, postscale)
+        if wire == fusion_lib.WIRE_BF16 and op in reducible:
+            gb = g.astype(jnp.bfloat16)
+            if route is not None:
+                if not adasum:
+                    gb = C._apply_scale(gb, prescale)
+                w = C.mesh_allreduce(gb, op, route.with_wires("none"))
+                w = C._apply_scale(w, postscale)
+            else:
+                w = C.allreduce(gb, op, axis_name, prescale, postscale)
             return w.astype(g.dtype), r
+        if route is not None and op in reducible:
+            gg = g if adasum else C._apply_scale(g, prescale)
+            w = C.mesh_allreduce(gg, op, route.with_wires("none"))
+            return C._apply_scale(w, postscale), r
         return C.allreduce(g, op, axis_name, prescale, postscale), r
 
     outs = []
@@ -408,7 +501,8 @@ def DistributedOptimizer(optimizer,
                          overlap: bool = False,
                          bucket_order=None,
                          quantize_min_bucket_bytes: Optional[int] = None,
-                         nonfinite_policy: Optional[str] = None):
+                         nonfinite_policy: Optional[str] = None,
+                         route=None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -464,6 +558,22 @@ def DistributedOptimizer(optimizer,
     ``hvd.observe_guard(opt_state)`` raises host-side). The state is
     wrapped in :class:`_GuardedState`; observe with
     ``hvd.observe_guard``.
+
+    ``route`` (None → ``HVD_TPU_ROUTE`` / ``init(route=)``) selects the
+    TOPOLOGY-AWARE ROUTER (docs/topology.md): a
+    :class:`~.ops.collectives.WirePlan`, a spec string like
+    ``"local:none,cross:int8"`` (fast axis first), or a named route
+    (``"flat"`` / ``"staged"`` / ``"staged_int8"``). Each fused bucket
+    then reduces via per-axis phases with PER-AXIS WIRE DTYPES —
+    fp32/bf16 on fast ICI axes, int8 on the slow cross hop — so wire
+    cost scales with the slowest link, not the world size. Composes
+    with ``compression="int8_ef"`` (the residual rides the linear
+    phases), with ``op=hvd.Adasum`` (hierarchical Adasum: fast axes
+    averaged, the adaptive recursion runs on shards over the slow axis
+    with fast-axis-psum-med scalars), and with ``overlap`` (each
+    chained bucket routes independently). Supersedes the legacy
+    ``hierarchical``/``quantized_cross`` booleans — passing both
+    raises.
     """
     try:
         import optax
@@ -473,20 +583,43 @@ def DistributedOptimizer(optimizer,
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
     ef = getattr(compression, "error_feedback", False)
+    route_explicit = route is not None
+    route = _resolve_route(route, local_axis, cross_axis)
+    if route_explicit and route is not None and (hierarchical
+                                                or quantized_cross):
+        raise ValueError(
+            "route= supersedes the hierarchical/quantized_cross "
+            "booleans: express the staged reduction as WirePlan phases "
+            "on the mesh router instead (collectives.mesh_allreduce, "
+            "docs/topology.md) — e.g. route='staged_int8' or "
+            "WirePlan.hierarchical(cross_wire='int8') for the old "
+            "hierarchical+quantized_cross pair")
+    if not route_explicit and (hierarchical or quantized_cross):
+        # Call-site legacy flags beat the HVD_TPU_ROUTE / init(route=)
+        # DEFAULT — an env knob must never make existing hierarchical
+        # call sites raise (or silently re-route them).
+        route = None
     if quantized_cross and (not hierarchical or op not in (
             C.ReduceOp.SUM, C.ReduceOp.AVERAGE)):
         raise ValueError("quantized_cross requires hierarchical=True and "
                          "a SUM/AVERAGE op (the int8 hop rides the "
-                         "staged RS->AR->AG pipeline)")
-    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+                         "staged RS->AR->AG pipeline); for Adasum or "
+                         "deeper meshes use the router — route= / "
+                         "collectives.mesh_allreduce (docs/topology.md)")
+    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE,
+                         C.ReduceOp.ADASUM):
         raise ValueError(
-            f"compression={compression.__name__} needs a SUM/AVERAGE op "
-            "(block-scaled payloads only compose with linear reductions)")
+            f"compression={compression.__name__} needs a SUM/AVERAGE/"
+            "ADASUM op (block-scaled payloads compose with linear "
+            "reductions, plus the routed hierarchical Adasum)")
     if ef and hierarchical:
-        raise ValueError(
-            "int8_ef composes with the flat rank axis; for hierarchical "
-            "(ICI/DCN) reduction use quantized_cross=True, which carries "
-            "the DCN hop as int8 inside the staged RS->AR->AG pipeline")
+        # Formerly a hard error: int8_ef now composes with the ICI/DCN
+        # split THROUGH the mesh router — the per-axis WirePlan carries
+        # the slow cross hop as int8 and the error-feedback residual
+        # rides the linear phases (docs/topology.md).
+        route = C.WirePlan.hierarchical(local_axis, cross_axis,
+                                        cross_wire="int8")
+        hierarchical = quantized_cross = False
 
     k = int(backward_passes_per_step)
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
@@ -501,7 +634,7 @@ def DistributedOptimizer(optimizer,
                             fusion_threshold_bytes, prescale_factor,
                             postscale_factor, hierarchical, local_axis,
                             cross_axis, quantized_cross, overlap,
-                            bucket_order)
+                            bucket_order, route)
 
     # Core transformation: reduce + inner update (+ the error-feedback
     # residual/step state when the compressor declares it). The k>1
@@ -521,7 +654,7 @@ def DistributedOptimizer(optimizer,
         reduced, new_res = _reduce_tree_ef(
             grads, state.residual, state.step, op, axis_name,
             fusion_threshold_bytes, prescale_factor, postscale_factor,
-            overlap, bucket_order, quantize_min_bucket_bytes)
+            overlap, bucket_order, quantize_min_bucket_bytes, route)
         updates, new_inner = optimizer.update(reduced, state.inner,
                                               params, **extra)
         return updates, _EFState(new_inner, new_res, state.step + 1)
@@ -530,7 +663,18 @@ def DistributedOptimizer(optimizer,
     # reduction + inner update — in the globally-agreed lax.cond, so a
     # skipped step leaves inner state, EF residual, and EF step counter
     # untouched. The k>1 aggregation below wraps THIS, so each
-    # effective (post-accumulation) step is what gets guarded.
+    # effective (post-accumulation) step is what gets guarded. Under a
+    # mesh route the one-scalar agreement runs over the PLAN's axes
+    # (the flat rank axis is not bound there); resolved at TRACE time
+    # so a defaulted route reaching a flat-axis step still agrees over
+    # the live axis (matching _reduce_tree's fallback).
+    def _guard_axes():
+        if route is not None and _axes_bound(*route.axis_names):
+            return tuple(route.axis_names)
+        if hierarchical and _axes_bound(local_axis, cross_axis):
+            return (local_axis, cross_axis)
+        return axis_name
+
     if nonfinite_policy is None:
         u_init, u_update = core_init, core_update
     else:
@@ -546,7 +690,7 @@ def DistributedOptimizer(optimizer,
 
             updates, new_inner, new_guard = integrity_lib.guarded_apply(
                 nonfinite_policy, fn, grads, state.inner, state.guard,
-                axis_name, scale_cfg)
+                _guard_axes(), scale_cfg)
             return updates, _GuardedState(new_inner, new_guard)
 
     if k <= 1:
@@ -595,7 +739,8 @@ def DistributedGradFn(grad_fn: Callable,
                       overlap: bool = False,
                       bucket_order=None,
                       quantize_min_bucket_bytes: Optional[int] = None,
-                      nonfinite_policy: Optional[str] = None):
+                      nonfinite_policy: Optional[str] = None,
+                      route=None):
     """DistributedGradientTape analog (reference
     tensorflow/__init__.py:564-629): wraps a function returning gradients
     (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
@@ -640,23 +785,40 @@ def DistributedGradFn(grad_fn: Callable,
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
     ef = getattr(compression, "error_feedback", False)
-    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+    route = _resolve_route(route)
+    if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE,
+                         C.ReduceOp.ADASUM):
         raise ValueError(
-            f"compression={compression.__name__} needs a SUM/AVERAGE op")
+            f"compression={compression.__name__} needs a SUM/AVERAGE/"
+            "ADASUM op")
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
     quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
         quantize_min_bucket_bytes)
     nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
         nonfinite_policy) if nonfinite_policy is not None else None
     scale_cfg = integrity_lib.ScaleConfig.from_env()
+    def _guard_axes():
+        """Resolved at TRACE time: the plan's axes when they are bound,
+        else the flat rank axis (a defaulted route must not push the
+        guard's agreement onto unbound axes — see _reduce_tree)."""
+        if route is not None and _axes_bound(*route.axis_names):
+            return tuple(route.axis_names)
+        return axis_name
 
     def reduce_grads(grads):
         return _reduce_tree(grads, op, axis_name, compression,
                             fusion_threshold_bytes, overlap=overlap,
-                            bucket_order=bucket_order)
+                            bucket_order=bucket_order, route=route)
 
     def _reduce_value(val):
-        if reduce_value and _axes_bound(axis_name):
+        if not reduce_value:
+            return val
+        if route is not None and _axes_bound(*route.axis_names):
+            return jax.tree.map(
+                lambda v: C.mesh_allreduce(
+                    v, C.ReduceOp.AVERAGE, route.with_wires("none")),
+                val)
+        if _axes_bound(axis_name):
             return jax.tree.map(
                 lambda v: C.allreduce(v, C.ReduceOp.AVERAGE, axis_name),
                 val)
@@ -683,7 +845,8 @@ def DistributedGradFn(grad_fn: Callable,
                     g, res, stp, op, axis_name,
                     fusion_threshold_bytes, overlap=overlap,
                     bucket_order=bucket_order,
-                    quantize_min_bytes=quantize_min_bucket_bytes)
+                    quantize_min_bytes=quantize_min_bucket_bytes,
+                    route=route)
                 return red, (new_res, stp + 1)
 
             if nonfinite_policy is None:
@@ -700,7 +863,8 @@ def DistributedGradFn(grad_fn: Callable,
             reduced, (new_res, new_step), new_guard = \
                 integrity_lib.guarded_apply(
                     nonfinite_policy, reduce_ef, grads, (residual, step),
-                    _guard_or_init(guard_state), axis_name, scale_cfg)
+                    _guard_or_init(guard_state), _guard_axes(),
+                    scale_cfg)
             new_state = _EFState(inner=None, residual=new_res,
                                  step=new_step)
             if has_value:
@@ -727,7 +891,7 @@ def DistributedGradFn(grad_fn: Callable,
             return reduce_grads(grads)
         reduced, _, new_guard = integrity_lib.guarded_apply(
             nonfinite_policy, lambda g, c: (reduce_grads(g), c), grads,
-            (), _guard_or_init(guard_state), axis_name, scale_cfg)
+            (), _guard_or_init(guard_state), _guard_axes(), scale_cfg)
         if has_value:
             return (_reduce_value(val), reduced), new_guard
         return reduced, new_guard
@@ -791,22 +955,31 @@ class AutotunedStepper:
         # Joint tuning (reference ParameterManager's hierarchical toggle):
         # build_step then takes (threshold, hierarchical). With a
         # tune_overlap tuner the signature widens once more to
-        # (threshold, hierarchical, overlap), and with tune_compression
-        # to (threshold, hierarchical, overlap, compression) — the full
-        # point the (re)built step must agree on across ranks.
+        # (threshold, hierarchical, overlap), with tune_compression to
+        # (threshold, hierarchical, overlap, compression), and with
+        # tune_route to (..., route) — route is the axis-order/
+        # reduction-mode candidate ("flat"/"staged"/"staged_int8"/
+        # "adasum"; docs/topology.md) — the full point the (re)built
+        # step must agree on across ranks.
         self._joint = getattr(tuner, "tune_hierarchical", False)
         self._joint_overlap = getattr(tuner, "tune_overlap", False)
         self._joint_comp = getattr(tuner, "tune_compression", False)
+        self._joint_route = getattr(tuner, "tune_route", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
         self._ovl = (tuner.current_overlap if self._joint_overlap
                      else False)
         self._comp = (tuner.current_compression if self._joint_comp
                       else "none")
+        self._route = (tuner.current_route if self._joint_route
+                       else "flat")
         self._step = self._rebuild()
         self.rebuilds = 0
         self._step_count = 0  # metrics/profiler step numbering
 
     def _rebuild(self):
+        if self._joint_route:
+            return self._build(self._threshold, self._hier, self._ovl,
+                               self._comp, self._route)
         if self._joint_comp:
             return self._build(self._threshold, self._hier, self._ovl,
                                self._comp)
@@ -832,6 +1005,10 @@ class AutotunedStepper:
     def compression(self) -> str:
         return self._comp
 
+    @property
+    def route(self) -> str:
+        return self._route
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -848,17 +1025,19 @@ class AutotunedStepper:
             _M_STEP.observe(dt)
         c = self._controller
         if c is None or c.size == 1:
-            new, tuner_h, tuner_o, tuner_c = self.tuner.feed_quad(
-                self.grad_bytes, dt)
+            new, tuner_h, tuner_o, tuner_c, tuner_r = \
+                self.tuner.feed_quint(self.grad_bytes, dt)
             new_h = tuner_h if self._joint else self._hier
             new_o = tuner_o if self._joint_overlap else self._ovl
             new_c = tuner_c if self._joint_comp else self._comp
+            new_r = tuner_r if self._joint_route else self._route
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new, new_h, new_o, new_c = (self._threshold, self._hier,
-                                        self._ovl, self._comp)
+            new, new_h, new_o, new_c, new_r = (
+                self._threshold, self._hier, self._ovl, self._comp,
+                self._route)
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -866,11 +1045,12 @@ class AutotunedStepper:
                 # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                cur_t, cur_h, cur_o, cur_c = \
-                    self.tuner.current_quad  # atomic
+                cur_t, cur_h, cur_o, cur_c, cur_r = \
+                    self.tuner.current_quint  # atomic
                 mine = (f"{cur_t}|{int(cur_h) if self._joint else 0}"
                         f"|{int(cur_o) if self._joint_overlap else 0}"
                         f"|{cur_c if self._joint_comp else 'none'}"
+                        f"|{cur_r if self._joint_route else 'flat'}"
                         + (":done" if c.rank == 0 and self.tuner.done
                            else ""))
                 vals = c.exchange("autotune_threshold", mine)
@@ -878,16 +1058,18 @@ class AutotunedStepper:
                 if v0.endswith(":done"):
                     self._tuner_done = True
                     v0 = v0[:-5]
-                t_str, h_str, o_str, c_str = v0.split("|")
+                t_str, h_str, o_str, c_str, r_str = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
                 new_o = bool(int(o_str)) if self._joint_overlap \
                     else self._ovl
                 new_c = c_str if self._joint_comp else self._comp
+                new_r = r_str if self._joint_route else self._route
         if (new != self._threshold or new_h != self._hier
-                or new_o != self._ovl or new_c != self._comp):
-            self._threshold, self._hier, self._ovl, self._comp = \
-                new, new_h, new_o, new_c
+                or new_o != self._ovl or new_c != self._comp
+                or new_r != self._route):
+            self._threshold, self._hier, self._ovl, self._comp, \
+                self._route = new, new_h, new_o, new_c, new_r
             self._step = self._rebuild()
             self.rebuilds += 1
             _M_REBUILDS.inc()

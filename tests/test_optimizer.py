@@ -159,9 +159,18 @@ def test_compression_accepts_names_and_config_default(hvd):
     with pytest.raises(ValueError, match="SUM/AVERAGE"):
         hvd_mod.DistributedOptimizer(optax.sgd(0.1), op=C.ReduceOp.MAX,
                                      compression="int8_ef")
-    with pytest.raises(ValueError, match="quantized_cross"):
+    # int8_ef + hierarchical (formerly a hard error) now routes through
+    # the mesh router with the int8 wire on the cross axis
+    # (docs/topology.md; the full behavioral test lives in
+    # test_mesh_routing.py).
+    tx = hvd_mod.DistributedOptimizer(optax.sgd(0.1), hierarchical=True,
+                                      compression="int8_ef")
+    assert tx is not None
+    # route= and the legacy booleans are mutually exclusive: the error
+    # points at the mesh router.
+    with pytest.raises(ValueError, match="mesh router|mesh_allreduce"):
         hvd_mod.DistributedOptimizer(optax.sgd(0.1), hierarchical=True,
-                                     compression="int8_ef")
+                                     route="staged_int8")
 
 
 def test_int8_ef_optimizer_tracks_fp32(hvd, rng):

@@ -218,6 +218,99 @@ def test_pairwise_combine_uses_kernels(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 
+def test_adasum_combine_pallas_jnp_parity(rng):
+    """The combine kernel is ELEMENTWISE given the (3,) scalar vector,
+    so the Pallas body (run under the CPU interpreter) and the jnp
+    fallback perform the same multiplies and adds — parity is pinned at
+    one rounding of the OPERAND scale (XLA may contract `a*ca + b*cb`
+    into an FMA in one separately-compiled program and not the other,
+    so bit equality across programs is not guaranteed; where the sum
+    cancels toward zero that single contraction is the whole absolute
+    difference). The ISSUE-6 satellite: these kernels had never run
+    outside the interpreter, so this parity is the contract a future
+    chip run is checked against."""
+    for n in (64, 4096, 70000):  # sub-block, one block, multi-block
+        a = jnp.asarray(rng.standard_normal(n), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(n) * 3, jnp.float32)
+        dn = pk.adasum_dot_norms(a, b, use_pallas=False)
+        got = np.asarray(pk.adasum_combine(a, b, dn, use_pallas=True))
+        want = np.asarray(pk.adasum_combine(a, b, dn, use_pallas=False))
+        scale = max(float(np.abs(np.asarray(a)).max()),
+                    float(np.abs(np.asarray(b)).max()))
+        np.testing.assert_allclose(got, want, rtol=1e-6,
+                                   atol=2 ** -23 * scale * 4)
+
+
+def test_adasum_dot_norms_edge_cases_parity(rng):
+    """Zero-norm / orthogonal / parallel inputs through BOTH kernel
+    paths: the degenerate coefficients (adasum.h:380-388) must agree
+    between the Pallas interpreter and the jnp fallback, and match the
+    analytic values."""
+    n = 2048
+    base = rng.standard_normal(n).astype(np.float32)
+    zeros = np.zeros(n, np.float32)
+    # orthogonal pair: disjoint support
+    oa, ob = zeros.copy(), zeros.copy()
+    oa[: n // 2] = base[: n // 2]
+    ob[n // 2:] = base[n // 2:]
+    cases = {
+        "zero_a": (zeros, base),
+        "zero_b": (base, zeros),
+        "zero_both": (zeros, zeros),
+        "orthogonal": (oa, ob),
+        "parallel": (base, 2.0 * base),
+    }
+    for name, (a, b) in cases.items():
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        dn_p = np.asarray(pk.adasum_dot_norms(a, b, use_pallas=True))
+        dn_j = np.asarray(pk.adasum_dot_norms(a, b, use_pallas=False))
+        np.testing.assert_allclose(dn_p, dn_j, rtol=1e-5, atol=1e-5,
+                                   err_msg=name)
+        out_p = np.asarray(pk.adasum_combine(a, b, jnp.asarray(dn_p),
+                                             use_pallas=True))
+        out_j = np.asarray(pk.adasum_combine(a, b, jnp.asarray(dn_p),
+                                             use_pallas=False))
+        # One-contraction parity (see test_adasum_combine_pallas_jnp_
+        # parity for why not bit-exact across compiled programs).
+        np.testing.assert_allclose(out_p, out_j, rtol=1e-6, atol=1e-6,
+                                   err_msg=name)
+        if name.startswith("zero") or name == "orthogonal":
+            # dot = 0 (or zero-norm side): plain sum, coefs 1.0.
+            np.testing.assert_allclose(out_p, np.asarray(a) +
+                                       np.asarray(b), rtol=1e-5,
+                                       atol=1e-6, err_msg=name)
+        elif name == "parallel":
+            # adasum(a, 2a): dot=2||a||^2 -> ca=1-1=0, cb=1-1/4=3/4
+            # -> result (3/4)*2a = 1.5a (equal-norm parallel inputs
+            # would average; the general parallel case interpolates).
+            np.testing.assert_allclose(out_p, 1.5 * np.asarray(a),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=name)
+
+
+def test_pairwise_combine_scalar_axes_sharded_vhdd(rng):
+    """_pairwise_combine(scalar_axes=) — the vector-halving VHDD form
+    the mesh router uses: combining SHARDS with fast-axis-psum-med
+    scalars must reproduce the FULL-vector combine exactly."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops.adasum import _pairwise_combine
+
+    a = rng.standard_normal((8, 128)).astype(np.float32)
+    b = rng.standard_normal((8, 128)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()), ("hvd",))
+    f = jax.jit(jax.shard_map(
+        lambda av, bv: _pairwise_combine(av, bv, scalar_axes=("hvd",)),
+        mesh=mesh, in_specs=(P("hvd"), P("hvd")),
+        out_specs=P("hvd")))
+    got = np.asarray(f(a.reshape(8, 1, 128), b.reshape(8, 1, 128)))
+    full = np.asarray(_pairwise_combine(jnp.asarray(a.ravel()),
+                                        jnp.asarray(b.ravel())))
+    np.testing.assert_allclose(got.reshape(-1), full, rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_flash_block_specs_obey_mosaic_tiling_rule():
     """Static pin of the Mosaic constraint that cost a round-3 chip
     window: every BlockSpec's minor-two dims must be (multiple of 8,

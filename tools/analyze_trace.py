@@ -95,9 +95,13 @@ def summarize_metrics(rec: dict) -> dict:
                 1000.0 * v["sum"] / v["count"], 3)
     if phases:
         out["step_phase_mean_ms"] = phases
-    wire = {s["labels"].get("wire", "?"): s["value"]
-            for s in samples("hvd_tpu_allreduce_bytes_total")
-            if s["value"]}
+    # Sum across the `axis` label (eager flat + per-mesh-axis samples
+    # share a wire format — a dict comprehension would keep only one).
+    wire = {}
+    for s in samples("hvd_tpu_allreduce_bytes_total"):
+        if s["value"]:
+            w = s["labels"].get("wire", "?")
+            wire[w] = wire.get(w, 0) + s["value"]
     if wire:
         out["allreduce_bytes_on_wire"] = wire
     cache = {s["labels"].get("result", "?"): s["value"]

@@ -119,6 +119,43 @@ def check_integrity_surface(missing: list) -> None:
                            "docs/integrity.md")
 
 
+def check_topology_surface(missing: list) -> None:
+    """The topology-routing layer (docs/topology.md): its env knobs,
+    its route metrics, and the router's public names must be
+    documented — an undocumented WirePlan wire or knob is an
+    undiscoverable one. Parsed textually (runs without jax)."""
+    doc = REPO / "docs" / "topology.md"
+    if not doc.exists():
+        missing.append("path: docs/topology.md")
+        return
+    text = doc.read_text()
+    for knob in ("HVD_TPU_MESH_SHAPE", "HVD_TPU_ROUTE"):
+        if knob not in text:
+            missing.append(f"topology knob {knob}: undocumented in "
+                           "docs/topology.md")
+    # Route metrics registered by the layer's source files.
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*"(hvd_tpu_[a-z0-9_]+)"')
+    names = set()
+    for rel in (("horovod_tpu", "ops", "collectives.py"),
+                ("horovod_tpu", "ops", "adasum.py")):
+        names |= set(reg_call.findall(REPO.joinpath(*rel).read_text()))
+    names.add("hvd_tpu_autotune_route_index")
+    for n in sorted(names):
+        if n not in text:
+            missing.append(f"topology metric {n}: undocumented in "
+                           "docs/topology.md")
+    # Public router surface must appear in the API doc.
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    src = (REPO / "horovod_tpu" / "ops" / "collectives.py").read_text()
+    for name in ("WirePlan", "mesh_allreduce", "mesh_reducescatter",
+                 "mesh_allgather", "mesh_wire_cost"):
+        if (f"def {name}" in src or f"class {name}" in src) \
+                and name not in api_text:
+            missing.append(f"api: {name} undocumented in docs/api.md")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -157,6 +194,7 @@ def main() -> int:
     check_compression_surface(missing)
     check_metrics_surface(missing)
     check_integrity_surface(missing)
+    check_topology_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
