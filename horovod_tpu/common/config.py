@@ -143,6 +143,18 @@ class Config:
     quantize_min_bucket_bytes: int = 64 * 1024
     # Elastic mode (reference: HOROVOD_ELASTIC).
     elastic: bool = False
+    # Telemetry-driven autoscaling (docs/autoscale.md — no reference
+    # analog: the reference's elastic layer only survives membership
+    # change, it never decides). `autoscale` arms the control loop in
+    # the elastic driver; `autoscale_policy` is a JSON policy file path
+    # or inline JSON (every threshold/window/hysteresis knob is DATA —
+    # see common/autoscale.AutoscalePolicy; individual fields override
+    # via HVD_TPU_AUTOSCALE_<FIELD>); `autoscale_log` is the
+    # driver-side JSON-lines decision log (deterministic under a seeded
+    # fault plan — tools/chaos_soak.py --family autoscale).
+    autoscale: bool = False
+    autoscale_policy: Optional[str] = None
+    autoscale_log: Optional[str] = None
     # Join mode: multi-process programs that call hvd.join() must enable
     # this so every eager collective runs a coordination round in which a
     # joined process can answer "JOIN" (the reference is ALWAYS in this
@@ -214,6 +226,9 @@ class Config:
         c.quantize_min_bucket_bytes = _env_int(
             "QUANTIZE_MIN_BYTES", cls.quantize_min_bucket_bytes)
         c.elastic = _env_bool("ELASTIC", False)
+        c.autoscale = _env_bool("AUTOSCALE", False)
+        c.autoscale_policy = _env("AUTOSCALE_POLICY")
+        c.autoscale_log = _env("AUTOSCALE_LOG")
         c.join_mode = _env_bool("JOIN_MODE", False)
         c.thread_affinity = _env("THREAD_AFFINITY")
         c.compilation_cache_dir = _env("COMPILATION_CACHE_DIR")

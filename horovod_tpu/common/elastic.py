@@ -126,6 +126,13 @@ class State:
         # lost, and an injected preemption latches in time for THIS
         # commit to honor it.
         faults_lib.maybe_worker_fault()
+        # Autoscale telemetry (docs/autoscale.md): one commit = one
+        # training step from the control plane's view — publish the
+        # rolling step-time summary over the rendezvous KV. A None
+        # check when the driver did not enable autoscaling.
+        from . import autoscale as autoscale_lib
+
+        autoscale_lib.note_step()
         self.save()
         self._handle_preemption()
         self.check_host_updates()

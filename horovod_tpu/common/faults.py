@@ -54,6 +54,12 @@ site                 where it fires / what it does
                      just-written step (mode ``bitflip`` default /
                      ``truncate`` / ``sidecar``) so restore must detect
                      it and walk back to the last verified step
+``straggler``        autoscale step-time publication
+                     (``autoscale.StepPublisher.note``, one hit per
+                     ``State.commit()``): ``delay_s`` sleeps for real
+                     (an honest slow worker the straggler detector must
+                     catch); ``scale`` inflates only the REPORTED step
+                     time (simulation)
 ===================  =====================================================
 
 Plan JSON: ``{"seed": 42, "faults": [{"site": ..., "step": N |
@@ -87,7 +93,8 @@ ENV_PLAN = "HVD_TPU_FAULT_PLAN"
 ENV_LOG = "HVD_TPU_FAULT_LOG"
 
 SITES = ("collective", "collective_stall", "rendezvous", "discovery",
-         "crash", "preempt", "nonfinite", "diverge", "checkpoint_corrupt")
+         "crash", "preempt", "nonfinite", "diverge", "checkpoint_corrupt",
+         "straggler")
 
 _SPEC_FIELDS = ("site", "step", "probability", "times", "mode", "delay_s",
                 "code", "exit_code", "message", "rank", "host", "target",
@@ -160,7 +167,8 @@ class FaultInjector:
     Thread-safe; each site keeps a hit counter, each spec a fired
     counter and (for probability mode) its own seeded RNG stream."""
 
-    def __init__(self, plan: FaultPlan, log_path: Optional[str] = None):
+    def __init__(self, plan: FaultPlan, log_path: Optional[str] = None,
+                 rank: Optional[str] = None, host: Optional[str] = None):
         self.plan = plan
         self._lock = threading.Lock()
         self._hits: Dict[str, int] = {}
@@ -169,8 +177,14 @@ class FaultInjector:
                       for i, s in enumerate(plan.faults)]
         self._log_path = log_path if log_path is not None \
             else os.environ.get(ENV_LOG) or None
-        self._rank = os.environ.get("HVD_TPU_PROC_ID")
-        self._host = os.environ.get("HVD_TPU_HOSTNAME")
+        # rank/host identity defaults to this process's env; explicit
+        # values let a single-process harness (the virtual-time autoscale
+        # soak) stand up one injector per SIMULATED worker, with exactly
+        # the per-worker counter semantics of a real deployment.
+        self._rank = rank if rank is not None \
+            else os.environ.get("HVD_TPU_PROC_ID")
+        self._host = host if host is not None \
+            else os.environ.get("HVD_TPU_HOSTNAME")
         self.injections: List[dict] = []
 
     def _matches(self, i: int, spec: FaultSpec, hit: int) -> bool:
@@ -370,6 +384,18 @@ def maybe_diverge() -> Optional["FaultSpec"]:
     if inj is None:
         return None
     return inj.check("diverge")
+
+
+def maybe_straggler() -> Optional["FaultSpec"]:
+    """Autoscale step-time publication (one hit per State.commit via
+    ``autoscale.StepPublisher.note``): when the plan fires, ``delay_s``
+    sleeps the worker for real — an injected straggler the autoscale
+    engine must detect and evict — while ``scale`` only inflates the
+    reported step time (the simulation knob)."""
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.check("straggler")
 
 
 def maybe_checkpoint_corrupt() -> Optional["FaultSpec"]:

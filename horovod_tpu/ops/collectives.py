@@ -908,7 +908,7 @@ def _quantized_allgather_1d(shard, axis_name: str, key, use_pallas):
 
 def mesh_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
                        plan: Optional[WirePlan] = None, key=None,
-                       use_pallas=None):
+                       use_pallas=None, return_residual: bool = False):
     """Staged per-axis reduce-scatter of a flat buffer: RS along each
     plan axis in order (fast first), each hop in its axis's wire format.
     ``x`` is 1-D with length divisible by ``prod(sizes)`` (times 4096
@@ -916,20 +916,41 @@ def mesh_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
     exact 0). Returns this rank's reduced chunk. The descent assigns
     chunks fast-axis-MAJOR (phase order), so the inverse gather is
     ``mesh_allgather(shard, plan.reversed())`` — slow axis first.
+
+    ``return_residual=True`` additionally returns this rank's
+    full-length fp32 quantization error with the same Σ-over-ranks
+    contract as :func:`quantized_reducescatter` (and
+    :func:`mesh_allreduce`'s descent): each int8 phase's local rounding
+    error lands on the owning shard via traced-offset embedding, so
+    summed over all mesh ranks the residuals equal the pending
+    correction — the error-feedback state the ZeRO-1 ``int8_ef``
+    sharded optimizer carries across steps (optim.sharded_update with
+    ``route=``). bf16/none phases contribute no tracked error (the cast
+    error sits far below the int8 rounding floor; none is exact).
     """
     plan = WirePlan.resolve(plan) or WirePlan.parse("hvd")
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError("mesh_reducescatter supports SUM/AVERAGE")
     buf = x
     total = 1
+    residual = (jnp.zeros((x.shape[0],), jnp.float32)
+                if return_residual else None)
+    off = jnp.zeros((), jnp.int32)
     for i, p in enumerate(plan.phases):
         n = lax.axis_size(p.axis)
         total *= n
         if p.wire == "int8":
             kc = None if key is None else jax.random.fold_in(key, i)
-            buf = quantized_reducescatter(buf, ReduceOp.SUM, p.axis,
-                                          key=kc, use_pallas=use_pallas)
-            buf = buf.astype(x.dtype)
+            rs = quantized_reducescatter(buf.astype(jnp.float32),
+                                         ReduceOp.SUM, p.axis,
+                                         key=kc, use_pallas=use_pallas,
+                                         return_residual=return_residual)
+            if return_residual:
+                shard, err = rs
+                residual = _embed_residual(residual, err, off)
+            else:
+                shard = rs
+            buf = shard.astype(x.dtype)
         elif p.wire == "bf16":
             buf = lax.psum_scatter(buf.astype(jnp.bfloat16), p.axis,
                                    scatter_dimension=0,
@@ -937,9 +958,13 @@ def mesh_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
         else:
             buf = lax.psum_scatter(buf, p.axis, scatter_dimension=0,
                                    tiled=True)
+        off = off + (lax.axis_index(p.axis)
+                     * buf.shape[0]).astype(jnp.int32)
     if op == ReduceOp.AVERAGE:
         buf = buf / jnp.asarray(total, buf.dtype)
-    return buf
+    if not return_residual:
+        return buf
+    return buf, residual
 
 
 def mesh_allgather(x, plan: Optional[WirePlan] = None, key=None,

@@ -1100,16 +1100,18 @@ def broadcast_parameters(params, root_rank: int = 0,
 # Transforms that couple elements across the tree (global-norm clipping)
 # would compute shard-local statistics — compose those OUTSIDE.
 
-def _sharded_state_specs(inner, plan, axis_name: str):
+def _sharded_state_specs(inner, plan, axes):
     """PartitionSpecs for an inner transform's state over bucket shards:
-    vector leaves P(axis), scalar leaves (step counters) replicated. A
-    length-1 probe per bucket suffices — only leaf rank matters."""
+    vector leaves P(axes) — a single axis name, or the plan's axis
+    tuple under a route (fast-major) — scalar leaves (step counters)
+    replicated. A length-1 probe per bucket suffices — only leaf rank
+    matters."""
     from jax.sharding import PartitionSpec as P
 
     probe = [jax.ShapeDtypeStruct((1,), b.dtype) for b in plan.buckets]
     shapes = jax.eval_shape(inner.init, probe)
     return jax.tree.map(
-        lambda s: P(axis_name) if s.ndim else P(), shapes)
+        lambda s: P(axes) if s.ndim else P(), shapes)
 
 
 def _gather_sharded_state(inner, plan, state, axis_name: str):
@@ -1130,6 +1132,22 @@ def _gather_sharded_state(inner, plan, state, axis_name: str):
         return leaf
 
     return jax.tree.map(one, state, full_shapes)
+
+
+def _gather_sharded_state_routed(inner, plan, state, route):
+    """Mesh analog of :func:`_gather_sharded_state`: vector (bucket-
+    shard) leaves all-gather over the plan in REVERSE with wires forced
+    native — state carry must be lossless — and drop the grid padding;
+    scalar leaves pass through. Serves both the ZeRO-1 and FSDP routed
+    gathers (one derivation to maintain)."""
+    exact = route.reversed().with_wires("none")
+    full_probe = [jax.ShapeDtypeStruct((b.total_elems,), b.dtype)
+                  for b in plan.buckets]
+    full_shapes = jax.eval_shape(inner.init, full_probe)
+    return jax.tree.map(
+        lambda leaf, shp: (C.mesh_allgather(leaf, exact)[:shp.shape[0]]
+                           if shp.ndim else leaf),
+        state, full_shapes)
 
 
 def _reshard_state(state_full, axis_name: str):
@@ -1165,6 +1183,67 @@ def _shard_flat(flat, axis_name: str, align: int = 1):
     return jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
 
 
+# -- mesh-routed sharding (route= on the ZeRO-1/FSDP surfaces) ---------------
+#
+# With a WirePlan the shard grid spans ALL plan axes (N = prod of axis
+# sizes) and chunk ownership is fast-axis-MAJOR — exactly the layout
+# collectives.mesh_reducescatter's descent produces, so the gradient RS
+# can ride the staged per-axis wires (int8 on the slow hop) and the
+# update all-gather inverts it with plan.reversed() (docs/topology.md).
+
+def _route_total(route) -> int:
+    n = 1
+    for a in route.axis_names:
+        n *= jax.lax.axis_size(a)
+    return n
+
+
+def _route_align(compression, route) -> int:
+    """Per-rank chunk alignment: whole 32x128 int8 blocks whenever ANY
+    hop is quantized — by the error-feedback compression or by an int8
+    wire on the plan itself (a stateless staged_int8 route quantizes the
+    RS the same way)."""
+    from .ops.collectives import _Q_BLOCK
+
+    ef = getattr(compression, "error_feedback", False)
+    if ef or (route is not None and "int8" in route.wires):
+        return _Q_BLOCK
+    return 1
+
+
+def _mesh_shard_flat(flat, route, align: int = 1):
+    """(1-D bucket) -> this rank's padded 1/N mesh slice, N = prod of
+    the plan's axis sizes, fast-axis-major chunk ownership (the static
+    twin of mesh_reducescatter's descent: each phase keeps this rank's
+    chunk of the previous phase's chunk)."""
+    N = _route_total(route)
+    flat, _ = fusion_lib.pad_to_multiple(flat, N * align)
+    for a in route.axis_names:
+        n = jax.lax.axis_size(a)
+        idx = jax.lax.axis_index(a)
+        chunk = flat.shape[0] // n
+        flat = jax.lax.dynamic_slice_in_dim(flat, idx * chunk, chunk)
+    return flat
+
+
+def _sharded_route(route, axis_name: str):
+    """Resolve + trace-time fallback for the sharded surfaces.
+
+    EXPLICIT-ONLY: unlike the reduction surfaces, ``route=None`` does
+    NOT consult the ``HVD_TPU_ROUTE`` / ``init(route=)`` default here —
+    the route decides the sharded STATE LAYOUT (and the PartitionSpecs
+    built OUTSIDE any trace, where no fallback can be probed), and an
+    env knob must never change a state layout out from under a
+    flat-world program. An explicit route traced under the flat mesh
+    still falls back to the flat axis (safety net — same contract as
+    _reduce_tree)."""
+    route = C.WirePlan.resolve(route)
+    if route is not None and not _axes_bound(*route.axis_names) \
+            and _axes_bound(axis_name):
+        return None
+    return route
+
+
 class _EFShardState(NamedTuple):
     """ZeRO-1 (sharded_update) analog of :class:`_EFState`: the inner
     state over bucket shards, plus this rank's full-length fp32
@@ -1187,7 +1266,8 @@ def _qpad_len(total_elems: int, n: int) -> int:
 
 def sharded_init(tx, params, axis_name: str = "hvd",
                  fusion_threshold_bytes: Optional[int] = None,
-                 compression=None, nonfinite_policy: Optional[str] = None):
+                 compression=None, nonfinite_policy: Optional[str] = None,
+                 route=None):
     """Inner-optimizer state over FUSED-BUCKET SHARDS — call inside the
     same shard_map/jit region as :func:`sharded_update` (the shard
     shapes depend on the bound axis). State structure = the inner
@@ -1200,8 +1280,21 @@ def sharded_init(tx, params, axis_name: str = "hvd",
     built with compression can only be consumed by an update using the
     SAME compression (and vice versa). ``nonfinite_policy`` likewise
     wraps the state in :class:`_GuardedState` (docs/integrity.md) —
-    init and update must agree on it."""
-    _require_axis(axis_name, "sharded_init")
+    init and update must agree on it.
+
+    ``route`` (EXPLICIT-ONLY — the ``HVD_TPU_ROUTE`` env default
+    applies to the reduction surfaces, never to a sharded state
+    layout) shards over ALL the WirePlan's mesh axes (fast-axis-major,
+    1/prod(sizes) per rank — docs/topology.md): the gradient
+    reduce-scatter then descends the staged per-axis wires instead of
+    the flat axis. Init, update, gather and reshard must all agree on
+    the route — it decides the shard grid."""
+    route = _sharded_route(route, axis_name)
+    if route is not None:
+        for a in route.axis_names:
+            _require_axis(a, "sharded_init(route=)")
+    else:
+        _require_axis(axis_name, "sharded_init")
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
     ef = getattr(compression, "error_feedback", False)
@@ -1212,10 +1305,17 @@ def sharded_init(tx, params, axis_name: str = "hvd",
     flats = fusion_lib.fuse(params, plan)
     from .ops.collectives import _Q_BLOCK
 
-    align = _Q_BLOCK if ef else 1
-    inner = tx.init([_shard_flat(f, axis_name, align) for f in flats])
-    if ef:
+    if route is not None:
+        align = _route_align(compression, route)
+        n = _route_total(route)
+        inner = tx.init([_mesh_shard_flat(f, route, align)
+                         for f in flats])
+    else:
+        align = _Q_BLOCK if ef else 1
         n = jax.lax.axis_size(axis_name)
+        inner = tx.init([_shard_flat(f, axis_name, align)
+                         for f in flats])
+    if ef:
         residual = [jnp.zeros((_qpad_len(b.total_elems, n),), jnp.float32)
                     for b in plan.buckets]
         inner = _EFShardState(inner=inner, residual=residual,
@@ -1231,7 +1331,8 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
                    grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                    fusion_threshold_bytes: Optional[int] = None,
                    compression=None,
-                   nonfinite_policy: Optional[str] = None, **extra):
+                   nonfinite_policy: Optional[str] = None,
+                   route=None, **extra):
     """ZeRO-1 step over fused buckets: RS(bucket grads) -> inner update
     on this rank's shards -> AG(bucket updates). A few large collectives
     instead of one pair per leaf (same bucketing as the replicated
@@ -1244,10 +1345,25 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
     stochastic rounding, folding each step's quantization error into the
     carried residual. The update all-gather stays in the params' dtype:
     updates are small relative to gradients' dynamic range and have no
-    residual state to absorb a second rounding."""
+    residual state to absorb a second rounding.
+
+    ``route`` (state from a ``sharded_init`` with the SAME route) runs
+    the gradient reduce-scatter as the staged per-axis descent
+    (``collectives.mesh_reducescatter``) and the update all-gather as
+    the inverse ascent — each hop in its axis's wire format, so a
+    ``staged_int8`` plan puts int8 only where the slow bytes are
+    (docs/topology.md). With ``int8_ef`` the descent's quantization
+    error feeds the carried residual (the ``mesh_reducescatter``
+    Σ-over-ranks contract) and the update ascent stays in the params'
+    dtype, exactly like the flat path."""
     if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
         raise ValueError("sharded_update supports SUM/AVERAGE")
-    _require_axis(axis_name, "sharded_update")
+    route = _sharded_route(route, axis_name)
+    if route is not None:
+        for a in route.axis_names:
+            _require_axis(a, "sharded_update(route=)")
+    else:
+        _require_axis(axis_name, "sharded_update")
     compression = _resolve_compression(compression)
     ef = getattr(compression, "error_feedback", False)
     nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
@@ -1267,7 +1383,8 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
             f"differ): compression={compression.__name__}, state "
             f"{'has' if isinstance(inner_state, _EFShardState) else 'lacks'} "
             "an error-feedback residual")
-    n = jax.lax.axis_size(axis_name)
+    n = (_route_total(route) if route is not None
+         else jax.lax.axis_size(axis_name))
     threshold = _resolve_fusion_threshold(fusion_threshold_bytes)
     # Plan over PARAMS (grads share the treedef): the state was built
     # over the params plan, and a grad leaf cast to another dtype must
@@ -1282,6 +1399,26 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
             jax.tree.map(lambda gg, p: gg.astype(p.dtype), g, params),
             plan)
         if not ef:
+            if route is not None:
+                align = _route_align(compression, route)
+
+                def rs(f):
+                    padded, _ = fusion_lib.pad_to_multiple(f, n * align)
+                    return C.mesh_reducescatter(padded, grad_op, route)
+
+                g_shards = [rs(f) for f in g_flats]
+                p_shards = [_mesh_shard_flat(f, route, align)
+                            for f in p_flats]
+                u_shards, new_st = tx.update(g_shards, st, p_shards,
+                                             **extra)
+                # Ascent inverts the fast-major descent: slow axis
+                # first, each hop in its axis's wire format (stateless —
+                # same bounded-error contract as mesh_allreduce).
+                u_flats = [C.mesh_allgather(u, route.reversed())
+                           [:f.shape[0]]
+                           for u, f in zip(u_shards, g_flats)]
+                return fusion_lib.unfuse(u_flats, plan), new_st
+
             def rs(f):
                 padded, _ = fusion_lib.pad_to_multiple(f, n)
                 return C.reducescatter(padded, grad_op, axis_name)
@@ -1299,15 +1436,32 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
         for i, (f, res) in enumerate(zip(g_flats, st.residual)):
             pad = res.shape[0] - f.shape[0]
             corrected = jnp.pad(f.astype(jnp.float32), (0, pad)) + res
-            shard, r = C.quantized_reducescatter(
-                corrected, grad_op, axis_name,
-                key=_ef_key(st.step, i), return_residual=True)
+            if route is not None:
+                shard, r = C.mesh_reducescatter(
+                    corrected, grad_op, route,
+                    key=_ef_key(st.step, i), return_residual=True)
+            else:
+                shard, r = C.quantized_reducescatter(
+                    corrected, grad_op, axis_name,
+                    key=_ef_key(st.step, i), return_residual=True)
             g_shards.append(shard.astype(f.dtype))
             new_residual.append(r)
-        p_shards = [_shard_flat(f, axis_name, _Q_BLOCK) for f in p_flats]
+        if route is not None:
+            p_shards = [_mesh_shard_flat(f, route, _Q_BLOCK)
+                        for f in p_flats]
+            # Update ascent stays in the params' dtype (wires
+            # downgraded): updates have no residual state to absorb a
+            # second rounding — the flat int8_ef contract.
+            u_gather = route.reversed().with_wires("none")
+        else:
+            p_shards = [_shard_flat(f, axis_name, _Q_BLOCK)
+                        for f in p_flats]
+            u_gather = None
         u_shards, new_inner = tx.update(g_shards, st.inner, p_shards,
                                         **extra)
-        u_flats = [C.allgather(u, axis_name)[:f.shape[0]]
+        u_flats = [(C.mesh_allgather(u, u_gather)
+                    if u_gather is not None
+                    else C.allgather(u, axis_name))[:f.shape[0]]
                    for u, f in zip(u_shards, g_flats)]
         new_st = _EFShardState(inner=new_inner, residual=new_residual,
                                step=st.step + 1)
@@ -1317,9 +1471,11 @@ def sharded_update(tx, grads, state, params, axis_name: str = "hvd",
         return core(grads, inner_state)
     # Guarded (docs/integrity.md): the cond wraps RS + update + AG, so
     # a skipped step leaves shards, EF residual and step untouched.
+    # Under a route the one-scalar agreement runs over the PLAN's axes
+    # (every mesh rank must take the same branch).
     updates, new_inner, new_guard = integrity_lib.guarded_apply(
         nonfinite_policy, core, grads, inner_state, state.guard,
-        axis_name)
+        tuple(route.axis_names) if route is not None else axis_name)
     return updates, _GuardedState(new_inner, new_guard)
 
 
@@ -1336,7 +1492,8 @@ class ShardedOptimizer:
     def __init__(self, inner, axis_name: str = "hvd",
                  grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                  fusion_threshold_bytes: Optional[int] = None,
-                 compression=None, nonfinite_policy: Optional[str] = None):
+                 compression=None, nonfinite_policy: Optional[str] = None,
+                 route=None):
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
@@ -1346,7 +1503,9 @@ class ShardedOptimizer:
         # under the carried state. Same for the compression: it decides
         # the shard alignment and the state structure (_EFShardState).
         # And the non-finite policy: it decides whether the state is
-        # _GuardedState-wrapped (docs/integrity.md).
+        # _GuardedState-wrapped (docs/integrity.md). And the route: it
+        # decides the SHARD GRID (1/prod(mesh sizes), fast-axis-major)
+        # — docs/topology.md.
         self.fusion_threshold_bytes = _resolve_fusion_threshold(
             fusion_threshold_bytes)
         self.compression = _resolve_compression(compression)
@@ -1354,12 +1513,22 @@ class ShardedOptimizer:
         self._ef = getattr(self.compression, "error_feedback", False)
         self.nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
             nonfinite_policy)
+        # Explicit-only (no HVD_TPU_ROUTE default): the route decides
+        # the state layout AND the state_specs built outside any trace.
+        self.route = C.WirePlan.resolve(route)
+
+    def _live_route(self):
+        """The pinned route with the trace-time flat-mesh fallback
+        applied (a defaulted route under the flat mesh must not change
+        the shard grid — same contract as the reduction surfaces)."""
+        return _sharded_route(self.route, self.axis_name)
 
     def init(self, params):
         return sharded_init(self.inner, params, self.axis_name,
                             self.fusion_threshold_bytes,
                             compression=self.compression,
-                            nonfinite_policy=self.nonfinite_policy)
+                            nonfinite_policy=self.nonfinite_policy,
+                            route=self.route)
 
     def update(self, grads, state, params=None, **extra):
         if params is None:
@@ -1370,6 +1539,7 @@ class ShardedOptimizer:
                               self.fusion_threshold_bytes,
                               compression=self.compression,
                               nonfinite_policy=self.nonfinite_policy,
+                              route=self.route,
                               **extra)
 
     def state_specs(self, params):
@@ -1381,17 +1551,20 @@ class ShardedOptimizer:
         matches — callable before init(). With an error-feedback
         compression the residual leaves are per-rank LOCAL (each rank's
         own quantization error), carried as P(axis) shards of the
-        rank-stacked global view; the step counter replicates."""
+        rank-stacked global view; the step counter replicates. Under a
+        route the shard dim spans ALL plan axes fast-axis-major —
+        ``P((fast, ..., slow))``."""
         from jax.sharding import PartitionSpec as P
 
+        axes = (tuple(self.route.axis_names) if self.route is not None
+                else self.axis_name)
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
-        inner_specs = _sharded_state_specs(self.inner, plan,
-                                           self.axis_name)
+        inner_specs = _sharded_state_specs(self.inner, plan, axes)
         if self._ef:
             inner_specs = _EFShardState(
                 inner=inner_specs,
-                residual=[P(self.axis_name)] * len(plan.buckets),
+                residual=[P(axes)] * len(plan.buckets),
                 step=P())
         if self.nonfinite_policy is None:
             return inner_specs
@@ -1416,14 +1589,39 @@ class ShardedOptimizer:
         its PSUM: Σ_r residual_r is the total pending correction and is
         world-size-independent; :meth:`reshard_state` hands it to the
         new world's rank 0 (zeros elsewhere) — the next reduction sums
-        residuals across ranks anyway, so placement is arbitrary."""
-        _require_axis(self.axis_name, "ShardedOptimizer.gather_state")
+        residuals across ranks anyway, so placement is arbitrary.
+
+        Routed states gather/psum over ALL the plan's axes (wires
+        forced native — state carry must be exact); the gathered form
+        is identical to the flat one, so a checkpoint written under a
+        route restores into a flat world and vice versa (the residual's
+        psum is grid-padding-independent: pads carry zeros)."""
+        route = self._live_route()
+        if route is not None:
+            for a in route.axis_names:
+                _require_axis(a, "ShardedOptimizer.gather_state")
+        else:
+            _require_axis(self.axis_name, "ShardedOptimizer.gather_state")
         threshold = _resolve_fusion_threshold(self.fusion_threshold_bytes)
         plan = fusion_lib.plan_fusion(params, threshold)
         guard = state.guard if isinstance(state, _GuardedState) else None
         if guard is not None:
             state = state.inner
-        if not self._ef:
+        if route is not None:
+            axes = tuple(route.axis_names)
+            if not self._ef:
+                full = _gather_sharded_state_routed(self.inner, plan,
+                                                    state, route)
+            else:
+                inner_full = _gather_sharded_state_routed(
+                    self.inner, plan, state.inner, route)
+                residual_full = [
+                    jax.lax.psum(r, axes)[:b.total_elems]
+                    for r, b in zip(state.residual, plan.buckets)]
+                full = _EFShardState(inner=inner_full,
+                                     residual=residual_full,
+                                     step=state.step)
+        elif not self._ef:
             full = _gather_sharded_state(self.inner, plan, state,
                                          self.axis_name)
         else:
@@ -1442,30 +1640,53 @@ class ShardedOptimizer:
         return _GuardedState(inner=full, guard=guard)
 
     def reshard_state(self, state_full):
-        """Full (gathered) state -> this world's 1/n shards (inside the
-        NEW world's SPMD region, whatever its size)."""
-        _require_axis(self.axis_name, "ShardedOptimizer.reshard_state")
+        """Full (gathered) state -> this world's shards (inside the
+        NEW world's SPMD region, whatever its size — or its ROUTE: a
+        flat checkpoint reshards onto a mesh-routed world and back)."""
+        route = self._live_route()
+        if route is not None:
+            for a in route.axis_names:
+                _require_axis(a, "ShardedOptimizer.reshard_state")
+        else:
+            _require_axis(self.axis_name, "ShardedOptimizer.reshard_state")
         guard = state_full.guard \
             if isinstance(state_full, _GuardedState) else None
         if guard is not None:
             state_full = state_full.inner
-        if not self._ef:
-            sharded = _reshard_state(state_full, self.axis_name)
-            return sharded if guard is None else \
-                _GuardedState(inner=sharded, guard=guard)
         from .ops.collectives import _Q_BLOCK
 
-        n = jax.lax.axis_size(self.axis_name)
-        me = jax.lax.axis_index(self.axis_name)
-        inner = jax.tree.map(
-            lambda v: _shard_flat(v, self.axis_name, _Q_BLOCK)
-            if v.ndim else v,
-            state_full.inner)
+        if route is not None:
+            align = _route_align(self.compression, route)
+            n = _route_total(route)
+            # "Am I mesh rank 0" = every plan axis index is 0.
+            me0 = jnp.asarray(True)
+            for a in route.axis_names:
+                me0 = jnp.logical_and(me0, jax.lax.axis_index(a) == 0)
+
+            def shard_leaf(v):
+                return _mesh_shard_flat(v, route, align) if v.ndim else v
+        else:
+            align = _Q_BLOCK
+            n = jax.lax.axis_size(self.axis_name)
+            me0 = jax.lax.axis_index(self.axis_name) == 0
+
+            def shard_leaf(v):
+                return (_shard_flat(v, self.axis_name, align)
+                        if v.ndim else v)
+
+        if not self._ef:
+            if route is None:
+                sharded = _reshard_state(state_full, self.axis_name)
+            else:
+                sharded = jax.tree.map(shard_leaf, state_full)
+            return sharded if guard is None else \
+                _GuardedState(inner=sharded, guard=guard)
+        inner = jax.tree.map(shard_leaf, state_full.inner)
         residual = []
         for r in state_full.residual:
             pad = _qpad_len(r.shape[0], n) - r.shape[0]
             r = jnp.pad(r, (0, pad))
-            residual.append(jnp.where(me == 0, r, jnp.zeros_like(r)))
+            residual.append(jnp.where(me0, r, jnp.zeros_like(r)))
         sharded = _EFShardState(inner=inner, residual=residual,
                                 step=state_full.step)
         return sharded if guard is None else \
@@ -1504,7 +1725,8 @@ class FSDPOptimizer:
 
     def __init__(self, inner, axis_name: str = "hvd",
                  grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
-                 fusion_threshold_bytes: Optional[int] = None):
+                 fusion_threshold_bytes: Optional[int] = None,
+                 route=None):
         if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
             raise ValueError("FSDPOptimizer supports SUM/AVERAGE")
         self.inner = inner
@@ -1512,9 +1734,26 @@ class FSDPOptimizer:
         self.grad_op = grad_op
         self.fusion_threshold_bytes = _resolve_fusion_threshold(
             fusion_threshold_bytes)
+        # Route (docs/topology.md): params at rest shard over ALL plan
+        # axes (fast-axis-major); the per-step param all-gather ascends
+        # and the grad reduce-scatter descends the staged per-axis
+        # wires. Pinned like the threshold — it decides the shard grid.
+        # Explicit-only: the HVD_TPU_ROUTE default never reshapes a
+        # sharded state layout (shard_specs are built outside traces).
+        self.route = C.WirePlan.resolve(route)
         self._plan = None
         self._flat_lens = None
         self._sig = None
+
+    def _live_route(self):
+        return _sharded_route(self.route, self.axis_name)
+
+    def _require_route_axes(self, route, what: str) -> None:
+        if route is not None:
+            for a in route.axis_names:
+                _require_axis(a, what)
+        else:
+            _require_axis(self.axis_name, what)
 
     def bind(self, params_template):
         """Pin the bucket plan from a params pytree (real arrays or
@@ -1561,20 +1800,35 @@ class FSDPOptimizer:
                 f"come from a different plan/template")
 
     def shard_params(self, params):
-        """Full params -> list of this rank's 1/n bucket shards."""
-        _require_axis(self.axis_name, "FSDPOptimizer.shard_params")
+        """Full params -> list of this rank's 1/n bucket shards (1/N
+        over all plan axes under a route)."""
+        route = self._live_route()
+        self._require_route_axes(route, "FSDPOptimizer.shard_params")
         self.bind(params)
         flats = fusion_lib.fuse(params, self._plan)
+        if route is not None:
+            align = _route_align(NoneCompressor, route)
+            return [_mesh_shard_flat(f, route, align) for f in flats]
         return [_shard_flat(f, self.axis_name) for f in flats]
 
     def gather_params(self, shards):
         """Bucket shards -> full params pytree (one all-gather per
-        bucket; padding from the shard split sliced back off)."""
+        bucket; padding from the shard split sliced back off). Under a
+        route the gather ascends the plan in reverse, each hop in its
+        axis's wire format — a staged_int8 plan moves the slow-axis
+        param bytes as block-scaled int8 (stateless, bounded like
+        mesh_allreduce's ascent)."""
         self._require_bound("gather_params")
         self._check_shards(shards, "gather_params")
-        _require_axis(self.axis_name, "FSDPOptimizer.gather_params")
-        flats = [C.allgather(s, self.axis_name)[:length]
-                 for s, length in zip(shards, self._flat_lens)]
+        route = self._live_route()
+        self._require_route_axes(route, "FSDPOptimizer.gather_params")
+        if route is not None:
+            inv = route.reversed()
+            flats = [C.mesh_allgather(s, inv)[:length]
+                     for s, length in zip(shards, self._flat_lens)]
+        else:
+            flats = [C.allgather(s, self.axis_name)[:length]
+                     for s, length in zip(shards, self._flat_lens)]
         return fusion_lib.unfuse(flats, self._plan)
 
     def init(self, shards):
@@ -1582,16 +1836,28 @@ class FSDPOptimizer:
 
     def update(self, grads, state, shards, **extra):
         """RS(full grads) -> inner update on this rank's shards ->
-        apply. Returns (new_shards, new_state)."""
+        apply. Returns (new_shards, new_state). Under a route the RS
+        descends the staged per-axis wires (docs/topology.md)."""
         self._require_bound("update")
         self._check_shards(shards, "update")
-        _require_axis(self.axis_name, "FSDPOptimizer.update")
-        n = jax.lax.axis_size(self.axis_name)
+        route = self._live_route()
+        self._require_route_axes(route, "FSDPOptimizer.update")
         g_flats = fusion_lib.fuse(grads, self._plan)
 
-        def rs(f):
-            padded, _ = fusion_lib.pad_to_multiple(f, n)
-            return C.reducescatter(padded, self.grad_op, self.axis_name)
+        if route is not None:
+            n = _route_total(route)
+            align = _route_align(NoneCompressor, route)
+
+            def rs(f):
+                padded, _ = fusion_lib.pad_to_multiple(f, n * align)
+                return C.mesh_reducescatter(padded, self.grad_op, route)
+        else:
+            n = jax.lax.axis_size(self.axis_name)
+
+            def rs(f):
+                padded, _ = fusion_lib.pad_to_multiple(f, n)
+                return C.reducescatter(padded, self.grad_op,
+                                       self.axis_name)
 
         g_shards = [rs(f).astype(s.dtype)
                     for f, s in zip(g_flats, shards)]
@@ -1603,18 +1869,23 @@ class FSDPOptimizer:
 
     def shard_specs(self, params_template):
         """P(axis) per bucket shard — for carrying shards through
-        shard_map. Binds the plan from the template."""
+        shard_map (P((fast, ..., slow)) over all plan axes under a
+        route). Binds the plan from the template."""
         from jax.sharding import PartitionSpec as P
 
         self.bind(params_template)
-        return [P(self.axis_name)] * len(self._flat_lens)
+        axes = (tuple(self.route.axis_names) if self.route is not None
+                else self.axis_name)
+        return [P(axes)] * len(self._flat_lens)
 
     def state_specs(self, params_template):
         """Specs for the inner state over bucket shards (vector leaves
-        P(axis), scalars replicated)."""
+        P(axis) — or the plan's axis tuple under a route; scalars
+        replicated)."""
         self.bind(params_template)
-        return _sharded_state_specs(self.inner, self._plan,
-                                    self.axis_name)
+        axes = (tuple(self.route.axis_names) if self.route is not None
+                else self.axis_name)
+        return _sharded_state_specs(self.inner, self._plan, axes)
 
     def gather_state(self, state):
         """Sharded state -> world-size-independent full state (inside
@@ -1627,12 +1898,23 @@ class FSDPOptimizer:
         explicitly across the resize so the new world re-buckets
         identically."""
         self._require_bound("gather_state")
-        _require_axis(self.axis_name, "FSDPOptimizer.gather_state")
-        return _gather_sharded_state(self.inner, self._plan, state,
-                                     self.axis_name)
+        route = self._live_route()
+        self._require_route_axes(route, "FSDPOptimizer.gather_state")
+        if route is None:
+            return _gather_sharded_state(self.inner, self._plan, state,
+                                         self.axis_name)
+        return _gather_sharded_state_routed(self.inner, self._plan,
+                                            state, route)
 
     def reshard_state(self, state_full):
         """Full (gathered) state -> this world's 1/n shards (inside the
-        NEW world's SPMD region, whatever its size)."""
-        _require_axis(self.axis_name, "FSDPOptimizer.reshard_state")
-        return _reshard_state(state_full, self.axis_name)
+        NEW world's SPMD region, whatever its size or route)."""
+        route = self._live_route()
+        self._require_route_axes(route, "FSDPOptimizer.reshard_state")
+        if route is None:
+            return _reshard_state(state_full, self.axis_name)
+        align = _route_align(NoneCompressor, route)
+        return jax.tree.map(
+            lambda v: (_mesh_shard_flat(v, route, align)
+                       if v.ndim else v),
+            state_full)

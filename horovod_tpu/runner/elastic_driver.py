@@ -54,12 +54,32 @@ class ScriptHostDiscovery(HostDiscovery):
     """Discovery via a user script printing 'hostname:slots' lines
     (reference discovery.py HostDiscoveryScript; the integration tests
     mutate the script's output to simulate host churn — elastic_common.py).
+
+    Flap debounce: a CHANGED host set is only reported upward after the
+    script returns the same new set ``debounce`` consecutive polls
+    (``HVD_TPU_DISCOVERY_DEBOUNCE``, default 2) — one bad scrape (a
+    truncated instance list, a half-registered VM) must not trigger a
+    spurious reshard that throws away a healthy epoch. The first
+    successful scrape is adopted immediately (there is nothing to
+    debounce against), and ``debounce<=1`` restores the trusting
+    historical behavior.
     """
 
-    def __init__(self, script: str, timeout_s: float = 30.0):
+    def __init__(self, script: str, timeout_s: float = 30.0,
+                 debounce: Optional[int] = None):
         self._script = script
         self._timeout_s = timeout_s
         self._last: Dict[str, int] = {}
+        self._primed = False
+        if debounce is None:
+            try:
+                debounce = int(os.environ.get(
+                    "HVD_TPU_DISCOVERY_DEBOUNCE", "2"))
+            except ValueError:
+                debounce = 2
+        self._debounce = max(1, debounce)
+        self._pending: Optional[Dict[str, int]] = None
+        self._pending_count = 0
         # Failure backoff: a flapping/crashing discovery script gets
         # re-run on an exponential full-jitter schedule
         # (HVD_TPU_DISCOVERY_BACKOFF_{BASE_S,MAX_S}) instead of every
@@ -101,10 +121,42 @@ class ScriptHostDiscovery(HostDiscovery):
                 hosts[name] = int(slots)
             else:
                 hosts[line] = 1
-        self._last = dict(hosts)
         self._backoff.reset()
         self._retry_at = 0.0
-        return hosts
+        return self._debounced(hosts)
+
+    def _debounced(self, hosts: Dict[str, int]) -> Dict[str, int]:
+        """Adopt a changed host set only after ``debounce`` consecutive
+        identical scrapes; the last adopted answer serves meanwhile."""
+        if not self._primed:
+            # First successful scrape: nothing to debounce against.
+            self._primed = True
+            self._last = dict(hosts)
+            return dict(hosts)
+        if hosts == self._last:
+            self._pending = None
+            self._pending_count = 0
+            return dict(hosts)
+        if self._pending is not None and hosts == self._pending:
+            self._pending_count += 1
+        else:
+            self._pending = dict(hosts)
+            self._pending_count = 1
+        if self._pending_count >= self._debounce:
+            logger.info(
+                "elastic: discovery change confirmed after %d "
+                "consecutive scrapes: %s -> %s", self._pending_count,
+                sorted(self._last), sorted(hosts))
+            self._last = dict(hosts)
+            self._pending = None
+            self._pending_count = 0
+            return dict(hosts)
+        logger.info(
+            "elastic: discovery reported a changed host set (%s -> %s); "
+            "debouncing (%d/%d consecutive scrapes)",
+            sorted(self._last), sorted(hosts), self._pending_count,
+            self._debounce)
+        return dict(self._last)
 
 
 @dataclasses.dataclass
@@ -180,23 +232,30 @@ class HostManager:
                 return bool(usable)
             return usable != prev
 
-    def blacklist(self, hostname: str) -> None:
+    def blacklist(self, hostname: str, ttl_s: Optional[float] = None,
+                  permanent: bool = False) -> None:
+        """Exile a host. ``ttl_s`` overrides the configured TTL for this
+        entry (the autoscale engine passes its policy's
+        ``evict_ttl_s``); strike doubling applies to either TTL.
+        ``permanent=True`` exiles forever (the engine's escalation
+        decisions — repeated stragglers, struck-out hosts)."""
         with self._lock:
             e = self._blacklist.get(hostname)
             strikes = (e.strikes if e else 0) + 1
-            if self._ttl <= 0:
+            ttl = self._ttl if ttl_s is None else ttl_s
+            if permanent or ttl <= 0:
                 until = float("inf")
             else:
-                until = self._clock() + self._ttl * (2 ** (strikes - 1))
+                until = self._clock() + ttl * (2 ** (strikes - 1))
             self._blacklist[hostname] = _BlacklistEntry(until, strikes)
         faults_lib.stats.bump("blacklist_events")
-        if self._ttl <= 0:
+        if permanent or ttl <= 0:
             logger.warning("elastic: blacklisted host %s (permanent)",
                            hostname)
         else:
             logger.warning(
                 "elastic: blacklisted host %s for %.0fs (strike %d)",
-                hostname, self._ttl * (2 ** (strikes - 1)), strikes)
+                hostname, ttl * (2 ** (strikes - 1)), strikes)
 
     def current_hosts(self) -> Dict[str, int]:
         with self._lock:
@@ -215,6 +274,22 @@ class HostManager:
                         "remaining_s": max(0.0, e.until - now)}
                     for h, e in self._blacklist.items()}
 
+    def permanently_exhausted(self) -> bool:
+        """True when the job can NEVER regain capacity on its own:
+        discovery knows at least one host and every known host sits on
+        a permanent (infinite) blacklist entry. A transiently empty
+        scrape (a flap) or a finite TTL both return False — those heal
+        with time, and aborting on them would turn one bad scrape into
+        a dead job."""
+        with self._lock:
+            if not self._hosts:
+                return False
+            for h in self._hosts:
+                e = self._blacklist.get(h)
+                if e is None or e.until != float("inf"):
+                    return False
+            return True
+
 
 class ElasticDriver:
     """Discovery loop + stable rank assignment (reference driver.py:68-309).
@@ -227,6 +302,9 @@ class ElasticDriver:
         self.max_np = max_np
         self.discovery_interval = discovery_interval
         self._assignments: Dict[str, List[hosts_lib.SlotInfo]] = {}
+        # Autoscale engine handle (run_elastic installs one when the
+        # control loop is enabled — docs/autoscale.md).
+        self.autoscale = None
         self._shutdown = threading.Event()
         self._host_change = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -277,8 +355,11 @@ class ElasticDriver:
 
     # -- rank assignment (reference driver.py _update_host_assignments) ---
 
-    def update_assignments(self) -> List[hosts_lib.SlotInfo]:
-        """Re-assign ranks, keeping existing hosts' ranks stable."""
+    def update_assignments(self, np_cap: Optional[int] = None
+                           ) -> List[hosts_lib.SlotInfo]:
+        """Re-assign ranks, keeping existing hosts' ranks stable.
+        ``np_cap`` (autoscale hold: the policy refused new capacity —
+        docs/autoscale.md) additionally caps the world below max_np."""
         hosts = self.host_manager.current_hosts()
         with self._lock:
             prev_order = [h for h in self._assignments if h in hosts]
@@ -286,12 +367,23 @@ class ElasticDriver:
             ordered = prev_order + sorted(new_hosts)
             np_total = min(self.max_np,
                            sum(hosts[h] for h in ordered))
+            if np_cap is not None:
+                np_total = max(self.min_np, min(np_total, np_cap))
             infos = hosts_lib.get_host_assignments(
                 [hosts_lib.HostInfo(h, hosts[h]) for h in ordered], np_total)
             self._assignments = {}
             for s in infos:
                 self._assignments.setdefault(s.hostname, []).append(s)
             return infos
+
+    def assigned_hosts(self) -> Dict[str, int]:
+        """Hosts of the CURRENT epoch's assignments with their slot
+        counts — the world that is actually running (the autoscale
+        engine evaluates against this, not the usable set: a
+        usable-but-unassigned host has no worker whose silence could
+        mean a stall)."""
+        with self._lock:
+            return {h: len(s) for h, s in self._assignments.items()}
 
     def record_failure(self, hostname: str) -> None:
         # Blacklist only — no _host_change signal: the caller restarts
@@ -341,7 +433,8 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
                ssh_port=None, poll_interval: float = 0.1,
                on_hosts_updated=None,
                grace_secs: Optional[float] = None,
-               spawner=None):
+               spawner=None,
+               on_tick=None, tick_interval_s: Optional[float] = None):
     """Run one elastic epoch with per-worker exit tracking.
 
     Returns ``(rc, failed_hosts, interrupted)``: ``failed_hosts`` are
@@ -361,6 +454,12 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     ``terminate`` / ``send_signal`` / ``wait``). The spawner owns slot
     env construction (coordinator negotiation may be deferred to the
     workers themselves).
+
+    ``on_tick`` (docs/autoscale.md) is the autoscale evaluation hook:
+    called every ``tick_interval_s`` seconds of the watch loop; when it
+    returns True the engine decided to reshape the world — the epoch is
+    interrupted through the SAME graceful path as a discovery change
+    (publish topology version, grace window, then terminate).
     """
     import shlex
     import signal
@@ -449,6 +548,9 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
             except (ProcessLookupError, OSError):
                 pass
 
+    next_tick = (time.monotonic() + tick_interval_s
+                 if on_tick is not None and tick_interval_s else None)
+
     try:
         while True:
             running = False
@@ -474,6 +576,24 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
             if failed and not terminated:
                 terminate_all()
                 terminated = True
+            if next_tick is not None and not terminated \
+                    and not interrupted and time.monotonic() >= next_tick:
+                # Autoscale tick: evict/shrink decisions blacklist their
+                # hosts and reshape via the same HOSTS_UPDATED channel a
+                # discovery change uses (the grace/terminate machinery
+                # below is shared).
+                next_tick = time.monotonic() + tick_interval_s
+                try:
+                    reshape = bool(on_tick())
+                except Exception:  # noqa: BLE001 — the control loop must
+                    logger.exception(   # never kill a healthy epoch
+                        "autoscale: tick evaluation failed")
+                    reshape = False
+                if reshape:
+                    interrupted = True
+                    if on_hosts_updated is not None:
+                        on_hosts_updated()
+                    grace_deadline = time.monotonic() + grace
             if not terminated and not interrupted and \
                     driver.hosts_updated():
                 # Topology changed mid-epoch: publish the new version
@@ -547,6 +667,27 @@ def run_elastic(args, command: List[str],
     faults_lib.refresh_from_env()
     driver = ElasticDriver(discovery, min_np, max_np)
     driver.start_discovery()
+    # Autoscale control plane (docs/autoscale.md): the policy engine
+    # lives HERE, in the driver process, so its memory — straggler
+    # strikes, eviction counts, cooldowns — spans elastic epochs. A bad
+    # policy fails the launch (silently scaling on defaults the user
+    # did not write would be worse than not starting).
+    from ..common import autoscale as autoscale_lib
+
+    engine = None
+    autoscale_policy = None
+    # Launcher knobs (hvdtpurun --autoscale-policy) arrive via
+    # env_extra; a policy set in the caller's environment works too —
+    # merge with env_extra winning (it carries the validated form).
+    autoscale_env = {**os.environ, **{
+        k: v for k, v in env_extra.items()
+        if k.startswith("HVD_TPU_AUTOSCALE")}}
+    if autoscale_lib.autoscale_enabled(autoscale_env):
+        policy = autoscale_lib.AutoscalePolicy.from_env(autoscale_env)
+        if policy.enabled:
+            autoscale_policy = policy
+            logger.warning("autoscale: enabled (policy: %s)",
+                           policy.to_json())
     # Per-job HMAC secret (reference runner/common/util/secret.py): the
     # KV coordinates worker lifecycle, so an unauthenticated writer on
     # the network could fake topology changes.
@@ -583,6 +724,40 @@ def run_elastic(args, command: List[str],
         if chaos_var in os.environ:
             env_extra.setdefault(chaos_var, os.environ[chaos_var])
 
+    on_tick = None
+    if autoscale_policy is not None:
+        # The engine reads worker reports straight off the in-process
+        # KV; workers get the RESOLVED policy (env overrides folded in)
+        # so publisher cadence and engine windows always agree.
+        engine = autoscale_lib.AutoscaleEngine(
+            autoscale_policy, min_np, max_np,
+            autoscale_lib.kv_report_fetcher(rdv),
+            log_path=autoscale_env.get(autoscale_lib.ENV_LOG, ""))
+        driver.autoscale = engine
+        env_extra[autoscale_lib.ENV_ENABLE] = "1"
+        env_extra[autoscale_lib.ENV_POLICY] = autoscale_policy.to_json()
+
+        def autoscale_tick() -> bool:
+            # Evaluate against the RUNNING world (assigned ∩ usable),
+            # same as the determinism sim: a usable-but-unassigned
+            # host (e.g. held back by a grow gate, or freshly
+            # TTL-recovered) has no worker — its stale KV report must
+            # not read as a stall.
+            usable = driver.host_manager.current_hosts()
+            assigned = {h: n for h, n in driver.assigned_hosts().items()
+                        if h in usable}
+            decisions = engine.tick(
+                assigned, driver.host_manager.blacklist_snapshot())
+            acted = False
+            for d in decisions:
+                if d.action in ("evict", "shrink") and d.target:
+                    driver.host_manager.blacklist(
+                        d.target, ttl_s=d.ttl_s, permanent=d.permanent)
+                    acted = True
+            return acted
+
+        on_tick = autoscale_tick
+
     def bump_version():
         nonlocal topo_version
         topo_version += 1
@@ -590,6 +765,7 @@ def run_elastic(args, command: List[str],
 
     try:
         attempts = 0
+        prev_np: Optional[int] = None
         epoch_down_since: Optional[float] = None
         while True:
             try:
@@ -624,7 +800,17 @@ def run_elastic(args, command: List[str],
             # poll may not have run since), or a fast failure loop keeps
             # relaunching yesterday's topology.
             driver.host_manager.update_available_hosts()
-            slots = driver.update_assignments()
+            np_cap = None
+            if engine is not None:
+                # Grow gate (docs/autoscale.md): the engine decides
+                # whether capacity beyond the previous world is ADOPTED
+                # (a `grow` decision) or HELD (np capped at prev size).
+                np_cap = engine.pre_epoch(
+                    prev_np, driver.host_manager.current_hosts())
+            slots = driver.update_assignments(np_cap=np_cap)
+            if engine is not None:
+                engine.observe_assignment({s.hostname for s in slots})
+            prev_np = len(slots)
             logger.info(
                 "elastic launch attempt %d with np=%d over hosts %s",
                 attempts, len(slots),
@@ -633,7 +819,10 @@ def run_elastic(args, command: List[str],
                 driver, slots, command, env_extra,
                 ssh_port=getattr(args, "ssh_port", None),
                 on_hosts_updated=bump_version, grace_secs=grace_secs,
-                spawner=spawner)
+                spawner=spawner, on_tick=on_tick,
+                tick_interval_s=(autoscale_policy.tick_interval_s
+                                 if autoscale_policy is not None
+                                 else None))
             if rc == 0 and not failed_hosts and not interrupted:
                 return 0
             epoch_down_since = time.monotonic()
@@ -649,12 +838,23 @@ def run_elastic(args, command: List[str],
                 logger.error("elastic: reset limit exceeded")
                 return rc or 1
             if not driver.host_manager.current_hosts():
-                logger.error(
-                    "elastic: every host is blacklisted or gone — job "
-                    "failed (reference registration.py:156). Last "
-                    "committed state is preserved; blacklist TTLs: %s",
+                # Empty usable set: only a FAST-FAIL when it can never
+                # heal (every known host permanently exiled). A flapped
+                # scrape or a finite blacklist TTL recovers with time —
+                # the loop-top wait_for_available_slots owns the real
+                # give-up timeout for those.
+                if driver.host_manager.permanently_exhausted():
+                    logger.error(
+                        "elastic: every host is permanently blacklisted "
+                        "— job failed (reference registration.py:156). "
+                        "Last committed state is preserved; blacklist: "
+                        "%s",
+                        driver.host_manager.blacklist_snapshot() or "{}")
+                    return rc or 1
+                logger.warning(
+                    "elastic: no usable hosts right now (flap or "
+                    "blacklist TTL pending — %s); waiting for capacity",
                     driver.host_manager.blacklist_snapshot() or "{}")
-                return rc or 1
     finally:
         if owns_rdv:
             rdv.stop()

@@ -350,6 +350,16 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="chaos: JSON fault plan (or @/path/to/plan.json) "
                         "exported to workers as HVD_TPU_FAULT_PLAN — see "
                         "horovod_tpu/common/faults.py for sites/format")
+    p.add_argument("--autoscale-policy", default=None,
+                   help="telemetry-driven autoscaling policy for the "
+                        "elastic driver: a JSON file path or inline JSON "
+                        "object (docs/autoscale.md). Validated eagerly — "
+                        "a bad field fails the launch naming it. Implies "
+                        "--elastic; exported as HVD_TPU_AUTOSCALE_POLICY "
+                        "(+ HVD_TPU_AUTOSCALE=1)")
+    p.add_argument("--autoscale-log", default=None,
+                   help="driver-side autoscale decision log path "
+                        "(JSON lines; HVD_TPU_AUTOSCALE_LOG)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
@@ -462,6 +472,18 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
 
         FaultPlan.from_json(plan)
         env["HVD_TPU_FAULT_PLAN"] = plan
+    if getattr(args, "autoscale_policy", None):
+        # Parse eagerly: a typo'd threshold must fail THIS launch with
+        # the field named, not silently run the job on defaults. The
+        # canonical (validated) JSON is what gets exported, so file
+        # paths work on the driver even when workers can't read them.
+        from ..common.autoscale import AutoscalePolicy
+
+        policy = AutoscalePolicy.load(args.autoscale_policy)
+        env["HVD_TPU_AUTOSCALE"] = "1"
+        env["HVD_TPU_AUTOSCALE_POLICY"] = policy.to_json()
+    if getattr(args, "autoscale_log", None):
+        env["HVD_TPU_AUTOSCALE_LOG"] = args.autoscale_log
     return env
 
 
@@ -491,6 +513,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         return 2
 
     env_extra = knob_env(args)
+
+    if getattr(args, "autoscale_policy", None) and not args.elastic:
+        # Autoscaling is a property of the elastic driver; the flag
+        # implies the mode (scaling a static world is a contradiction).
+        args.elastic = True
 
     if args.elastic:
         from .elastic_driver import run_elastic

@@ -171,6 +171,17 @@ class RendezvousServer:
         with srv.kv_lock:  # type: ignore[attr-defined]
             return srv.kv_store.get(f"/kv/{scope}/{key}")  # type: ignore
 
+    def scope_items(self, scope: str) -> Dict[str, bytes]:
+        """All (key, value) pairs under a scope — the autoscale engine
+        reads every worker's ``autoscale/steptime.<rank>`` report in one
+        snapshot without N HTTP round-trips (driver-side only)."""
+        srv = self._http.server
+        prefix = f"/kv/{scope}/"
+        with srv.kv_lock:  # type: ignore[attr-defined]
+            return {k[len(prefix):]: v
+                    for k, v in srv.kv_store.items()  # type: ignore
+                    if k.startswith(prefix)}
+
 
 class RendezvousClient:
     """Worker-side client (reference: http/http_client.py). Signs every

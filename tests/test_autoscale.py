@@ -1,0 +1,675 @@
+"""Telemetry-driven autoscaling (docs/autoscale.md): policy-as-data
+parsing/validation, the decision engine (straggler/stall/divergence/
+strike triggers, hysteresis, min_np floor, grow gating), the worker
+step-time publisher over the rendezvous KV, HostManager blacklist TTL +
+strike-doubling interplay with eviction decisions, ScriptHostDiscovery
+flap debounce, the hvdtpurun --autoscale-policy surface, and the seeded
+chaos soak's decision-log determinism contract."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.common import autoscale as autoscale_lib
+from horovod_tpu.common.autoscale import (AutoscaleEngine, AutoscalePolicy,
+                                          StepReport)
+from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                               FixedHostDiscovery,
+                                               HostManager,
+                                               ScriptHostDiscovery)
+
+import tools.chaos_soak as chaos_soak  # noqa: E402
+
+
+# -- policy: thresholds as data ---------------------------------------------
+
+def test_policy_defaults_roundtrip():
+    p = AutoscalePolicy()
+    q = AutoscalePolicy.from_json(p.to_json())
+    assert p == q
+
+
+def test_policy_unknown_field_named():
+    with pytest.raises(ValueError, match="stragler_ratio"):
+        AutoscalePolicy.from_json('{"stragler_ratio": 2.0}')
+
+
+def test_policy_bad_type_named():
+    with pytest.raises(ValueError, match="'window'"):
+        AutoscalePolicy.from_json('{"window": "huge"}')
+
+
+def test_policy_range_validation_names_field():
+    with pytest.raises(ValueError, match="straggler_ratio"):
+        AutoscalePolicy.from_dict({"straggler_ratio": 0.5})
+    with pytest.raises(ValueError, match="tick_interval_s"):
+        AutoscalePolicy.from_dict({"tick_interval_s": -1})
+    with pytest.raises(ValueError, match="straggler_patience"):
+        AutoscalePolicy.from_dict({"straggler_patience": 0})
+
+
+def test_policy_not_an_object():
+    with pytest.raises(ValueError, match="JSON object"):
+        AutoscalePolicy.from_json("[1, 2]")
+    with pytest.raises(ValueError, match="invalid JSON"):
+        AutoscalePolicy.from_json("{nope")
+
+
+def test_policy_load_file_and_inline(tmp_path):
+    f = tmp_path / "pol.json"
+    f.write_text('{"straggler_ratio": 4.0}')
+    assert AutoscalePolicy.load(str(f)).straggler_ratio == 4.0
+    assert AutoscalePolicy.load("@" + str(f)).straggler_ratio == 4.0
+    assert AutoscalePolicy.load(
+        '{"straggler_ratio": 5.0}').straggler_ratio == 5.0
+
+
+def test_policy_env_field_overrides(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_AUTOSCALE_POLICY",
+                       '{"straggler_ratio": 4.0, "window": 16}')
+    monkeypatch.setenv("HVD_TPU_AUTOSCALE_STRAGGLER_RATIO", "6.0")
+    p = AutoscalePolicy.from_env()
+    assert p.straggler_ratio == 6.0     # field knob wins over the file
+    assert p.window == 16               # file value survives
+    monkeypatch.setenv("HVD_TPU_AUTOSCALE_WINDOW", "oops")
+    with pytest.raises(ValueError, match="'window'"):
+        AutoscalePolicy.from_env()
+
+
+def test_autoscale_enabled_resolution(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_AUTOSCALE", raising=False)
+    monkeypatch.delenv("HVD_TPU_AUTOSCALE_POLICY", raising=False)
+    assert not autoscale_lib.autoscale_enabled()
+    monkeypatch.setenv("HVD_TPU_AUTOSCALE_POLICY", "{}")
+    assert autoscale_lib.autoscale_enabled()   # a policy implies intent
+    monkeypatch.setenv("HVD_TPU_AUTOSCALE", "0")
+    assert not autoscale_lib.autoscale_enabled()  # explicit 0 wins
+
+
+# -- the decision engine ----------------------------------------------------
+
+def _policy(**over):
+    base = dict(straggler_ratio=2.0, straggler_patience=2, min_ranks=3,
+                evict_ttl_s=10.0, evict_cooldown_s=0.0,
+                grow_cooldown_s=0.0, tick_interval_s=1.0)
+    base.update(over)
+    return AutoscalePolicy.from_dict(base)
+
+
+class _Harness:
+    """Engine + fake clock + mutable report table."""
+
+    def __init__(self, policy, min_np=1, max_np=3):
+        self.now = 0.0
+        self.reports = {}
+        self.engine = AutoscaleEngine(
+            policy, min_np, max_np, lambda: dict(self.reports),
+            clock=lambda: self.now, log_path="")
+
+    def report(self, rank, host, step, p50, **kw):
+        self.reports[rank] = StepReport(rank=rank, host=host, step=step,
+                                        n=8, p50=p50, mean=p50, last=p50,
+                                        **kw)
+
+    def tick(self, hosts, blacklist=None, dt=1.0):
+        self.now += dt
+        return self.engine.tick(hosts, blacklist or {})
+
+
+HOSTS3 = {"a": 1, "b": 1, "c": 1}
+
+
+def _feed(h, tick_no, slow_host="c", slow=0.5, fast=0.05):
+    for r, host in enumerate("abc"):
+        h.report(r, host, step=tick_no * 5,
+                 p50=slow if host == slow_host else fast)
+
+
+def test_engine_straggler_patience_then_evict():
+    h = _Harness(_policy())
+    decisions = []
+    for i in range(5):
+        _feed(h, i)
+        decisions.append(h.tick(HOSTS3))
+    # tick 0 = baseline (no advancement yet); ticks 1-2 accumulate the
+    # two patience strikes; eviction on tick 2.
+    assert [len(d) for d in decisions] == [0, 0, 1, 0, 0]
+    d = decisions[2][0]
+    assert (d.action, d.target, d.reason) == ("evict", "c", "straggler")
+    assert d.ttl_s == 10.0 and not d.permanent
+
+
+def test_engine_purge_requires_fresh_flags_after_evict():
+    h = _Harness(_policy())
+    for i in range(3):
+        _feed(h, i)
+        h.tick(HOSTS3)
+    # c evicted on tick 2; keep feeding the SAME stale c report: it
+    # must not re-convict (step never changes again).
+    for i in range(3, 8):
+        h.report(0, "a", step=i * 5, p50=0.05)
+        h.report(1, "b", step=i * 5, p50=0.05)
+        ds = h.tick(HOSTS3)
+        assert ds == []
+
+
+def test_engine_min_np_floor_blocks_eviction():
+    h = _Harness(_policy(), min_np=3, max_np=3)
+    for i in range(6):
+        _feed(h, i)
+        assert h.tick(HOSTS3) == []  # eviction would drop below min_np
+
+
+def test_engine_min_ranks_quorum():
+    h = _Harness(_policy(min_ranks=3))
+    hosts2 = {"a": 1, "c": 1}
+    for i in range(5):
+        h.report(0, "a", step=i * 5, p50=0.05)
+        h.report(2, "c", step=i * 5, p50=0.5)
+        assert h.tick(hosts2) == []  # 2 ranks can't name a straggler
+
+
+def test_engine_evict_cooldown_spaces_evictions():
+    h = _Harness(_policy(evict_cooldown_s=100.0))
+    for i in range(3):
+        _feed(h, i)
+        ds = h.tick(HOSTS3)
+    assert ds and ds[0].target == "c"
+    # b turns slow immediately after: the cooldown holds the next
+    # eviction even with patience satisfied.
+    for i in range(3, 7):
+        h.report(0, "a", step=i * 5, p50=0.05)
+        h.report(1, "b", step=i * 5, p50=0.5)
+        ds = h.tick({"a": 1, "b": 1})
+        assert ds == []
+
+
+def test_engine_permanent_escalation():
+    h = _Harness(_policy(evict_permanent_after=2))
+    for i in range(3):
+        _feed(h, i)
+        ds = h.tick(HOSTS3)
+    assert ds and not ds[0].permanent
+    # c returns (TTL expired) and re-offends with FRESH advancing
+    # reports: the second eviction is permanent.
+    for i in range(3, 8):
+        _feed(h, i)
+        ds = h.tick(HOSTS3)
+        if ds:
+            break
+    assert ds and ds[0].action == "evict" and ds[0].permanent
+
+
+def test_engine_grow_for_returned_evicted_host():
+    h = _Harness(_policy())
+    h.engine.observe_assignment({"a", "b", "c"})
+    for i in range(3):
+        _feed(h, i)
+        ds = h.tick(HOSTS3)
+    assert ds and ds[0].action == "evict"
+    # Exiled world of 2; c's TTL expires and discovery re-offers it.
+    assert h.engine.pre_epoch(3, {"a": 1, "b": 1}) is None  # shrink: no-op
+    cap = h.engine.pre_epoch(2, HOSTS3)
+    assert cap is None
+    log = h.engine.decision_log()
+    assert json.loads(log[-1])["action"] == "grow"
+    # The SAME return must not produce a second grow.
+    assert h.engine.pre_epoch(2, HOSTS3) is None
+    assert json.loads(h.engine.decision_log()[-1])["action"] == "grow"
+    assert len([l for l in h.engine.decision_log()
+                if json.loads(l)["action"] == "grow"]) == 1
+
+
+def test_engine_grow_for_brand_new_host_and_recovery_silence():
+    h = _Harness(_policy(), max_np=4)
+    h.engine.observe_assignment({"a", "b"})
+    # a flapped away and returned: recovery churn, NOT a decision.
+    assert h.engine.pre_epoch(1, {"a": 1, "b": 1}) is None
+    assert h.engine.decision_log() == []
+    # discovery offers a never-before-seen host d: engine adopts it.
+    assert h.engine.pre_epoch(2, {"a": 1, "b": 1, "d": 1}) is None
+    assert [json.loads(l)["action"]
+            for l in h.engine.decision_log()] == ["grow"]
+
+
+def test_engine_grow_hold_caps_np_on_comm_gate():
+    h = _Harness(_policy(grow_min_comm_fraction=0.5))
+    h.engine.observe_assignment({"a", "b"})
+    # Compute-bound reports (comm 10%): the policy REFUSES the new
+    # host — np capped at the previous world size.
+    h.report(0, "a", 5, 0.05, comm_fraction=0.1)
+    h.report(1, "b", 5, 0.05, comm_fraction=0.1)
+    h.tick({"a": 1, "b": 1})
+    assert h.engine.pre_epoch(2, {"a": 1, "b": 1, "d": 1}) == 2
+    assert h.engine.decision_log() == []
+    # Comm-bound reports flip the gate: grow.
+    for i in (2, 3):
+        h.report(0, "a", 5 * i, 0.05, comm_fraction=0.8)
+        h.report(1, "b", 5 * i, 0.05, comm_fraction=0.8)
+        h.tick({"a": 1, "b": 1})
+    assert h.engine.pre_epoch(2, {"a": 1, "b": 1, "d": 1}) is None
+    assert [json.loads(l)["action"]
+            for l in h.engine.decision_log()] == ["grow"]
+
+
+def test_engine_grow_respects_max_np():
+    h = _Harness(_policy(), max_np=2)
+    h.engine.observe_assignment({"a", "b"})
+    assert h.engine.pre_epoch(2, HOSTS3) == 2  # capped at max_np
+    assert h.engine.decision_log() == []
+
+
+def test_engine_stall_shrinks_silent_host():
+    h = _Harness(_policy(stall_timeout_s=3.0, min_ranks=3))
+    for i in range(6):
+        h.report(0, "a", step=i * 5, p50=0.05)
+        h.report(1, "b", step=i * 5, p50=0.05)
+        h.report(2, "c", step=5, p50=0.05)   # c froze after one report
+        ds = h.tick(HOSTS3)
+        if ds:
+            break
+    assert ds and ds[0].action == "shrink" and ds[0].target == "c"
+    assert ds[0].reason == "stall"
+
+
+def test_engine_divergence_resyncs_shrink():
+    h = _Harness(_policy(max_divergence_resyncs=2))
+    h.report(0, "a", 5, 0.05, resyncs=0)
+    h.report(1, "b", 5, 0.05, resyncs=0)
+    h.report(2, "c", 5, 0.05, resyncs=1)
+    assert h.tick(HOSTS3) == []   # baseline anchors, delta 0
+    h.report(2, "c", 10, 0.05, resyncs=3)  # +2 since baseline
+    ds = h.tick(HOSTS3)
+    assert ds and ds[0].action == "shrink" and \
+        ds[0].reason == "divergence_resyncs" and ds[0].target == "c"
+
+
+def test_engine_divergence_global_counter_is_unattributable():
+    """The in-trace resync counter bumps on EVERY rank per resync
+    (integrity.record_divergence), so equal deltas across hosts carry
+    no attribution — the engine must NOT shrink anyone (let alone rank
+    0's healthy host) on a globally-synchronized counter."""
+    h = _Harness(_policy(max_divergence_resyncs=2))
+    for r, host in enumerate("abc"):
+        h.report(r, host, 5, 0.05, resyncs=0)
+    assert h.tick(HOSTS3) == []
+    for r, host in enumerate("abc"):
+        h.report(r, host, 10, 0.05, resyncs=3)
+    assert h.tick(HOSTS3) == []
+    assert h.engine.decision_log() == []
+
+
+def test_engine_stall_one_shrink_per_tick_with_cooldown():
+    """A shared hiccup silencing several hosts at once must reshape
+    one host per tick/cooldown, not collapse the world in one pass."""
+    hosts4 = {"a": 1, "b": 1, "c": 1, "d": 1}
+    h = _Harness(_policy(stall_timeout_s=3.0, min_ranks=3,
+                         evict_cooldown_s=0.0), max_np=4)
+    shrunk = []
+    for i in range(10):
+        h.report(0, "a", step=i * 5, p50=0.05)  # only a advances
+        for r, host in ((1, "b"), (2, "c"), (3, "d")):
+            if host not in shrunk:
+                h.report(r, host, step=5, p50=0.05)  # frozen
+        live = {k: v for k, v in hosts4.items() if k not in shrunk}
+        ds = h.tick(live)
+        assert len(ds) <= 1, "one reshape decision per tick"
+        for d in ds:
+            assert d.action == "shrink" and d.reason == "stall"
+            shrunk.append(d.target)
+            h.reports.pop({"b": 1, "c": 2, "d": 3}[d.target], None)
+    assert len(shrunk) >= 2 and len(set(shrunk)) == len(shrunk)
+
+
+def test_engine_retains_only_nonkeep_decisions():
+    h = _Harness(_policy())
+    for i in range(20):
+        _feed(h, i, slow=0.05)  # nobody slow: keeps only
+        h.tick(HOSTS3)
+    assert h.engine.decisions == []  # keeps are counted, not retained
+
+
+def test_engine_blacklist_strikes_permanent_evict():
+    h = _Harness(_policy(max_blacklist_strikes=3))
+    bl = {"c": {"strikes": 3, "remaining_s": 5.0}}
+    ds = h.tick(HOSTS3, blacklist=bl)
+    assert ds and ds[0].action == "evict" and ds[0].permanent \
+        and ds[0].reason == "blacklist_strikes"
+    # Idempotent: the same snapshot must not re-decide.
+    assert h.tick(HOSTS3, blacklist=bl) == []
+
+
+def test_engine_decision_log_is_deterministic_and_metric_counted():
+    from horovod_tpu.common import metrics as metrics_lib
+
+    def run():
+        h = _Harness(_policy())
+        h.engine.observe_assignment({"a", "b", "c"})
+        for i in range(4):
+            _feed(h, i)
+            h.tick(HOSTS3)
+        h.engine.pre_epoch(2, HOSTS3)
+        return h.engine.decision_log()
+
+    before = {s["labels"]["action"]: s["value"]
+              for s in metrics_lib.snapshot()
+              ["hvd_tpu_autoscale_decisions_total"]["samples"]}
+    a, b = run(), run()
+    assert a == b and len(a) == 2
+    assert [json.loads(l)["action"] for l in a] == ["evict", "grow"]
+    after = {s["labels"]["action"]: s["value"]
+             for s in metrics_lib.snapshot()
+             ["hvd_tpu_autoscale_decisions_total"]["samples"]}
+    # Pre-seeded families all present; evict/grow/keep advanced.
+    assert set(after) >= {"keep", "grow", "shrink", "evict"}
+    assert after["evict"] == before["evict"] + 2
+    assert after["grow"] == before["grow"] + 2
+    assert after["keep"] > before["keep"]
+
+
+def test_engine_decision_log_file(tmp_path):
+    log = tmp_path / "decisions.jsonl"
+    h = _Harness(_policy())
+    h.engine._log_path = str(log)
+    for i in range(3):
+        _feed(h, i)
+        h.tick(HOSTS3)
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert lines == [{"seq": 1, "action": "evict", "target": "c",
+                      "reason": "straggler"}]
+
+
+# -- worker publisher over the rendezvous KV --------------------------------
+
+def test_step_publisher_roundtrip(monkeypatch):
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    srv = RendezvousServer("127.0.0.1", secret=b"pk")
+    port = srv.start()
+    try:
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS_SECRET", "pk")
+        monkeypatch.setenv("HVD_TPU_AUTOSCALE", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOSCALE_POLICY",
+                           '{"publish_interval_s": 0.0, "window": 4}')
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS", f"127.0.0.1:{port}")
+        monkeypatch.setenv("HVD_TPU_PROC_ID", "3")
+        monkeypatch.setenv("HVD_TPU_HOSTNAME", "hostX")
+        autoscale_lib._reset_publisher_for_tests()
+        try:
+            for _ in range(4):
+                autoscale_lib.note_step()
+            reports = autoscale_lib.kv_report_fetcher(srv)()
+            assert 3 in reports
+            r = reports[3]
+            assert r.host == "hostX" and r.step == 3 and r.p50 > 0
+        finally:
+            autoscale_lib._reset_publisher_for_tests()
+    finally:
+        srv.stop()
+
+
+def test_step_publisher_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_AUTOSCALE", raising=False)
+    monkeypatch.delenv("HVD_TPU_AUTOSCALE_POLICY", raising=False)
+    autoscale_lib._reset_publisher_for_tests()
+    try:
+        autoscale_lib.note_step()  # must not raise, must stay None
+        assert autoscale_lib._publisher is None
+    finally:
+        autoscale_lib._reset_publisher_for_tests()
+
+
+def test_straggler_site_scale_inflates_report_only(monkeypatch):
+    from horovod_tpu.common import faults as faults_lib
+
+    class _Sink:
+        def __init__(self):
+            self.puts = []
+
+        def put(self, scope, key, value):
+            self.puts.append((scope, key, json.loads(value.decode())))
+
+    monkeypatch.setenv("HVD_TPU_FAULT_PLAN", json.dumps(
+        {"seed": 1, "faults": [{"site": "straggler", "step": 1,
+                                "times": 0, "scale": 50.0}]}))
+    faults_lib.refresh_from_env()
+    try:
+        sink = _Sink()
+        pub = autoscale_lib.StepPublisher(sink, rank=0, host="h",
+                                          window=4,
+                                          publish_interval_s=0.0)
+        clock = [0.0]
+        pub._clock = lambda: clock[0]
+        for _ in range(3):
+            clock[0] += 0.01
+            pub.note()
+        assert sink.puts, "publisher never published"
+        rec = sink.puts[-1][2]
+        # 0.01 s wall steps reported as 0.5 s — the simulation knob.
+        assert rec["p50"] == pytest.approx(0.5, rel=0.2)
+    finally:
+        monkeypatch.delenv("HVD_TPU_FAULT_PLAN", raising=False)
+        faults_lib.refresh_from_env()
+
+
+# -- HostManager blacklist TTL x eviction decisions (satellite) -------------
+
+def test_blacklist_ttl_expiry_recovery_probe():
+    clock = [0.0]
+    hm = HostManager(FixedHostDiscovery({"a": 1, "b": 1}),
+                     blacklist_ttl_s=10.0, clock=lambda: clock[0])
+    hm.update_available_hosts()
+    hm.blacklist("b")
+    assert hm.current_hosts() == {"a": 1}
+    clock[0] = 10.5
+    hm.update_available_hosts()
+    assert hm.current_hosts() == {"a": 1, "b": 1}  # recovery probe
+
+
+def test_blacklist_strike_doubling_and_engine_ttl_override():
+    clock = [0.0]
+    hm = HostManager(FixedHostDiscovery({"a": 1, "b": 1}),
+                     blacklist_ttl_s=10.0, clock=lambda: clock[0])
+    hm.update_available_hosts()
+    # Engine eviction overrides the TTL with the policy's value...
+    hm.blacklist("b", ttl_s=4.0)
+    assert hm.blacklist_snapshot()["b"]["remaining_s"] == \
+        pytest.approx(4.0)
+    clock[0] = 5.0
+    assert not hm.is_blacklisted("b")
+    # ...and a second strike doubles whatever TTL the new exile uses.
+    hm.blacklist("b", ttl_s=4.0)
+    assert hm.blacklist_snapshot()["b"]["strikes"] == 2
+    assert hm.blacklist_snapshot()["b"]["remaining_s"] == \
+        pytest.approx(8.0)
+    clock[0] = 12.0
+    assert hm.is_blacklisted("b")
+    clock[0] = 13.5
+    assert not hm.is_blacklisted("b")
+
+
+def test_blacklist_permanent_and_exhaustion():
+    clock = [0.0]
+    hm = HostManager(FixedHostDiscovery({"a": 1, "b": 1}),
+                     blacklist_ttl_s=10.0, clock=lambda: clock[0])
+    hm.update_available_hosts()
+    hm.blacklist("a", ttl_s=5.0)
+    hm.blacklist("b", permanent=True)
+    assert hm.current_hosts() == {}
+    # A finite TTL still pending => NOT permanently exhausted.
+    assert not hm.permanently_exhausted()
+    hm.blacklist("a", permanent=True)
+    assert hm.permanently_exhausted()
+
+
+def test_blacklist_update_returns_change_on_ttl_expiry():
+    clock = [0.0]
+    hm = HostManager(FixedHostDiscovery({"a": 1, "b": 1}),
+                     blacklist_ttl_s=3.0, clock=lambda: clock[0])
+    assert hm.update_available_hosts()
+    hm.blacklist("b")
+    assert hm.update_available_hosts()      # usable set shrank
+    assert not hm.update_available_hosts()  # steady
+    clock[0] = 4.0
+    # TTL expiry alone (no discovery change) must report a change so
+    # the driver reshapes — this is what makes grow-after-evict fire.
+    assert hm.update_available_hosts()
+
+
+def test_update_assignments_np_cap():
+    drv = ElasticDriver(FixedHostDiscovery({"a": 2, "b": 2}),
+                        min_np=1, max_np=4)
+    drv.host_manager.update_available_hosts()
+    assert len(drv.update_assignments()) == 4
+    assert len(drv.update_assignments(np_cap=2)) == 2
+    # The cap never cuts below min_np.
+    drv2 = ElasticDriver(FixedHostDiscovery({"a": 2, "b": 2}),
+                         min_np=3, max_np=4)
+    drv2.host_manager.update_available_hosts()
+    assert len(drv2.update_assignments(np_cap=1)) == 3
+    drv.stop()
+    drv2.stop()
+
+
+# -- ScriptHostDiscovery flap debounce (satellite) --------------------------
+
+def _disco_script(tmp_path, content):
+    feed = tmp_path / "hosts.txt"
+    feed.write_text(content)
+    script = tmp_path / "disco.sh"
+    script.write_text(f"#!/bin/bash\ncat {feed}\n")
+    script.chmod(0o755)
+    return script, feed
+
+
+def test_script_discovery_debounces_one_bad_scrape(tmp_path):
+    script, feed = _disco_script(tmp_path, "a:1\nb:1\n")
+    d = ScriptHostDiscovery(str(script), debounce=2)
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    # One truncated scrape: NOT reported (the last adopted set serves).
+    feed.write_text("a:1\n")
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    # The original answer returns: pending change discarded.
+    feed.write_text("a:1\nb:1\n")
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    feed.write_text("a:1\n")
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+    # Second consecutive identical scrape confirms the change.
+    assert d.find_available_hosts_and_slots() == {"a": 1}
+
+
+def test_script_discovery_debounce_one_is_trusting(tmp_path):
+    script, feed = _disco_script(tmp_path, "a:1\n")
+    d = ScriptHostDiscovery(str(script), debounce=1)
+    assert d.find_available_hosts_and_slots() == {"a": 1}
+    feed.write_text("a:1\nb:1\n")
+    assert d.find_available_hosts_and_slots() == {"a": 1, "b": 1}
+
+
+def test_script_discovery_debounce_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DISCOVERY_DEBOUNCE", "3")
+    script, feed = _disco_script(tmp_path, "a:1\n")
+    d = ScriptHostDiscovery(str(script))
+    assert d._debounce == 3
+    d.find_available_hosts_and_slots()
+    feed.write_text("b:1\n")
+    assert d.find_available_hosts_and_slots() == {"a": 1}
+    assert d.find_available_hosts_and_slots() == {"a": 1}
+    assert d.find_available_hosts_and_slots() == {"b": 1}
+
+
+# -- hvdtpurun flag surface -------------------------------------------------
+
+def test_launch_autoscale_policy_flag_validates(tmp_path):
+    from horovod_tpu.runner import launch as launch_lib
+
+    args = launch_lib.parse_args(
+        ["--autoscale-policy", '{"straggler_ratio": 3.0}',
+         "--autoscale-log", str(tmp_path / "d.jsonl"), "--", "true"])
+    env = launch_lib.knob_env(args)
+    assert env["HVD_TPU_AUTOSCALE"] == "1"
+    assert json.loads(env["HVD_TPU_AUTOSCALE_POLICY"])[
+        "straggler_ratio"] == 3.0
+    assert env["HVD_TPU_AUTOSCALE_LOG"].endswith("d.jsonl")
+
+    bad = launch_lib.parse_args(
+        ["--autoscale-policy", '{"stragler_ratio": 3.0}', "--", "true"])
+    with pytest.raises(ValueError, match="stragler_ratio"):
+        launch_lib.knob_env(bad)
+
+
+def test_launch_autoscale_policy_file(tmp_path):
+    from horovod_tpu.runner import launch as launch_lib
+
+    pol = tmp_path / "policy.json"
+    pol.write_text('{"evict_ttl_s": 60.0}')
+    args = launch_lib.parse_args(
+        ["--autoscale-policy", str(pol), "--", "true"])
+    env = launch_lib.knob_env(args)
+    assert json.loads(env["HVD_TPU_AUTOSCALE_POLICY"])[
+        "evict_ttl_s"] == 60.0
+
+
+# -- the chaos soak: decisions are deterministic ----------------------------
+
+def test_autoscale_sim_soak_decision_log_byte_identical():
+    """The seeded control-plane soak (virtual time — the --repeat
+    backbone of tools/chaos_soak.py --family autoscale): same fault
+    plan => byte-identical decision log, and the canonical sequence is
+    evict(straggler) -> grow(recovered capacity) -> evict(permanent)."""
+    plan = chaos_soak.autoscale_plan(42)
+    policy = chaos_soak.autoscale_policy()
+    a, _ = chaos_soak.simulate_autoscale(plan, policy)
+    b, _ = chaos_soak.simulate_autoscale(plan, policy)
+    assert a == b, "same plan must replay the identical decision log"
+    acts = [(json.loads(l)["action"], json.loads(l)["target"])
+            for l in a]
+    assert acts == [("evict", "hostC"), ("grow", "1"),
+                    ("evict", "hostC")]
+    # Different seed still converges on the same decisions here (the
+    # plan's step-indexed faults dominate), but MUST stay internally
+    # reproducible.
+    c, _ = chaos_soak.simulate_autoscale(chaos_soak.autoscale_plan(7),
+                                         policy)
+    d, _ = chaos_soak.simulate_autoscale(chaos_soak.autoscale_plan(7),
+                                         policy)
+    assert c == d
+
+
+def test_autoscale_live_smoke_evicts_and_regrows(tmp_path):
+    """The end-to-end acceptance scenario (ISSUE 7): a REAL elastic job
+    under the seeded plan — the driver evicts the injected straggler,
+    grows back when the blacklist TTL expires and discovery re-offers
+    the host, escalates the repeat offender to permanent, never drops
+    below min_np, and finishes every step. run_autoscale_soak asserts
+    all of it internally."""
+    rec = chaos_soak.run_autoscale_soak(str(tmp_path), steps=120,
+                                        seed=42)
+    assert rec["final_step"] == 120
+    # Invariants, not byte-identity (the live run is wall-clock-driven;
+    # byte-identity is the virtual-time sim's contract): the straggler
+    # is evicted first, capacity grows back, and every eviction names
+    # the injected straggler host only.
+    decs = [json.loads(l) for l in rec["decisions"]]
+    assert decs and decs[0]["action"] == "evict" \
+        and decs[0]["target"] == "hostC" \
+        and decs[0]["reason"] == "straggler"
+    assert "grow" in [d["action"] for d in decs]
+    assert all(d["target"] == "hostC" for d in decs
+               if d["action"] == "evict")
+    assert "straggler" in rec["injected_sites"]
+
+
+@pytest.mark.slow
+def test_autoscale_live_repeat_is_deterministic(tmp_path):
+    a = chaos_soak.run_autoscale_soak(str(tmp_path / "a"), steps=120,
+                                      seed=11)
+    b = chaos_soak.run_autoscale_soak(str(tmp_path / "b"), steps=120,
+                                      seed=11)
+    assert a["sequences"] == b["sequences"], \
+        "same seed must reproduce the same decision sequences"

@@ -156,6 +156,59 @@ def check_topology_surface(missing: list) -> None:
             missing.append(f"api: {name} undocumented in docs/api.md")
 
 
+def check_autoscale_surface(missing: list) -> None:
+    """The autoscaling layer (docs/autoscale.md): every
+    ``HVD_TPU_AUTOSCALE_*`` knob — the enable/policy/log trio plus one
+    generated ``HVD_TPU_AUTOSCALE_<FIELD>`` override per AutoscalePolicy
+    field — and every ``hvd_tpu_autoscale_*`` metric must be documented
+    there, or the control plane's thresholds are undiscoverable. Parsed
+    textually (runs without jax installed)."""
+    doc = REPO / "docs" / "autoscale.md"
+    if not doc.exists():
+        missing.append("path: docs/autoscale.md")
+        return
+    text = doc.read_text()
+    src = (REPO / "horovod_tpu" / "common" / "autoscale.py").read_text()
+    # Policy fields = annotated dataclass attributes of AutoscalePolicy.
+    m = re.search(r"class AutoscalePolicy:.*?\n\n    @classmethod", src,
+                  re.S)
+    if m is None:
+        missing.append("autoscale: AutoscalePolicy dataclass not found")
+        return
+    fields = re.findall(r"^    (\w+): (?:bool|int|float)", m.group(0),
+                        re.M)
+    if not fields:
+        missing.append("autoscale: no AutoscalePolicy fields parsed")
+    knobs = {"HVD_TPU_AUTOSCALE", "HVD_TPU_AUTOSCALE_POLICY",
+             "HVD_TPU_AUTOSCALE_LOG", "HVD_TPU_DISCOVERY_DEBOUNCE"}
+    knobs |= {"HVD_TPU_AUTOSCALE_" + f.upper() for f in fields}
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"autoscale knob {k}: undocumented in "
+                           "docs/autoscale.md")
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*"(hvd_tpu_[a-z0-9_]+)"')
+    names = set(reg_call.findall(src))
+    if not names:
+        missing.append("autoscale: no hvd_tpu_* metrics registered by "
+                       "the autoscale layer")
+    for n in sorted(names):
+        if n not in text:
+            missing.append(f"autoscale metric {n}: undocumented in "
+                           "docs/autoscale.md")
+    # The field list in the doc's policy-schema table must be complete.
+    for f in fields:
+        if f"`{f}`" not in text:
+            missing.append(f"autoscale policy field {f}: missing from "
+                           "the docs/autoscale.md schema table")
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    for name in ("AutoscalePolicy", "AutoscaleEngine",
+                 "--autoscale-policy"):
+        if name not in api_text:
+            missing.append(f"api: {name} undocumented in docs/api.md")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -195,6 +248,7 @@ def main() -> int:
     check_metrics_surface(missing)
     check_integrity_surface(missing)
     check_topology_surface(missing)
+    check_autoscale_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
