@@ -240,6 +240,38 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="per-layer activation recomputation on the GPT "
                         "models (long-context HBM relief)")
+    p.add_argument("--accum", type=int, default=1,
+                   help="scan-based gradient accumulation: split the "
+                        "per-rank batch into this many microbatches "
+                        "under lax.scan (hvd accum_steps=; one "
+                        "collective round per EFFECTIVE step; "
+                        "docs/performance.md MFU playbook)")
+    p.add_argument("--remat-policy", default="none",
+                   choices=["none", "full", "dots", "dots_no_batch"],
+                   help="jax.checkpoint policy for the microbatch loss "
+                        "under --accum (tuned jointly with it: remat "
+                        "frees the activation memory accumulation "
+                        "needs)")
+    p.add_argument("--prefetch", default="",
+                   choices=["", "off", "single", "double"],
+                   help="feed the step through the device-infeed "
+                        "pipeline instead of static device-resident "
+                        "args: off = per-step blocking host->device "
+                        "placement (the host tax on the timed path), "
+                        "single = one batch staged ahead, double = "
+                        "background-thread double-buffered "
+                        "hvd.DeviceInfeed. Infeed wait lands in the "
+                        "BENCH json. Default '' keeps the legacy "
+                        "static-args loop ('' != off: off measures the "
+                        "transfer, '' excludes it)")
+    p.add_argument("--shard-update", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="weight-update sharding (ZeRO-1, "
+                        "hvd.ShardedOptimizer): 'auto' shards when "
+                        "hvd.should_shard_update says the replicated "
+                        "params cross HVD_TPU_AUTO_SHARD_THRESHOLD "
+                        "(arXiv:1909.09756), 'on' forces it (n>1), "
+                        "'off' keeps the replicated update")
     p.add_argument("--no-s2d", action="store_true",
                    help="disable the space-to-depth ResNet stem "
                         "(measures the lever's value; default stem is "
@@ -260,6 +292,8 @@ def main():
         # ADVICE r4: zero iterations left the window-timing loop with no
         # batch to force (NameError) and the legacy path with mean([]).
         p.error("--num-iters and --batches-per-iter must be >= 1")
+    if args.accum < 1:
+        p.error("--accum must be >= 1")
 
     if not args._worker:
         return _supervise(sys.argv[1:], args.model)
@@ -405,6 +439,82 @@ def _guard_policy(args):
     return "skip_step" if args.guard == "on" else "off"
 
 
+def _shard_decision(args, params, n) -> bool:
+    """Whether this run uses the ZeRO-1 sharded update
+    (hvd.ShardedOptimizer; docs/performance.md). 'auto' consults the
+    hvd.should_shard_update heuristic — replicated params at least
+    HVD_TPU_AUTO_SHARD_THRESHOLD bytes and n > 1; incompatible arms
+    (single rank, Adasum routing, overlap scheduling — the sharded
+    surface has no bucket chaining) log and fall back to replicated."""
+    import horovod_tpu as hvd
+
+    if args.shard_update == "off":
+        return False
+    why = None
+    if n <= 1:
+        why = "single-rank world"
+    elif args.route.startswith("adasum") and args.mesh_shape:
+        why = "Adasum routing (sharded update reduces SUM/AVERAGE only)"
+    elif args.overlap:
+        why = "--overlap (no bucket chaining on the sharded surface)"
+    if why is not None:
+        if args.shard_update == "on":
+            _log(f"--shard-update on ignored: {why}")
+        return False
+    if args.shard_update == "on":
+        return True
+    return hvd.should_shard_update(params, size=n)
+
+
+def _make_tx(args, params, n, inner):
+    """The optimizer for a bench arm: replicated DistributedOptimizer
+    or (when the weight-update-sharding decision says so) the ZeRO-1
+    ShardedOptimizer — same update() call shape either way. Returns
+    (tx, sharded: bool)."""
+    import horovod_tpu as hvd
+
+    rt = _routing(args)
+    sharded = _shard_decision(args, params, n)
+    _ARM["sharded"] = sharded
+    if sharded:
+        tx = hvd.ShardedOptimizer(
+            inner, axis_name=hvd.rank_axis(),
+            compression=args.compression,
+            nonfinite_policy=_guard_policy(args),
+            accum_steps=args.accum, remat_policy=args.remat_policy,
+            **({"route": rt["plan"]} if rt else {}))
+    else:
+        tx = hvd.DistributedOptimizer(
+            inner, axis_name=hvd.rank_axis(), overlap=args.overlap,
+            compression=args.compression,
+            nonfinite_policy=_guard_policy(args),
+            accum_steps=args.accum, remat_policy=args.remat_policy,
+            **_route_kwargs(rt))
+    return tx, sharded
+
+
+def _init_opt_state(tx, sharded, params, n, routing):
+    """Optimizer state + its shard_map PartitionSpecs. The sharded
+    state MUST be built inside an SPMD region (the 1/n shard shapes
+    come from the bound axis), so it gets a one-shot jitted shard_map
+    init program; replicated state keeps the host-side init."""
+    import jax
+
+    import horovod_tpu as hvd
+    from jax.sharding import PartitionSpec as P
+
+    if not sharded:
+        return tx.init(params), P()
+    from horovod_tpu.common import basics
+
+    specs = tx.state_specs(params)
+    mesh = routing["mesh"] if routing else basics.context().mesh
+    init_fn = jax.jit(jax.shard_map(
+        tx.init, mesh=mesh, in_specs=P(), out_specs=specs,
+        check_vma=False))
+    return init_fn(params), specs
+
+
 def _setup(args, batch_size, n):
     if args.model.startswith("bert"):
         return _setup_bert(args, batch_size, n)
@@ -413,7 +523,27 @@ def _setup(args, batch_size, n):
     return _setup_cnn(args, batch_size, n)
 
 
+# infeed_pipeline generators created by _make_stepper during this
+# benchmark invocation: the stepper's feed (backed by an infinite host
+# iterator) never self-exhausts, and the guard A/B builds a SECOND
+# stepper while the first's worker still pins depth+1 device-resident
+# batches — so each _run_benchmark closes every feed it opened.
+_FEEDS = []
+
+
 def _run_benchmark(args, n):
+    try:
+        return _run_benchmark_inner(args, n)
+    finally:
+        while _FEEDS:
+            feed = _FEEDS.pop()
+            try:
+                feed.close()
+            except Exception:  # noqa: BLE001 — result already computed
+                pass
+
+
+def _run_benchmark_inner(args, n):
     is_bert = args.model.startswith("bert")
     is_gpt = args.model.startswith("gpt")
     batch_size = args.batch_size or (8 if (is_bert or is_gpt) else 256)
@@ -448,6 +578,7 @@ def _run_benchmark(args, n):
             _log(f"profiler unavailable: {e}")
 
     total_batches = args.num_iters * args.batches_per_iter
+    iw_count0, iw_sum0 = _infeed_wait_totals()
     try:
         if args.sync_per_iter:
             # Legacy mode: one host fetch per iteration group. Serializes
@@ -481,6 +612,7 @@ def _run_benchmark(args, n):
         if profiling:
             jax.profiler.stop_trace()
             _log(f"profiler trace written to {args.profile_dir}")
+    iw_count1, iw_sum1 = _infeed_wait_totals()
 
     # batch_size is the GLOBAL batch (sharded over n chips in spmd mode);
     # the metric is per-chip, so divide the measured global rate by n.
@@ -521,7 +653,28 @@ def _run_benchmark(args, n):
         "mesh_shape": args.mesh_shape or None,
         "route": ((_routing(args) or {}).get("describe")
                   if args.mesh_shape else None),
+        "accum": args.accum,
+        "remat_policy": args.remat_policy,
+        "prefetch": args.prefetch or None,
+        "shard_update": _ARM["sharded"],
     }
+    if args.prefetch:
+        # Infeed-wait delta over the TIMED window only (warmup waits
+        # excluded): how long the step loop blocked on the next device
+        # batch — the host-overhead number the --prefetch A/B exists
+        # to move (docs/performance.md MFU playbook).
+        waited = max(iw_sum1 - iw_sum0, 0.0)
+        nbatch = max(iw_count1 - iw_count0, 0)
+        result["infeed"] = {
+            "mode": args.prefetch,
+            "wait_s": round(waited, 4),
+            "wait_ms_per_batch": round(1000.0 * waited / nbatch, 3)
+            if nbatch else None,
+            "batches": nbatch,
+        }
+        if window_s is not None and window_s > 0:
+            result["infeed"]["wait_pct_of_window"] = round(
+                100.0 * waited / window_s, 1)
     if args.guard == "on":
         # Guard-overhead A/B (docs/integrity.md): rebuild the SAME
         # config without the guard and time a short window — the delta
@@ -595,6 +748,32 @@ def _run_benchmark(args, n):
         result["model_flops_per_sample_g"] = round(model_flops / 1e9, 2)
         result["mfu_model_pct"] = round(100.0 * val * model_flops / peak,
                                         1)
+    # The headline `mfu` field (ROADMAP item 2): COMPUTED from the
+    # measured rate and the per-platform peak table — model basis when
+    # the analytic FLOPs exist, else the executable basis. On the CPU
+    # fallback the peak is a NOMINAL 1 TFLOP/s (marked below): the
+    # number then only supports A/B deltas within a round, never
+    # cross-platform claims.
+    if "mfu_model_pct" in result or "mfu_exec_pct" in result:
+        model_basis = "mfu_model_pct" in result
+        result["mfu"] = result["mfu_model_pct"] if model_basis \
+            else result["mfu_exec_pct"]
+        result["mfu_basis"] = "model" if model_basis else "exec"
+        # Backfill into the one-line summary so the trajectory is
+        # readable straight off the BENCH record heads.
+        result["config_note"] += f" mfu={result['mfu']}%"
+        if _peak_is_nominal():
+            result["peak_flops_basis"] = "nominal_cpu_1tflop"
+        try:
+            from horovod_tpu.common import metrics as hv_metrics
+
+            hv_metrics.gauge(
+                "hvd_tpu_bench_mfu",
+                "computed model-FLOPs utilization of the last bench "
+                "run, percent (bench.py; docs/performance.md)"
+            ).set(result["mfu"])
+        except Exception:  # noqa: BLE001 — telemetry must not fail it
+            pass
     mx = _metrics_summary()
     if mx:
         # WHY a round got faster, not just how fast: the wire-byte mix,
@@ -667,12 +846,34 @@ def _metrics_summary():
 
 _LAST_LOWERED = {"lowered": None, "compiled": None}
 _TIMINGS = {"compile_s": None}
+_ARM = {"sharded": None}  # what _make_tx actually decided
+
+
+def _infeed_wait_totals():
+    """(count, sum_seconds) of the infeed-wait histogram — deltas
+    around the timed window attribute starvation to THAT window."""
+    try:
+        import horovod_tpu as hvd
+
+        s = hvd.metrics().get("hvd_tpu_infeed_wait_seconds", {}) \
+            .get("samples", [])
+        if not s:
+            return 0, 0.0
+        v = s[0]["value"]
+        return int(v.get("count", 0)), float(v.get("sum", 0.0))
+    except Exception:  # noqa: BLE001 — telemetry must not fail a bench
+        return 0, 0.0
 
 _PEAK_BF16_FLOPS = {
-    # Published peak dense bf16 FLOP/s per chip.
+    # Published peak dense bf16 FLOP/s per chip. The "cpu" row is a
+    # NOMINAL 1 TFLOP/s so the CPU-simulated A/B arms still carry a
+    # computed `mfu` field (flagged peak_flops_basis=nominal_cpu_1tflop
+    # in the record) — the absolute value means nothing off-chip, only
+    # the within-round delta does.
     "TPU v5 lite": 197e12, "TPU v5e": 197e12,
     "TPU v5": 459e12, "TPU v5p": 459e12,
     "TPU v4": 275e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+    "cpu": 1.0e12,
 }
 
 
@@ -684,6 +885,12 @@ def _peak_flops():
         if kind.startswith(k):
             return v
     return None
+
+
+def _peak_is_nominal() -> bool:
+    import jax
+
+    return jax.devices()[0].device_kind.startswith("cpu")
 
 
 def _step_flops(n):
@@ -709,23 +916,38 @@ def _step_flops(n):
 
 
 def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
-                  routing=None):
+                  routing=None, state_specs=None, prefetch=""):
     """Shared step-loop builder: jit (n=1) or spmd_step shard_map (n>1);
     with ``routing`` (--mesh-shape) the step shards over the N-D route
-    mesh so the optimizer's WirePlan axes are bound."""
+    mesh so the optimizer's WirePlan axes are bound.
+
+    ``state_specs`` optionally overrides the per-state-item shard_map
+    specs (the ZeRO-1 arm carries its 1/n optimizer state as
+    ``ShardedOptimizer.state_specs``; everything else replicates).
+    ``prefetch`` (off/single/double) switches the loop from static
+    device-resident args to a HOST-FED pipeline: each step consumes the
+    next batch from ``hvd.infeed_pipeline``, so the host->device
+    transfer is on (off) or off (double) the timed path and the wait is
+    measured into ``hvd_tpu_infeed_wait_seconds``."""
     import jax
 
     import horovod_tpu as hvd
+    from jax.sharding import PartitionSpec as P
 
     nstate = len(params_and_state)
     donate = tuple(range(nstate))  # update state in place in HBM
+    if state_specs is None:
+        state_specs = [P()] * nstate
+    state_specs = tuple(state_specs)
+    data_sharding = None  # NamedSharding for infeed placement
     if routing is not None and n > 1:
-        from jax.sharding import PartitionSpec as P
-
         axes = routing["axes"]
         spec = P(axes)
-        in_specs = tuple([P()] * nstate) + tuple([spec] * len(extra_args))
-        out_specs = tuple([P()] * nstate) + (P(),)
+        in_specs = state_specs + tuple([spec] * len(extra_args))
+        out_specs = state_specs + (P(),)
+        if prefetch:
+            data_sharding = jax.sharding.NamedSharding(
+                routing["mesh"], spec)
 
         def _step(*all_args):
             state, data = all_args[:nstate], all_args[nstate:]
@@ -737,11 +959,14 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
                           check_vma=False),
             donate_argnums=donate)
     elif n > 1:
-        from jax.sharding import PartitionSpec as P
-
         ax = hvd.rank_axis()
-        in_specs = tuple([P()] * nstate) + tuple([P(ax)] * len(extra_args))
-        out_specs = tuple([P()] * nstate) + (P(),)
+        in_specs = state_specs + tuple([P(ax)] * len(extra_args))
+        out_specs = state_specs + (P(),)
+        if prefetch:
+            from horovod_tpu.common import basics
+
+            data_sharding = jax.sharding.NamedSharding(
+                basics.context().mesh, P(ax))
 
         @hvd.spmd_step(in_specs=in_specs, out_specs=out_specs,
                        donate_argnums=donate)
@@ -754,6 +979,20 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
         def train_step(*all_args):
             state, data = all_args[:nstate], all_args[nstate:]
             return model_apply_loss(state, data, pmean_axis=None)
+
+    feed = None
+    if prefetch:
+        from horovod_tpu import data as data_lib
+
+        host_batch = tuple(np.asarray(x) for x in extra_args)
+
+        def host_iter():
+            while True:  # infinite: warmup, window, and any A/B rebuild
+                yield host_batch
+
+        feed = data_lib.infeed_pipeline(host_iter(), prefetch,
+                                        sharding=data_sharding)
+        _FEEDS.append(feed)
 
     carry = list(params_and_state)
 
@@ -768,6 +1007,11 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
     # Timed separately from warmup: compile_s is the (cacheable) XLA
     # cost, warmup_s the first executions' cost.
     fn = train_step
+    if feed is not None:
+        # Lower/compile against a FED batch: the executable pins its
+        # input shardings, and the pipeline's NamedSharding-placed
+        # batches must match what it was built for.
+        extra_args = next(feed)
     try:
         t0 = time.perf_counter()
         lowered = train_step.lower(*carry, *extra_args)
@@ -781,7 +1025,8 @@ def _make_stepper(model_apply_loss, params_and_state, n, extra_args,
              f"falling back to jit dispatch")
 
     def run_batch():
-        out = fn(*carry, *extra_args)
+        data = next(feed) if feed is not None else extra_args
+        out = fn(*carry, *data)
         carry[:] = out[:-1]
         return out[-1]
 
@@ -853,30 +1098,40 @@ def _setup_cnn(args, batch_size, n):
 
     # Reference benchmark uses plain SGD lr=0.01 wrapped in
     # DistributedOptimizer; same here (fused allreduce over the rank
-    # axis, or the mesh router's per-axis plan under --mesh-shape).
+    # axis, or the mesh router's per-axis plan under --mesh-shape) —
+    # or the ZeRO-1 sharded update when the --shard-update decision
+    # fires (docs/performance.md).
+    from jax.sharding import PartitionSpec as P
+
     rt = _routing(args)
-    route_kw = _route_kwargs(rt)
-    tx = hvd.DistributedOptimizer(optax.sgd(0.01),
-                                  axis_name=hvd.rank_axis(),
-                                  overlap=args.overlap,
-                                  compression=args.compression,
-                                  nonfinite_policy=_guard_policy(args),
-                                  **route_kw)
-    opt_state = tx.init(params)
+    tx, sharded = _make_tx(args, params, n, optax.sgd(0.01))
+    opt_state, opt_specs = _init_opt_state(tx, sharded, params, n, rt)
 
     def apply_loss(state, data, pmean_axis):
         p, bs, st = state
         x, y = data
 
-        def loss_fn(p, bs):
+        def loss_fn(p, bs, xb, yb):
             logits, new_state = model.apply(
-                {"params": p, "batch_stats": bs}, x, train=True,
+                {"params": p, "batch_stats": bs}, xb, train=True,
                 mutable=["batch_stats"], rngs={"dropout": dropout_rng})
             loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y).mean()
+                logits, yb).mean()
             return loss, new_state.get("batch_stats", {})
 
-        (l, new_bs), g = jax.value_and_grad(loss_fn, has_aux=True)(p, bs)
+        if args.accum > 1 or args.remat_policy != "none":
+            # Scan-based accumulation: k microbatches per effective
+            # step, ONE reduction on the mean gradient (batch stats
+            # averaged across microbatches). Also the ONLY place the
+            # remat wrap happens — a requested --remat-policy must go
+            # through it even at k=1, or the record would claim a remat
+            # the step never ran.
+            (l, new_bs), g = tx.accumulate(
+                lambda pp, xb, yb: loss_fn(pp, bs, xb, yb),
+                has_aux=True)(p, x, y)
+        else:
+            (l, new_bs), g = jax.value_and_grad(
+                lambda pp: loss_fn(pp, bs, x, y), has_aux=True)(p)
         if pmean_axis is not None:
             # BatchNorm stats averaged across ranks (SyncBatchNorm-lite).
             new_bs = jax.tree.map(
@@ -887,7 +1142,9 @@ def _setup_cnn(args, batch_size, n):
         return p, new_bs, st, l
 
     run = _make_stepper(apply_loss, (params, batch_stats, opt_state),
-                        n, (images, labels), routing=rt)
+                        n, (images, labels), routing=rt,
+                        state_specs=[P(), P(), opt_specs],
+                        prefetch=args.prefetch)
     return (run, "img/s", CNN_BASELINE_PER_DEVICE,
             _cnn_model_flops(args.model, image_size))
 
@@ -916,27 +1173,27 @@ def _setup_bert(args, batch_size, n):
     # bf16 first moment: halves the Adam mu HBM traffic per step (the
     # "bf16-dominant optimizer path" lever; nu stays fp32 — optax only
     # exposes mu_dtype, and the second moment is scale-sensitive).
+    from jax.sharding import PartitionSpec as P
+
     rt = _routing(args)
-    route_kw = _route_kwargs(rt)
-    tx = hvd.DistributedOptimizer(
-        optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
-        axis_name=hvd.rank_axis(), overlap=args.overlap,
-        compression=args.compression,
-        nonfinite_policy=_guard_policy(args), **route_kw)
-    opt_state = tx.init(params)
+    tx, sharded = _make_tx(args, params, n,
+                           optax.adamw(1e-4, mu_dtype=jnp.bfloat16))
+    opt_state, opt_specs = _init_opt_state(tx, sharded, params, n, rt)
 
     def apply_loss(state, data, pmean_axis):
         p, st = state
         toks, mask_pos, y = data
 
-        def loss_fn(p):
-            logits = model.apply({"params": p}, toks)
+        def loss_fn(p, tb, mb, yb):
+            logits = model.apply({"params": p}, tb)
             per_tok = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y)
-            return (per_tok * mask_pos).sum() / jnp.maximum(
-                mask_pos.sum(), 1.0)
+                logits, yb)
+            return (per_tok * mb).sum() / jnp.maximum(mb.sum(), 1.0)
 
-        l, g = jax.value_and_grad(loss_fn)(p)
+        if args.accum > 1 or args.remat_policy != "none":
+            l, g = tx.accumulate(loss_fn)(p, toks, mask_pos, y)
+        else:
+            l, g = jax.value_and_grad(loss_fn)(p, toks, mask_pos, y)
         if pmean_axis is not None:
             l = jax.lax.pmean(l, pmean_axis)
         updates, st = tx.update(g, st, p)
@@ -945,7 +1202,8 @@ def _setup_bert(args, batch_size, n):
 
     run = _make_stepper(apply_loss, (params, opt_state), n,
                         (tokens, mask_positions.astype(jnp.float32), labels),
-                        routing=rt)
+                        routing=rt, state_specs=[P(), opt_specs],
+                        prefetch=args.prefetch)
     return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
             _transformer_model_flops(params, model.num_layers,
                                      model.hidden_size, args.seq_len))
@@ -973,25 +1231,26 @@ def _setup_gpt(args, batch_size, n):
     _log("model.init done")
     import jax.numpy as jnp
 
+    from jax.sharding import PartitionSpec as P
+
     rt = _routing(args)
-    route_kw = _route_kwargs(rt)
-    tx = hvd.DistributedOptimizer(
-        optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
-        axis_name=hvd.rank_axis(), overlap=args.overlap,
-        compression=args.compression,
-        nonfinite_policy=_guard_policy(args), **route_kw)
-    opt_state = tx.init(params)
+    tx, sharded = _make_tx(args, params, n,
+                           optax.adamw(1e-4, mu_dtype=jnp.bfloat16))
+    opt_state, opt_specs = _init_opt_state(tx, sharded, params, n, rt)
 
     def apply_loss(state, data, pmean_axis):
         p, st = state
         (toks,) = data
 
-        def loss_fn(p):
-            logits = model.apply({"params": p}, toks[:, :-1])
+        def loss_fn(p, tb):
+            logits = model.apply({"params": p}, tb[:, :-1])
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, toks[:, 1:]).mean()
+                logits, tb[:, 1:]).mean()
 
-        l, g = jax.value_and_grad(loss_fn)(p)
+        if args.accum > 1 or args.remat_policy != "none":
+            l, g = tx.accumulate(loss_fn)(p, toks)
+        else:
+            l, g = jax.value_and_grad(loss_fn)(p, toks)
         if pmean_axis is not None:
             l = jax.lax.pmean(l, pmean_axis)
         updates, st = tx.update(g, st, p)
@@ -999,7 +1258,8 @@ def _setup_gpt(args, batch_size, n):
         return p, st, l
 
     run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,),
-                        routing=rt)
+                        routing=rt, state_specs=[P(), opt_specs],
+                        prefetch=args.prefetch)
     return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
             _transformer_model_flops(params, model.num_layers,
                                      model.hidden, args.seq_len))

@@ -57,13 +57,17 @@ from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
 from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
-                    StepTimer, broadcast_parameters, observe_ef_residual,
-                    sharded_init, sharded_update)
+                    StepTimer, accumulate_gradients, auto_shard_threshold,
+                    broadcast_parameters, observe_ef_residual,
+                    resolve_remat_policy, sharded_init, sharded_update,
+                    should_shard_update)
 from .common import integrity
 from .common import metrics as _metrics_lib
 from .common.faults import recovery_stats
 from .common.integrity import (DivergenceDetector, current_loss_scale,
                                observe_guard)
+from .data import (BackgroundPrefetcher, DeviceInfeed, infeed_pipeline,
+                   prefetch_to_device, shard_batch)
 from .functions import allgather_object, broadcast_object, broadcast_variables
 from .process_set import ProcessSet
 
@@ -480,4 +484,8 @@ __all__ = [
     "integrity", "observe_guard", "current_loss_scale",
     "DivergenceDetector", "MismatchError", "NonFiniteError",
     "DivergenceError", "CheckpointCorruptError", "StallTimeoutError",
+    "accumulate_gradients", "resolve_remat_policy",
+    "auto_shard_threshold", "should_shard_update", "DeviceInfeed",
+    "prefetch_to_device", "BackgroundPrefetcher", "shard_batch",
+    "infeed_pipeline",
 ]

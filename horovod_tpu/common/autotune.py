@@ -19,7 +19,8 @@ from __future__ import annotations
 import logging
 import math
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, List, NamedTuple, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -46,17 +47,72 @@ _M_ROUTE_IDX = metrics_lib.gauge(
     "hvd_tpu_autotune_route_index",
     "index of the current routing/reduction-mode candidate "
     "(see route_candidates order; 0 = flat)")
+_M_ACCUM = metrics_lib.gauge(
+    "hvd_tpu_autotune_accum_steps",
+    "current gradient-accumulation microbatch count candidate")
+_M_REMAT_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_remat_index",
+    "index of the current remat-policy candidate "
+    "(see remat_candidates order; 0 = none)")
+_M_SHARD = metrics_lib.gauge(
+    "hvd_tpu_autotune_shard_update",
+    "current weight-update-sharding toggle (0 = replicated, "
+    "1 = ZeRO-1 sharded)")
 _M_CONVERGED = metrics_lib.gauge(
     "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
 _M_SAMPLES = metrics_lib.counter(
     "hvd_tpu_autotune_samples_total",
-    "scored samples per configuration "
-    "(config = threshold|hierarchical|overlap|compression)",
+    "scored samples per configuration (config = threshold|hierarchical"
+    "|overlap|compression|route|accum|remat|shard)",
     labels=("config",))
 
 _MB = 1024 * 1024
 DEFAULT_CANDIDATES = tuple(int(x * _MB) for x in
                            (1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+
+class TunedPoint(NamedTuple):
+    """The full tuned configuration (docs/autotune.md): the fusion
+    threshold plus every joint toggle/candidate. Untuned axes sit at
+    their defaults. ``AutotunedStepper`` build functions receive this
+    whole point when any of the MFU dimensions (accum/remat/shard) are
+    tuned."""
+
+    threshold: int
+    hierarchical: bool
+    overlap: bool
+    compression: str
+    route: str
+    accum: int        # gradient-accumulation microbatch count
+    remat: str        # remat-policy name ("none"/"dots"/...)
+    shard: bool       # weight-update sharding (ZeRO-1) toggle
+
+
+def _phase_bound_accum_gate() -> bool:
+    """Default pruning gate for the accumulation dimension: True
+    ("explore accum>1") when the StepTimer phase histograms
+    (``hvd_tpu_step_phase_seconds``, docs/metrics.md) show the step is
+    COMM-BOUND (comm phase >= 15% of the phase-timed step) — the regime
+    where amortizing the collective round over k microbatches pays — or
+    when no phase evidence exists yet (memory pressure is invisible
+    from here; never prune blind). A compute-dominated step gets the
+    accum>1 candidates pruned: each would recompile and sample for
+    nothing."""
+    try:
+        snap = metrics_lib.snapshot()
+        samples = snap.get("hvd_tpu_step_phase_seconds", {}) \
+            .get("samples", [])
+        sums = {}
+        for s in samples:
+            v = s.get("value")
+            if isinstance(v, dict) and v.get("count"):
+                sums[s["labels"].get("phase", "?")] = float(v["sum"])
+        total = sum(sums.values())
+        if not total or "comm" not in sums:
+            return True
+        return sums["comm"] / total >= 0.15
+    except Exception:  # noqa: BLE001 — telemetry must not break tuning
+        return True
 
 
 class GaussianProcess:
@@ -133,7 +189,14 @@ class Autotuner:
                      "none", "bf16", "int8_ef"),
                  tune_route: bool = False,
                  route_candidates: Sequence[str] = (
-                     "flat", "staged", "staged_int8", "adasum")):
+                     "flat", "staged", "staged_int8", "adasum"),
+                 tune_accum: bool = False,
+                 accum_candidates: Sequence[int] = (1, 2, 4, 8),
+                 tune_remat: bool = False,
+                 remat_candidates: Sequence[str] = (
+                     "none", "dots", "full"),
+                 tune_shard: bool = False,
+                 accum_gate: Optional[Callable[[], bool]] = None):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
         self.steps_per_sample = steps_per_sample
@@ -164,18 +227,41 @@ class Autotuner:
                                  if tune_route else ("flat",))
         self.compression_candidates = (tuple(compression_candidates)
                                        if tune_compression else ("none",))
+        # The MFU dimensions (ROADMAP item 2, docs/performance.md):
+        # gradient-accumulation microbatch count, remat policy (the two
+        # tune JOINTLY — remat frees the memory accumulation needs),
+        # and the weight-update-sharding toggle (ZeRO-1 as a measured
+        # candidate, arXiv:1909.09756). Accumulation candidates are
+        # PRUNED at the first sample boundary unless the step shows
+        # comm- or memory-bound evidence (accum_gate; default reads the
+        # StepTimer phase histograms) — a compute-bound step would pay
+        # the full recompile-and-sample cost of every accum point for
+        # no reachable win.
+        self.tune_accum = tune_accum
+        self.accum_candidates = (tuple(int(a) for a in accum_candidates)
+                                 if tune_accum else (1,))
+        self.tune_remat = tune_remat
+        self.remat_candidates = (tuple(remat_candidates)
+                                 if tune_remat else ("none",))
+        self.tune_shard = tune_shard
+        self.accum_gate = accum_gate
+        self._accum_pruned = False
         hs = (0, 1) if tune_hierarchical else (0,)
         ovs = (0, 1) if tune_overlap else (0,)
         cs = tuple(range(len(self.compression_candidates)))
         rs = tuple(range(len(self.route_candidates)))
-        self._space: List[Tuple[int, int, int, int, int]] = [
-            (t, h, o, c, rt) for t in self.candidates for h in hs
-            for o in ovs for c in cs for rt in rs]
+        accs = tuple(range(len(self.accum_candidates)))
+        rms = tuple(range(len(self.remat_candidates)))
+        shs = (0, 1) if tune_shard else (0,)
+        self._space: List[Tuple[int, ...]] = [
+            (t, h, o, c, rt, a, m, s) for t in self.candidates for h in hs
+            for o in ovs for c in cs for rt in rs for a in accs
+            for m in rms for s in shs]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
         self._secs = 0.0
-        self._samples: Dict[Tuple[int, int, int, int], List[float]] = {}
+        self._samples: Dict[Tuple[int, ...], List[float]] = {}
         self._cur = self._space[len(self._space) // 2]
         self._done = False
         # Samples arrive from finalizer-pool threads (eager engine) and
@@ -193,6 +279,12 @@ class Autotuner:
             cols.append("compression")
         if tune_route:
             cols.append("route")
+        if tune_accum:
+            cols.append("accum")
+        if tune_remat:
+            cols.append("remat")
+        if tune_shard:
+            cols.append("shard")
         self._columns = tuple(cols)
         self._publish_metrics()
         if log_file:
@@ -252,11 +344,43 @@ class Autotuner:
     @property
     def current_quint(self) -> Tuple[int, bool, bool, str, str]:
         """Atomic (threshold, hierarchical, overlap, compression,
-        route) snapshot — the full tuned point."""
+        route) snapshot — the historical 5-axis point (the MFU axes
+        are on :attr:`current_full`)."""
         with self._tlock:
             return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
                     self.compression_candidates[self._cur[3]],
                     self.route_candidates[self._cur[4]])
+
+    @property
+    def current_accum(self) -> int:
+        with self._tlock:
+            return self.accum_candidates[self._cur[5]]
+
+    @property
+    def current_remat(self) -> str:
+        with self._tlock:
+            return self.remat_candidates[self._cur[6]]
+
+    @property
+    def current_shard(self) -> bool:
+        with self._tlock:
+            return bool(self._cur[7])
+
+    @property
+    def current_full(self) -> TunedPoint:
+        """Atomic snapshot of the FULL tuned point (all 8 axes)."""
+        with self._tlock:
+            return self._point_of(self._cur)
+
+    def _point_of(self, cur: Tuple[int, ...]) -> TunedPoint:
+        return TunedPoint(
+            threshold=cur[0], hierarchical=bool(cur[1]),
+            overlap=bool(cur[2]),
+            compression=self.compression_candidates[cur[3]],
+            route=self.route_candidates[cur[4]],
+            accum=self.accum_candidates[cur[5]],
+            remat=self.remat_candidates[cur[6]],
+            shard=bool(cur[7]))
 
     @property
     def done(self) -> bool:
@@ -304,21 +428,27 @@ class Autotuner:
 
     def feed_quint(self, nbytes: float,
                    seconds: float) -> Tuple[int, bool, bool, str, str]:
-        """Like feed() but returns the full (threshold, hierarchical,
-        overlap, compression, route) point under ONE lock
-        acquisition."""
+        """Like feed() but returns the historical 5-axis (threshold,
+        hierarchical, overlap, compression, route) point under ONE
+        lock acquisition."""
+        return tuple(self.feed_full(nbytes, seconds)[:5])
+
+    def feed_full(self, nbytes: float, seconds: float) -> TunedPoint:
+        """Atomic record + (if a sample completed) suggest, returning
+        the FULL 8-axis :class:`TunedPoint` under one lock acquisition
+        — the call AutotunedStepper uses."""
         with self._tlock:
             self.record(nbytes, seconds)
             if self.ready():
-                self.suggest()
-            return (self._cur[0], bool(self._cur[1]), bool(self._cur[2]),
-                    self.compression_candidates[self._cur[3]],
-                    self.route_candidates[self._cur[4]])
+                self._suggest_locked()
+            return self._point_of(self._cur)
 
     def _config_label(self, point: Tuple[int, ...]) -> str:
         return (f"{point[0]}|{int(point[1])}|{int(point[2])}"
                 f"|{self.compression_candidates[point[3]]}"
-                f"|{self.route_candidates[point[4]]}")
+                f"|{self.route_candidates[point[4]]}"
+                f"|{self.accum_candidates[point[5]]}"
+                f"|{self.remat_candidates[point[6]]}|{int(point[7])}")
 
     def _publish_metrics(self) -> None:
         """Mirror the live point into the metrics registry (called with
@@ -328,6 +458,9 @@ class Autotuner:
         _M_OVERLAP.set(self._cur[2])
         _M_COMP_IDX.set(self._cur[3])
         _M_ROUTE_IDX.set(self._cur[4])
+        _M_ACCUM.set(self.accum_candidates[self._cur[5]])
+        _M_REMAT_IDX.set(self._cur[6])
+        _M_SHARD.set(self._cur[7])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
     def _row(self, point: Tuple[int, ...]) -> List:
@@ -343,6 +476,12 @@ class Autotuner:
             row.append(self.compression_candidates[point[3]])
         if self.tune_route:
             row.append(self.route_candidates[point[4]])
+        if self.tune_accum:
+            row.append(self.accum_candidates[point[5]])
+        if self.tune_remat:
+            row.append(self.remat_candidates[point[6]])
+        if self.tune_shard:
+            row.append(point[7])
         return row
 
     def _log(self, point: Tuple[int, ...], score: float) -> None:
@@ -360,15 +499,45 @@ class Autotuner:
         with self._tlock:
             return self._suggest_locked()
 
-    @staticmethod
-    def _features(point: Tuple[int, ...]) -> List[float]:
+    def _features(self, point: Tuple[int, ...]) -> List[float]:
         # log2(threshold) spans ~20-28; scale the binary toggles (and the
-        # categorical compression/route indices) so the RBF kernel treats
-        # "other branch" as a real distance.
+        # categorical compression/route/remat indices) so the RBF kernel
+        # treats "other branch" as a real distance. Accumulation enters
+        # as log2(k) — neighboring microbatch counts genuinely are
+        # neighboring configurations.
         return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2],
-                2.0 * point[3], 2.0 * point[4]]
+                2.0 * point[3], 2.0 * point[4],
+                math.log2(max(self.accum_candidates[point[5]], 1)),
+                2.0 * point[6], 2.0 * point[7]]
+
+    def _maybe_prune_accum(self) -> None:
+        """One-shot accumulation-space pruning, decided at the FIRST
+        sample boundary (by then the StepTimer phase histograms have
+        real step evidence): when the gate says the step is
+        compute-bound, accum>1 candidates are dropped — already-sampled
+        points stay (their scores are evidence, and re-adding them to
+        the GP costs nothing)."""
+        if self._accum_pruned or not self.tune_accum:
+            return
+        self._accum_pruned = True
+        gate = self.accum_gate if self.accum_gate is not None \
+            else _phase_bound_accum_gate
+        try:
+            allowed = bool(gate())
+        except Exception:  # noqa: BLE001 — a broken gate must not
+            allowed = True  # wedge tuning; explore instead
+        if allowed:
+            return
+        before = len(self._space)
+        self._space = [p for p in self._space
+                       if p[5] == 0 or p in self._samples]
+        logger.info(
+            "autotune: step is compute-bound (StepTimer phases) — "
+            "pruned %d accumulation candidates from the search space",
+            before - len(self._space))
 
     def _suggest_locked(self) -> int:
+        self._maybe_prune_accum()
         score = self._bytes / max(self._secs, 1e-9)
         self._samples.setdefault(self._cur, []).append(score)
         _M_SAMPLES.labels(config=self._config_label(self._cur)).inc()
@@ -421,7 +590,13 @@ class Autotuner:
                        % self.compression_candidates[best[3]]
                        if self.tune_compression else "")
                     + (", route=%s" % self.route_candidates[best[4]]
-                       if self.tune_route else ""),
+                       if self.tune_route else "")
+                    + (", accum=%d" % self.accum_candidates[best[5]]
+                       if self.tune_accum else "")
+                    + (", remat=%s" % self.remat_candidates[best[6]]
+                       if self.tune_remat else "")
+                    + (", shard_update=%s" % bool(best[7])
+                       if self.tune_shard else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

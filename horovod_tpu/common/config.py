@@ -141,6 +141,28 @@ class Config:
     # assign_wire_dtypes — the per-bucket overhead of quantize/dequant +
     # scales only amortizes on large buckets).
     quantize_min_bucket_bytes: int = 64 * 1024
+    # Scan-based gradient accumulation (docs/performance.md "MFU
+    # playbook"): default microbatch count for the accumulate()
+    # surfaces — hvd.accumulate_gradients and the accum_steps= knob on
+    # DistributedOptimizer/ShardedOptimizer. 1 = off. One collective
+    # round, one guard agreement, and one error-feedback advance per
+    # EFFECTIVE (post-accumulation) step.
+    accum_steps: int = 1
+    # Remat policy for the microbatch loss under accumulation — maps to
+    # jax.checkpoint policies: "none" | "full" (recompute everything) |
+    # "dots" (save matmul outputs) | "dots_no_batch" (save only
+    # non-batch-dim matmuls — the TPU-recommended default for
+    # transformers).
+    remat_policy: Optional[str] = None
+    # Device-infeed mode default for the data pipeline helpers and the
+    # bench --prefetch arm: "off" (place each batch on demand, blocked)
+    # | "single" (one batch staged ahead on the consumer thread) |
+    # "double" (background-thread double-buffered DeviceInfeed).
+    prefetch: Optional[str] = None
+    # Weight-update sharding heuristic (hvd.should_shard_update): when
+    # the replicated params are at least this many bytes and the world
+    # has >1 rank, ZeRO-1's sharded update is the default candidate.
+    auto_shard_threshold_bytes: int = 256 * _MB
     # Elastic mode (reference: HOROVOD_ELASTIC).
     elastic: bool = False
     # Telemetry-driven autoscaling (docs/autoscale.md — no reference
@@ -225,6 +247,11 @@ class Config:
         c.compression = _env("COMPRESSION")
         c.quantize_min_bucket_bytes = _env_int(
             "QUANTIZE_MIN_BYTES", cls.quantize_min_bucket_bytes)
+        c.accum_steps = _env_int("ACCUM_STEPS", cls.accum_steps)
+        c.remat_policy = _env("REMAT_POLICY")
+        c.prefetch = _env("PREFETCH")
+        c.auto_shard_threshold_bytes = _env_int(
+            "AUTO_SHARD_THRESHOLD", cls.auto_shard_threshold_bytes)
         c.elastic = _env_bool("ELASTIC", False)
         c.autoscale = _env_bool("AUTOSCALE", False)
         c.autoscale_policy = _env("AUTOSCALE_POLICY")

@@ -316,6 +316,51 @@ class _AggState(NamedTuple):
     counter: jnp.ndarray
 
 
+try:
+    # The class needs the optax base at definition time; the rest of
+    # this module must keep importing without optax installed.
+    import optax as _optax
+except Exception:  # pragma: no cover — exercised only without optax
+    _optax = None
+
+
+if _optax is not None:
+
+    class AccumGradientTransformation(_optax.GradientTransformation):
+        """The optax pair plus the scan-based accumulation driver the
+        factory bound it to (docs/performance.md):
+        ``accumulate(loss_fn, has_aux=False)`` returns the microbatched
+        ``value_and_grad`` for the bound
+        ``accum_steps``/``remat_policy`` — feed its gradients to
+        ``update`` ONCE per effective step, so the collective round,
+        non-finite guard agreement, and error-feedback advance all run
+        once per effective step by construction.
+
+        A module-level SUBCLASS of ``optax.GradientTransformation``
+        with defaulted extras (not a wider NamedTuple): the 2-tuple
+        shape, ``init, update = tx`` destructuring, isinstance checks,
+        pickle/copy, and pytree flatten/unflatten all keep working.
+        A pytree unflatten rebuilds ``cls(init, update)`` — the
+        accumulation config resets to the ``1``/``"none"`` defaults,
+        matching the pre-accumulation return type, which carried
+        none."""
+
+        def __new__(cls, init, update, accum_steps: int = 1,
+                    remat_policy: str = "none"):
+            self = super().__new__(cls, init, update)
+            self.accum_steps = accum_steps
+            self.remat_policy = remat_policy
+            return self
+
+        def accumulate(self, loss_fn: Callable, has_aux: bool = False):
+            return accumulate_gradients(loss_fn, self.accum_steps,
+                                        self.remat_policy,
+                                        has_aux=has_aux)
+
+else:  # pragma: no cover — optax-less installs have no optax surface
+    AccumGradientTransformation = None
+
+
 class _GuardedState(NamedTuple):
     """Optimizer-state wrapper carried when a non-finite policy is
     active (docs/integrity.md): the wrapped surface's state (possibly
@@ -485,6 +530,223 @@ def _resolve_fusion_threshold(explicit: Optional[int]) -> int:
     return 64 * 1024 * 1024
 
 
+# -- scan-based gradient accumulation (accum_steps=) -------------------------
+#
+# The MFU lever for batch-starved and memory-bound steps (ROADMAP item 2,
+# docs/performance.md "MFU playbook"): instead of paying one dispatch +
+# one traced cond per microbatch (the reference-style
+# ``backward_passes_per_step`` aggregation above), ONE jitted step scans
+# the loss/grad over k microbatches, carrying an fp32 gradient
+# accumulator, and pays the collective round, the non-finite guard
+# agreement, and the error-feedback state advance exactly once per
+# EFFECTIVE step. Activation memory peaks at one microbatch (1/k of the
+# fused batch), which is what lets remat + bigger per-chip batches trade
+# against each other.
+
+_REMAT_POLICY_NAMES = ("none", "full", "dots", "dots_no_batch")
+
+
+def resolve_remat_policy(policy: Optional[str] = None):
+    """Resolve a remat-policy name to ``(name, wrap, jax_policy)``.
+
+    ``None`` consults the configured default (``HVD_TPU_REMAT_POLICY``
+    / ``init(remat_policy=)``). Names map to ``jax.checkpoint``
+    policies: ``"none"`` = no remat; ``"full"`` = recompute everything
+    in backward (``jax.checkpoint`` default); ``"dots"`` = save matmul
+    outputs, recompute elementwise (``dots_saveable``);
+    ``"dots_no_batch"`` = save only non-batch-dim matmuls
+    (``dots_with_no_batch_dims_saveable`` — the TPU-recommended policy
+    for transformer blocks)."""
+    if policy is None:
+        from .common import basics
+
+        if basics.is_initialized():
+            policy = basics.context().config.remat_policy
+        else:
+            from .common.config import _env
+
+            policy = _env("REMAT_POLICY")
+    if policy is None or policy in ("none", "off", ""):
+        return "none", False, None
+    if policy == "full":
+        return "full", True, None
+    cp = jax.checkpoint_policies
+    if policy == "dots":
+        return "dots", True, cp.dots_saveable
+    if policy == "dots_no_batch":
+        return "dots_no_batch", True, cp.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"unknown remat policy {policy!r}; choose from "
+        f"{_REMAT_POLICY_NAMES}")
+
+
+def _resolve_accum_steps(explicit: Optional[int] = None) -> int:
+    """None → the configured default (``HVD_TPU_ACCUM_STEPS`` /
+    ``init(accum_steps=)``, falling back to 1); an explicit value always
+    wins."""
+    if explicit is not None:
+        k = int(explicit)
+    else:
+        from .common import basics
+
+        if basics.is_initialized():
+            k = int(basics.context().config.accum_steps)
+        else:
+            from .common.config import _env_int
+
+            k = _env_int("ACCUM_STEPS", 1)
+    if k < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {k}")
+    return k
+
+
+def _split_microbatches(batch_args, k: int):
+    """Each array leaf of the batch pytrees gains a leading microbatch
+    axis: ``(b, ...) -> (k, b//k, ...)``. Raises (naming the leaf shape)
+    when a leading dim does not divide."""
+    def one(x):
+        x = jnp.asarray(x)
+        if x.ndim == 0 or x.shape[0] % k:
+            raise ValueError(
+                f"accum_steps={k} does not divide the leading batch dim "
+                f"of a batch leaf with shape {jnp.shape(x)}; every batch "
+                "array must carry b = k * microbatch rows")
+        return x.reshape((k, x.shape[0] // k) + x.shape[1:])
+
+    return jax.tree.map(one, batch_args)
+
+
+def accumulate_gradients(loss_fn: Callable,
+                         accum_steps: Optional[int] = None,
+                         remat_policy: Optional[str] = None,
+                         has_aux: bool = False):
+    """Scan-based gradient accumulation: wrap a LOSS function into a
+    microbatched ``value_and_grad``.
+
+    Returns ``fn(params, *batch) -> (value, grads)`` (or
+    ``((value, aux), grads)`` with ``has_aux``): the batch args are
+    split into ``accum_steps`` microbatches along their leading dim and
+    a ``lax.scan`` runs ``jax.value_and_grad(loss_fn)`` per microbatch,
+    accumulating gradients (and the loss) in fp32 — activation memory
+    peaks at ONE microbatch instead of the fused batch. The returned
+    gradients are the MEAN over microbatches, so a loss that is a mean
+    over its batch rows yields gradients equivalent to the fused large
+    batch (the accumulation-equivalence contract, tests/test_accum.py).
+
+    ``remat_policy`` wraps the microbatch loss in ``jax.checkpoint``
+    (:func:`resolve_remat_policy` names), trading recompute for a
+    further activation-memory cut INSIDE each microbatch — the two
+    levers tune jointly (docs/performance.md).
+
+    Float ``aux`` leaves are averaged across microbatches (e.g. batch
+    stats); integer leaves keep the LAST microbatch's value. There are
+    no collectives in here: reduce the returned gradients once per
+    effective step (DistributedOptimizer/DistributedGradFn compose this
+    for you via their own ``accum_steps=``)."""
+    k = _resolve_accum_steps(accum_steps)
+    _, wrap, jax_policy = resolve_remat_policy(remat_policy)
+    inner = jax.checkpoint(loss_fn, policy=jax_policy) if wrap else loss_fn
+    vgrad = jax.value_and_grad(inner, has_aux=has_aux)
+    if k == 1:
+        return vgrad
+
+    def accum_fn(params, *batch):
+        mbs = _split_microbatches(batch, k)
+        mb0 = jax.tree.map(lambda x: x[0], mbs)
+        # Every microbatch runs through the SAME compiled scan body —
+        # unrolling the first iteration would let XLA compile it
+        # differently, and ulp-level drift between "identical"
+        # microbatches breaks the bitwise state-transition contract
+        # (tests/test_accum.py). eval_shape gives the accumulator
+        # structure without spending a FLOP.
+        shapes = jax.eval_shape(vgrad, params, *mb0)
+        out_s, g_s = shapes
+        v_s, aux_s = out_s if has_aux else (out_s, None)
+
+        def zeros_acc(t):
+            return jax.tree.map(
+                lambda s: jnp.zeros(
+                    s.shape, jnp.float32
+                    if jnp.issubdtype(s.dtype, jnp.floating)
+                    else s.dtype), t)
+
+        def acc_add(acc, new):
+            return jax.tree.map(
+                lambda a, x: a + x.astype(jnp.float32)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else x,  # non-float aux: keep the latest microbatch's
+                acc, new)
+
+        carry0 = (zeros_acc(g_s), jnp.zeros((), jnp.float32),
+                  zeros_acc(aux_s))
+
+        def body(carry, mb):
+            g_acc, v_acc, aux_acc = carry
+            out, g = vgrad(params, *mb)
+            v, aux = out if has_aux else (out, None)
+            return (acc_add(g_acc, g), v_acc + v.astype(jnp.float32),
+                    acc_add(aux_acc, aux)), None
+
+        (g_acc, v_acc, aux_acc), _ = jax.lax.scan(body, carry0, mbs)
+
+        def mean_like(acc, template):
+            return jax.tree.map(
+                lambda a, s: (a / k).astype(s.dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, acc, template)
+
+        grads = mean_like(g_acc, g_s)
+        value = (v_acc / k).astype(v_s.dtype)
+        if has_aux:
+            return (value, mean_like(aux_acc, aux_s)), grads
+        return value, grads
+
+    return accum_fn
+
+
+def auto_shard_threshold(explicit: Optional[int] = None) -> int:
+    """The weight-update-sharding threshold in bytes
+    (``HVD_TPU_AUTO_SHARD_THRESHOLD`` / ``init(auto_shard_threshold_
+    bytes=)``, default 256 MiB): replicated params at least this large
+    make ZeRO-1's sharded update the default candidate."""
+    if explicit is not None:
+        return int(explicit)
+    from .common import basics
+
+    if basics.is_initialized():
+        return int(basics.context().config.auto_shard_threshold_bytes)
+    from .common.config import Config, _env_int
+
+    return _env_int("AUTO_SHARD_THRESHOLD",
+                    Config.auto_shard_threshold_bytes)
+
+
+def should_shard_update(params, size: Optional[int] = None,
+                        threshold_bytes: Optional[int] = None) -> bool:
+    """Heuristic (arXiv:1909.09756, docs/performance.md): True when
+    weight-update sharding (ZeRO-1, :class:`ShardedOptimizer`) should
+    be the default candidate for this model — the world has more than
+    one rank and the replicated params are at least
+    :func:`auto_shard_threshold` bytes (the regime where the replicated
+    optimizer state + update compute dominate the RS+AG latency the
+    sharded path adds). Accepts real arrays or ShapeDtypeStructs."""
+    if size is None:
+        from .common import basics
+
+        size = basics.context().size() if basics.is_initialized() else 1
+    if size <= 1:
+        return False
+    import numpy as np
+
+    nbytes = 0
+    for leaf in jax.tree.leaves(params):
+        shape = getattr(leaf, "shape", ())
+        dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+            else leaf.dtype
+        nbytes += int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+    return nbytes >= auto_shard_threshold(threshold_bytes)
+
+
 def DistributedOptimizer(optimizer,
                          op: C.ReduceOp = C.ReduceOp.AVERAGE,
                          axis_name: str = "hvd",
@@ -502,7 +764,9 @@ def DistributedOptimizer(optimizer,
                          bucket_order=None,
                          quantize_min_bucket_bytes: Optional[int] = None,
                          nonfinite_policy: Optional[str] = None,
-                         route=None):
+                         route=None,
+                         accum_steps: Optional[int] = None,
+                         remat_policy: Optional[str] = None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -513,7 +777,33 @@ def DistributedOptimizer(optimizer,
     ``backward_passes_per_step`` accumulates k local microbatch gradients
     before one fused allreduce + inner update (reference
     gradient_aggregation.py semantics: allreduce every k-th call, identity
-    updates in between).
+    updates in between). Prefer ``accum_steps`` (below) for new code —
+    the scan-based form pays one dispatch per EFFECTIVE step instead of
+    one per microbatch.
+
+    ``accum_steps`` (None → ``HVD_TPU_ACCUM_STEPS`` /
+    ``init(accum_steps=)``) + ``remat_policy`` select SCAN-BASED
+    gradient accumulation (docs/performance.md "MFU playbook"): the
+    returned transformation carries an ``accumulate(loss_fn,
+    has_aux=False)`` driver (:func:`accumulate_gradients` bound to the
+    pinned knobs) that microbatches the loss under ``lax.scan`` —
+    activation memory peaks at 1/k of the fused batch, and
+    ``remat_policy`` ("full"/"dots"/"dots_no_batch") further remats
+    inside each microbatch via ``jax.checkpoint``. Feed its MEAN
+    gradient to ``update()`` once per effective step::
+
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3), accum_steps=4,
+                                      remat_policy="dots_no_batch")
+        vgrad = tx.accumulate(loss_fn)         # scans 4 microbatches
+        loss, grads = vgrad(params, batch)     # batch rows = 4 * mb
+        updates, state = tx.update(grads, state, params)
+
+    The collective round, the non-finite guard agreement, and the
+    int8_ef error-feedback/stochastic-rounding advance then all run
+    exactly ONCE per effective step by construction — accumulation
+    composes with ``overlap``/``compression``/``route``/
+    ``nonfinite_policy`` unchanged. Mutually exclusive with the legacy
+    ``backward_passes_per_step`` aggregation.
 
     ``quantized_cross`` (requires ``hierarchical``) carries the DCN hop
     of each fused bucket as block-scaled int8 — the EQuARX-style
@@ -622,6 +912,16 @@ def DistributedOptimizer(optimizer,
         hierarchical = quantized_cross = False
 
     k = int(backward_passes_per_step)
+    accum_k = _resolve_accum_steps(accum_steps)
+    # Resolve (and validate) the remat policy ONCE at factory time — a
+    # later env-knob change must not re-shape the accumulate driver.
+    remat_name, _, _ = resolve_remat_policy(remat_policy)
+    if accum_k > 1 and k > 1:
+        raise ValueError(
+            "accum_steps and backward_passes_per_step are two spellings "
+            "of gradient accumulation — pick one (accum_steps is the "
+            "scan-based form; backward_passes_per_step the legacy "
+            "call-per-microbatch aggregation)")
     fusion_threshold_bytes = _resolve_fusion_threshold(fusion_threshold_bytes)
     quantize_min_bucket_bytes = _resolve_quantize_min_bytes(
         quantize_min_bucket_bytes)
@@ -693,8 +993,12 @@ def DistributedOptimizer(optimizer,
                 _guard_axes(), scale_cfg)
             return updates, _GuardedState(new_inner, new_guard)
 
+    def _finish(init_f, update_f):
+        return AccumGradientTransformation(
+            init_f, update_f, accum_k, remat_name)
+
     if k <= 1:
-        return optax.GradientTransformation(u_init, u_update)
+        return _finish(u_init, u_update)
 
     def init_fn(params):
         acc = jax.tree.map(jnp.zeros_like, params)
@@ -726,7 +1030,7 @@ def DistributedOptimizer(optimizer,
         new_counter = jnp.where(do_step, 0, counter)
         return updates, _AggState(new_inner, new_acc, new_counter)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    return _finish(init_fn, update_fn)
 
 
 def DistributedGradFn(grad_fn: Callable,
@@ -740,7 +1044,9 @@ def DistributedGradFn(grad_fn: Callable,
                       bucket_order=None,
                       quantize_min_bucket_bytes: Optional[int] = None,
                       nonfinite_policy: Optional[str] = None,
-                      route=None):
+                      route=None,
+                      accum_steps: Optional[int] = None,
+                      remat_policy: Optional[str] = None):
     """DistributedGradientTape analog (reference
     tensorflow/__init__.py:564-629): wraps a function returning gradients
     (e.g. ``jax.grad(loss)``) so the result is allreduced across ranks.
@@ -754,6 +1060,26 @@ def DistributedGradFn(grad_fn: Callable,
     ``overlap``/``bucket_order``: readiness-ordered buckets + issue-order
     chaining, as on :func:`DistributedOptimizer` — scheduling only,
     identical numerics.
+
+    ``accum_steps`` (EXPLICIT-ONLY on this surface: it changes how the
+    first argument is interpreted, so the ``HVD_TPU_ACCUM_STEPS`` env
+    default is deliberately not consulted) selects SCAN-BASED gradient
+    accumulation: pass the LOSS function instead of ``jax.grad(loss)``
+    — the wrapper owns the grad computation (it must: the microbatch
+    scan and the ``remat_policy`` ``jax.checkpoint`` wrap live between
+    loss and gradients, :func:`accumulate_gradients`)::
+
+        gfn = hvd.DistributedGradFn(loss_fn, accum_steps=4,
+                                    remat_policy="dots", has_value=True)
+        (loss, grads) = gfn(params, batch)   # batch rows = 4 * mb
+
+    The batch args are split into k microbatches along their leading
+    dim, gradients accumulate in fp32 under ``lax.scan``, and the
+    REDUCTION (with overlap / int8_ef error feedback / route / the
+    non-finite guard agreement) runs exactly once on the accumulated
+    mean — one collective round and one guard agreement per effective
+    step. ``has_value=False`` simply drops the (already computed) loss
+    from the returns.
 
     With an error-feedback compression (``"int8_ef"``) the wrapper is
     STATEFUL in the functional style: the wrapped function grows an
@@ -786,6 +1112,24 @@ def DistributedGradFn(grad_fn: Callable,
     _check_reduce_safe(compression)
     ef = getattr(compression, "error_feedback", False)
     route = _resolve_route(route)
+    accum_k = int(accum_steps) if accum_steps is not None else 1
+    if accum_k > 1:
+        # grad_fn is the LOSS here; the scan driver produces
+        # (value, grads) — has_value only controls the caller-visible
+        # return arity below.
+        grad_fn = accumulate_gradients(grad_fn, accum_k, remat_policy)
+        produces_value = True
+    else:
+        if accum_k < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_k}")
+        if remat_policy is not None:
+            raise ValueError(
+                "remat_policy on DistributedGradFn requires "
+                "accum_steps > 1 — remat wraps the LOSS before "
+                "value_and_grad, which this surface only owns under "
+                "the microbatch scan (use jax.checkpoint on your loss "
+                "directly otherwise)")
+        produces_value = has_value
     if ef and op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE,
                          C.ReduceOp.ADASUM):
         raise ValueError(
@@ -832,7 +1176,7 @@ def DistributedGradFn(grad_fn: Callable,
     if ef:
         def wrapped(*args, ef_state=None, guard_state=None, **kwargs):
             out = grad_fn(*args, **kwargs)
-            val, grads = out if has_value else (None, out)
+            val, grads = out if produces_value else (None, out)
             if ef_state is None:
                 residual = _zeros_residual(grads)
                 step = jnp.zeros((), jnp.int32)
@@ -881,7 +1225,7 @@ def DistributedGradFn(grad_fn: Callable,
 
     def wrapped(*args, guard_state=None, **kwargs):
         out = grad_fn(*args, **kwargs)
-        if has_value:
+        if produces_value:
             val, grads = out
         else:
             val, grads = None, out
@@ -965,6 +1309,15 @@ class AutotunedStepper:
         self._joint_overlap = getattr(tuner, "tune_overlap", False)
         self._joint_comp = getattr(tuner, "tune_compression", False)
         self._joint_route = getattr(tuner, "tune_route", False)
+        # MFU dimensions (docs/performance.md): accumulation microbatch
+        # count, remat policy, weight-update sharding. When ANY of them
+        # is tuned, build_step receives the whole
+        # :class:`~.common.autotune.TunedPoint` instead of the
+        # positional cascade — eight positional args would be
+        # unreadable at every call site.
+        self._joint_accum = getattr(tuner, "tune_accum", False)
+        self._joint_remat = getattr(tuner, "tune_remat", False)
+        self._joint_shard = getattr(tuner, "tune_shard", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
         self._ovl = (tuner.current_overlap if self._joint_overlap
                      else False)
@@ -972,11 +1325,28 @@ class AutotunedStepper:
                       else "none")
         self._route = (tuner.current_route if self._joint_route
                        else "flat")
+        self._accum = (tuner.current_accum if self._joint_accum else 1)
+        self._remat = (tuner.current_remat if self._joint_remat
+                       else "none")
+        self._shard = (tuner.current_shard if self._joint_shard
+                       else False)
         self._step = self._rebuild()
         self.rebuilds = 0
         self._step_count = 0  # metrics/profiler step numbering
 
+    @property
+    def _mfu_joint(self) -> bool:
+        return self._joint_accum or self._joint_remat or self._joint_shard
+
     def _rebuild(self):
+        if self._mfu_joint:
+            from .common.autotune import TunedPoint
+
+            return self._build(TunedPoint(
+                threshold=self._threshold, hierarchical=self._hier,
+                overlap=self._ovl, compression=self._comp,
+                route=self._route, accum=self._accum, remat=self._remat,
+                shard=self._shard))
         if self._joint_route:
             return self._build(self._threshold, self._hier, self._ovl,
                                self._comp, self._route)
@@ -1009,6 +1379,18 @@ class AutotunedStepper:
     def route(self) -> str:
         return self._route
 
+    @property
+    def accum(self) -> int:
+        return self._accum
+
+    @property
+    def remat(self) -> str:
+        return self._remat
+
+    @property
+    def shard(self) -> bool:
+        return self._shard
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -1025,19 +1407,22 @@ class AutotunedStepper:
             _M_STEP.observe(dt)
         c = self._controller
         if c is None or c.size == 1:
-            new, tuner_h, tuner_o, tuner_c, tuner_r = \
-                self.tuner.feed_quint(self.grad_bytes, dt)
-            new_h = tuner_h if self._joint else self._hier
-            new_o = tuner_o if self._joint_overlap else self._ovl
-            new_c = tuner_c if self._joint_comp else self._comp
-            new_r = tuner_r if self._joint_route else self._route
+            pt = self.tuner.feed_full(self.grad_bytes, dt)
+            new = pt.threshold
+            new_h = pt.hierarchical if self._joint else self._hier
+            new_o = pt.overlap if self._joint_overlap else self._ovl
+            new_c = pt.compression if self._joint_comp else self._comp
+            new_r = pt.route if self._joint_route else self._route
+            new_a = pt.accum if self._joint_accum else self._accum
+            new_m = pt.remat if self._joint_remat else self._remat
+            new_s = pt.shard if self._joint_shard else self._shard
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new, new_h, new_o, new_c, new_r = (
+            new, new_h, new_o, new_c, new_r, new_a, new_m, new_s = (
                 self._threshold, self._hier, self._ovl, self._comp,
-                self._route)
+                self._route, self._accum, self._remat, self._shard)
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -1045,12 +1430,15 @@ class AutotunedStepper:
                 # no point paying a KV round per period forever.
                 if c.rank == 0 and self.tuner.ready():
                     self.tuner.suggest()
-                cur_t, cur_h, cur_o, cur_c, cur_r = \
-                    self.tuner.current_quint  # atomic
-                mine = (f"{cur_t}|{int(cur_h) if self._joint else 0}"
-                        f"|{int(cur_o) if self._joint_overlap else 0}"
-                        f"|{cur_c if self._joint_comp else 'none'}"
-                        f"|{cur_r if self._joint_route else 'flat'}"
+                cur = self.tuner.current_full  # atomic
+                mine = (f"{cur.threshold}"
+                        f"|{int(cur.hierarchical) if self._joint else 0}"
+                        f"|{int(cur.overlap) if self._joint_overlap else 0}"
+                        f"|{cur.compression if self._joint_comp else 'none'}"
+                        f"|{cur.route if self._joint_route else 'flat'}"
+                        f"|{cur.accum if self._joint_accum else 1}"
+                        f"|{cur.remat if self._joint_remat else 'none'}"
+                        f"|{int(cur.shard) if self._joint_shard else 0}"
                         + (":done" if c.rank == 0 and self.tuner.done
                            else ""))
                 vals = c.exchange("autotune_threshold", mine)
@@ -1058,18 +1446,26 @@ class AutotunedStepper:
                 if v0.endswith(":done"):
                     self._tuner_done = True
                     v0 = v0[:-5]
-                t_str, h_str, o_str, c_str, r_str = v0.split("|")
+                (t_str, h_str, o_str, c_str, r_str, a_str, m_str,
+                 s_str) = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
                 new_o = bool(int(o_str)) if self._joint_overlap \
                     else self._ovl
                 new_c = c_str if self._joint_comp else self._comp
                 new_r = r_str if self._joint_route else self._route
+                new_a = int(a_str) if self._joint_accum else self._accum
+                new_m = m_str if self._joint_remat else self._remat
+                new_s = bool(int(s_str)) if self._joint_shard \
+                    else self._shard
         if (new != self._threshold or new_h != self._hier
                 or new_o != self._ovl or new_c != self._comp
-                or new_r != self._route):
-            self._threshold, self._hier, self._ovl, self._comp, \
-                self._route = new, new_h, new_o, new_c, new_r
+                or new_r != self._route or new_a != self._accum
+                or new_m != self._remat or new_s != self._shard):
+            (self._threshold, self._hier, self._ovl, self._comp,
+             self._route, self._accum, self._remat,
+             self._shard) = (new, new_h, new_o, new_c, new_r, new_a,
+                             new_m, new_s)
             self._step = self._rebuild()
             self.rebuilds += 1
             _M_REBUILDS.inc()
@@ -1493,10 +1889,17 @@ class ShardedOptimizer:
                  grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
                  fusion_threshold_bytes: Optional[int] = None,
                  compression=None, nonfinite_policy: Optional[str] = None,
-                 route=None):
+                 route=None, accum_steps: Optional[int] = None,
+                 remat_policy: Optional[str] = None):
         self.inner = inner
         self.axis_name = axis_name
         self.grad_op = grad_op
+        # Scan-based accumulation (docs/performance.md): pinned once
+        # like the threshold; consumed by accumulate() — update() runs
+        # once per EFFECTIVE step either way, so the RS+AG pair, the
+        # guard agreement, and the EF advance stay once-per-step.
+        self.accum_steps = _resolve_accum_steps(accum_steps)
+        self.remat_policy = resolve_remat_policy(remat_policy)[0]
         # Pinned ONCE (like the DistributedOptimizer factory): the state
         # layout is one shard per bucket, so a live autotuner moving the
         # threshold between traces must not replan the buckets out from
@@ -1522,6 +1925,14 @@ class ShardedOptimizer:
         applied (a defaulted route under the flat mesh must not change
         the shard grid — same contract as the reduction surfaces)."""
         return _sharded_route(self.route, self.axis_name)
+
+    def accumulate(self, loss_fn, has_aux: bool = False):
+        """The scan-based microbatch ``value_and_grad`` for the pinned
+        ``accum_steps``/``remat_policy`` (:func:`accumulate_gradients`)
+        — feed its mean gradient to :meth:`update` once per effective
+        step."""
+        return accumulate_gradients(loss_fn, self.accum_steps,
+                                    self.remat_policy, has_aux=has_aux)
 
     def init(self, params):
         return sharded_init(self.inner, params, self.axis_name,

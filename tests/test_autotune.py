@@ -351,3 +351,138 @@ def test_stepper_joint_compression_rebuilds():
     comps = {c for _, _, _, c in seen}
     assert len(comps) >= 2, seen  # the compression axis was explored
     assert stepper.compression in ("none", "bf16", "int8_ef")
+
+
+# -- the MFU dimensions: accum / remat / shard (docs/performance.md §4c) -----
+
+def test_autotuner_mfu_dimensions_space():
+    """tune_accum/tune_remat/tune_shard widen the space to the full
+    product, and the point accessors expose the new axes."""
+    t = Autotuner(candidates_bytes=[1024, 2048], warmup_samples=0,
+                  steps_per_sample=1, tune_accum=True,
+                  accum_candidates=(1, 2, 4), tune_remat=True,
+                  remat_candidates=("none", "dots"), tune_shard=True,
+                  accum_gate=lambda: True)
+    assert len(t._space) == 2 * 3 * 2 * 2
+    pt = t.current_full
+    assert pt.accum in (1, 2, 4)
+    assert pt.remat in ("none", "dots")
+    assert isinstance(pt.shard, bool)
+    # Historical accessors unchanged by the widening.
+    assert t.current in (1024, 2048)
+    assert t.current_quint[0] in (1024, 2048)
+
+
+def test_autotuner_accum_pruned_when_compute_bound():
+    """A False accum gate (= compute-bound step) drops the unsampled
+    accum>1 candidates at the first sample boundary; a True gate keeps
+    the full space (the default gate with no phase evidence is True)."""
+    for allowed, expect_pruned in ((False, True), (True, False)):
+        t = Autotuner(candidates_bytes=[1024], warmup_samples=0,
+                      steps_per_sample=1, tune_accum=True,
+                      accum_candidates=(1, 2, 4),
+                      accum_gate=lambda: allowed)
+        before = len(t._space)
+        t.feed_full(100.0, 1.0)  # first sample boundary → gate runs
+        untried_accum = [p for p in t._space
+                         if p[5] > 0 and p not in t._samples]
+        if expect_pruned:
+            assert not untried_accum, t._space
+            assert len(t._space) < before
+        else:
+            assert untried_accum
+
+
+def test_autotuner_default_accum_gate_no_evidence():
+    """Without StepTimer phase samples the default gate must EXPLORE
+    (memory pressure is invisible here — never prune blind)."""
+    from horovod_tpu.common.autotune import _phase_bound_accum_gate
+
+    assert _phase_bound_accum_gate() is True
+
+
+def test_autotuner_mfu_csv_columns(tmp_path):
+    log = str(tmp_path / "mfu.csv")
+    t = Autotuner(candidates_bytes=[1024], warmup_samples=0,
+                  steps_per_sample=1, log_file=log, tune_accum=True,
+                  tune_remat=True, tune_shard=True,
+                  accum_gate=lambda: True)
+    t.record(100.0, 1.0)
+    t.suggest()
+    lines = open(log).read().strip().splitlines()
+    assert lines[0] == ("unix_time,threshold_bytes,accum,remat,shard,"
+                        "score_bytes_per_sec,steps")
+
+
+def test_stepper_mfu_rebuilds_on_tuned_point_and_is_bounded():
+    """With any MFU dimension tuned, build receives ONE TunedPoint; the
+    rebuild counter stays bounded by the number of distinct sampled
+    points (no rebuild storms — the acceptance bound)."""
+    from horovod_tpu.common.autotune import TunedPoint
+    from horovod_tpu.optim import AutotunedStepper
+
+    t = Autotuner(candidates_bytes=[1024], warmup_samples=0,
+                  steps_per_sample=1, tune_accum=True,
+                  accum_candidates=(1, 2), tune_shard=True,
+                  accum_gate=lambda: True)
+    seen = []
+
+    def build(point):
+        assert isinstance(point, TunedPoint)
+        seen.append(point)
+        return lambda x: x + 1
+
+    stepper = AutotunedStepper(build, grad_bytes=1000, tuner=t,
+                               block=False)
+    for i in range(16):
+        stepper(i)
+    assert stepper.rebuilds >= 1
+    assert {p.accum for p in seen} >= {1, 2}  # the accum axis explored
+    # Bound: a rebuild only ever happens on a point MOVE, and the tuner
+    # can move at most once per sample (steps_per_sample=1 here), never
+    # revisiting more points than the space holds before convergence.
+    assert stepper.rebuilds <= len(t._space) + len(t._samples)
+    assert stepper.accum in (1, 2)
+    assert isinstance(stepper.shard, bool)
+
+
+def test_stepper_mfu_multiprocess_sync_eight_fields():
+    """The rank-0-synced exchange carries the full 8-field point: both
+    ranks adopt identical TunedPoints at identical call indices."""
+    import threading
+
+    from horovod_tpu.common.autotune import TunedPoint
+    from horovod_tpu.common.controller import Controller, InMemoryTransport
+    from horovod_tpu.optim import AutotunedStepper
+
+    transport = InMemoryTransport()
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def run_rank(rank):
+        c = Controller(rank, 2, transport, timeout_s=10.0)
+        tuner = Autotuner(candidates_bytes=[1024, 2048],
+                          warmup_samples=0, steps_per_sample=2,
+                          tune_accum=True, accum_candidates=(1, 2),
+                          accum_gate=lambda: True)
+        points = []
+
+        def build(point):
+            assert isinstance(point, TunedPoint)
+            points.append(tuple(point))
+            return lambda x: x + 1
+
+        stepper = AutotunedStepper(build, grad_bytes=1000, tuner=tuner,
+                                   block=False, controller=c)
+        barrier.wait()
+        for i in range(8):
+            stepper(i)
+        results[rank] = points
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results[0] == results[1], results

@@ -112,6 +112,22 @@ def summarize_metrics(rec: dict) -> dict:
     fill = samples("hvd_tpu_fusion_fill_efficiency")
     if fill:
         out["fusion_fill_efficiency"] = fill[0]["value"]
+    # Infeed starvation (docs/performance.md MFU playbook): how long
+    # the step loop blocked on the next device batch. High infeed-wait
+    # with a low comm phase = input-bound — reach for the prefetch
+    # lever, not accumulation.
+    iw = next(iter(samples("hvd_tpu_infeed_wait_seconds")), None)
+    if iw and isinstance(iw.get("value"), dict) \
+            and iw["value"].get("count"):
+        v = iw["value"]
+        out["infeed_wait"] = {
+            "count": v["count"],
+            "mean_ms": round(1000.0 * v["sum"] / v["count"], 3),
+            "total_s": round(v["sum"], 3),
+        }
+    depth = samples("hvd_tpu_infeed_queue_depth")
+    if depth:
+        out["infeed_queue_depth"] = depth[0]["value"]
     rec_counts = {s["labels"].get("counter", "?"): int(s["value"])
                   for s in samples("hvd_tpu_recovery_total")
                   if s["value"]}

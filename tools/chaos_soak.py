@@ -25,8 +25,13 @@ leaked to disk breaks the invariant.
 ``--family integrity`` drives a guarded SPMD training run
 (docs/integrity.md) under the three DATA failure sites:
 
-* a **NaN-poisoned batch** (``nonfinite`` site) that the skip_step
-  non-finite guard must skip identically on every rank;
+* a **NaN-poisoned microbatch** (``nonfinite`` site) landing
+  MID-ACCUMULATION — the training step runs scan-based gradient
+  accumulation (``accum_steps=2``, docs/performance.md) and only the
+  first microbatch is poisoned, so the skip_step guard must skip the
+  whole effective step identically on every rank, discarding the
+  partially-accumulated gradient (params bitwise unchanged, inner/EF
+  state untouched);
 * a **silently diverged replica** (``diverge`` site) that the in-trace
   divergence detector must catch and resync from rank 0;
 * a **corrupted latest checkpoint** (``checkpoint_corrupt`` site) that
@@ -189,14 +194,24 @@ X = rng.standard_normal((n, 8, 16)).astype(np.float32)
 W = rng.standard_normal((16, 4)).astype(np.float32)
 Y = (X.reshape(-1, 16) @ W).reshape(n, 8, 4).astype(np.float32)
 p0 = {"w": jnp.zeros((16, 4), jnp.float32)}
+# Scan-based accumulation UNDER the guard (docs/performance.md): 2
+# microbatches per effective step — the NaN poison below lands in
+# microbatch 0 only, so the non-finite value reaches the guard through
+# the scan's partially-accumulated gradient, and a skip must discard
+# that accumulator coherently on every rank (inner state, EF residual,
+# and params untouched).
 tx = hvd.DistributedOptimizer(optax.sgd(0.05), axis_name=ax,
                               compression="int8_ef",
                               quantize_min_bucket_bytes=0,
-                              nonfinite_policy="skip_step")
+                              nonfinite_policy="skip_step",
+                              accum_steps=2)
 
 
 def loss_fn(p, xb, yb):
     return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+
+grad_fn = tx.accumulate(loss_fn)
 
 
 @hvd.spmd_step(in_specs=(P(ax), P(), P(ax), P(ax), P()),
@@ -207,7 +222,7 @@ def step(ps, s, xb, yb, i):
     # its gradients can contaminate the reduction.
     p, checked, div = integrity.divergence_guard(p, i, ax, every=3,
                                                  policy="resync")
-    l, g = jax.value_and_grad(loss_fn)(p, xb[0], yb[0])
+    l, g = grad_fn(p, xb[0], yb[0])
     u, s = tx.update(g, s, p)
     p = optax.apply_updates(p, u)
     return (jax.tree.map(lambda v: v[None], p), s,
@@ -219,11 +234,22 @@ mgr = ckpt_lib.CheckpointManager(os.path.join(workdir, "ckpt"),
 ps = {"w": jnp.broadcast_to(p0["w"][None], (n,) + p0["w"].shape)}
 s = tx.init(p0)
 loss = None
+skip_unchanged = None
 for i in range(TOTAL):
-    xb = integrity.chaos_poison(jnp.asarray(X))       # "nonfinite" site
+    # "nonfinite" site, MID-ACCUMULATION: only the first 4 rows — the
+    # first of the two scan microbatches — are poisoned.
+    xb = jnp.asarray(X)
+    xb = xb.at[:, :4].set(integrity.chaos_poison(xb[:, :4]))
     ps = integrity.chaos_perturb(ps)                  # "diverge" site
+    if i == 2:  # the plan's nonfinite step (1-based step 3)
+        w_pre = np.asarray(ps["w"]).copy()
     ps, s, loss, checked, div = step(ps, s, xb, jnp.asarray(Y),
                                      jnp.asarray(i, jnp.int32))
+    if i == 2:
+        # skip_step must leave params bitwise untouched on EVERY
+        # replica — the partially-accumulated gradient is discarded.
+        skip_unchanged = bool(
+            np.array_equal(np.asarray(ps["w"]), w_pre))
     integrity.record_divergence(checked, div, policy="resync")
     # "checkpoint_corrupt" site fires inside save() on the final step.
     mgr.save(i, {"w": np.asarray(ps["w"])[0], "step": i}, force=True)
@@ -242,6 +268,8 @@ result = {
     "restored_step": int(np.asarray(restored["step"])),
     "divergence_resyncs": stats["divergence_resyncs"],
     "checkpoint_corruptions": stats["checkpoint_corruptions"],
+    "accum_steps": 2,
+    "skip_left_params_unchanged": skip_unchanged,
 }
 with open(os.path.join(workdir, "result.json"), "w") as f:
     json.dump(result, f)
@@ -279,9 +307,13 @@ def run_integrity_soak(workdir: str, steps: int = 10, seed: int = 42,
     with open(os.path.join(workdir, "result.json")) as f:
         result = json.load(f)
     # (a) the NaN step was skipped (guard counted it, training finished
-    # finite on every replica)...
+    # finite on every replica) — and the NaN landed MID-ACCUMULATION
+    # (microbatch 0 of 2), so the skip proves the partially-accumulated
+    # gradient was discarded coherently: params bitwise unchanged on
+    # every rank across the poisoned effective step...
     assert result["nonfinite_steps"] >= 1, result
     assert result["final_finite"], result
+    assert result["skip_left_params_unchanged"], result
     # (b) ...the perturbed replica was detected and resynced...
     assert result["divergence_resyncs"] >= 1, result
     assert result["replicas_identical"], result

@@ -674,11 +674,88 @@ def mesh_routing_section():
     return out
 
 
+def infeed_section():
+    """Host→device input path (docs/performance.md MFU playbook):
+    (a) raw host→device bandwidth (``jax.device_put`` + completion
+    fetch) across transfer sizes, and (b) the consumer-visible wait per
+    batch for each infeed mode — blocking placement (off) vs one batch
+    staged ahead (single) vs the background double-buffered
+    ``hvd.DeviceInfeed`` (double) — under a producer with real host
+    cost. The double buffer's wait collapses toward zero whenever the
+    per-batch host cost fits inside the step; off pays it serially every
+    step. Wall-clock timing, recorded not asserted (CI boxes jitter)."""
+    import jax
+
+    from horovod_tpu import data as data_lib
+
+    out = {}
+    # (a) host→device bandwidth by payload size.
+    sizes_mb = (1, 16, 64) if not SMALL else (1, 4)
+    bw = {}
+    for mb in sizes_mb:
+        host = np.random.default_rng(0).standard_normal(
+            (mb * 1024 * 1024 // 4,)).astype(np.float32)
+
+        def put():
+            return jax.device_put(host)
+
+        ms = _time_ms(put, iters=10, warmup=2)
+        bw[f"{mb}MiB"] = {
+            "ms": round(ms, 3),
+            "gbps": round(host.nbytes * 8 / (ms / 1e3) / 1e9, 2),
+        }
+    out["host_to_device"] = bw
+
+    # (b) per-batch consumer wait by infeed mode. Producer cost and
+    # simulated step time are chosen so double-buffering CAN hide the
+    # producer (host_cost < step) — the measured question is whether
+    # it does on this host.
+    host_cost_s, step_s, batches = 0.003, 0.005, 30
+    if SMALL:
+        batches = 10
+    batch_np = np.zeros((256, 1024), np.float32)  # 1 MiB
+
+    def producer():
+        for _ in range(batches):
+            time.sleep(host_cost_s)
+            yield (batch_np,)
+
+    modes = {}
+    for mode in ("off", "single", "double"):
+        t0 = time.perf_counter()
+        waited = 0.0
+        pipe = data_lib.infeed_pipeline(producer(), mode)
+        try:
+            it = iter(pipe)
+            while True:
+                tw0 = time.perf_counter()  # wait = fetch + residency
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                _force(b)
+                waited += time.perf_counter() - tw0
+                time.sleep(step_s)  # the "step"
+        finally:
+            pipe.close()
+        wall = time.perf_counter() - t0
+        modes[mode] = {
+            "wall_s": round(wall, 3),
+            "consumer_wait_ms_per_batch": round(
+                1000.0 * waited / batches, 3),
+        }
+    out["modes"] = modes
+    out["double_hides_producer"] = bool(
+        modes["double"]["wall_s"] <= modes["off"]["wall_s"])
+    return out
+
+
 SECTIONS = {"flash": flash_section, "striped": striped_section,
             "overlap": overlap_section, "grad_overlap": grad_overlap_section,
             "fusion": fusion_section, "kernels": kernels_section,
             "compression": compression_section,
-            "mesh_routing": mesh_routing_section}
+            "mesh_routing": mesh_routing_section,
+            "infeed": infeed_section}
 
 
 def main():
