@@ -403,6 +403,18 @@ def stop_metrics_server() -> None:
     _metrics_lib.stop_serving()
 
 
+def flight_recorder():
+    """The process-wide flight recorder (docs/podmon.md): the ring of
+    the last N collective events plus the black-box dump surface.
+    ``flight_recorder().events()`` is the live ring;
+    ``flight_recorder().dump("manual")`` writes a black box on demand
+    (the same payload SIGUSR2 or a fatal stall produces). Usable before
+    ``init()`` — the env-configured recorder is created on first use."""
+    from .common import flightrec as _flightrec_lib
+
+    return _flightrec_lib.recorder()
+
+
 # -- timeline (reference operations.cc:720-746) ----------------------------
 
 def start_timeline(filename: str, mark_cycles: bool = False,
@@ -480,7 +492,8 @@ __all__ = [
     "rocm_built", "xla_built", "tpu_available",
     "ProcessSet", "add_process_set", "remove_process_set", "run",
     "recovery_stats", "metrics", "start_metrics_server",
-    "stop_metrics_server", "StepTimer", "observe_ef_residual",
+    "stop_metrics_server", "flight_recorder",
+    "StepTimer", "observe_ef_residual",
     "integrity", "observe_guard", "current_loss_scale",
     "DivergenceDetector", "MismatchError", "NonFiniteError",
     "DivergenceError", "CheckpointCorruptError", "StallTimeoutError",

@@ -109,6 +109,11 @@ _M_STEP_P50 = metrics_lib.gauge(
     "autoscale control plane (per-worker registry; exported samples "
     "carry the registry's rank=/size= GLOBAL labels once hvd.init() "
     "stamps them)")
+_M_STEPS = metrics_lib.counter(
+    "hvd_tpu_autoscale_steps_total",
+    "commits observed by the step publisher — the advancing per-rank "
+    "step counter the pod aggregator's SCRAPE path reads in place of "
+    "the KV report's step field (docs/podmon.md)")
 
 
 def _truthy(raw: Optional[str]) -> bool:
@@ -438,6 +443,7 @@ class StepPublisher:
                 dt *= spec.scale  # report-only inflation (simulation)
             self._window.append(dt)
             self._step += 1
+            _M_STEPS.inc()
             if now - self._last_publish < self._interval:
                 return
             self._last_publish = now

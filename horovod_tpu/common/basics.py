@@ -15,12 +15,12 @@ operations.cc:692-700).
 
 from __future__ import annotations
 
-import atexit
 import logging
 import os
 import threading
 from typing import List, Optional, Sequence
 
+from . import shutdown as shutdown_lib
 from . import topology as topo_lib
 from .config import Config, configure
 from .exceptions import NotInitializedError
@@ -128,6 +128,26 @@ class Context:
         # Reference polls CheckForStalledTensors each background cycle
         # (stall_inspector.cc:28+); here a daemon watchdog thread polls.
         self.stall.start_watchdog()
+        # Flight recorder (docs/podmon.md): the per-process black box.
+        # Built from config and installed as the process singleton so
+        # the eager engine's submit/complete path and the stall
+        # inspector's dump trigger all feed one ring; SIGUSR2 arms the
+        # on-demand dump (best-effort — main thread only, like the
+        # preemption latch).
+        from . import flightrec as flightrec_lib
+
+        # rank= is the context fallback; HVD_TPU_PROC_ID (the virtual
+        # identity) wins inside the constructor — same precedence as
+        # the metrics rank= label below, so a direct multi-controller
+        # launch (no hvdtpurun) still writes blackbox.rank<k>.json per
+        # process instead of N colliding rank-0 boxes.
+        self.flightrec = flightrec_lib.install(flightrec_lib.FlightRecorder(
+            size=config.flightrec_size,
+            directory=config.flightrec_dir,
+            enabled=config.flightrec,
+            rank=self.rank()))
+        self.flightrec._stall_inspector = self.stall
+        flightrec_lib.install_signal_handler()
         # Autotuner (reference ParameterManager, parameter_manager.cc):
         # constructed when HOROVOD_AUTOTUNE is set; the eager engine feeds
         # it grouped-allreduce timings and reads the live fusion threshold
@@ -179,8 +199,26 @@ class Context:
         self._owns_metrics_server = False
         self._owns_metrics_dump = False
         if metrics_lib.enabled():
-            metrics_lib.set_global_labels(rank=str(self.rank()),
-                                          size=str(self.size()))
+            # host= rides along with rank=/size= (docs/podmon.md): the
+            # pod aggregator attributes a scraped series to a host
+            # without a reverse lookup, and the scrape-path autoscale
+            # reports need the same host key the KV reports carry.
+            labels = {"rank": str(self.rank()), "size": str(self.size())}
+            virtual_np = os.environ.get("HVD_TPU_VIRTUAL_NUM_PROC")
+            if virtual_np:
+                # FORCE_LOCAL virtual hosts: every worker is an
+                # independent 1-proc jax world that believes it is
+                # rank 0 of 1 — the VIRTUAL identity (the same one the
+                # autoscale KV publisher and podmon endpoint
+                # registration key on) is what pod-scope scrapes must
+                # see, or N workers collapse to one series.
+                labels["rank"] = os.environ.get("HVD_TPU_PROC_ID",
+                                                labels["rank"])
+                labels["size"] = virtual_np
+            host_label = os.environ.get("HVD_TPU_HOSTNAME")
+            if host_label:
+                labels["host"] = host_label
+            metrics_lib.set_global_labels(**labels)
             if config.metrics_trace_bridge:
                 metrics_lib.enable_trace_bridge(True)
             if config.metrics_file:
@@ -214,6 +252,15 @@ class Context:
                     self._owns_metrics_server = already is None
                     logger.info("metrics: Prometheus /metrics endpoint "
                                 "on port %d", self.metrics_port)
+                    # Pod-scope discovery (docs/podmon.md): advertise
+                    # this worker's endpoint over the controller KV so
+                    # the driver-side aggregator can scrape it without
+                    # knowing ephemeral ports. Best-effort; no-op
+                    # without HVD_TPU_RENDEZVOUS.
+                    from . import podmon as podmon_lib
+
+                    podmon_lib.register_endpoint(self.metrics_port,
+                                                 rank=self.rank())
         # Elastic host-update channel: poll the driver's rendezvous KV
         # topology version (reference: WorkerNotificationClient,
         # elastic/worker.py). Consumed by State.check_host_updates().
@@ -407,7 +454,12 @@ def init(comm: Optional[Sequence[int]] = None, process_sets=None,
         _context = Context(configure(**config_overrides), comm=comm)
         for ps in process_sets or ():
             _context.add_process_set(ps)
-        atexit.register(shutdown)
+        # One ordered teardown sequence (common/shutdown.py): the
+        # context stops its export surfaces AFTER the flight recorder
+        # finalizes and BEFORE the recovery-stats dump — independent
+        # atexit hooks used to race these.
+        shutdown_lib.register("context", shutdown,
+                              shutdown_lib.CONTEXT_PRIORITY)
         return _context
 
 

@@ -205,6 +205,15 @@ class Config:
     # metrics<->timeline bridge: histogram spans + step annotations also
     # emit jax.profiler Trace/StepTraceAnnotations.
     metrics_trace_bridge: bool = False
+    # Flight recorder (docs/podmon.md): fixed-size ring of the last N
+    # collective events per process, dumped with all-thread stacks as a
+    # JSON "black box" on StallTimeoutError / MismatchError / fatal
+    # non-finite abort / SIGUSR2 (and pushed to the controller KV when
+    # reachable — HVD_TPU_FLIGHTREC_PUSH). The ring write is one lock +
+    # dict store; disable only when that is too much.
+    flightrec: bool = True
+    flightrec_size: int = 256
+    flightrec_dir: Optional[str] = None  # black-box dir (default ".")
     # Logging level.
     log_level: str = "warning"
     # Mesh axis name used for the data-parallel "ranks" axis.
@@ -264,6 +273,9 @@ class Config:
                                           cls.metrics_interval_s)
         c.metrics_port = _env_int("METRICS_PORT", cls.metrics_port)
         c.metrics_trace_bridge = _env_bool("METRICS_TRACE", False)
+        c.flightrec = _env_bool("FLIGHTREC", True)
+        c.flightrec_size = _env_int("FLIGHTREC_SIZE", cls.flightrec_size)
+        c.flightrec_dir = _env("FLIGHTREC_DIR")
         c.log_level = _env("LOG_LEVEL", "warning") or "warning"
         c.rank_axis = _env("RANK_AXIS", cls.rank_axis) or cls.rank_axis
         c.force_cpu_devices = _env_int("FORCE_CPU_DEVICES", 0)

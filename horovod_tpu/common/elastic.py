@@ -131,8 +131,12 @@ class State:
         # rolling step-time summary over the rendezvous KV. A None
         # check when the driver did not enable autoscaling.
         from . import autoscale as autoscale_lib
+        from . import flightrec as flightrec_lib
 
         autoscale_lib.note_step()
+        # Flight recorder step stamp (docs/podmon.md): one commit = one
+        # step, so ring events carry the step a post-mortem aligns on.
+        flightrec_lib.note_commit()
         self.save()
         self._handle_preemption()
         self.check_host_updates()
@@ -358,6 +362,13 @@ def run(func: Callable) -> Callable:
                     # fresh assignments (graceful re-rendezvous).
                     sys.exit(HOSTS_UPDATED_EXIT_CODE)
             except Exception as e:  # noqa: BLE001 — classified below
+                # Black-box chokepoint (docs/podmon.md): whatever path a
+                # fatal StallTimeoutError / MismatchError / NonFiniteError
+                # took to get here, the ring is dumped before the retry
+                # loop tears the evidence down. No-op for other types.
+                from . import flightrec as flightrec_lib
+
+                flightrec_lib.maybe_dump_for(e)
                 if not _is_comm_failure(e):
                     raise
                 logger.warning("elastic: collective failure (%s); rolling "
@@ -367,6 +378,19 @@ def run(func: Callable) -> Callable:
                 faults_lib.stats.bump("restores")
                 skip_sync = False
                 if driver_managed:
+                    # The epoch is dying: this rank's ring is the
+                    # healthy half of the pod post-mortem ("rank 0
+                    # completed seq k; rank 1 never did"). Dumping HERE
+                    # is deterministic — the driver's SIGUSR2 fan-out
+                    # only reaches workers still alive when it fires,
+                    # and a graceful peer-failure exit races it.
+                    # fallback=True: a specific stall/mismatch box from
+                    # THIS process must not be overwritten by the
+                    # generic peer-failure one.
+                    flightrec_lib.recorder().dump(
+                        "peer_failure",
+                        reason=f"{type(e).__name__}: {e}",
+                        fallback=True)
                     logger.warning(
                         "elastic: exiting for driver-managed restart "
                         "(peer failure, exit code %d)",

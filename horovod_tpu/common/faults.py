@@ -573,9 +573,14 @@ class RecoveryStats:
         if self._exit_hook_registered:
             return
         self._exit_hook_registered = True
-        import atexit
+        # One ordered teardown sequence (common/shutdown.py): the
+        # counter dump runs LAST, after the flight recorder finalized
+        # and the metrics dump drained — an independent atexit hook
+        # here could interleave with the half-drained metrics file.
+        from . import shutdown as shutdown_lib
 
-        atexit.register(self._dump_at_exit)
+        shutdown_lib.register("recovery_stats", self._dump_at_exit,
+                              shutdown_lib.RECOVERY_STATS_PRIORITY)
 
     def _dump_at_exit(self) -> None:
         snap = self.snapshot()

@@ -61,6 +61,10 @@ ENV_FILE = "HVD_TPU_METRICS_FILE"       # JSON-lines dump path
 ENV_INTERVAL = "HVD_TPU_METRICS_INTERVAL_S"
 ENV_PORT = "HVD_TPU_METRICS_PORT"       # /metrics endpoint (0 = ephemeral)
 ENV_TRACE = "HVD_TPU_METRICS_TRACE"     # jax.profiler bridge
+ENV_DEBUG = "HVD_TPU_METRICS_DEBUG"     # /debug/* on-demand capture
+# Upper bound for one /debug/profile?ms= capture: the handler thread
+# sleeps for the window, so an unbounded request would pin it.
+PROFILE_MS_CAP = 60_000
 
 # Default latency buckets (seconds): sub-ms dispatch latencies up to
 # multi-second stalled collectives — fixed at registration (Prometheus
@@ -694,11 +698,15 @@ class MetricsServer:
         self._reg = reg
         self._http = BackgroundHTTPServer(_metrics_handler_cls(), host=host)
 
-    def start(self, port: int = 0) -> int:
+    def start(self, port: int = 0,
+              debug: Optional[bool] = None) -> int:
+        if debug is None:
+            debug = _truthy(os.environ.get(ENV_DEBUG), False)
         return self._http.start(
             port,
             metrics_registry=(self._reg if self._reg is not None
-                              else registry()))
+                              else registry()),
+            debug_enabled=bool(debug))
 
     @property
     def port(self) -> int:
@@ -725,29 +733,120 @@ def _metrics_handler_cls():
         def log_message(self, fmt, *args):  # quiet
             pass
 
-        def do_GET(self):
-            from urllib.parse import urlparse
-
-            reg = self.server.metrics_registry  # type: ignore[attr-defined]
-            path = urlparse(self.path).path
-            if path in ("/", "/metrics"):
-                body = reg.prometheus_text().encode()
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif path == "/metrics.json":
-                body = json.dumps(reg.snapshot()).encode()
-                ctype = "application/json"
-            else:
-                self.send_response(404)
-                self.end_headers()
-                return
-            self.send_response(200)
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
+        def _unavailable(self, why: str) -> None:
+            # 503 with a one-line reason: a disabled debug surface
+            # answers cleanly instead of 404-ing (the operator can tell
+            # "off" from "wrong URL").
+            self._send(503, (why + "\n").encode(),
+                       "text/plain; charset=utf-8")
+
+        def do_GET(self):
+            from urllib.parse import parse_qs, urlparse
+
+            reg = self.server.metrics_registry  # type: ignore[attr-defined]
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path in ("/", "/metrics"):
+                self._send(200, reg.prometheus_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metrics.json":
+                self._send(200, json.dumps(reg.snapshot()).encode(),
+                           "application/json")
+            elif path == "/debug/stacks":
+                # On-demand all-thread dump (docs/podmon.md): the
+                # lightweight remote analog of a SIGUSR2 black box —
+                # "what is this rank doing RIGHT NOW" without ssh.
+                if not getattr(self.server, "debug_enabled", False):
+                    return self._unavailable(
+                        "debug endpoints disabled "
+                        "(HVD_TPU_METRICS_DEBUG=1 enables)")
+                self._send(200, _thread_stacks_text().encode(),
+                           "text/plain; charset=utf-8")
+            elif path == "/debug/profile":
+                if not getattr(self.server, "debug_enabled", False):
+                    return self._unavailable(
+                        "debug endpoints disabled "
+                        "(HVD_TPU_METRICS_DEBUG=1 enables)")
+                qs = parse_qs(parsed.query)
+                try:
+                    ms = int(qs.get("ms", ["1000"])[0])
+                except ValueError:
+                    ms = 1000
+                ms = max(1, min(ms, PROFILE_MS_CAP))
+                target = qs.get("dir", [None])[0]
+                ok, payload = _capture_profile(target, ms)
+                if not ok:
+                    return self._unavailable(payload)
+                self._send(200, json.dumps(payload).encode(),
+                           "application/json")
+            else:
+                self.send_response(404)
+                self.end_headers()
+
     _handler_cls = _MetricsHandler
     return _MetricsHandler
+
+
+def thread_stacks() -> Dict[str, List[str]]:
+    """All-thread Python stacks keyed ``"<name>:<tid>"`` — the one
+    collector behind both /debug/stacks and the flight recorder's
+    black-box ``stacks`` payload (the two views must not drift)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    return {f"{names.get(tid, '?')}:{tid}": traceback.format_stack(frame)
+            for tid, frame in sys._current_frames().items()}
+
+
+def _thread_stacks_text() -> str:
+    chunks = []
+    for label, stack in thread_stacks().items():
+        name, _, tid = label.rpartition(":")
+        chunks.append(f"--- thread {name} ({tid}) ---\n" + "".join(stack))
+    return "\n".join(chunks)
+
+
+_profile_lock = threading.Lock()
+
+
+def _capture_profile(target: Optional[str], ms: int):
+    """Bounded jax.profiler capture for /debug/profile. Returns
+    ``(ok, payload_or_reason)``. 503 reasons: jax unavailable, another
+    capture already running (one at a time — overlapping start_trace
+    calls abort the runtime), or a start failure."""
+    if not _profile_lock.acquire(blocking=False):
+        return False, "a profiler capture is already in progress"
+    try:
+        try:
+            import jax
+        except Exception as e:  # noqa: BLE001 — jax-less processes
+            return False, f"jax.profiler unavailable ({e})"
+        if target is None:
+            import tempfile
+
+            target = tempfile.mkdtemp(prefix="hvd_tpu_profile_")
+        try:
+            jax.profiler.start_trace(target)
+        except Exception as e:  # noqa: BLE001 — never kill the server
+            return False, f"profiler start failed ({e})"
+        try:
+            time.sleep(ms / 1000.0)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                return False, f"profiler stop failed ({e})"
+        return True, {"dir": target, "ms": ms}
+    finally:
+        _profile_lock.release()
 
 
 _server: Optional[MetricsServer] = None

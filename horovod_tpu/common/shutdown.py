@@ -1,0 +1,91 @@
+"""One ordered process-shutdown sequence for every teardown hook.
+
+Before this module, three subsystems raced each other at interpreter
+exit through independently registered ``atexit`` hooks: the Context
+shutdown (which stops the metrics JSON-lines dumper and drains its
+final snapshot line), ``RecoveryStats``' at-exit counter dump, and —
+new in the pod-observability layer — the flight recorder's pending
+black-box write. ``atexit`` runs hooks in reverse registration order,
+which here is an accident of which subsystem was touched first; a
+black-box dump triggered during teardown could interleave with a
+half-drained metrics file.
+
+This module is the single ``atexit`` entry point: subsystems register
+named callbacks with an explicit priority, and ONE hook runs them in
+priority order under one lock. The order is:
+
+1. flight recorder finalize (priority 10) — capture the in-flight ring
+   and any signal-requested black box FIRST, while the engine/stall
+   state is still alive;
+2. Context shutdown (priority 20) — stops the stall watchdog, drains
+   the metrics dump (final snapshot line), stops the HTTP endpoints;
+3. RecoveryStats dump (priority 30) — the counters summarize the whole
+   run, including anything the two steps above bumped.
+
+Registration is idempotent per name (last registration wins) and safe
+to call from any thread; callbacks never raise out of the sequence.
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Callable, Dict, Tuple
+
+logger = logging.getLogger("horovod_tpu")
+
+# Canonical priorities (documented above; used by the registrants).
+FLIGHTREC_PRIORITY = 10
+CONTEXT_PRIORITY = 20
+RECOVERY_STATS_PRIORITY = 30
+
+_lock = threading.Lock()
+_callbacks: Dict[str, Tuple[int, Callable[[], None]]] = {}
+_hook_registered = False
+_ran = False
+
+
+def register(name: str, fn: Callable[[], None],
+             priority: int = 50) -> None:
+    """Register (or replace) a named shutdown callback. Lower priority
+    runs first. The single underlying ``atexit`` hook is installed on
+    the first registration."""
+    global _hook_registered
+    with _lock:
+        _callbacks[name] = (priority, fn)
+        if not _hook_registered:
+            _hook_registered = True
+            atexit.register(run)
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _callbacks.pop(name, None)
+
+
+def run() -> None:
+    """Run the shutdown sequence once (idempotent; re-entrant calls —
+    e.g. an explicit call followed by the atexit firing — are no-ops).
+    Each callback is isolated: a failing one logs and the sequence
+    continues."""
+    global _ran
+    with _lock:
+        if _ran:
+            return
+        _ran = True
+        items = sorted(_callbacks.items(), key=lambda kv: kv[1][0])
+    for name, (_, fn) in items:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            logger.exception("shutdown: %s callback failed", name)
+
+
+def _reset_for_tests() -> None:
+    """Forget registrations and the ran-latch (the atexit hook stays
+    installed; with no callbacks it is a no-op)."""
+    global _ran
+    with _lock:
+        _callbacks.clear()
+        _ran = False

@@ -104,9 +104,20 @@ class StallInspector:
                 _M_FATAL.inc()
                 exc_type = (StallTimeoutError
                             if self.fatal_mode == "raise" else StallError)
-                raise exc_type(
+                exc = exc_type(
                     f"collective {name} stalled for {age:.0f}s "
                     f"(> shutdown threshold {self.shutdown_time:.0f}s)")
+                # Black box at latch time (docs/podmon.md): the hung
+                # collective is STILL pending in the flight ring here —
+                # the moment the post-mortem needs captured. Dumping
+                # from the watchdog thread is deliberate: the main
+                # thread may be wedged inside the very collective.
+                from . import flightrec as flightrec_lib
+
+                flightrec_lib.recorder().dump(
+                    "stall_timeout",
+                    reason=f"{exc_type.__name__}: {exc}")
+                raise exc
             if age > self.check_time:
                 stalled = True
                 if name not in self._warned:
@@ -116,6 +127,9 @@ class StallInspector:
                         "completed for >%.0fs: %s (reference analog: "
                         "stall_inspector.cc CheckForStalledTensors)",
                         self.check_time, name)
+                    from . import flightrec as flightrec_lib
+
+                    flightrec_lib.recorder().mark_stalled(name)
                     with self._lock:
                         self._warned.add(name)
         return stalled

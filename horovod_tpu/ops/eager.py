@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 logger = logging.getLogger("horovod_tpu")
 
 from ..common import faults as faults_lib
+from ..common import flightrec as flightrec_lib
 from ..common import fusion as fusion_lib
 from ..common import metrics as metrics_lib
 from ..common.exceptions import (DuplicateTensorNameError, MismatchError,
@@ -674,6 +675,10 @@ class EagerEngine:
             time.sleep(0.001)
         if self.stall is not None:
             self.stall.record_submit(full)
+        # Flight recorder (docs/podmon.md): the ring records every
+        # submit so a post-mortem can replay the last N collectives —
+        # one dict write when enabled, one bool check otherwise.
+        flightrec_lib.recorder().record_submit(full, kind)
         if _METRICS_ON:
             self._submit_ts[full] = time.perf_counter()
         # Chaos site "collective_stall": delay AFTER record_submit so the
@@ -696,8 +701,22 @@ class EagerEngine:
                     time.perf_counter() - t0)
         if self.stall is not None:
             self.stall.record_complete(full)
+        # First completion wins in the ring: an error outcome recorded
+        # by _fail is not overwritten by this "ok".
+        flightrec_lib.recorder().record_complete(full)
         if self.timeline is not None:
             self.timeline.end(full)
+
+    def _fail(self, full: Optional[str], exc: BaseException) -> None:
+        """Collective exception path: stamp the error outcome into the
+        flight ring, dump a black box for the fatal classes
+        (StallTimeoutError / MismatchError — docs/podmon.md), then run
+        the normal completion bookkeeping."""
+        if full is not None:
+            flightrec_lib.recorder().record_complete(
+                full, outcome=f"error:{type(exc).__name__}")
+        flightrec_lib.maybe_dump_for(exc)
+        self._end(full)
 
     def _finalize_async(self, full: Optional[str], result,
                         on_complete=None):
@@ -892,6 +911,8 @@ class EagerEngine:
             if _METRICS_ON:
                 self._count_allreduce_bytes(dt, compression, quant,
                                             small_bf16, wire, nbytes)
+            flightrec_lib.recorder().annotate(
+                full, nbytes=nbytes, wire=wire or "none")
             key = ("ar", dt.shape, str(dt.dtype), int(op), prescale_factor,
                    postscale_factor, compression.__name__, wire, hier)
 
@@ -952,8 +973,8 @@ class EagerEngine:
                 return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 
@@ -979,8 +1000,8 @@ class EagerEngine:
             resp = self._join_round(req)
             out = self._join_dispatch(req, set(resp["joined"]), xa,
                                       prescale, postscale)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 
@@ -1051,6 +1072,12 @@ class EagerEngine:
             if _METRICS_ON:
                 self._count_grouped_bytes(repr(key), leaves, threshold,
                                           quant, qmin, compression)
+            if flightrec_lib.recorder().enabled:
+                flightrec_lib.recorder().annotate(
+                    full, nbytes=sum(
+                        int(np.prod(l.shape[1:]) or 1) * l.dtype.itemsize
+                        for l in leaves),
+                    wire="int8" if quant else "none")
 
             def build():
                 cast_comp = (NoneCompressor if getattr(
@@ -1113,8 +1140,8 @@ class EagerEngine:
 
             out_leaves = self._compiled(key, build)(leaves)
             out = jax.tree.unflatten(treedef, list(out_leaves))
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out, on_complete)
 
@@ -1186,8 +1213,8 @@ class EagerEngine:
                         return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 
@@ -1235,8 +1262,8 @@ class EagerEngine:
             res = np.concatenate(
                 [y[r * maxn:r * maxn + counts[r]]
                  for r in range(self.size)], axis=0)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         self._end(full)
         return res
@@ -1258,8 +1285,8 @@ class EagerEngine:
                 return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 
@@ -1287,8 +1314,8 @@ class EagerEngine:
                 return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 
@@ -1465,8 +1492,8 @@ class EagerEngine:
                            [ys[d, s * seg:s * seg + matrix[s][d]]
                             for s in range(n)], axis=0)
                        for d in range(n)]
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         self._end(full)
         return res
@@ -1490,8 +1517,8 @@ class EagerEngine:
                 return self._shard_mapped(per_rank)
 
             out = self._compiled(key, build)(dt)
-        except Exception:
-            self._end(full)
+        except Exception as e:
+            self._fail(full, e)
             raise
         return self._finalize_async(full, out)
 

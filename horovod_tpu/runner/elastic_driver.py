@@ -535,13 +535,51 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     grace = (grace_secs if grace_secs is not None else
              float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30")))
 
-    def terminate_all():
+    dumps_requested = False
+
+    def request_dumps() -> bool:
+        # Flight-recorder fan-out (docs/podmon.md): the epoch is dying
+        # on a FAILURE, so ask every surviving worker for its black box
+        # (SIGUSR2 -> common/flightrec.py dump) — "what was every rank
+        # doing when the job hung" needs the ring from the healthy
+        # ranks too. Fired the moment the first failure exit is seen
+        # (a gracefully peer-failure-exiting survivor won't be alive by
+        # terminate time); handles without send_signal (non-subprocess
+        # spawners) are skipped.
+        nonlocal dumps_requested
+        sig = getattr(signal, "SIGUSR2", None)
+        signaled = False
+        for _, p in procs:
+            send = getattr(p, "send_signal", None)
+            if sig is None or send is None or p.poll() is not None:
+                continue
+            try:
+                send(sig)
+                signaled = True
+            except (ProcessLookupError, OSError, ValueError):
+                pass
+        dumps_requested = dumps_requested or signaled
+        return signaled
+
+    def terminate_all(dump_first: bool = False):
         # Signal EVERY worker, even ones whose handle already reported
         # an exit: a KV-backed pool handle may have SYNTHESIZED rc=1
         # from a transiently stale heartbeat while the remote worker is
         # actually alive — skipping it would leave a live duplicate of
         # the dead epoch running. Popen.terminate on an exited child is
         # a no-op, so the blanket signal is safe for local epochs too.
+        if dump_first and not dumps_requested:
+            # Bounded grace for the dump to hit disk before the kill
+            # (HVD_TPU_FLIGHTREC_SIGNAL_GRACE_S, default 1 s). Skipped
+            # when request_dumps() already fired earlier — the epoch's
+            # grace window was the write window.
+            try:
+                dump_grace = float(os.environ.get(
+                    "HVD_TPU_FLIGHTREC_SIGNAL_GRACE_S", "1.0"))
+            except ValueError:
+                dump_grace = 1.0
+            if request_dumps() and dump_grace > 0:
+                time.sleep(dump_grace)
         for _, p in procs:
             try:
                 p.terminate()
@@ -573,8 +611,14 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
                         # (reference: WorkerStateRegistry FAILURE →
                         # HostManager.blacklist, registration.py:150-153).
                         failed.add(hostname)
+            if (epoch_ending and not interrupted and not terminated
+                    and not dumps_requested):
+                # A peer-failure exit is ending the epoch: collect the
+                # survivors' rings NOW, while they are still alive —
+                # they exit 79 on their own within the grace window.
+                request_dumps()
             if failed and not terminated:
-                terminate_all()
+                terminate_all(dump_first=True)
                 terminated = True
             if next_tick is not None and not terminated \
                     and not interrupted and time.monotonic() >= next_tick:
@@ -608,7 +652,10 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
                 grace_deadline = time.monotonic() + grace
             if (grace_deadline is not None and not terminated
                     and time.monotonic() > grace_deadline):
-                terminate_all()
+                # dump_first only on FAILURE endings: a topology-change
+                # interrupt is routine — black-boxing every reshape
+                # would bury the real post-mortems in noise.
+                terminate_all(dump_first=(rc != 0 and not interrupted))
                 terminated = True
             if not running:
                 break
@@ -724,14 +771,42 @@ def run_elastic(args, command: List[str],
         if chaos_var in os.environ:
             env_extra.setdefault(chaos_var, os.environ[chaos_var])
 
+    # Pod-scope aggregator (docs/podmon.md): scrape every rank's
+    # /metrics.json (endpoints advertised over THIS job's KV, plus any
+    # HVD_TPU_POD_METRICS_ENDPOINTS remote pods) and re-serve the
+    # merged rank-labeled view + step-skew gauge on /pod/metrics. The
+    # monitor lives in the driver so its series span elastic epochs.
+    from ..common import podmon as podmon_lib
+
+    pod_monitor = None
+    pod_port = podmon_lib.monitor_port_from_env(
+        {**os.environ, **env_extra})
+    if pod_port is not None:
+        pod_monitor = podmon_lib.PodMonitor(
+            podmon_lib.combined_endpoints(
+                podmon_lib.kv_endpoints(rdv),
+                podmon_lib.static_endpoints()))
+        pod_monitor.start(pod_port)
+        # The scrape needs per-worker endpoints: default workers to an
+        # ephemeral /metrics port when nothing chose one.
+        if "HVD_TPU_METRICS_PORT" not in env_extra \
+                and "HVD_TPU_METRICS_PORT" not in os.environ:
+            env_extra["HVD_TPU_METRICS_PORT"] = "0"
+
     on_tick = None
     if autoscale_policy is not None:
         # The engine reads worker reports straight off the in-process
         # KV; workers get the RESOLVED policy (env overrides folded in)
         # so publisher cadence and engine windows always agree.
+        fetch = autoscale_lib.kv_report_fetcher(rdv)
+        if pod_monitor is not None:
+            # Alternative signal source (docs/podmon.md): ranks that
+            # never publish to the KV — remote pods, pre-publisher
+            # workers — still feed the engine through the scrape path;
+            # KV reports win per rank when both exist.
+            fetch = podmon_lib.merged_report_fetcher(fetch, pod_monitor)
         engine = autoscale_lib.AutoscaleEngine(
-            autoscale_policy, min_np, max_np,
-            autoscale_lib.kv_report_fetcher(rdv),
+            autoscale_policy, min_np, max_np, fetch,
             log_path=autoscale_env.get(autoscale_lib.ENV_LOG, ""))
         driver.autoscale = engine
         env_extra[autoscale_lib.ENV_ENABLE] = "1"
@@ -856,6 +931,8 @@ def run_elastic(args, command: List[str],
                     "blacklist TTL pending — %s); waiting for capacity",
                     driver.host_manager.blacklist_snapshot() or "{}")
     finally:
+        if pod_monitor is not None:
+            pod_monitor.stop()
         if owns_rdv:
             rdv.stop()
         driver.stop()

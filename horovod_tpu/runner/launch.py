@@ -44,9 +44,11 @@ def build_env_for_slot(base_env: Dict[str, str], coordinator: str,
     env["HVD_TPU_PROC_ID"] = str(proc_id)
     if num_proc > 1 and env.get("HVD_TPU_METRICS_FILE"):
         # One JSON-lines dump per worker: N processes appending
-        # snapshots to one file would interleave rank states.
+        # snapshots to one file would interleave rank states. The
+        # .rank<k> suffix is what analyze_trace.py --metrics globs to
+        # build its per-rank + merged report (docs/podmon.md).
         env["HVD_TPU_METRICS_FILE"] = \
-            f"{env['HVD_TPU_METRICS_FILE']}.{proc_id}"
+            f"{env['HVD_TPU_METRICS_FILE']}.rank{proc_id}"
     if extra:
         env.update(extra)
     return env
@@ -153,8 +155,13 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     coord = f"{coord_host}:{_free_port()}"
     handles = []
     for i, hostname in enumerate(hosts):
+        # HVD_TPU_HOSTNAME rides along like the elastic/spark paths:
+        # podmon.register_endpoint advertises it as the scrape address
+        # (without it a remote worker falls back to loopback and the
+        # driver-side aggregator scrapes itself).
         env = build_env_for_slot({}, coord, num_proc, i,
-                                 {**env_extra, **_slot_local_env(0, 1)})
+                                 {**env_extra, **_slot_local_env(0, 1),
+                                  "HVD_TPU_HOSTNAME": hostname})
         # *_SECRET vars must not ride the remote argv (any local user on
         # the worker reads it via ps); they travel over ssh stdin as one
         # export line the bootstrap evals before exec'ing the command.
@@ -338,8 +345,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "would collide")
     p.add_argument("--metrics-file", default=None,
                    help="per-worker metrics JSON-lines dump path "
-                        "(.<rank> is appended in multi-proc runs; "
+                        "(.rank<k> is appended in multi-proc runs; "
                         "HVD_TPU_METRICS_FILE)")
+    p.add_argument("--pod-metrics-port", type=int, default=None,
+                   help="driver-side pod aggregator (docs/podmon.md): "
+                        "scrape every worker's /metrics.json and serve "
+                        "the merged rank-labeled view + "
+                        "hvd_tpu_pod_step_skew_seconds on ONE "
+                        "/pod/metrics endpoint at this port (0 = "
+                        "ephemeral; HVD_TPU_POD_METRICS_PORT). Workers "
+                        "default to --metrics-port 0 when unset so "
+                        "there is something to scrape")
     p.add_argument("--log-level", default=None)
     # Elastic (reference launch.py elastic flags).
     p.add_argument("--elastic", action="store_true")
@@ -457,6 +473,14 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_METRICS_PORT"] = str(args.metrics_port)
     if args.metrics_file:
         env["HVD_TPU_METRICS_FILE"] = args.metrics_file
+    if getattr(args, "pod_metrics_port", None) is not None:
+        env["HVD_TPU_POD_METRICS_PORT"] = str(args.pod_metrics_port)
+        # The aggregator scrapes the workers' /metrics.json — an
+        # explicit --metrics-port wins, otherwise each worker binds an
+        # ephemeral endpoint and advertises it over the KV.
+        env.setdefault("HVD_TPU_METRICS_PORT",
+                       str(args.metrics_port
+                           if args.metrics_port is not None else 0))
     if args.log_level:
         env["HVD_TPU_LOG_LEVEL"] = args.log_level
     if args.elastic:
@@ -485,6 +509,36 @@ def knob_env(args: argparse.Namespace) -> Dict[str, str]:
     if getattr(args, "autoscale_log", None):
         env["HVD_TPU_AUTOSCALE_LOG"] = args.autoscale_log
     return env
+
+
+def _start_pod_monitor(env_extra: Dict[str, str],
+                       advertise_host: str = "127.0.0.1"):
+    """Start the driver-side pod aggregator (docs/podmon.md) when
+    ``HVD_TPU_POD_METRICS_PORT`` requests one for a STATIC launch.
+    Without a rendezvous KV in play, one is started here purely for
+    worker endpoint advertisement (workers ignore it otherwise —
+    elastic host-update polling only arms under ``--elastic``).
+    Returns ``(monitor, owned_rdv)``; the caller stops both."""
+    from ..common import podmon as podmon_lib
+
+    merged_env = {**os.environ, **env_extra}
+    port = podmon_lib.monitor_port_from_env(merged_env)
+    if port is None:
+        return None, None
+    from .rendezvous import RendezvousServer
+
+    owned_rdv = None
+    sources = [podmon_lib.static_endpoints(
+        merged_env.get(podmon_lib.ENV_ENDPOINTS))]
+    if "HVD_TPU_RENDEZVOUS" not in merged_env:
+        owned_rdv = RendezvousServer("0.0.0.0")
+        kv_port = owned_rdv.start()
+        env_extra["HVD_TPU_RENDEZVOUS"] = f"{advertise_host}:{kv_port}"
+        sources.append(podmon_lib.kv_endpoints(owned_rdv))
+    monitor = podmon_lib.PodMonitor(
+        podmon_lib.combined_endpoints(*sources))
+    monitor.start(port)
+    return monitor, owned_rdv
 
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
@@ -575,12 +629,24 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         # on -np > slots rather than oversubscribing, hosts.py:100).
         hosts_lib.get_host_assignments(host_infos, args.num_proc)
 
-    if host_infos is None or all(
-            h.hostname in ("localhost", "127.0.0.1", socket.gethostname())
-            for h in host_infos):
-        return run_local(args.num_proc, command, env_extra, args.verbose)
-    return run_ssh(host_infos, command, env_extra, args.num_proc,
-                   args.verbose, args.ssh_port)
+    monitor = owned_rdv = None
+    try:
+        if host_infos is None or all(
+                h.hostname in ("localhost", "127.0.0.1",
+                               socket.gethostname())
+                for h in host_infos):
+            monitor, owned_rdv = _start_pod_monitor(env_extra)
+            return run_local(args.num_proc, command, env_extra,
+                             args.verbose)
+        monitor, owned_rdv = _start_pod_monitor(
+            env_extra, advertise_host=socket.gethostname())
+        return run_ssh(host_infos, command, env_extra, args.num_proc,
+                       args.verbose, args.ssh_port)
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if owned_rdv is not None:
+            owned_rdv.stop()
 
 
 def main() -> None:

@@ -26,6 +26,26 @@ def test_chaos_smoke_survives_three_fault_families(tmp_path):
     assert rec["injections"] >= 3
 
 
+def test_chaos_stall_blackboxes_every_rank_and_names_the_hung_op(tmp_path):
+    """ISSUE 9 acceptance: a seeded ``collective_stall`` run produces
+    flight-recorder black boxes on EVERY rank (the stalled rank at
+    watchdog latch, the healthy rank via the driver's SIGUSR2
+    fan-out), ``flight_diff`` names the injected-stall rank and the
+    exact collective (op + signature + step) it failed to complete,
+    one live /pod/metrics scrape shows rank-labeled step-time series
+    for all ranks plus nonzero skew under the injected straggler, and
+    the elastic retry still finishes the job."""
+    rec = chaos_soak.run_stall_soak(str(tmp_path), steps=60, seed=42)
+    assert rec["rc"] == 0
+    assert rec["final_step"] == 60
+    assert rec["blackbox_ranks"] == [0, 1]
+    assert rec["hung_collective"]["op"] == "allreduce"
+    assert rec["hung_collective"]["name"] == "allreduce.grad"
+    assert rec["pod_step_skew_seconds"] > 0.05
+    assert {"collective_stall", "straggler"} <= \
+        set(rec["injected_sites"])
+
+
 @pytest.mark.slow
 def test_chaos_soak_same_seed_reproduces_sequences(tmp_path):
     a = chaos_soak.run_soak(str(tmp_path / "a"), steps=12, seed=11)

@@ -1,0 +1,474 @@
+"""Flight recorder + black-box post-mortem plane (docs/podmon.md):
+ring semantics (wraparound, first-completion-wins, stall marking),
+the black-box dump (schema, once-per-trigger dedup, fallback boxes,
+SIGUSR2 on-demand capture, exit finalizer), the fatal-exception
+trigger mapping, ``tools/flight_diff.py`` cross-rank alignment
+("rank 5 never submitted allreduce for bucket 12 at step 4812"), and
+the single ordered shutdown sequence (common/shutdown.py)."""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.common import flightrec as flightrec_lib
+from horovod_tpu.common import shutdown as shutdown_lib
+from horovod_tpu.common.exceptions import (MismatchError, NonFiniteError,
+                                           StallTimeoutError)
+from horovod_tpu.common.flightrec import FlightRecorder
+
+import tools.flight_diff as flight_diff  # noqa: E402
+
+
+def _rec(tmp_path, **kw):
+    kw.setdefault("size", 8)
+    kw.setdefault("rank", 0)
+    kw.setdefault("push", False)
+    kw.setdefault("enabled", True)
+    return FlightRecorder(directory=str(tmp_path), **kw)
+
+
+# -- the ring ----------------------------------------------------------------
+
+def test_ring_records_submit_annotate_complete(tmp_path):
+    r = _rec(tmp_path)
+    seq = r.record_submit("allreduce.g1", "allreduce")
+    assert seq == 1
+    r.annotate("allreduce.g1", nbytes=4096, wire="int8")
+    r.record_complete("allreduce.g1")
+    (ev,) = r.events()
+    assert ev["op"] == "allreduce" and ev["name"] == "allreduce.g1"
+    assert ev["bytes"] == 4096 and ev["wire"] == "int8"
+    assert ev["outcome"] == "ok"
+    assert ev["t_complete"] >= ev["t_submit"]
+    assert not r.pending()
+
+
+def test_ring_wraps_keeping_last_n(tmp_path):
+    r = _rec(tmp_path, size=8)
+    for i in range(20):
+        r.record_submit(f"allreduce.g{i}", "allreduce")
+        r.record_complete(f"allreduce.g{i}")
+    evs = r.events()
+    assert len(evs) == 8
+    # Oldest-first, the LAST 8 sequence numbers.
+    assert [e["seq"] for e in evs] == list(range(13, 21))
+
+
+def test_first_completion_wins(tmp_path):
+    """An error outcome recorded on the exception path must not be
+    overwritten by the finalizer's eventual ok."""
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.g1", "allreduce")
+    r.record_complete("allreduce.g1", outcome="error:Boom")
+    r.record_complete("allreduce.g1", outcome="ok")
+    assert r.events()[0]["outcome"] == "error:Boom"
+
+
+def test_mark_stalled_only_flags_pending(tmp_path):
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.g1", "allreduce")
+    r.record_submit("allreduce.g2", "allreduce")
+    r.record_complete("allreduce.g2")
+    r.mark_stalled("allreduce.g1")
+    r.mark_stalled("allreduce.g2")     # completed: untouched
+    out = {e["name"]: e["outcome"] for e in r.events()}
+    assert out == {"allreduce.g1": "stalled", "allreduce.g2": "ok"}
+
+
+def test_step_stamp_advances_per_commit(tmp_path):
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.a", "allreduce")
+    r.advance_step()
+    r.record_submit("allreduce.b", "allreduce")
+    r.advance_step(step=41)
+    r.record_submit("allreduce.c", "allreduce")
+    steps = [e["step"] for e in r.events()]
+    assert steps == [0, 1, 41]
+
+
+def test_disabled_recorder_is_inert(tmp_path):
+    r = _rec(tmp_path, enabled=False)
+    assert r.record_submit("allreduce.g1", "allreduce") == -1
+    r.annotate("allreduce.g1", nbytes=1)
+    r.record_complete("allreduce.g1")
+    assert r.events() == [] and r.pending() == []
+    assert r.dump("stall_timeout") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+# -- the black box -----------------------------------------------------------
+
+def test_blackbox_schema_and_roundtrip_through_flight_diff(tmp_path):
+    """The writer/reader schema contract: a dumped box must load
+    through flight_diff's strict validator (and the key tuples are the
+    literal contract check_parity audits)."""
+    assert flight_diff.BLACKBOX_KEYS == flightrec_lib.BLACKBOX_KEYS
+    assert flight_diff.EVENT_KEYS == flightrec_lib.EVENT_KEYS
+    r = _rec(tmp_path, rank=3, host="hostD")
+    r.record_submit("allreduce.grad", "allreduce")
+    r.annotate("allreduce.grad", nbytes=128, wire="none")
+    path = r.dump("sigusr2", reason="on demand")
+    assert path == str(tmp_path / "blackbox.rank3.json")
+    box = flight_diff.load_blackbox(path)
+    assert box["schema"] == flightrec_lib.BLACKBOX_SCHEMA_VERSION
+    assert box["rank"] == 3 and box["host"] == "hostD"
+    assert box["trigger"] == "sigusr2" and box["reason"] == "on demand"
+    assert box["events"][0]["name"] == "allreduce.grad"
+    assert box["events"][0]["outcome"] == "pending"
+    # All-thread stacks: at least this thread, with real frames.
+    assert any("test_blackbox_schema" in "".join(frames)
+               for frames in box["stacks"].values())
+
+
+def test_flight_diff_rejects_truncated_box(tmp_path):
+    p = tmp_path / "blackbox.rank0.json"
+    p.write_text(json.dumps({"schema": 1, "rank": 0}))
+    with pytest.raises(ValueError, match="missing keys"):
+        flight_diff.load_blackbox(str(p))
+
+
+def test_dump_once_per_trigger_keeps_first(tmp_path):
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.g1", "allreduce")
+    assert r.dump("stall_timeout", reason="first") is not None
+    assert r.dump("stall_timeout", reason="second") is None
+    box = json.load(open(r.box_path()))
+    assert box["reason"] == "first"
+    # A different trigger still dumps (and overwrites the one file).
+    assert r.dump("mismatch") is not None
+
+
+def test_fallback_dump_yields_to_specific_box(tmp_path):
+    """The generic peer-failure box only writes when the process has
+    no box yet — it must never overwrite a stall/mismatch one."""
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.g1", "allreduce")
+    assert r.dump("stall_timeout", reason="the real story") is not None
+    assert r.dump("peer_failure", fallback=True) is None
+    assert json.load(open(r.box_path()))["trigger"] == "stall_timeout"
+    # On a rank with no prior box the fallback DOES write.
+    r2 = _rec(tmp_path, rank=1)
+    assert r2.dump("peer_failure", fallback=True) is not None
+    assert json.load(open(r2.box_path()))["trigger"] == "peer_failure"
+
+
+def test_failed_write_unlatches_trigger_for_retry(tmp_path):
+    """A write failure (full disk, unmounted volume) must not suppress
+    a retry of the trigger or a later fallback dump — the rank would
+    end the run with no box at all despite two dump opportunities."""
+    r = _rec(tmp_path)
+    r.record_submit("allreduce.g1", "allreduce")
+    (tmp_path / "file").write_text("x")
+    r.directory = str(tmp_path / "file" / "sub")   # NotADirectoryError
+    assert r.dump("stall_timeout") is None
+    r.directory = str(tmp_path)
+    # The fallback box is not deduped against the failed attempt...
+    assert r.dump("peer_failure", fallback=True) is not None
+    # ...and the original trigger can retry too.
+    assert r.dump("stall_timeout") is not None
+    assert json.load(open(r.box_path()))["trigger"] == "stall_timeout"
+
+
+def test_env_proc_id_wins_over_explicit_rank(tmp_path, monkeypatch):
+    """Virtual-identity convention (FORCE_LOCAL sim worlds): every
+    worker is a 1-proc jax world whose context rank is 0 — the env
+    identity must win or N boxes collapse onto blackbox.rank0.json."""
+    monkeypatch.setenv("HVD_TPU_PROC_ID", "5")
+    r = FlightRecorder(directory=str(tmp_path), rank=0, push=False,
+                       enabled=True)
+    assert r.rank == 5
+    assert r.box_path().endswith("blackbox.rank5.json")
+    monkeypatch.delenv("HVD_TPU_PROC_ID")
+    assert FlightRecorder(directory=str(tmp_path), rank=3).rank == 3
+
+
+def test_stall_inspector_inflight_embedded(tmp_path):
+    from horovod_tpu.common.stall import StallInspector
+
+    insp = StallInspector(check_time_seconds=60.0)
+    insp.record_submit("allreduce.hung")
+    r = _rec(tmp_path)
+    r._stall_inspector = insp
+    box = r.blackbox("manual")
+    assert "allreduce.hung" in box["stall_inflight"]
+    assert box["stall_inflight"]["allreduce.hung"] >= 0
+
+
+def test_trigger_mapping_for_fatal_classes(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec_lib._reset_for_tests()
+    shutdown_lib._reset_for_tests()
+    try:
+        assert flightrec_lib._trigger_for(
+            StallTimeoutError("x")) == "stall_timeout"
+        assert flightrec_lib._trigger_for(
+            MismatchError("x", ranks=(1,))) == "mismatch"
+        assert flightrec_lib._trigger_for(
+            NonFiniteError("x")) == "nonfinite"
+        assert flightrec_lib._trigger_for(ValueError("x")) is None
+        # maybe_dump_for: a fatal class writes, a plain error doesn't.
+        assert flightrec_lib.maybe_dump_for(ValueError("x")) is None
+        path = flightrec_lib.maybe_dump_for(NonFiniteError("nan storm"))
+        assert path is not None
+        assert "NonFiniteError: nan storm" in \
+            json.load(open(path))["reason"]
+    finally:
+        flightrec_lib._reset_for_tests()
+        shutdown_lib._reset_for_tests()
+
+
+def test_sigusr2_handler_dumps_on_demand(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec_lib._reset_for_tests()
+    shutdown_lib._reset_for_tests()
+    old = signal.getsignal(signal.SIGUSR2)
+    try:
+        assert flightrec_lib.install_signal_handler()
+        flightrec_lib.recorder().record_submit("allreduce.g1",
+                                               "allreduce")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 5.0
+        box_path = flightrec_lib.recorder().box_path()
+        while not os.path.exists(box_path) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        box = json.load(open(box_path))
+        assert box["trigger"] == "sigusr2"
+        # NOT once-per-trigger: a second signal re-dumps fresh state
+        # (the dump runs on a short-lived thread — poll for the
+        # refreshed box, don't assume it landed synchronously).
+        flightrec_lib.recorder().record_complete("allreduce.g1")
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 5.0
+        box2 = box
+        while time.monotonic() < deadline:
+            box2 = json.load(open(box_path))
+            if box2["events"][0]["outcome"] == "ok":
+                break
+            time.sleep(0.01)
+        assert box2["events"][0]["outcome"] == "ok"
+    finally:
+        signal.signal(signal.SIGUSR2, old)
+        flightrec_lib._reset_for_tests()
+        shutdown_lib._reset_for_tests()
+
+
+def test_exit_finalizer_dumps_only_wedged_processes(tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec_lib._reset_for_tests()
+    shutdown_lib._reset_for_tests()
+    try:
+        rec = flightrec_lib.recorder()
+        # Clean process (no pending events): nothing written.
+        rec.record_submit("allreduce.g1", "allreduce")
+        rec.record_complete("allreduce.g1")
+        flightrec_lib._finalize()
+        assert not os.path.exists(rec.box_path())
+        # Wedged process (collective still in flight): the exit box.
+        rec.record_submit("allreduce.g2", "allreduce")
+        flightrec_lib._finalize()
+        assert json.load(open(rec.box_path()))["trigger"] == "exit"
+    finally:
+        flightrec_lib._reset_for_tests()
+        shutdown_lib._reset_for_tests()
+
+
+def test_dump_pushes_box_to_controller_kv(tmp_path, monkeypatch):
+    """A dumped box also lands in the rendezvous KV
+    (``flightrec/blackbox.<rank>``) so the driver can collect boxes
+    from ranks whose filesystem it cannot read."""
+    from horovod_tpu.runner.rendezvous import RendezvousServer
+
+    rdv = RendezvousServer("127.0.0.1")
+    port = rdv.start()
+    try:
+        monkeypatch.setenv("HVD_TPU_RENDEZVOUS", f"127.0.0.1:{port}")
+        r = _rec(tmp_path, rank=2, push=True)
+        r.record_submit("allreduce.g1", "allreduce")
+        assert r.dump("sigusr2") is not None
+        raw = rdv.scope_items(flightrec_lib.KV_SCOPE)["blackbox.2"]
+        box = json.loads(raw.decode())
+        assert box["rank"] == 2 and box["trigger"] == "sigusr2"
+        assert flight_diff.BLACKBOX_KEYS == tuple(box.keys())
+    finally:
+        rdv.stop()
+
+
+def test_dump_survives_dead_kv(tmp_path, monkeypatch):
+    """A dead controller must not delay or break the local dump."""
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS", "127.0.0.1:1")
+    r = _rec(tmp_path, push=True)
+    r.record_submit("allreduce.g1", "allreduce")
+    assert r.dump("sigusr2") is not None
+    assert os.path.exists(r.box_path())
+
+
+# -- flight_diff cross-rank alignment ---------------------------------------
+
+def _box(rank, events, host="", trigger="sigusr2", step=0):
+    return {"schema": 1, "rank": rank, "host": host, "pid": 100 + rank,
+            "trigger": trigger, "reason": "", "t_unix": 0.0,
+            "step": step,
+            "seq_head": max((e["seq"] for e in events), default=0),
+            "events": events, "stacks": {}, "stall_inflight": {},
+            "recovery": {}}
+
+
+def _ev(seq, name="allreduce.grad", step=0, outcome="ok",
+        t0=0.0, t1=0.001):
+    return {"seq": seq, "op": "allreduce", "name": name, "step": step,
+            "bytes": 64, "wire": "none", "t_submit": t0,
+            "t_complete": (t1 if outcome == "ok" else None),
+            "outcome": outcome}
+
+
+def test_flight_diff_names_missing_and_incomplete_ranks():
+    """The acceptance sentence: 'rank 2 never submitted allreduce for
+    bucket 12 at step 4812' — from boxes alone."""
+    boxes = {
+        0: _box(0, [_ev(1), _ev(2, name="allreduce.bucket12",
+                              step=4812)]),
+        1: _box(1, [_ev(1), _ev(2, name="allreduce.bucket12",
+                              step=4812, outcome="stalled")],
+                trigger="stall_timeout"),
+        2: _box(2, [_ev(1)]),
+    }
+    rep = flight_diff.analyze(boxes)
+    assert rep["ranks"] == [0, 1, 2]
+    assert rep["common_completed_seq"] == 1
+    (finding,) = rep["findings"]
+    assert finding["seq"] == 2
+    assert finding["name"] == "allreduce.bucket12"
+    assert finding["step"] == 4812
+    assert finding["missing_ranks"] == [2]
+    assert finding["incomplete_ranks"] == [1]
+    verdicts = "\n".join(finding["verdicts"])
+    assert "rank 2 never submitted allreduce.bucket12" in verdicts
+    assert "rank 1 never completed allreduce.bucket12" in verdicts
+    assert "step 4812" in verdicts
+    assert rep["laggard_rank"] in (1, 2)
+
+
+def test_flight_diff_clean_boxes_have_no_findings():
+    boxes = {r: _box(r, [_ev(1), _ev(2)]) for r in range(3)}
+    rep = flight_diff.analyze(boxes)
+    assert rep["findings"] == []
+    assert rep["common_completed_seq"] == 2
+
+
+def test_flight_diff_scrolled_out_seq_is_unknown_not_missing():
+    """A seq below some rank's ring floor must not be judged — a small
+    ring forgetting old events is not evidence of divergence."""
+    boxes = {
+        0: _box(0, [_ev(s) for s in range(5, 9)]),   # ring kept 5..8
+        1: _box(1, [_ev(s) for s in range(1, 9)]),
+    }
+    rep = flight_diff.analyze(boxes)
+    assert rep["findings"] == []
+
+
+def test_flight_diff_duration_skew_attributes_slowest_rank():
+    boxes = {
+        0: _box(0, [_ev(1, t0=0.0, t1=0.010)]),
+        1: _box(1, [_ev(1, t0=5.0, t1=5.090)]),   # per-host clocks
+    }
+    skew = flight_diff.duration_skew(boxes)
+    assert skew["aligned_events"] == 1
+    assert skew["top_skew"][0]["slowest_rank"] == 1
+    assert skew["max_skew_ms"] == pytest.approx(80.0, abs=1.0)
+
+
+def test_flight_diff_cli_json_and_exit_codes(tmp_path, capsys,
+                                             monkeypatch):
+    for r in range(2):
+        (tmp_path / f"blackbox.rank{r}.json").write_text(
+            json.dumps(_box(r, [_ev(1)] if r == 0 else [])))
+    # Drive through argv like an operator would.
+    monkeypatch.setattr(sys, "argv",
+                        ["flight_diff.py", str(tmp_path), "--json"])
+    assert flight_diff.main() == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ranks"] == [0, 1]
+    assert any("rank 1 never submitted" in v
+               for f in rep["findings"] for v in f["verdicts"])
+    # No boxes: exit 2.
+    monkeypatch.setattr(sys, "argv",
+                        ["flight_diff.py", str(tmp_path / "empty")])
+    assert flight_diff.main() == 2
+
+
+# -- the ordered shutdown sequence ------------------------------------------
+
+def test_shutdown_sequence_runs_in_priority_order():
+    shutdown_lib._reset_for_tests()
+    try:
+        order = []
+        shutdown_lib.register("stats", lambda: order.append("stats"),
+                              shutdown_lib.RECOVERY_STATS_PRIORITY)
+        shutdown_lib.register("ctx", lambda: order.append("ctx"),
+                              shutdown_lib.CONTEXT_PRIORITY)
+        shutdown_lib.register("flight", lambda: order.append("flight"),
+                              shutdown_lib.FLIGHTREC_PRIORITY)
+        shutdown_lib.run()
+        assert order == ["flight", "ctx", "stats"]
+        # Idempotent: the atexit firing after an explicit run is a noop.
+        shutdown_lib.run()
+        assert order == ["flight", "ctx", "stats"]
+    finally:
+        shutdown_lib._reset_for_tests()
+
+
+def test_shutdown_failing_callback_is_isolated():
+    shutdown_lib._reset_for_tests()
+    try:
+        order = []
+
+        def boom():
+            order.append("boom")
+            raise RuntimeError("teardown bug")
+
+        shutdown_lib.register("a", boom, 10)
+        shutdown_lib.register("b", lambda: order.append("b"), 20)
+        shutdown_lib.run()
+        assert order == ["boom", "b"]
+    finally:
+        shutdown_lib._reset_for_tests()
+
+
+def test_shutdown_registration_is_idempotent_per_name():
+    shutdown_lib._reset_for_tests()
+    try:
+        order = []
+        shutdown_lib.register("x", lambda: order.append("old"), 10)
+        shutdown_lib.register("x", lambda: order.append("new"), 10)
+        shutdown_lib.unregister("nope")     # unknown: harmless
+        shutdown_lib.run()
+        assert order == ["new"]
+    finally:
+        shutdown_lib._reset_for_tests()
+
+
+def test_shutdown_thread_safe_registration():
+    shutdown_lib._reset_for_tests()
+    try:
+        hits = []
+        threads = [threading.Thread(
+            target=lambda i=i: shutdown_lib.register(
+                f"t{i}", lambda i=i: hits.append(i), i))
+            for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        shutdown_lib.run()
+        assert hits == sorted(hits) and len(hits) == 16
+    finally:
+        shutdown_lib._reset_for_tests()

@@ -23,10 +23,23 @@ mix, cache hit rate, and fusion fill land next to the device-trace
 numbers, and a merged ``per_step`` report compares the host-side step
 histogram against the device Steps track. With ``--metrics`` the trace
 itself is optional — a metrics-only report still prints (message,
-rc 0). Usage:
+rc 0).
+
+Multi-rank dumps: ``hvdtpurun --metrics-file base.jsonl`` writes one
+``base.jsonl.rank<k>`` per worker; ``--metrics base.jsonl`` GLOBS the
+suffixed siblings (``.rank<k>`` and the legacy bare ``.<k>``) and
+reports BOTH a per-rank view (``metrics_per_rank``) and a merged pod
+view (summed bytes/recovery, per-rank step means + the step skew) —
+instead of silently reading rank 0 only.
+
+``--flight DIR`` overlays the flight-recorder black boxes
+(``HVD_TPU_FLIGHTREC_DIR`` — docs/podmon.md): cross-rank alignment by
+collective seq (which rank never arrived where, via
+``tools/flight_diff.py``) plus per-collective duration skew next to
+the per-step report. Usage:
 
     python tools/analyze_trace.py results/tpu_r05/trace_resnet50 \
-        [--metrics results/metrics.jsonl]
+        [--metrics results/metrics.jsonl] [--flight results/blackbox]
 
 Prints ONE JSON object.
 """
@@ -36,6 +49,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import statistics
 import sys
 from collections import defaultdict
@@ -69,6 +83,112 @@ def load_metrics_snapshot(path: str):
     except OSError:
         return None
     return last
+
+
+def load_rank_dumps(path: str) -> dict:
+    """{rank: last-snapshot record} for a --metrics argument. A bare
+    file with no suffixed siblings is rank 0 alone (the historical
+    single-dump behavior); ``hvdtpurun --metrics-file`` writes
+    ``<path>.rank<k>`` per worker (legacy launches wrote ``<path>.<k>``)
+    and all of them are merged here — the report used to silently read
+    rank 0's file only."""
+    out = {}
+    suffixed = re.compile(re.escape(os.path.basename(path))
+                          + r"\.(?:rank)?(\d+)$")
+    directory = os.path.dirname(path) or "."
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in names:
+        m = suffixed.match(name)
+        if not m:
+            continue
+        rec = load_metrics_snapshot(os.path.join(directory, name))
+        if rec is not None:
+            out[int(m.group(1))] = rec
+    if os.path.exists(path):
+        rec = load_metrics_snapshot(path)
+        if rec is not None:
+            # The bare file is rank 0's (single-proc runs write it
+            # unsuffixed); an explicit .rank0 sibling wins.
+            out.setdefault(0, rec)
+    return out
+
+
+def merge_rank_summaries(per_rank: dict) -> dict:
+    """One pod view from per-rank summaries: extensive quantities
+    (bytes, counts, recovery events) sum; step time reports per-rank
+    means plus the pod skew — the number a single-rank report cannot
+    show (docs/podmon.md)."""
+    ranks = sorted(per_rank)
+    out = {"ranks": ranks}
+    by_rank_mean = {}
+    total_count = 0
+    total_sum_ms = 0.0
+    for r in ranks:
+        s = per_rank[r].get("step_seconds")
+        if s:
+            by_rank_mean[str(r)] = s["mean_ms"]
+            total_count += s["count"]
+            total_sum_ms += s["mean_ms"] * s["count"]
+    if by_rank_mean:
+        out["step_mean_ms_by_rank"] = by_rank_mean
+        out["step_seconds"] = {
+            "count": total_count,
+            "mean_ms": round(total_sum_ms / max(total_count, 1), 3),
+        }
+        if len(by_rank_mean) >= 2:
+            vals = list(by_rank_mean.values())
+            out["step_skew_ms"] = round(max(vals) - min(vals), 3)
+            out["slowest_rank"] = int(max(by_rank_mean,
+                                          key=by_rank_mean.get))
+    wire = {}
+    recovery = {}
+    infeed_total_s = 0.0
+    for r in ranks:
+        for w, v in per_rank[r].get("allreduce_bytes_on_wire",
+                                    {}).items():
+            wire[w] = wire.get(w, 0) + v
+        for k, v in per_rank[r].get("recovery", {}).items():
+            recovery[k] = recovery.get(k, 0) + v
+        iw = per_rank[r].get("infeed_wait")
+        if iw:
+            infeed_total_s += iw.get("total_s", 0.0)
+    if wire:
+        out["allreduce_bytes_on_wire"] = wire
+    if recovery:
+        out["recovery"] = recovery
+    if infeed_total_s:
+        out["infeed_wait_total_s"] = round(infeed_total_s, 3)
+    rates = [per_rank[r]["cache_hit_rate"] for r in ranks
+             if "cache_hit_rate" in per_rank[r]]
+    if rates:
+        out["cache_hit_rate"] = round(sum(rates) / len(rates), 3)
+    return out
+
+
+def summarize_flight(flight_dir: str) -> dict:
+    """Black-box overlay (tools/flight_diff.py): cross-rank divergence
+    verdicts + per-collective duration skew."""
+    try:
+        import flight_diff
+    except ImportError:
+        from tools import flight_diff  # imported as a package module
+    boxes = flight_diff.load_all(flight_dir)
+    if not boxes:
+        return {"note": f"no blackbox.rank*.json under {flight_dir}"}
+    report = flight_diff.analyze(boxes)
+    skew = flight_diff.duration_skew(boxes)
+    return {
+        "ranks": report["ranks"],
+        "common_completed_seq": report["common_completed_seq"],
+        "laggard_rank": report["laggard_rank"],
+        "verdicts": [v for f in report["findings"]
+                     for v in f["verdicts"]],
+        "max_duration_skew_ms": skew["max_skew_ms"],
+        "top_skew": skew["top_skew"][:5],
+    }
 
 
 def summarize_metrics(rec: dict) -> dict:
@@ -148,17 +268,35 @@ def _track_kind(thread_name: str) -> str:
     return "other"
 
 
-def main(root: str, metrics_path: str = None) -> int:
-    metrics_rec = (load_metrics_snapshot(metrics_path)
-                   if metrics_path else None)
+def main(root: str, metrics_path: str = None,
+         flight_dir: str = None) -> int:
+    rank_recs = load_rank_dumps(metrics_path) if metrics_path else {}
+    per_rank_sums = {r: summarize_metrics(rec)
+                     for r, rec in rank_recs.items()}
+    if len(rank_recs) > 1:
+        metrics_summary = merge_rank_summaries(per_rank_sums)
+        metrics_by_rank = {str(r): per_rank_sums[r]
+                           for r in sorted(per_rank_sums)}
+    elif rank_recs:
+        metrics_summary = next(iter(per_rank_sums.values()))
+        metrics_by_rank = None
+    else:
+        metrics_summary = metrics_by_rank = None
+    flight = summarize_flight(flight_dir) if flight_dir else None
     path = find_trace(root)
     if path is None:
-        if metrics_rec is not None:
-            # Metrics-only degrade: the dump still answers "where did
-            # time/bytes go" even when no device capture exists.
+        if metrics_summary is not None or flight is not None:
+            # Metrics/flight-only degrade: the dumps still answer
+            # "where did time/bytes go" / "who never arrived" even
+            # when no device capture exists.
             out = {"note": f"no *.trace.json.gz under {root}; "
-                           "metrics-only report",
-                   "metrics": summarize_metrics(metrics_rec)}
+                           "metrics-only report"}
+            if metrics_summary is not None:
+                out["metrics"] = metrics_summary
+            if metrics_by_rank is not None:
+                out["metrics_per_rank"] = metrics_by_rank
+            if flight is not None:
+                out["flight"] = flight
             print(json.dumps(out, indent=2))
             return 0
         print(json.dumps({"note": f"no *.trace.json.gz under {root} "
@@ -299,9 +437,11 @@ def main(root: str, metrics_path: str = None) -> int:
             "p50_ms": round(statistics.median(step_durs) / 1000, 3),
             "max_ms": round(step_durs[-1] / 1000, 3),
         }
-    if metrics_rec is not None:
-        mx = summarize_metrics(metrics_rec)
+    if metrics_summary is not None:
+        mx = metrics_summary
         out["metrics"] = mx
+        if metrics_by_rank is not None:
+            out["metrics_per_rank"] = metrics_by_rank
         # Merged per-step report: host-side step histogram (registry)
         # next to the device Steps track — a gap between them is host
         # overhead / dispatch serialization the device trace can't see.
@@ -319,6 +459,8 @@ def main(root: str, metrics_path: str = None) -> int:
                 3)
         if per_step:
             out["per_step"] = per_step
+    if flight is not None:
+        out["flight"] = flight
     print(json.dumps(out, indent=2))
     return 0
 
@@ -328,7 +470,15 @@ if __name__ == "__main__":
     p.add_argument("root", nargs="?", default=".",
                    help="profile dir from bench.py --profile-dir")
     p.add_argument("--metrics", default=None,
-                   help="metrics JSON-lines file (HVD_TPU_METRICS_FILE) "
-                        "to merge into the report")
+                   help="metrics JSON-lines file (HVD_TPU_METRICS_FILE)"
+                        " to merge into the report; per-rank "
+                        ".rank<k>-suffixed siblings are globbed into a "
+                        "per-rank + merged view")
+    p.add_argument("--flight", default=None,
+                   help="flight-recorder black-box dir "
+                        "(HVD_TPU_FLIGHTREC_DIR) to overlay: cross-rank "
+                        "divergence verdicts + collective duration skew "
+                        "(tools/flight_diff.py)")
     args = p.parse_args()
-    sys.exit(main(args.root, metrics_path=args.metrics))
+    sys.exit(main(args.root, metrics_path=args.metrics,
+                  flight_dir=args.flight))

@@ -682,6 +682,218 @@ def run_autoscale_soak(workdir: str, steps: int = 120, seed: int = 42,
     return record
 
 
+# -- the stall family (docs/podmon.md) ---------------------------------------
+
+def stall_plan(seed: int) -> dict:
+    """The hung-collective acceptance plan (ISSUE 9): hostB is a
+    persistent honest straggler (visible skew on the pod scrape) whose
+    4th collective then stalls past the shutdown threshold — the
+    watchdog must escalate (StallTimeoutError), every rank must dump a
+    flight-recorder black box, and the elastic retry must carry the
+    job to completion. Timing contract (FORCE_LOCAL worlds are
+    DECOUPLED — the healthy rank does not wedge in the collective the
+    way a real pod would): rank 1 must exit while rank 0 is still
+    stepping, or there is no live survivor for the driver's SIGUSR2
+    fan-out. Rank 1 exits after ~4 straggled steps + the 1.2 s stall +
+    watchdog/restore overhead (~3 s); rank 0's floor is steps*pace
+    (60*0.12 = 7.2 s) — keep that margin when retuning."""
+    return {"seed": seed, "faults": [
+        {"site": "straggler", "step": 1, "times": 0, "host": "hostB",
+         "delay_s": 0.2},
+        {"site": "collective_stall", "step": 4, "times": 1,
+         "host": "hostB", "delay_s": 1.2},
+    ]}
+
+
+def stall_policy() -> dict:
+    """Autoscale policy for the stall soak: publication ON (the pod
+    scrape needs per-rank step-time gauges) but every decision trigger
+    effectively off — the flight-recorder story must not race an
+    eviction."""
+    return {
+        "tick_interval_s": 0.25,
+        "publish_interval_s": 0.0,
+        "window": 8,
+        "straggler_ratio": 50.0,
+        "straggler_patience": 99,
+        "min_ranks": 3,
+        "grow_min_comm_fraction": 0.0,
+    }
+
+
+def _scrape_pod_metrics(port: int, stop, captured: dict) -> None:
+    """Poll the driver's /pod/metrics until the run ends, keeping the
+    last scrape that shows step-time series for >=2 ranks."""
+    import re as re_lib
+    import time
+    import urllib.request
+
+    pat = re_lib.compile(
+        r'^hvd_tpu_pod_step_time_seconds\{[^}]*rank="(\d+)"[^}]*\} '
+        r'([0-9.eE+-]+)$', re_lib.M)
+    skew_pat = re_lib.compile(
+        r"^hvd_tpu_pod_step_skew_seconds (\S+)$", re_lib.M)
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/pod/metrics",
+                    timeout=2.0) as resp:
+                text = resp.read().decode()
+            ranks = {int(r): float(v) for r, v in pat.findall(text)}
+            m = skew_pat.search(text)
+            if len(ranks) >= 2 and m:
+                skew = float(m.group(1))
+                if skew > captured.get("skew", -1.0):
+                    captured.update({"ranks": ranks, "skew": skew,
+                                     "text": text})
+        except OSError:
+            pass
+        time.sleep(0.3)
+
+
+def run_stall_soak(workdir: str, steps: int = 60, seed: int = 42,
+                   plan: dict | None = None) -> dict:
+    """One seeded stall-family run: injected ``collective_stall`` →
+    watchdog escalation (HVD_TPU_STALL_FATAL=raise) → black boxes on
+    EVERY rank (the stalled rank at watchdog latch, the healthy ranks
+    via the driver's SIGUSR2 fan-out) → ``flight_diff`` names the
+    hung collective → elastic retry finishes the job. Also proves the
+    pod aggregator live: ``--pod-metrics-port`` is set, and one scrape
+    of /pod/metrics must show rank-labeled step-time series for every
+    rank plus a nonzero skew under the injected straggler."""
+    import threading
+
+    import numpy as np
+
+    from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.runner import launch as launch_lib
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train_stall.py")
+    with open(train_py, "w") as f:
+        f.write(AUTOSCALE_SCRIPT)  # the paced elastic job fits as-is
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    boxdir = os.path.join(workdir, "blackbox")
+    plan = plan if plan is not None else stall_plan(seed)
+    pace = 0.12
+    pod_port = launch_lib._free_port()
+
+    overrides = {
+        "HVD_TPU_ELASTIC_FORCE_LOCAL": "1",
+        "HVD_TPU_ELASTIC_RESET_LIMIT": "40",
+        "HVD_TPU_ELASTIC_GRACE_SECS": "1.5",
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_FAULT_LOG": fault_log,
+        # Watchdog escalation: warn fast, shutdown < the injected
+        # delay, raise the typed StallTimeoutError into elastic.
+        "HVD_TPU_STALL_CHECK_TIME_SECONDS": "0.25",
+        "HVD_TPU_STALL_SHUTDOWN_TIME_SECONDS": "0.8",
+        "HVD_TPU_STALL_FATAL": "raise",
+        "HVD_TPU_FLIGHTREC_DIR": boxdir,
+        "HVD_TPU_FLIGHTREC_SIGNAL_GRACE_S": "0.8",
+        # Publication on, decisions off: the pod scrape needs per-rank
+        # step-time series (autoscale publisher feeds the gauges).
+        "HVD_TPU_AUTOSCALE": "1",
+        "HVD_TPU_AUTOSCALE_POLICY": json.dumps(stall_policy()),
+        "HVD_TPU_POD_METRICS_INTERVAL_S": "0.3",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    saved_env = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    stop = threading.Event()
+    captured: dict = {}
+    scraper = threading.Thread(
+        target=_scrape_pod_metrics, args=(pod_port, stop, captured),
+        daemon=True)
+    scraper.start()
+    try:
+        rc = launch_lib.run_commandline(
+            ["-np", "2", "--elastic", "--min-np", "1", "--max-np", "2",
+             "-H", "hostA:1,hostB:1",
+             "--pod-metrics-port", str(pod_port), "--",
+             sys.executable, train_py, workdir, str(steps), str(pace)])
+    finally:
+        stop.set()
+        scraper.join(timeout=5)
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        faults_lib.uninstall()
+
+    assert rc == 0, f"stall soak: elastic run failed rc={rc}"
+    with open(os.path.join(workdir, "ckpt", "state.pkl"), "rb") as f:
+        final = pickle.load(f)
+    step = int(np.asarray(final["step"]))
+    assert step == steps, f"finished at step {step}, wanted {steps}"
+
+    # (a) black boxes on EVERY rank: the stalled rank dumped at
+    # watchdog latch time, the healthy rank on the driver's SIGUSR2.
+    import tools.flight_diff as flight_diff
+
+    boxes = flight_diff.load_all(boxdir)
+    assert set(boxes) == {0, 1}, \
+        f"expected black boxes for ranks 0 and 1 under {boxdir}, " \
+        f"got {sorted(boxes)}"
+    assert boxes[1]["trigger"] == "stall_timeout", boxes[1]["trigger"]
+    assert "allreduce.grad" in boxes[1]["reason"], boxes[1]["reason"]
+
+    # (b) flight_diff names the injected-stall rank and the exact
+    # collective (op + signature + step) it failed to complete.
+    report = flight_diff.analyze(boxes)
+    verdicts = [v for f in report["findings"] for v in f["verdicts"]]
+    named = [v for v in verdicts
+             if "rank 1 never completed allreduce.grad" in v
+             and "op=allreduce" in v and "step" in v]
+    assert named, f"flight_diff must name the hung collective on " \
+                  f"rank 1; verdicts: {verdicts[:5]}"
+    assert report["laggard_rank"] == 1, report
+    hung = [f for f in report["findings"] if 1 in f["incomplete_ranks"]]
+    assert hung and hung[0]["name"] == "allreduce.grad" \
+        and hung[0]["op"] == "allreduce", hung[:1]
+
+    # (c) the live pod scrape: rank-labeled step-time series for both
+    # ranks + nonzero skew under the injected straggler.
+    assert captured.get("ranks") and set(captured["ranks"]) == {0, 1}, \
+        f"/pod/metrics must expose step-time series for both ranks, " \
+        f"captured: {sorted(captured.get('ranks', {}))}"
+    assert captured["skew"] > 0.05, \
+        f"injected 0.25s/step straggler must show as pod step skew, " \
+        f"got {captured['skew']}"
+    assert captured["ranks"][1] > captured["ranks"][0], captured["ranks"]
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert {"collective_stall", "straggler"} <= sites, sorted(sites)
+    return {
+        "metric": "chaos_soak_stall",
+        "seed": seed,
+        "steps": steps,
+        "rc": rc,
+        "final_step": step,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "blackbox_ranks": sorted(boxes),
+        "hung_collective": {k: hung[0][k]
+                            for k in ("seq", "op", "name", "step")},
+        "pod_step_skew_seconds": captured["skew"],
+        # The determinism contract for --repeat: wall-clock pacing makes
+        # epoch counts timing-dependent, so (like the autoscale live
+        # run) the repeated assertion is the INVARIANT set, not a
+        # byte-identical log.
+        "sequences": {
+            "invariants": {
+                "sites": sorted(sites),
+                "stalled_rank": 1,
+                "hung_op": hung[0]["op"],
+                "hung_name": hung[0]["name"],
+                "blackbox_ranks": sorted(boxes),
+            }
+        },
+    }
+
+
 def run_soak(workdir: str, steps: int = 12, seed: int = 42,
              plan: dict | None = None) -> dict:
     """One seeded chaos run; returns the validated record. Raises
@@ -756,18 +968,23 @@ def run_soak(workdir: str, steps: int = 12, seed: int = 42,
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
-                                         "autoscale"),
+                                         "autoscale", "stall"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
                          "detector/verified-checkpoint stack; "
                          "autoscale = straggler/preempt-storm/flap "
                          "faults through the telemetry-driven control "
-                         "plane (decision-log determinism)")
+                         "plane (decision-log determinism); "
+                         "stall = a hung collective through the "
+                         "watchdog -> flight-recorder black box -> "
+                         "flight_diff attribution -> elastic retry "
+                         "path, with the pod aggregator scraped live "
+                         "(docs/podmon.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
-                         "autoscale: 120 — its control loop needs a "
-                         "seconds-scale run)")
+                         "autoscale: 120, stall: 60 — their control "
+                         "loops need a seconds-scale run)")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--repeat", type=int, default=1,
                     help=">1: rerun the same seed and assert identical "
@@ -777,9 +994,10 @@ def main() -> int:
     args = ap.parse_args()
 
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
-            "autoscale": run_autoscale_soak}[args.family]
+            "autoscale": run_autoscale_soak,
+            "stall": run_stall_soak}[args.family]
     if args.steps is None:
-        args.steps = 120 if args.family == "autoscale" else 12
+        args.steps = {"autoscale": 120, "stall": 60}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

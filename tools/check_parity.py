@@ -270,6 +270,102 @@ def check_mfu_surface(missing: list) -> None:
             missing.append(f"api: {name} undocumented in docs/api.md")
 
 
+def check_podmon_surface(missing: list) -> None:
+    """The pod-observability layer (docs/podmon.md): every
+    ``HVD_TPU_FLIGHTREC_*`` / ``HVD_TPU_POD_METRICS_*`` knob, every
+    flight-recorder and pod-level metric, and the ``--pod-metrics-port``
+    CLI flag must be documented, and the black-box JSON schema must
+    round-trip through ``tools/flight_diff.py`` — the writer's and
+    reader's key tuples are compared byte for byte so the schema cannot
+    drift. Parsed textually (runs without jax installed)."""
+    doc = REPO / "docs" / "podmon.md"
+    if not doc.exists():
+        missing.append("path: docs/podmon.md")
+        return
+    text = doc.read_text()
+    flightrec_src = (REPO / "horovod_tpu" / "common"
+                     / "flightrec.py").read_text()
+    podmon_src = (REPO / "horovod_tpu" / "common"
+                  / "podmon.py").read_text()
+    driver_src = (REPO / "horovod_tpu" / "runner"
+                  / "elastic_driver.py").read_text()
+    metrics_doc = REPO / "docs" / "metrics.md"
+    metrics_text = metrics_doc.read_text() if metrics_doc.exists() else ""
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+
+    # Knobs: every HVD_TPU_* literal the layer consults.
+    env_lit = re.compile(r'"(HVD_TPU_[A-Z0-9_]+)"')
+    knobs = set(env_lit.findall(flightrec_src))
+    knobs |= set(env_lit.findall(podmon_src))
+    knobs |= {k for k in env_lit.findall(driver_src)
+              if "FLIGHTREC" in k or "POD_METRICS" in k}
+    knobs |= {"HVD_TPU_METRICS_DEBUG"}       # the /debug arm switch
+    # Consulted identity/env plumbing, not knobs of this layer.
+    knobs -= {"HVD_TPU_RENDEZVOUS", "HVD_TPU_PROC_ID",
+              "HVD_TPU_HOSTNAME", "HVD_TPU_ELASTIC_FORCE_LOCAL"}
+    if not any("FLIGHTREC" in k for k in knobs):
+        missing.append("podmon: no HVD_TPU_FLIGHTREC_* knobs parsed")
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"podmon knob {k}: undocumented in "
+                           "docs/podmon.md")
+
+    # Metrics: registry-constructed (flightrec) + computed pod families
+    # (emitted straight into the /pod/metrics exposition, so the
+    # registry scan in check_metrics_surface cannot see them).
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"(hvd_tpu_[a-z0-9_]+)"')
+    names = set(reg_call.findall(flightrec_src))
+    names |= set(re.findall(r'"(hvd_tpu_pod_[a-z0-9_]+)"', podmon_src))
+    if not any(n.startswith("hvd_tpu_pod_") for n in names):
+        missing.append("podmon: no hvd_tpu_pod_* families parsed")
+    for n in sorted(names):
+        for where, t in (("docs/podmon.md", text),
+                         ("docs/metrics.md", metrics_text)):
+            if n not in t:
+                missing.append(f"podmon metric {n}: undocumented in "
+                               f"{where}")
+
+    # The launcher flag.
+    launch_src = (REPO / "horovod_tpu" / "runner"
+                  / "launch.py").read_text()
+    if "--pod-metrics-port" not in launch_src:
+        missing.append("podmon: launch.py lacks --pod-metrics-port")
+    for where, t in (("docs/podmon.md", text), ("docs/api.md", api_text)):
+        if "--pod-metrics-port" not in t:
+            missing.append("podmon: --pod-metrics-port undocumented in "
+                           f"{where}")
+    for name in ("hvd.flight_recorder()", "flight_diff.py",
+                 "/debug/stacks", "/debug/profile"):
+        if name not in api_text:
+            missing.append(f"api: {name} undocumented in docs/api.md")
+
+    # Black-box schema round-trip: the writer's and the reader's key
+    # tuples must be LITERALLY identical (flight_diff must run on a
+    # machine with nothing but the boxes, so it carries a copy).
+    tup = re.compile(
+        r"^(BLACKBOX_KEYS|EVENT_KEYS) = (\([^)]*\))", re.M | re.S)
+    writer = dict(tup.findall(flightrec_src))
+    reader = dict(tup.findall(
+        (REPO / "tools" / "flight_diff.py").read_text()))
+    for key in ("BLACKBOX_KEYS", "EVENT_KEYS"):
+        if key not in writer or key not in reader:
+            missing.append(f"podmon schema: {key} missing from "
+                           "flightrec.py or flight_diff.py")
+        elif re.sub(r"\s+", " ", writer[key]) != \
+                re.sub(r"\s+", " ", reader[key]):
+            missing.append(
+                f"podmon schema drift: {key} differs between "
+                "common/flightrec.py and tools/flight_diff.py")
+    ver = re.compile(r"^BLACKBOX_SCHEMA_VERSION = (\d+)", re.M)
+    wv = ver.search(flightrec_src)
+    rv = ver.search((REPO / "tools" / "flight_diff.py").read_text())
+    if not wv or not rv or wv.group(1) != rv.group(1):
+        missing.append("podmon schema drift: BLACKBOX_SCHEMA_VERSION "
+                       "differs between writer and reader")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -311,6 +407,7 @@ def main() -> int:
     check_topology_surface(missing)
     check_autoscale_surface(missing)
     check_mfu_surface(missing)
+    check_podmon_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
