@@ -200,7 +200,7 @@ def main():
                    choices=["resnet50", "resnet101", "resnet152",
                             "vgg16", "vgg19", "inception3",
                             "vit_base", "bert_large", "bert_base",
-                            "gpt_small", "gpt_medium"])
+                            "gpt_small", "gpt_medium", "gpt_tiny"])
     p.add_argument("--overlap", action="store_true",
                    help="readiness-ordered gradient buckets + issue-"
                         "order chaining on the DistributedOptimizer "
@@ -240,6 +240,38 @@ def main():
     p.add_argument("--remat", action="store_true",
                    help="per-layer activation recomputation on the GPT "
                         "models (long-context HBM relief)")
+    p.add_argument("--moe", default="",
+                   help="GPT-MoE arm (docs/moe.md): "
+                        "'num_experts[,capacity_factor]' (e.g. 8,1.25) "
+                        "swaps every decoder layer's dense MLP for the "
+                        "expert-parallel MoE FFN — GShard top-2 gating "
+                        "+ alltoall dispatch over the rank axis (or "
+                        "the --mesh-shape route mesh). Drop-rate / "
+                        "expert-load / dispatch-byte fields land in "
+                        "the BENCH json. GPT models only")
+    p.add_argument("--moe-wire", default="",
+                   choices=["", "none", "bf16", "int8", "auto"],
+                   help="dispatch/combine alltoall payload format for "
+                        "--moe ('' = HVD_TPU_MOE_WIRE or none): bf16 "
+                        "cast (2x fewer bytes), block-scaled int8 "
+                        "(~4x), or auto (size-thresholded). Under "
+                        "--mesh-shape the format applies to the SLOW "
+                        "cross axis of the per-axis mesh_alltoall "
+                        "plan; fast axes stay exact")
+    p.add_argument("--moe-overlap", type=int, default=0,
+                   help="capacity-dim pipelining depth for --moe "
+                        "(0 = HVD_TPU_MOE_OVERLAP_CHUNKS or 1): "
+                        "dispatch-alltoall of chunk k+1 overlaps "
+                        "expert-FFN compute of chunk k via "
+                        "optimization_barrier chaining")
+    p.add_argument("--moe-router-noise", type=float, default=1.0,
+                   help="noisy-gating jitter std for --moe (Shazeer et "
+                        "al. 2017): an UNTRAINED router's init bias "
+                        "otherwise overflows capacity from step 0 "
+                        "(~13%% drops measured at capacity 1.25), "
+                        "charging the bench's drop-rate to init "
+                        "artifacts instead of real load. 0 disables "
+                        "(docs/moe.md runbook)")
     p.add_argument("--accum", type=int, default=1,
                    help="scan-based gradient accumulation: split the "
                         "per-rank batch into this many microbatches "
@@ -294,6 +326,13 @@ def main():
         p.error("--num-iters and --batches-per-iter must be >= 1")
     if args.accum < 1:
         p.error("--accum must be >= 1")
+    if args.moe and not args.model.startswith("gpt"):
+        p.error("--moe requires a gpt_* model")
+    if args.moe:
+        try:
+            _parse_moe_spec(args.moe)
+        except ValueError as e:
+            p.error(str(e))
 
     if not args._worker:
         return _supervise(sys.argv[1:], args.model)
@@ -385,6 +424,68 @@ def main():
     if note:
         result["note"] = note
     _emit(result)
+
+
+def _parse_moe_spec(spec):
+    """'num_experts[,capacity_factor]' -> (int, float | None); raises
+    ValueError with the offending text (argparse-friendly)."""
+    parts = [s.strip() for s in str(spec).split(",") if s.strip()]
+    if not parts or len(parts) > 2:
+        raise ValueError(f"--moe expects 'experts[,capacity]', got "
+                         f"{spec!r}")
+    try:
+        experts = int(parts[0])
+        cf = float(parts[1]) if len(parts) == 2 else None
+    except ValueError:
+        raise ValueError(f"--moe expects 'experts[,capacity]', got "
+                         f"{spec!r}") from None
+    if experts < 1 or (cf is not None and cf <= 0):
+        raise ValueError(f"--moe values must be positive, got {spec!r}")
+    return experts, cf
+
+
+def _moe_config(args, n):
+    """Resolved GPT-MoE arm config (model kwargs + record fields) or
+    None. Defaults fall back to the HVD_TPU_MOE_* knobs; under
+    --mesh-shape the dispatch rides a mesh_alltoall plan over the
+    routing mesh's axes with the --moe-wire format on the SLOW axis."""
+    if not args.moe:
+        return None
+    cached = getattr(args, "_moe_cfg", "unset")
+    if cached != "unset":
+        return cached
+    from horovod_tpu.common import basics
+
+    cfg = basics.context().config
+    experts, cf = _parse_moe_spec(args.moe)
+    if cf is None:
+        cf = cfg.moe_capacity_factor
+    wire = args.moe_wire or cfg.moe_wire or "none"
+    overlap = args.moe_overlap or cfg.moe_overlap_chunks or 1
+    rt = _routing(args)
+    axis, route = None, None
+    if rt is not None:
+        axes = list(rt["plan"].axis_names)  # fast first
+        # Slow-axis wire of the mesh_alltoall plan; "auto" means
+        # compress-where-the-slow-bytes-are, i.e. int8 on the cross hop
+        # (the bench slabs sit far above the size threshold).
+        slow = {"bf16": "bf16", "int8": "int8",
+                "auto": "int8"}.get(wire, "none")
+        route = ",".join([f"{a}:none" for a in axes[:-1]]
+                         + [f"{axes[-1]}:{slow}"])
+    elif n > 1:
+        import horovod_tpu as hvd
+
+        axis = hvd.rank_axis()
+    if experts % max(n, 1):
+        _log(f"--moe {experts} experts do not divide over {n} ranks; "
+             f"raising to {-(-experts // n) * n}")
+        experts = -(-experts // n) * n
+    out = {"experts": experts, "capacity_factor": cf, "wire": wire,
+           "overlap_chunks": int(overlap), "axis": axis, "route": route,
+           "router_noise": float(args.moe_router_noise)}
+    args._moe_cfg = out
+    return out
 
 
 def _routing(args):
@@ -657,7 +758,39 @@ def _run_benchmark_inner(args, n):
         "remat_policy": args.remat_policy,
         "prefetch": args.prefetch or None,
         "shard_update": _ARM["sharded"],
+        "moe": args.moe or None,
+        "moe_wire": (_moe_config(args, n) or {}).get("wire")
+        if args.moe else None,
+        "moe_overlap": (_moe_config(args, n) or {}).get("overlap_chunks")
+        if args.moe else None,
     }
+    moe_cfg = _moe_config(args, n) if is_gpt else None
+    if moe_cfg:
+        # The step output vector is [loss, dropped, frac, routed,
+        # load x E] (global — psum-ed in-layer); publish the drop/load
+        # gauges host-side and record the arm's health numbers the
+        # acceptance criteria read (drop-rate, load balance, dispatch
+        # bytes by wire from the alltoall byte family).
+        from horovod_tpu.parallel import moe as moe_lib
+
+        vec = np.asarray(jax.device_get(l)).reshape(-1)
+        e = moe_cfg["experts"]
+        load = vec[4:4 + e]
+        rec = moe_lib.record_moe_stats(
+            {"dropped_tokens": vec[1], "dropped_frac": vec[2],
+             "expert_load": load})
+        result["moe"] = {
+            "experts": e,
+            "capacity_factor": moe_cfg["capacity_factor"],
+            "wire": moe_cfg["wire"],
+            "route": moe_cfg["route"],
+            "overlap_chunks": moe_cfg["overlap_chunks"],
+            "router_noise": moe_cfg["router_noise"],
+            "final_loss": round(float(vec[0]), 4),
+            "dropped_frac": round(rec["dropped_frac"], 6),
+            "load_max_over_mean": round(
+                float(load.max() / max(load.mean(), 1e-9)), 3),
+        }
     if args.prefetch:
         # Infeed-wait delta over the TIMED window only (warmup waits
         # excluded): how long the step loop blocked on the next device
@@ -827,6 +960,21 @@ def _metrics_summary():
     elif planned:
         out["bytes_on_wire"] = planned
         out["bytes_basis"] = "planned_per_compile"
+    # Alltoall (MoE dispatch/combine) byte mix, same basis note as the
+    # allreduce family: in-jit exchanges stamp at trace time (planned
+    # per compile), eager calls per call on axis=flat.
+    a2a_wire, a2a_axis = {}, {}
+    for s in samples("hvd_tpu_alltoall_bytes_total"):
+        if not s["value"]:
+            continue
+        w = s["labels"].get("wire", "?")
+        ax = s["labels"].get("axis", "flat")
+        a2a_wire[w] = a2a_wire.get(w, 0) + s["value"]
+        a2a_axis.setdefault(ax, {})
+        a2a_axis[ax][w] = a2a_axis[ax].get(w, 0) + s["value"]
+    if a2a_wire:
+        out["alltoall_bytes_on_wire"] = a2a_wire
+        out["alltoall_bytes_by_axis"] = a2a_axis
     cache = {s["labels"].get("result", "?"): s["value"]
              for s in samples("hvd_tpu_eager_cache_total")}
     lookups = sum(cache.values())
@@ -1209,25 +1357,74 @@ def _setup_bert(args, batch_size, n):
                                      model.hidden_size, args.seq_len))
 
 
+def _moe_collect(inter, num_experts):
+    """Sum the sown MoE intermediates across layers: (aux_loss,
+    stats_vec) where stats_vec = [dropped_tokens, dropped_frac, routed,
+    expert_load x E] (fp32, already global — moe_layer psums over the
+    ep world)."""
+    import jax
+    import jax.numpy as jnp
+
+    aux = jnp.zeros((), jnp.float32)
+    dropped = jnp.zeros((), jnp.float32)
+    routed = jnp.zeros((), jnp.float32)
+    load = jnp.zeros((num_experts,), jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(inter)[0]:
+        ks = jax.tree_util.keystr(path)
+        if "moe_aux" in ks:
+            aux = aux + leaf
+        elif "dropped_tokens" in ks:
+            dropped = dropped + leaf
+        elif "routed_tokens" in ks:
+            routed = routed + leaf
+        elif "expert_load" in ks:
+            load = load + leaf
+    frac = dropped / jnp.maximum(routed, 1.0)
+    return aux, jnp.concatenate([dropped[None], frac[None],
+                                 routed[None], load])
+
+
 def _setup_gpt(args, batch_size, n):
     """Causal-LM pretraining step on the GPT decoder (next-token loss,
     AdamW, flash attention + RoPE) — the model family this framework
     adds beyond the reference's CNN + BERT benchmarks. No reference
-    number exists, so the BERT nominal per-device baseline stands in."""
+    number exists, so the BERT nominal per-device baseline stands in.
+    ``--moe`` swaps the dense MLPs for the expert-parallel MoE FFN
+    (docs/moe.md): the load-balancing aux loss joins the objective and
+    the step output grows the drop/load stats vector recorded into the
+    BENCH json."""
     import jax
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import gpt_medium, gpt_small
+    from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
 
-    model = (gpt_small if args.model == "gpt_small"
-             else gpt_medium)(remat=args.remat)
+    moe = _moe_config(args, n)
+    mkw = {}
+    if moe:
+        mkw = {"moe_experts": moe["experts"],
+               "moe_capacity_factor": moe["capacity_factor"],
+               "moe_axis": moe["axis"], "moe_route": moe["route"],
+               "moe_wire": moe["wire"] if moe["route"] is None
+               else "none",
+               "moe_overlap_chunks": moe["overlap_chunks"],
+               "moe_router_noise": moe["router_noise"]}
+    # gpt_tiny: the CPU-scale A/B model (the simulated-mesh MoE and
+    # routing arms need a decoder whose step fits a CPU budget; same
+    # methodology, the delta's SIGN is the evidence — docs/moe.md).
+    model = {"gpt_small": gpt_small, "gpt_medium": gpt_medium,
+             "gpt_tiny": gpt_tiny}[args.model](remat=args.remat, **mkw)
     rng = jax.random.PRNGKey(0)
     S = args.seq_len
     tokens = jax.random.randint(rng, (batch_size, S + 1), 0,
                                 model.vocab_size)
 
-    params = jax.jit(model.init)(rng, tokens[:, :-1])["params"]
+    # Init outside the SPMD region through a LOCAL clone (no bound ep
+    # axis at init time): the expert bank is replicated, so the param
+    # tree is identical to the sharded apply's.
+    init_model = model.clone(moe_axis=None, moe_route=None) if moe \
+        else model
+    params = jax.jit(init_model.init)(rng, tokens[:, :-1])["params"]
     _log("model.init done")
     import jax.numpy as jnp
 
@@ -1243,18 +1440,38 @@ def _setup_gpt(args, batch_size, n):
         (toks,) = data
 
         def loss_fn(p, tb):
+            if moe:
+                logits, mods = model.apply(
+                    {"params": p}, tb[:, :-1],
+                    mutable=["intermediates"],
+                    rngs={"gating": jax.random.PRNGKey(17)})
+                aux, stats = _moe_collect(mods["intermediates"],
+                                          moe["experts"])
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tb[:, 1:]).mean()
+                return ce + 0.01 * aux, stats
             logits = model.apply({"params": p}, tb[:, :-1])
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, tb[:, 1:]).mean()
 
         if args.accum > 1 or args.remat_policy != "none":
-            l, g = tx.accumulate(loss_fn)(p, toks)
+            out = tx.accumulate(loss_fn, has_aux=bool(moe))(p, toks)
         else:
-            l, g = jax.value_and_grad(loss_fn)(p, toks)
+            out = jax.value_and_grad(loss_fn,
+                                     has_aux=bool(moe))(p, toks)
+        if moe:
+            (l, stats), g = out
+        else:
+            l, g = out
         if pmean_axis is not None:
             l = jax.lax.pmean(l, pmean_axis)
         updates, st = tx.update(g, st, p)
         p = optax.apply_updates(p, updates)
+        if moe:
+            # Loss + the global drop/load stats ride one output vector
+            # (the stats are already replicated — psum-ed in-layer).
+            return p, st, jnp.concatenate(
+                [l.astype(jnp.float32)[None], stats])
         return p, st, l
 
     run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,),

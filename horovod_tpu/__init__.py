@@ -259,15 +259,19 @@ def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
 
 
 def alltoall(x, name: Optional[str] = None, splits=None, process_set=None,
-             chunked: Optional[bool] = None):
+             chunked: Optional[bool] = None, wire=None):
     """Even all-to-all, or — with ``splits`` — the dynamic uneven variant
     where recv splits are negotiated through the controller (reference:
     operations.cc:1020-1081, controller.h:56-58 AlltoallGetRecvSplits).
     See EagerEngine.alltoallv for the two call conventions. ``chunked``
     (extension) selects the uneven wire form: None auto-routes skewed
-    tables through the bounded per-hop exchange, True/False forces it."""
+    tables through the bounded per-hop exchange, True/False forces it.
+    ``wire`` (extension, docs/moe.md) compresses the exchanged payload:
+    ``"bf16"``/``"int8"``/``"auto"`` or a ``Compression`` class — part
+    of the compile-cache signature and the cross-rank contract; with
+    ``splits`` it requires the chunked form."""
     return _engine(process_set).alltoall(x, name, splits=splits,
-                                         chunked=chunked)
+                                         chunked=chunked, wire=wire)
 
 
 _rs_default_warned = False

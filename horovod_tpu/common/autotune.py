@@ -58,12 +58,16 @@ _M_SHARD = metrics_lib.gauge(
     "hvd_tpu_autotune_shard_update",
     "current weight-update-sharding toggle (0 = replicated, "
     "1 = ZeRO-1 sharded)")
+_M_MOE_WIRE_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_moe_wire_index",
+    "current MoE dispatch-wire candidate index "
+    "(see moe_wire_candidates order; 0 = none)")
 _M_CONVERGED = metrics_lib.gauge(
     "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
 _M_SAMPLES = metrics_lib.counter(
     "hvd_tpu_autotune_samples_total",
     "scored samples per configuration (config = threshold|hierarchical"
-    "|overlap|compression|route|accum|remat|shard)",
+    "|overlap|compression|route|accum|remat|shard|moe_wire)",
     labels=("config",))
 
 _MB = 1024 * 1024
@@ -86,6 +90,9 @@ class TunedPoint(NamedTuple):
     accum: int        # gradient-accumulation microbatch count
     remat: str        # remat-policy name ("none"/"dots"/...)
     shard: bool       # weight-update sharding (ZeRO-1) toggle
+    # MoE dispatch wire format ("none"/"bf16"/"int8" — docs/moe.md);
+    # defaulted so pre-existing 8-positional constructions keep working.
+    moe_wire: str = "none"
 
 
 def _phase_bound_accum_gate() -> bool:
@@ -196,6 +203,9 @@ class Autotuner:
                  remat_candidates: Sequence[str] = (
                      "none", "dots", "full"),
                  tune_shard: bool = False,
+                 tune_moe_wire: bool = False,
+                 moe_wire_candidates: Sequence[str] = (
+                     "none", "bf16", "int8"),
                  accum_gate: Optional[Callable[[], bool]] = None):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
@@ -244,6 +254,14 @@ class Autotuner:
         self.remat_candidates = (tuple(remat_candidates)
                                  if tune_remat else ("none",))
         self.tune_shard = tune_shard
+        # The MoE dispatch-wire axis (docs/moe.md): which payload
+        # format the expert-parallel alltoall carries — none / bf16 /
+        # int8. Same trade as the reduction-compression axis (wire
+        # bytes vs quantize overhead, plus an accuracy term the loss
+        # already prices), on the PERMUTE family.
+        self.tune_moe_wire = tune_moe_wire
+        self.moe_wire_candidates = (tuple(moe_wire_candidates)
+                                    if tune_moe_wire else ("none",))
         self.accum_gate = accum_gate
         self._accum_pruned = False
         hs = (0, 1) if tune_hierarchical else (0,)
@@ -253,10 +271,11 @@ class Autotuner:
         accs = tuple(range(len(self.accum_candidates)))
         rms = tuple(range(len(self.remat_candidates)))
         shs = (0, 1) if tune_shard else (0,)
+        mws = tuple(range(len(self.moe_wire_candidates)))
         self._space: List[Tuple[int, ...]] = [
-            (t, h, o, c, rt, a, m, s) for t in self.candidates for h in hs
-            for o in ovs for c in cs for rt in rs for a in accs
-            for m in rms for s in shs]
+            (t, h, o, c, rt, a, m, s, mw) for t in self.candidates
+            for h in hs for o in ovs for c in cs for rt in rs
+            for a in accs for m in rms for s in shs for mw in mws]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
@@ -285,6 +304,8 @@ class Autotuner:
             cols.append("remat")
         if tune_shard:
             cols.append("shard")
+        if tune_moe_wire:
+            cols.append("moe_wire")
         self._columns = tuple(cols)
         self._publish_metrics()
         if log_file:
@@ -367,8 +388,13 @@ class Autotuner:
             return bool(self._cur[7])
 
     @property
+    def current_moe_wire(self) -> str:
+        with self._tlock:
+            return self.moe_wire_candidates[self._cur[8]]
+
+    @property
     def current_full(self) -> TunedPoint:
-        """Atomic snapshot of the FULL tuned point (all 8 axes)."""
+        """Atomic snapshot of the FULL tuned point (all 9 axes)."""
         with self._tlock:
             return self._point_of(self._cur)
 
@@ -380,7 +406,8 @@ class Autotuner:
             route=self.route_candidates[cur[4]],
             accum=self.accum_candidates[cur[5]],
             remat=self.remat_candidates[cur[6]],
-            shard=bool(cur[7]))
+            shard=bool(cur[7]),
+            moe_wire=self.moe_wire_candidates[cur[8]])
 
     @property
     def done(self) -> bool:
@@ -448,7 +475,8 @@ class Autotuner:
                 f"|{self.compression_candidates[point[3]]}"
                 f"|{self.route_candidates[point[4]]}"
                 f"|{self.accum_candidates[point[5]]}"
-                f"|{self.remat_candidates[point[6]]}|{int(point[7])}")
+                f"|{self.remat_candidates[point[6]]}|{int(point[7])}"
+                f"|{self.moe_wire_candidates[point[8]]}")
 
     def _publish_metrics(self) -> None:
         """Mirror the live point into the metrics registry (called with
@@ -461,6 +489,7 @@ class Autotuner:
         _M_ACCUM.set(self.accum_candidates[self._cur[5]])
         _M_REMAT_IDX.set(self._cur[6])
         _M_SHARD.set(self._cur[7])
+        _M_MOE_WIRE_IDX.set(self._cur[8])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
     def _row(self, point: Tuple[int, ...]) -> List:
@@ -482,6 +511,8 @@ class Autotuner:
             row.append(self.remat_candidates[point[6]])
         if self.tune_shard:
             row.append(point[7])
+        if self.tune_moe_wire:
+            row.append(self.moe_wire_candidates[point[8]])
         return row
 
     def _log(self, point: Tuple[int, ...], score: float) -> None:
@@ -508,7 +539,7 @@ class Autotuner:
         return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2],
                 2.0 * point[3], 2.0 * point[4],
                 math.log2(max(self.accum_candidates[point[5]], 1)),
-                2.0 * point[6], 2.0 * point[7]]
+                2.0 * point[6], 2.0 * point[7], 2.0 * point[8]]
 
     def _maybe_prune_accum(self) -> None:
         """One-shot accumulation-space pruning, decided at the FIRST
@@ -596,7 +627,9 @@ class Autotuner:
                     + (", remat=%s" % self.remat_candidates[best[6]]
                        if self.tune_remat else "")
                     + (", shard_update=%s" % bool(best[7])
-                       if self.tune_shard else ""),
+                       if self.tune_shard else "")
+                    + (", moe_wire=%s" % self.moe_wire_candidates[best[8]]
+                       if self.tune_moe_wire else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

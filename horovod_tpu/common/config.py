@@ -141,6 +141,18 @@ class Config:
     # assign_wire_dtypes — the per-bucket overhead of quantize/dequant +
     # scales only amortizes on large buckets).
     quantize_min_bucket_bytes: int = 64 * 1024
+    # Expert-parallel MoE dispatch (docs/moe.md). `moe_wire` is the
+    # default payload format for the dispatch/combine alltoall on the
+    # MoE surfaces (parallel/moe.moe_layer via bench --moe, models.gpt
+    # MoeMlp): "none" | "bf16" | "int8" | "auto" (int8 at or above the
+    # fusion.assign_alltoall_wire size threshold, bf16 below).
+    moe_wire: Optional[str] = None
+    # Capacity-dim pipelining depth: dispatch-alltoall of chunk k+1
+    # overlaps expert-FFN compute of chunk k (1 = off).
+    moe_overlap_chunks: int = 1
+    # Default expert capacity factor (GShard: tokens*2/num_experts *
+    # this; overflow routes are dropped and re-weighted).
+    moe_capacity_factor: float = 1.25
     # Scan-based gradient accumulation (docs/performance.md "MFU
     # playbook"): default microbatch count for the accumulate()
     # surfaces — hvd.accumulate_gradients and the accum_steps= knob on
@@ -256,6 +268,11 @@ class Config:
         c.compression = _env("COMPRESSION")
         c.quantize_min_bucket_bytes = _env_int(
             "QUANTIZE_MIN_BYTES", cls.quantize_min_bucket_bytes)
+        c.moe_wire = _env("MOE_WIRE")
+        c.moe_overlap_chunks = _env_int("MOE_OVERLAP_CHUNKS",
+                                        cls.moe_overlap_chunks)
+        c.moe_capacity_factor = _env_float("MOE_CAPACITY_FACTOR",
+                                           cls.moe_capacity_factor)
         c.accum_steps = _env_int("ACCUM_STEPS", cls.accum_steps)
         c.remat_policy = _env("REMAT_POLICY")
         c.prefetch = _env("PREFETCH")

@@ -104,6 +104,23 @@ class DivergenceError(HorovodTpuError):
         self.ranks = tuple(ranks)
 
 
+class AlltoallvLayoutError(HorovodTpuError, NotImplementedError):
+    """The dynamic (controller-negotiated) ``alltoallv`` was called in a
+    multi-process layout it does not support: the eager engine assumes
+    exactly one rank per process, so a multi-device-per-process world
+    (controller size != engine size) cannot negotiate per-rank splits.
+
+    Routes forward: run one process per rank (``hvdtpurun -np N``), or
+    keep the exchange IN-JIT where no negotiation round exists —
+    ``ops.collectives.alltoallv`` (flat, segment-padded) or
+    ``ops.collectives.alltoallv_chunked`` (per-hop padded, the bounded-
+    wire form for skewed split tables; ``chunked=True`` on the eager
+    surface selects it once the layout assumption holds).
+
+    Subclasses :class:`NotImplementedError` so pre-existing handlers of
+    the old bare error keep working."""
+
+
 class CheckpointCorruptError(HorovodTpuError):
     """Checkpoint integrity verification failed (CRC/size mismatch
     against the sidecar manifest) and no earlier verified step exists

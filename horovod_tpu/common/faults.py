@@ -60,6 +60,12 @@ site                 where it fires / what it does
                      (an honest slow worker the straggler detector must
                      catch); ``scale`` inflates only the REPORTED step
                      time (simulation)
+``moe_skew``         MoE router (``parallel.moe.chaos_skew_gate``, one
+                     hit per consulted step): bias the router weights
+                     by ``scale`` toward expert ``target`` — a hot
+                     expert whose capacity overflow the
+                     ``hvd_tpu_moe_*`` drop/load gauges must surface
+                     (docs/moe.md)
 ===================  =====================================================
 
 Plan JSON: ``{"seed": 42, "faults": [{"site": ..., "step": N |
@@ -94,7 +100,7 @@ ENV_LOG = "HVD_TPU_FAULT_LOG"
 
 SITES = ("collective", "collective_stall", "rendezvous", "discovery",
          "crash", "preempt", "nonfinite", "diverge", "checkpoint_corrupt",
-         "straggler")
+         "straggler", "moe_skew")
 
 _SPEC_FIELDS = ("site", "step", "probability", "times", "mode", "delay_s",
                 "code", "exit_code", "message", "rank", "host", "target",
@@ -396,6 +402,18 @@ def maybe_straggler() -> Optional["FaultSpec"]:
     if inj is None:
         return None
     return inj.check("straggler")
+
+
+def maybe_moe_skew() -> Optional["FaultSpec"]:
+    """MoE router (one hit per consulted step via
+    ``parallel.moe.chaos_skew_gate``): when the plan fires, the caller
+    biases the router logits by ``scale`` toward expert ``target`` — a
+    hot expert driven through the real gating/capacity path so the
+    drop-rate and load gauges must react (docs/moe.md)."""
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.check("moe_skew")
 
 
 def maybe_checkpoint_corrupt() -> Optional["FaultSpec"]:

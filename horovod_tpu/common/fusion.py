@@ -282,6 +282,27 @@ def assign_wire_dtypes(plan: FusionPlan, quantize_min_bytes: int,
     return dataclasses.replace(plan, wire_dtypes=tuple(wires))
 
 
+# Default size threshold for quantizing an alltoall payload — the same
+# amortization argument as assign_wire_dtypes' bucket threshold
+# (quantize/dequant kernels + per-4096-block scales + block padding only
+# pay off on large slabs), applied to the dispatch/combine exchange.
+A2A_QUANTIZE_MIN_BYTES = 64 * 1024
+
+
+def assign_alltoall_wire(nbytes: int,
+                         quantize_min_bytes: int = A2A_QUANTIZE_MIN_BYTES,
+                         small_wire: str = WIRE_BF16) -> str:
+    """Wire format for one alltoall payload of ``nbytes`` raw bytes —
+    the :func:`assign_wire_dtypes` size-threshold rule lifted to the
+    dispatch path (``wire="auto"`` on ``parallel.moe.moe_layer`` and
+    the eager ``alltoall``): int8 at or above the threshold, the cheap
+    ``small_wire`` cast below it. Deterministic in (nbytes, threshold),
+    so every rank picks the identical format without negotiation."""
+    if nbytes >= quantize_min_bytes:
+        return WIRE_INT8
+    return small_wire or WIRE_NONE
+
+
 def fuse(tree, plan: FusionPlan) -> List[jnp.ndarray]:
     """Concatenate each bucket's leaves into one flat array
     (the MemcpyInFusionBuffer analog, collective_operations.h:97-110)."""

@@ -47,6 +47,84 @@ def _causal_attend(q, k, v, mask=None):
     return flash_attention(q, k, v, mask=mask, causal=True)
 
 
+class MoeMlp(nn.Module):
+    """Expert-parallel FFN replacing the dense MLP when the GPT
+    ``moe_experts`` knob is set (docs/moe.md): GShard top-2 gating +
+    all-to-all dispatch over the ``moe_axis``/``moe_route`` ep world
+    (``parallel/moe.py`` — wire-compressed, mesh-routed,
+    overlap-pipelined). The expert bank is REPLICATED (each rank stores
+    all experts, uses only its local slice): under SPMD the backward
+    all-to-all returns every rank's cotangents to the expert owner, so
+    the owner-only gradient averaged across ranks equals the mean-loss
+    gradient exactly — no correction factor, and the one-line
+    DistributedOptimizer keeps working unchanged (sharded expert
+    storage is the ZeRO-3 roadmap item).
+
+    The load-balancing aux loss and the drop/load stats are sown into
+    the ``"intermediates"`` collection (``moe_aux`` / ``moe_stats``) —
+    pass ``mutable=["intermediates"]`` to collect them; plain ``apply``
+    calls still work (sow is a no-op when the collection is immutable).
+    """
+
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    axis_name: Optional[str] = None      # flat ep axis (None = local)
+    route: Optional[str] = None          # WirePlan spec (wins over axis)
+    wire: str = "none"                   # none | bf16 | int8 | auto
+    overlap_chunks: int = 1
+    # Noisy-gating jitter std (active only when a "gating" rng is
+    # passed to apply); an untrained router's init bias otherwise
+    # overflows capacity from step 0 — docs/moe.md.
+    router_noise: float = 0.0
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel import moe as moe_lib
+
+        b, s, h = x.shape
+        e = self.num_experts
+        gate_w = self.param("gate", nn.initializers.normal(0.02), (h, e),
+                            jnp.float32)
+        w_in = self.param("w_in", nn.initializers.normal(0.02),
+                          (e, h, self.mlp_dim), jnp.float32)
+        b_in = self.param("b_in", nn.initializers.zeros,
+                          (e, self.mlp_dim), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.normal(0.02),
+                           (e, self.mlp_dim, h), jnp.float32)
+        b_out = self.param("b_out", nn.initializers.zeros, (e, h),
+                           jnp.float32)
+
+        n = moe_lib.ep_size(self.axis_name, self.route)
+        e_local = e // n
+        my_base = moe_lib.ep_index(self.axis_name, self.route) * e_local
+
+        def expert_fn(local_idx, tokens):
+            ge = my_base + local_idx                 # global expert id
+            wi = jnp.take(w_in, ge, axis=0).astype(self.dtype)
+            wo = jnp.take(w_out, ge, axis=0).astype(self.dtype)
+            bi = jnp.take(b_in, ge, axis=0).astype(self.dtype)
+            bo = jnp.take(b_out, ge, axis=0).astype(self.dtype)
+            y = nn.gelu(tokens @ wi + bi)
+            return (y @ wo + bo).astype(tokens.dtype)
+
+        tokens = x.reshape(b * s, h)
+        gkey = self.make_rng("gating") \
+            if self.router_noise > 0 and self.has_rng("gating") else None
+        y, aux, stats = moe_lib.moe_layer(
+            tokens, gate_w, expert_fn, e,
+            capacity_factor=self.capacity_factor,
+            axis_name=self.axis_name, route=self.route, wire=self.wire,
+            overlap_chunks=self.overlap_chunks, return_stats=True,
+            key=gkey,
+            router_noise_std=self.router_noise if gkey is not None
+            else 0.0)
+        self.sow("intermediates", "moe_aux", aux)
+        self.sow("intermediates", "moe_stats", stats)
+        return y.reshape(b, s, h).astype(x.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
@@ -73,6 +151,13 @@ class DecoderLayer(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     attend_fn: Optional[Callable] = None
+    moe_experts: int = 0                 # 0 = dense FFN
+    moe_capacity_factor: float = 1.25
+    moe_axis: Optional[str] = None
+    moe_route: Optional[str] = None
+    moe_wire: str = "none"
+    moe_overlap_chunks: int = 1
+    moe_router_noise: float = 0.0
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -81,6 +166,13 @@ class DecoderLayer(nn.Module):
                                     self.attend_fn,
                                     name="attn")(y, positions)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.moe_experts:
+            return x + MoeMlp(self.moe_experts, self.mlp_dim,
+                              self.moe_capacity_factor, self.dtype,
+                              self.moe_axis, self.moe_route,
+                              self.moe_wire, self.moe_overlap_chunks,
+                              self.moe_router_noise,
+                              name="moe")(y)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype,
                      param_dtype=jnp.float32, name="mlp_in")(y)
         y = nn.gelu(y)
@@ -96,7 +188,13 @@ class GPT(nn.Module):
     (jax.checkpoint): activations are recomputed during backprop
     instead of stored, cutting long-context HBM from O(layers x S x
     hidden) to O(S x hidden) at ~1/3 extra FLOPs — the standard TPU
-    memory/compute trade for sequence lengths past a few thousand."""
+    memory/compute trade for sequence lengths past a few thousand.
+
+    ``moe_experts > 0`` swaps each layer's dense MLP for the
+    expert-parallel :class:`MoeMlp` (GPT-MoE, docs/moe.md) — the
+    ``moe_*`` fields thread straight through to ``parallel/moe.py``
+    (ep axis / WirePlan route spec / dispatch wire format / capacity
+    chunking depth)."""
 
     vocab_size: int = 32000
     num_layers: int = 12
@@ -106,6 +204,13 @@ class GPT(nn.Module):
     dtype: Any = jnp.bfloat16
     attend_fn: Optional[Callable] = None
     remat: bool = False
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_axis: Optional[str] = None
+    moe_route: Optional[str] = None
+    moe_wire: str = "none"
+    moe_overlap_chunks: int = 1
+    moe_router_noise: float = 0.0
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -115,8 +220,12 @@ class GPT(nn.Module):
         layer_cls = nn.remat(DecoderLayer) if self.remat else DecoderLayer
         for i in range(self.num_layers):
             x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
-                          self.attend_fn, name=f"layer{i}")(x,
-                                                            positions)
+                          self.attend_fn, self.moe_experts,
+                          self.moe_capacity_factor, self.moe_axis,
+                          self.moe_route, self.moe_wire,
+                          self.moe_overlap_chunks,
+                          self.moe_router_noise,
+                          name=f"layer{i}")(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_ln")(x)
         # Weight-tied head: bf16 operands + fp32 accumulation — the

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -253,7 +254,61 @@ def alltoallv(x, splits_matrix, axis_name: str = "hvd"):
     return y.reshape((n * maxs,) + x.shape[1:])
 
 
-def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
+def _int8_ppermute_impl(chunk, axis_name: str, perm, key, use_pallas):
+    shape, size = chunk.shape, int(chunk.size)
+    flat = chunk.astype(jnp.float32).reshape(-1)
+    flat = jnp.pad(flat, (0, -size % _Q_BLOCK))
+    q, s = _int8_chunks(flat, 1, key, use_pallas)
+    qg = lax.ppermute(q[0], axis_name, list(perm))
+    sg = lax.ppermute(s[0], axis_name, list(perm))
+    return _deq(qg, sg)[:size].reshape(shape).astype(chunk.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 4))
+def _int8_ppermute(chunk, axis_name: str, perm, key, use_pallas):
+    """int8 ppermute hop with a straight-through gradient (the zero-
+    gradient-of-round problem of :func:`_int8_a2a`, on the chunked
+    exchange's hops): cotangents ride the INVERSE permutation in the
+    same wire format."""
+    return _int8_ppermute_impl(chunk, axis_name, perm, key, use_pallas)
+
+
+def _int8_ppermute_fwd(chunk, axis_name, perm, key, use_pallas):
+    return _int8_ppermute_impl(chunk, axis_name, perm, key,
+                               use_pallas), key
+
+
+def _int8_ppermute_bwd(axis_name, perm, use_pallas, key, g):
+    kb = None if key is None else jax.random.fold_in(key, 0x5714)
+    inv = tuple((d, s) for s, d in perm)
+    return _int8_ppermute_impl(g, axis_name, inv, kb, use_pallas), None
+
+
+_int8_ppermute.defvjp(_int8_ppermute_fwd, _int8_ppermute_bwd)
+
+
+def _ppermute_wire(chunk, axis_name: str, perm, wire: str, key,
+                   use_pallas):
+    """One alltoallv_chunked hop in its wire format: ``none`` sends the
+    native dtype, ``bf16`` casts around the permute (2x fewer bytes),
+    ``int8`` sends block-scaled int8 payload + fp32 scales (the scales
+    ride their own small permute alongside the blocks; straight-through
+    gradient). Masked padding rows are exact zeros in every format (0
+    quantizes to exactly 0, for round-to-nearest and stochastic
+    rounding alike), so the no-row-leakage contract of the chunked
+    exchange is wire-independent.
+    """
+    if wire == "bf16":
+        return lax.ppermute(chunk.astype(jnp.bfloat16), axis_name,
+                            perm).astype(chunk.dtype)
+    if wire == "int8":
+        return _int8_ppermute(chunk, axis_name, tuple(perm), key,
+                              use_pallas)
+    return lax.ppermute(chunk, axis_name, perm)
+
+
+def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd",
+                      wire: str = "none", key=None, use_pallas=None):
     """Uneven all-to-all with per-HOP padding — the bounded-wire-bytes
     variant (VERDICT r3 weak #4: the segment-padded form moves
     O(n * max_split) bytes, which blows up under the skewed expert loads
@@ -281,7 +336,20 @@ def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
     Padding rows (beyond each segment's valid count) are zeros — each
     hop's chunk is masked before the wire so rows a sender slices past
     its segment boundary never leak to the receiver.
+
+    ``wire`` selects the per-hop payload format (``"none"`` native
+    dtype / ``"bf16"`` cast / ``"int8"`` block-scaled quantized — the
+    dispatch-compression family of :func:`compressed_alltoall`; lossy
+    wires bound the per-element error by the cast/quantization step,
+    docs/moe.md). The k=0 self-segment never touches the wire and is
+    always exact. ``key`` makes int8 roundings stochastic (unbiased),
+    folded per hop.
     """
+    if wire not in _WIRES:
+        raise ValueError(f"unknown wire format {wire!r}; choose from "
+                         f"{_WIRES}")
+    if wire != "none" and not jnp.issubdtype(x.dtype, jnp.floating):
+        wire = "none"  # int payloads ride uncompressed
     n = len(splits_matrix)
     if lax.axis_size(axis_name) != n:
         raise ValueError(
@@ -339,7 +407,9 @@ def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd"):
         chunk = _masked(chunk, split_tbl[me, dst_idx[me]])
         # Send to (r+k) mod n; receive from (r-k) mod n.
         perm = [(r, (r + k) % n) for r in range(n)]
-        got = lax.ppermute(chunk, axis_name, perm)
+        kk = None if key is None else jax.random.fold_in(key, k)
+        got = _ppermute_wire(chunk, axis_name, perm, wire, kk,
+                             use_pallas)
         src = (me - k) % n
         out = lax.dynamic_update_slice_in_dim(out, got, src * seg, 0)
 
@@ -690,6 +760,14 @@ _M_AXIS_BYTES = metrics_lib.counter(
     "allreduce bytes on the wire by wire format and mesh axis "
     "(axis=flat: eager per-call accounting; mesh axes: per compiled "
     "routing plan; int8 includes the per-4096-block fp32 scales)",
+    labels=("wire", "axis"))
+_M_A2A_BYTES = metrics_lib.counter(
+    "hvd_tpu_alltoall_bytes_total",
+    "alltoall (dispatch/combine) bytes on the wire by wire format and "
+    "mesh axis (axis=flat: eager per-call accounting; named axes: per "
+    "compiled program at trace time — the planned_per_compile basis; "
+    "the self-chunk never crosses the wire and is excluded; int8 "
+    "includes the per-4096-block fp32 scales)",
     labels=("wire", "axis"))
 
 
@@ -1180,6 +1258,224 @@ def mesh_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
     if not return_residual:
         return y
     return y, residual[:size].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Wire-compressed + mesh-routed alltoall — the MoE dispatch hot path
+# (docs/moe.md).
+#
+# Expert-parallel dispatch/combine is a PERMUTATION, not a reduction:
+# per-block scales never meet a sum, so int8/bf16 on the wire is
+# strictly easier than the EQuARX reduce path (no error feedback
+# needed — rounding error lands once, on activations, bounded by the
+# block absmax step). compressed_alltoall carries the even exchange in
+# a chosen wire format; mesh_alltoall decomposes the global exchange
+# into per-axis phases over a WirePlan (fast axis first) so each hop —
+# in particular the slow cross-host one — picks its own payload format,
+# exactly the PR-6 per-axis-wire contract extended from reduce to
+# permute. Unlike the reduce router the payload never shrinks per
+# phase (nothing is reduced), so the slow-axis win comes from the WIRE
+# FORMAT, not the staging; the staging is what makes a per-axis wire
+# expressible at all.
+# ---------------------------------------------------------------------------
+
+
+def _count_a2a_bytes(axis: str, wire: str, nelems: int, n: int,
+                     itemsize: int) -> None:
+    """Trace-time per-axis byte stamping for the alltoall family: an
+    exchange over ``n`` ranks keeps ``(n-1)/n`` of the buffer on the
+    wire (the self-chunk stays local)."""
+    if not _METRICS_ON or n <= 1:
+        return
+    eb = _wire_elem_bytes(wire, itemsize)
+    _M_A2A_BYTES.labels(wire=wire, axis=axis).inc(
+        (n - 1) / n * nelems * eb)
+
+
+def alltoall_wire_cost(plan: WirePlan, nelems: int,
+                       axis_sizes: Sequence[int],
+                       itemsize: int = 4) -> dict:
+    """Static per-axis bytes-per-device model of a mesh-routed alltoall
+    (the analytic half of ``tpu_microbench alltoall``). Every phase
+    exchanges the FULL buffer over its axis — a permutation has nothing
+    to shrink — keeping ``(n-1)/n`` of it on the wire in that phase's
+    format. Compare against the flat exchange's
+    ``(N-1)/N * nelems * itemsize``, all of which can transit the slow
+    link at the native dtype. Returns ``{axis: {"wire", "bytes",
+    "size"}}`` plus ``"total"``."""
+    sizes = list(axis_sizes)
+    if len(sizes) != len(plan.phases):
+        raise ValueError("axis_sizes must parallel plan.phases")
+    out = {}
+    total = 0.0
+    for p, n in zip(plan.phases, sizes):
+        eb = _wire_elem_bytes(p.wire, itemsize)
+        b = (n - 1) / n * nelems * eb if n > 1 else 0.0
+        out[p.axis] = {"wire": p.wire, "bytes": b, "size": n}
+        total += b
+    out["total"] = total
+    return out
+
+
+def _int8_a2a_impl(chunks, axis_name: str, key, use_pallas):
+    n, c = chunks.shape
+    pad = -c % _Q_BLOCK
+    flat = jnp.pad(chunks.astype(jnp.float32),
+                   ((0, 0), (0, pad))).reshape(-1)
+    q, s = _int8_chunks(flat, n, key, use_pallas)
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    return _deq(qx, sx)[:, :c].astype(chunks.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 3))
+def _int8_a2a(chunks, axis_name: str, key, use_pallas):
+    """int8 exchange with a STRAIGHT-THROUGH gradient. The MoE dispatch
+    sits INSIDE the differentiated forward (unlike the int8 allreduce,
+    which quantizes already-computed gradients), and ``round`` has zero
+    gradient almost everywhere — naively differentiating the quantized
+    exchange silently kills every gradient that crosses it. STE treats
+    the quantizer as identity; the cotangent exchange is the SAME
+    all_to_all (this split0/concat0 form is self-adjoint: out[j] on
+    rank r = in[r] on rank j) and rides int8 on the wire too — the
+    backward alltoall is just as much wire traffic as the forward
+    (key folded so backward roundings are independent)."""
+    return _int8_a2a_impl(chunks, axis_name, key, use_pallas)
+
+
+def _int8_a2a_fwd(chunks, axis_name, key, use_pallas):
+    return _int8_a2a_impl(chunks, axis_name, key, use_pallas), key
+
+
+def _int8_a2a_bwd(axis_name, use_pallas, key, g):
+    kb = None if key is None else jax.random.fold_in(key, 0x5713)
+    return _int8_a2a_impl(g, axis_name, kb, use_pallas), None
+
+
+_int8_a2a.defvjp(_int8_a2a_fwd, _int8_a2a_bwd)
+
+
+def _a2a_exchange(chunks, axis_name: str, wire: str, key, use_pallas):
+    """Exchange per-destination chunks ``(n, C)`` -> ``(n, C)``
+    source-major over one axis, payload in ``wire`` format. int8 rides
+    block-scaled quantized (scales travel with their blocks on a
+    parallel small exchange; straight-through gradient — see
+    :func:`_int8_a2a`); the fp32 compute dtype is the caller's."""
+    if wire == "int8":
+        return _int8_a2a(chunks, axis_name, key, use_pallas)
+    if wire == "bf16":
+        return lax.all_to_all(chunks.astype(jnp.bfloat16), axis_name,
+                              split_axis=0,
+                              concat_axis=0).astype(chunks.dtype)
+    return lax.all_to_all(chunks, axis_name, split_axis=0, concat_axis=0)
+
+
+def compressed_alltoall(x, axis_name: str = "hvd", wire: str = "int8",
+                        key=None, use_pallas=None, _telemetry: bool = True):
+    """Wire-compressed even all-to-all (tiled semantics of
+    :func:`alltoall`: dim 0 splits into ``n`` equal chunks, chunk ``j``
+    to rank ``j``, received chunks concatenate along dim 0).
+
+    ``wire`` names the payload format: ``"none"`` (native dtype —
+    degenerates to :func:`alltoall`), ``"bf16"`` (cast around the
+    exchange, 2x fewer bytes), ``"int8"`` (block-scaled quantized, ~4x
+    — one fp32 scale per 4096-element block rides with its blocks).
+
+    **Error bound** (lossy wires; docs/moe.md): per element at most
+    ``r*s`` where ``s`` is the element's 4096-block absmax/127 (int8;
+    ``r=1/2`` round-to-nearest, ``r=1`` stochastic with ``key``) or one
+    bf16 mantissa step (bf16). Activations tolerate this; reduced
+    gradients want the error-feedback reduce path instead
+    (``quantized_allreduce``).
+    """
+    if wire == "fp32":
+        wire = "none"
+    if wire not in _WIRES:
+        raise ValueError(f"unknown wire format {wire!r}; choose from "
+                         f"{_WIRES}")
+    n = lax.axis_size(axis_name)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"dim 0 ({x.shape[0]}) must divide into {n} chunks")
+    if wire != "none" and not jnp.issubdtype(x.dtype, jnp.floating):
+        wire = "none"  # int payloads ride uncompressed
+    if _telemetry:
+        _count_a2a_bytes(axis_name, wire, int(x.size), n,
+                         x.dtype.itemsize)
+    if n == 1 or wire == "none":
+        # n == 1: nothing on the wire — quantizing would add pure loss.
+        return alltoall(x, axis_name)
+    m = x.shape[0] // n
+    rest = x.shape[1:]
+    per = m
+    for d in rest:
+        per *= int(d)
+    out = _a2a_exchange(x.reshape(n, per), axis_name, wire, key,
+                        use_pallas)
+    return out.reshape((n * m,) + rest).astype(x.dtype)
+
+
+def mesh_alltoall(x, plan, key=None, use_pallas=None,
+                  _telemetry: bool = True):
+    """Mesh-routed all-to-all: the global exchange over ``N = prod(axis
+    sizes)`` ranks decomposed into one phase per :class:`WirePlan` axis
+    (fast axis first), each phase's hop in its own wire format — e.g.
+    ``"local:none,cross:int8"`` keeps ICI exact and quantizes only the
+    slow DCN hop.
+
+    Semantics match :func:`alltoall` over the combined axes with the
+    global rank order SLOW-AXIS-MAJOR (the ``(cross, ..., local)`` mesh
+    layout used everywhere else): dim 0 splits into ``N`` chunks,
+    destination-indexed slow-major; the result concatenates source
+    chunks slow-major. Phase ``i`` exchanges destination coordinate
+    ``i`` within its axis; after all phases every chunk sits on its
+    destination with source coordinates in place of destination ones —
+    a 1-phase plan degenerates to :func:`compressed_alltoall`.
+
+    Per-axis planned bytes land in
+    ``hvd_tpu_alltoall_bytes_total{wire=,axis=}`` at trace time. Error
+    bound per lossy phase as in :func:`compressed_alltoall` (one
+    rounding per lossy hop; ``key`` folds per phase).
+    """
+    plan = WirePlan.resolve(plan)
+    if plan is None:
+        raise ValueError("mesh_alltoall requires a WirePlan (route)")
+    phases = plan.phases
+    ns = [lax.axis_size(p.axis) for p in phases]
+    N = 1
+    for n in ns:
+        N *= n
+    if x.shape[0] % N:
+        raise ValueError(
+            f"dim 0 ({x.shape[0]}) must divide into {N} chunks "
+            f"(mesh {'x'.join(str(n) for n in reversed(ns))})")
+    if len(phases) == 1:
+        return compressed_alltoall(x, phases[0].axis, phases[0].wire,
+                                   key=key, use_pallas=use_pallas,
+                                   _telemetry=_telemetry)
+    m = x.shape[0] // N
+    rest = x.shape[1:]
+    if _telemetry:
+        for p, n in zip(phases, ns):
+            _count_a2a_bytes(p.axis, p.wire
+                             if jnp.issubdtype(x.dtype, jnp.floating)
+                             else "none",
+                             int(x.size), n, x.dtype.itemsize)
+    # Leading dims slow-major: [n_slow, ..., n_fast, m] + rest.
+    lead = tuple(reversed(ns))
+    buf = x.reshape(lead + (m,) + rest)
+    k = len(ns)
+    for i, p in enumerate(phases):
+        pos = k - 1 - i          # phase i's coordinate dim (fast last)
+        moved = jnp.moveaxis(buf, pos, 0)
+        shape = moved.shape
+        chunks = moved.reshape(shape[0], -1)
+        ki = None if key is None else jax.random.fold_in(key, i)
+        wire = p.wire if jnp.issubdtype(x.dtype, jnp.floating) \
+            else "none"
+        got = _a2a_exchange(chunks, p.axis, wire, ki, use_pallas)
+        buf = jnp.moveaxis(got.reshape(shape), 0, pos)
+    return buf.reshape((N * m,) + rest).astype(x.dtype)
 
 
 def hierarchical_allreduce_staged(x, op: ReduceOp = ReduceOp.AVERAGE,

@@ -1318,6 +1318,10 @@ class AutotunedStepper:
         self._joint_accum = getattr(tuner, "tune_accum", False)
         self._joint_remat = getattr(tuner, "tune_remat", False)
         self._joint_shard = getattr(tuner, "tune_shard", False)
+        # MoE dispatch-wire axis (docs/moe.md): like the MFU axes it
+        # rides the whole-TunedPoint build signature — the build fn
+        # threads pt.moe_wire into its moe_layer/MoeMlp construction.
+        self._joint_moe_wire = getattr(tuner, "tune_moe_wire", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
         self._ovl = (tuner.current_overlap if self._joint_overlap
                      else False)
@@ -1330,13 +1334,16 @@ class AutotunedStepper:
                        else "none")
         self._shard = (tuner.current_shard if self._joint_shard
                        else False)
+        self._moe_wire = (tuner.current_moe_wire
+                          if self._joint_moe_wire else "none")
         self._step = self._rebuild()
         self.rebuilds = 0
         self._step_count = 0  # metrics/profiler step numbering
 
     @property
     def _mfu_joint(self) -> bool:
-        return self._joint_accum or self._joint_remat or self._joint_shard
+        return (self._joint_accum or self._joint_remat
+                or self._joint_shard or self._joint_moe_wire)
 
     def _rebuild(self):
         if self._mfu_joint:
@@ -1346,7 +1353,7 @@ class AutotunedStepper:
                 threshold=self._threshold, hierarchical=self._hier,
                 overlap=self._ovl, compression=self._comp,
                 route=self._route, accum=self._accum, remat=self._remat,
-                shard=self._shard))
+                shard=self._shard, moe_wire=self._moe_wire))
         if self._joint_route:
             return self._build(self._threshold, self._hier, self._ovl,
                                self._comp, self._route)
@@ -1391,6 +1398,10 @@ class AutotunedStepper:
     def shard(self) -> bool:
         return self._shard
 
+    @property
+    def moe_wire(self) -> str:
+        return self._moe_wire
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -1416,13 +1427,17 @@ class AutotunedStepper:
             new_a = pt.accum if self._joint_accum else self._accum
             new_m = pt.remat if self._joint_remat else self._remat
             new_s = pt.shard if self._joint_shard else self._shard
+            new_w = pt.moe_wire if self._joint_moe_wire \
+                else self._moe_wire
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
-            new, new_h, new_o, new_c, new_r, new_a, new_m, new_s = (
+            (new, new_h, new_o, new_c, new_r, new_a, new_m, new_s,
+             new_w) = (
                 self._threshold, self._hier, self._ovl, self._comp,
-                self._route, self._accum, self._remat, self._shard)
+                self._route, self._accum, self._remat, self._shard,
+                self._moe_wire)
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -1439,6 +1454,7 @@ class AutotunedStepper:
                         f"|{cur.accum if self._joint_accum else 1}"
                         f"|{cur.remat if self._joint_remat else 'none'}"
                         f"|{int(cur.shard) if self._joint_shard else 0}"
+                        f"|{cur.moe_wire if self._joint_moe_wire else 'none'}"
                         + (":done" if c.rank == 0 and self.tuner.done
                            else ""))
                 vals = c.exchange("autotune_threshold", mine)
@@ -1447,7 +1463,7 @@ class AutotunedStepper:
                     self._tuner_done = True
                     v0 = v0[:-5]
                 (t_str, h_str, o_str, c_str, r_str, a_str, m_str,
-                 s_str) = v0.split("|")
+                 s_str, w_str) = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
                 new_o = bool(int(o_str)) if self._joint_overlap \
@@ -1458,14 +1474,17 @@ class AutotunedStepper:
                 new_m = m_str if self._joint_remat else self._remat
                 new_s = bool(int(s_str)) if self._joint_shard \
                     else self._shard
+                new_w = w_str if self._joint_moe_wire \
+                    else self._moe_wire
         if (new != self._threshold or new_h != self._hier
                 or new_o != self._ovl or new_c != self._comp
                 or new_r != self._route or new_a != self._accum
-                or new_m != self._remat or new_s != self._shard):
+                or new_m != self._remat or new_s != self._shard
+                or new_w != self._moe_wire):
             (self._threshold, self._hier, self._ovl, self._comp,
-             self._route, self._accum, self._remat,
-             self._shard) = (new, new_h, new_o, new_c, new_r, new_a,
-                             new_m, new_s)
+             self._route, self._accum, self._remat, self._shard,
+             self._moe_wire) = (new, new_h, new_o, new_c, new_r, new_a,
+                                new_m, new_s, new_w)
             self._step = self._rebuild()
             self.rebuilds += 1
             _M_REBUILDS.inc()

@@ -224,9 +224,9 @@ def test_alltoallv_skewed_routes_chunked(hvd, mode):
     calls = {}
     orig = e.alltoallv
 
-    def spy(x, sp, name=None, chunked=None):
+    def spy(x, sp, name=None, chunked=None, **kw):
         calls["chunked_arg"] = chunked
-        return orig(x, sp, name, chunked=chunked)
+        return orig(x, sp, name, chunked=chunked, **kw)
 
     e.alltoallv = spy
     try:

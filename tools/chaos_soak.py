@@ -365,6 +365,243 @@ def injection_sequences(fault_log):
     return seqs
 
 
+# -- the moe family (docs/moe.md) --------------------------------------------
+
+MOE_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import json
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt_lib
+from horovod_tpu.common import faults as faults_lib
+from horovod_tpu.common import integrity
+from horovod_tpu.parallel import moe as moe_lib
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+hvd.init(force_cpu_devices=4)
+ax, n = hvd.rank_axis(), hvd.size()
+
+d, t, E = 8, 16, 4
+rng = np.random.default_rng(0)
+X = rng.standard_normal((n, t, d)).astype(np.float32)
+Y = np.tanh(X * 2.0).astype(np.float32)
+p0 = {
+    "gate": jnp.asarray(rng.standard_normal((d, E)) * 0.1, jnp.float32),
+    "w": jnp.asarray(rng.standard_normal((E, d, d)) * 0.3, jnp.float32),
+}
+tx = hvd.DistributedOptimizer(optax.sgd(0.05), axis_name=ax)
+
+
+def loss_fn(p, xb, yb):
+    def expert_fn(le, toks):
+        ge = moe_lib.ep_index(ax) * (E // n) + le
+        return jnp.tanh(toks @ jnp.take(p["w"], ge, axis=0))
+
+    # The full hot path under chaos: wire-compressed dispatch +
+    # capacity-chunked overlap pipelining, capacity_factor 1.0 so the
+    # injected hot expert MUST overflow.
+    y, aux, stats = moe_lib.moe_layer(
+        xb, p["gate"], expert_fn, E, capacity_factor=1.0,
+        axis_name=ax, wire="bf16", overlap_chunks=2, return_stats=True)
+    return jnp.mean((y - yb) ** 2) + 0.01 * aux, stats
+
+
+@hvd.spmd_step(in_specs=(P(ax), P(), P(ax), P(ax), P()),
+               out_specs=(P(ax), P(), P(), P(), P(), P()))
+def step(ps, s, xb, yb, i):
+    p = jax.tree.map(lambda v: v[0], ps)
+    # Integrity guard: cross-rank parameter fingerprints must agree —
+    # the MoE exchange is a permutation, so replicas stay bitwise
+    # identical unless something (or chaos) breaks.
+    p, checked, div = integrity.divergence_guard(p, i, ax, every=2,
+                                                 policy="warn")
+    (l, stats), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        p, xb[0], yb[0])
+    u, s = tx.update(g, s, p)
+    p = optax.apply_updates(p, u)
+    statvec = jnp.concatenate(
+        [stats["dropped_tokens"][None], stats["dropped_frac"][None],
+         stats["routed_tokens"][None], stats["expert_load"]])
+    return (jax.tree.map(lambda v: v[None], p), s,
+            jax.lax.pmean(l, ax), checked, div, statvec)
+
+
+mgr = ckpt_lib.CheckpointManager(os.path.join(workdir, "ckpt"),
+                                 max_to_keep=TOTAL + 1)
+start = 0
+try:
+    saved = mgr.restore()
+except Exception:
+    saved = None
+resumed_from = None
+if saved is not None:
+    for k in ("gate", "w"):
+        p0[k] = jnp.asarray(saved[k])
+    resumed_from = int(np.asarray(saved["step"]))
+    start = resumed_from + 1
+
+ps = {k: jnp.broadcast_to(v[None], (n,) + v.shape)
+      for k, v in p0.items()}
+s = tx.init(p0)
+drop_frac_max = 0.0
+guard_checks = 0
+divergences = 0
+loss = None
+for i in range(start, TOTAL):
+    # "crash" site, one hit per step — the mid-MoE-step elastic reset:
+    # the process dies hard here, the soak harness relaunches it, and
+    # the verified-checkpoint restore must land it back mid-run.
+    faults_lib.maybe_worker_fault()
+    # "moe_skew" site: bias the router toward a hot expert.
+    ps["gate"] = moe_lib.chaos_skew_gate(ps["gate"])
+    ps, s, loss, checked, div, statvec = step(
+        ps, s, jnp.asarray(X), jnp.asarray(Y),
+        jnp.asarray(i, jnp.int32))
+    sv = np.asarray(statvec)
+    rec = moe_lib.record_moe_stats(
+        {"dropped_tokens": sv[0], "dropped_frac": sv[1],
+         "expert_load": sv[3:]})
+    drop_frac_max = max(drop_frac_max, rec["dropped_frac"])
+    guard_checks += int(np.asarray(checked))
+    divergences += int(np.asarray(div))
+    mgr.save(i, {"gate": np.asarray(ps["gate"])[0],
+                 "w": np.asarray(ps["w"])[0], "step": i}, force=True)
+    # Synchronous save: the crash site fires BETWEEN steps, and the
+    # relaunch count is only deterministic if every completed step's
+    # checkpoint is durable before the next step can die.
+    mgr.wait()
+
+snap = hvd.metrics()
+
+
+def gauge_val(name):
+    ss = snap.get(name, {}).get("samples", [])
+    return max((float(s["value"]) for s in ss), default=0.0)
+
+
+g = np.asarray(ps["gate"])
+w = np.asarray(ps["w"])
+result = {
+    "completed_steps": TOTAL - start,
+    "final_step": TOTAL - 1,
+    "resumed_from": resumed_from,
+    "final_loss": float(np.asarray(loss)),
+    "drop_frac_max": drop_frac_max,
+    "drop_gauge": gauge_val("hvd_tpu_moe_dropped_tokens"),
+    "load_gauge_max": gauge_val("hvd_tpu_moe_expert_load"),
+    "guard_checks": guard_checks,
+    "divergences": divergences,
+    "replicas_identical": bool(
+        all(np.array_equal(g[r], g[0]) and np.array_equal(w[r], w[0])
+            for r in range(n))),
+}
+with open(os.path.join(workdir, "result.json"), "w") as f:
+    json.dump(result, f)
+"""
+
+
+def moe_plan(seed: int) -> dict:
+    """The moe family (docs/moe.md): a hot-expert router skew that MUST
+    overflow capacity (drop gauges fire), plus a hard crash mid-run —
+    the elastic-reset path through a verified-checkpoint restore. Sites
+    are consulted once per training step (1-based hit index, per
+    process — the relaunch starts past the crash hit, so the crash
+    cannot re-fire and the run completes)."""
+    return {"seed": seed, "faults": [
+        {"site": "moe_skew", "step": 3, "scale": 50.0, "target": "0"},
+        {"site": "crash", "step": 5, "exit_code": 17},
+    ]}
+
+
+def run_moe_soak(workdir: str, steps: int = 8, seed: int = 42,
+                 plan: dict | None = None) -> dict:
+    """One seeded moe-family run: the MoE hot path (bf16 dispatch wire,
+    capacity chunking, drop/load gauges, divergence guard) under a
+    router-skew fault and a mid-run crash+relaunch. Asserts (a) the
+    drop gauges fired after the skew, (b) the integrity guard agreed
+    across ranks throughout (checks ran, zero divergences, replicas
+    bitwise identical), (c) the reset mid-MoE-step finished: the crash
+    relaunch restored from the verified checkpoint and completed every
+    step."""
+    import subprocess
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train_moe.py")
+    with open(train_py, "w") as f:
+        f.write(MOE_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    plan = plan if plan is not None else moe_plan(seed)
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_FAULT_PLAN": json.dumps(plan),
+        "HVD_TPU_FAULT_LOG": fault_log,
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    crash_rcs = [s.get("exit_code", 1) for s in plan["faults"]
+                 if s["site"] == "crash"]
+    relaunches = 0
+    for _attempt in range(4):
+        proc = subprocess.run(
+            [sys.executable, train_py, workdir, str(steps)], env=env,
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode == 0:
+            break
+        assert proc.returncode in crash_rcs, \
+            f"moe soak rc={proc.returncode} (not the injected crash)\n" \
+            f"{proc.stdout}\n{proc.stderr}"
+        relaunches += 1
+    else:
+        raise AssertionError("moe soak never completed within 4 "
+                             "launches")
+
+    with open(os.path.join(workdir, "result.json")) as f:
+        result = json.load(f)
+    # (a) the skewed router overflowed capacity and the gauges fired.
+    assert result["drop_frac_max"] >= 0.15, result
+    assert result["drop_gauge"] > 0, result
+    assert result["load_gauge_max"] > 0, result
+    # (b) the integrity guard agreed across ranks the whole run.
+    assert result["guard_checks"] >= 1, result
+    assert result["divergences"] == 0, result
+    assert result["replicas_identical"], result
+    # (c) the elastic reset finished: exactly one crash+relaunch, the
+    # relaunch resumed from the last verified step and ran to the end.
+    assert relaunches == len(crash_rcs), (relaunches, result)
+    if crash_rcs:
+        assert result["resumed_from"] is not None, result
+    assert result["final_step"] == steps - 1, result
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    want = {s["site"] for s in plan["faults"]}
+    assert want <= sites, \
+        f"expected injections covering {sorted(want)}, got " \
+        f"{sorted(sites)}"
+    return {
+        "metric": "chaos_soak_moe",
+        "seed": seed,
+        "steps": steps,
+        "rc": proc.returncode,
+        "relaunches": relaunches,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "result": result,
+        "sequences": {f"{k[0]}@{k[1]}": v
+                      for k, v in injection_sequences(log).items()},
+    }
+
+
 # -- the autoscale family (docs/autoscale.md) --------------------------------
 
 AUTOSCALE_SCRIPT = """
@@ -968,7 +1205,7 @@ def run_soak(workdir: str, steps: int = 12, seed: int = 42,
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
-                                         "autoscale", "stall"),
+                                         "autoscale", "stall", "moe"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
@@ -980,7 +1217,13 @@ def main() -> int:
                          "watchdog -> flight-recorder black box -> "
                          "flight_diff attribution -> elastic retry "
                          "path, with the pod aggregator scraped live "
-                         "(docs/podmon.md)")
+                         "(docs/podmon.md); "
+                         "moe = a hot-expert router skew + a mid-step "
+                         "crash through the MoE dispatch hot path: "
+                         "drop/load gauges must fire, the integrity "
+                         "guard must agree across ranks, and the "
+                         "relaunch must restore and finish "
+                         "(docs/moe.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
                          "autoscale: 120, stall: 60 — their control "
@@ -995,9 +1238,10 @@ def main() -> int:
 
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
             "autoscale": run_autoscale_soak,
-            "stall": run_stall_soak}[args.family]
+            "stall": run_stall_soak, "moe": run_moe_soak}[args.family]
     if args.steps is None:
-        args.steps = {"autoscale": 120, "stall": 60}.get(args.family, 12)
+        args.steps = {"autoscale": 120, "stall": 60,
+                      "moe": 8}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

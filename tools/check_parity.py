@@ -366,6 +366,115 @@ def check_podmon_surface(missing: list) -> None:
                        "differs between writer and reader")
 
 
+def check_moe_surface(missing: list) -> None:
+    """The expert-parallel MoE hot path (docs/moe.md): every
+    ``HVD_TPU_MOE_*`` knob (config.py), every ``hvd_tpu_moe_*`` /
+    ``hvd_tpu_alltoall_*`` metric, the bench flags, and the public API
+    names must be documented — an undocumented dispatch knob is an
+    undiscoverable one. Parsed textually (runs without jax)."""
+    doc = REPO / "docs" / "moe.md"
+    if not doc.exists():
+        missing.append("path: docs/moe.md")
+        return
+    text = doc.read_text()
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    metrics_doc = REPO / "docs" / "metrics.md"
+    metrics_text = metrics_doc.read_text() if metrics_doc.exists() else ""
+
+    # Knobs: the MOE_* env lookups in config.py (prefixed HVD_TPU_).
+    config_src = (REPO / "horovod_tpu" / "common"
+                  / "config.py").read_text()
+    env_call = re.compile(r'_env(?:_int|_float|_bool)?\(\s*"(MOE_[A-Z0-9_]+)"')
+    knobs = {"HVD_TPU_" + n for n in env_call.findall(config_src)}
+    if not knobs:
+        missing.append("moe: no HVD_TPU_MOE_* knobs parsed from "
+                       "config.py")
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"moe knob {k}: undocumented in docs/moe.md")
+
+    # Metrics: hvd_tpu_moe_* (parallel/moe.py) + hvd_tpu_alltoall_*
+    # (ops/collectives.py, ops/eager.py, common/autotune.py gauges).
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"(hvd_tpu_[a-z0-9_]+)"')
+    names = set()
+    for rel in (("horovod_tpu", "parallel", "moe.py"),
+                ("horovod_tpu", "ops", "collectives.py"),
+                ("horovod_tpu", "ops", "eager.py"),
+                ("horovod_tpu", "common", "autotune.py")):
+        names |= set(reg_call.findall(REPO.joinpath(*rel).read_text()))
+    names = {n for n in names
+             if n.startswith("hvd_tpu_moe_")
+             or n.startswith("hvd_tpu_alltoall_")
+             or n == "hvd_tpu_autotune_moe_wire_index"}
+    if not any(n.startswith("hvd_tpu_moe_") for n in names):
+        missing.append("moe: no hvd_tpu_moe_* metrics registered")
+    if not any(n.startswith("hvd_tpu_alltoall_") for n in names):
+        missing.append("moe: no hvd_tpu_alltoall_* metrics registered")
+    for n in sorted(names):
+        for where, t in (("docs/moe.md", text),
+                         ("docs/metrics.md", metrics_text)):
+            if n not in t:
+                missing.append(f"moe metric {n}: undocumented in "
+                               f"{where}")
+
+    # Bench flags: present in bench.py AND named in docs/moe.md.
+    bench_src = (REPO / "bench.py").read_text()
+    for flag in ("--moe", "--moe-wire", "--moe-overlap",
+                 "--moe-router-noise"):
+        if f'"{flag}"' not in bench_src:
+            missing.append(f"moe: bench.py lacks the {flag} flag")
+        elif flag not in text:
+            missing.append(f"moe bench flag {flag}: undocumented in "
+                           "docs/moe.md")
+
+    # Public API names: if defined in source, they must appear in both
+    # docs/api.md and docs/moe.md.
+    api_names = {
+        ("horovod_tpu", "parallel", "moe.py"): (
+            "moe_layer", "top2_gating", "ep_index", "ep_size",
+            "record_moe_stats", "chaos_skew_gate"),
+        ("horovod_tpu", "ops", "collectives.py"): (
+            "compressed_alltoall", "mesh_alltoall",
+            "alltoall_wire_cost"),
+        ("horovod_tpu", "common", "fusion.py"): (
+            "assign_alltoall_wire",),
+        ("horovod_tpu", "models", "gpt.py"): ("MoeMlp",),
+        ("horovod_tpu", "common", "exceptions.py"): (
+            "AlltoallvLayoutError",),
+    }
+    for rel, fns in api_names.items():
+        src = REPO.joinpath(*rel).read_text()
+        for name in fns:
+            if f"def {name}" not in src and f"class {name}" not in src:
+                continue
+            for where, t in (("docs/api.md", api_text),
+                             ("docs/moe.md", text)):
+                if name not in t:
+                    missing.append(f"moe api {name}: undocumented in "
+                                   f"{where}")
+
+    # The tool surfaces: microbench section + chaos family.
+    micro_src = (REPO / "tools" / "tpu_microbench.py").read_text()
+    if '"alltoall"' not in micro_src:
+        missing.append("moe: tpu_microbench.py lacks the alltoall "
+                       "section")
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    if "run_moe_soak" not in soak_src or '"moe"' not in soak_src:
+        missing.append("moe: chaos_soak.py lacks the moe family")
+    # The fault site + hot-expert troubleshooting entry.
+    faults_src = (REPO / "horovod_tpu" / "common"
+                  / "faults.py").read_text()
+    if '"moe_skew"' not in faults_src:
+        missing.append("moe: faults.py lacks the moe_skew site")
+    ts = (REPO / "docs" / "troubleshooting.md")
+    ts_text = ts.read_text() if ts.exists() else ""
+    if "hvd_tpu_moe_expert_load" not in ts_text:
+        missing.append("moe: docs/troubleshooting.md lacks the "
+                       "hot-expert entry reading the load gauge")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -408,6 +517,7 @@ def main() -> int:
     check_autoscale_surface(missing)
     check_mfu_surface(missing)
     check_podmon_surface(missing)
+    check_moe_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
