@@ -313,6 +313,35 @@ def main():
                         "every batches-per-iter batches instead of once "
                         "at window end (serializes host and device; "
                         "r03 measured it as a 14%% wall tax)")
+    p.add_argument("--serve", action="store_true",
+                   help="inference-serving workload (docs/serve.md): "
+                        "drive a multi-replica continuously-batched "
+                        "GPT decode service over a seeded open-loop "
+                        "Poisson trace; records workload='serve' with "
+                        "p50/p99 latency, token throughput, batch "
+                        "occupancy, and a repeat-identity event digest "
+                        "into the BENCH json. GPT models only "
+                        "(non-GPT --model falls back to gpt_tiny)")
+    p.add_argument("--serve-replicas", type=int, default=2,
+                   help="initial replica count for --serve (the SLO "
+                        "controller may grow/drain from here)")
+    p.add_argument("--serve-slots", type=int, default=4,
+                   help="decode slots per replica for --serve "
+                        "(HVD_TPU_SERVE_SLOTS overrides)")
+    p.add_argument("--serve-kv", default="",
+                   choices=["", "fp32", "int8"],
+                   help="KV-cache storage for --serve ('' = "
+                        "HVD_TPU_SERVE_KV_DTYPE or fp32): int8 is the "
+                        "block-scaled ~4x-smaller cache; the record "
+                        "carries kv_cache_bytes either way")
+    p.add_argument("--serve-requests", type=int, default=80,
+                   help="trace length for --serve")
+    p.add_argument("--serve-rate", type=float, default=25.0,
+                   help="open-loop arrival rate (requests/s, virtual "
+                        "time) for --serve")
+    p.add_argument("--serve-seed", type=int, default=42,
+                   help="traffic seed for --serve (same seed => "
+                        "byte-identical event sequence)")
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -385,6 +414,17 @@ def main():
     platform = jax.devices()[0].platform
     n = hvd.size()
     _log(f"worker initialized: platform={platform} n={n}")
+
+    if args.serve:
+        # Serving workload (docs/serve.md): scheduling + latency, not
+        # training MFU — its own record shape, gated per-workload by
+        # the bench queue.
+        result = _run_serve_benchmark(args)
+        result["platform"] = platform
+        if args.smoke:
+            result["smoke"] = True
+        _emit(result)
+        return
 
     # Global batch must divide over the n chips (spmd_step shards it).
     if platform == "cpu" and not args.smoke and args.batch_size == 0:
@@ -632,6 +672,110 @@ def _setup(args, batch_size, n):
 _FEEDS = []
 
 
+def _run_serve_benchmark(args):
+    """The --serve workload: a CPU/TPU multi-replica continuously
+    batched GPT decode service driven by a seeded open-loop Poisson
+    trace (docs/serve.md). Emits workload="serve" with p50/p99 latency
+    (virtual time — deterministic), real token throughput (wall time),
+    mean batch occupancy, the KV-cache byte accounting, and an
+    event-digest fingerprint: two runs of the same seed/config must
+    produce the same digest (the repeat-identity acceptance check)."""
+    import hashlib
+
+    import jax
+
+    from horovod_tpu.models import gpt, init_kv_cache
+    from horovod_tpu.serve import kvcache as kv_lib
+    from horovod_tpu.serve.controller import SLOPolicy, ServeCluster
+    from horovod_tpu.serve.engine import (engine_defaults_from_env,
+                                          make_engine_factory)
+    from horovod_tpu.serve.traffic import poisson_trace
+
+    model_name = args.model if args.model.startswith("gpt") \
+        else "gpt_tiny"
+    if args.smoke:
+        model_name = "gpt_tiny"
+    model = {"gpt_tiny": gpt.gpt_tiny, "gpt_small": gpt.gpt_small,
+             "gpt_medium": gpt.gpt_medium}[model_name]()
+
+    geometry = {"slots": args.serve_slots, "max_len": 64,
+                "max_prompt_len": 16}
+    geometry.update(engine_defaults_from_env())
+    if args.serve_kv:
+        geometry["kv_kind"] = args.serve_kv
+    kv_kind = geometry.setdefault("kv_kind", "fp32")
+    geometry["max_prompt_len"] = min(geometry["max_prompt_len"],
+                                     geometry["max_len"])
+
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 4), np.int32))
+    factory = make_engine_factory(model, params, **geometry)
+    requests = min(args.serve_requests, 20) if args.smoke \
+        else args.serve_requests
+    trace = poisson_trace(
+        seed=args.serve_seed, n_requests=requests,
+        rate_rps=args.serve_rate,
+        prompt_lens=(4, 8, geometry["max_prompt_len"]),
+        output_lens=(4, 8, 16, 32),
+        vocab_size=model.vocab_size)
+    # Policy from env (HVD_TPU_SERVE_POLICY / HVD_TPU_SERVE_*): the
+    # DEFAULT policy has every grow/shrink trigger off, so the stock
+    # bench measures a fixed replica set — controller activity is an
+    # explicit arm.
+    cluster = ServeCluster(factory, policy=SLOPolicy.from_env(),
+                           replicas=args.serve_replicas, step_s=0.05,
+                           log_path="")
+    _log(f"serve: {model_name} replicas={args.serve_replicas} "
+         f"slots={geometry['slots']} kv={kv_kind} "
+         f"requests={requests} rate={args.serve_rate}/s")
+    report = cluster.run(trace)
+
+    digest = hashlib.sha256(json.dumps(
+        {"events": [list(e) for e in report["events"]],
+         "decisions": report["decisions"]},
+        sort_keys=True).encode()).hexdigest()[:16]
+    cache_bytes = kv_lib.cache_nbytes(init_kv_cache(
+        model, geometry["slots"], geometry["max_len"], kind=kv_kind))
+    fp32_bytes = kv_lib.cache_nbytes(init_kv_cache(
+        model, geometry["slots"], geometry["max_len"], kind="fp32"))
+    return {
+        "metric": f"{model_name}_serve_tokens_per_sec",
+        "value": report["tokens_per_wall_s"],
+        "unit": "tok/s",
+        "workload": "serve",
+        "latency_p50_s": report["latency_p50_s"],
+        "latency_p99_s": report["latency_p99_s"],
+        "tokens_per_virtual_s": report["tokens_per_virtual_s"],
+        "mean_occupancy": report["mean_occupancy"],
+        "completed": report["completed"],
+        "dropped": report["dropped"],
+        "deadline_misses": report["deadline_misses"],
+        "decisions": len(report["decisions"]),
+        "event_digest": digest,
+        "kv": {
+            "kind": kv_kind,
+            "cache_bytes_per_replica": cache_bytes,
+            "reduction_vs_fp32_x": round(fp32_bytes / cache_bytes, 2),
+        },
+        "config": {
+            "model": model_name,
+            "replicas": args.serve_replicas,
+            "slots": geometry["slots"],
+            "max_len": geometry["max_len"],
+            "max_prompt_len": geometry["max_prompt_len"],
+            "requests": requests,
+            "rate_rps": args.serve_rate,
+            "seed": args.serve_seed,
+            "step_s": 0.05,
+        },
+        "config_note": (
+            f"serve {model_name} r={args.serve_replicas} "
+            f"slots={geometry['slots']} kv={kv_kind} "
+            f"p99={report['latency_p99_s']}s "
+            f"occ={report['mean_occupancy']}"),
+    }
+
+
 def _run_benchmark(args, n):
     try:
         return _run_benchmark_inner(args, n)
@@ -723,6 +867,10 @@ def _run_benchmark_inner(args, n):
                   f"_per_sec_per_chip",
         "value": round(val, 2),
         "unit": "samples/s" if (is_bert or is_gpt) else "img/s",
+        # Workload tag: the bench-queue regression gate diffs records
+        # within a workload only (training MFU vs serve latency are
+        # different regression bases — docs/serve.md).
+        "workload": "train",
         "vs_baseline": round(val / baseline, 3),
     }
     if args.model.startswith("resnet") and not args.no_s2d:

@@ -87,7 +87,7 @@ def __getattr__(name):
         return _run
     if name in ("checkpoint", "callbacks", "elastic", "executor",
                 "tensorflow", "torch", "mxnet", "store", "estimator",
-                "spark"):
+                "spark", "serve"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)
@@ -504,5 +504,5 @@ __all__ = [
     "accumulate_gradients", "resolve_remat_policy",
     "auto_shard_threshold", "should_shard_update", "DeviceInfeed",
     "prefetch_to_device", "BackgroundPrefetcher", "shard_batch",
-    "infeed_pipeline",
+    "infeed_pipeline", "serve",
 ]

@@ -66,6 +66,12 @@ site                 where it fires / what it does
                      expert whose capacity overflow the
                      ``hvd_tpu_moe_*`` drop/load gauges must surface
                      (docs/moe.md)
+``replica_kill``     serve cluster round (tools/chaos_soak.py --family
+                     serve, one hit per decode round): hard-kill
+                     serving replica ``target`` mid-stream — queued +
+                     in-flight requests must re-route with zero drops
+                     and the SLO controller must log the kill → grow
+                     sequence (docs/serve.md)
 ===================  =====================================================
 
 Plan JSON: ``{"seed": 42, "faults": [{"site": ..., "step": N |
@@ -100,7 +106,7 @@ ENV_LOG = "HVD_TPU_FAULT_LOG"
 
 SITES = ("collective", "collective_stall", "rendezvous", "discovery",
          "crash", "preempt", "nonfinite", "diverge", "checkpoint_corrupt",
-         "straggler", "moe_skew")
+         "straggler", "moe_skew", "replica_kill")
 
 _SPEC_FIELDS = ("site", "step", "probability", "times", "mode", "delay_s",
                 "code", "exit_code", "message", "rank", "host", "target",

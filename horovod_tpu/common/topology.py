@@ -63,6 +63,42 @@ class Topology:
         return [i for i, d in enumerate(self.devices) if id(d) in local]
 
 
+def _cpu_platform_selected() -> bool:
+    """True when this process will run on the CPU backend — the loopback
+    test tier (JAX_PLATFORMS=cpu / jax_platforms config /
+    HVD_TPU_FORCE_CPU_DEVICES), not a real TPU pod."""
+    import jax
+
+    if os.environ.get("HVD_TPU_FORCE_CPU_DEVICES"):
+        return True
+    for raw in (os.environ.get("JAX_PLATFORMS", ""),
+                getattr(jax.config, "jax_platforms", None) or ""):
+        if raw.split(",")[0].strip().lower() == "cpu":
+            return True
+    return False
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Configure a cross-process collectives implementation for
+    multi-process CPU worlds.
+
+    XLA's CPU client refuses to compile multiprocess computations
+    ("Multiprocess computations aren't implemented on the CPU backend")
+    unless it was created with a collectives implementation, and jax
+    0.4.x never reads the JAX_CPU_COLLECTIVES_IMPLEMENTATION env var —
+    the config knob must be set in-process BEFORE the backend client
+    exists. Without this, every `runner.run(..., np=2)` world on CPU
+    (tests/test_run_api.py) dies at its first allreduce.
+    """
+    import jax
+
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except Exception:  # noqa: BLE001 — older jaxlib without the knob
+        pass
+
+
 def _maybe_init_distributed() -> None:
     """Initialize jax.distributed when launched multi-process.
 
@@ -79,6 +115,8 @@ def _maybe_init_distributed() -> None:
         nproc = int(os.environ["HVD_TPU_NUM_PROC"])
         pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
         if nproc > 1:
+            if _cpu_platform_selected():
+                _maybe_enable_cpu_collectives()
             try:
                 jax.distributed.initialize(
                     coordinator_address=coord,

@@ -47,6 +47,26 @@ def _causal_attend(q, k, v, mask=None):
     return flash_attention(q, k, v, mask=mask, causal=True)
 
 
+def _cache_attend(q, k_all, v_all, q_pos, k_pos):
+    """Attention of ``s_in`` new queries over a ring-buffer KV cache
+    (docs/serve.md): q (B, S_in, H, D) at global positions ``q_pos``
+    (B, S_in); k_all/v_all (B, S_max, H, D) cache slabs whose line j
+    holds the token at global position ``k_pos[b, j]`` (-1 = empty).
+    A line is attendable iff occupied AND causally visible — validity
+    is data, so prefill (S_in = prompt), single-token decode, and
+    ring-wrapped sequences all share this one program. fp32 softmax
+    (the standard LM-head/attention stability recipe)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_all.astype(jnp.float32)) / jnp.sqrt(float(d))
+    visible = ((k_pos[:, None, :] >= 0)
+               & (k_pos[:, None, :] <= q_pos[:, :, None]))  # (B,S_in,S_max)
+    logits = jnp.where(visible[:, None], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w,
+                      v_all.astype(jnp.float32)).astype(q.dtype)
+
+
 class MoeMlp(nn.Module):
     """Expert-parallel FFN replacing the dense MLP when the GPT
     ``moe_experts`` knob is set (docs/moe.md): GShard top-2 gating +
@@ -131,12 +151,31 @@ class CausalSelfAttention(nn.Module):
     attend_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None, cache_ctx=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
         qkv = nn.Dense(3 * h, dtype=self.dtype, param_dtype=jnp.float32,
                        name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cache is not None:
+            # Incremental (serve) path: RoPE with each token's GLOBAL
+            # position, scatter the new K/V into their ring lines, and
+            # attend over the cache slab (docs/serve.md). Keys are
+            # stored ALREADY ROPED, so absolute positions survive the
+            # ring wrap without re-rotation.
+            from ..serve import kvcache as kv_lib
+
+            idx, q_pos, k_pos = cache_ctx
+            q = rope(q.reshape(b, s, self.num_heads, head_dim), q_pos)
+            k = rope(k.reshape(b, s, self.num_heads, head_dim), q_pos)
+            v = v.reshape(b, s, self.num_heads, head_dim)
+            cache = kv_lib.layer_write(cache, idx, k, v)
+            k_all, v_all = kv_lib.layer_read(cache, jnp.float32)
+            o = _cache_attend(q, k_all, v_all, q_pos,
+                              k_pos).reshape(b, s, h)
+            return nn.Dense(h, dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name="out")(o), cache
         q = rope(q.reshape(b, s, self.num_heads, head_dim), positions)
         k = rope(k.reshape(b, s, self.num_heads, head_dim), positions)
         v = v.reshape(b, s, self.num_heads, head_dim)
@@ -160,25 +199,33 @@ class DecoderLayer(nn.Module):
     moe_router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None, cache_ctx=None):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        x = x + CausalSelfAttention(self.num_heads, self.dtype,
-                                    self.attend_fn,
-                                    name="attn")(y, positions)
+        if cache is not None:
+            a, cache = CausalSelfAttention(
+                self.num_heads, self.dtype, self.attend_fn,
+                name="attn")(y, positions, cache, cache_ctx)
+            x = x + a
+        else:
+            x = x + CausalSelfAttention(self.num_heads, self.dtype,
+                                        self.attend_fn,
+                                        name="attn")(y, positions)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.moe_experts:
-            return x + MoeMlp(self.moe_experts, self.mlp_dim,
-                              self.moe_capacity_factor, self.dtype,
-                              self.moe_axis, self.moe_route,
-                              self.moe_wire, self.moe_overlap_chunks,
-                              self.moe_router_noise,
-                              name="moe")(y)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_out")(y)
-        return x + y
+            out = x + MoeMlp(self.moe_experts, self.mlp_dim,
+                             self.moe_capacity_factor, self.dtype,
+                             self.moe_axis, self.moe_route,
+                             self.moe_wire, self.moe_overlap_chunks,
+                             self.moe_router_noise,
+                             name="moe")(y)
+        else:
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_out")(y)
+            out = x + y
+        return out if cache is None else (out, cache)
 
 
 class GPT(nn.Module):
@@ -213,19 +260,42 @@ class GPT(nn.Module):
     moe_router_noise: float = 0.0
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, cache=None):
         emb = nn.Embed(self.vocab_size, self.hidden,
                        param_dtype=jnp.float32, name="tok_emb")
         x = emb(tokens).astype(self.dtype)
         layer_cls = nn.remat(DecoderLayer) if self.remat else DecoderLayer
+        cache_ctx = None
+        new_layers = []
+        if cache is not None:
+            # Incremental mode (docs/serve.md): the s_in new tokens of
+            # every slot extend that slot's sequence at global
+            # positions pos..pos+s_in, landing in ring lines
+            # (pos + i) % max_len — prefill (s_in = prompt length) and
+            # decode (s_in = 1) are the SAME program at different
+            # shapes. Returns (logits, updated cache).
+            b, s_in = tokens.shape
+            s_max = cache["slot_pos"].shape[1]
+            q_pos = (cache["pos"][:, None]
+                     + jnp.arange(s_in, dtype=jnp.int32)[None, :])
+            idx = q_pos % s_max
+            slot_pos = cache["slot_pos"].at[
+                jnp.arange(b)[:, None], idx].set(q_pos)
+            cache_ctx = (idx, q_pos, slot_pos)
         for i in range(self.num_layers):
-            x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
-                          self.attend_fn, self.moe_experts,
-                          self.moe_capacity_factor, self.moe_axis,
-                          self.moe_route, self.moe_wire,
-                          self.moe_overlap_chunks,
-                          self.moe_router_noise,
-                          name=f"layer{i}")(x, positions)
+            layer = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
+                              self.attend_fn, self.moe_experts,
+                              self.moe_capacity_factor, self.moe_axis,
+                              self.moe_route, self.moe_wire,
+                              self.moe_overlap_chunks,
+                              self.moe_router_noise,
+                              name=f"layer{i}")
+            if cache is not None:
+                x, lc = layer(x, positions, cache["layers"][i],
+                              cache_ctx)
+                new_layers.append(lc)
+            else:
+                x = layer(x, positions)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="final_ln")(x)
         # Weight-tied head: bf16 operands + fp32 accumulation — the
@@ -236,6 +306,11 @@ class GPT(nn.Module):
             x.astype(self.dtype), emb.embedding.astype(self.dtype),
             (((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if cache is not None:
+            new_cache = {"layers": tuple(new_layers),
+                         "pos": cache["pos"] + tokens.shape[1],
+                         "slot_pos": cache_ctx[2]}
+            return logits, new_cache
         return logits
 
 
@@ -259,3 +334,17 @@ def gpt_tiny(**kw):
                  ("dtype", jnp.float32)):
         kw.setdefault(k, v)
     return GPT(**kw)
+
+
+def init_kv_cache(model: GPT, slots: int, max_len: int,
+                  kind: str = "fp32"):
+    """A fresh KV-cache pytree matching ``model``'s geometry — the
+    ``cache=`` argument of the incremental ``model.apply`` path
+    (docs/serve.md). ``kind`` is ``"fp32"`` (model-dtype storage) or
+    ``"int8"`` (block-scaled, ~4x smaller)."""
+    from ..serve import kvcache as kv_lib
+
+    return kv_lib.init_cache(model.num_layers, slots, max_len,
+                             model.num_heads,
+                             model.hidden // model.num_heads,
+                             kind=kind, dtype=model.dtype)

@@ -1,0 +1,66 @@
+"""``hvd.serve`` — distributed inference serving on the training
+substrate (docs/serve.md).
+
+The north star serves heavy traffic; everything below this package
+optimizes training. ``hvd.serve`` closes the gap with the pieces the
+substrate already grew: GPT decode over an explicit ring-buffer KV
+cache (``kvcache`` — fp32 or block-scaled int8 storage reusing the
+Pallas wire quantization), a continuous batcher generalizing the
+``DeviceInfeed`` background-feed pattern to request queues
+(``queue``/``batcher``), a per-replica decode engine with
+flight-recorder events on the decode path (``engine``), seeded
+open-loop traffic (``traffic``), and an SLO-driven replica controller
+repurposing the autoscale decision machinery — p99 latency / queue
+depth instead of step-time skew, graceful drain, deterministic
+decision log (``controller``).
+
+Public surface (all lazily imported; ``import horovod_tpu as hvd`` then
+``hvd.serve.X``):
+
+* ``Request``, ``RequestQueue`` — the admission plane.
+* ``TrafficTrace``, ``poisson_trace`` — seeded open-loop load.
+* ``DecodeEngine``, ``ContinuousBatcher`` — one replica's decode loop.
+* ``SLOPolicy``, ``ServeController``, ``ServeCluster`` — the
+  multi-replica control plane.
+* ``kvcache`` — the cache pytree ops (init/export/import, int8).
+* ``init_kv_cache`` — re-exported model-geometry cache constructor.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "Request": ("queue", "Request"),
+    "RequestQueue": ("queue", "RequestQueue"),
+    "TrafficTrace": ("traffic", "TrafficTrace"),
+    "poisson_trace": ("traffic", "poisson_trace"),
+    "DecodeEngine": ("engine", "DecodeEngine"),
+    "ContinuousBatcher": ("batcher", "ContinuousBatcher"),
+    "SLOPolicy": ("controller", "SLOPolicy"),
+    "ServeController": ("controller", "ServeController"),
+    "ServeCluster": ("controller", "ServeCluster"),
+    "init_kv_cache": ("..models.gpt", "init_kv_cache"),
+}
+
+_LAZY_MODULES = ("kvcache", "queue", "batcher", "engine", "controller",
+                 "traffic")
+
+__all__ = sorted(list(_LAZY) + list(_LAZY_MODULES))
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY:
+        mod_name, attr = _LAZY[name]
+        mod = importlib.import_module(
+            mod_name if mod_name.startswith(".." ) else "." + mod_name,
+            __name__)
+        val = getattr(mod, attr)
+        globals()[name] = val
+        return val
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'horovod_tpu.serve' has no attribute {name!r}")

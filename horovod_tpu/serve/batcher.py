@@ -1,0 +1,128 @@
+"""Continuous batching: the per-replica serving loop (docs/serve.md).
+
+Static batching decodes a batch until its LONGEST sequence finishes —
+short requests pay the long tail's latency and finished slots burn
+compute. Continuous batching retires a sequence the step it finishes
+and admits a queued request into the freed slot on the very next step,
+which is where serving throughput actually comes from (Orca/vLLM's
+core scheduling idea). The loop per decode round:
+
+    admit (queue -> free slots, unless draining)
+    decode (one jitted step across all slots)
+    retire (finished sequences complete + free their slots)
+
+Graceful drain (the controller's shrink path and the replica-kill
+runbook): ``start_drain()`` stops admission and empties the queue for
+re-routing; in-flight sequences keep decoding locally until
+``drained``. Every transition lands in a deterministic event list —
+``(step, event, ...)`` integer tuples — which is the byte-identity
+surface the serve chaos family replays (tools/chaos_soak.py --family
+serve).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..common import metrics as metrics_lib
+from .engine import DecodeEngine
+from .queue import Request, RequestQueue
+
+_M_DRAINS = metrics_lib.counter(
+    "hvd_tpu_serve_drains_total",
+    "replica drains started, by cause (shrink = SLO scale-down, "
+    "kill = replica loss)",
+    labels=("cause",))
+for _c in ("shrink", "kill"):
+    _M_DRAINS.labels(cause=_c)
+del _c
+_M_OCCUPANCY = metrics_lib.gauge(
+    "hvd_tpu_serve_batch_occupancy",
+    "active decode slots / total slots of the last decode round, "
+    "by replica",
+    labels=("replica",))
+
+
+class ContinuousBatcher:
+    """One replica's admission + decode + retire loop over a
+    :class:`DecodeEngine` and its :class:`RequestQueue`."""
+
+    def __init__(self, engine: DecodeEngine,
+                 queue: Optional[RequestQueue] = None):
+        self.engine = engine
+        self.queue = queue if queue is not None else RequestQueue()
+        self.name = engine.name
+        self.draining = False
+        self.completed: List[Request] = []
+        self.events: List[Tuple] = []
+        self.steps = 0
+        self._occ_sum = 0.0
+        self._occ_n = 0
+
+    # -- drain lifecycle -----------------------------------------------------
+
+    def start_drain(self, cause: str = "shrink") -> List[Request]:
+        """Stop admitting; hand back the queued (unstarted) requests
+        for re-routing. In-flight sequences keep decoding here until
+        :attr:`drained`."""
+        if not self.draining:
+            self.draining = True
+            _M_DRAINS.labels(cause=cause).inc()
+            self.events.append((self.steps, "drain_start", cause))
+        rerouted = self.queue.drain()
+        for req in rerouted:
+            req.reroutes += 1
+            self.events.append((self.steps, "reroute", req.rid))
+        return rerouted
+
+    @property
+    def drained(self) -> bool:
+        return (self.draining and self.engine.active_count() == 0
+                and len(self.queue) == 0)
+
+    def abort(self) -> List[Request]:
+        """Replica kill: queued AND in-flight requests come back for
+        re-routing (in-flight restart from their prompts on a peer —
+        zero dropped requests)."""
+        out = self.start_drain(cause="kill")
+        aborted = self.engine.abort_all()
+        for req in aborted:
+            self.events.append((self.steps, "abort", req.rid))
+        return out + aborted
+
+    # -- the serving loop ----------------------------------------------------
+
+    def run_step(self, now: float = 0.0) -> List[Request]:
+        """One admit/decode/retire round; returns the requests that
+        completed this round."""
+        finished: List[Request] = []
+        if not self.draining:
+            for req in self.queue.take(len(self.engine.free_slots()),
+                                       now):
+                slot = self.engine.admit(req, now)
+                self.events.append((self.steps, "admit", req.rid, slot))
+                if self.engine.request_done(slot):
+                    # 1-token/instant-EOS request: complete at prefill.
+                    finished.append(self.engine.retire(slot, now))
+        occ = self.engine.active_count() / max(1, self.engine.slots)
+        self._occ_sum += occ
+        self._occ_n += 1
+        _M_OCCUPANCY.labels(replica=self.name).set(occ)
+        finished.extend(self.engine.step(now))
+        for req in finished:
+            self.events.append((self.steps, "finish", req.rid,
+                                len(req.tokens)))
+        self.completed.extend(finished)
+        self.steps += 1
+        return finished
+
+    def mean_occupancy(self) -> float:
+        return self._occ_sum / self._occ_n if self._occ_n else 0.0
+
+    def close(self) -> None:
+        """Zero this replica's labeled gauges on departure (kill or
+        finished drain) — replica names are monotonic, so stale series
+        would otherwise accumulate one dead gauge per departed replica
+        for the life of the process."""
+        _M_OCCUPANCY.labels(replica=self.name).set(0)
+        self.engine.close()

@@ -1,0 +1,529 @@
+"""SLO-driven replica control: the autoscale decision machinery
+repurposed for serving (docs/serve.md).
+
+Training autoscaling (common/autoscale.py) turns per-rank step-time
+telemetry into ``keep | grow | shrink | evict`` through policies-as-
+data and a deterministic decision log. Serving needs the same control
+plane with different signals — request-latency SLOs (p99 over a
+completion window) and queue depth instead of step-time skew — and one
+different mechanism: replicas leave by GRACEFUL DRAIN (stop admitting,
+finish in-flight, re-route the queue) rather than eviction, because a
+replica holds irreplaceable in-flight state the way a training rank
+does not.
+
+Same contracts as the training plane, deliberately:
+
+* :class:`SLOPolicy` — every threshold is data
+  (``HVD_TPU_SERVE_POLICY`` file/inline JSON +
+  ``HVD_TPU_SERVE_<FIELD>`` env overrides), validation names the bad
+  field.
+* Decisions reuse ``common/autoscale.Decision`` — the same
+  ``{"seq", "action", "target", "reason"}`` JSON-lines log
+  (``HVD_TPU_SERVE_LOG``), deterministic fields only, so a seeded
+  chaos run replays byte-identically
+  (tools/chaos_soak.py --family serve).
+* The elastic ``HostManager`` plugs in unchanged: a killed replica's
+  host is blacklisted with the same TTL/strike machinery, and grow
+  consults the usable-host set before starting a replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.autoscale import Decision
+from .batcher import ContinuousBatcher
+from .engine import DecodeEngine
+from .queue import Request
+from .traffic import TrafficTrace
+
+logger = logging.getLogger("horovod_tpu")
+
+ENV_POLICY = "HVD_TPU_SERVE_POLICY"   # policy file path or inline JSON
+ENV_LOG = "HVD_TPU_SERVE_LOG"         # decision log (JSONL)
+
+
+def _truthy(raw: Optional[str]) -> bool:
+    return (raw or "").strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class SLOPolicy:
+    """Every serving-SLO threshold — data, not code (docs/serve.md has
+    the schema table and recipes)."""
+
+    enabled: bool = True
+    # Controller cadence (virtual seconds in simulation).
+    tick_interval_s: float = 0.25
+    # Completion window the latency percentiles cover.
+    window: int = 16
+    # Grow when the windowed p99 exceeds this (0 = off).
+    target_p99_s: float = 0.0
+    # Grow when total queued requests exceed this (0 = off).
+    max_queue_depth: int = 0
+    # Drain one replica when instantaneous slot occupancy falls below
+    # this AND every queue is empty (0 = never shrink on load).
+    low_occupancy: float = 0.0
+    # Replica-count floor/ceiling. A kill that drops the cluster below
+    # min_replicas restores capacity immediately (no cooldown).
+    min_replicas: int = 1
+    max_replicas: int = 4
+    grow_cooldown_s: float = 1.0
+    shrink_cooldown_s: float = 2.0
+
+    @classmethod
+    def field_names(cls) -> Tuple[str, ...]:
+        return tuple(f.name for f in dataclasses.fields(cls))
+
+    @classmethod
+    def from_dict(cls, data: Dict) -> "SLOPolicy":
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"serve policy must be a JSON object, got "
+                f"{type(data).__name__}")
+        known = cls.field_names()
+        unknown = sorted(set(data) - set(known))
+        if unknown:
+            raise ValueError(
+                f"serve policy: unknown field(s) {unknown}; known "
+                f"fields: {sorted(known)}")
+        policy = cls()
+        for name, value in data.items():
+            default = getattr(policy, name)
+            try:
+                if isinstance(default, bool):
+                    if isinstance(value, str):
+                        value = _truthy(value)
+                    value = bool(value)
+                elif isinstance(default, int):
+                    value = int(value)
+                elif isinstance(default, float):
+                    value = float(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"serve policy: field {name!r} must be a "
+                    f"{type(default).__name__}, got {value!r}")
+            setattr(policy, name, value)
+        policy.validate()
+        return policy
+
+    def validate(self) -> "SLOPolicy":
+        for name in ("tick_interval_s", "target_p99_s", "low_occupancy",
+                     "grow_cooldown_s", "shrink_cooldown_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"serve policy: field {name!r} must be >= 0, got "
+                    f"{getattr(self, name)}")
+        for name in ("window", "min_replicas", "max_replicas"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"serve policy: field {name!r} must be >= 1, got "
+                    f"{getattr(self, name)}")
+        if self.max_queue_depth < 0:
+            raise ValueError(
+                "serve policy: field 'max_queue_depth' must be >= 0 "
+                f"(0 disables), got {self.max_queue_depth}")
+        if self.low_occupancy > 1.0:
+            raise ValueError(
+                "serve policy: field 'low_occupancy' is a fraction in "
+                f"[0, 1], got {self.low_occupancy}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"serve policy: max_replicas {self.max_replicas} < "
+                f"min_replicas {self.min_replicas}")
+        return self
+
+    @classmethod
+    def from_json(cls, text: str) -> "SLOPolicy":
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"serve policy: invalid JSON ({e})")
+        return cls.from_dict(data)
+
+    @classmethod
+    def load(cls, source: str) -> "SLOPolicy":
+        source = source.strip()
+        if source.startswith("@"):
+            with open(source[1:]) as f:
+                return cls.from_json(f.read())
+        if source.startswith("{"):
+            return cls.from_json(source)
+        with open(source) as f:
+            return cls.from_json(f.read())
+
+    @classmethod
+    def from_env(cls, env=None) -> "SLOPolicy":
+        """``HVD_TPU_SERVE_POLICY`` (file or inline JSON) as the base,
+        then any ``HVD_TPU_SERVE_<FIELD>`` env knob overrides its
+        field — same layering as the training AutoscalePolicy, audited
+        by tools/check_parity.py check_serve_surface."""
+        env = os.environ if env is None else env
+        raw = env.get(ENV_POLICY)
+        policy = cls.load(raw) if raw else cls()
+        overrides: Dict = {}
+        for name in cls.field_names():
+            val = env.get("HVD_TPU_SERVE_" + name.upper())
+            if val is not None:
+                overrides[name] = val
+        if overrides:
+            merged = dataclasses.asdict(policy)
+            merged.update(overrides)
+            policy = cls.from_dict(merged)
+        return policy
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+
+class ServeController:
+    """Turns cluster telemetry (windowed latency percentiles, queue
+    depths, occupancy, replica loss) into deterministic
+    ``keep | grow | drain`` decisions, logged exactly like the training
+    autoscaler's."""
+
+    def __init__(self, policy: SLOPolicy,
+                 log_path: Optional[str] = None):
+        self.policy = policy
+        self._log_path = (log_path if log_path is not None
+                          else os.environ.get(ENV_LOG) or None)
+        self.decisions: List[Decision] = []
+        self._seq = 0
+        self._latencies: deque = deque(maxlen=max(1, policy.window))
+        self._last_grow_t = -float("inf")
+        self._last_shrink_t = -float("inf")
+        self._last_tick_t = -float("inf")
+
+    # -- evidence feeds ------------------------------------------------------
+
+    def observe_completion(self, req: Request) -> None:
+        if req.latency_s is not None:
+            self._latencies.append(req.latency_s)
+
+    def windowed_p99(self) -> Optional[float]:
+        if not self._latencies:
+            return None
+        return float(np.percentile(np.asarray(self._latencies), 99))
+
+    # -- decision plumbing (the autoscale contract) --------------------------
+
+    def _record(self, decision: Decision) -> Decision:
+        if decision.action != "keep":
+            self._seq += 1
+            decision.seq = self._seq
+            self.decisions.append(decision)
+            logger.warning("serve: decision #%d %s target=%s (%s)",
+                           decision.seq, decision.action,
+                           decision.target, decision.reason)
+            if self._log_path:
+                try:
+                    with open(self._log_path, "a") as f:
+                        f.write(decision.log_line() + "\n")
+                except OSError:
+                    pass  # the log is evidence, never a failure mode
+        return decision
+
+    def decision_log(self) -> List[str]:
+        return [d.log_line() for d in self.decisions
+                if d.action != "keep"]
+
+    # -- triggers ------------------------------------------------------------
+
+    def note_replica_lost(self, name: str) -> Decision:
+        """A replica died mid-stream: the kill IS a drain (its queue
+        and in-flight re-route) — record it so the log names the kill
+        before the restoring grow."""
+        return self._record(Decision(action="drain", target=name,
+                                     reason="replica_lost"))
+
+    def tick(self, now: float, live: int, draining: int,
+             queue_depth: int, occupancy: float,
+             below_min: bool,
+             shrink_candidate: Optional[str] = None) -> Decision:
+        """One control evaluation. Returns the (single) decision; the
+        cluster applies grow/drain. At most one reshape per tick —
+        reshape, then re-measure, same hysteresis discipline as the
+        training engine."""
+        p = self.policy
+        if now - self._last_tick_t < p.tick_interval_s \
+                and not below_min:
+            return Decision(action="keep")
+        self._last_tick_t = now
+        active = live - draining
+        if below_min:
+            # Restore the floor immediately — a kill must not wait out
+            # a cooldown while requests queue on the survivors.
+            self._last_grow_t = now
+            return self._record(Decision(
+                action="grow", target="1", reason="restore_capacity"))
+        grow_ok = (active < p.max_replicas
+                   and now - self._last_grow_t >= p.grow_cooldown_s)
+        if grow_ok and p.target_p99_s > 0:
+            p99 = self.windowed_p99()
+            if p99 is not None and p99 > p.target_p99_s:
+                self._last_grow_t = now
+                return self._record(Decision(
+                    action="grow", target="1", reason="slo_p99"))
+        if grow_ok and p.max_queue_depth > 0 \
+                and queue_depth > p.max_queue_depth:
+            self._last_grow_t = now
+            return self._record(Decision(
+                action="grow", target="1", reason="queue_depth"))
+        if (p.low_occupancy > 0 and active > p.min_replicas
+                and queue_depth == 0 and occupancy < p.low_occupancy
+                and shrink_candidate is not None
+                and now - self._last_shrink_t >= p.shrink_cooldown_s):
+            self._last_shrink_t = now
+            return self._record(Decision(
+                action="drain", target=shrink_candidate,
+                reason="low_occupancy"))
+        return Decision(action="keep")
+
+
+class ServeCluster:
+    """Multi-replica serving: a router over per-replica batchers, the
+    SLO controller, and a virtual-time run loop (the CPU-simulated
+    server of docs/serve.md — deterministic by construction: the clock
+    is decode rounds x ``step_s``).
+
+    ``engine_factory(name) -> DecodeEngine`` starts replicas (grow and
+    kill-restore reuse it); ``host_manager`` (optional, the elastic
+    ``HostManager``) maps replicas onto hosts — a killed replica
+    blacklists its host and grow requires a usable one.
+    """
+
+    def __init__(self, engine_factory: Callable[[str], DecodeEngine],
+                 policy: Optional[SLOPolicy] = None, replicas: int = 2,
+                 step_s: float = 0.05, log_path: Optional[str] = None,
+                 host_manager=None,
+                 host_of: Optional[Callable[[str], str]] = None):
+        self.factory = engine_factory
+        self.policy = policy if policy is not None \
+            else SLOPolicy.from_env()
+        self.step_s = float(step_s)
+        self._now = 0.0
+        self.controller = ServeController(self.policy,
+                                          log_path=log_path)
+        self.host_manager = host_manager
+        self.host_of = host_of or (lambda name: name)
+        self.batchers: Dict[str, ContinuousBatcher] = {}
+        self.events: List[Tuple] = []
+        self.completed: List[Request] = []
+        self.overflow: deque = deque()
+        self.rounds = 0
+        self._next_id = 0
+        for _ in range(max(1, int(replicas))):
+            self._start_replica()
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    # How many candidate replica ids _start_replica scans for one
+    # whose host is usable before declaring growth blocked (replica
+    # ids are monotonic; skipped ids are simply never used).
+    _GROW_SCAN = 16
+
+    def _start_replica(self) -> Optional[str]:
+        name = f"r{self._next_id}"
+        consumed = 1
+        if self.host_manager is not None:
+            # The new replica's OWN host must be usable (not
+            # blacklisted) and not already hosting a replica — scan
+            # forward through candidate ids until one maps to such a
+            # host (deterministic: a pure function of cluster state).
+            usable = set(self.host_manager.current_hosts())
+            used = {self.host_of(n) for n in self.batchers}
+            for k in range(self._GROW_SCAN):
+                cand = f"r{self._next_id + k}"
+                host = self.host_of(cand)
+                if host in usable and host not in used:
+                    name, consumed = cand, k + 1
+                    break
+            else:
+                self.events.append((self.rounds, "grow_blocked",
+                                    "no_usable_host"))
+                return None
+        self._next_id += consumed
+        self.batchers[name] = ContinuousBatcher(self.factory(name))
+        self.events.append((self.rounds, "replica_start", name))
+        return name
+
+    def live(self) -> List[str]:
+        return sorted(self.batchers)
+
+    def serving(self) -> List[str]:
+        """Replicas accepting new work (live and not draining)."""
+        return sorted(n for n, b in self.batchers.items()
+                      if not b.draining)
+
+    def kill_replica(self, name: str) -> None:
+        """Hard replica loss (the chaos site): queued + in-flight
+        requests re-route to peers, the host is blacklisted, the
+        controller logs the kill; the next tick restores capacity."""
+        b = self.batchers.pop(name, None)
+        if b is None:
+            return
+        rerouted = b.abort()
+        b.close()
+        self.events.append((self.rounds, "replica_kill", name,
+                            len(rerouted)))
+        self.events.extend((self.rounds, "batcher", name) + e
+                           for e in b.events)
+        if self.host_manager is not None:
+            self.host_manager.blacklist(self.host_of(name))
+        self.controller.note_replica_lost(name)
+        self._reroute(rerouted)
+
+    def _reroute(self, reqs: List[Request]) -> None:
+        for req in reqs:
+            req.replica = None
+            if not self._route(req):
+                self.overflow.append(req)
+
+    # -- routing -------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if not self._route(req):
+            self.overflow.append(req)
+
+    def _route(self, req: Request) -> bool:
+        """Least-loaded live non-draining replica (queued + active),
+        name order breaking ties — deterministic. A bounded queue may
+        refuse (``submit`` returns False); the next-least-loaded
+        replica is tried before the request overflows."""
+        order = sorted(self.serving(), key=lambda n: (
+            len(self.batchers[n].queue)
+            + self.batchers[n].engine.active_count(), n))
+        for name in order:
+            if self.batchers[name].queue.submit(req):
+                self.events.append((self.rounds, "route", req.rid,
+                                    name, req.reroutes))
+                return True
+        return False
+
+    # -- control -------------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        return (sum(len(b.queue) for b in self.batchers.values())
+                + len(self.overflow))
+
+    def occupancy(self) -> float:
+        bs = list(self.batchers.values())
+        if not bs:
+            return 0.0
+        return (sum(b.engine.active_count() for b in bs)
+                / max(1, sum(b.engine.slots for b in bs)))
+
+    def _shrink_candidate(self) -> Optional[str]:
+        """Deterministic drain victim: the newest serving replica."""
+        serving = self.serving()
+        if len(serving) <= self.policy.min_replicas:
+            return None
+        return max(serving, key=lambda n: (int(n[1:]), n))
+
+    def _apply(self, decision) -> None:
+        if decision.action == "grow":
+            self._start_replica()
+        elif decision.action == "drain" \
+                and decision.reason == "low_occupancy" \
+                and decision.target in self.batchers:
+            self.events.append((self.rounds, "drain", decision.target))
+            self._reroute(
+                self.batchers[decision.target].start_drain("shrink"))
+
+    def tick(self) -> None:
+        if self.host_manager is not None:
+            self.host_manager.update_available_hosts()
+        live = len(self.batchers)
+        draining = sum(1 for b in self.batchers.values() if b.draining)
+        below_min = (live - draining) < self.policy.min_replicas
+        decision = self.controller.tick(
+            self._now, live, draining, self.queue_depth(),
+            self.occupancy(), below_min,
+            shrink_candidate=self._shrink_candidate())
+        self._apply(decision)
+        # Finished drains leave the cluster.
+        for name in self.live():
+            b = self.batchers[name]
+            if b.draining and b.drained:
+                b.close()
+                self.events.append((self.rounds, "drained", name))
+                self.events.extend((self.rounds, "batcher", name) + e
+                                   for e in b.events)
+                self.batchers.pop(name)
+
+    # -- the run loop --------------------------------------------------------
+
+    def run(self, trace: TrafficTrace, max_rounds: int = 100000,
+            round_hook: Optional[Callable[["ServeCluster", int],
+                                          None]] = None) -> Dict:
+        """Drive the seeded open-loop trace to completion in virtual
+        time. ``round_hook(cluster, round_idx)`` is the chaos injection
+        point (e.g. kill a replica at round k). Returns the report —
+        latency percentiles, token counts, occupancy, the deterministic
+        event list, and the decision log."""
+        pending = deque(trace.requests)
+        wall0 = time.monotonic()
+        while self.rounds < max_rounds:
+            while pending and pending[0].arrival_t <= self._now:
+                self.submit(pending.popleft())
+            if self.overflow:
+                self._reroute([self.overflow.popleft()
+                               for _ in range(len(self.overflow))])
+            if round_hook is not None:
+                round_hook(self, self.rounds)
+            self.tick()
+            for name in self.live():
+                for req in self.batchers[name].run_step(self._now):
+                    self.completed.append(req)
+                    self.controller.observe_completion(req)
+            self.rounds += 1
+            self._now += self.step_s
+            if not pending and not self.queue_depth() \
+                    and all(b.engine.active_count() == 0
+                            for b in self.batchers.values()):
+                break
+        wall_s = time.monotonic() - wall0
+        return self.report(len(trace.requests), wall_s)
+
+    def report(self, submitted: int, wall_s: float = 0.0) -> Dict:
+        lats = [r.latency_s for r in self.completed
+                if r.latency_s is not None]
+        arr = np.asarray(lats) if lats else np.zeros((1,))
+        gen_tokens = sum(len(r.tokens) for r in self.completed)
+        occ = [b.mean_occupancy() for b in self.batchers.values()
+               if b.steps]
+        for name in self.live():
+            self.events.extend(
+                (self.rounds, "batcher", name) + e
+                for e in self.batchers[name].events)
+        return {
+            "submitted": submitted,
+            "completed": len(self.completed),
+            "dropped": submitted - len(self.completed),
+            "rounds": self.rounds,
+            "virtual_s": round(self._now, 6),
+            "wall_s": round(wall_s, 3),
+            "latency_p50_s": round(float(np.percentile(arr, 50)), 6),
+            "latency_p99_s": round(float(np.percentile(arr, 99)), 6),
+            "generated_tokens": gen_tokens,
+            "tokens_per_virtual_s": round(
+                gen_tokens / self._now, 3) if self._now else 0.0,
+            "tokens_per_wall_s": round(
+                gen_tokens / wall_s, 3) if wall_s else 0.0,
+            "mean_occupancy": round(
+                sum(occ) / len(occ), 4) if occ else 0.0,
+            "max_reroutes": max((r.reroutes for r in self.completed),
+                                default=0),
+            "deadline_misses": sum(1 for r in self.completed
+                                   if r.deadline_missed),
+            "events": self.events,
+            "decisions": self.controller.decision_log(),
+        }

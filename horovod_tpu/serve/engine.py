@@ -1,0 +1,313 @@
+"""One replica's decode plane: slots, jitted prefill/decode, retire
+(docs/serve.md).
+
+Exactly TWO compiled programs serve every request mix, because request
+variety is data, not shape:
+
+* ``prefill`` — (1, max_prompt_len) tokens + a length scalar: the
+  prompt's KV lines land in a fresh single-slot cache (pad lines
+  invalidated), and the first output token is the argmax at position
+  ``length - 1``. Admission scatters the slot into the batch cache
+  (``kvcache.write_slot``) — dynamic slot index, no recompile.
+* ``decode`` — one token per slot across ALL slots: (slots, 1) last
+  tokens against the (slots, max_len, ...) ring cache. Finished/empty
+  slots decode garbage that is never read — cheaper than a ragged
+  program per occupancy pattern, and the reason sequences of any
+  length mix share the step.
+
+Sampling is greedy argmax — deterministic, the repeat-identity
+contract. The decode step is bracketed with flight-recorder events
+(op ``serve``), so a hung replica's black box names the decode batch it
+never completed, the same attribution the training collectives get
+(docs/podmon.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import flightrec as flightrec_lib
+from ..common import metrics as metrics_lib
+from . import kvcache as kv_lib
+from .queue import Request, record_completion
+
+_M_TOKENS = metrics_lib.counter(
+    "hvd_tpu_serve_tokens_total",
+    "tokens processed by the serve engines, by kind "
+    "(prompt = prefilled, generated = decoded)",
+    labels=("kind",))
+for _k in ("prompt", "generated"):
+    _M_TOKENS.labels(kind=_k)
+del _k
+_M_ACTIVE = metrics_lib.gauge(
+    "hvd_tpu_serve_active_requests",
+    "requests currently holding a decode slot, summed over this "
+    "process's replicas")
+_M_CACHE_BYTES = metrics_lib.gauge(
+    "hvd_tpu_serve_kv_cache_bytes",
+    "allocated KV-cache bytes, by replica (int8 storage shows the "
+    "~4x reduction over fp32 here)",
+    labels=("replica",))
+
+
+class DecodeEngine:
+    """Slots + cache + the two jitted programs for ONE replica.
+
+    ``model`` is a GPT-family flax module whose ``apply`` supports the
+    ``cache=`` incremental path (models/gpt.py); ``params`` its
+    variables. Greedy decode; ``eos_id`` (optional) ends a sequence
+    early, ``max_new_tokens`` always bounds it.
+    """
+
+    def __init__(self, model, params, slots: int = 4, max_len: int = 32,
+                 max_prompt_len: int = 16, kv_kind: str = "fp32",
+                 eos_id: Optional[int] = None, name: str = "r0",
+                 programs=None):
+        if max_prompt_len > max_len:
+            raise ValueError(
+                f"max_prompt_len {max_prompt_len} exceeds the cache's "
+                f"max_len {max_len}")
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.max_prompt_len = int(max_prompt_len)
+        self.kv_kind = kv_kind
+        self.eos_id = eos_id
+        self.name = name
+        from ..models.gpt import init_kv_cache
+
+        self.cache = init_kv_cache(model, self.slots, self.max_len,
+                                   kind=kv_kind)
+        self._single = init_kv_cache(model, 1, self.max_len,
+                                     kind=kv_kind)
+        _M_CACHE_BYTES.labels(replica=name).set(
+            kv_lib.cache_nbytes(self.cache))
+        # Per-slot host state (the python side of the batcher loop).
+        self.requests: List[Optional[Request]] = [None] * self.slots
+        self.generated: List[List[int]] = [[] for _ in range(self.slots)]
+        self.last_tokens = np.zeros((self.slots,), np.int32)
+        self.decode_steps = 0
+        if programs is None:
+            programs = compile_programs(model)
+        (self._prefill, self._decode, self._write_slot,
+         self._reset_slot) = programs
+
+    # -- admission -----------------------------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.requests) if r is None]
+
+    def active_count(self) -> int:
+        return self.slots - len(self.free_slots())
+
+    def admit(self, req: Request, now: float = 0.0) -> int:
+        """Prefill ``req`` into a free slot; returns the slot. The
+        prompt is truncated to the engine's ``max_prompt_len`` window
+        (documented serving contract, docs/serve.md)."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError(f"replica {self.name}: no free slot")
+        slot = free[0]
+        prompt = list(req.prompt)[-self.max_prompt_len:]
+        padded = np.zeros((1, self.max_prompt_len), np.int32)
+        padded[0, :len(prompt)] = prompt
+        single, first = self._prefill(
+            self.params, jnp.asarray(padded),
+            jnp.asarray(len(prompt), jnp.int32), self._single)
+        self.cache = self._write_slot(self.cache, slot, single)
+        self.requests[slot] = req
+        req.replica = self.name
+        tok = int(first)
+        self.generated[slot] = [tok]
+        self.last_tokens[slot] = tok
+        _M_TOKENS.labels(kind="prompt").inc(len(prompt))
+        _M_TOKENS.labels(kind="generated").inc()
+        _M_ACTIVE.inc()
+        return slot
+
+    # -- the decode step -----------------------------------------------------
+
+    def step(self, now: float = 0.0) -> List[Request]:
+        """One decode round across every slot; retires and returns the
+        requests that finished this step (their ``tokens``/``finish_t``
+        filled)."""
+        if self.active_count() == 0:
+            return []
+        rec = flightrec_lib.recorder()
+        step_name = f"serve.decode.{self.name}"
+        rec.record_submit(step_name, "serve")
+        try:
+            logits, self.cache, next_tokens = self._decode(
+                self.params, self.cache,
+                jnp.asarray(self.last_tokens))
+            next_np = np.asarray(next_tokens)
+        except BaseException:
+            rec.record_complete(step_name, outcome="error")
+            raise
+        rec.annotate(step_name,
+                     nbytes=kv_lib.cache_nbytes(self.cache),
+                     wire=self.kv_kind)
+        rec.record_complete(step_name)
+        self.decode_steps += 1
+        finished: List[Request] = []
+        for slot, req in enumerate(self.requests):
+            if req is None:
+                continue
+            done = False
+            if len(self.generated[slot]) >= req.max_new_tokens:
+                # The finishing token was produced by the PREVIOUS
+                # round (or prefill); this round's output for the slot
+                # is discarded.
+                done = True
+            else:
+                tok = int(next_np[slot])
+                self.generated[slot].append(tok)
+                self.last_tokens[slot] = tok
+                _M_TOKENS.labels(kind="generated").inc()
+                done = (len(self.generated[slot]) >= req.max_new_tokens
+                        or (self.eos_id is not None
+                            and tok == self.eos_id))
+            if done:
+                finished.append(self.retire(slot, now))
+        return finished
+
+    def request_done(self, slot: int) -> bool:
+        """True when the slot's sequence already hit its stop condition
+        (a 1-token request finishes at prefill; the batcher retires it
+        without waiting for a decode round)."""
+        req = self.requests[slot]
+        if req is None:
+            return False
+        toks = self.generated[slot]
+        return bool(len(toks) >= req.max_new_tokens
+                    or (self.eos_id is not None and toks
+                        and toks[-1] == self.eos_id))
+
+    def retire(self, slot: int, now: float) -> Request:
+        req = self.requests[slot]
+        req.tokens = tuple(self.generated[slot])
+        req.finish_t = now
+        record_completion(req)
+        self.requests[slot] = None
+        self.generated[slot] = []
+        self.cache = self._reset_slot(self.cache, slot)
+        _M_ACTIVE.dec()
+        return req
+
+    # -- drain / teardown ----------------------------------------------------
+
+    def abort_all(self) -> List[Request]:
+        """Hard abort (replica kill): every in-flight request comes
+        back UNFINISHED for re-routing — generated tokens are dropped
+        and the peer re-prefills from the prompt (no dropped
+        requests, docs/serve.md drain runbook)."""
+        out = []
+        for slot, req in enumerate(self.requests):
+            if req is None:
+                continue
+            req.reroutes += 1
+            req.replica = None
+            out.append(req)
+            self.requests[slot] = None
+            self.generated[slot] = []
+            self.cache = self._reset_slot(self.cache, slot)
+            _M_ACTIVE.dec()
+        return out
+
+    def export_slot(self, slot: int) -> Dict[str, Any]:
+        """A slot's warm cache as the int8 block-scaled wire blob
+        (``kvcache.export_slot`` — the Pallas quantization path), for
+        peers that accept mid-sequence migration instead of a
+        re-prefill."""
+        return kv_lib.export_slot(self.cache, slot)
+
+    def close(self) -> None:
+        """Zero this replica's labeled gauges when it leaves the
+        cluster — a departed replica's cache is freed, so a stale
+        ``kv_cache_bytes`` series would overstate live HBM on every
+        pod scrape."""
+        _M_CACHE_BYTES.labels(replica=self.name).set(0)
+
+
+def _prefill_fn(model, params, tokens, length, single_cache):
+    """(1, P) prompt -> (single-slot cache, first output token)."""
+    logits, cache = model.apply(params, tokens, cache=single_cache)
+    # Pad lines (written at positions >= length) must never be
+    # attendable; the write head rewinds to the true prompt length.
+    sp = cache["slot_pos"]
+    cache = {
+        "layers": cache["layers"],
+        "pos": jnp.full_like(cache["pos"], length),
+        "slot_pos": jnp.where(sp >= length, -1, sp),
+    }
+    first = jnp.argmax(logits[0, length - 1], axis=-1).astype(jnp.int32)
+    return cache, first
+
+
+def _decode_fn(model, params, cache, last_tokens):
+    """(slots,) last tokens -> (logits, cache, greedy next tokens)."""
+    logits, cache = model.apply(params, last_tokens[:, None],
+                                cache=cache)
+    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    return logits, cache, nxt
+
+
+ENV_KV_DTYPE = "HVD_TPU_SERVE_KV_DTYPE"   # fp32 | int8 cache storage
+ENV_SLOTS = "HVD_TPU_SERVE_SLOTS"         # decode slots per replica
+ENV_MAX_LEN = "HVD_TPU_SERVE_MAX_LEN"     # ring-buffer cache lines
+
+
+def engine_defaults_from_env(env=None) -> Dict[str, Any]:
+    """The env-tunable engine geometry (docs/serve.md knob table):
+    ``HVD_TPU_SERVE_KV_DTYPE`` / ``HVD_TPU_SERVE_SLOTS`` /
+    ``HVD_TPU_SERVE_MAX_LEN``, as DecodeEngine kwargs."""
+    env = env if env is not None else os.environ
+    out: Dict[str, Any] = {}
+    kind = env.get(ENV_KV_DTYPE)
+    if kind:
+        if kind not in kv_lib.KINDS:
+            raise ValueError(
+                f"{ENV_KV_DTYPE}={kind!r}: known kinds {kv_lib.KINDS}")
+        out["kv_kind"] = kind
+    for env_name, kwarg in ((ENV_SLOTS, "slots"),
+                            (ENV_MAX_LEN, "max_len")):
+        raw = env.get(env_name)
+        if raw:
+            try:
+                out[kwarg] = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"{env_name}={raw!r} must be an integer")
+    return out
+
+
+def compile_programs(model):
+    """The jitted serving programs for ``model``, built ONCE and shared
+    by every replica: jax.jit caches on the wrapper's identity, so an
+    engine building its own wrappers would re-trace + recompile per
+    replica — and the kill → grow restore path would pay a full XLA
+    compile before serving its first request."""
+    return (jax.jit(functools.partial(_prefill_fn, model)),
+            jax.jit(functools.partial(_decode_fn, model)),
+            jax.jit(kv_lib.write_slot),
+            jax.jit(kv_lib.reset_slot))
+
+
+def make_engine_factory(model, params, **kw) -> Callable[[str],
+                                                         DecodeEngine]:
+    """Factory the replica controller uses to start replicas (grow /
+    restart after a kill): same model+params+geometry+compiled
+    programs, fresh cache."""
+    programs = compile_programs(model)
+
+    def factory(name: str) -> DecodeEngine:
+        return DecodeEngine(model, params, name=name,
+                            programs=programs, **kw)
+    return factory

@@ -1,0 +1,225 @@
+"""Paged ring-buffer KV cache for incremental GPT decode (docs/serve.md).
+
+The cache is a plain pytree so one jitted decode step serves every
+request mix: per layer ``k``/``v`` slabs laid out as (slots, max_len,
+heads, head_dim), plus shared per-slot bookkeeping — ``pos`` (total
+tokens written, the ring write head) and ``slot_pos`` (each cache
+line's GLOBAL sequence position, -1 = empty). Sequences of different
+lengths share the one compiled program because validity is data, not
+shape: attention masks on ``slot_pos`` (occupied AND causally visible),
+and a write at global position p lands in line ``p % max_len`` — past
+``max_len`` the ring overwrites the oldest line, truncating attention
+to the last ``max_len`` tokens.
+
+Two storage formats, selected by ``kind``:
+
+* ``"fp32"`` — k/v stored in the model dtype (the parity baseline).
+* ``"int8"`` — block-scaled int8, one fp32 absmax scale per
+  (slot, line, head) block: the same ``round(x * 127 / absmax)``
+  recipe as ``ops/pallas_kernels.quantize_int8`` applied at KV-cache
+  granularity (per head-vector instead of per 32x128 tile, so a
+  single-token write stays one fused scatter). ~4x less HBM + wire
+  per cached token; the decode parity bound vs fp32 is documented in
+  docs/serve.md and enforced by tests/test_serve.py.
+
+Whole-cache movement (slot migration between replicas, drain handoff)
+reuses the Pallas wire path directly: :func:`export_slot` /
+:func:`import_slot` ship a slot's lines through
+``ops/pallas_kernels.quantize_int8`` — the EQuARX-style block-scaled
+wire format gradients and MoE dispatch already ride.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import pallas_kernels as pk
+
+KINDS = ("fp32", "int8")
+
+
+def init_cache(num_layers: int, slots: int, max_len: int, num_heads: int,
+               head_dim: int, kind: str = "fp32",
+               dtype: Any = jnp.float32) -> Dict[str, Any]:
+    """Fresh all-empty cache pytree. ``kind`` picks the storage format
+    (KINDS); ``dtype`` is the fp32-kind storage/compute dtype."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown kv-cache kind {kind!r}; known: {KINDS}")
+    shape = (slots, max_len, num_heads, head_dim)
+    layers = []
+    for _ in range(num_layers):
+        if kind == "int8":
+            layers.append({
+                "k_q": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(shape[:3], jnp.float32),
+                "v_q": jnp.zeros(shape, jnp.int8),
+                "v_s": jnp.zeros(shape[:3], jnp.float32),
+            })
+        else:
+            layers.append({"k": jnp.zeros(shape, dtype),
+                           "v": jnp.zeros(shape, dtype)})
+    return {
+        "layers": tuple(layers),
+        "pos": jnp.zeros((slots,), jnp.int32),
+        "slot_pos": jnp.full((slots, max_len), -1, jnp.int32),
+    }
+
+
+def cache_kind(cache: Dict[str, Any]) -> str:
+    """Storage format, recovered from the pytree structure (the format
+    is structural, so it is static under jit)."""
+    return "int8" if "k_q" in cache["layers"][0] else "fp32"
+
+
+def max_len(cache: Dict[str, Any]) -> int:
+    return int(cache["slot_pos"].shape[1])
+
+
+def num_slots(cache: Dict[str, Any]) -> int:
+    return int(cache["slot_pos"].shape[0])
+
+
+def cache_nbytes(cache: Dict[str, Any]) -> int:
+    """Total bytes of the cache storage (the
+    ``hvd_tpu_serve_kv_cache_bytes`` accounting — int8 shows the ~4x
+    reduction over fp32 here)."""
+    return sum(leaf.size * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(cache))
+
+
+# -- the block-scale recipe at KV granularity --------------------------------
+
+def quantize_heads(x):
+    """Block-scaled int8 over the trailing head_dim axis: one fp32
+    absmax scale per head vector — ``pallas_kernels.quantize_int8``'s
+    recipe (absmax/127, round-to-nearest, clip) at the granularity a
+    single-token cache write needs. Returns ``(q, scales)`` with
+    ``scales.shape == x.shape[:-1]``."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.maximum(absmax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scales[..., None]), -127, 127)
+    return q.astype(jnp.int8), scales
+
+
+def dequantize_heads(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_heads`."""
+    return (q.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+# -- write / read ------------------------------------------------------------
+
+def layer_write(layer: Dict[str, Any], idx, k_new, v_new
+                ) -> Dict[str, Any]:
+    """Scatter the new tokens' K/V into their ring lines.
+
+    ``idx`` is (slots, s_in) int32 — each new token's cache line
+    (``global_pos % max_len``); ``k_new``/``v_new`` are
+    (slots, s_in, heads, head_dim). One batched scatter, identical for
+    prefill (s_in = prompt) and decode (s_in = 1)."""
+    b = jnp.arange(idx.shape[0])[:, None]
+    if "k_q" in layer:
+        kq, ks = quantize_heads(k_new)
+        vq, vs = quantize_heads(v_new)
+        return {
+            "k_q": layer["k_q"].at[b, idx].set(kq),
+            "k_s": layer["k_s"].at[b, idx].set(ks),
+            "v_q": layer["v_q"].at[b, idx].set(vq),
+            "v_s": layer["v_s"].at[b, idx].set(vs),
+        }
+    return {"k": layer["k"].at[b, idx].set(k_new.astype(layer["k"].dtype)),
+            "v": layer["v"].at[b, idx].set(v_new.astype(layer["v"].dtype))}
+
+
+def layer_read(layer: Dict[str, Any], dtype=jnp.float32
+               ) -> Tuple[Any, Any]:
+    """The full (slots, max_len, heads, head_dim) K/V slabs in compute
+    dtype (dequantized for the int8 kind); invalid lines are masked by
+    the caller via ``slot_pos``."""
+    if "k_q" in layer:
+        return (dequantize_heads(layer["k_q"], layer["k_s"], dtype),
+                dequantize_heads(layer["v_q"], layer["v_s"], dtype))
+    return layer["k"].astype(dtype), layer["v"].astype(dtype)
+
+
+def reset_slot(cache: Dict[str, Any], slot) -> Dict[str, Any]:
+    """Mark one slot empty (pos = 0, every line invalid). The k/v
+    payload is left in place — ``slot_pos`` = -1 already masks it out
+    of every read, so zeroing would be a wasted memory pass."""
+    return {
+        "layers": cache["layers"],
+        "pos": cache["pos"].at[slot].set(0),
+        "slot_pos": cache["slot_pos"].at[slot].set(-1),
+    }
+
+
+def write_slot(cache: Dict[str, Any], slot, single: Dict[str, Any]
+               ) -> Dict[str, Any]:
+    """Copy a 1-slot cache (e.g. a fresh prefill) into ``slot`` of a
+    multi-slot cache of the same geometry/kind."""
+    layers = tuple(
+        {k: dst[k].at[slot].set(src[k][0]) for k in dst}
+        for dst, src in zip(cache["layers"], single["layers"]))
+    return {
+        "layers": layers,
+        "pos": cache["pos"].at[slot].set(single["pos"][0]),
+        "slot_pos": cache["slot_pos"].at[slot].set(single["slot_pos"][0]),
+    }
+
+
+# -- wire movement: the Pallas block-quantized export ------------------------
+
+def export_slot(cache: Dict[str, Any], slot: int,
+                use_pallas: Optional[bool] = None) -> Dict[str, Any]:
+    """One slot's cache lines as an int8 block-scaled wire blob —
+    every fp32/model-dtype leaf rides ``pallas_kernels.quantize_int8``
+    (int8 leaves ship as-is); the bookkeeping vectors travel exact.
+    This is the warm-cache migration path: a draining replica can hand
+    a long in-flight sequence to a peer at ~4x fewer bytes instead of
+    re-running its whole prefill."""
+    out_layers = []
+    for layer in cache["layers"]:
+        packed = {}
+        for name, leaf in layer.items():
+            arr = leaf[slot]
+            if arr.dtype == jnp.int8:
+                packed[name] = {"raw": arr}
+            else:
+                q, s, n = pk.quantize_int8(arr, use_pallas=use_pallas)
+                packed[name] = {"q": q, "s": s, "n": n,
+                                "shape": arr.shape,
+                                "dtype": str(arr.dtype)}
+        out_layers.append(packed)
+    return {
+        "layers": out_layers,
+        "pos": cache["pos"][slot],
+        "slot_pos": cache["slot_pos"][slot],
+    }
+
+
+def import_slot(cache: Dict[str, Any], slot: int, blob: Dict[str, Any],
+                use_pallas: Optional[bool] = None) -> Dict[str, Any]:
+    """Inverse of :func:`export_slot`: land a wire blob in ``slot`` of a
+    same-geometry cache."""
+    layers = []
+    for dst, packed in zip(cache["layers"], blob["layers"]):
+        new = {}
+        for name, leaf in dst.items():
+            item = packed[name]
+            if "raw" in item:
+                arr = item["raw"]
+            else:
+                arr = pk.dequantize_int8(
+                    item["q"], item["s"], item["n"], item["shape"],
+                    dtype=jnp.dtype(item["dtype"]),
+                    use_pallas=use_pallas)
+            new[name] = leaf.at[slot].set(arr)
+        layers.append(new)
+    return {
+        "layers": tuple(layers),
+        "pos": cache["pos"].at[slot].set(blob["pos"]),
+        "slot_pos": cache["slot_pos"].at[slot].set(blob["slot_pos"]),
+    }

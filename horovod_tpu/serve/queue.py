@@ -1,0 +1,137 @@
+"""Request admission plane: the ``DeviceInfeed`` background-feed
+pattern generalized to inference requests (docs/serve.md).
+
+``DeviceInfeed`` (data.py) keeps a bounded queue of ready batches ahead
+of a consumer and measures the consumer's wait; a serving replica needs
+the same shape with requests instead of batches — a bounded FIFO the
+router feeds asynchronously, the batcher drains into free decode slots,
+and telemetry measures (queue depth, time-in-queue, deadline misses).
+Unlike the infeed the queue must also run BACKWARD: a draining replica
+re-routes its unstarted requests to peers (``drain()``), which is why
+admission hands out ``Request`` objects rather than opaque batches.
+
+Deterministic by construction: FIFO order, integer virtual-time stamps,
+no wall-clock reads — the chaos soak's byte-identity contract
+(docs/serve.md) starts here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import List, Optional, Tuple
+
+from ..common import metrics as metrics_lib
+
+_M_QUEUE_DEPTH = metrics_lib.gauge(
+    "hvd_tpu_serve_queue_depth",
+    "requests queued ahead of the decode slots, summed over this "
+    "process's replicas")
+_M_LATENCY = metrics_lib.histogram(
+    "hvd_tpu_serve_latency_seconds",
+    "end-to-end request latency: arrival -> last generated token "
+    "(virtual time in simulation, wall time live)")
+_M_DEADLINE_MISSES = metrics_lib.counter(
+    "hvd_tpu_serve_deadline_misses_total",
+    "requests that completed after their deadline (deadline_s from "
+    "arrival; 0 = no deadline)")
+
+
+@dataclasses.dataclass
+class Request:
+    """One inference request. ``arrival_t`` is stamped by the traffic
+    source (virtual seconds); ``deadline_s`` is the per-request latency
+    budget from arrival (0 = none) the batcher tracks."""
+
+    rid: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    arrival_t: float = 0.0
+    deadline_s: float = 0.0
+    # Filled at completion.
+    tokens: Tuple[int, ...] = ()
+    finish_t: Optional[float] = None
+    replica: Optional[str] = None
+    reroutes: int = 0
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.arrival_t
+
+    @property
+    def deadline_missed(self) -> bool:
+        return bool(self.deadline_s > 0 and self.latency_s is not None
+                    and self.latency_s > self.deadline_s)
+
+
+class RequestQueue:
+    """Bounded FIFO between the router and one replica's batcher.
+
+    ``submit`` enqueues (router side, any thread); ``take(n, now)``
+    dequeues up to n for admission (batcher side) and records each
+    request's time-in-queue; ``drain()`` empties the queue for
+    re-routing — the unstarted half of a graceful drain. Thread-safe;
+    iteration order is strict FIFO so a seeded run replays exactly."""
+
+    def __init__(self, maxsize: int = 0):
+        self._q: deque = deque()
+        self._maxsize = int(maxsize)
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.rejected = 0
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue; False when the queue is at maxsize (the router
+        should pick another replica or shed load loudly)."""
+        with self._lock:
+            if self._maxsize and len(self._q) >= self._maxsize:
+                self.rejected += 1
+                return False
+            self._q.append(req)
+            self.submitted += 1
+            _M_QUEUE_DEPTH.inc()
+            return True
+
+    def take(self, n: int, now: float = 0.0) -> List[Request]:
+        out: List[Request] = []
+        with self._lock:
+            while self._q and len(out) < int(n):
+                out.append(self._q.popleft())
+            _M_QUEUE_DEPTH.dec(len(out))
+        return out
+
+    def requeue_front(self, reqs: List[Request]) -> None:
+        """Put re-routed requests BACK at the head (they already waited
+        elsewhere; FIFO fairness follows arrival, not re-route time)."""
+        with self._lock:
+            for req in reversed(reqs):
+                self._q.appendleft(req)
+            _M_QUEUE_DEPTH.inc(len(reqs))
+
+    def drain(self) -> List[Request]:
+        """Empty the queue for re-routing (graceful-drain step 1)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            _M_QUEUE_DEPTH.dec(len(out))
+        return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def __len__(self) -> int:
+        return self.depth()
+
+
+def record_completion(req: Request) -> None:
+    """Completion telemetry shared by every retire/finish path: latency
+    histogram + deadline-miss counter (one definition of 'done')."""
+    lat = req.latency_s
+    if lat is not None:
+        _M_LATENCY.observe(lat)
+    if req.deadline_missed:
+        _M_DEADLINE_MISSES.inc()

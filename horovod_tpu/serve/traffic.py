@@ -1,0 +1,64 @@
+"""Seeded open-loop traffic for the serve bench/chaos surfaces
+(docs/serve.md).
+
+Open-loop (arrivals ignore the server's state) is the honest serving
+benchmark shape: a closed loop self-throttles under overload and hides
+queueing collapse. Arrivals are Poisson (exponential inter-arrival
+times at ``rate_rps``), prompt/output lengths are drawn from mixed
+seeded distributions — everything derives from ``numpy``'s
+``default_rng(seed)``, so the same seed replays the byte-identical
+request sequence (the chaos soak and the bench repeat-determinism
+check both rely on this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from .queue import Request
+
+
+@dataclasses.dataclass
+class TrafficTrace:
+    """A materialized request sequence (arrival-sorted)."""
+
+    seed: int
+    requests: List[Request]
+
+    @property
+    def duration_s(self) -> float:
+        return self.requests[-1].arrival_t if self.requests else 0.0
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+def poisson_trace(seed: int, n_requests: int, rate_rps: float,
+                  prompt_lens: Sequence[int] = (4, 8, 16),
+                  output_lens: Sequence[int] = (4, 8, 16, 32),
+                  vocab_size: int = 128,
+                  deadline_s: float = 0.0) -> TrafficTrace:
+    """Seeded open-loop trace: Poisson arrivals at ``rate_rps``, prompt
+    and output lengths drawn uniformly from the given mixes, prompt
+    tokens uniform over ``[1, vocab_size)`` (0 is reserved for pad).
+    ``deadline_s`` stamps every request with a latency budget."""
+    if n_requests < 1 or rate_rps <= 0:
+        raise ValueError(
+            f"need n_requests >= 1 and rate_rps > 0, got "
+            f"{n_requests}/{rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    plens = rng.choice(np.asarray(prompt_lens), size=n_requests)
+    olens = rng.choice(np.asarray(output_lens), size=n_requests)
+    reqs = []
+    for i in range(n_requests):
+        prompt: Tuple[int, ...] = tuple(
+            int(t) for t in rng.integers(1, vocab_size, int(plens[i])))
+        reqs.append(Request(
+            rid=i, prompt=prompt, max_new_tokens=int(olens[i]),
+            arrival_t=float(arrivals[i]), deadline_s=deadline_s))
+    return TrafficTrace(seed=seed, requests=reqs)

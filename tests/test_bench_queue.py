@@ -194,3 +194,66 @@ def test_analyze_trace_named_tracks(tmp_path):
     # steps statistics from the Steps track.
     assert out["steps"]["count"] == 2
     assert out["steps"]["mean_ms"] == 4.0
+
+
+# -- the per-workload regression gate (ISSUE 11, docs/serve.md) -------------
+
+def test_gate_train_record_regresses_on_value():
+    new = {"workload": "train", "value": 90.0, "mfu": 30.0}
+    old = {"workload": "train", "value": 100.0, "mfu": 30.2,
+           "platform": "tpu"}
+    gate = q.gate_record("j", new, banked=old)
+    assert gate["regressed"] == ["value"]
+    assert new["regression"] is True
+    assert new["gate"]["diffs"]["value"]["delta_pct"] == -10.0
+
+
+def test_gate_serve_record_regresses_on_p99_latency():
+    old = {"workload": "serve", "value": 100.0, "latency_p99_s": 2.0,
+           "platform": "tpu"}
+    worse = {"workload": "serve", "value": 100.0, "latency_p99_s": 2.5}
+    gate = q.gate_record("s", worse, banked=old)
+    assert gate["regressed"] == ["latency_p99_s"]
+    assert worse["regression"] is True
+    # Higher throughput + lower latency passes.
+    better = {"workload": "serve", "value": 103.0,
+              "latency_p99_s": 1.9}
+    gate = q.gate_record("s", better, banked=old)
+    assert gate["regressed"] == []
+    assert "regression" not in better
+
+
+def test_gate_skips_cross_workload_and_missing_fields():
+    train = {"workload": "train", "value": 100.0, "platform": "tpu"}
+    assert q.gate_record("x", {"workload": "serve", "value": 1.0},
+                         banked=train) is None
+    assert q.gate_record("x", {"workload": "train"},
+                         banked=train) is None
+
+
+def test_gate_reads_banked_record_from_round_dirs(tmp_path,
+                                                  monkeypatch):
+    monkeypatch.setattr(q, "REPO", str(tmp_path))
+    monkeypatch.setattr(q, "_SEARCH_ORDER", ("r_new", "r_mid", "r_old"))
+    monkeypatch.setattr(q, "_ROUND", "r_new")
+    for rdir, val in (("r_mid", 196.0), ("r_old", 200.0)):
+        d = tmp_path / "results" / rdir
+        d.mkdir(parents=True)
+        (d / "serve_j.json").write_text(json.dumps(
+            {"workload": "serve", "value": val, "latency_p99_s": 1.0,
+             "platform": "tpu"}))
+    # The current round dir is skipped (a capture never gates against
+    # itself), and the floor is the BEST banked record — r_old's 200,
+    # not the newer-but-worse r_mid 196 (the anti-decay ratchet).
+    new = {"workload": "serve", "value": 150.0, "latency_p99_s": 1.0}
+    gate = q.gate_record("serve_j", new)
+    assert gate["vs"] == "r_old"
+    assert gate["diffs"]["value"]["banked"] == 200.0
+    assert gate["regressed"] == ["value"]
+
+
+def test_serve_job_queued():
+    names = [n for n, _, _ in q.JOBS]
+    assert "serve_gpt_small" in names
+    argv = dict((n, a) for n, a, _ in q.JOBS)["serve_gpt_small"]
+    assert "--serve" in argv

@@ -46,6 +46,32 @@ def test_chaos_stall_blackboxes_every_rank_and_names_the_hung_op(tmp_path):
         set(rec["injected_sites"])
 
 
+def test_chaos_serve_kill_reroutes_and_logs_kill_then_grow(tmp_path):
+    """ISSUE 11 acceptance: a seeded ``replica_kill`` mid-stream —
+    queued + in-flight requests re-route with ZERO drops, the killed
+    replica's host lands on the elastic blacklist, and the SLO
+    controller's decision log names the kill (drain
+    reason=replica_lost) before the restoring grow. Two runs of the
+    same seed reproduce the event + decision sequences byte-for-byte
+    (virtual time makes the whole run deterministic)."""
+    import json as json_lib
+
+    a = chaos_soak.run_serve_soak(str(tmp_path / "a"), steps=30,
+                                  seed=42)
+    assert a["dropped"] == 0 and a["completed"] == a["requests"]
+    assert a["max_reroutes"] >= 1
+    decisions = [json_lib.loads(l) for l in a["decisions"]]
+    assert (decisions[0]["action"], decisions[0]["target"],
+            decisions[0]["reason"]) == ("drain", "r1", "replica_lost")
+    assert any(d["action"] == "grow"
+               and d["reason"] == "restore_capacity"
+               for d in decisions[1:])
+    assert a["injected_sites"] == ["replica_kill"]
+    b = chaos_soak.run_serve_soak(str(tmp_path / "b"), steps=30,
+                                  seed=42)
+    assert a["sequences"] == b["sequences"]
+
+
 @pytest.mark.slow
 def test_chaos_soak_same_seed_reproduces_sequences(tmp_path):
     a = chaos_soak.run_soak(str(tmp_path / "a"), steps=12, seed=11)

@@ -919,6 +919,139 @@ def run_autoscale_soak(workdir: str, steps: int = 120, seed: int = 42,
     return record
 
 
+# -- the serve family (docs/serve.md) ----------------------------------------
+
+SERVE_HOSTS = ("host0", "host1", "host2", "host3")
+
+
+def serve_plan(seed: int) -> dict:
+    """The serving acceptance plan (ISSUE 11): hard-kill replica r1
+    mid-stream. The cluster must re-route its queued AND in-flight
+    requests (zero drops), blacklist its host through the elastic
+    HostManager, and the SLO controller's decision log must name the
+    kill (drain reason=replica_lost) before the restoring grow."""
+    return {"seed": seed, "faults": [
+        {"site": "replica_kill", "step": 8, "target": "r1"},
+    ]}
+
+
+def serve_policy() -> dict:
+    """The soak's SLO policy — thresholds as data, tuned for a
+    virtual-seconds run: a 2-replica floor (the kill MUST trigger a
+    restore), p99/queue-depth growth headroom to one spare replica."""
+    return {
+        "tick_interval_s": 0.1,
+        "window": 16,
+        "target_p99_s": 2.0,
+        "max_queue_depth": 8,
+        "min_replicas": 2,
+        "max_replicas": 3,
+        "grow_cooldown_s": 0.5,
+        "shrink_cooldown_s": 2.0,
+    }
+
+
+def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
+                   plan: dict | None = None) -> dict:
+    """One seeded serve-family run: the REAL serve stack (tiny-GPT
+    DecodeEngine, continuous batcher, SLO controller, elastic
+    HostManager for replica hosts) on a virtual clock, under a seeded
+    replica-kill plan. ``steps`` is the trace length (requests).
+    Asserts (a) zero dropped requests — queued and in-flight work from
+    the killed replica completed elsewhere (reroutes observed), (b) the
+    decision log names kill -> grow deterministically, (c) the killed
+    replica's host was blacklisted through the HostManager. The
+    --repeat contract compares the full event + decision sequences
+    byte-for-byte (virtual time makes them deterministic by
+    construction — the assertion is the repeat check)."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.models import gpt_tiny
+    from horovod_tpu.runner.elastic_driver import HostManager
+    from horovod_tpu.serve.controller import SLOPolicy, ServeCluster
+    from horovod_tpu.serve.engine import make_engine_factory
+    from horovod_tpu.serve.traffic import poisson_trace
+
+    os.makedirs(workdir, exist_ok=True)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    decision_log = os.path.join(workdir, "decisions.jsonl")
+    plan = plan if plan is not None else serve_plan(seed)
+    policy = SLOPolicy.from_dict(serve_policy())
+
+    fp = faults_lib.FaultPlan.from_json(json.dumps(plan))
+    inj = faults_lib.FaultInjector(fp, log_path=fault_log,
+                                   rank="driver", host="sim")
+
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 4), np.int32))
+    factory = make_engine_factory(model, params, slots=4, max_len=32,
+                                  max_prompt_len=16)
+    trace = poisson_trace(seed=seed, n_requests=steps, rate_rps=25.0)
+
+    vt = [0.0]
+
+    class SimDiscovery:
+        def find_available_hosts_and_slots(self):
+            return {h: 1 for h in SERVE_HOSTS}
+
+    hm = HostManager(SimDiscovery(), blacklist_ttl_s=30.0,
+                     clock=lambda: vt[0])
+    hm.update_available_hosts()
+    cluster = ServeCluster(
+        factory, policy=policy, replicas=2, step_s=0.05,
+        log_path=decision_log, host_manager=hm,
+        host_of=lambda name: f"host{int(name[1:]) % len(SERVE_HOSTS)}")
+
+    def hook(c, round_idx):
+        vt[0] = round_idx * c.step_s
+        spec = inj.check("replica_kill")
+        if spec is not None and spec.target in c.batchers:
+            c.kill_replica(spec.target)
+
+    report = cluster.run(trace, round_hook=hook)
+
+    # (a) zero request loss; the killed replica's work actually moved.
+    assert report["dropped"] == 0, report
+    assert report["completed"] == len(trace.requests), report
+    assert report["max_reroutes"] >= 1, \
+        f"kill must re-route in-flight/queued work: {report}"
+    # (b) the decision log names kill -> grow, in order.
+    decisions = [json.loads(l) for l in report["decisions"]]
+    assert decisions and decisions[0]["action"] == "drain" \
+        and decisions[0]["target"] == "r1" \
+        and decisions[0]["reason"] == "replica_lost", decisions
+    grows = [d for d in decisions if d["action"] == "grow"]
+    assert grows and grows[0]["reason"] == "restore_capacity", decisions
+    # (c) the host left the usable set via the elastic blacklist.
+    assert "host1" in hm.blacklist_snapshot(), \
+        f"killed replica's host must be blacklisted: " \
+        f"{hm.blacklist_snapshot()}"
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert "replica_kill" in sites, sorted(sites)
+    return {
+        "metric": "chaos_soak_serve",
+        "seed": seed,
+        "steps": steps,
+        "requests": len(trace.requests),
+        "completed": report["completed"],
+        "dropped": report["dropped"],
+        "max_reroutes": report["max_reroutes"],
+        "latency_p99_s": report["latency_p99_s"],
+        "decisions": report["decisions"],
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "sequences": {
+            "events": [list(e) for e in report["events"]],
+            "decisions": report["decisions"],
+        },
+    }
+
+
 # -- the stall family (docs/podmon.md) ---------------------------------------
 
 def stall_plan(seed: int) -> dict:
@@ -1205,7 +1338,8 @@ def run_soak(workdir: str, steps: int = 12, seed: int = 42,
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
-                                         "autoscale", "stall", "moe"),
+                                         "autoscale", "stall", "moe",
+                                         "serve"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
@@ -1223,11 +1357,18 @@ def main() -> int:
                          "drop/load gauges must fire, the integrity "
                          "guard must agree across ranks, and the "
                          "relaunch must restore and finish "
-                         "(docs/moe.md)")
+                         "(docs/moe.md); "
+                         "serve = a replica kill mid-stream through "
+                         "the hvd.serve cluster: graceful drain + "
+                         "queue/in-flight re-route with zero dropped "
+                         "requests, the SLO controller's kill -> grow "
+                         "decision sequence byte-deterministic "
+                         "(docs/serve.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
                          "autoscale: 120, stall: 60 — their control "
-                         "loops need a seconds-scale run)")
+                         "loops need a seconds-scale run; family "
+                         "serve: 40 trace requests)")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--repeat", type=int, default=1,
                     help=">1: rerun the same seed and assert identical "
@@ -1238,10 +1379,11 @@ def main() -> int:
 
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
             "autoscale": run_autoscale_soak,
-            "stall": run_stall_soak, "moe": run_moe_soak}[args.family]
+            "stall": run_stall_soak, "moe": run_moe_soak,
+            "serve": run_serve_soak}[args.family]
     if args.steps is None:
         args.steps = {"autoscale": 120, "stall": 60,
-                      "moe": 8}.get(args.family, 12)
+                      "moe": 8, "serve": 40}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

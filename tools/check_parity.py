@@ -475,6 +475,124 @@ def check_moe_surface(missing: list) -> None:
                        "hot-expert entry reading the load gauge")
 
 
+def check_serve_surface(missing: list) -> None:
+    """The inference-serving subsystem (docs/serve.md): every
+    ``HVD_TPU_SERVE_*`` knob (explicit literals in the serve package
+    plus one generated ``HVD_TPU_SERVE_<FIELD>`` override per SLOPolicy
+    field), every ``hvd_tpu_serve_*`` metric, the ``hvd.serve`` public
+    API names, the bench/chaos surfaces, and the fault site must all be
+    documented — an undocumented serving knob is an undiscoverable one.
+    Parsed textually (runs without jax installed)."""
+    doc = REPO / "docs" / "serve.md"
+    if not doc.exists():
+        missing.append("path: docs/serve.md")
+        return
+    text = doc.read_text()
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    metrics_doc = REPO / "docs" / "metrics.md"
+    metrics_text = metrics_doc.read_text() if metrics_doc.exists() else ""
+    serve_dir = REPO / "horovod_tpu" / "serve"
+    sources = {p.name: p.read_text()
+               for p in sorted(serve_dir.glob("*.py"))}
+    if not sources:
+        missing.append("serve: horovod_tpu/serve/ has no sources")
+        return
+
+    # Knobs: explicit HVD_TPU_SERVE_* literals + one generated
+    # override per SLOPolicy field (controller.from_env).
+    knobs = set()
+    env_lit = re.compile(r'"(HVD_TPU_SERVE_[A-Z0-9_]+)"')
+    for src in sources.values():
+        knobs |= set(env_lit.findall(src))
+    m = re.search(r"class SLOPolicy:.*?\n\n    @classmethod",
+                  sources.get("controller.py", ""), re.S)
+    if m is None:
+        missing.append("serve: SLOPolicy dataclass not found")
+        return
+    fields = re.findall(r"^    (\w+): (?:bool|int|float)", m.group(0),
+                        re.M)
+    if not fields:
+        missing.append("serve: no SLOPolicy fields parsed")
+    knobs |= {"HVD_TPU_SERVE_" + f.upper() for f in fields}
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"serve knob {k}: undocumented in "
+                           "docs/serve.md")
+    for f in fields:
+        if f"`{f}`" not in text:
+            missing.append(f"serve policy field {f}: missing from the "
+                           "docs/serve.md schema table")
+
+    # Metrics registered by the serve package.
+    reg_call = re.compile(
+        r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"(hvd_tpu_[a-z0-9_]+)"')
+    names = set()
+    for src in sources.values():
+        names |= set(reg_call.findall(src))
+    if not any(n.startswith("hvd_tpu_serve_") for n in names):
+        missing.append("serve: no hvd_tpu_serve_* metrics registered")
+    for n in sorted(names):
+        for where, t in (("docs/serve.md", text),
+                         ("docs/metrics.md", metrics_text)):
+            if n not in t:
+                missing.append(f"serve metric {n}: undocumented in "
+                               f"{where}")
+
+    # Public API names: defined in source -> documented in both docs.
+    api_names = {
+        "queue.py": ("Request", "RequestQueue"),
+        "traffic.py": ("TrafficTrace", "poisson_trace"),
+        "engine.py": ("DecodeEngine", "make_engine_factory"),
+        "batcher.py": ("ContinuousBatcher",),
+        "controller.py": ("SLOPolicy", "ServeController",
+                          "ServeCluster"),
+        "kvcache.py": ("init_cache", "export_slot", "import_slot"),
+    }
+    for fname, fns in api_names.items():
+        src = sources.get(fname, "")
+        for name in fns:
+            if f"def {name}" not in src and f"class {name}" not in src:
+                continue
+            for where, t in (("docs/api.md", api_text),
+                             ("docs/serve.md", text)):
+                if name not in t:
+                    missing.append(f"serve api {name}: undocumented "
+                                   f"in {where}")
+    gpt_src = (REPO / "horovod_tpu" / "models" / "gpt.py").read_text()
+    if "def init_kv_cache" in gpt_src:
+        for where, t in (("docs/api.md", api_text),
+                         ("docs/serve.md", text)):
+            if "init_kv_cache" not in t:
+                missing.append("serve api init_kv_cache: undocumented "
+                               f"in {where}")
+
+    # Bench + chaos + fault-site surfaces.
+    bench_src = (REPO / "bench.py").read_text()
+    for flag in ("--serve", "--serve-replicas", "--serve-kv",
+                 "--serve-requests", "--serve-rate", "--serve-seed"):
+        if f'"{flag}"' not in bench_src:
+            missing.append(f"serve: bench.py lacks the {flag} flag")
+        elif flag not in text:
+            missing.append(f"serve bench flag {flag}: undocumented in "
+                           "docs/serve.md")
+    if '"workload": "serve"' not in bench_src:
+        missing.append("serve: bench.py serve records lack the "
+                       "workload tag")
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    if "run_serve_soak" not in soak_src or '"serve"' not in soak_src:
+        missing.append("serve: chaos_soak.py lacks the serve family")
+    faults_src = (REPO / "horovod_tpu" / "common"
+                  / "faults.py").read_text()
+    if '"replica_kill"' not in faults_src:
+        missing.append("serve: faults.py lacks the replica_kill site")
+    ts = (REPO / "docs" / "troubleshooting.md")
+    ts_text = ts.read_text() if ts.exists() else ""
+    if "hvd_tpu_serve_queue_depth" not in ts_text:
+        missing.append("serve: docs/troubleshooting.md lacks the "
+                       "queue-backlog entry reading the depth gauge")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -518,6 +636,7 @@ def main() -> int:
     check_mfu_surface(missing)
     check_podmon_surface(missing)
     check_moe_surface(missing)
+    check_serve_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")

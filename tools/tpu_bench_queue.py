@@ -34,6 +34,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from tools.round_dirs import CURRENT as _ROUND  # noqa: E402
+from tools.round_dirs import SEARCH_ORDER as _SEARCH_ORDER  # noqa: E402
 
 OUTDIR = os.path.join(REPO, "results", _ROUND)
 
@@ -108,11 +109,32 @@ JOBS = [
                       "--model", "bert_large", "--num-iters", "3",
                       "--profile-dir", f"results/{_ROUND}/trace_bert"],
      1200),
+    # The serving workload (docs/serve.md): multi-replica continuous
+    # batching + KV-cache decode on the chip; its record is gated on
+    # tokens/s + p99 latency instead of MFU (workload="serve").
+    ("serve_gpt_small", ["bench.py", "--_worker", "--_platform=tpu",
+                         "--serve", "--model", "gpt_small",
+                         "--serve-requests", "200"], 1200),
     # Elastic reset under fire (VERDICT r3 #6): train → SIGKILL →
     # lease cooldown → orbax restore + persistent-compile-cache warm
     # start, all on the real chip.
     ("elastic_reset", ["tools/tpu_elastic_reset.py"], 1800),
 ]
+
+# Regression gate (ROADMAP item 5 seed, extended per-workload by ISSUE
+# 11): a fresh capture is diffed against the best banked record for the
+# same job across the round dirs, on the metric basis its workload
+# defines. >GATE_PCT worse on any basis marks the record
+# regression=true and the gate LOGS LOUDLY — the ratchet that turns
+# banked chip numbers from anecdotes into a floor.
+GATE_PCT = 2.0
+
+# workload -> [(field, direction)]: direction +1 = higher is better
+# (throughput/MFU), -1 = lower is better (latency).
+GATE_BASES = {
+    "train": [("value", +1), ("mfu", +1)],
+    "serve": [("value", +1), ("latency_p99_s", -1)],
+}
 
 
 def _log(msg):
@@ -235,8 +257,80 @@ def _summarize_trace(job_name):
         _log(f"job {job_name}: trace analysis failed ({e})")
 
 
+def best_banked(name, skip_current=True):
+    """The BEST prior record for job ``name`` across the round dirs
+    (``skip_current`` excludes the dir a fresh capture is about to land
+    in, so a record is never gated against itself). 'Best' = highest
+    primary-basis ``value`` (throughput for both workloads) among valid
+    TPU records — NOT the newest: gating against the newest would let
+    the floor decay ~GATE_PCT per round (each capture 2% worse than
+    the last, none ever flagged); gating against the max makes the
+    banked number an actual ratchet."""
+    best, best_dir = None, None
+    for rdir in _SEARCH_ORDER:
+        if skip_current and rdir == _ROUND:
+            continue
+        path = os.path.join(REPO, "results", rdir, f"{name}.json")
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(rec, dict) or rec.get("platform") != "tpu" \
+                or not isinstance(rec.get("value"), (int, float)):
+            continue
+        if best is None or rec["value"] > best["value"]:
+            best, best_dir = rec, rdir
+    return best, best_dir
+
+
+def gate_record(name, payload, banked=None):
+    """Per-workload regression gate: diff ``payload`` against the best
+    banked record on its workload's bases (GATE_BASES — training diffs
+    value/MFU, serve diffs tokens/s + p99 latency). Returns the diff
+    dict (also annotated onto the payload) or None when there is
+    nothing comparable; regressions past GATE_PCT set
+    ``payload["regression"] = True`` and log loudly."""
+    if banked is None:
+        banked, rdir = best_banked(name)
+    else:
+        rdir = "given"
+    if banked is None:
+        return None
+    workload = payload.get("workload", "train")
+    if banked.get("workload", "train") != workload:
+        return None  # a job that changed workload is not comparable
+    diffs, regressed = {}, []
+    for field, direction in GATE_BASES.get(workload, GATE_BASES["train"]):
+        new, old = payload.get(field), banked.get(field)
+        if not isinstance(new, (int, float)) \
+                or not isinstance(old, (int, float)) or not old:
+            continue
+        delta_pct = (new - old) / abs(old) * 100.0
+        diffs[field] = {"new": new, "banked": old,
+                        "delta_pct": round(delta_pct, 2)}
+        if direction * delta_pct < -GATE_PCT:
+            regressed.append(field)
+    if not diffs:
+        return None
+    gate = {"vs": rdir, "workload": workload, "diffs": diffs,
+            "regressed": regressed}
+    payload["gate"] = gate
+    if regressed:
+        payload["regression"] = True
+        _log(f"job {name}: REGRESSION vs banked {rdir} record on "
+             + ", ".join(f"{f} ({diffs[f]['delta_pct']:+.1f}%)"
+                         for f in regressed))
+    else:
+        _log(f"job {name}: gate ok vs {rdir} ("
+             + ", ".join(f"{f} {d['delta_pct']:+.1f}%"
+                         for f, d in diffs.items()) + ")")
+    return gate
+
+
 def write_result(name, payload):
     os.makedirs(OUTDIR, exist_ok=True)
+    gate_record(name, payload)
     with open(os.path.join(OUTDIR, f"{name}.json"), "w") as f:
         json.dump(payload, f, indent=2)
     _summarize_trace(name)
