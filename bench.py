@@ -296,6 +296,17 @@ def main():
                         "BENCH json. Default '' keeps the legacy "
                         "static-args loop ('' != off: off measures the "
                         "transfer, '' excludes it)")
+    p.add_argument("--zero-stage", default="auto",
+                   choices=["auto", "0", "1", "2", "3"],
+                   help="ZeRO stage for the optimizer (docs/zero.md): "
+                        "0 replicated, 1 sharded optimizer state, 2 + "
+                        "sharded gradient accumulation, 3 + sharded "
+                        "params with gather-on-demand. 'auto' consults "
+                        "HVD_TPU_ZERO_STAGE, then the legacy "
+                        "--shard-update heuristic (stage 1). Stages "
+                        "2/3 are gpt_* models only. Every record "
+                        "carries a 'memory' block with the per-rank "
+                        "at-rest/peak state bytes the stage implies")
     p.add_argument("--shard-update", default="auto",
                    choices=["auto", "on", "off"],
                    help="weight-update sharding (ZeRO-1, "
@@ -607,17 +618,66 @@ def _shard_decision(args, params, n) -> bool:
     return hvd.should_shard_update(params, size=n)
 
 
+def _zero_stage_decision(args, params, n) -> int:
+    """Which ZeRO stage this arm runs (docs/zero.md). Explicit
+    --zero-stage wins; 'auto' consults the HVD_TPU_ZERO_STAGE config
+    knob, then the legacy --shard-update heuristic (stage 1).
+    Incompatible arms (single rank, Adasum routing; stages 2/3 on
+    non-GPT models or --moe) log and fall back."""
+    stage = None
+    if args.zero_stage != "auto":
+        stage = int(args.zero_stage)
+    else:
+        from horovod_tpu.common import basics
+
+        cfg = basics.context().config.zero_stage \
+            if basics.is_initialized() else 0
+        if cfg:
+            stage = int(cfg)
+    if stage is None:
+        return 1 if _shard_decision(args, params, n) else 0
+    if stage == 0:
+        return 0
+    why = None
+    if n <= 1:
+        why = "single-rank world"
+    elif args.route.startswith("adasum") and args.mesh_shape:
+        why = "Adasum routing (sharded update reduces SUM/AVERAGE only)"
+    elif stage == 1 and args.overlap:
+        # Same guard the legacy heuristic enforces: ShardedOptimizer
+        # has no bucket chaining, so running it would stamp an overlap
+        # arm that never overlapped (stages 2/3 chain internally).
+        why = "--overlap (no bucket chaining on the ZeRO-1 surface)"
+    elif stage >= 2 and not args.model.startswith("gpt"):
+        why = f"stage {stage} is wired for gpt_* models only here"
+    elif stage >= 3 and args.moe:
+        why = "stage 3 + --moe (sharded expert storage is a named " \
+              "follow-up)"
+    if why is not None:
+        _log(f"--zero-stage {stage} ignored: {why}; falling back to "
+             "the replicated arm")
+        return 0
+    return stage
+
+
 def _make_tx(args, params, n, inner):
     """The optimizer for a bench arm: replicated DistributedOptimizer
-    or (when the weight-update-sharding decision says so) the ZeRO-1
-    ShardedOptimizer — same update() call shape either way. Returns
-    (tx, sharded: bool)."""
+    (stage 0) or the ZeRO surface at the decided stage — stage 1 keeps
+    the historical ShardedOptimizer (identical semantics), stages 2/3
+    build hvd.ZeroOptimizer (docs/zero.md). Returns (tx, stage)."""
     import horovod_tpu as hvd
 
     rt = _routing(args)
-    sharded = _shard_decision(args, params, n)
-    _ARM["sharded"] = sharded
-    if sharded:
+    stage = _zero_stage_decision(args, params, n)
+    _ARM["sharded"] = stage
+    if stage >= 2:
+        tx = hvd.ZeroOptimizer(
+            inner, zero_stage=stage, axis_name=hvd.rank_axis(),
+            compression=args.compression,
+            nonfinite_policy=_guard_policy(args),
+            accum_steps=args.accum, remat_policy=args.remat_policy,
+            **({"route": rt["plan"]} if rt else {}))
+    elif stage == 1:
         tx = hvd.ShardedOptimizer(
             inner, axis_name=hvd.rank_axis(),
             compression=args.compression,
@@ -631,7 +691,54 @@ def _make_tx(args, params, n, inner):
             nonfinite_policy=_guard_policy(args),
             accum_steps=args.accum, remat_policy=args.remat_policy,
             **_route_kwargs(rt))
-    return tx, sharded
+    _ARM["memory"] = _memory_block(params, inner, stage, n, args.accum)
+    return tx, stage
+
+
+def _memory_block(params, inner, stage, n, accum):
+    """The BENCH ``memory`` block (docs/zero.md): per-rank at-rest and
+    peak state bytes COMPUTED FROM THE SHARDINGS the stage implies —
+    params, gradient accumulator, inner optimizer state — so the
+    ZeRO-2/3 win is a recorded number, not an anecdote. eval_shape
+    only; no arrays are built."""
+    import jax
+
+    import numpy as np
+
+    def tree_bytes(t):
+        return int(sum(int(np.prod(l.shape)) * jnp_dtype_size(l)
+                       for l in jax.tree.leaves(t)))
+
+    def jnp_dtype_size(l):
+        import jax.numpy as jnp
+
+        return jnp.dtype(l.dtype).itemsize
+
+    pb = tree_bytes(params)
+    try:
+        ob = tree_bytes(jax.eval_shape(inner.init, params))
+    except Exception:  # noqa: BLE001 — memory block must never fail it
+        ob = 0
+    shard = n if (stage >= 1 and n > 1) else 1
+    pshard = n if (stage >= 3 and n > 1) else 1
+    gshard = n if (stage >= 2 and n > 1) else 1
+    # Gradients: backprop's transient output is one full tree on every
+    # stage; the ACCUMULATOR (what persists across microbatches) is
+    # what the stages shard. accum==1 carries no accumulator.
+    grad_accum = 0 if accum <= 1 else pb // gshard
+    at_rest = {"params": pb // pshard, "grad_accum": grad_accum,
+               "opt_state": ob // shard}
+    peak = {"params": pb,  # stage 3's transient full gather
+            "grads": pb,   # one microbatch's backprop output
+            "opt_state": ob // shard}
+    return {
+        "zero_stage": stage, "n_ranks": n,
+        "replicated_total_bytes": pb + ob,
+        "per_rank_at_rest": at_rest,
+        "per_rank_at_rest_bytes": sum(at_rest.values()),
+        "per_rank_peak": peak,
+        "per_rank_peak_bytes": sum(peak.values()) + grad_accum,
+    }
 
 
 def _init_opt_state(tx, sharded, params, n, routing):
@@ -905,13 +1012,22 @@ def _run_benchmark_inner(args, n):
         "accum": args.accum,
         "remat_policy": args.remat_policy,
         "prefetch": args.prefetch or None,
-        "shard_update": _ARM["sharded"],
+        "shard_update": bool(_ARM["sharded"]),
+        "zero_stage": _ARM["sharded"],
         "moe": args.moe or None,
         "moe_wire": (_moe_config(args, n) or {}).get("wire")
         if args.moe else None,
         "moe_overlap": (_moe_config(args, n) or {}).get("overlap_chunks")
         if args.moe else None,
     }
+    if _ARM.get("memory"):
+        # Sharding-derived per-rank state bytes (docs/zero.md): the
+        # ZeRO A/B's acceptance number — per-rank AT-REST state bytes
+        # (params + grad accumulator + opt state) must drop ≥3x from
+        # stage 1 to stage 3 on the same model/mesh. (Peak includes
+        # the transients — stage 3's full gather and one microbatch's
+        # grads — which no stage can shard away.)
+        result["memory"] = _ARM["memory"]
     moe_cfg = _moe_config(args, n) if is_gpt else None
     if moe_cfg:
         # The step output vector is [loss, dropped, frac, routed,
@@ -1142,7 +1258,10 @@ def _metrics_summary():
 
 _LAST_LOWERED = {"lowered": None, "compiled": None}
 _TIMINGS = {"compile_s": None}
-_ARM = {"sharded": None}  # what _make_tx actually decided
+# What _make_tx actually decided: "sharded" is the ZeRO stage (0 =
+# replicated; truthy = sharded surfaces), "memory" the computed
+# per-rank state-byte block for the BENCH record (docs/zero.md).
+_ARM = {"sharded": None, "memory": None}
 
 
 def _infeed_wait_totals():
@@ -1579,33 +1698,82 @@ def _setup_gpt(args, batch_size, n):
     from jax.sharding import PartitionSpec as P
 
     rt = _routing(args)
-    tx, sharded = _make_tx(args, params, n,
-                           optax.adamw(1e-4, mu_dtype=jnp.bfloat16))
-    opt_state, opt_specs = _init_opt_state(tx, sharded, params, n, rt)
+    tx, zstage = _make_tx(args, params, n,
+                          optax.adamw(1e-4, mu_dtype=jnp.bfloat16))
+
+    def loss_of(p, tb):
+        if moe:
+            logits, mods = model.apply(
+                {"params": p}, tb[:, :-1],
+                mutable=["intermediates"],
+                rngs={"gating": jax.random.PRNGKey(17)})
+            aux, stats = _moe_collect(mods["intermediates"],
+                                      moe["experts"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tb[:, 1:]).mean()
+            return ce + 0.01 * aux, stats
+        logits = model.apply({"params": p}, tb[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tb[:, 1:]).mean()
+
+    flops = _transformer_model_flops(params, model.num_layers,
+                                     model.hidden, args.seq_len)
+
+    if zstage >= 3:
+        # Stage-3 arm (docs/zero.md): params live as 1/N bucket shards;
+        # every step gathers them on demand (chained per-bucket AG) and
+        # the update returns new shards — the state carried through the
+        # step is (shards, opt_state), both 1/N.
+        from horovod_tpu.common import basics
+
+        sspecs = tx.shard_specs(params)
+        opt_specs = tx.state_specs(params)
+        mesh = rt["mesh"] if rt else basics.context().mesh
+
+        def _setup_shards(p):
+            sh = tx.shard_params(p)
+            return sh, tx.init(sh)
+
+        setup = jax.jit(jax.shard_map(
+            _setup_shards, mesh=mesh, in_specs=(P(),),
+            out_specs=(sspecs, opt_specs), check_vma=False))
+        shards, opt_state = setup(params)
+
+        def apply_loss(state, data, pmean_axis):
+            sh, st = state
+            (toks,) = data
+            if args.accum > 1 or args.remat_policy != "none":
+                out, g = tx.accumulate(loss_of,
+                                       has_aux=bool(moe))(sh, toks)
+            else:
+                full = tx.gather_params(sh)
+                out, g = jax.value_and_grad(
+                    loss_of, has_aux=bool(moe))(full, toks)
+            l, stats = out if moe else (out, None)
+            if pmean_axis is not None:
+                l = jax.lax.pmean(l, pmean_axis)
+            sh, st = tx.update(g, st, sh)
+            if moe:
+                return sh, st, jnp.concatenate(
+                    [l.astype(jnp.float32)[None], stats])
+            return sh, st, l
+
+        run = _make_stepper(apply_loss, (shards, opt_state), n,
+                            (tokens,), routing=rt,
+                            state_specs=[sspecs, opt_specs],
+                            prefetch=args.prefetch)
+        return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
+
+    opt_state, opt_specs = _init_opt_state(tx, zstage, params, n, rt)
 
     def apply_loss(state, data, pmean_axis):
         p, st = state
         (toks,) = data
 
-        def loss_fn(p, tb):
-            if moe:
-                logits, mods = model.apply(
-                    {"params": p}, tb[:, :-1],
-                    mutable=["intermediates"],
-                    rngs={"gating": jax.random.PRNGKey(17)})
-                aux, stats = _moe_collect(mods["intermediates"],
-                                          moe["experts"])
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, tb[:, 1:]).mean()
-                return ce + 0.01 * aux, stats
-            logits = model.apply({"params": p}, tb[:, :-1])
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, tb[:, 1:]).mean()
-
         if args.accum > 1 or args.remat_policy != "none":
-            out = tx.accumulate(loss_fn, has_aux=bool(moe))(p, toks)
+            out = tx.accumulate(loss_of, has_aux=bool(moe))(p, toks)
         else:
-            out = jax.value_and_grad(loss_fn,
+            out = jax.value_and_grad(loss_of,
                                      has_aux=bool(moe))(p, toks)
         if moe:
             (l, stats), g = out
@@ -1625,9 +1793,7 @@ def _setup_gpt(args, batch_size, n):
     run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,),
                         routing=rt, state_specs=[P(), opt_specs],
                         prefetch=args.prefetch)
-    return (run, "samples/s", BERT_BASELINE_PER_DEVICE,
-            _transformer_model_flops(params, model.num_layers,
-                                     model.hidden, args.seq_len))
+    return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
 
 
 if __name__ == "__main__":

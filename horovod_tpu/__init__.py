@@ -57,10 +57,10 @@ from .ops.collectives import (Adasum, Average, Max, Min, Product, ReduceOp,
 from .ops.compression import Compression
 from .optim import (AutotunedStepper, DistributedGradFn,
                     DistributedOptimizer, FSDPOptimizer, ShardedOptimizer,
-                    StepTimer, accumulate_gradients, auto_shard_threshold,
-                    broadcast_parameters, observe_ef_residual,
-                    resolve_remat_policy, sharded_init, sharded_update,
-                    should_shard_update)
+                    StepTimer, ZeroOptimizer, accumulate_gradients,
+                    auto_shard_threshold, broadcast_parameters,
+                    observe_ef_residual, resolve_remat_policy,
+                    sharded_init, sharded_update, should_shard_update)
 from .common import integrity
 from .common import metrics as _metrics_lib
 from .common.faults import recovery_stats
@@ -486,7 +486,7 @@ __all__ = [
     "stop_timeline", "spmd_step", "ReduceOp", "Average", "Sum", "Adasum",
     "Min", "Max", "Product", "Compression", "DistributedOptimizer",
     "DistributedGradFn", "AutotunedStepper", "ShardedOptimizer",
-    "FSDPOptimizer", "sharded_init", "sharded_update",
+    "FSDPOptimizer", "ZeroOptimizer", "sharded_init", "sharded_update",
     "broadcast_parameters", "broadcast_object",
     "allgather_object", "broadcast_variables", "collective_ops",
     "HorovodInternalError", "HostsUpdatedInterrupt", "NotInitializedError",

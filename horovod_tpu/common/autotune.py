@@ -56,8 +56,9 @@ _M_REMAT_IDX = metrics_lib.gauge(
     "(see remat_candidates order; 0 = none)")
 _M_SHARD = metrics_lib.gauge(
     "hvd_tpu_autotune_shard_update",
-    "current weight-update-sharding toggle (0 = replicated, "
-    "1 = ZeRO-1 sharded)")
+    "current ZeRO-stage candidate (0 = replicated, 1 = sharded "
+    "optimizer state, 2 = + sharded gradients, 3 = + sharded "
+    "parameters — docs/zero.md)")
 _M_MOE_WIRE_IDX = metrics_lib.gauge(
     "hvd_tpu_autotune_moe_wire_index",
     "current MoE dispatch-wire candidate index "
@@ -89,7 +90,7 @@ class TunedPoint(NamedTuple):
     route: str
     accum: int        # gradient-accumulation microbatch count
     remat: str        # remat-policy name ("none"/"dots"/...)
-    shard: bool       # weight-update sharding (ZeRO-1) toggle
+    shard: int        # ZeRO stage (0 = replicated; 1/2/3 = docs/zero.md)
     # MoE dispatch wire format ("none"/"bf16"/"int8" — docs/moe.md);
     # defaulted so pre-existing 8-positional constructions keep working.
     moe_wire: str = "none"
@@ -203,6 +204,7 @@ class Autotuner:
                  remat_candidates: Sequence[str] = (
                      "none", "dots", "full"),
                  tune_shard: bool = False,
+                 shard_candidates: Sequence[int] = (0, 1, 2, 3),
                  tune_moe_wire: bool = False,
                  moe_wire_candidates: Sequence[str] = (
                      "none", "bf16", "int8"),
@@ -254,6 +256,14 @@ class Autotuner:
         self.remat_candidates = (tuple(remat_candidates)
                                  if tune_remat else ("none",))
         self.tune_shard = tune_shard
+        # The shard axis is the ZeRO STAGE (docs/zero.md), widened from
+        # the historical on/off toggle: 0 = replicated update, 1 =
+        # sharded optimizer state, 2 = + sharded gradient accumulation,
+        # 3 = + sharded parameters with gather-on-demand. Candidates
+        # are stage numbers, pruned by the caller (e.g. bench passes
+        # (0, 1) when the model cannot run the stage-3 step shape).
+        self.shard_candidates = (tuple(int(x) for x in shard_candidates)
+                                 if tune_shard else (0,))
         # The MoE dispatch-wire axis (docs/moe.md): which payload
         # format the expert-parallel alltoall carries — none / bf16 /
         # int8. Same trade as the reduction-compression axis (wire
@@ -270,7 +280,7 @@ class Autotuner:
         rs = tuple(range(len(self.route_candidates)))
         accs = tuple(range(len(self.accum_candidates)))
         rms = tuple(range(len(self.remat_candidates)))
-        shs = (0, 1) if tune_shard else (0,)
+        shs = tuple(range(len(self.shard_candidates)))
         mws = tuple(range(len(self.moe_wire_candidates)))
         self._space: List[Tuple[int, ...]] = [
             (t, h, o, c, rt, a, m, s, mw) for t in self.candidates
@@ -383,9 +393,9 @@ class Autotuner:
             return self.remat_candidates[self._cur[6]]
 
     @property
-    def current_shard(self) -> bool:
+    def current_shard(self) -> int:
         with self._tlock:
-            return bool(self._cur[7])
+            return self.shard_candidates[self._cur[7]]
 
     @property
     def current_moe_wire(self) -> str:
@@ -406,7 +416,7 @@ class Autotuner:
             route=self.route_candidates[cur[4]],
             accum=self.accum_candidates[cur[5]],
             remat=self.remat_candidates[cur[6]],
-            shard=bool(cur[7]),
+            shard=self.shard_candidates[cur[7]],
             moe_wire=self.moe_wire_candidates[cur[8]])
 
     @property
@@ -488,7 +498,7 @@ class Autotuner:
         _M_ROUTE_IDX.set(self._cur[4])
         _M_ACCUM.set(self.accum_candidates[self._cur[5]])
         _M_REMAT_IDX.set(self._cur[6])
-        _M_SHARD.set(self._cur[7])
+        _M_SHARD.set(self.shard_candidates[self._cur[7]])
         _M_MOE_WIRE_IDX.set(self._cur[8])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
@@ -510,7 +520,7 @@ class Autotuner:
         if self.tune_remat:
             row.append(self.remat_candidates[point[6]])
         if self.tune_shard:
-            row.append(point[7])
+            row.append(self.shard_candidates[point[7]])
         if self.tune_moe_wire:
             row.append(self.moe_wire_candidates[point[8]])
         return row
@@ -626,7 +636,7 @@ class Autotuner:
                        if self.tune_accum else "")
                     + (", remat=%s" % self.remat_candidates[best[6]]
                        if self.tune_remat else "")
-                    + (", shard_update=%s" % bool(best[7])
+                    + (", zero_stage=%s" % self.shard_candidates[best[7]]
                        if self.tune_shard else "")
                     + (", moe_wire=%s" % self.moe_wire_candidates[best[8]]
                        if self.tune_moe_wire else ""),

@@ -175,6 +175,14 @@ class Config:
     # the replicated params are at least this many bytes and the world
     # has >1 rank, ZeRO-1's sharded update is the default candidate.
     auto_shard_threshold_bytes: int = 256 * _MB
+    # Default ZeRO stage for the TOOLS (bench --zero-stage auto,
+    # docs/zero.md): 0 = replicated update, 1 = sharded optimizer
+    # state, 2 = + sharded gradient accumulation, 3 = + sharded
+    # parameters with gather-on-demand. Deliberately NOT consulted by
+    # DistributedOptimizer itself — the stage changes the update() call
+    # contract (SPMD region, params/shards argument), and an env knob
+    # must never break existing call sites; pass zero_stage= there.
+    zero_stage: int = 0
     # Elastic mode (reference: HOROVOD_ELASTIC).
     elastic: bool = False
     # Telemetry-driven autoscaling (docs/autoscale.md — no reference
@@ -278,6 +286,7 @@ class Config:
         c.prefetch = _env("PREFETCH")
         c.auto_shard_threshold_bytes = _env_int(
             "AUTO_SHARD_THRESHOLD", cls.auto_shard_threshold_bytes)
+        c.zero_stage = _env_int("ZERO_STAGE", cls.zero_stage)
         c.elastic = _env_bool("ELASTIC", False)
         c.autoscale = _env_bool("AUTOSCALE", False)
         c.autoscale_policy = _env("AUTOSCALE_POLICY")

@@ -250,11 +250,16 @@ def sanitize(tree):
 
 def guarded_apply(policy: str, fn: Callable, grads, carry,
                   guard: GuardState, axis_name: str,
-                  scale: Optional[ScaleConfig] = None):
+                  scale: Optional[ScaleConfig] = None,
+                  skip_like=None):
     """Run ``fn(grads, carry) -> (out, new_carry)`` under the non-finite
     policy. ``out`` must be shaped like ``grads`` (updates or reduced
     gradients — true for every optimizer surface here), because the
-    skip branch substitutes ``zeros_like(grads)``.
+    skip branch substitutes ``zeros_like(grads)``; when ``out`` has a
+    DIFFERENT structure (the ZeRO-3 surface returns param-shard-shaped
+    update deltas from full-gradient input, optim.ZeroOptimizer), pass
+    that structure as ``skip_like`` and the skip branch zeros it
+    instead.
 
     Returns ``(out, new_carry, new_guard)``. Under ``skip_step`` /
     ``scale_backoff`` / ``abort`` the whole ``fn`` — reduction AND
@@ -286,7 +291,9 @@ def guarded_apply(policy: str, fn: Callable, grads, carry,
 
         def skip(args):
             g, c = args
-            return jax.tree.map(jnp.zeros_like, g), c
+            z = jax.tree.map(jnp.zeros_like,
+                             g if skip_like is None else skip_like)
+            return z, c
 
         out, new_carry = jax.lax.cond(ok, take, skip, (grads, carry))
 
@@ -449,6 +456,23 @@ def fingerprint_digest(tree) -> str:
     through the controller KV."""
     fp = np.asarray(jax.device_get(fingerprint(tree)), np.float32)
     return f"{zlib.crc32(fp.tobytes()) & 0xFFFFFFFF:08x}"
+
+
+def sharded_fingerprint(shards, axes) -> jnp.ndarray:
+    """Fingerprint of a SHARDED pytree (ZeRO-2/3 param/state shards,
+    docs/zero.md): each rank fingerprints its own shard and the chunk
+    vectors are psum-med over the plan's axes (``axes`` — a single
+    axis name or the WirePlan's axis tuple, the same agreement surface
+    the mesh guard uses). The result is replicated — every rank holds
+    the identical vector by construction — and deterministic in the
+    (shard layout, values), so it serves as the divergence/corruption
+    digest where :func:`check_divergence`'s replica comparison cannot
+    apply (shards legitimately differ per rank). Compare across steps
+    or across a checkpoint round-trip of the SAME world/layout; the sum
+    is layout-dependent, so cross-world comparison goes through the
+    gathered full state instead."""
+    fp = fingerprint(shards)
+    return jax.lax.psum(fp, axes)
 
 
 def check_divergence(params, axis_name: str,

@@ -53,6 +53,20 @@ _M_EF_NORM = metrics_lib.gauge(
 _M_REBUILDS = metrics_lib.counter(
     "hvd_tpu_autotune_rebuilds_total",
     "step-function rebuilds triggered by autotuner point moves")
+_M_ZERO_GATHER = metrics_lib.counter(
+    "hvd_tpu_zero_gather_bytes_total",
+    "bytes moved by the ZeRO sharded-training collectives, ring-"
+    "accounted per device at trace time (docs/zero.md): kind=param "
+    "is the stage-3 on-demand parameter all-gather, kind=grad the "
+    "gradient reduce-scatter descent, kind=update the stage-1/2 "
+    "update all-gather; wire/axis show which hop carried them",
+    labels=("kind", "wire", "axis"))
+_M_ZERO_RESIDENT = metrics_lib.gauge(
+    "hvd_tpu_zero_param_bytes_resident",
+    "at-rest parameter bytes resident per rank under the current "
+    "ZeRO stage (stage 3 = 1/N bucket shards; stages 0-2 = full "
+    "replica) — the memory-model number docs/zero.md derives",
+    labels=("stage",))
 
 
 class StepTimer:
@@ -766,7 +780,8 @@ def DistributedOptimizer(optimizer,
                          nonfinite_policy: Optional[str] = None,
                          route=None,
                          accum_steps: Optional[int] = None,
-                         remat_policy: Optional[str] = None):
+                         remat_policy: Optional[str] = None,
+                         zero_stage: int = 0):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -869,6 +884,40 @@ def DistributedOptimizer(optimizer,
         import optax
     except ImportError as e:  # pragma: no cover
         raise ImportError("DistributedOptimizer requires optax") from e
+
+    if zero_stage:
+        # The one-line ZeRO surface (docs/zero.md): stage 1 = sharded
+        # optimizer state, 2 = + sharded gradient accumulation, 3 =
+        # + sharded parameters with gather-on-demand. EXPLICIT-ONLY
+        # (no HVD_TPU_ZERO_STAGE consult here): the stage changes the
+        # update() call contract — it must run inside the SPMD region
+        # and takes params/shards — and an env knob must never break
+        # existing call sites; bench/tools read the config knob and
+        # pass the stage explicitly.
+        if int(zero_stage) not in (1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 0 (off), 1, 2 or 3 — got "
+                f"{zero_stage!r}")
+        if backward_passes_per_step != 1 or hierarchical \
+                or quantized_cross:
+            raise ValueError(
+                "zero_stage composes with accum_steps / route / "
+                "compression / nonfinite_policy, not with the legacy "
+                "backward_passes_per_step aggregation or the "
+                "hierarchical/quantized_cross booleans (express the "
+                "staged reduction as a WirePlan route instead)")
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "pre/postscale_factor are not supported on the ZeRO "
+                "sharded surfaces — fold the scale into your loss")
+        return ZeroOptimizer(
+            optimizer, zero_stage=int(zero_stage),
+            axis_name=axis_name, grad_op=op,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            compression=compression, nonfinite_policy=nonfinite_policy,
+            route=route, accum_steps=accum_steps,
+            remat_policy=remat_policy, overlap=True,
+            bucket_order=bucket_order)
 
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
@@ -1333,7 +1382,7 @@ class AutotunedStepper:
         self._remat = (tuner.current_remat if self._joint_remat
                        else "none")
         self._shard = (tuner.current_shard if self._joint_shard
-                       else False)
+                       else 0)  # ZeRO stage, 0 = replicated
         self._moe_wire = (tuner.current_moe_wire
                           if self._joint_moe_wire else "none")
         self._step = self._rebuild()
@@ -1395,7 +1444,8 @@ class AutotunedStepper:
         return self._remat
 
     @property
-    def shard(self) -> bool:
+    def shard(self) -> int:
+        """The tuned ZeRO stage (0 = replicated; docs/zero.md)."""
         return self._shard
 
     @property
@@ -1472,7 +1522,7 @@ class AutotunedStepper:
                 new_r = r_str if self._joint_route else self._route
                 new_a = int(a_str) if self._joint_accum else self._accum
                 new_m = m_str if self._joint_remat else self._remat
-                new_s = bool(int(s_str)) if self._joint_shard \
+                new_s = int(s_str) if self._joint_shard \
                     else self._shard
                 new_w = w_str if self._joint_moe_wire \
                     else self._moe_wire
@@ -2348,3 +2398,793 @@ class FSDPOptimizer:
             lambda v: (_mesh_shard_flat(v, route, align)
                        if v.ndim else v),
             state_full)
+
+
+# -- ZeRO-2/3: gradient- and parameter-sharded training (docs/zero.md) -------
+#
+# ZeRO-1 (ShardedOptimizer, above) shards the OPTIMIZER STATE over the
+# rank axis (or the WirePlan grid). ZeRO-2 additionally keeps the
+# GRADIENT accumulator as 1/N shards: each microbatch's gradients are
+# reduce-scattered straight into the owner's shard, so no full-size
+# accumulated gradient ever materializes. ZeRO-3 additionally keeps the
+# PARAMETERS at rest as 1/N bucket shards, all-gathered ON DEMAND per
+# readiness-ordered bucket for the step's compute and freed after use
+# (XLA liveness): the gather chain pins bucket order with the
+# optimization-barrier pattern (common/overlap.py, parallel/moe.py), so
+# the async-collective scheduler may prefetch bucket k+1's params under
+# bucket k's compute. Overlap bucketing's readiness order IS the gather
+# schedule — forward (flatten) order for the param gathers, reverse for
+# the gradient reduce-scatters.
+#
+# Wire model per effective step (docs/zero.md): stage 1/2 pay
+# RS(grads) + AG(updates); stage 3 pays AG(params) + RS(grads) — the
+# same ring bytes, with the update AG traded for the on-demand param
+# gather. All hops ride the route's per-axis wires; int8_ef keeps its
+# Σ-residual contract on the quantized descent (mesh_reducescatter).
+
+def _zero_count_bytes(kind: str, nelems: int, itemsize: int, route,
+                      axis_name: str, wire: Optional[str] = None) -> None:
+    """Trace-time ring accounting of one sharded-collective descent or
+    ascent into ``hvd_tpu_zero_gather_bytes_total``: ``(n-1)/n`` of the
+    live buffer per device per axis, each hop priced at its wire format
+    (``collectives.mesh_wire_cost``'s recipe). Axis sizes are trace-time
+    constants, so the increments are static per compile. ``wire``
+    overrides the flat-axis payload name (the quantized flat RS)."""
+    if not _METRICS_ON:
+        return
+    length = float(nelems)
+    if route is None:
+        if not _axes_bound(axis_name):
+            return
+        n = jax.lax.axis_size(axis_name)
+        w = wire or "none"
+        _M_ZERO_GATHER.labels(kind=kind, wire=w, axis=axis_name).inc(
+            (n - 1) / n * length * C._wire_elem_bytes(w, itemsize))
+        return
+    if not _axes_bound(*route.axis_names):
+        return
+    for p in route.phases:
+        n = jax.lax.axis_size(p.axis)
+        w = wire or p.wire
+        _M_ZERO_GATHER.labels(kind=kind, wire=w, axis=p.axis).inc(
+            (n - 1) / n * length * C._wire_elem_bytes(w, itemsize))
+        length /= n
+
+
+def _is_shard_grads(grads, like=None) -> bool:
+    """True when ``grads`` is a list/tuple of 1-D bucket-shard arrays
+    (the output of the ZeRO-2/3 shard accumulators) rather than a
+    params-shaped pytree. ``like`` (params, or the stage-3 shard list)
+    disambiguates the pathological case where the params tree is
+    ITSELF a flat list of 1-D vectors: a tree with ``like``'s
+    structure AND leaf shapes is a full-gradient tree, never shards —
+    while stage-3 shard grads must match the shard list's shapes
+    exactly."""
+    if not isinstance(grads, (list, tuple)) or not grads:
+        return False
+    if not all(getattr(jnp.asarray(g), "ndim", None) == 1
+               for g in grads):
+        return False
+    if like is None:
+        return True
+    g_shapes = [tuple(jnp.shape(g)) for g in grads]
+    if isinstance(like, (list, tuple)) and like \
+            and all(getattr(jnp.asarray(s), "ndim", None) == 1
+                    for s in like):
+        # Stage-3 form: ``like`` is the param-shard list — shard grads
+        # mirror it one-to-one.
+        return g_shapes == [tuple(jnp.shape(s)) for s in like]
+    if jax.tree.structure(grads) != jax.tree.structure(like):
+        return True
+    return g_shapes != [tuple(jnp.shape(p))
+                        for p in jax.tree.leaves(like)]
+
+
+class ZeroOptimizer:
+    """One surface over the ZeRO stages (docs/zero.md)::
+
+        tx = hvd.DistributedOptimizer(optax.adamw(1e-3), zero_stage=3,
+                                      axis_name=ax)           # == this
+        tx = hvd.ZeroOptimizer(optax.adamw(1e-3), zero_stage=3,
+                               axis_name=ax)
+
+    Stage semantics (all inside the jitted SPMD region — the shard
+    shapes come from the bound axes):
+
+    * ``zero_stage=1`` — optimizer state sharded; full grads in,
+      RS -> shard update -> AG(updates) out. Exactly
+      :class:`ShardedOptimizer` (delegated; same state layout,
+      checkpoint-compatible).
+    * ``zero_stage=2`` — plus gradient sharding: :meth:`accumulate`
+      carries a 1/N-shard fp32 accumulator (reduce-scatter per
+      microbatch, exact native wires), and :meth:`update` accepts the
+      resulting shard-gradient list directly (no RS inside). Full-grad
+      ``update()`` calls keep stage-1 semantics, so the two stages are
+      state-compatible.
+    * ``zero_stage=3`` — plus parameter sharding: params live as 1/N
+      fast-major bucket shards (:meth:`shard_params`), are gathered on
+      demand (:meth:`gather_params` — per-bucket all-gathers chained in
+      readiness order so bucket k+1's gather can fly under bucket k's
+      compute), and :meth:`update` returns NEW SHARDS (the update never
+      all-gathers; the next step's param gather is the inverse hop).
+
+    Composition contracts:
+
+    * ``route=`` (explicit-only, like every sharded surface): the shard
+      grid spans ALL plan axes fast-major and every RS/AG hop rides the
+      plan's per-axis wires (int8 on the slow hop under
+      ``staged_int8``).
+    * ``compression="int8_ef"``: the quantized gradient descent keeps
+      the Σ-over-ranks residual contract (``mesh_reducescatter``); the
+      residual advances once per quantized descent — under the stage-2/3
+      shard accumulator the per-microbatch RS is EXACT (native wires),
+      so the EF residual advances only on full-grad ``update()`` calls
+      (accum_steps=1) and never drifts silently.
+    * ``nonfinite_policy``: one globally-agreed flag over the plan's
+      axes; a skipped step leaves shards, inner state, EF residual and
+      step counter untouched (stage 3 adds zeros to the param shards).
+    * ``accum_steps``/``remat_policy``: :meth:`accumulate` gathers
+      params ONCE per effective step (stage 3) and accumulates
+      shard-sized gradients (stages 2/3) — the gather count is
+      trace-verified (tests/test_zero.py).
+
+    Elementwise inner transforms only — the ShardedOptimizer contract.
+    """
+
+    def __init__(self, inner, zero_stage: int = 2,
+                 axis_name: str = "hvd",
+                 grad_op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                 fusion_threshold_bytes: Optional[int] = None,
+                 compression=None,
+                 nonfinite_policy: Optional[str] = None,
+                 route=None, accum_steps: Optional[int] = None,
+                 remat_policy: Optional[str] = None,
+                 overlap: bool = True, bucket_order=None):
+        stage = int(zero_stage)
+        if stage not in (1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 1, 2 or 3, got {zero_stage!r} "
+                "(0/off = the replicated DistributedOptimizer)")
+        if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+            raise ValueError("ZeroOptimizer supports SUM/AVERAGE")
+        self.zero_stage = stage
+        self.inner = inner
+        self.axis_name = axis_name
+        self.grad_op = grad_op
+        self.fusion_threshold_bytes = _resolve_fusion_threshold(
+            fusion_threshold_bytes)
+        self.compression = _resolve_compression(compression)
+        _check_reduce_safe(self.compression)
+        self._ef = getattr(self.compression, "error_feedback", False)
+        self.nonfinite_policy = integrity_lib.resolve_nonfinite_policy(
+            nonfinite_policy)
+        # Explicit-only (no HVD_TPU_ROUTE default): the route decides
+        # the shard grid and the PartitionSpecs built outside traces.
+        self.route = C.WirePlan.resolve(route)
+        self.accum_steps = _resolve_accum_steps(accum_steps)
+        self.remat_policy = resolve_remat_policy(remat_policy)[0]
+        self.overlap = bool(overlap)
+        self.bucket_order = bucket_order
+        # Stages 1/2 ride the ZeRO-1 substrate unchanged: same state
+        # layout, EF/guard wrapping, gather/reshard — checkpoint- and
+        # elastic-compatible by construction.
+        self._z1 = ShardedOptimizer(
+            inner, axis_name=axis_name, grad_op=grad_op,
+            fusion_threshold_bytes=self.fusion_threshold_bytes,
+            compression=self.compression,
+            nonfinite_policy=self.nonfinite_policy, route=self.route,
+            accum_steps=self.accum_steps,
+            remat_policy=self.remat_policy)
+        # Stage-3 bound plan (the FSDPOptimizer binding contract).
+        self._plan = None
+        self._flat_lens = None
+        self._sig = None
+
+    # -- shared plumbing -----------------------------------------------------
+
+    def _live_route(self):
+        return _sharded_route(self.route, self.axis_name)
+
+    def _require_route_axes(self, route, what: str) -> None:
+        if route is not None:
+            for a in route.axis_names:
+                _require_axis(a, what)
+        else:
+            _require_axis(self.axis_name, what)
+
+    def _axes(self, route):
+        return tuple(route.axis_names) if route is not None \
+            else self.axis_name
+
+    def _n(self, route) -> int:
+        return (_route_total(route) if route is not None
+                else jax.lax.axis_size(self.axis_name))
+
+    def _plan_z12(self, params):
+        """Stages 1/2 plan the buckets from the live params each call
+        (the sharded_update contract — state carries one shard per
+        bucket of THIS plan)."""
+        return fusion_lib.plan_fusion(params, self.fusion_threshold_bytes)
+
+    # -- stage-3 plan binding (the FSDPOptimizer contract) -------------------
+
+    def bind(self, params_template):
+        """Pin the stage-3 bucket plan from a params pytree (arrays or
+        ShapeDtypeStructs). A later bind with a structurally different
+        template raises — shards from the old plan would silently
+        misalign; unbind() (or a fresh instance) retargets."""
+        sig = (str(jax.tree.structure(params_template)),
+               tuple((tuple(x.shape), str(x.dtype))
+                     for x in jax.tree.leaves(params_template)))
+        if self._sig is not None and sig != self._sig:
+            raise ValueError(
+                "ZeroOptimizer is already bound to a different param "
+                "tree (structure or leaf shapes changed); use a fresh "
+                "instance per param tree, or call unbind() first")
+        self._sig = sig
+        order = (self.bucket_order if self.bucket_order is not None
+                 else fusion_lib.ORDER_FLATTEN)
+        self._plan = fusion_lib.plan_fusion(
+            params_template, self.fusion_threshold_bytes, order=order)
+        self._flat_lens = [b.total_elems for b in self._plan.buckets]
+        return self
+
+    def unbind(self):
+        self._plan = self._flat_lens = self._sig = None
+        return self
+
+    def _require_bound(self, what: str):
+        if self._plan is None:
+            raise ValueError(
+                f"{what} needs the stage-3 bucket plan — call "
+                f"shard_params (or bind(params_template)) first")
+
+    def _check_shards(self, shards, what: str):
+        if len(shards) != len(self._flat_lens):
+            raise ValueError(
+                f"{what}: got {len(shards)} bucket shards but the bound "
+                f"plan has {len(self._flat_lens)} buckets — these "
+                f"shards come from a different plan/template")
+
+    # -- stage-3 parameter residency -----------------------------------------
+
+    def shard_params(self, params):
+        """Full params -> this rank's 1/N bucket shards (stage 3; the
+        at-rest layout — fast-axis-major over all plan axes under a
+        route). Publishes the per-rank resident-byte gauge."""
+        if self.zero_stage < 3:
+            raise ValueError(
+                "shard_params is the stage-3 surface (params stay "
+                f"replicated under zero_stage={self.zero_stage})")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.shard_params")
+        self.bind(params)
+        flats = fusion_lib.fuse(params, self._plan)
+        align = _route_align(self.compression, route)
+        if route is not None:
+            shards = [_mesh_shard_flat(f, route, align) for f in flats]
+        else:
+            shards = [_shard_flat(f, self.axis_name, align)
+                      for f in flats]
+        if _METRICS_ON:
+            resident = sum(int(s.shape[0]) * jnp.dtype(s.dtype).itemsize
+                           for s in shards)
+            _M_ZERO_RESIDENT.labels(stage="3").set(resident)
+        return shards
+
+    def gather_params(self, shards):
+        """Bucket shards -> full params pytree: ONE all-gather per
+        readiness-ordered bucket, chained through an
+        ``optimization_barrier`` so the issue order is pinned (bucket
+        k+1's gather may then fly under bucket k's compute — the
+        prefetch schedule; inert on CPU, numerics unchanged). Under a
+        route the gather ascends the plan in reverse, each hop in its
+        axis's wire format, and the moved bytes land in
+        ``hvd_tpu_zero_gather_bytes_total{kind="param"}``."""
+        self._require_bound("gather_params")
+        self._check_shards(shards, "gather_params")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.gather_params")
+        if route is not None:
+            inv = route.reversed()
+
+            def ag(s):
+                return C.mesh_allgather(s, inv)
+        else:
+            def ag(s):
+                return C.allgather(s, self.axis_name)
+
+        if self.overlap:
+            from .common import overlap as overlap_lib
+
+            outs = overlap_lib.chain_issue_order(shards, ag)
+        else:
+            outs = [ag(s) for s in shards]
+        flats = [o[:length]
+                 for o, length in zip(outs, self._flat_lens)]
+        for b in self._plan.buckets:
+            _zero_count_bytes("param", b.total_elems,
+                              jnp.dtype(b.dtype).itemsize, route,
+                              self.axis_name)
+        return fusion_lib.unfuse(flats, self._plan)
+
+    def shard_specs(self, params_template):
+        """P(axes) per stage-3 bucket shard, for carrying the shards
+        through shard_map. Binds the plan."""
+        from jax.sharding import PartitionSpec as P
+
+        self.bind(params_template)
+        axes = (tuple(self.route.axis_names) if self.route is not None
+                else self.axis_name)
+        return [P(axes)] * len(self._flat_lens)
+
+    # -- state ---------------------------------------------------------------
+
+    def init(self, params_or_shards):
+        """Stage 1/2: ``init(params)`` (sharded_init). Stage 3:
+        ``init(shards)`` — inner state over the param shards, plus the
+        EF residual / guard wrappers when configured."""
+        if self.zero_stage < 3:
+            return self._z1.init(params_or_shards)
+        shards = params_or_shards
+        self._require_bound("ZeroOptimizer.init")
+        self._check_shards(shards, "init")
+        inner = self.inner.init(list(shards))
+        if self._ef:
+            n = self._n(self._live_route())
+            residual = [jnp.zeros((_qpad_len(b.total_elems, n),),
+                                  jnp.float32)
+                        for b in self._plan.buckets]
+            inner = _EFShardState(inner=inner, residual=residual,
+                                  step=jnp.zeros((), jnp.int32))
+        if self.nonfinite_policy is None:
+            return inner
+        return _GuardedState(
+            inner=inner,
+            guard=integrity_lib.init_guard_state(self.nonfinite_policy))
+
+    def state_specs(self, params_template):
+        if self.zero_stage < 3:
+            return self._z1.state_specs(params_template)
+        from jax.sharding import PartitionSpec as P
+
+        self.bind(params_template)
+        axes = (tuple(self.route.axis_names) if self.route is not None
+                else self.axis_name)
+        inner_specs = _sharded_state_specs(self.inner, self._plan, axes)
+        if self._ef:
+            inner_specs = _EFShardState(
+                inner=inner_specs,
+                residual=[P(axes)] * len(self._plan.buckets),
+                step=P())
+        if self.nonfinite_policy is None:
+            return inner_specs
+        return _GuardedState(inner=inner_specs,
+                             guard=integrity_lib.guard_state_specs())
+
+    # -- the exact (native-wire) shard reduce-scatter ------------------------
+
+    def _rs_exact(self, f, route, n, align):
+        padded, _ = fusion_lib.pad_to_multiple(f, n * align)
+        if route is not None:
+            return C.mesh_reducescatter(padded, self.grad_op,
+                                        route.with_wires("none"))
+        return C.reducescatter(padded, self.grad_op, self.axis_name)
+
+    def _rs_tree_exact(self, grads, params_like, plan, route, n, align):
+        """Full gradient pytree -> fp32 bucket shards via the EXACT
+        reduce-scatter descent (native wires on every hop — the shard
+        accumulator must sum losslessly across microbatches), chained
+        in REVERSE (backward-readiness) order under ``overlap``."""
+        g_flats = fusion_lib.fuse(
+            jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
+                         params_like), plan)
+        outs: list = [None] * len(g_flats)
+        token = None
+        order = (range(len(g_flats) - 1, -1, -1) if self.overlap
+                 else range(len(g_flats)))
+        for i in order:
+            f = g_flats[i]
+            if self.overlap and token is not None:
+                f, token = jax.lax.optimization_barrier((f, token))
+            s = self._rs_exact(f, route, n, align)
+            outs[i] = s.astype(jnp.float32)
+            token = s
+        for b in plan.buckets:
+            _zero_count_bytes("grad", b.total_elems,
+                              jnp.dtype(b.dtype).itemsize, route,
+                              self.axis_name, wire="none")
+        return outs
+
+    # -- update --------------------------------------------------------------
+
+    def update(self, grads, state, params=None, **extra):
+        """Stage 1/2 with a params-shaped ``grads``: stage-1 semantics
+        (sharded_update — RS inside, EF descent quantized, full updates
+        out). Stage 1/2 with a SHARD-GRADIENT list (from
+        :meth:`accumulate` / :meth:`reduce_grads`): shard-local inner
+        update + AG(updates) — no second reduction. Stage 3:
+        ``update(grads, state, shards) -> (new_shards, new_state)``."""
+        if self.zero_stage < 3:
+            if _is_shard_grads(grads, like=params):
+                return self._update_from_shards_z12(grads, state, params,
+                                                    **extra)
+            return self._z1.update(grads, state, params, **extra)
+        return self._update_z3(grads, state, params, **extra)
+
+    def reduce_grads(self, grads, params):
+        """Full gradient pytree -> fp32 bucket-shard list via the exact
+        reduce-scatter (the ZeRO-2 descent without accumulation); feed
+        to :meth:`update`. One RS round, no full-gradient copy beyond
+        backprop's own transient output."""
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.reduce_grads")
+        n = self._n(route)
+        align = _route_align(self.compression, route)
+        plan = (self._plan if self.zero_stage >= 3
+                else self._plan_z12(params))
+        if self.zero_stage >= 3:
+            self._require_bound("reduce_grads")
+        return self._rs_tree_exact(grads, params, plan, route, n, align)
+
+    def _update_from_shards_z12(self, g_shards, state, params, **extra):
+        if params is None:
+            raise ValueError("ZeroOptimizer.update requires params")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.update")
+        axes = self._axes(route)
+        guarded = isinstance(state, _GuardedState)
+        if (self.nonfinite_policy is not None) != guarded:
+            raise ValueError(
+                "ZeroOptimizer.update nonfinite_policy must match the "
+                "init that built this state")
+        inner_state = state.inner if guarded else state
+        if self._ef != isinstance(inner_state, _EFShardState):
+            raise ValueError(
+                "ZeroOptimizer.update compression= must match the init "
+                "that built this state (EF state/shard alignment)")
+        plan = self._plan_z12(params)
+        if len(g_shards) != len(plan.buckets):
+            raise ValueError(
+                f"got {len(g_shards)} gradient shards for a plan of "
+                f"{len(plan.buckets)} buckets")
+        align = _route_align(self.compression, route)
+        p_flats = fusion_lib.fuse(params, plan)
+        if route is not None:
+            p_shards = [_mesh_shard_flat(f, route, align)
+                        for f in p_flats]
+            u_gather = route.reversed().with_wires("none")
+        else:
+            p_shards = [_shard_flat(f, self.axis_name, align)
+                        for f in p_flats]
+            u_gather = None
+
+        def core(gs, st):
+            ist = st.inner if self._ef else st
+            gs = [g.astype(p.dtype) for g, p in zip(gs, p_shards)]
+            u_shards, new_inner = self.inner.update(gs, ist, p_shards,
+                                                    **extra)
+            u_shards = [u.astype(jnp.float32) for u in u_shards]
+            if self._ef:
+                # No quantized hop ran: residual and step carry over
+                # untouched (the EF telescope only advances on a lossy
+                # descent).
+                new_st = _EFShardState(inner=new_inner,
+                                       residual=st.residual,
+                                       step=st.step)
+            else:
+                new_st = new_inner
+            return u_shards, new_st
+
+        if not guarded:
+            u_shards, new_inner = core(g_shards, inner_state)
+            new_guard = None
+        else:
+            u_shards, new_inner, new_guard = integrity_lib.guarded_apply(
+                self.nonfinite_policy, core, list(g_shards), inner_state,
+                state.guard, axes)
+        # Update all-gather OUTSIDE the guard: a skipped step gathers
+        # zeros (harmless), and the guard's skip branch stays
+        # structure-matched to the shard gradients.
+        u_flats = [(C.mesh_allgather(u, u_gather)
+                    if u_gather is not None
+                    else C.allgather(u, self.axis_name))[:f.shape[0]]
+                   .astype(f.dtype)
+                   for u, f in zip(u_shards, p_flats)]
+        for b in plan.buckets:
+            _zero_count_bytes("update", b.total_elems,
+                              jnp.dtype(b.dtype).itemsize, route,
+                              self.axis_name, wire="none")
+        updates = fusion_lib.unfuse(u_flats, plan)
+        if new_guard is None:
+            return updates, new_inner
+        return updates, _GuardedState(new_inner, new_guard)
+
+    def _update_z3(self, grads, state, shards, **extra):
+        if shards is None:
+            raise ValueError(
+                "stage-3 update requires the param shards as the third "
+                "argument: update(grads, state, shards)")
+        self._require_bound("update")
+        self._check_shards(shards, "update")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.update")
+        axes = self._axes(route)
+        guarded = isinstance(state, _GuardedState)
+        if (self.nonfinite_policy is not None) != guarded:
+            raise ValueError(
+                "ZeroOptimizer.update nonfinite_policy must match the "
+                "init that built this state")
+        inner_state = state.inner if guarded else state
+        if self._ef != isinstance(inner_state, _EFShardState):
+            raise ValueError(
+                "ZeroOptimizer.update compression= must match the init "
+                "that built this state (EF state/shard alignment)")
+        n = self._n(route)
+        align = _route_align(self.compression, route)
+        plan = self._plan
+        from_shards = _is_shard_grads(grads, like=list(shards))
+
+        def core(g, st):
+            """-> (u_shards ≅ param shards, new inner state). The whole
+            descent — EF residual advance included — sits inside the
+            guard's cond."""
+            if from_shards:
+                g_shards = [gg.astype(s.dtype)
+                            for gg, s in zip(g, shards)]
+                new_res, new_step = ((st.residual, st.step)
+                                     if self._ef else (None, None))
+            elif not self._ef:
+                g_flats = fusion_lib.fuse(g, plan)
+                g_shards = []
+                for f, s in zip(g_flats, shards):
+                    padded, _ = fusion_lib.pad_to_multiple(
+                        f.astype(s.dtype), n * align)
+                    if route is not None:
+                        # The descent rides the PLAN's wires (int8 on
+                        # the slow hop under staged_int8 — stateless,
+                        # bounded, the FSDP contract).
+                        g_shards.append(C.mesh_reducescatter(
+                            padded, self.grad_op, route))
+                    else:
+                        g_shards.append(C.reducescatter(
+                            padded, self.grad_op, self.axis_name))
+                for b in plan.buckets:
+                    _zero_count_bytes("grad", b.total_elems,
+                                      jnp.dtype(b.dtype).itemsize,
+                                      route, self.axis_name)
+                new_res = new_step = None
+            else:
+                # Quantized descent with error feedback: corrected
+                # gradient g + residual rides the int8 wires; the local
+                # rounding error becomes the next residual
+                # (Σ-over-ranks contract, mesh_reducescatter).
+                g_flats = fusion_lib.fuse(g, plan)
+                g_shards, new_res = [], []
+                for i, (f, res) in enumerate(zip(g_flats, st.residual)):
+                    pad = res.shape[0] - f.shape[0]
+                    corrected = jnp.pad(f.astype(jnp.float32),
+                                        (0, pad)) + res
+                    if route is not None:
+                        shard, r = C.mesh_reducescatter(
+                            corrected, self.grad_op, route,
+                            key=_ef_key(st.step, i),
+                            return_residual=True)
+                    else:
+                        shard, r = C.quantized_reducescatter(
+                            corrected, self.grad_op, self.axis_name,
+                            key=_ef_key(st.step, i),
+                            return_residual=True)
+                    g_shards.append(shard.astype(shards[i].dtype))
+                    new_res.append(r)
+                for b in plan.buckets:
+                    _zero_count_bytes("grad", b.total_elems,
+                                      jnp.dtype(b.dtype).itemsize,
+                                      route, self.axis_name,
+                                      wire=None if route is not None
+                                      else "int8")
+                new_step = st.step + 1
+            ist = st.inner if self._ef else st
+            u_shards, new_inner = self.inner.update(g_shards, ist,
+                                                    list(shards),
+                                                    **extra)
+            u_shards = [u.astype(s.dtype)
+                        for u, s in zip(u_shards, shards)]
+            if self._ef:
+                new_st = _EFShardState(inner=new_inner,
+                                       residual=new_res, step=new_step)
+            else:
+                new_st = new_inner
+            return u_shards, new_st
+
+        if not guarded:
+            u_shards, new_inner = core(grads, inner_state)
+            new_guard = None
+        else:
+            u_shards, new_inner, new_guard = integrity_lib.guarded_apply(
+                self.nonfinite_policy, core,
+                list(grads) if from_shards else grads, inner_state,
+                state.guard, axes, skip_like=list(shards))
+        new_shards = [(s + u).astype(s.dtype)
+                      for s, u in zip(shards, u_shards)]
+        if new_guard is None:
+            return new_shards, new_inner
+        return new_shards, _GuardedState(new_inner, new_guard)
+
+    # -- scan-based shard accumulation ---------------------------------------
+
+    def accumulate(self, loss_fn: Callable, has_aux: bool = False):
+        """The microbatched ``value_and_grad`` for the pinned
+        ``accum_steps``/``remat_policy``. Stage 1 delegates to the
+        full-accumulator scan (:func:`accumulate_gradients`). Stages
+        2/3 return ``fn(params_or_shards, *batch) -> (value,
+        shard_grads)``: the carried accumulator is the 1/N gradient
+        SHARD list — each microbatch's full gradients exist only
+        transiently inside its own backward before the exact
+        reduce-scatter folds them into the owner's shard. Stage 3
+        gathers the params ONCE per effective step, outside the scan
+        (trace-count-verified, tests/test_zero.py), so k microbatches
+        share one chained param gather."""
+        if self.zero_stage == 1:
+            return self._z1.accumulate(loss_fn, has_aux=has_aux)
+        k = self.accum_steps
+        _, wrap, jax_policy = resolve_remat_policy(self.remat_policy)
+        inner_loss = jax.checkpoint(loss_fn, policy=jax_policy) \
+            if wrap else loss_fn
+        vgrad = jax.value_and_grad(inner_loss, has_aux=has_aux)
+        stage3 = self.zero_stage >= 3
+
+        def fn(params_or_shards, *batch):
+            route = self._live_route()
+            n = self._n(route)
+            align = _route_align(self.compression, route)
+            if stage3:
+                self._require_bound("ZeroOptimizer.accumulate")
+                plan = self._plan
+                full = self.gather_params(params_or_shards)
+            else:
+                full = params_or_shards
+                plan = self._plan_z12(full)
+
+            def rs(g):
+                return self._rs_tree_exact(g, full, plan, route, n,
+                                           align)
+
+            if k == 1:
+                out, g = vgrad(full, *batch)
+                return out, rs(g)
+
+            mbs = _split_microbatches(batch, k)
+            mb0 = jax.tree.map(lambda x: x[0], mbs)
+            shapes = jax.eval_shape(vgrad, full, *mb0)
+            out_s, _g_s = shapes
+            v_s, aux_s = out_s if has_aux else (out_s, None)
+
+            def zeros_acc(t):
+                return jax.tree.map(
+                    lambda s: jnp.zeros(
+                        s.shape, jnp.float32
+                        if jnp.issubdtype(s.dtype, jnp.floating)
+                        else s.dtype), t)
+
+            def acc_add(acc, new):
+                return jax.tree.map(
+                    lambda a, x: a + x.astype(jnp.float32)
+                    if jnp.issubdtype(jnp.asarray(a).dtype,
+                                      jnp.floating)
+                    else x, acc, new)
+
+            def chunk_len(total_elems: int) -> int:
+                grid = n * align
+                return (-(-total_elems // grid) * grid) // n
+
+            g_acc0 = [jnp.zeros((chunk_len(b.total_elems),),
+                                jnp.float32) for b in plan.buckets]
+            carry0 = (g_acc0, jnp.zeros((), jnp.float32),
+                      zeros_acc(aux_s))
+
+            def body(carry, mb):
+                g_acc, v_acc, aux_acc = carry
+                out, g = vgrad(full, *mb)
+                v, aux = out if has_aux else (out, None)
+                g_sh = rs(g)
+                g_acc = [a + s for a, s in zip(g_acc, g_sh)]
+                return (g_acc, v_acc + v.astype(jnp.float32),
+                        acc_add(aux_acc, aux)), None
+
+            (g_acc, v_acc, aux_acc), _ = jax.lax.scan(body, carry0,
+                                                      mbs)
+            g_shards = [a / k for a in g_acc]
+            value = (v_acc / k).astype(v_s.dtype)
+            if has_aux:
+                aux = jax.tree.map(
+                    lambda a, s: (a / k).astype(s.dtype)
+                    if jnp.issubdtype(jnp.asarray(a).dtype,
+                                      jnp.floating)
+                    else a, aux_acc, aux_s)
+                return (value, aux), g_shards
+            return value, g_shards
+
+        return fn
+
+    # -- elastic resize ------------------------------------------------------
+
+    def gather_state(self, state, params=None):
+        """Sharded state -> world-size-independent full state (inside
+        the OLD world's SPMD region). Stage 3 needs no ``params`` (the
+        bound plan carries the bucket layout); the param SHARDS
+        themselves travel via :meth:`gather_params` /
+        :meth:`shard_params`. EF residuals carry as their psum (the
+        world-size-independent pending correction; the new world's
+        mesh-rank 0 receives it)."""
+        if self.zero_stage < 3:
+            return self._z1.gather_state(state, params)
+        self._require_bound("gather_state")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.gather_state")
+        guard = state.guard if isinstance(state, _GuardedState) else None
+        if guard is not None:
+            state = state.inner
+        inner = state.inner if self._ef else state
+        if route is not None:
+            inner_full = _gather_sharded_state_routed(
+                self.inner, self._plan, inner, route)
+        else:
+            inner_full = _gather_sharded_state(
+                self.inner, self._plan, inner, self.axis_name)
+        if self._ef:
+            axes = self._axes(route)
+            residual_full = [
+                jax.lax.psum(r, axes)[:b.total_elems]
+                for r, b in zip(state.residual, self._plan.buckets)]
+            full = _EFShardState(inner=inner_full,
+                                 residual=residual_full,
+                                 step=state.step)
+        else:
+            full = inner_full
+        return full if guard is None else _GuardedState(inner=full,
+                                                        guard=guard)
+
+    def reshard_state(self, state_full):
+        """Full (gathered) state -> this world's shards (inside the NEW
+        world's SPMD region, whatever its size or route)."""
+        if self.zero_stage < 3:
+            return self._z1.reshard_state(state_full)
+        self._require_bound("reshard_state")
+        route = self._live_route()
+        self._require_route_axes(route, "ZeroOptimizer.reshard_state")
+        guard = state_full.guard \
+            if isinstance(state_full, _GuardedState) else None
+        if guard is not None:
+            state_full = state_full.inner
+        align = _route_align(self.compression, route)
+        n = self._n(route)
+        if route is not None:
+            me0 = jnp.asarray(True)
+            for a in route.axis_names:
+                me0 = jnp.logical_and(me0, jax.lax.axis_index(a) == 0)
+
+            def shard_leaf(v):
+                return _mesh_shard_flat(v, route, align) if v.ndim \
+                    else v
+        else:
+            me0 = jax.lax.axis_index(self.axis_name) == 0
+
+            def shard_leaf(v):
+                return (_shard_flat(v, self.axis_name, align)
+                        if v.ndim else v)
+
+        if not self._ef:
+            sharded = jax.tree.map(shard_leaf, state_full)
+            return sharded if guard is None else \
+                _GuardedState(inner=sharded, guard=guard)
+        inner = jax.tree.map(shard_leaf, state_full.inner)
+        residual = []
+        for r in state_full.residual:
+            pad = _qpad_len(r.shape[0], n) - r.shape[0]
+            r = jnp.pad(r, (0, pad))
+            residual.append(jnp.where(me0, r, jnp.zeros_like(r)))
+        sharded = _EFShardState(inner=inner, residual=residual,
+                                step=state_full.step)
+        return sharded if guard is None else \
+            _GuardedState(inner=sharded, guard=guard)

@@ -41,6 +41,11 @@ _M_OCCUPANCY = metrics_lib.gauge(
     "active decode slots / total slots of the last decode round, "
     "by replica",
     labels=("replica",))
+_M_MIGRATIONS = metrics_lib.counter(
+    "hvd_tpu_serve_kv_migrations_total",
+    "in-flight sequences moved between replicas with their warm KV "
+    "cache (int8 wire export/import) instead of a re-prefill — the "
+    "default graceful-drain path (docs/serve.md)")
 
 
 class ContinuousBatcher:
@@ -79,6 +84,38 @@ class ContinuousBatcher:
     def drained(self) -> bool:
         return (self.draining and self.engine.active_count() == 0
                 and len(self.queue) == 0)
+
+    def migrate_requests(self) -> List[Tuple]:
+        """Graceful-drain step 2, warm-handoff form (the DEFAULT —
+        docs/serve.md): every in-flight sequence leaves WITH its int8
+        block-scaled cache blob and generated-so-far tokens, so a peer
+        continues mid-sequence instead of re-prefilling (or instead of
+        this replica lingering until its longest sequence finishes).
+        Returns ``[(request, wire_blob, generated), ...]``; the cluster
+        places them on peers with free slots."""
+        out = []
+        for slot, req in enumerate(self.engine.requests):
+            if req is None:
+                continue
+            req, blob, generated = self.engine.migrate_out(slot)
+            self.events.append((self.steps, "migrate_out", req.rid,
+                                len(generated)))
+            out.append((req, blob, generated))
+        return out
+
+    def admit_migrated(self, req, blob, generated,
+                       now: float = 0.0) -> int:
+        """Land a migrated sequence (warm cache + decode state) in one
+        of this replica's free slots."""
+        slot = self.engine.admit_migrated(req, blob, generated, now)
+        _M_MIGRATIONS.inc()
+        self.events.append((self.steps, "migrate_in", req.rid, slot))
+        return slot
+
+    def migratable_slots(self) -> int:
+        """Free slots available to receive migrated sequences (serving
+        replicas only — a draining replica never admits)."""
+        return 0 if self.draining else len(self.engine.free_slots())
 
     def abort(self) -> List[Request]:
         """Replica kill: queued AND in-flight requests come back for

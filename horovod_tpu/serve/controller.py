@@ -78,6 +78,17 @@ class SLOPolicy:
     max_replicas: int = 4
     grow_cooldown_s: float = 1.0
     shrink_cooldown_s: float = 2.0
+    # How a graceful drain relocates IN-FLIGHT sequences
+    # (docs/serve.md): "migrate" (the DEFAULT) hands each one to a peer
+    # WITH its warm KV cache over the int8 wire
+    # (kvcache.export_slot/import_slot) — decode continues
+    # mid-sequence, no re-prefill, and the drained replica leaves on
+    # the next tick instead of lingering until its longest sequence
+    # finishes; "local" keeps the historical behavior (in-flight
+    # sequences finish on the draining replica). Sequences that find
+    # no free peer slot fall back to a re-prefill re-route — never
+    # dropped.
+    drain_mode: str = "migrate"
 
     @classmethod
     def field_names(cls) -> Tuple[str, ...]:
@@ -139,6 +150,11 @@ class SLOPolicy:
             raise ValueError(
                 f"serve policy: max_replicas {self.max_replicas} < "
                 f"min_replicas {self.min_replicas}")
+        if self.drain_mode not in ("migrate", "local"):
+            raise ValueError(
+                "serve policy: field 'drain_mode' must be 'migrate' "
+                f"(warm-KV handoff, the default) or 'local', got "
+                f"{self.drain_mode!r}")
         return self
 
     @classmethod
@@ -435,8 +451,39 @@ class ServeCluster:
                 and decision.reason == "low_occupancy" \
                 and decision.target in self.batchers:
             self.events.append((self.rounds, "drain", decision.target))
-            self._reroute(
-                self.batchers[decision.target].start_drain("shrink"))
+            b = self.batchers[decision.target]
+            self._reroute(b.start_drain("shrink"))
+            if self.policy.drain_mode == "migrate":
+                self._migrate_inflight(decision.target)
+
+    def _migrate_inflight(self, target: str) -> None:
+        """The warm-KV drain default (docs/serve.md): each of the
+        draining replica's in-flight sequences moves to the peer with
+        the most free slots (name order breaking ties — deterministic)
+        WITH its int8-wire cache blob; a sequence with no free peer
+        slot falls back to a re-prefill re-route. Either way the
+        drained replica empties NOW and leaves on the next tick."""
+        moved = self.batchers[target].migrate_requests()
+        for req, blob, generated in moved:
+            peers = sorted(
+                (n for n in self.serving() if n != target),
+                key=lambda n: (-self.batchers[n].migratable_slots(), n))
+            placed = False
+            for name in peers:
+                if self.batchers[name].migratable_slots() <= 0:
+                    continue
+                self.batchers[name].admit_migrated(req, blob,
+                                                   generated, self._now)
+                self.events.append((self.rounds, "migrate", req.rid,
+                                    target, name))
+                placed = True
+                break
+            if not placed:
+                # No warm landing spot: re-prefill on a peer (the
+                # historical path) — zero dropped requests either way.
+                req.reroutes += 1
+                req.replica = None
+                self._reroute([req])
 
     def tick(self) -> None:
         if self.host_manager is not None:

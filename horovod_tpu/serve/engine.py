@@ -120,7 +120,10 @@ class DecodeEngine:
         padded[0, :len(prompt)] = prompt
         single, first = self._prefill(
             self.params, jnp.asarray(padded),
-            jnp.asarray(len(prompt), jnp.int32), self._single)
+            jnp.asarray(len(prompt), jnp.int32), self._single,
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.sample_seed & 0x7FFFFFFF, jnp.int32),
+            jnp.asarray(req.rid, jnp.int32))
         self.cache = self._write_slot(self.cache, slot, single)
         self.requests[slot] = req
         req.replica = self.name
@@ -143,10 +146,23 @@ class DecodeEngine:
         rec = flightrec_lib.recorder()
         step_name = f"serve.decode.{self.name}"
         rec.record_submit(step_name, "serve")
+        temps = np.zeros((self.slots,), np.float32)
+        seeds = np.zeros((self.slots,), np.int32)
+        rids = np.zeros((self.slots,), np.int32)
+        poss = np.zeros((self.slots,), np.int32)
+        for slot, req in enumerate(self.requests):
+            if req is None:
+                continue
+            temps[slot] = req.temperature
+            seeds[slot] = req.sample_seed & 0x7FFFFFFF
+            rids[slot] = req.rid
+            poss[slot] = len(self.generated[slot])
         try:
             logits, self.cache, next_tokens = self._decode(
                 self.params, self.cache,
-                jnp.asarray(self.last_tokens))
+                jnp.asarray(self.last_tokens), jnp.asarray(temps),
+                jnp.asarray(seeds), jnp.asarray(rids),
+                jnp.asarray(poss))
             next_np = np.asarray(next_tokens)
         except BaseException:
             rec.record_complete(step_name, outcome="error")
@@ -228,6 +244,43 @@ class DecodeEngine:
         re-prefill."""
         return kv_lib.export_slot(self.cache, slot)
 
+    def migrate_out(self, slot: int):
+        """Evict one in-flight sequence WITH its warm state: returns
+        ``(request, wire_blob, generated_tokens)`` — the int8
+        block-scaled cache export plus the host-side decode state a
+        peer needs to continue mid-sequence (the graceful-drain default,
+        docs/serve.md). The slot frees immediately; nothing completes."""
+        req = self.requests[slot]
+        if req is None:
+            raise RuntimeError(f"replica {self.name}: slot {slot} empty")
+        blob = kv_lib.export_slot(self.cache, slot)
+        generated = list(self.generated[slot])
+        self.requests[slot] = None
+        self.generated[slot] = []
+        self.cache = self._reset_slot(self.cache, slot)
+        _M_ACTIVE.dec()
+        return req, blob, generated
+
+    def admit_migrated(self, req: Request, blob: Dict[str, Any],
+                       generated, now: float = 0.0) -> int:
+        """Land a migrated sequence in a free slot: the wire blob
+        imports into the cache (``kvcache.import_slot`` — dequantized
+        through the same Pallas path) and decode continues from the
+        last generated token — no re-prefill. Same-geometry engines
+        only (the cluster's factory guarantees it)."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError(f"replica {self.name}: no free slot")
+        slot = free[0]
+        self.cache = kv_lib.import_slot(self.cache, slot, blob)
+        self.requests[slot] = req
+        req.replica = self.name
+        req.migrations += 1
+        self.generated[slot] = list(generated)
+        self.last_tokens[slot] = generated[-1] if generated else 0
+        _M_ACTIVE.inc()
+        return slot
+
     def close(self) -> None:
         """Zero this replica's labeled gauges when it leaves the
         cluster — a departed replica's cache is freed, so a stale
@@ -236,7 +289,29 @@ class DecodeEngine:
         _M_CACHE_BYTES.labels(replica=self.name).set(0)
 
 
-def _prefill_fn(model, params, tokens, length, single_cache):
+def _sample_token(row, temp, seed, rid, pos):
+    """One slot's next token: greedy argmax at ``temp == 0`` (the
+    historical deterministic default — bit-identical to the
+    pre-sampling engine), else a categorical draw from
+    ``softmax(logits / temp)`` under the per-request PRNG lane
+    ``fold_in(fold_in(PRNGKey(seed), rid), pos)``. The KEY is
+    deterministic in (seed, rid, position) alone — never the slot or
+    replica — so re-batching, slot reassignment and migration cannot
+    perturb the randomness (the event-digest repeat contract,
+    docs/serve.md). The LOGITS are the cache's: a warm migration over
+    the int8 wire carries the kvcache round-trip's bounded rounding
+    (docs/serve.md parity table), which can shift a near-tie token."""
+    row = row.astype(jnp.float32)
+    greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(seed), rid), pos)
+    sampled = jax.random.categorical(
+        key, row / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
+def _prefill_fn(model, params, tokens, length, single_cache, temp,
+                seed, rid):
     """(1, P) prompt -> (single-slot cache, first output token)."""
     logits, cache = model.apply(params, tokens, cache=single_cache)
     # Pad lines (written at positions >= length) must never be
@@ -247,15 +322,20 @@ def _prefill_fn(model, params, tokens, length, single_cache):
         "pos": jnp.full_like(cache["pos"], length),
         "slot_pos": jnp.where(sp >= length, -1, sp),
     }
-    first = jnp.argmax(logits[0, length - 1], axis=-1).astype(jnp.int32)
+    first = _sample_token(logits[0, length - 1], temp, seed, rid,
+                          jnp.zeros((), jnp.int32))
     return cache, first
 
 
-def _decode_fn(model, params, cache, last_tokens):
-    """(slots,) last tokens -> (logits, cache, greedy next tokens)."""
+def _decode_fn(model, params, cache, last_tokens, temps, seeds, rids,
+               poss):
+    """(slots,) last tokens -> (logits, cache, next tokens). Per-slot
+    sampling state (temperature / seed / rid / position) rides data
+    arrays, so every request mix shares the ONE compiled program."""
     logits, cache = model.apply(params, last_tokens[:, None],
                                 cache=cache)
-    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    nxt = jax.vmap(_sample_token)(logits[:, 0], temps, seeds, rids,
+                                  poss)
     return logits, cache, nxt
 
 

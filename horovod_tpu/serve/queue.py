@@ -49,11 +49,21 @@ class Request:
     max_new_tokens: int
     arrival_t: float = 0.0
     deadline_s: float = 0.0
+    # Sampling lane (docs/serve.md): temperature 0 = greedy argmax
+    # (the historical default — byte-identical to pre-sampling
+    # engines); > 0 samples from softmax(logits / temperature) under a
+    # per-request PRNG lane seeded by (sample_seed, rid, position) —
+    # deterministic per request regardless of batching, slot
+    # assignment, or mid-sequence migration, so the seeded-repeat
+    # event-digest contract keeps holding.
+    temperature: float = 0.0
+    sample_seed: int = 0
     # Filled at completion.
     tokens: Tuple[int, ...] = ()
     finish_t: Optional[float] = None
     replica: Optional[str] = None
     reroutes: int = 0
+    migrations: int = 0
 
     @property
     def latency_s(self) -> Optional[float]:

@@ -40,11 +40,17 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
                   prompt_lens: Sequence[int] = (4, 8, 16),
                   output_lens: Sequence[int] = (4, 8, 16, 32),
                   vocab_size: int = 128,
-                  deadline_s: float = 0.0) -> TrafficTrace:
+                  deadline_s: float = 0.0,
+                  temperature: float = 0.0) -> TrafficTrace:
     """Seeded open-loop trace: Poisson arrivals at ``rate_rps``, prompt
     and output lengths drawn uniformly from the given mixes, prompt
     tokens uniform over ``[1, vocab_size)`` (0 is reserved for pad).
-    ``deadline_s`` stamps every request with a latency budget."""
+    ``deadline_s`` stamps every request with a latency budget.
+    ``temperature`` > 0 stamps every request with that sampling
+    temperature plus a seeded per-request ``sample_seed`` (drawn from
+    this trace's own rng — the PRNG lane the engine folds with
+    (rid, position)), so a sampled trace replays byte-identically under
+    the same trace seed; 0 keeps the greedy default."""
     if n_requests < 1 or rate_rps <= 0:
         raise ValueError(
             f"need n_requests >= 1 and rate_rps > 0, got "
@@ -54,11 +60,15 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
     arrivals = np.cumsum(gaps)
     plens = rng.choice(np.asarray(prompt_lens), size=n_requests)
     olens = rng.choice(np.asarray(output_lens), size=n_requests)
+    sseeds = (rng.integers(0, 2 ** 31 - 1, size=n_requests)
+              if temperature > 0 else np.zeros(n_requests, np.int64))
     reqs = []
     for i in range(n_requests):
         prompt: Tuple[int, ...] = tuple(
             int(t) for t in rng.integers(1, vocab_size, int(plens[i])))
         reqs.append(Request(
             rid=i, prompt=prompt, max_new_tokens=int(olens[i]),
-            arrival_t=float(arrivals[i]), deadline_s=deadline_s))
+            arrival_t=float(arrivals[i]), deadline_s=deadline_s,
+            temperature=float(temperature),
+            sample_seed=int(sseeds[i])))
     return TrafficTrace(seed=seed, requests=reqs)

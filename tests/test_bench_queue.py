@@ -257,3 +257,44 @@ def test_serve_job_queued():
     assert "serve_gpt_small" in names
     argv = dict((n, a) for n, a, _ in q.JOBS)["serve_gpt_small"]
     assert "--serve" in argv
+
+
+def test_gate_record_diffs_memory_block():
+    """ISSUE 12 satellite: the per-workload gate diffs the BENCH
+    ``memory`` block — same-stage at-rest growth past the gate is a
+    regression; a cross-stage delta stays informational."""
+    base = {"workload": "train", "value": 100.0, "mfu": 30.0,
+            "platform": "tpu",
+            "memory": {"zero_stage": 1, "per_rank_at_rest_bytes": 1000,
+                       "per_rank_peak_bytes": 3000}}
+    fat = {"workload": "train", "value": 100.0, "mfu": 30.0,
+           "memory": {"zero_stage": 1, "per_rank_at_rest_bytes": 1500,
+                      "per_rank_peak_bytes": 3000}}
+    gate = q.gate_record("j", dict(fat), banked=base)
+    assert "memory" in gate["diffs"]
+    assert "memory.per_rank_at_rest_bytes" in gate["regressed"]
+    # Cross-stage: the ZeRO A/B delta is evidence, not a regression.
+    z3 = {"workload": "train", "value": 100.0, "mfu": 30.0,
+          "memory": {"zero_stage": 3, "per_rank_at_rest_bytes": 300,
+                     "per_rank_peak_bytes": 3000}}
+    gate3 = q.gate_record("j", dict(z3), banked=base)
+    assert "memory" in gate3["diffs"] and not gate3["regressed"]
+
+
+def test_bench_memory_block_shows_zero3_win():
+    """bench._memory_block: stage-3 per-rank at-rest state bytes drop
+    >=3x vs stage 1 on an 8-rank world (the acceptance number)."""
+    import numpy as np
+    import optax
+    sys.path.insert(0, q.REPO)
+    import bench
+
+    params = {"w": np.zeros((1024, 64), np.float32),
+              "b": np.zeros((64,), np.float32)}
+    inner = optax.adamw(1e-3)
+    m1 = bench._memory_block(params, inner, 1, 8, accum=2)
+    m3 = bench._memory_block(params, inner, 3, 8, accum=2)
+    assert m1["per_rank_at_rest_bytes"] >= \
+        3 * m3["per_rank_at_rest_bytes"]
+    assert m3["per_rank_at_rest"]["params"] * 8 == \
+        m1["per_rank_at_rest"]["params"]

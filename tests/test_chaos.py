@@ -79,3 +79,16 @@ def test_chaos_soak_same_seed_reproduces_sequences(tmp_path):
     assert a["sequences"] == b["sequences"], \
         "same seed must reproduce the same injection sequence"
     assert a["final_step"] == b["final_step"] == 12
+
+
+def test_chaos_zero_midstep_crash_verified_resume(tmp_path):
+    """ISSUE 12 satellite: the zero family — ZeRO-3 sharded training
+    (params + Adam state + int8_ef residual all 1/N shards) dies HARD
+    mid-step with its last finalized sharded checkpoint torn; the
+    resume walks back to the previous VERIFIED step and replays to a
+    final state byte-identical with an uninterrupted run."""
+    rec = chaos_soak.run_zero_soak(str(tmp_path), steps=8, seed=42)
+    assert rec["rc"] == 7  # the hard mid-step exit
+    assert rec["byte_identical_resume"]
+    assert rec["restored_step"] == rec["crash_step"] - 2  # walk-back
+    assert "checkpoint_corrupt" in rec["injected_sites"]

@@ -353,3 +353,136 @@ def test_lazy_namespace_exports():
     assert hvd.serve.kvcache is kv_lib
     with pytest.raises(AttributeError):
         hvd.serve.not_a_thing
+
+
+# -- warm-KV migration: the DEFAULT drain path (ISSUE 12 satellite) ----------
+
+def test_warm_kv_migration_continues_midstream(tiny):
+    """A sequence migrated with its warm cache continues decoding on
+    the peer WITHOUT re-prefill. Greedy + fp32 cache on this fixed
+    model/seed: the int8 wire round-trip's bounded rounding
+    (docs/serve.md parity table) stays below every argmax margin, so
+    the stream matches a never-migrated engine exactly — the general
+    contract is bounded deviation, byte-equality is this pinned
+    fixture's property."""
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+    src, dst = factory("rs"), factory("rd")
+    prompt = (5, 9, 3)
+    # Reference: decode 6 tokens on one engine, no migration.
+    ref_eng = factory("ref")
+    ref = Request(rid=7, prompt=prompt, max_new_tokens=6)
+    ref_eng.admit(ref)
+    while ref_eng.active_count():
+        ref_eng.step(0.0)
+    # Same request, migrated after 2 decode rounds.
+    req = Request(rid=7, prompt=prompt, max_new_tokens=6)
+    slot = src.admit(req)
+    src.step(0.0)
+    src.step(0.0)
+    moved, blob, generated = src.migrate_out(slot)
+    assert moved is req and src.active_count() == 0
+    assert len(generated) == 3  # prefill token + 2 decode rounds
+    dst.admit_migrated(req, blob, generated)
+    assert req.migrations == 1 and req.replica == "rd"
+    while dst.active_count():
+        dst.step(1.0)
+    assert req.tokens == ref.tokens, (req.tokens, ref.tokens)
+
+
+def test_drain_migrates_by_default_and_drains_immediately(tiny):
+    """drain_mode='migrate' (the default): a drain decision hands the
+    in-flight sequence to the peer WITH its warm cache — the drained
+    replica empties immediately instead of lingering until its longest
+    sequence finishes, the cluster records the migrate hop, and the
+    request completes on the peer without a re-prefill."""
+    from horovod_tpu.common.autoscale import Decision
+
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=4, max_len=64,
+                                  max_prompt_len=8)
+    pol = SLOPolicy()
+    assert pol.drain_mode == "migrate"  # the satellite's DEFAULT
+    cluster = ServeCluster(factory, policy=pol, replicas=2,
+                           step_s=0.05, log_path="")
+    req = Request(rid=0, prompt=(1, 2, 3), max_new_tokens=30)
+    cluster.submit(req)
+    for name in cluster.live():
+        cluster.batchers[name].run_step(0.0)  # admit + 1 decode round
+    holder = req.replica
+    peer = next(n for n in cluster.live() if n != holder)
+    cluster._apply(Decision(action="drain", target=holder,
+                            reason="low_occupancy"))
+    # Immediate handoff: the drained replica is empty NOW; the peer
+    # holds the sequence with its generated-so-far tokens intact.
+    assert cluster.batchers[holder].drained
+    assert req.replica == peer and req.migrations == 1
+    assert ("migrate", req.rid, holder, peer) in {
+        tuple(e[1:]) for e in cluster.events if e[1] == "migrate"}
+    now = 0.05
+    while cluster.batchers[peer].engine.active_count():
+        cluster.batchers[peer].run_step(now)
+        now += 0.05
+    assert len(req.tokens) == 30  # finished mid-stream on the peer
+    # The policy knob still admits the historical local-finish mode.
+    with pytest.raises(ValueError, match="drain_mode"):
+        SLOPolicy.from_dict({"drain_mode": "teleport"})
+
+
+# -- temperature sampling with the seeded per-request PRNG lane ---------------
+
+def test_temperature_sampling_deterministic_lane(tiny):
+    """temperature > 0 samples under fold_in(PRNGKey(seed), rid, pos):
+    the same (seed, rid) replays byte-identically, a different seed
+    draws a different stream, and temperature=0 stays bit-identical to
+    the historical greedy argmax."""
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=1, max_len=32,
+                                  max_prompt_len=8)
+
+    def decode(temp, sample_seed, rid=3):
+        eng = factory("rt")
+        req = Request(rid=rid, prompt=(2, 4, 6), max_new_tokens=8,
+                      temperature=temp, sample_seed=sample_seed)
+        eng.admit(req)
+        while eng.active_count():
+            eng.step(0.0)
+        return req.tokens
+
+    greedy1, greedy2 = decode(0.0, 0), decode(0.0, 123)
+    assert greedy1 == greedy2  # seed is inert at temperature 0
+    s1a, s1b = decode(1.0, 42), decode(1.0, 42)
+    assert s1a == s1b  # seeded repeat -> byte-identical
+    s2 = decode(1.0, 43)
+    assert s1a != s2 or s1a != greedy1  # the lane actually samples
+
+
+def test_temperature_survives_migration(tiny):
+    """The PRNG lane keys on (seed, rid, position) — never the slot or
+    replica — so migration cannot perturb the randomness; on this
+    pinned fixture the int8 cache round-trip stays below the sampling
+    margins too, so the migrated stream equals the in-place one."""
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+
+    def ref():
+        eng = factory("r0")
+        req = Request(rid=9, prompt=(1, 2, 3), max_new_tokens=6,
+                      temperature=0.9, sample_seed=77)
+        eng.admit(req)
+        while eng.active_count():
+            eng.step(0.0)
+        return req.tokens
+
+    src, dst = factory("rs"), factory("rd")
+    req = Request(rid=9, prompt=(1, 2, 3), max_new_tokens=6,
+                  temperature=0.9, sample_seed=77)
+    slot = src.admit(req)
+    src.step(0.0)
+    _, blob, generated = src.migrate_out(slot)
+    dst.admit_migrated(req, blob, generated)
+    while dst.active_count():
+        dst.step(1.0)
+    assert req.tokens == ref()

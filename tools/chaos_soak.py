@@ -1052,6 +1052,202 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
     }
 
 
+# -- the zero family (docs/zero.md) ------------------------------------------
+
+def zero_plan(seed: int, steps: int) -> dict:
+    """The zero family: a HARD MID-STEP CRASH of a ZeRO-3 sharded
+    training job (params + Adam state + int8_ef residual all live as
+    1/N shards) plus a torn final sharded checkpoint — the resume must
+    walk back to the previous VERIFIED step and replay to a final state
+    byte-identical with an uninterrupted run. ``crash_step`` is the
+    1-based training step that dies after compute, before its save."""
+    crash = max(3, steps - 2)
+    return {"seed": seed, "crash_step": crash, "faults": [
+        # Corrupt the last checkpoint the crashed run finalized
+        # (step crash-1): restore must walk back to crash-2.
+        {"site": "checkpoint_corrupt", "step": crash - 1,
+         "mode": "bitflip"},
+    ]}
+
+
+ZERO_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import json
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt_lib
+from horovod_tpu.common import integrity
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+MODE = sys.argv[3]            # crash | resume | reference
+CRASH = int(sys.argv[4])      # 1-based step that dies mid-step
+hvd.init(force_cpu_devices=4)
+ax, n = hvd.rank_axis(), hvd.size()
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((8, 16)).astype(np.float32)
+W0 = rng.standard_normal((16, 4)).astype(np.float32)
+Y = (X @ W0).astype(np.float32)
+params = {"w": np.zeros((16, 4), np.float32),
+          "b": np.zeros((4,), np.float32)}
+
+# ZeRO-3 with the quantized int8_ef descent: params, Adam state AND the
+# error-feedback residual all live as 1/n shards — exactly the state a
+# sharded checkpoint must round-trip (docs/zero.md).
+tx = hvd.ZeroOptimizer(optax.adamw(5e-2), zero_stage=3, axis_name=ax,
+                       compression="int8_ef")
+sspecs = tx.shard_specs(params)
+stspecs = tx.state_specs(params)
+
+
+def loss_fn(p, xb, yb):
+    return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+
+@hvd.spmd_step(in_specs=(P(),), out_specs=(sspecs, stspecs))
+def setup(p):
+    sh = tx.shard_params(p)
+    return sh, tx.init(sh)
+
+
+@hvd.spmd_step(in_specs=(sspecs, stspecs, P(ax), P(ax)),
+               out_specs=(sspecs, stspecs, P()))
+def step(sh, st, xb, yb):
+    full = tx.gather_params(sh)
+    l, g = jax.value_and_grad(loss_fn)(full, xb, yb)
+    sh, st = tx.update(g, st, sh)
+    return sh, st, jax.lax.pmean(l, ax)
+
+
+@hvd.spmd_step(in_specs=(sspecs,), out_specs=(P(), P()))
+def digest(sh):
+    return (tx.gather_params(sh),
+            integrity.sharded_fingerprint(sh, ax))
+
+
+ckdir = os.path.join(workdir, "zero_ckpt")
+sh, st = setup(params)
+start = 0
+if MODE == "resume":
+    # Fresh template carries the target shardings; restore_sharded
+    # loads the latest VERIFIED step (walk-back past the torn one)
+    # placing each rank's pieces on its own device — no full-param
+    # assembly on one host.
+    (restored, start) = ckpt_lib.restore_sharded(
+        {"shards": sh, "state": st}, ckdir)
+    sh, st = restored["shards"], restored["state"]
+
+loss = None
+for i in range(start + 1, TOTAL + 1):
+    sh, st, loss = step(sh, st, jnp.asarray(X), jnp.asarray(Y))
+    if MODE == "crash" and i == CRASH:
+        os._exit(7)       # mid-step: computed, never checkpointed
+    if MODE != "reference":
+        ckpt_lib.save_sharded({"shards": sh, "state": st}, ckdir,
+                              step=i, max_to_keep=TOTAL + 1)
+
+full, fp = digest(sh)
+result = {
+    "mode": MODE,
+    "restored_step": start,
+    "final_loss": float(np.asarray(jax.device_get(loss)).reshape(-1)[0]),
+    "final_w": np.asarray(
+        jax.device_get(full["w"].addressable_data(0))).tolist(),
+    "fingerprint": np.asarray(
+        jax.device_get(fp.addressable_data(0))).tolist(),
+}
+with open(os.path.join(workdir, f"result_{MODE}.json"), "w") as f:
+    json.dump(result, f)
+"""
+
+
+def run_zero_soak(workdir: str, steps: int = 8, seed: int = 42,
+                  plan: dict | None = None) -> dict:
+    """One seeded zero-family run, three phases: (1) CRASH — ZeRO-3
+    training dies hard (os._exit) mid-step, its last finalized sharded
+    checkpoint additionally torn by the fault plan; (2) RESUME — a
+    fresh process restores the latest VERIFIED sharded checkpoint
+    (walk-back) and finishes the schedule; (3) REFERENCE — the same
+    schedule uninterrupted. Acceptance: the resumed run's final params
+    and sharded fingerprint are BYTE-IDENTICAL to the reference's (the
+    EF stochastic-rounding keys are step-seeded, so the replay is
+    exact), and the walk-back actually engaged."""
+    import subprocess
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train_zero.py")
+    with open(train_py, "w") as f:
+        f.write(ZERO_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    plan = plan if plan is not None else zero_plan(seed, steps)
+    crash = int(plan["crash_step"])
+
+    def phase(mode: str, with_faults: bool):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.pop("HVD_TPU_FAULT_PLAN", None)
+        if with_faults:
+            env["HVD_TPU_FAULT_PLAN"] = json.dumps(plan)
+            env["HVD_TPU_FAULT_LOG"] = fault_log
+        return subprocess.run(
+            [sys.executable, train_py, workdir, str(steps), mode,
+             str(crash)], env=env, capture_output=True, text=True,
+            timeout=600)
+
+    p1 = phase("crash", with_faults=True)
+    assert p1.returncode == 7, \
+        f"crash phase rc={p1.returncode} (want the hard exit 7)\n" \
+        f"{p1.stdout}\n{p1.stderr}"
+    p2 = phase("resume", with_faults=False)
+    assert p2.returncode == 0, \
+        f"resume rc={p2.returncode}\n{p2.stdout}\n{p2.stderr}"
+    p3 = phase("reference", with_faults=False)
+    assert p3.returncode == 0, \
+        f"reference rc={p3.returncode}\n{p3.stdout}\n{p3.stderr}"
+
+    with open(os.path.join(workdir, "result_resume.json")) as f:
+        resumed = json.load(f)
+    with open(os.path.join(workdir, "result_reference.json")) as f:
+        reference = json.load(f)
+    # The torn step (crash-1) must have been walked back: the verified
+    # restore lands on crash-2.
+    assert resumed["restored_step"] == crash - 2, (resumed, crash)
+    assert resumed["final_w"] == reference["final_w"], \
+        "resumed ZeRO-3 trajectory diverged from the uninterrupted one"
+    assert resumed["fingerprint"] == reference["fingerprint"], \
+        "sharded fingerprint mismatch after resume"
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert "checkpoint_corrupt" in sites, \
+        f"the torn-checkpoint site never fired: {sorted(sites)}"
+    return {
+        "metric": "chaos_soak_zero",
+        "seed": seed,
+        "steps": steps,
+        "crash_step": crash,
+        "restored_step": resumed["restored_step"],
+        "rc": p1.returncode,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "final_loss": resumed["final_loss"],
+        "byte_identical_resume": True,
+        "sequences": {f"{k[0]}@{k[1]}": v
+                      for k, v in injection_sequences(log).items()},
+    }
+
+
 # -- the stall family (docs/podmon.md) ---------------------------------------
 
 def stall_plan(seed: int) -> dict:
@@ -1339,7 +1535,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
                                          "autoscale", "stall", "moe",
-                                         "serve"),
+                                         "serve", "zero"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
@@ -1363,7 +1559,12 @@ def main() -> int:
                          "queue/in-flight re-route with zero dropped "
                          "requests, the SLO controller's kill -> grow "
                          "decision sequence byte-deterministic "
-                         "(docs/serve.md)")
+                         "(docs/serve.md); "
+                         "zero = a hard mid-step crash of ZeRO-3 "
+                         "sharded training + a torn sharded "
+                         "checkpoint: the verified walk-back restores "
+                         "and the replay lands byte-identical with an "
+                         "uninterrupted run (docs/zero.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
                          "autoscale: 120, stall: 60 — their control "
@@ -1380,10 +1581,11 @@ def main() -> int:
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
             "autoscale": run_autoscale_soak,
             "stall": run_stall_soak, "moe": run_moe_soak,
-            "serve": run_serve_soak}[args.family]
+            "serve": run_serve_soak, "zero": run_zero_soak}[args.family]
     if args.steps is None:
         args.steps = {"autoscale": 120, "stall": 60,
-                      "moe": 8, "serve": 40}.get(args.family, 12)
+                      "moe": 8, "serve": 40,
+                      "zero": 8}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

@@ -313,18 +313,45 @@ def gate_record(name, payload, banked=None):
             regressed.append(field)
     if not diffs:
         return None
+    # Memory block (docs/zero.md): diff the sharding-derived per-rank
+    # state bytes. Same-zero-stage growth past the gate is a REGRESSION
+    # (the state got fatter at the same sharding); across stages the
+    # delta is the A/B evidence and stays informational.
+    new_mem, old_mem = payload.get("memory"), banked.get("memory")
+    if isinstance(new_mem, dict) and isinstance(old_mem, dict):
+        mem = {}
+        for field in ("per_rank_at_rest_bytes", "per_rank_peak_bytes"):
+            nv, ov = new_mem.get(field), old_mem.get(field)
+            if isinstance(nv, (int, float)) and ov:
+                mem[field] = {"new": nv, "banked": ov,
+                              "delta_pct": round(
+                                  (nv - ov) / abs(ov) * 100.0, 2)}
+        if mem:
+            mem["zero_stage"] = {"new": new_mem.get("zero_stage"),
+                                 "banked": old_mem.get("zero_stage")}
+            diffs["memory"] = mem
+            same_stage = (new_mem.get("zero_stage")
+                          == old_mem.get("zero_stage"))
+            at_rest = mem.get("per_rank_at_rest_bytes", {})
+            if same_stage and at_rest.get("delta_pct", 0) > GATE_PCT:
+                regressed.append("memory.per_rank_at_rest_bytes")
     gate = {"vs": rdir, "workload": workload, "diffs": diffs,
             "regressed": regressed}
     payload["gate"] = gate
+    def _pct(f):
+        d = diffs
+        for part in f.split("."):
+            d = d.get(part, {}) if isinstance(d, dict) else {}
+        v = d.get("delta_pct") if isinstance(d, dict) else None
+        return f"{v:+.1f}%" if isinstance(v, (int, float)) else "?"
+
     if regressed:
         payload["regression"] = True
         _log(f"job {name}: REGRESSION vs banked {rdir} record on "
-             + ", ".join(f"{f} ({diffs[f]['delta_pct']:+.1f}%)"
-                         for f in regressed))
+             + ", ".join(f"{f} ({_pct(f)})" for f in regressed))
     else:
         _log(f"job {name}: gate ok vs {rdir} ("
-             + ", ".join(f"{f} {d['delta_pct']:+.1f}%"
-                         for f, d in diffs.items()) + ")")
+             + ", ".join(f"{f} {_pct(f)}" for f in diffs) + ")")
     return gate
 
 
