@@ -296,6 +296,23 @@ def main():
                         "BENCH json. Default '' keeps the legacy "
                         "static-args loop ('' != off: off measures the "
                         "transfer, '' excludes it)")
+    p.add_argument("--pipeline-stages", type=int, default=0,
+                   help="pipeline-parallel stages for the gpt_* models "
+                        "(docs/pipeline.md): decoder layers split into "
+                        "N stages on a pp mesh axis, trained under the "
+                        "scan-based 1F1B schedule; 0 consults "
+                        "HVD_TPU_PP_STAGES (1 = off)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel width for the gpt_* models: "
+                        "sharded-head attention + column/row-parallel "
+                        "MLP over a tp mesh axis; 0 consults "
+                        "HVD_TPU_TP (1 = off)")
+    p.add_argument("--pp-wire", default="",
+                   choices=["", "none", "bf16", "int8"],
+                   help="stage-boundary activation/cotangent wire "
+                        "format for the pipeline schedule (int8 = "
+                        "block-scaled with straight-through VJP); "
+                        "empty consults HVD_TPU_PP_WIRE")
     p.add_argument("--zero-stage", default="auto",
                    choices=["auto", "0", "1", "2", "3"],
                    help="ZeRO stage for the optimizer (docs/zero.md): "
@@ -398,6 +415,17 @@ def main():
                 os.environ.setdefault(
                     "HVD_TPU_FORCE_CPU_DEVICES",
                     str(int(np.prod(dims))))
+    pp_req = args.pipeline_stages \
+        or int(os.environ.get("HVD_TPU_PP_STAGES", "1") or 1)
+    tp_req = args.tp or int(os.environ.get("HVD_TPU_TP", "1") or 1)
+    if (pp_req > 1 or tp_req > 1) and args._platform == "cpu":
+        # Hybrid pp/tp arm on the CPU fallback (flags or the
+        # HVD_TPU_PP_STAGES/HVD_TPU_TP knobs): force enough virtual
+        # devices that dp x pp x tp factors the world — the test
+        # tier's 8 when pp*tp fits, else exactly pp*tp (dp=1).
+        per = max(pp_req, 1) * max(tp_req, 1)
+        os.environ.setdefault("HVD_TPU_FORCE_CPU_DEVICES",
+                              str(per * max(1, 8 // per)))
 
     import horovod_tpu as hvd
 
@@ -582,6 +610,68 @@ def _route_kwargs(rt):
     """DistributedOptimizer kwargs for a _routing() config (one place
     to extend when the route grows more optimizer knobs)."""
     return {"route": rt["plan"], "op": rt["op"]} if rt else {}
+
+
+def _parallel_config(args, n):
+    """--pipeline-stages/--tp hybrid-mesh config (docs/pipeline.md):
+    {"spec", "mesh", "dp", "pp", "tp", "wire"} or None (flat arm).
+    Flags win; unset flags consult the HVD_TPU_PP_STAGES / HVD_TPU_TP /
+    HVD_TPU_PP_WIRE config knobs. A shape that does not factor the
+    live device count (or a non-gpt model) falls back to the flat arm
+    with a log line rather than failing the run. Memoized on the args
+    namespace — consulted by the model setup AND the JSON record."""
+    cached = getattr(args, "_parallel_cfg", "unset")
+    if cached != "unset":
+        return cached
+    from horovod_tpu.common import basics
+
+    cfg = basics.context().config if basics.is_initialized() else None
+    pp = args.pipeline_stages or (cfg.pp_stages if cfg else 1)
+    tp = args.tp or (cfg.tp if cfg else 1)
+    wire = args.pp_wire or (cfg.pp_wire if cfg else None) or "none"
+    if pp <= 1 and tp <= 1:
+        args._parallel_cfg = None
+        return None
+    layers = None
+    if args.model.startswith("gpt"):
+        from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
+
+        factory = {"gpt_tiny": gpt_tiny, "gpt_small": gpt_small,
+                   "gpt_medium": gpt_medium}.get(args.model)
+        if factory is not None:
+            # Module construction is a dataclass build (no params) —
+            # the geometry stays single-sourced in models/gpt.py.
+            layers = factory().num_layers
+    why = None
+    if not args.model.startswith("gpt"):
+        why = "hybrid pp/tp arms are wired for the gpt_* models"
+    elif n % max(pp, 1) or (n // pp) % max(tp, 1):
+        why = (f"pp={pp} x tp={tp} does not factor the {n}-device "
+               "world")
+    elif layers is not None and pp > 1 and layers % pp:
+        why = (f"{args.model}'s {layers} decoder layers do not divide "
+               f"into pp={pp} stages")
+    elif args.mesh_shape:
+        why = ("--mesh-shape routing and --pipeline-stages/--tp are "
+               "separate arms (the hybrid mesh carries its own dp "
+               "route)")
+    if why is not None:
+        _log(f"--pipeline-stages/--tp ignored: {why}; using the flat "
+             "arm")
+        args._parallel_cfg = None
+        return None
+    from horovod_tpu.parallel.spec import ParallelSpec
+
+    dims = {"dp": n // (pp * tp)}
+    if pp > 1:
+        dims["pp"] = pp
+    if tp > 1:
+        dims["tp"] = tp
+    spec = ParallelSpec.resolve(dims)
+    args._parallel_cfg = {
+        "spec": spec, "mesh": spec.mesh(), "dp": dims["dp"], "pp": pp,
+        "tp": tp, "wire": wire}
+    return args._parallel_cfg
 
 
 def _guard_policy(args):
@@ -1019,6 +1109,18 @@ def _run_benchmark_inner(args, n):
         if args.moe else None,
         "moe_overlap": (_moe_config(args, n) or {}).get("overlap_chunks")
         if args.moe else None,
+        # Hybrid dp x pp x tp arm (docs/pipeline.md): the resolved
+        # spec + stage-boundary wire, so the per-axis byte mix in
+        # metrics.activation_bytes_by_axis is self-describing.
+        "parallel": ((_parallel_config(args, n) or {}).get("spec")
+                     .describe()
+                     if is_gpt and _parallel_config(args, n) else None),
+        "pipeline_stages": ((_parallel_config(args, n) or {}).get("pp")
+                            if is_gpt else None),
+        "tp": ((_parallel_config(args, n) or {}).get("tp")
+               if is_gpt else None),
+        "pp_wire": ((_parallel_config(args, n) or {}).get("wire")
+                    if is_gpt else None),
     }
     if _ARM.get("memory"):
         # Sharding-derived per-rank state bytes (docs/zero.md): the
@@ -1239,6 +1341,37 @@ def _metrics_summary():
     if a2a_wire:
         out["alltoall_bytes_on_wire"] = a2a_wire
         out["alltoall_bytes_by_axis"] = a2a_axis
+    # Pipeline stage-boundary sends (docs/pipeline.md): trace-time
+    # planned bytes (ticks x payload) by wire and axis — activation
+    # bytes must land ONLY on the pp axis; the per-axis split next to
+    # bytes_by_axis is the hybrid arm's wire-mix evidence.
+    act_wire, act_axis = {}, {}
+    for s in samples("hvd_tpu_pipeline_activation_bytes_total"):
+        if not s["value"]:
+            continue
+        w = s["labels"].get("wire", "?")
+        ax = s["labels"].get("axis", "pp")
+        act_wire[w] = act_wire.get(w, 0) + s["value"]
+        act_axis.setdefault(ax, {})
+        act_axis[ax][w] = act_axis[ax].get(w, 0) + s["value"]
+    if act_wire:
+        out["activation_bytes_on_wire"] = act_wire
+        out["activation_bytes_by_axis"] = act_axis
+    # ZeRO sharded-collective bytes (docs/zero.md): the gradient
+    # reduce-scatter / param+update all-gathers by kind, wire and axis
+    # — under the hybrid arm this is the gradient half of the per-axis
+    # wire-mix evidence (axis="dp" next to the pp activation bytes).
+    zero_axis = {}
+    for s in samples("hvd_tpu_zero_gather_bytes_total"):
+        if not s["value"]:
+            continue
+        ax = s["labels"].get("axis", "?")
+        key = (f"{s['labels'].get('kind', '?')}:"
+               f"{s['labels'].get('wire', '?')}")
+        zero_axis.setdefault(ax, {})
+        zero_axis[ax][key] = zero_axis[ax].get(key, 0) + s["value"]
+    if zero_axis:
+        out["zero_bytes_by_axis"] = zero_axis
     cache = {s["labels"].get("result", "?"): s["value"]
              for s in samples("hvd_tpu_eager_cache_total")}
     lookups = sum(cache.values())
@@ -1666,6 +1799,10 @@ def _setup_gpt(args, batch_size, n):
     import horovod_tpu as hvd
     from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
 
+    par = _parallel_config(args, n)
+    if par is not None:
+        return _setup_gpt_hybrid(args, batch_size, n, par)
+
     moe = _moe_config(args, n)
     mkw = {}
     if moe:
@@ -1793,6 +1930,183 @@ def _setup_gpt(args, batch_size, n):
     run = _make_stepper(apply_loss, (params, opt_state), n, (tokens,),
                         routing=rt, state_specs=[P(), opt_specs],
                         prefetch=args.prefetch)
+    return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
+
+
+def _wrap_pp_spec(s, pp_axis="pp"):
+    """Prepend the pp axis to a shard PartitionSpec's leading dim:
+    ZeRO shard/state leaves differ across pipeline stages AND dp
+    replicas, so the round-trip assembly must split over both (a bare
+    P("dp") would broadcast stage 0's shard onto every stage)."""
+    from jax.sharding import PartitionSpec as P
+
+    parts = tuple(s)
+    if not parts or parts[0] is None:
+        return s
+    first = parts[0]
+    axes = (first,) if isinstance(first, str) else tuple(first)
+    return P((pp_axis,) + axes, *parts[1:])
+
+
+def _setup_gpt_hybrid(args, batch_size, n, par):
+    """The hybrid dp x pp (x tp) GPT arm (docs/pipeline.md): decoder
+    layers stage-stacked over the pp axis and trained under the
+    scan-based 1F1B schedule (pipeline_accumulate_gradients), heads/MLP
+    sharded over tp inside each stage, gradients reduced over dp ONLY
+    via DistributedOptimizer(parallel=spec) — or ZeRO stage-3 shards
+    PER PIPELINE STAGE under --zero-stage 3. The BENCH record's
+    ``memory`` block is computed from the per-rank resident tree (this
+    rank's stage + the shared embedding/head)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
+    from horovod_tpu.models.gpt import (param_bytes, pipeline_fns,
+                                        stack_stage_params)
+    from horovod_tpu.parallel.pipeline import (
+        pipeline_accumulate_gradients)
+    from horovod_tpu.parallel.spec import (hybrid_param_specs,
+                                           hybrid_state_specs)
+
+    spec, mesh = par["spec"], par["mesh"]
+    pp, tp, dp = par["pp"], par["tp"], par["dp"]
+    mkw = {"remat": args.remat}
+    if tp > 1:
+        mkw["tp_axis"] = "tp"
+    model = {"gpt_small": gpt_small, "gpt_medium": gpt_medium,
+             "gpt_tiny": gpt_tiny}[args.model](**mkw)
+    rng = jax.random.PRNGKey(0)
+    S = args.seq_len
+    tokens = jax.random.randint(rng, (batch_size, S + 1), 0,
+                                model.vocab_size)
+    # Init through the replicated clone: the tp param tree is
+    # byte-compatible with the dense one (_DenseMaster), so one init
+    # serves both.
+    params = jax.jit(model.clone(tp_axis=None).init)(
+        rng, tokens[:, :-1])["params"]
+    _log("model.init done")
+    stages, shared = stack_stage_params(params, pp)
+    stage_fn, pre_fn, loss_fn = pipeline_fns(model)
+    accum = max(args.accum, 1)
+    vg = pipeline_accumulate_gradients(
+        stage_fn, loss_fn, accum_steps=accum, axis_name="pp",
+        pre_fn=pre_fn, wire=par["wire"],
+        remat_policy=args.remat_policy)
+    inner = optax.adamw(1e-4, mu_dtype=jnp.bfloat16)
+    flops = _transformer_model_flops(params, model.num_layers,
+                                     model.hidden, args.seq_len)
+    rt = {"mesh": mesh, "axes": tuple(spec.dp_axes)}
+
+    zstage = 0
+    if args.zero_stage not in ("auto", "0"):
+        zstage = int(args.zero_stage)
+        if zstage in (1, 2) or pp <= 1:
+            _log(f"--zero-stage {zstage} on the hybrid arm falls back "
+                 "to 0 (per-stage sharding is wired for stage 3 under "
+                 "--pipeline-stages; stages 1/2 ride the flat arm)")
+            zstage = 0
+    if args.guard == "on":
+        _log("--guard on ignored on the hybrid arm: the carried guard "
+             "state is per-stage (agreement over dp only) — A/B guard "
+             "overhead on the flat arm")
+
+    # Per-rank resident tree: this rank's stage slice + the shared
+    # embedding/head (tp masters are replicated and sliced in-trace) —
+    # the honest basis for the memory block.
+    per_rank = ({"stages": jax.tree.map(lambda a: a[0:1], stages),
+                 "shared": shared} if pp > 1 else params)
+    mem = _memory_block(per_rank, inner, zstage, dp, accum)
+    mem["parallel"] = spec.describe()
+    mem["full_model_params_bytes"] = param_bytes(params)
+    _ARM["sharded"] = zstage
+    _ARM["memory"] = mem
+
+    if pp <= 1:
+        # tp-only arm: no pipeline axis to bind — the tp model trains
+        # under the ordinary (optionally accumulated) step with the
+        # parallel optimizer combining slice grads over tp and
+        # reducing over dp.
+        tx = hvd.DistributedOptimizer(inner, parallel=spec,
+                                      compression=args.compression,
+                                      nonfinite_policy="off")
+        opt = tx.init(params)
+
+        def loss_of(p, tb):
+            logits = model.apply({"params": p}, tb[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tb[:, 1:]).mean()
+
+        def apply_loss(state, data, pmean_axis):
+            p, op = state
+            (toks,) = data
+            if accum > 1 or args.remat_policy != "none":
+                loss, g = tx.accumulate(loss_of)(p, toks)
+            else:
+                loss, g = jax.value_and_grad(loss_of)(p, toks)
+            loss = jax.lax.pmean(loss, pmean_axis)
+            updates, op = tx.update(g, op, p)
+            return optax.apply_updates(p, updates), op, loss
+
+        run = _make_stepper(apply_loss, (params, opt), n, (tokens,),
+                            routing=rt, state_specs=[P(), P()],
+                            prefetch=args.prefetch)
+        return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
+
+    pspecs = hybrid_param_specs()
+
+    if zstage >= 3:
+        tx = hvd.ZeroOptimizer(inner, zero_stage=3, parallel=spec,
+                               compression=args.compression)
+        sspecs = [_wrap_pp_spec(s) for s in tx.shard_specs(per_rank)]
+        ospecs = jax.tree.map(_wrap_pp_spec, tx.state_specs(per_rank),
+                              is_leaf=lambda x: isinstance(x, P))
+
+        def _setup_shards(st_g, sh):
+            shd = tx.shard_params({"stages": st_g, "shared": sh})
+            return shd, tx.init(shd)
+
+        setup = jax.jit(jax.shard_map(
+            _setup_shards, mesh=mesh, in_specs=(P("pp"), P()),
+            out_specs=(sspecs, ospecs), check_vma=False))
+        shards, opt = setup(stages, shared)
+
+        def apply_loss(state, data, pmean_axis):
+            shd, op = state
+            (toks,) = data
+            full = tx.gather_params(shd)
+            loss, g = vg(full, toks[:, :-1], toks[:, 1:])
+            loss = jax.lax.pmean(loss, pmean_axis)
+            shd, op = tx.update(g, op, shd)
+            return shd, op, loss
+
+        run = _make_stepper(apply_loss, (shards, opt), n, (tokens,),
+                            routing=rt, state_specs=[sspecs, ospecs],
+                            prefetch=args.prefetch)
+        return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
+
+    tx = hvd.DistributedOptimizer(inner, parallel=spec,
+                                  compression=args.compression,
+                                  nonfinite_policy="off")
+    opt = tx.init({"stages": stages, "shared": shared})
+    ospecs = hybrid_state_specs(jax.eval_shape(lambda: opt))
+
+    def apply_loss(state, data, pmean_axis):
+        st, sh, op = state
+        (toks,) = data
+        p = {"stages": st, "shared": sh}
+        loss, g = vg(p, toks[:, :-1], toks[:, 1:])
+        loss = jax.lax.pmean(loss, pmean_axis)
+        updates, op = tx.update(g, op, p)
+        p = optax.apply_updates(p, updates)
+        return p["stages"], p["shared"], op, loss
+
+    run = _make_stepper(
+        apply_loss, (stages, shared, opt), n, (tokens,), routing=rt,
+        state_specs=[pspecs["stages"], pspecs["shared"], ospecs],
+        prefetch=args.prefetch)
     return run, "samples/s", BERT_BASELINE_PER_DEVICE, flops
 
 

@@ -69,6 +69,15 @@ from .common.integrity import (DivergenceDetector, current_loss_scale,
 from .data import (BackgroundPrefetcher, DeviceInfeed, infeed_pipeline,
                    prefetch_to_device, shard_batch)
 from .functions import allgather_object, broadcast_object, broadcast_variables
+from .parallel.pipeline import (pipeline_accumulate_gradients,
+                                pipeline_apply, pipeline_train_step_1f1b,
+                                select_last_stage)
+from .parallel.spec import ParallelSpec
+from .parallel.tensor_parallel import (column_parallel,
+                                       combine_slice_grads, row_parallel,
+                                       shard_column, shard_head_rows,
+                                       shard_heads, shard_row,
+                                       tp_attention_qkv, tp_mlp)
 from .process_set import ProcessSet
 
 __version__ = "0.1.0"
@@ -149,6 +158,21 @@ def route_mesh():
     factorization is multi-axis (shard over it to use route= plans);
     else None."""
     return _ctx().route_mesh
+
+
+def parallel_spec():
+    """The resolved hybrid :class:`ParallelSpec` from
+    ``HVD_TPU_PARALLEL`` / ``init(parallel=)`` (docs/pipeline.md) —
+    pass it EXPLICITLY to ``DistributedOptimizer(parallel=...)``; else
+    None."""
+    return _ctx().parallel_spec
+
+
+def parallel_mesh():
+    """The role-named (dp/pp/tp/ep) jax Mesh matching
+    :func:`parallel_spec` — shard_map your hybrid step over it; else
+    None."""
+    return _ctx().parallel_mesh
 
 
 def rank_axis() -> str:
@@ -505,4 +529,10 @@ __all__ = [
     "auto_shard_threshold", "should_shard_update", "DeviceInfeed",
     "prefetch_to_device", "BackgroundPrefetcher", "shard_batch",
     "infeed_pipeline", "serve",
+    "ParallelSpec", "parallel_spec", "parallel_mesh",
+    "pipeline_accumulate_gradients", "pipeline_apply",
+    "pipeline_train_step_1f1b", "select_last_stage",
+    "column_parallel", "row_parallel", "tp_mlp", "tp_attention_qkv",
+    "shard_column", "shard_row", "shard_heads", "shard_head_rows",
+    "combine_slice_grads",
 ]

@@ -63,12 +63,16 @@ _M_MOE_WIRE_IDX = metrics_lib.gauge(
     "hvd_tpu_autotune_moe_wire_index",
     "current MoE dispatch-wire candidate index "
     "(see moe_wire_candidates order; 0 = none)")
+_M_PP_WIRE_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_pp_wire_index",
+    "current pipeline stage-boundary wire candidate index "
+    "(see pp_wire_candidates order; 0 = none — docs/pipeline.md)")
 _M_CONVERGED = metrics_lib.gauge(
     "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
 _M_SAMPLES = metrics_lib.counter(
     "hvd_tpu_autotune_samples_total",
     "scored samples per configuration (config = threshold|hierarchical"
-    "|overlap|compression|route|accum|remat|shard|moe_wire)",
+    "|overlap|compression|route|accum|remat|shard|moe_wire|pp_wire)",
     labels=("config",))
 
 _MB = 1024 * 1024
@@ -94,6 +98,9 @@ class TunedPoint(NamedTuple):
     # MoE dispatch wire format ("none"/"bf16"/"int8" — docs/moe.md);
     # defaulted so pre-existing 8-positional constructions keep working.
     moe_wire: str = "none"
+    # Pipeline stage-boundary send wire ("none"/"bf16"/"int8" —
+    # docs/pipeline.md); defaulted for the same compatibility reason.
+    pp_wire: str = "none"
 
 
 def _phase_bound_accum_gate() -> bool:
@@ -208,6 +215,9 @@ class Autotuner:
                  tune_moe_wire: bool = False,
                  moe_wire_candidates: Sequence[str] = (
                      "none", "bf16", "int8"),
+                 tune_pp_wire: bool = False,
+                 pp_wire_candidates: Sequence[str] = (
+                     "none", "bf16", "int8"),
                  accum_gate: Optional[Callable[[], bool]] = None):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
@@ -272,6 +282,13 @@ class Autotuner:
         self.tune_moe_wire = tune_moe_wire
         self.moe_wire_candidates = (tuple(moe_wire_candidates)
                                     if tune_moe_wire else ("none",))
+        # The pipeline stage-boundary wire axis (docs/pipeline.md):
+        # which payload format the 1F1B activation/cotangent ppermutes
+        # carry. Same wire-bytes-vs-quantize-overhead trade as the MoE
+        # dispatch axis, on the pipeline's send family.
+        self.tune_pp_wire = tune_pp_wire
+        self.pp_wire_candidates = (tuple(pp_wire_candidates)
+                                   if tune_pp_wire else ("none",))
         self.accum_gate = accum_gate
         self._accum_pruned = False
         hs = (0, 1) if tune_hierarchical else (0,)
@@ -282,10 +299,12 @@ class Autotuner:
         rms = tuple(range(len(self.remat_candidates)))
         shs = tuple(range(len(self.shard_candidates)))
         mws = tuple(range(len(self.moe_wire_candidates)))
+        pws = tuple(range(len(self.pp_wire_candidates)))
         self._space: List[Tuple[int, ...]] = [
-            (t, h, o, c, rt, a, m, s, mw) for t in self.candidates
+            (t, h, o, c, rt, a, m, s, mw, pw) for t in self.candidates
             for h in hs for o in ovs for c in cs for rt in rs
-            for a in accs for m in rms for s in shs for mw in mws]
+            for a in accs for m in rms for s in shs for mw in mws
+            for pw in pws]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
@@ -316,6 +335,8 @@ class Autotuner:
             cols.append("shard")
         if tune_moe_wire:
             cols.append("moe_wire")
+        if tune_pp_wire:
+            cols.append("pp_wire")
         self._columns = tuple(cols)
         self._publish_metrics()
         if log_file:
@@ -403,8 +424,13 @@ class Autotuner:
             return self.moe_wire_candidates[self._cur[8]]
 
     @property
+    def current_pp_wire(self) -> str:
+        with self._tlock:
+            return self.pp_wire_candidates[self._cur[9]]
+
+    @property
     def current_full(self) -> TunedPoint:
-        """Atomic snapshot of the FULL tuned point (all 9 axes)."""
+        """Atomic snapshot of the FULL tuned point (all 10 axes)."""
         with self._tlock:
             return self._point_of(self._cur)
 
@@ -417,7 +443,8 @@ class Autotuner:
             accum=self.accum_candidates[cur[5]],
             remat=self.remat_candidates[cur[6]],
             shard=self.shard_candidates[cur[7]],
-            moe_wire=self.moe_wire_candidates[cur[8]])
+            moe_wire=self.moe_wire_candidates[cur[8]],
+            pp_wire=self.pp_wire_candidates[cur[9]])
 
     @property
     def done(self) -> bool:
@@ -486,7 +513,8 @@ class Autotuner:
                 f"|{self.route_candidates[point[4]]}"
                 f"|{self.accum_candidates[point[5]]}"
                 f"|{self.remat_candidates[point[6]]}|{int(point[7])}"
-                f"|{self.moe_wire_candidates[point[8]]}")
+                f"|{self.moe_wire_candidates[point[8]]}"
+                f"|{self.pp_wire_candidates[point[9]]}")
 
     def _publish_metrics(self) -> None:
         """Mirror the live point into the metrics registry (called with
@@ -500,6 +528,7 @@ class Autotuner:
         _M_REMAT_IDX.set(self._cur[6])
         _M_SHARD.set(self.shard_candidates[self._cur[7]])
         _M_MOE_WIRE_IDX.set(self._cur[8])
+        _M_PP_WIRE_IDX.set(self._cur[9])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
     def _row(self, point: Tuple[int, ...]) -> List:
@@ -523,6 +552,8 @@ class Autotuner:
             row.append(self.shard_candidates[point[7]])
         if self.tune_moe_wire:
             row.append(self.moe_wire_candidates[point[8]])
+        if self.tune_pp_wire:
+            row.append(self.pp_wire_candidates[point[9]])
         return row
 
     def _log(self, point: Tuple[int, ...], score: float) -> None:
@@ -549,7 +580,8 @@ class Autotuner:
         return [math.log2(point[0]), 2.0 * point[1], 2.0 * point[2],
                 2.0 * point[3], 2.0 * point[4],
                 math.log2(max(self.accum_candidates[point[5]], 1)),
-                2.0 * point[6], 2.0 * point[7], 2.0 * point[8]]
+                2.0 * point[6], 2.0 * point[7], 2.0 * point[8],
+                2.0 * point[9]]
 
     def _maybe_prune_accum(self) -> None:
         """One-shot accumulation-space pruning, decided at the FIRST
@@ -639,7 +671,9 @@ class Autotuner:
                     + (", zero_stage=%s" % self.shard_candidates[best[7]]
                        if self.tune_shard else "")
                     + (", moe_wire=%s" % self.moe_wire_candidates[best[8]]
-                       if self.tune_moe_wire else ""),
+                       if self.tune_moe_wire else "")
+                    + (", pp_wire=%s" % self.pp_wire_candidates[best[9]]
+                       if self.tune_pp_wire else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

@@ -118,6 +118,24 @@ class Context:
             logger.warning(
                 "mesh shape invalid for this topology (%s); routing "
                 "falls back to the flat axis", e)
+        # Hybrid parallelism spec (docs/pipeline.md): role-named mesh
+        # (dp/pp/tp/ep) from HVD_TPU_PARALLEL / init(parallel=). The
+        # spec itself is consumed EXPLICITLY by the optimizer surfaces
+        # (parallel=) and the tools — the Context only resolves and
+        # publishes it (hvd.parallel_spec()/hvd.parallel_mesh()).
+        self.parallel_spec = None
+        self.parallel_mesh = None
+        if config.parallel:
+            from ..parallel.spec import ParallelSpec
+
+            try:
+                spec = ParallelSpec.resolve(config.parallel)
+                self.parallel_mesh = spec.mesh(topo.devices)
+                self.parallel_spec = spec
+            except ValueError as e:
+                logger.warning(
+                    "parallel spec invalid for this topology (%s); "
+                    "hybrid parallelism disabled", e)
 
         self.timeline = Timeline(config.timeline_filename,
                                  config.timeline_mark_cycles)

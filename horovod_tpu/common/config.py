@@ -125,6 +125,22 @@ class Config:
     # "2x4" = 2 hosts x 4 chips; also read pre-init by
     # topology.mesh_shape_from_env so tools can consume it directly).
     mesh_shape: Optional[str] = None
+    # Hybrid dp x pp x tp parallelism on one mesh (docs/pipeline.md).
+    # `parallel` is the ParallelSpec form ("dp=2,pp=2,tp=2", slow axis
+    # first; init(parallel=) also takes a role dict) the Context
+    # resolves into hvd.parallel_spec()/hvd.parallel_mesh(). The
+    # optimizer surfaces take the spec EXPLICITLY (parallel=) — an env
+    # knob must never rename the reduction axes of existing call
+    # sites; bench/tools read this and pass it through.
+    parallel: Optional[str] = None
+    # Stage-boundary activation/cotangent wire format for the pipeline
+    # schedule (parallel/pipeline.py): "none" | "bf16" | "int8"
+    # (block-scaled, straight-through VJP — the MoE-dispatch pattern).
+    pp_wire: Optional[str] = None
+    # Tool defaults for the hybrid mesh shape (bench --pipeline-stages
+    # / --tp consult these when the flags are unset; 1 = off).
+    pp_stages: int = 1
+    tp: int = 1
     # Adasum scalar precision (reference keeps fp64 scalars, adasum.h).
     adasum_scalar_dtype: str = "float32"
     # Compression for the wire format of eager collectives.
@@ -270,6 +286,10 @@ class Config:
         c.overlap_xla_flags = _env_bool("OVERLAP_XLA_FLAGS", False)
         c.route = _env("ROUTE")
         c.mesh_shape = _env("MESH_SHAPE")
+        c.parallel = _env("PARALLEL")
+        c.pp_wire = _env("PP_WIRE")
+        c.pp_stages = _env_int("PP_STAGES", cls.pp_stages)
+        c.tp = _env_int("TP", cls.tp)
         c.adasum_scalar_dtype = _env(
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")

@@ -5,7 +5,8 @@ VGG, Inception V3 — the reference's benchmark families
 
 from .bert import bert_base, bert_large, bert_tiny  # noqa: F401
 from .gpt import (GPT, gpt_medium, gpt_small, gpt_tiny,  # noqa: F401
-                  init_kv_cache, rope)
+                  init_kv_cache, param_bytes, pipeline_fns, rope,
+                  stack_stage_params)
 from .inception import InceptionV3  # noqa: F401
 from .mlp import MLP, ConvNet  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401

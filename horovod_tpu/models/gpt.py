@@ -145,15 +145,73 @@ class MoeMlp(nn.Module):
         return y.reshape(b, s, h).astype(x.dtype)
 
 
+class _DenseMaster(nn.Module):
+    """Master (replicated, full-shape) kernel + bias with nn.Dense's
+    param names, shapes, and initializers, returned RAW so the
+    tensor-parallel path can slice them per rank (docs/pipeline.md):
+    the param tree stays byte-compatible with the dense path, so one
+    checkpoint (and one ``model.init``) serves both the replicated and
+    the tp-sharded apply."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (in_features, self.features), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (self.features,),
+                       jnp.float32)
+        return k, b
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     attend_fn: Optional[Callable] = None
+    # Megatron-style sharded-head attention (docs/pipeline.md): heads
+    # shard over this mesh axis — column-parallel fused QKV
+    # (parallel/tensor_parallel.shard_heads), local attention on the
+    # head subset, row-parallel output projection (ONE allreduce per
+    # block). Params stay replicated masters sliced in-trace, so the
+    # tree matches the dense path and DistributedOptimizer's tp
+    # slice-grad combine (combine_slice_grads) reassembles exactly.
+    # The incremental (serve cache) path ignores the axis: serving
+    # replicas are whole-model by construction (docs/serve.md).
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_ctx=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
+        if self.tp_axis and cache is None:
+            from ..parallel import tensor_parallel as tp_lib
+
+            ntp = jax.lax.axis_size(self.tp_axis)
+            heads_l = self.num_heads // ntp
+            qkv_k, qkv_b = _DenseMaster(3 * h, name="qkv")(h)
+            w3 = tp_lib.shard_heads(qkv_k, self.num_heads,
+                                    self.tp_axis, fused=3)
+            b3 = tp_lib.shard_heads(qkv_b, self.num_heads,
+                                    self.tp_axis, fused=3)
+            xd = x.astype(self.dtype)
+
+            def proj(i):
+                w = w3[:, i].reshape(h, heads_l * head_dim)
+                bb = b3[i].reshape(heads_l * head_dim)
+                y = xd @ w.astype(self.dtype) + bb.astype(self.dtype)
+                return y.reshape(b, s, heads_l, head_dim)
+
+            q = rope(proj(0), positions)
+            k = rope(proj(1), positions)
+            v = proj(2)
+            attend = self.attend_fn or _causal_attend
+            o = attend(q, k, v).reshape(b, s, heads_l * head_dim)
+            out_k, out_b = _DenseMaster(h, name="out")(h)
+            w_loc = tp_lib.shard_head_rows(out_k, self.num_heads,
+                                           self.tp_axis)
+            return tp_lib.row_parallel(o, w_loc.astype(self.dtype),
+                                       self.tp_axis,
+                                       out_b.astype(self.dtype))
         qkv = nn.Dense(3 * h, dtype=self.dtype, param_dtype=jnp.float32,
                        name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -197,6 +255,11 @@ class DecoderLayer(nn.Module):
     moe_wire: str = "none"
     moe_overlap_chunks: int = 1
     moe_router_noise: float = 0.0
+    # Tensor-parallel mesh axis (docs/pipeline.md): sharded-head
+    # attention + the paired column/row-parallel dense MLP (one
+    # allreduce per block). Composes with the MoE expert axis — tp
+    # shards the attention while ep routes the FFN tokens.
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_ctx=None):
@@ -204,11 +267,13 @@ class DecoderLayer(nn.Module):
         if cache is not None:
             a, cache = CausalSelfAttention(
                 self.num_heads, self.dtype, self.attend_fn,
+                tp_axis=self.tp_axis,
                 name="attn")(y, positions, cache, cache_ctx)
             x = x + a
         else:
             x = x + CausalSelfAttention(self.num_heads, self.dtype,
                                         self.attend_fn,
+                                        tp_axis=self.tp_axis,
                                         name="attn")(y, positions)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.moe_experts:
@@ -218,6 +283,23 @@ class DecoderLayer(nn.Module):
                              self.moe_wire, self.moe_overlap_chunks,
                              self.moe_router_noise,
                              name="moe")(y)
+        elif self.tp_axis:
+            from ..parallel import tensor_parallel as tp_lib
+
+            k1, b1 = _DenseMaster(self.mlp_dim,
+                                  name="mlp_in")(x.shape[-1])
+            k2, b2 = _DenseMaster(x.shape[-1],
+                                  name="mlp_out")(self.mlp_dim)
+            y = tp_lib.tp_mlp(
+                y.astype(self.dtype),
+                tp_lib.shard_column(k1.astype(self.dtype),
+                                    self.tp_axis),
+                tp_lib.shard_column(b1.astype(self.dtype),
+                                    self.tp_axis),
+                tp_lib.shard_row(k2.astype(self.dtype), self.tp_axis),
+                b2.astype(self.dtype), self.tp_axis,
+                activation=nn.gelu)
+            out = x + y
         else:
             y = nn.Dense(self.mlp_dim, dtype=self.dtype,
                          param_dtype=jnp.float32, name="mlp_in")(y)
@@ -258,6 +340,12 @@ class GPT(nn.Module):
     moe_wire: str = "none"
     moe_overlap_chunks: int = 1
     moe_router_noise: float = 0.0
+    # Tensor-parallel mesh axis (docs/pipeline.md): heads + MLP width
+    # shard over ``tp`` inside every decoder layer, params stay
+    # replicated masters sliced in-trace — the tree matches the dense
+    # model, so one init/checkpoint serves both and
+    # ``DistributedOptimizer(parallel=...)`` reassembles slice grads.
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, cache=None):
@@ -289,6 +377,7 @@ class GPT(nn.Module):
                               self.moe_route, self.moe_wire,
                               self.moe_overlap_chunks,
                               self.moe_router_noise,
+                              tp_axis=self.tp_axis,
                               name=f"layer{i}")
             if cache is not None:
                 x, lc = layer(x, positions, cache["layers"][i],
@@ -334,6 +423,106 @@ def gpt_tiny(**kw):
                  ("dtype", jnp.float32)):
         kw.setdefault(k, v)
     return GPT(**kw)
+
+
+def param_bytes(params) -> int:
+    """Total bytes of a param tree (real arrays or ShapeDtypeStructs) —
+    the number the hybrid acceptance test compares against the
+    single-replica budget (docs/pipeline.md)."""
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(np.prod(getattr(leaf, "shape", ()))) \
+            * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def stack_stage_params(params, num_stages: int):
+    """Split a GPT param tree (``init(...)["params"]``) into the hybrid
+    pipeline layout (docs/pipeline.md):
+
+    Returns ``(stages, shared)``: ``stages`` is the decoder layers
+    stacked STAGE-MAJOR — every leaf gains a leading
+    ``(num_stages, layers_per_stage)`` pair, so ``in_specs=P("pp")``
+    shards stage ``s``'s layers onto pp rank ``s`` — and ``shared`` is
+    the replicated remainder (``tok_emb`` + ``final_ln``), consumed by
+    ``pipeline_fns``'s pre/loss closures at the two pipeline ends.
+    Raises when the layer count does not divide into stages."""
+    layer_keys = sorted((k for k in params if k.startswith("layer")),
+                        key=lambda k: int(k[len("layer"):]))
+    n_layers = len(layer_keys)
+    if num_stages < 1 or n_layers % num_stages:
+        raise ValueError(
+            f"{n_layers} decoder layers do not divide into "
+            f"{num_stages} pipeline stages")
+    lps = n_layers // num_stages
+    per_stage = []
+    for s in range(num_stages):
+        chunk = [params[layer_keys[s * lps + j]] for j in range(lps)]
+        per_stage.append(jax.tree.map(lambda *a: jnp.stack(a), *chunk))
+    stages = jax.tree.map(lambda *a: jnp.stack(a), *per_stage)
+    shared = {k: v for k, v in params.items()
+              if not k.startswith("layer")}
+    return stages, shared
+
+
+def pipeline_fns(model: GPT):
+    """The ``(stage_fn, pre_fn, loss_fn)`` closures that plug a GPT
+    into ``parallel.pipeline.pipeline_accumulate_gradients``
+    (docs/pipeline.md):
+
+    - ``stage_fn(stage_params, x)`` applies the owned decoder layers in
+      sequence. Leaves carry the ``stack_stage_params`` layout
+      ``(local_stages, layers_per_stage, ...)`` — under ``in_specs=
+      P("pp")`` each pp rank holds ``(1, lps, ...)`` and runs its one
+      stage; the SAME closure applied to the full stacked tree runs the
+      whole chain (the single-program reference the bitwise test pins
+      against). Carries the model's ``tp_axis``/MoE fields, so tensor
+      and expert parallelism run INSIDE each stage.
+    - ``pre_fn(shared, tokens)`` is the stage-0 input: the embedding
+      lookup (same math as the model's ``tok_emb`` path).
+    - ``loss_fn(shared, out, targets)`` is the last-stage loss: final
+      LayerNorm + weight-tied LM head (bf16 operands, fp32
+      accumulation — the model's own head recipe) + mean next-token
+      cross-entropy.
+
+    The closures recompute from stored inputs under 1F1B, so they must
+    be deterministic — they are (no dropout in this decoder)."""
+    layer = DecoderLayer(model.num_heads, model.mlp_dim, model.dtype,
+                         model.attend_fn, model.moe_experts,
+                         model.moe_capacity_factor, model.moe_axis,
+                         model.moe_route, model.moe_wire,
+                         model.moe_overlap_chunks,
+                         model.moe_router_noise,
+                         tp_axis=model.tp_axis)
+
+    def stage_fn(stage_params, x):
+        local_stages, lps = jax.tree.leaves(stage_params)[0].shape[:2]
+        for i in range(local_stages):
+            for j in range(lps):
+                lp = jax.tree.map(lambda a: a[i, j], stage_params)
+                x = layer.apply({"params": lp}, x)
+        return x
+
+    def pre_fn(shared, tokens):
+        return shared["tok_emb"]["embedding"][tokens].astype(
+            model.dtype)
+
+    def loss_fn(shared, out, targets):
+        ln = nn.LayerNorm(dtype=model.dtype, param_dtype=jnp.float32)
+        x = ln.apply({"params": shared["final_ln"]}, out)
+        emb = shared["tok_emb"]["embedding"]
+        logits = jax.lax.dot_general(
+            x.astype(model.dtype), emb.astype(model.dtype),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(logp, targets[..., None],
+                                 axis=-1)[..., 0]
+        return -ll.mean()
+
+    return stage_fn, pre_fn, loss_fn
 
 
 def init_kv_cache(model: GPT, slots: int, max_len: int,

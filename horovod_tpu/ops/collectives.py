@@ -307,6 +307,26 @@ def _ppermute_wire(chunk, axis_name: str, perm, wire: str, key,
     return lax.ppermute(chunk, axis_name, perm)
 
 
+def wired_ppermute(x, axis_name: str, perm, wire: str = "none",
+                   key=None, use_pallas=None):
+    """One ``lax.ppermute`` hop in a wire format — the public
+    stage-boundary send of the pipeline schedule (parallel/pipeline.py,
+    docs/pipeline.md): ``none`` = native dtype, ``bf16`` = cast around
+    the permute (2x fewer bytes), ``int8`` = block-scaled payload +
+    fp32 scales with a STRAIGHT-THROUGH gradient (cotangents ride the
+    inverse permutation in the same wire — the MoE-dispatch VJP
+    pattern, so autodiff through a quantized activation send keeps the
+    gradient flowing). Integer payloads always ride uncompressed.
+    ``key`` makes int8 roundings stochastic (unbiased)."""
+    if wire not in _WIRES:
+        raise ValueError(f"unknown wire format {wire!r}; choose from "
+                         f"{_WIRES}")
+    if wire != "none" and not jnp.issubdtype(x.dtype, jnp.floating):
+        wire = "none"
+    return _ppermute_wire(x, axis_name, list(perm), wire, key,
+                          use_pallas)
+
+
 def alltoallv_chunked(x, splits_matrix, axis_name: str = "hvd",
                       wire: str = "none", key=None, use_pallas=None):
     """Uneven all-to-all with per-HOP padding — the bounded-wire-bytes

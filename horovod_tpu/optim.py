@@ -207,6 +207,36 @@ def _resolve_route(route, local_axis: str = "local",
     return C.WirePlan.resolve(route, local_axis, cross_axis)
 
 
+def _resolve_parallel(parallel):
+    """Coerce a ``parallel=`` value (ParallelSpec / dict / spec string)
+    — EXPLICIT-ONLY, deliberately no ``HVD_TPU_PARALLEL`` consult here:
+    the spec renames the reduction axes (``hvd`` -> ``dp``) and an env
+    knob must never re-route existing call sites' collectives onto
+    axes their mesh does not bind (the same contract as ``route=`` on
+    the sharded surfaces). ``HVD_TPU_PARALLEL`` / ``init(parallel=)``
+    feed the Context's mesh (``hvd.parallel_spec()``) and the tools,
+    which pass the spec explicitly."""
+    if parallel is None:
+        return None
+    from .parallel.spec import ParallelSpec
+
+    return ParallelSpec.resolve(parallel)
+
+
+def _combine_tp(grads, tp_axis: str):
+    """pmean-combine tensor-parallel slice gradients
+    (tensor_parallel.combine_slice_grads) ahead of the dp reduction.
+    Resolved at TRACE time: when the tp axis is not bound, the model
+    necessarily ran unsharded in this trace, the grads are already
+    exact, and the combine is correctly skipped (the single-device
+    debug path)."""
+    if not _axes_bound(tp_axis):
+        return grads
+    from .parallel.tensor_parallel import combine_slice_grads
+
+    return combine_slice_grads(grads, tp_axis)
+
+
 def _axes_bound(*axes) -> bool:
     """True iff all mesh axis names are bound in the current trace (i.e. we
     are inside shard_map/pmap over them). Probed once, narrowly, so a
@@ -781,7 +811,8 @@ def DistributedOptimizer(optimizer,
                          route=None,
                          accum_steps: Optional[int] = None,
                          remat_policy: Optional[str] = None,
-                         zero_stage: int = 0):
+                         zero_stage: int = 0,
+                         parallel=None):
     """Wrap an optax optimizer so ``update()`` allreduces gradients first.
 
     Use inside the jitted step function running under
@@ -879,11 +910,63 @@ def DistributedOptimizer(optimizer,
     chained bucket routes independently). Supersedes the legacy
     ``hierarchical``/``quantized_cross`` booleans — passing both
     raises.
+
+    ``parallel`` (EXPLICIT-ONLY — a :class:`~.parallel.spec.
+    ParallelSpec`, role dict, or spec string like ``"dp=2,pp=2,tp=2"``;
+    docs/pipeline.md) declares HYBRID dp x pp x tp parallelism on one
+    mesh: gradients then reduce over the ``dp`` axis ONLY (pipeline
+    stages own disjoint params — their activation sends ride the pp
+    axis, not the gradient reduction; tensor-parallel slice gradients
+    are pmean-combined over ``tp`` first via
+    ``tensor_parallel.combine_slice_grads``), and the non-finite guard
+    agrees over the ``dp`` axis only (each stage guards its own
+    params). Feed it the gradients of
+    ``parallel.pipeline.pipeline_accumulate_gradients`` (the 1F1B
+    schedule with the same ``(value, grads)`` contract as
+    ``accumulate``). Composes with ``compression``/``overlap``/
+    ``zero_stage`` (ZeRO shard grids then span the dp axis, so
+    stage-2/3 shards live PER PIPELINE STAGE); supersedes
+    ``axis_name``/``route`` — passing an explicit route alongside
+    raises unless its axes are exactly the spec's dp axes.
     """
     try:
         import optax
     except ImportError as e:  # pragma: no cover
         raise ImportError("DistributedOptimizer requires optax") from e
+
+    pspec = _resolve_parallel(parallel)
+    tp_combine_axis = None
+    if pspec is not None:
+        if hierarchical or quantized_cross:
+            raise ValueError(
+                "parallel= supersedes the hierarchical/quantized_cross "
+                "booleans — wires on the dp reduction come from route= "
+                "(a WirePlan over the spec's dp axes)")
+        if route is not None:
+            rt = C.WirePlan.resolve(route)
+            if rt is not None and set(rt.axis_names) != set(
+                    pspec.dp_axes):
+                raise ValueError(
+                    f"route axes {rt.axis_names} must be exactly the "
+                    f"parallel spec's dp axes {pspec.dp_axes} — "
+                    "gradients reduce over dp only (activation traffic "
+                    "rides the pp axis; tp combines via pmean)")
+        if not pspec.dp_axes:
+            raise ValueError(
+                f"parallel spec {pspec.describe()!r} has no dp axis — "
+                "with nothing to reduce over, wrap the optimizer "
+                "directly (pure pp x tp runs need no "
+                "DistributedOptimizer)")
+        axis_name = pspec.dp_axes[0]
+        if route is None:
+            # Reduce through the mesh router over the dp axis (not the
+            # flat psum): the router stamps the per-axis byte counters
+            # (hvd_tpu_allreduce_bytes_total{axis="dp"}) that prove the
+            # schedule's wire mix, and it pins the plan so the
+            # HVD_TPU_ROUTE default (which names local/cross axes this
+            # mesh does not bind) can never apply.
+            route = pspec.grad_route()
+        tp_combine_axis = pspec.tp_axis
 
     if zero_stage:
         # The one-line ZeRO surface (docs/zero.md): stage 1 = sharded
@@ -917,7 +1000,7 @@ def DistributedOptimizer(optimizer,
             compression=compression, nonfinite_policy=nonfinite_policy,
             route=route, accum_steps=accum_steps,
             remat_policy=remat_policy, overlap=True,
-            bucket_order=bucket_order)
+            bucket_order=bucket_order, parallel=pspec)
 
     compression = _resolve_compression(compression)
     _check_reduce_safe(compression)
@@ -1043,6 +1126,19 @@ def DistributedOptimizer(optimizer,
             return updates, _GuardedState(new_inner, new_guard)
 
     def _finish(init_f, update_f):
+        if tp_combine_axis is not None:
+            # Tensor-parallel slice grads reassemble (pmean over tp)
+            # BEFORE everything downstream — the dp reduction, the
+            # guard's finite check, and the legacy k>1 accumulator all
+            # see exact gradients (pmean is linear, so combining ahead
+            # of accumulation is equivalent).
+            inner_update_f = update_f
+
+            def update_f(grads, state, params=None, **extra):  # noqa: F811
+                return inner_update_f(_combine_tp(grads,
+                                                  tp_combine_axis),
+                                      state, params, **extra)
+
         return AccumGradientTransformation(
             init_f, update_f, accum_k, remat_name)
 
@@ -1371,6 +1467,10 @@ class AutotunedStepper:
         # rides the whole-TunedPoint build signature — the build fn
         # threads pt.moe_wire into its moe_layer/MoeMlp construction.
         self._joint_moe_wire = getattr(tuner, "tune_moe_wire", False)
+        # Pipeline stage-boundary wire axis (docs/pipeline.md): same
+        # whole-TunedPoint contract — the build fn threads pt.pp_wire
+        # into its pipeline_accumulate_gradients(wire=) construction.
+        self._joint_pp_wire = getattr(tuner, "tune_pp_wire", False)
         self._hier = (tuner.current_hierarchical if self._joint else False)
         self._ovl = (tuner.current_overlap if self._joint_overlap
                      else False)
@@ -1385,6 +1485,8 @@ class AutotunedStepper:
                        else 0)  # ZeRO stage, 0 = replicated
         self._moe_wire = (tuner.current_moe_wire
                           if self._joint_moe_wire else "none")
+        self._pp_wire = (tuner.current_pp_wire
+                         if self._joint_pp_wire else "none")
         self._step = self._rebuild()
         self.rebuilds = 0
         self._step_count = 0  # metrics/profiler step numbering
@@ -1392,7 +1494,8 @@ class AutotunedStepper:
     @property
     def _mfu_joint(self) -> bool:
         return (self._joint_accum or self._joint_remat
-                or self._joint_shard or self._joint_moe_wire)
+                or self._joint_shard or self._joint_moe_wire
+                or self._joint_pp_wire)
 
     def _rebuild(self):
         if self._mfu_joint:
@@ -1402,7 +1505,8 @@ class AutotunedStepper:
                 threshold=self._threshold, hierarchical=self._hier,
                 overlap=self._ovl, compression=self._comp,
                 route=self._route, accum=self._accum, remat=self._remat,
-                shard=self._shard, moe_wire=self._moe_wire))
+                shard=self._shard, moe_wire=self._moe_wire,
+                pp_wire=self._pp_wire))
         if self._joint_route:
             return self._build(self._threshold, self._hier, self._ovl,
                                self._comp, self._route)
@@ -1452,6 +1556,10 @@ class AutotunedStepper:
     def moe_wire(self) -> str:
         return self._moe_wire
 
+    @property
+    def pp_wire(self) -> str:
+        return self._pp_wire
+
     def __call__(self, *args, **kwargs):
         import time
 
@@ -1479,15 +1587,17 @@ class AutotunedStepper:
             new_s = pt.shard if self._joint_shard else self._shard
             new_w = pt.moe_wire if self._joint_moe_wire \
                 else self._moe_wire
+            new_pw = pt.pp_wire if self._joint_pp_wire \
+                else self._pp_wire
         else:
             if c.rank == 0:
                 self.tuner.record(self.grad_bytes, dt)
             self._calls += 1
             (new, new_h, new_o, new_c, new_r, new_a, new_m, new_s,
-             new_w) = (
+             new_w, new_pw) = (
                 self._threshold, self._hier, self._ovl, self._comp,
                 self._route, self._accum, self._remat, self._shard,
-                self._moe_wire)
+                self._moe_wire, self._pp_wire)
             if self._calls % self._period == 0 and not self._tuner_done:
                 # Sample boundary — same call index on every process
                 # (SPMD lockstep), so the exchange is synchronous. After
@@ -1505,6 +1615,7 @@ class AutotunedStepper:
                         f"|{cur.remat if self._joint_remat else 'none'}"
                         f"|{int(cur.shard) if self._joint_shard else 0}"
                         f"|{cur.moe_wire if self._joint_moe_wire else 'none'}"
+                        f"|{cur.pp_wire if self._joint_pp_wire else 'none'}"
                         + (":done" if c.rank == 0 and self.tuner.done
                            else ""))
                 vals = c.exchange("autotune_threshold", mine)
@@ -1513,7 +1624,7 @@ class AutotunedStepper:
                     self._tuner_done = True
                     v0 = v0[:-5]
                 (t_str, h_str, o_str, c_str, r_str, a_str, m_str,
-                 s_str, w_str) = v0.split("|")
+                 s_str, w_str, pw_str) = v0.split("|")
                 new = int(t_str)
                 new_h = bool(int(h_str)) if self._joint else self._hier
                 new_o = bool(int(o_str)) if self._joint_overlap \
@@ -1526,15 +1637,18 @@ class AutotunedStepper:
                     else self._shard
                 new_w = w_str if self._joint_moe_wire \
                     else self._moe_wire
+                new_pw = pw_str if self._joint_pp_wire \
+                    else self._pp_wire
         if (new != self._threshold or new_h != self._hier
                 or new_o != self._ovl or new_c != self._comp
                 or new_r != self._route or new_a != self._accum
                 or new_m != self._remat or new_s != self._shard
-                or new_w != self._moe_wire):
+                or new_w != self._moe_wire or new_pw != self._pp_wire):
             (self._threshold, self._hier, self._ovl, self._comp,
              self._route, self._accum, self._remat, self._shard,
-             self._moe_wire) = (new, new_h, new_o, new_c, new_r, new_a,
-                                new_m, new_s, new_w)
+             self._moe_wire, self._pp_wire) = (
+                new, new_h, new_o, new_c, new_r, new_a, new_m, new_s,
+                new_w, new_pw)
             self._step = self._rebuild()
             self.rebuilds += 1
             _M_REBUILDS.inc()
@@ -2539,7 +2653,8 @@ class ZeroOptimizer:
                  nonfinite_policy: Optional[str] = None,
                  route=None, accum_steps: Optional[int] = None,
                  remat_policy: Optional[str] = None,
-                 overlap: bool = True, bucket_order=None):
+                 overlap: bool = True, bucket_order=None,
+                 parallel=None):
         stage = int(zero_stage)
         if stage not in (1, 2, 3):
             raise ValueError(
@@ -2547,6 +2662,37 @@ class ZeroOptimizer:
                 "(0/off = the replicated DistributedOptimizer)")
         if grad_op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
             raise ValueError("ZeroOptimizer supports SUM/AVERAGE")
+        # Hybrid parallelism (docs/pipeline.md): the spec pins the shard
+        # grid + reduction to the dp axis ONLY, so ZeRO shards live PER
+        # PIPELINE STAGE (each pp/tp coordinate forms its own dp shard
+        # group) and the guard agrees over dp only; tp slice grads are
+        # pmean-combined before every reduce-scatter.
+        self._tp_axis = None
+        pspec = _resolve_parallel(parallel)
+        if pspec is not None:
+            if not pspec.dp_axes:
+                raise ValueError(
+                    f"parallel spec {pspec.describe()!r} has no dp axis "
+                    "— ZeRO shards gradient/optimizer/param state over "
+                    "the data-parallel replicas; a pure pp x tp spec "
+                    "has nothing to shard over")
+            if route is not None:
+                rt = C.WirePlan.resolve(route)
+                if rt is not None and set(rt.axis_names) != set(
+                        pspec.dp_axes):
+                    raise ValueError(
+                        f"route axes {rt.axis_names} must be exactly "
+                        f"the parallel spec's dp axes {pspec.dp_axes}")
+            else:
+                # Shard and reduce through the mesh router over the dp
+                # axis (mirroring DistributedOptimizer's parallel=
+                # default): the router stamps the per-axis byte
+                # counters (hvd_tpu_zero_gather_bytes_total /
+                # hvd_tpu_allreduce_bytes_total axis="dp") that prove
+                # the hybrid schedule's wire mix.
+                route = pspec.grad_route()
+            axis_name = pspec.dp_axes[0]
+            self._tp_axis = pspec.tp_axis
         self.zero_stage = stage
         self.inner = inner
         self.axis_name = axis_name
@@ -2584,6 +2730,15 @@ class ZeroOptimizer:
 
     def _live_route(self):
         return _sharded_route(self.route, self.axis_name)
+
+    def _maybe_combine_tp(self, grads):
+        """Reassemble tensor-parallel slice gradients (pmean over tp)
+        before a full-gradient tree enters any reduce-scatter — no-op
+        without a parallel spec, or when the tp axis is unbound in this
+        trace (the model then ran unsharded and grads are exact)."""
+        if self._tp_axis is None:
+            return grads
+        return _combine_tp(grads, self._tp_axis)
 
     def _require_route_axes(self, route, what: str) -> None:
         if route is not None:
@@ -2809,7 +2964,11 @@ class ZeroOptimizer:
             if _is_shard_grads(grads, like=params):
                 return self._update_from_shards_z12(grads, state, params,
                                                     **extra)
-            return self._z1.update(grads, state, params, **extra)
+            return self._z1.update(self._maybe_combine_tp(grads), state,
+                                   params, **extra)
+        if not _is_shard_grads(grads, like=list(params)
+                               if params is not None else None):
+            grads = self._maybe_combine_tp(grads)
         return self._update_z3(grads, state, params, **extra)
 
     def reduce_grads(self, grads, params):
@@ -2825,7 +2984,8 @@ class ZeroOptimizer:
                 else self._plan_z12(params))
         if self.zero_stage >= 3:
             self._require_bound("reduce_grads")
-        return self._rs_tree_exact(grads, params, plan, route, n, align)
+        return self._rs_tree_exact(self._maybe_combine_tp(grads),
+                                   params, plan, route, n, align)
 
     def _update_from_shards_z12(self, g_shards, state, params, **extra):
         if params is None:
@@ -3047,8 +3207,8 @@ class ZeroOptimizer:
                 plan = self._plan_z12(full)
 
             def rs(g):
-                return self._rs_tree_exact(g, full, plan, route, n,
-                                           align)
+                return self._rs_tree_exact(self._maybe_combine_tp(g),
+                                           full, plan, route, n, align)
 
             if k == 1:
                 out, g = vgrad(full, *batch)

@@ -1,16 +1,22 @@
 """Parallelism strategies over the collective primitive set: mesh builders,
-sequence parallelism (ring attention, Ulysses), expert parallel,
-tensor parallel, pipeline (GPipe fill-drain + interleaved 1F1B)."""
+the hybrid dp x pp x tp ParallelSpec (docs/pipeline.md), sequence
+parallelism (ring attention, Ulysses), expert parallel, tensor
+parallel, pipeline (GPipe fill-drain + interleaved 1F1B, both riding
+lax.scan with wire-dtyped stage-boundary sends)."""
 
 from .mesh import build_mesh, data_spec, param_spec  # noqa: F401
 from .moe import moe_layer, top2_gating  # noqa: F401
-from .pipeline import (pipeline_apply,  # noqa: F401
-                       pipeline_train_step_1f1b, select_last_stage)
+from .pipeline import (pipeline_accumulate_gradients,  # noqa: F401
+                       pipeline_apply, pipeline_train_step_1f1b,
+                       select_last_stage)
 from .ring_attention import (ring_attend_fn,  # noqa: F401
                              ring_attention)
+from .spec import (ParallelSpec, hybrid_param_specs,  # noqa: F401
+                   hybrid_state_specs)
 from .tensor_parallel import (column_parallel,  # noqa: F401
                               combine_slice_grads, row_parallel,
-                              shard_column, shard_row,
+                              shard_column, shard_head_rows,
+                              shard_heads, shard_row,
                               tp_attention_qkv, tp_mlp)
 from .ulysses import (ulysses_attend_fn,  # noqa: F401
                       ulysses_attention)

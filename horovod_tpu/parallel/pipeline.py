@@ -1,39 +1,115 @@
-"""Pipeline parallelism — GPipe-style microbatching over a ``pp`` axis.
+"""Pipeline parallelism — 1F1B microbatching over a ``pp`` mesh axis.
 
 The reference has no PP (SURVEY.md §2.7); the TPU-native implementation
 uses the SPMD trick: every device holds ONE stage's parameters (stacked
 stage-major and sharded over ``pp``), activations advance one stage per
-tick via ``lax.ppermute``, and a ``lax.fori_loop`` runs
-``n_micro + n_stages - 1`` ticks so the pipeline fills and drains. Autodiff
-through the loop gives the backward pipeline for free (at GPipe-style
-activation memory; pair with ``jax.checkpoint`` on the stage fn to trade
-FLOPs for memory).
+tick via ``lax.ppermute``, and one ``lax.scan`` tick loop runs the
+schedule so the pipeline fills and drains. Three surfaces:
 
-Two schedules are provided: :func:`pipeline_apply` (GPipe fill-drain,
-autodiff backward) and :func:`pipeline_train_step_1f1b` (explicit
-interleaved 1F1B). Megatron's VIRTUAL-STAGE interleaving (v chunks per
-device, bubble ÷ v) is deliberately NOT implemented: under lockstep
-SPMD every device executes the same traced program every tick, so a
-device would pay v gated forward evals + v recompute-VJPs per tick
-whether or not its chunks are scheduled — the bubble saved is smaller
-than the dummy work added for every v > 1. Virtual stages pay off in
-MPMD runtimes where idle slots cost nothing; on a TPU mesh the 1F1B
-memory bound (this module) plus XLA's latency-hiding scheduler is the
-right trade.
+- :func:`pipeline_apply` — GPipe fill-drain forward; autodiff through
+  the loop gives the backward pipeline (at GPipe activation memory).
+- :func:`pipeline_train_step_1f1b` — explicit interleaved 1F1B
+  (PipeDream-flush): at most ``n_stages`` microbatch inputs live per
+  device, backward recomputes each stage from its stored input.
+- :func:`pipeline_accumulate_gradients` — the 1F1B schedule packaged
+  as a drop-in for ``optim.accumulate_gradients``: same ``lax.scan``
+  accumulation idiom (one compiled body per tick, fp32 accumulators,
+  MEAN gradients over microbatches), same ``fn(params, *batch) ->
+  (value, grads)`` contract — so ``DistributedOptimizer(...,
+  parallel=spec)`` consumes the result unchanged and only the ``dp``
+  axes run the gradient allreduce (docs/pipeline.md).
+
+STAGE-BOUNDARY WIRE DTYPES: every ``ppermute`` send (forward
+activations AND backward cotangents) can ride ``bf16`` or
+block-scaled ``int8`` (``wire=`` / ``HVD_TPU_PP_WIRE``) through
+:func:`~..ops.collectives.wired_ppermute` — the int8 path carries the
+straight-through-VJP pattern from the MoE dispatch, so autodiff
+through a quantized send keeps gradients flowing. Per-compiled-program
+wire bytes are stamped into
+``hvd_tpu_pipeline_activation_bytes_total{wire,axis}`` (per-device
+planned bytes: ticks x payload — the ``planned_per_compile`` basis of
+the mesh-router counters), which is how the schedule's wire mix is
+PROVEN: activation bytes appear only on the pp axis, gradient-reduce
+bytes only on the dp axes.
+
+Megatron's VIRTUAL-STAGE interleaving (v chunks per device, bubble / v)
+is deliberately NOT implemented: under lockstep SPMD every device
+executes the same traced program every tick, so a device would pay v
+gated forward evals + v recompute-VJPs per tick whether or not its
+chunks are scheduled — the bubble saved is smaller than the dummy work
+added for every v > 1. Virtual stages pay off in MPMD runtimes where
+idle slots cost nothing; on a TPU mesh the 1F1B memory bound (this
+module) plus XLA's latency-hiding scheduler is the right trade.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import math
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common import metrics as metrics_lib
+
+# Telemetry (docs/metrics.md, docs/pipeline.md): stage-boundary send
+# bytes, computed at TRACE time from the static schedule (ticks x
+# payload x wire cost) — the planned_per_compile basis shared with the
+# mesh-router allreduce counters, and the activation half of the
+# per-axis byte accounting the hybrid acceptance test asserts.
+_METRICS_ON = metrics_lib.enabled()
+_M_ACT_BYTES = metrics_lib.counter(
+    "hvd_tpu_pipeline_activation_bytes_total",
+    "pipeline stage-boundary bytes on the wire (forward activations + "
+    "backward cotangents) by wire format and mesh axis — per-device "
+    "planned bytes per compiled schedule (ticks x payload; int8 "
+    "includes the per-4096-block fp32 scales)",
+    labels=("wire", "axis"))
+
+
+def _resolve_pp_wire(explicit: Optional[str]) -> str:
+    """None -> the configured default (``HVD_TPU_PP_WIRE`` /
+    ``init(pp_wire=)``, falling back to ``"none"``); an explicit value
+    always wins."""
+    if explicit is not None:
+        return explicit
+    from ..common import basics
+
+    if basics.is_initialized():
+        return basics.context().config.pp_wire or "none"
+    from ..common.config import _env
+
+    return _env("PP_WIRE") or "none"
+
+
+def _count_send_bytes(axis_name: str, wire: str, nelems: int,
+                      itemsize: int, sends: int) -> None:
+    if not _METRICS_ON or sends <= 0 or nelems <= 0:
+        return
+    from ..ops.collectives import _wire_elem_bytes
+
+    _M_ACT_BYTES.labels(wire=wire, axis=axis_name).inc(
+        float(sends) * float(nelems) * _wire_elem_bytes(wire, itemsize))
+
+
+def _send(x, axis_name, perm, wire, key, salt):
+    """One stage-boundary hop in the schedule's wire format. ``salt``
+    may be a traced tick index — ``fold_in`` accepts traced data, so
+    every tick's stochastic rounding draws an independent key inside
+    the scan body."""
+    if wire == "none":
+        return lax.ppermute(x, axis_name, perm)
+    from ..ops.collectives import wired_ppermute
+
+    kk = None if key is None else jax.random.fold_in(key, salt)
+    return wired_ppermute(x, axis_name, perm, wire=wire, key=kk)
+
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
-                   axis_name: str = "pp"):
-    """Run microbatches through the stage pipeline.
+                   axis_name: str = "pp", wire: Optional[str] = None,
+                   key=None):
+    """Run microbatches through the stage pipeline (GPipe fill-drain).
 
     Args:
       stage_fn: (params, activation (B, ...)) -> activation — the SAME
@@ -44,15 +120,25 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
         ``axis_name`` outside, e.g. in_specs=P("pp")).
       x_micro: (n_micro, B, ...) microbatches; only stage 0's copy is
         consumed (other devices may pass zeros of the same shape).
+      wire: stage-boundary send format (None -> ``HVD_TPU_PP_WIRE``;
+        ``"none"``/``"bf16"``/``"int8"`` — int8 sends carry the
+        straight-through VJP, so autodiff through the loop still
+        trains). Forward sends are stamped into the activation byte
+        counter; the autodiff transpose adds the mirror-image backward
+        sends at the same cost.
 
     Returns (n_micro, B, ...) outputs of the LAST stage (valid on stage
-    n-1; other devices return garbage — select with axis_index outside).
+    n-1; other devices return garbage — select with
+    :func:`select_last_stage` outside).
     """
+    wire = _resolve_pp_wire(wire)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = x_micro.shape[0]
     state_shape = x_micro.shape[1:]
     total = n_micro + n - 1
+    _count_send_bytes(axis_name, wire, math.prod(state_shape),
+                      jnp.dtype(x_micro.dtype).itemsize, total)
 
     # j sends to j+1 (stage order); stage 0 receives nothing meaningful.
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -60,7 +146,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     outs0 = jnp.zeros((n_micro,) + state_shape, x_micro.dtype)
     carry0 = jnp.zeros(state_shape, x_micro.dtype)
 
-    def body(t, loop):
+    def body(loop, t):
         carry, outs = loop
         # Stage 0 injects microbatch t (while available); others use the
         # activation received on the previous tick.
@@ -75,16 +161,17 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
             lambda o: lax.dynamic_update_index_in_dim(
                 o, out, jnp.maximum(w, 0), 0),
             lambda o: o, outs)
-        nxt = lax.ppermute(out, axis_name, perm)
-        return nxt, outs
+        nxt = _send(out, axis_name, perm, wire, key, t)
+        return (nxt, outs), None
 
-    _, outs = lax.fori_loop(0, total, body, (carry0, outs0))
+    (_, outs), _ = lax.scan(body, (carry0, outs0), jnp.arange(total))
     return outs
 
 
 def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
                              stage_params, x_micro, y_micro,
-                             axis_name: str = "pp"):
+                             axis_name: str = "pp",
+                             wire: Optional[str] = None, key=None):
     """One training step under a REAL 1F1B (PipeDream-flush) schedule.
 
     Unlike :func:`pipeline_apply` + autodiff (GPipe semantics: all
@@ -95,7 +182,7 @@ def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
     stage forward from the stored INPUT activation (Megatron-style
     activation recomputation), so only inputs are buffered.
 
-    Lockstep SPMD schedule, one global tick loop of
+    Lockstep SPMD schedule, one ``lax.scan`` tick loop of
     ``2*(n_micro + n_stages - 1)`` ticks:
 
     - stage ``s`` runs FORWARD of microbatch ``f`` at tick ``2f + s``
@@ -105,10 +192,11 @@ def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
     The parities of the two tick sets differ on every device, so each
     device strictly alternates F-tick / B-tick in steady state — one
     forward, one backward. Activations advance via ``ppermute`` (+1)
-    each tick; output cotangents flow via ``ppermute`` (-1). An
-    activation stored at tick ``2f+s`` is consumed at ``2f+2n-1-s`` and
-    its ring slot (``f mod n``) is overwritten no earlier than
-    ``2f+2n+s`` — the ``n``-slot ring is exactly the 1F1B bound.
+    each tick; output cotangents flow via ``ppermute`` (-1), both in
+    the schedule's ``wire`` format. An activation stored at tick
+    ``2f+s`` is consumed at ``2f+2n-1-s`` and its ring slot
+    (``f mod n``) is overwritten no earlier than ``2f+2n+s`` — the
+    ``n``-slot ring is exactly the 1F1B bound.
 
     Args:
       stage_fn: (params, activation) -> activation, same signature on
@@ -119,6 +207,8 @@ def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
         ``axis_name`` outside).
       x_micro: (n_micro, B, ...) microbatch inputs (consumed on stage 0).
       y_micro: (n_micro, B, ...) targets (consumed on the LAST stage).
+      wire: stage-boundary send format for BOTH wavefronts (None ->
+        ``HVD_TPU_PP_WIRE``). ``key`` makes int8 roundings stochastic.
 
     Returns ``(grads, loss_sum)``: grads = d(sum of microbatch losses)/
     d(stage_params) for THIS device's stage; loss_sum = the summed loss
@@ -127,34 +217,84 @@ def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
     stage_fn eval + one recompute-VJP per tick (the standard cost of a
     lockstep SPMD schedule: unscheduled slots run gated dummy work).
     """
+    carry = _run_1f1b(stage_fn, loss_fn, stage_params, x_micro, y_micro,
+                      axis_name, _resolve_pp_wire(wire), key,
+                      pre_fn=None, shared=None, fp32_accum=False)
+    return carry["g_stage"], carry["loss_sum"]
+
+
+def _run_1f1b(stage_fn, loss_fn, stage_params, x_micro, y_micro,
+              axis_name, wire, key, pre_fn, shared, fp32_accum):
+    """The shared 1F1B tick loop. With ``pre_fn``/``shared`` (the
+    hybrid GPT form) stage 0 computes its input as
+    ``pre_fn(shared, x_micro[f])`` (embedding), the last stage's loss is
+    ``loss_fn(shared, out, y_micro[b])`` (final LN + tied head), and the
+    carry accumulates ``g_shared`` contributions from both pipeline ends
+    (psum over ``axis_name`` outside assembles them). ``fp32_accum``
+    selects fp32 gradient/loss accumulators (the
+    ``accumulate_gradients`` contract)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = x_micro.shape[0]
-    state_shape = x_micro.shape[1:]
     total = 2 * (m + n - 1)
+
+    if pre_fn is not None:
+        act_s = jax.eval_shape(
+            pre_fn, shared, jax.tree.map(lambda a: a[0], x_micro))
+        state_shape, act_dtype = act_s.shape, act_s.dtype
+    else:
+        state_shape, act_dtype = x_micro.shape[1:], x_micro.dtype
+
+    # Both wavefronts (activations down, cotangents up) ride the wire
+    # every tick.
+    _count_send_bytes(axis_name, wire, math.prod(state_shape),
+                      jnp.dtype(act_dtype).itemsize, 2 * total)
 
     fwd_perm = [(j, (j + 1) % n) for j in range(n)]
     bwd_perm = [(j, (j - 1) % n) for j in range(n)]
 
-    acts0 = jnp.zeros((n,) + state_shape, x_micro.dtype)
-    carry_f0 = jnp.zeros(state_shape, x_micro.dtype)
-    carry_b0 = jnp.zeros(state_shape, x_micro.dtype)
-    grads0 = jax.tree.map(jnp.zeros_like, stage_params)
-    loss0 = jnp.zeros((), jnp.float32)
+    def zeros_acc(t):
+        if not fp32_accum:
+            return jax.tree.map(jnp.zeros_like, t)
+        return jax.tree.map(
+            lambda s: jnp.zeros(
+                jnp.shape(s), jnp.float32
+                if jnp.issubdtype(jnp.asarray(s).dtype, jnp.floating)
+                else jnp.asarray(s).dtype), t)
 
-    def body(t, loop):
-        carry_f, carry_b, acts, grads, loss_sum = loop
+    def acc_add(acc, new, gate):
+        def one(a, x):
+            x = jnp.where(gate, x, jnp.zeros_like(x))
+            if fp32_accum and jnp.issubdtype(
+                    jnp.asarray(a).dtype, jnp.floating):
+                x = x.astype(jnp.float32)
+            return a + x
 
+        return jax.tree.map(one, acc, new)
+
+    carry0 = {
+        "carry_f": jnp.zeros(state_shape, act_dtype),
+        "carry_b": jnp.zeros(state_shape, act_dtype),
+        "acts": jnp.zeros((n,) + state_shape, act_dtype),
+        "g_stage": zeros_acc(stage_params),
+        "loss_sum": jnp.zeros((), jnp.float32),
+    }
+    if pre_fn is not None:
+        carry0["g_shared"] = zeros_acc(shared)
+
+    def body(carry, t):
         # ---- forward slot: microbatch f at tick 2f + idx -------------
         tf_ = t - idx
         f = jnp.clip(tf_ // 2, 0, m - 1)
         do_f = (tf_ >= 0) & (tf_ % 2 == 0) & (tf_ // 2 < m)
-        inp = jnp.where(idx == 0, x_micro[f], carry_f)
+        mb_f = jax.tree.map(lambda a: a[f], x_micro)
+        inp0 = pre_fn(shared, mb_f) if pre_fn is not None else mb_f
+        inp = jnp.where(idx == 0, inp0, carry["carry_f"])
         out_f = stage_fn(stage_params, inp)
         acts = lax.cond(
             do_f,
             lambda a: lax.dynamic_update_index_in_dim(a, inp, f % n, 0),
-            lambda a: a, acts)
+            lambda a: a, carry["acts"])
 
         # ---- backward slot: microbatch b at tick 2b + 2n - 1 - idx ---
         tb_ = t - (2 * n - 1 - idx)
@@ -162,26 +302,145 @@ def pipeline_train_step_1f1b(stage_fn: Callable, loss_fn: Callable,
         do_b = (tb_ >= 0) & (tb_ % 2 == 0) & (tb_ // 2 < m)
         inp_b = acts[b % n]
         out_b, vjp_fn = jax.vjp(stage_fn, stage_params, inp_b)
-        loss_b, g_last = jax.value_and_grad(
-            lambda o: loss_fn(o, y_micro[b]))(out_b)
+        y_b = jax.tree.map(lambda a: a[b], y_micro)
+        if pre_fn is not None:
+            loss_b, (g_head, g_last) = jax.value_and_grad(
+                lambda sh, o: loss_fn(sh, o, y_b),
+                argnums=(0, 1))(shared, out_b)
+        else:
+            loss_b, g_last = jax.value_and_grad(
+                lambda o: loss_fn(o, y_b))(out_b)
         g_out = jnp.where(idx == n - 1, g_last,
-                          carry_b.astype(g_last.dtype))
+                          carry["carry_b"].astype(g_last.dtype))
         dp, dx = vjp_fn(g_out.astype(out_b.dtype))
-        grads = jax.tree.map(
-            lambda G, d: G + jnp.where(do_b, d, jnp.zeros_like(d)),
-            grads, dp)
-        loss_sum = loss_sum + jnp.where(
-            do_b & (idx == n - 1), loss_b.astype(jnp.float32), 0.0)
+
+        new = {
+            "g_stage": acc_add(carry["g_stage"], dp, do_b),
+            "loss_sum": carry["loss_sum"] + jnp.where(
+                do_b & (idx == n - 1), loss_b.astype(jnp.float32), 0.0),
+            "acts": acts,
+        }
+        if pre_fn is not None:
+            # Shared-parameter gradients accrue at BOTH pipeline ends:
+            # the head/final-LN grads on the last stage, and the
+            # embedding grads on stage 0 by chaining this tick's input
+            # cotangent through a pre_fn recompute (the same
+            # recompute-from-stored-input trade as the stage backward).
+            g_sh = acc_add(carry["g_shared"], g_head,
+                           do_b & (idx == n - 1))
+            mb_b = jax.tree.map(lambda a: a[b], x_micro)
+            _, vjp_pre = jax.vjp(lambda sh: pre_fn(sh, mb_b), shared)
+            (g_pre,) = vjp_pre(dx.astype(act_dtype))
+            new["g_shared"] = acc_add(g_sh, g_pre, do_b & (idx == 0))
 
         # ---- advance the two wavefronts ------------------------------
-        carry_f = lax.ppermute(out_f, axis_name, fwd_perm)
-        carry_b = lax.ppermute(dx.astype(carry_b.dtype), axis_name,
-                               bwd_perm)
-        return carry_f, carry_b, acts, grads, loss_sum
+        new["carry_f"] = _send(out_f, axis_name, fwd_perm, wire, key,
+                               2 * t)
+        new["carry_b"] = _send(dx.astype(act_dtype), axis_name,
+                               bwd_perm, wire, key, 2 * t + 1)
+        return new, None
 
-    _, _, _, grads, loss_sum = lax.fori_loop(
-        0, total, body, (carry_f0, carry_b0, acts0, grads0, loss0))
-    return grads, loss_sum
+    carry, _ = lax.scan(body, carry0, jnp.arange(total))
+    return carry
+
+
+def _resolve_accum(accum_steps):
+    from ..optim import _resolve_accum_steps
+
+    return _resolve_accum_steps(accum_steps)
+
+
+def pipeline_accumulate_gradients(stage_fn: Callable, loss_fn: Callable,
+                                  accum_steps: Optional[int] = None,
+                                  axis_name: str = "pp",
+                                  pre_fn: Optional[Callable] = None,
+                                  wire: Optional[str] = None,
+                                  key=None,
+                                  remat_policy: Optional[str] = None):
+    """The 1F1B schedule as a drop-in ``accumulate_gradients``: wrap the
+    stage pipeline into a microbatched ``value_and_grad``.
+
+    Rides the same ``lax.scan`` accumulation pattern as
+    :func:`~..optim.accumulate_gradients` (one compiled body per tick,
+    fp32 accumulators, MEAN gradients over the ``accum_steps``
+    microbatches — the microbatch structure gradient accumulation
+    already pays for IS the pipeline schedule) and returns the same
+    ``fn(params, *batch) -> (value, grads)`` contract, so the result
+    feeds ``DistributedOptimizer.update`` unchanged: only the ``dp``
+    axes reduce gradients, the ``pp`` axis carries ONLY the
+    stage-boundary activation/cotangent sends (in ``wire`` dtype), and
+    one collective round / guard agreement / EF advance runs per
+    effective step (docs/pipeline.md).
+
+    Two forms, selected by ``pre_fn``:
+
+    WITHOUT ``pre_fn`` (homogeneous-chain form): ``params`` is this
+    device's stage parameters, ``loss_fn(out, y_mb) -> scalar``, batch
+    is ``(x, y)`` whose leading dim is ``accum_steps * microbatch``.
+
+    WITH ``pre_fn`` (the hybrid GPT form): ``params`` is the dict
+    ``{"stages": <this device's stage params>, "shared": <replicated
+    embedding/head params>}`` (models/gpt.stack_stage_params layout);
+    stage 0 computes its input as ``pre_fn(shared, x_mb)`` (embedding)
+    and the last stage's loss is ``loss_fn(shared, out, y_mb)`` (final
+    LN + weight-tied head). Shared-parameter gradients accrue at both
+    pipeline ends and are psum-assembled over ``axis_name`` before
+    returning, so the returned ``grads["shared"]`` is replicated across
+    pp and the returned ``grads["stages"]`` is per-stage — exactly the
+    tree ``DistributedOptimizer(parallel=...)`` expects.
+
+    The returned loss is the MEAN microbatch loss, replicated across
+    the pp axis (psum of the last stage's masked sum); gradients are
+    the MEAN over microbatches, matching the accumulation-equivalence
+    contract (bitwise-pinned against the single-device
+    ``accumulate_gradients`` reference in tests/test_pipeline.py).
+
+    ``remat_policy`` wraps ``stage_fn`` in ``jax.checkpoint``
+    (``optim.resolve_remat_policy`` names) — largely redundant under
+    1F1B (backward already recomputes each stage from its stored
+    input) but it composes for stages whose internals want a finer
+    policy. ``wire``/``key`` select the stage-boundary send format
+    (None -> ``HVD_TPU_PP_WIRE``) and stochastic-rounding key.
+    """
+    k = _resolve_accum(accum_steps)
+    wire = _resolve_pp_wire(wire)
+    from ..optim import _split_microbatches, resolve_remat_policy
+
+    _, wrap, jax_policy = resolve_remat_policy(remat_policy)
+    sfn = jax.checkpoint(stage_fn, policy=jax_policy) if wrap \
+        else stage_fn
+
+    def accum_fn(params, x, y):
+        x_micro, y_micro = _split_microbatches((x, y), k)
+        if pre_fn is not None:
+            stage_params, shared = params["stages"], params["shared"]
+        else:
+            stage_params, shared = params, None
+        carry = _run_1f1b(sfn, loss_fn, stage_params, x_micro, y_micro,
+                          axis_name, wire, key, pre_fn, shared,
+                          fp32_accum=True)
+
+        def mean_like(acc, template):
+            return jax.tree.map(
+                lambda a, s: (a / k).astype(jnp.asarray(s).dtype)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, acc, template)
+
+        g_stage = mean_like(carry["g_stage"], stage_params)
+        # Loss lives on the last stage only; the masked psum replicates
+        # it (same lowering as collectives.broadcast).
+        loss = lax.psum(carry["loss_sum"], axis_name) / k
+        if pre_fn is None:
+            return loss, g_stage
+        # Shared grads: stage 0 holds the embedding half, the last
+        # stage the head half, middle stages zeros — one psum over pp
+        # assembles the full tree identically on every stage.
+        g_shared = jax.tree.map(lambda a: lax.psum(a, axis_name),
+                                carry["g_shared"])
+        g_shared = mean_like(g_shared, shared)
+        return loss, {"stages": g_stage, "shared": g_shared}
+
+    return accum_fn
 
 
 def select_last_stage(outs, axis_name: str = "pp"):

@@ -1,0 +1,229 @@
+"""ParallelSpec — hybrid dp x pp x tp parallelism declared on ONE mesh.
+
+The mesh-axis machinery (common/topology.py, ops/collectives.WirePlan)
+routes COLLECTIVES per axis; a ParallelSpec promotes it to routing
+COMPUTATION (ROADMAP item 2, the MLPerf TPU-v3 pod recipe —
+arXiv:1909.09756): each mesh axis is assigned a parallelism ROLE:
+
+  ``dp``  data parallelism      — batch shards, gradient allreduce
+  ``pp``  pipeline parallelism  — decoder stages, 1F1B activation sends
+                                  (parallel/pipeline.py)
+  ``tp``  tensor parallelism    — column/row-parallel weights +
+                                  sharded-head attention
+                                  (parallel/tensor_parallel.py)
+  ``ep``  expert parallelism    — MoE alltoall dispatch
+                                  (parallel/moe.py)
+
+Declare roles SLOW axis first, FAST axis last (row-major device order,
+same convention as ``HVD_TPU_MESH_SHAPE``): the gradient allreduce
+tolerates the slow hop, while tensor parallelism's per-layer allreduce
+needs the fastest links — so ``dict(dp=2, pp=2, tp=2)`` puts ``dp``
+on the cross/DCN hop and ``tp`` on intra-host ICI (the Megatron
+placement rule). ``hvd.init(parallel=...)`` accepts a dict, a spec
+string (``"dp=2,pp=2,tp=2"``, the ``HVD_TPU_PARALLEL`` env form), or a
+ParallelSpec, and publishes the resolved spec as
+``hvd.parallel_spec()`` / its mesh as ``hvd.parallel_mesh()``.
+
+The optimizer surfaces consume the spec directly
+(``DistributedOptimizer(..., parallel=spec)``): gradients reduce over
+the ``dp`` axes ONLY (through the usual route/compression/guard
+stack), tp slice-gradients are pmean-combined over ``tp`` first
+(tensor_parallel.combine_slice_grads), the non-finite guard agrees
+over the ``dp`` axes only (each pipeline stage owns different params —
+docs/pipeline.md), and ZeRO shard grids span the ``dp`` axes so
+stage-2/3 shards live PER PIPELINE STAGE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+# Roles a mesh axis can play. The axis NAME in the jax Mesh is the role
+# name itself, so shard_map specs and WirePlan phases read naturally
+# (P("pp"), "dp:int8").
+ROLES = ("dp", "pp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelSpec:
+    """Immutable role -> size assignment, SLOW axis first.
+
+    ``dims`` is an ordered tuple of ``(role, size)`` pairs; the mesh is
+    built slow-major (first role = slowest links, last = fastest ICI),
+    matching ``topology.parse_mesh_shape``'s row-major convention.
+    """
+
+    dims: Tuple[Tuple[str, int], ...]
+
+    def __post_init__(self):
+        if not self.dims:
+            raise ValueError("ParallelSpec needs at least one axis")
+        seen = set()
+        for role, size in self.dims:
+            if role not in ROLES:
+                raise ValueError(
+                    f"unknown parallelism role {role!r}; choose from "
+                    f"{ROLES}")
+            if role in seen:
+                raise ValueError(f"duplicate role {role!r} in spec")
+            seen.add(role)
+            if int(size) < 1:
+                raise ValueError(
+                    f"axis {role!r} needs size >= 1, got {size}")
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str) -> "ParallelSpec":
+        """``"dp=2,pp=2,tp=2"`` (slow -> fast) — the HVD_TPU_PARALLEL
+        env form."""
+        dims = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"bad parallel spec segment {part!r}: expected "
+                    "role=size, e.g. 'dp=2,pp=2,tp=2'")
+            role, size = part.split("=", 1)
+            dims.append((role.strip(), int(size)))
+        return cls(tuple(dims))
+
+    @classmethod
+    def resolve(cls, value) -> Optional["ParallelSpec"]:
+        """Coerce a user-facing ``parallel=`` value: an existing spec,
+        a role->size dict (insertion order = slow -> fast), or a spec
+        string; None stays None (no hybrid parallelism)."""
+        if value is None:
+            return None
+        if isinstance(value, ParallelSpec):
+            return value
+        if isinstance(value, dict):
+            return cls(tuple((str(k), int(v)) for k, v in value.items()))
+        return cls.parse(str(value))
+
+    # -- views --------------------------------------------------------
+
+    @property
+    def roles(self) -> Tuple[str, ...]:
+        return tuple(r for r, _ in self.dims)
+
+    @property
+    def sizes(self) -> dict:
+        return {r: s for r, s in self.dims}
+
+    def size_of(self, role: str) -> int:
+        return self.sizes.get(role, 1)
+
+    @property
+    def total(self) -> int:
+        n = 1
+        for _, s in self.dims:
+            n *= s
+        return n
+
+    @property
+    def dp_axes(self) -> Tuple[str, ...]:
+        """Axes the gradient allreduce runs over — ``dp`` only (a
+        size-1 dp axis still binds in shard_map and reduces as a
+        no-op, so it is kept)."""
+        return tuple(r for r, _ in self.dims if r == "dp")
+
+    @property
+    def pp_axis(self) -> Optional[str]:
+        return "pp" if self.size_of("pp") > 1 else None
+
+    @property
+    def tp_axis(self) -> Optional[str]:
+        return "tp" if self.size_of("tp") > 1 else None
+
+    @property
+    def ep_axis(self) -> Optional[str]:
+        return "ep" if self.size_of("ep") > 1 else None
+
+    def describe(self) -> str:
+        return ",".join(f"{r}={s}" for r, s in self.dims)
+
+    # -- mesh / routing -----------------------------------------------
+
+    def mesh(self, devices: Optional[Sequence] = None):
+        """The N-D jax Mesh with role-named axes over ``devices``
+        (default: the live backend's device list, mesh order). The
+        spec must factor the device count exactly — a silent partial
+        mesh would drop ranks from the reduction."""
+        import jax
+        import numpy as np
+
+        devs = list(devices) if devices is not None else list(
+            jax.devices())
+        if self.total != len(devs):
+            raise ValueError(
+                f"parallel spec {self.describe()!r} covers {self.total} "
+                f"devices but {len(devs)} are available (dp*pp*tp must "
+                "factor the world size exactly)")
+        arr = np.array(devs).reshape(tuple(s for _, s in self.dims))
+        return jax.sharding.Mesh(arr, self.roles)
+
+    def grad_route(self, wires=None):
+        """The WirePlan the gradient allreduce runs over — the ``dp``
+        axes ONLY, fast axis first (activation traffic rides the pp
+        axis, tp combines via pmean; neither belongs in the gradient
+        reduction). ``wires`` optionally maps axis -> wire dtype
+        (``{"dp": "int8"}``). Returns None when there is no dp axis
+        (pure pp x tp — nothing to reduce)."""
+        from ..ops.collectives import AxisPhase, WirePlan
+
+        axes = self.dp_axes
+        if not axes:
+            return None
+        wires = wires or {}
+        # dims are slow -> fast; WirePlan wants fast first.
+        return WirePlan(tuple(AxisPhase(a, wires.get(a, "none"))
+                              for a in reversed(axes)))
+
+    def data_spec(self):
+        """PartitionSpec for a batch argument: leading dim sharded over
+        the dp axes, replicated over pp/tp/ep (every stage and shard
+        sees the replica's full microbatch stream)."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = self.dp_axes
+        return P(axes if len(axes) > 1 else (axes[0] if axes else None))
+
+
+def hybrid_param_specs(pp_axis: str = "pp"):
+    """shard_map spec prefix for the hybrid param tree
+    ``{"stages": <stage-stacked>, "shared": <replicated>}``
+    (models/gpt.stack_stage_params layout): stage-major leaves shard
+    their leading axis over ``pp``; the shared (embedding/head) tree
+    replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"stages": P(pp_axis), "shared": P()}
+
+
+def hybrid_state_specs(state_shapes, pp_axis: str = "pp",
+                       base_spec=None):
+    """shard_map specs for an optimizer-state tree built over hybrid
+    params: any leaf living under a ``"stages"`` key (optax state
+    mirrors the param tree, so mu/nu/EF residuals all nest the
+    stage-stacked subtree) shards its leading axis over ``pp``; every
+    other leaf (step counters, guard scalars, shared-param moments)
+    takes ``base_spec`` (default: replicated). Keyed on tree PATHS, not
+    shapes — a hidden size that happens to equal the stage count can't
+    mis-shard."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if base_spec is None:
+        base_spec = P()
+
+    def one(path, _leaf):
+        for k in path:
+            if getattr(k, "key", None) == "stages":
+                return P(pp_axis)
+        return base_spec
+
+    return jax.tree_util.tree_map_with_path(one, state_shapes)

@@ -78,6 +78,54 @@ def shard_row(w, axis_name: str = "tp"):
     return lax.dynamic_slice_in_dim(w, idx * chunk, chunk, axis=0)
 
 
+def shard_heads(w, num_heads: int, axis_name: str = "tp",
+                fused: int = 1):
+    """Slice the HEAD dimension of an attention projection parameter —
+    the column-parallel sharding attention wants (contiguous
+    ``shard_column`` slices would mix q/k/v in a fused kernel).
+
+    ``w``: (..., fused * num_heads * head_dim), the last dim laid out
+    as ``fused`` consecutive blocks (e.g. the GPT fused QKV kernel
+    (h, 3h) with ``fused=3``, layout [q|k|v]) of ``num_heads`` heads
+    each. Returns this rank's (..., fused, heads_local, head_dim)
+    slice. Raises when ``num_heads`` does not divide over the axis or
+    the last dim does not factor."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if num_heads % n:
+        raise ValueError(f"num_heads {num_heads} not divisible by tp "
+                         f"size {n} (a silent truncation would drop "
+                         "heads)")
+    if w.shape[-1] % (fused * num_heads):
+        raise ValueError(
+            f"last dim {w.shape[-1]} does not factor as fused={fused} "
+            f"x num_heads={num_heads} x head_dim")
+    hl = num_heads // n
+    hd = w.shape[-1] // (fused * num_heads)
+    wr = w.reshape(w.shape[:-1] + (fused, num_heads, hd))
+    return lax.dynamic_slice_in_dim(wr, idx * hl, hl, axis=wr.ndim - 2)
+
+
+def shard_head_rows(w, num_heads: int, axis_name: str = "tp"):
+    """Slice the head-major INPUT rows of an attention output
+    projection (num_heads * head_dim, out) to this rank's
+    (heads_local * head_dim, out) — the row-parallel partner of
+    :func:`shard_heads` (pair with ``row_parallel``'s psum)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if num_heads % n:
+        raise ValueError(f"num_heads {num_heads} not divisible by tp "
+                         f"size {n}")
+    if w.shape[0] % num_heads:
+        raise ValueError(f"in dim {w.shape[0]} does not factor into "
+                         f"{num_heads} heads")
+    hl = num_heads // n
+    hd = w.shape[0] // num_heads
+    wr = w.reshape((num_heads, hd) + w.shape[1:])
+    loc = lax.dynamic_slice_in_dim(wr, idx * hl, hl, axis=0)
+    return loc.reshape((hl * hd,) + w.shape[1:])
+
+
 def combine_slice_grads(grads, axis_name: str = "tp"):
     """Combine gradients of SLICE-used replicated params (those fed
     through :func:`shard_column` / :func:`shard_row`) taken with

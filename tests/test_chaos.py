@@ -92,3 +92,18 @@ def test_chaos_zero_midstep_crash_verified_resume(tmp_path):
     assert rec["byte_identical_resume"]
     assert rec["restored_step"] == rec["crash_step"] - 2  # walk-back
     assert "checkpoint_corrupt" in rec["injected_sites"]
+
+
+def test_chaos_pipeline_straggler_crash_verified_resume(tmp_path):
+    """ISSUE 13 satellite: the pipeline family — hybrid dp=4 x pp=2
+    1F1B training (int8 stage-boundary wire, dp-only gradient reduce)
+    eats a straggler sleep on one stage, dies HARD mid-schedule with
+    its last checkpoint torn; the relaunch walks back to the previous
+    VERIFIED step and the per-step event log (loss + param digest)
+    replays byte-identically against an uninterrupted run."""
+    rec = chaos_soak.run_pipeline_soak(str(tmp_path), steps=8, seed=42)
+    assert rec["rc"] == 7  # the hard mid-schedule exit
+    assert rec["byte_identical_resume"]
+    assert rec["restored_step"] == rec["crash_step"] - 2  # walk-back
+    assert {"straggler", "checkpoint_corrupt"} <= set(
+        rec["injected_sites"])

@@ -1248,6 +1248,235 @@ def run_zero_soak(workdir: str, steps: int = 8, seed: int = 42,
     }
 
 
+# -- the pipeline family (docs/pipeline.md) ----------------------------------
+
+def pipeline_plan(seed: int, steps: int) -> dict:
+    """The pipeline family: a STRAGGLER on one stage (real sleep at the
+    step boundary — in the single-controller sim the slow stage stalls
+    the whole lockstep schedule, which is exactly what it does on a
+    pod) plus a HARD MID-SCHEDULE CRASH of hybrid dp x pp training,
+    with the last finalized checkpoint torn — the relaunch must walk
+    back to the previous VERIFIED step and replay to a final state
+    byte-identical with an uninterrupted run."""
+    crash = max(3, steps - 2)
+    return {"seed": seed, "crash_step": crash, "faults": [
+        {"site": "straggler", "step": 2, "delay_s": 0.2, "times": 1},
+        {"site": "checkpoint_corrupt", "step": crash - 1,
+         "mode": "bitflip"},
+    ]}
+
+
+PIPELINE_SCRIPT = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import hashlib
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt_lib
+from horovod_tpu.common import faults as faults_lib
+from horovod_tpu.models.gpt import gpt_tiny, pipeline_fns, \\
+    stack_stage_params
+from horovod_tpu.parallel.spec import (ParallelSpec,
+                                       hybrid_param_specs,
+                                       hybrid_state_specs)
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+MODE = sys.argv[3]            # crash | resume | reference
+CRASH = int(sys.argv[4])      # 1-based step that dies mid-schedule
+hvd.init(force_cpu_devices=8)
+
+spec = ParallelSpec.resolve({"dp": 4, "pp": 2})
+mesh = spec.mesh(jax.devices())
+model = gpt_tiny(num_layers=2, hidden=32, num_heads=2, mlp_dim=64,
+                 vocab_size=64)
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
+Y = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
+params = jax.jit(model.init)(jax.random.PRNGKey(0), X)["params"]
+stages, shared = stack_stage_params(params, 2)
+stage_fn, pre_fn, loss_fn = pipeline_fns(model)
+vg = hvd.pipeline_accumulate_gradients(stage_fn, loss_fn,
+                                       accum_steps=2, axis_name="pp",
+                                       pre_fn=pre_fn, wire="int8",
+                                       key=jax.random.PRNGKey(7))
+tx = hvd.DistributedOptimizer(optax.adam(1e-2), parallel=spec)
+opt = tx.init({"stages": stages, "shared": shared})
+ospecs = hybrid_state_specs(jax.eval_shape(lambda: opt))
+pspecs = hybrid_param_specs()
+
+
+def step_fn(st, sh, op, x, y):
+    p = {"stages": st, "shared": sh}
+    loss, g = vg(p, x, y)
+    updates, op = tx.update(g, op, p)
+    p = optax.apply_updates(p, updates)
+    loss = jax.lax.pmean(loss, spec.dp_axes)
+    return p["stages"], p["shared"], op, loss
+
+
+step = jax.jit(jax.shard_map(
+    step_fn, mesh=mesh,
+    in_specs=(pspecs["stages"], pspecs["shared"], ospecs,
+              spec.data_spec(), spec.data_spec()),
+    out_specs=(pspecs["stages"], pspecs["shared"], ospecs, P()),
+    check_vma=False))
+
+# Place the state on the hybrid mesh: the restore template must carry
+# the target shardings (restore_sharded lands each rank's pieces on
+# its own device).
+place = jax.jit(jax.shard_map(
+    lambda a, b, c: (a, b, c), mesh=mesh,
+    in_specs=(pspecs["stages"], pspecs["shared"], ospecs),
+    out_specs=(pspecs["stages"], pspecs["shared"], ospecs),
+    check_vma=False))
+stages, shared, opt = place(stages, shared, opt)
+
+
+def digest(st, sh):
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(st) + jax.tree.leaves(sh):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+ckdir = os.path.join(workdir, "pp_ckpt")
+events = open(os.path.join(workdir, f"events_{MODE}.jsonl"), "w")
+start = 0
+if MODE == "resume":
+    (restored, start) = ckpt_lib.restore_sharded(
+        {"stages": stages, "shared": shared, "opt": opt}, ckdir)
+    stages, shared, opt = (restored["stages"], restored["shared"],
+                           restored["opt"])
+
+loss = None
+for i in range(start + 1, TOTAL + 1):
+    sp = faults_lib.maybe_straggler()
+    if sp is not None and sp.delay_s:
+        time.sleep(sp.delay_s)   # the slow stage stalls the schedule
+    stages, shared, opt, loss = step(stages, shared, opt, X, Y)
+    lval = float(np.asarray(jax.device_get(loss)).reshape(-1)[0])
+    events.write(json.dumps({"step": i, "loss": f"{lval:.17g}",
+                             "digest": digest(stages, shared)}) + "\\n")
+    if MODE == "crash" and i == CRASH:
+        events.close()
+        os._exit(7)   # mid-schedule: computed, never checkpointed
+    if MODE != "reference":
+        ckpt_lib.save_sharded(
+            {"stages": stages, "shared": shared, "opt": opt}, ckdir,
+            step=i, max_to_keep=TOTAL + 1)
+events.close()
+
+result = {
+    "mode": MODE,
+    "restored_step": start,
+    "final_loss": float(np.asarray(jax.device_get(loss)).reshape(-1)[0]),
+    "digest": digest(stages, shared),
+}
+with open(os.path.join(workdir, f"result_{MODE}.json"), "w") as f:
+    json.dump(result, f)
+"""
+
+
+def run_pipeline_soak(workdir: str, steps: int = 8, seed: int = 42,
+                      plan: dict | None = None) -> dict:
+    """One seeded pipeline-family run, three phases (the zero-family
+    shape on the HYBRID dp x pp stack): (1) CRASH — dp=4 x pp=2 1F1B
+    training (int8 stage-boundary wire, dp-only gradient reduce) eats
+    a straggler sleep on one stage, then dies hard mid-schedule, its
+    last finalized checkpoint torn by the fault plan; (2) RESUME — a
+    fresh process restores the latest VERIFIED checkpoint (walk-back)
+    and finishes; (3) REFERENCE — uninterrupted. Acceptance: the
+    resumed run's final param digest is IDENTICAL to the reference's,
+    the per-step event log (loss + digest per step) matches the
+    reference's on every replayed step, and under ``--repeat`` the
+    whole decision/event record is byte-identical."""
+    import subprocess
+
+    os.makedirs(workdir, exist_ok=True)
+    train_py = os.path.join(workdir, "train_pipeline.py")
+    with open(train_py, "w") as f:
+        f.write(PIPELINE_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    plan = plan if plan is not None else pipeline_plan(seed, steps)
+    crash = int(plan["crash_step"])
+
+    def phase(mode: str, with_faults: bool):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.pop("HVD_TPU_FAULT_PLAN", None)
+        if with_faults:
+            env["HVD_TPU_FAULT_PLAN"] = json.dumps(plan)
+            env["HVD_TPU_FAULT_LOG"] = fault_log
+        return subprocess.run(
+            [sys.executable, train_py, workdir, str(steps), mode,
+             str(crash)], env=env, capture_output=True, text=True,
+            timeout=600)
+
+    p1 = phase("crash", with_faults=True)
+    assert p1.returncode == 7, \
+        f"crash phase rc={p1.returncode} (want the hard exit 7)\n" \
+        f"{p1.stdout}\n{p1.stderr}"
+    p2 = phase("resume", with_faults=False)
+    assert p2.returncode == 0, \
+        f"resume rc={p2.returncode}\n{p2.stdout}\n{p2.stderr}"
+    p3 = phase("reference", with_faults=False)
+    assert p3.returncode == 0, \
+        f"reference rc={p3.returncode}\n{p3.stdout}\n{p3.stderr}"
+
+    with open(os.path.join(workdir, "result_resume.json")) as f:
+        resumed = json.load(f)
+    with open(os.path.join(workdir, "result_reference.json")) as f:
+        reference = json.load(f)
+    assert resumed["restored_step"] == crash - 2, (resumed, crash)
+    assert resumed["digest"] == reference["digest"], \
+        "resumed hybrid trajectory diverged from the uninterrupted one"
+
+    def events(mode):
+        with open(os.path.join(workdir, f"events_{mode}.jsonl")) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    ref_by_step = {e["step"]: e for e in events("reference")}
+    for e in events("resume"):
+        assert e == ref_by_step[e["step"]], \
+            f"replayed step {e['step']} event diverged: {e} vs " \
+            f"{ref_by_step[e['step']]}"
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert {"straggler", "checkpoint_corrupt"} <= sites, sorted(sites)
+    return {
+        "metric": "chaos_soak_pipeline",
+        "seed": seed,
+        "steps": steps,
+        "crash_step": crash,
+        "restored_step": resumed["restored_step"],
+        "rc": p1.returncode,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "final_loss": resumed["final_loss"],
+        "byte_identical_resume": True,
+        "sequences": {
+            "events": [json.dumps(e) for e in events("reference")],
+            "final_digest": resumed["digest"],
+            "injections": {f"{k[0]}@{k[1]}": v
+                           for k, v in
+                           injection_sequences(log).items()},
+        },
+    }
+
+
 # -- the stall family (docs/podmon.md) ---------------------------------------
 
 def stall_plan(seed: int) -> dict:
@@ -1535,7 +1764,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
                                          "autoscale", "stall", "moe",
-                                         "serve", "zero"),
+                                         "serve", "zero", "pipeline"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
@@ -1564,7 +1793,14 @@ def main() -> int:
                          "sharded training + a torn sharded "
                          "checkpoint: the verified walk-back restores "
                          "and the replay lands byte-identical with an "
-                         "uninterrupted run (docs/zero.md)")
+                         "uninterrupted run (docs/zero.md); "
+                         "pipeline = a straggler on one pipeline "
+                         "stage + a hard mid-schedule crash of hybrid "
+                         "dp x pp 1F1B training (int8 activation "
+                         "wire) + a torn checkpoint: the verified "
+                         "walk-back restores and the per-step event "
+                         "log replays byte-identically "
+                         "(docs/pipeline.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
                          "autoscale: 120, stall: 60 — their control "
@@ -1581,11 +1817,12 @@ def main() -> int:
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
             "autoscale": run_autoscale_soak,
             "stall": run_stall_soak, "moe": run_moe_soak,
-            "serve": run_serve_soak, "zero": run_zero_soak}[args.family]
+            "serve": run_serve_soak, "zero": run_zero_soak,
+            "pipeline": run_pipeline_soak}[args.family]
     if args.steps is None:
         args.steps = {"autoscale": 120, "stall": 60,
                       "moe": 8, "serve": 40,
-                      "zero": 8}.get(args.family, 12)
+                      "zero": 8, "pipeline": 8}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

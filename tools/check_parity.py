@@ -687,6 +687,131 @@ def check_zero_surface(missing: list) -> None:
         missing.append("zero: tests/test_zero.py missing")
 
 
+def check_pipeline_surface(missing: list) -> None:
+    """The hybrid 3D-parallelism subsystem (docs/pipeline.md): every
+    knob (HVD_TPU_PARALLEL / HVD_TPU_PP_* / HVD_TPU_TP), metric, API
+    name, bench/chaos/autotune surface named by ISSUE 13 must exist in
+    the source AND be documented. Parsed textually (runs without
+    jax installed)."""
+    doc = REPO / "docs" / "pipeline.md"
+    if not doc.exists():
+        missing.append("path: docs/pipeline.md")
+        return
+    text = doc.read_text()
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    metrics_text = (REPO / "docs" / "metrics.md").read_text() \
+        if (REPO / "docs" / "metrics.md").exists() else ""
+    spec_src = (REPO / "horovod_tpu" / "parallel" / "spec.py").read_text()
+    pipe_src = (REPO / "horovod_tpu" / "parallel"
+                / "pipeline.py").read_text()
+    tp_src = (REPO / "horovod_tpu" / "parallel"
+              / "tensor_parallel.py").read_text()
+    gpt_src = (REPO / "horovod_tpu" / "models" / "gpt.py").read_text()
+    optim_src = (REPO / "horovod_tpu" / "optim.py").read_text()
+    coll_src = (REPO / "horovod_tpu" / "ops" / "collectives.py").read_text()
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    tune_src = (REPO / "horovod_tpu" / "common"
+                / "autotune.py").read_text()
+    bench_src = (REPO / "bench.py").read_text()
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    queue_src = (REPO / "tools" / "tpu_bench_queue.py").read_text()
+
+    # API names: defined -> documented in docs/pipeline.md AND api.md.
+    api = {
+        "ParallelSpec": spec_src, "grad_route": spec_src,
+        "parallel_spec": (REPO / "horovod_tpu"
+                          / "__init__.py").read_text(),
+        "parallel_mesh": (REPO / "horovod_tpu"
+                          / "__init__.py").read_text(),
+        "pipeline_accumulate_gradients": pipe_src,
+        "pipeline_apply": pipe_src,
+        "pipeline_train_step_1f1b": pipe_src,
+        "select_last_stage": pipe_src,
+        "wired_ppermute": coll_src,
+        "tp_mlp": tp_src, "column_parallel": tp_src,
+        "row_parallel": tp_src, "shard_heads": tp_src,
+        "shard_head_rows": tp_src, "combine_slice_grads": tp_src,
+        "stack_stage_params": gpt_src, "pipeline_fns": gpt_src,
+    }
+    for name, src in api.items():
+        if f"def {name}" not in src and f"class {name}" not in src:
+            missing.append(f"pipeline api {name}: not found in source")
+            continue
+        for where, t in (("docs/pipeline.md", text),
+                         ("docs/api.md", api_text)):
+            if name not in t:
+                missing.append(f"pipeline api {name}: undocumented in "
+                               f"{where}")
+
+    # The optimizer surfaces must take the spec.
+    if "parallel=None" not in optim_src:
+        missing.append("pipeline: optim.py optimizer surfaces lack "
+                       "parallel=")
+    elif "parallel=" not in text:
+        missing.append("pipeline: the optimizer parallel= knob is "
+                       "undocumented in docs/pipeline.md")
+
+    # Metrics: the activation byte counter + the autotune gauge.
+    for metric, src, srcname in (
+            ("hvd_tpu_pipeline_activation_bytes_total", pipe_src,
+             "parallel/pipeline.py"),
+            ("hvd_tpu_autotune_pp_wire_index", tune_src,
+             "common/autotune.py")):
+        if metric not in src:
+            missing.append(f"pipeline metric {metric}: not registered "
+                           f"in {srcname}")
+        for where, t in (("docs/pipeline.md", text),
+                         ("docs/metrics.md", metrics_text)):
+            if metric not in t:
+                missing.append(f"pipeline metric {metric}: "
+                               f"undocumented in {where}")
+
+    # Knobs: config fields + env names documented.
+    for field, env in (("parallel", '"PARALLEL"'),
+                       ("pp_wire", '"PP_WIRE"'),
+                       ("pp_stages", '"PP_STAGES"'),
+                       ("tp", '"TP"')):
+        if f"{field}:" not in cfg_src or env not in cfg_src:
+            missing.append(f"pipeline: config.py lacks the {field} "
+                           "knob")
+    for knob in ("HVD_TPU_PARALLEL", "HVD_TPU_PP_WIRE",
+                 "HVD_TPU_PP_STAGES", "HVD_TPU_TP"):
+        if knob not in text:
+            missing.append(f"pipeline knob {knob}: undocumented in "
+                           "docs/pipeline.md")
+
+    # Autotune axis.
+    if "pp_wire_candidates" not in tune_src:
+        missing.append("pipeline: autotune.py lacks the pp_wire axis")
+    elif "pp_wire_candidates" not in text:
+        missing.append("pipeline: pp_wire_candidates undocumented in "
+                       "docs/pipeline.md")
+
+    # Bench arms + queue job + chaos family.
+    for flag in ('"--pipeline-stages"', '"--tp"', '"--pp-wire"'):
+        if flag not in bench_src:
+            missing.append(f"pipeline: bench.py lacks the {flag} flag")
+        elif flag.strip('"') not in text:
+            missing.append(f"pipeline bench flag {flag.strip(chr(34))}:"
+                           " undocumented in docs/pipeline.md")
+    if '"train_gpt_pp"' not in queue_src:
+        missing.append("pipeline: tpu_bench_queue.py lacks the "
+                       "train_gpt_pp job")
+    elif "train_gpt_pp" not in text:
+        missing.append("pipeline: the train_gpt_pp queue job is "
+                       "undocumented in docs/pipeline.md")
+    if "run_pipeline_soak" not in soak_src \
+            or '"pipeline"' not in soak_src:
+        missing.append("pipeline: chaos_soak.py lacks the pipeline "
+                       "family")
+    elif "--family pipeline" not in text:
+        missing.append("pipeline: chaos family undocumented in "
+                       "docs/pipeline.md")
+    if not (REPO / "tests" / "test_pipeline.py").exists():
+        missing.append("pipeline: tests/test_pipeline.py missing")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -732,6 +857,7 @@ def main() -> int:
     check_moe_surface(missing)
     check_serve_surface(missing)
     check_zero_surface(missing)
+    check_pipeline_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")
