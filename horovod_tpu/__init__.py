@@ -72,6 +72,7 @@ from .functions import allgather_object, broadcast_object, broadcast_variables
 from .parallel.pipeline import (pipeline_accumulate_gradients,
                                 pipeline_apply, pipeline_train_step_1f1b,
                                 select_last_stage)
+from .parallel.respec import RespecDecision, solve_respec
 from .parallel.spec import ParallelSpec
 from .parallel.tensor_parallel import (column_parallel,
                                        combine_slice_grads, row_parallel,

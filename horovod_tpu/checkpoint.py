@@ -519,10 +519,12 @@ def save_sharded(tree, directory: str, step: int,
     store one copy. Rides :class:`CheckpointManager`, so the CRC+size
     verify sidecar and the walk-back chain apply unchanged.
 
-    Restore with :func:`restore_sharded` in the SAME world layout
-    (size + shard specs); a world-size change goes through the
-    gathered full state instead (``ZeroOptimizer.gather_state`` /
-    ``reshard_state`` — the elastic journey)."""
+    Restore with :func:`restore_sharded`: the SAME world layout maps
+    pieces straight back onto their devices; a CHANGED shard grid (an
+    elastic respec — docs/elastic.md "hybrid worlds") reshards on
+    restore using the per-piece index boxes recorded in the meta
+    sidecar. A changed GLOBAL shape still goes through the gathered
+    full state (``ZeroOptimizer.gather_state`` / ``reshard_state``)."""
     leaves, _ = jax.tree.flatten(tree)
     arrays = {}
     meta = []
@@ -551,10 +553,15 @@ def save_sharded(tree, directory: str, step: int,
                     "state through the gathered full form "
                     "(ZeroOptimizer.gather_state, docs/zero.md)")
             ordered = sorted(shards, key=lambda s: s.device.id)
+            boxes = []
             for si, sh in enumerate(ordered):
                 arrays[f"l{li}_s{si}"] = np.asarray(
                     jax.device_get(sh.data))
-            meta.append(("sharded", len(ordered)))
+                boxes.append(_norm_index(sh.index, leaf.shape))
+            # 3-tuple meta: the index boxes make the pieces
+            # self-describing, so a DIFFERENT shard grid can reshard
+            # on restore (replicated duplicates dedupe by box).
+            meta.append(("sharded", len(ordered), boxes))
     # Meta sidecar FIRST: meta without arrays is harmless (restore
     # selects a verified array step and looks its meta up), arrays
     # without meta would turn a mid-save crash into an unrecoverable
@@ -585,9 +592,16 @@ def restore_sharded(template, directory: str):
     ``jax.Array`` leaves — e.g. freshly initialized shards/state in the
     resumed world, carrying the target shardings). Loads the latest
     VERIFIED step (the walk-back chain) and returns ``(tree, step)``.
-    Each piece is placed on its own device
-    (``make_array_from_single_device_arrays``) — the full value is
-    never assembled on one host."""
+
+    The template's shard grid need not match the checkpoint's: on a
+    mismatch (an elastic respec changed dp/pp/tp — docs/elastic.md
+    "hybrid worlds") each TARGET shard is assembled from the recorded
+    source pieces overlapping its index box and placed directly on its
+    own device — reshard-on-restore, with no full gather and no
+    full-value host assembly. Requires the index boxes
+    :func:`save_sharded` has recorded since schema'ing them into the
+    meta sidecar; older 2-tuple metas keep the strict same-grid
+    contract."""
     mgr = CheckpointManager(directory)
     try:
         restored = mgr.restore()
@@ -608,7 +622,9 @@ def restore_sharded(template, directory: str):
             f"recorded {len(meta)} — structure changed across the "
             "round-trip")
     out = []
-    for li, (leaf, (kind, nsh)) in enumerate(zip(leaves, meta)):
+    for li, (leaf, rec) in enumerate(zip(leaves, meta)):
+        kind, nsh = rec[0], rec[1]
+        boxes = rec[2] if len(rec) > 2 else None
         if kind == "replicated":
             val = arrays[f"l{li}"]
             sharding = getattr(leaf, "sharding", None)
@@ -617,17 +633,101 @@ def restore_sharded(template, directory: str):
             continue
         shards = sorted(leaf.addressable_shards,
                         key=lambda s: s.device.id)
-        if len(shards) != nsh:
-            raise ValueError(
-                f"leaf {li}: checkpoint holds {nsh} shards but the "
-                f"template's sharding has {len(shards)} — restore "
-                "into the SAME world layout, or go through the "
-                "gathered full state (docs/zero.md)")
+        # Same GRID means same piece count AND same per-position index
+        # boxes: an equal count over a different axis (a pp->tp respec
+        # on the same device set) must reshard, not pass pieces
+        # through positionally onto the wrong cells.
+        same_grid = len(shards) == nsh
+        if same_grid and boxes is not None:
+            same_grid = all(
+                _norm_index(sh.index, leaf.shape) ==
+                [list(b) for b in box]
+                for sh, box in zip(shards, boxes))
+        if not same_grid:
+            if boxes is None:
+                raise ValueError(
+                    f"leaf {li}: checkpoint holds {nsh} shards but the "
+                    f"template's sharding has {len(shards)} and the "
+                    "meta sidecar predates index boxes — restore into "
+                    "the SAME world layout, or go through the gathered "
+                    "full state (docs/zero.md)")
+            out.append(_reshard_on_restore(li, leaf, shards, arrays,
+                                           boxes))
+            continue
         pieces = [jax.device_put(arrays[f"l{li}_s{si}"], sh.device)
                   for si, sh in enumerate(shards)]
         out.append(jax.make_array_from_single_device_arrays(
             leaf.shape, leaf.sharding, pieces))
     return jax.tree.unflatten(treedef, out), step
+
+
+def _norm_index(index, shape):
+    """A Shard.index (tuple of slices into the global array) as
+    concrete ``[start, stop]`` pairs — picklable, comparable, and
+    valid without the live sharding."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _reshard_on_restore(li, leaf, shards, arrays, boxes):
+    """Assemble each TARGET shard of ``leaf`` from the checkpoint
+    pieces whose recorded index boxes overlap it (docs/elastic.md):
+    duplicates (replication across an unrelated mesh axis) dedupe by
+    box, every target cell must be covered exactly, and only
+    per-target-shard slices ever materialize on host — the full value
+    is never assembled, which is what lets a respec'd world restore a
+    bigger world's state without a gather."""
+    import numpy as np
+
+    # Dedupe replicated duplicates: one source piece per distinct box.
+    sources = {}
+    for si, box in enumerate(boxes):
+        sources.setdefault(tuple(tuple(b) for b in box), f"l{li}_s{si}")
+    implied = [max(b[1] for b in key) for key in zip(
+        *[k for k in sources])]
+    if list(leaf.shape) != implied:
+        raise ValueError(
+            f"leaf {li}: checkpoint global shape {implied} vs template "
+            f"{list(leaf.shape)} — reshard-on-restore remaps shard "
+            "grids, not shapes; a changed global goes through the "
+            "gathered full state (docs/zero.md)")
+    pieces = []
+    for sh in shards:
+        tbox = _norm_index(sh.index, leaf.shape)
+        key = tuple(tuple(b) for b in tbox)
+        if key in sources:            # exact grid cell — pass through
+            val = arrays[sources[key]]
+        else:
+            dtype = arrays[next(iter(sources.values()))].dtype
+            val = np.zeros([hi - lo for lo, hi in tbox], dtype=dtype)
+            covered = 0
+            for sbox, name in sources.items():
+                ov = [(max(tl, sl), min(th, sh_)) for (tl, th), (sl, sh_)
+                      in zip(tbox, sbox)]
+                if any(hi <= lo for lo, hi in ov):
+                    continue
+                src_sl = tuple(slice(lo - sl, hi - sl) for (lo, hi),
+                               (sl, _) in zip(ov, sbox))
+                dst_sl = tuple(slice(lo - tl, hi - tl) for (lo, hi),
+                               (tl, _) in zip(ov, tbox))
+                val[dst_sl] = arrays[name][src_sl]
+                vol = 1
+                for lo, hi in ov:
+                    vol *= hi - lo
+                covered += vol
+            if covered != val.size:
+                raise ValueError(
+                    f"leaf {li}: target shard {tbox} only covered "
+                    f"{covered}/{val.size} cells by the checkpoint's "
+                    "pieces — the recorded shard grid does not tile "
+                    "the template's global shape")
+        pieces.append(jax.device_put(val, sh.device))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, pieces)
 
 
 def _jnp_asarray(x):

@@ -58,14 +58,22 @@ Three pieces (docs/autoscale.md):
 
   Every decision increments
   ``hvd_tpu_autoscale_decisions_total{action=}`` (pre-seeded to 0 for
-  all four actions) and every non-keep decision is appended to the
+  every action) and every non-keep decision is appended to the
   JSON-lines decision log (``HVD_TPU_AUTOSCALE_LOG``) with
   DETERMINISTIC fields only — ``{"seq", "action", "target", "reason"}``
-  — so a seeded chaos run replays to a byte-identical log
-  (tools/chaos_soak.py --family autoscale).
+  plus ``role`` when a ParallelSpec makes it derivable — so a seeded
+  chaos run replays to a byte-identical log (tools/chaos_soak.py
+  --family autoscale / hybrid).
 
-``min_np`` is a hard floor: no evict/shrink decision may take the
-usable slot count below it; blocked decisions degrade to ``keep``.
+``min_np`` is a hard floor for VOLUNTARY reshapes: no evict/shrink
+decision may take the usable slot count below it; blocked decisions
+degrade to ``keep``. Hybrid worlds (docs/elastic.md): with a declared
+ParallelSpec the engine additionally validates the floor to whole
+pp x tp replicas, groups straggler scoring by dp replica (convicting
+the HOST of the strictly slowest rank, never its 1F1B-stalled
+pipeline peers), and re-solves the mesh per epoch through
+``plan_respec`` — the fifth action, ``respec``, whose target is the
+solved spec string.
 """
 
 from __future__ import annotations
@@ -88,7 +96,7 @@ ENV_LOG = "HVD_TPU_AUTOSCALE_LOG"       # driver-side decision log (JSONL)
 
 KV_SCOPE = "autoscale"                  # rendezvous KV scope for reports
 
-ACTIONS = ("keep", "grow", "shrink", "evict")
+ACTIONS = ("keep", "grow", "shrink", "evict", "respec")
 
 # Telemetry (docs/metrics.md / docs/autoscale.md). Pre-seeding every
 # action at 0 makes "no decision yet" distinguishable from "metrics
@@ -163,6 +171,13 @@ class AutoscalePolicy:
     # Shrink a rank's host once its published divergence-resync counter
     # grows by this much (0 = off).
     max_divergence_resyncs: int = 0
+    # Hard world floor for VOLUNTARY reshapes (evict/shrink). 0 = the
+    # driver's --min-np wins. With a hybrid ParallelSpec active the
+    # effective floor must be a whole number of model replicas — a
+    # multiple of pp x tp (x ep) — and the engine REJECTS any other
+    # value at construction (docs/elastic.md "hybrid worlds"):
+    # stranding a partial pipeline would orphan its peers' shards.
+    min_np: int = 0
 
     @classmethod
     def field_names(cls) -> Tuple[str, ...]:
@@ -222,12 +237,35 @@ class AutoscalePolicy:
                 f"> 1.0 (a ratio at/below 1 flags every rank), got "
                 f"{self.straggler_ratio}")
         for name in ("evict_permanent_after", "max_blacklist_strikes",
-                     "max_divergence_resyncs"):
+                     "max_divergence_resyncs", "min_np"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"autoscale policy: field {name!r} must be >= 0 "
                     f"(0 disables), got {getattr(self, name)}")
         return self
+
+    def resolve_min_np(self, engine_min_np: int, parallel=None) -> int:
+        """The effective voluntary-reshape floor: the policy's
+        ``min_np`` when set, else the driver's. With a ParallelSpec
+        active the floor must hold WHOLE model replicas — any value
+        that is not a multiple of the replica size is rejected with a
+        message naming the roles (the ISSUE 14 satellite: a --min-np 3
+        on a pp=2,tp=2 world would strand partial pipelines whose
+        peers' shards nothing can serve)."""
+        floor = self.min_np if self.min_np > 0 else int(engine_min_np)
+        if parallel is None:
+            return floor
+        replica = parallel.replica_ranks
+        if replica > 1 and floor % replica != 0:
+            roles = ", ".join(f"{r}={s}" for r, s in parallel.dims
+                              if r != "dp")
+            raise ValueError(
+                f"autoscale policy: min_np={floor} is not a multiple "
+                f"of the model-replica size {replica} (roles {roles} "
+                f"from parallel spec {parallel.describe()!r}) — a "
+                "floor that splits a replica strands partial "
+                f"pipelines; use {replica}, {2 * replica}, ...")
+        return floor
 
     @classmethod
     def from_json(cls, text: str) -> "AutoscalePolicy":
@@ -322,6 +360,8 @@ class StepReport:
     comm_fraction: Optional[float] = None
     resyncs: int = 0             # divergence_resyncs from RecoveryStats
     t: float = 0.0               # worker wall time at publication
+    role: Optional[str] = None   # "dp1/pp0/tp1" when a ParallelSpec is
+    #                              active (ParallelSpec.role_label)
 
     @classmethod
     def from_json(cls, raw: bytes) -> Optional["StepReport"]:
@@ -333,15 +373,34 @@ class StepReport:
                        last=float(d.get("last", 0.0)),
                        comm_fraction=d.get("comm_fraction"),
                        resyncs=int(d.get("resyncs", 0)),
-                       t=float(d.get("t", 0.0)))
+                       t=float(d.get("t", 0.0)),
+                       role=(str(d["role"]) if d.get("role") else None))
         except (ValueError, KeyError, TypeError, UnicodeDecodeError):
             return None  # a torn/foreign record must not kill the engine
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
-        if d.get("comm_fraction") is None:
-            d.pop("comm_fraction", None)
+        for opt in ("comm_fraction", "role"):
+            if d.get(opt) is None:
+                d.pop(opt, None)
         return json.dumps(d, sort_keys=True)
+
+
+def _role_from_env(rank: int) -> Optional[str]:
+    """This worker's (dp,pp,tp) coordinate label when a hybrid
+    ParallelSpec is declared (docs/pipeline.md) — stamped on every
+    step report so the driver's attribution can tell a straggling
+    host from its 1F1B-stalled pipeline peers. None when role-blind
+    (no spec, or the rank lies outside it mid-reshape)."""
+    try:
+        from ..parallel.spec import spec_from_env
+
+        spec = spec_from_env()
+        if spec is not None and 0 <= int(rank) < spec.total:
+            return spec.role_label(int(rank))
+    except Exception:  # noqa: BLE001 — telemetry must not kill a worker
+        pass
+    return None
 
 
 def _comm_fraction_from_metrics() -> Optional[float]:
@@ -374,12 +433,14 @@ class StepPublisher:
 
     def __init__(self, client, rank: int, host: str,
                  window: int = 32, publish_interval_s: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 role: Optional[str] = None):
         from collections import deque
 
         self._client = client
         self.rank = rank
         self.host = host
+        self.role = role if role is not None else _role_from_env(rank)
         self._window = deque(maxlen=max(1, int(window)))
         self._interval = publish_interval_s
         self._clock = clock
@@ -464,7 +525,7 @@ class StepPublisher:
             n=len(vals), p50=p50,
             mean=sum(vals) / len(vals), last=last_dt,
             comm_fraction=_comm_fraction_from_metrics(),
-            resyncs=int(resyncs), t=time.time())
+            resyncs=int(resyncs), t=time.time(), role=self.role)
 
     def _publish(self, report: StepReport) -> None:
         try:
@@ -509,18 +570,24 @@ class Decision:
     deterministic decision-log sequence); keeps carry seq 0."""
 
     action: str
-    target: Optional[str] = None    # hostname, or str(n) for grow
+    target: Optional[str] = None    # hostname, str(n) for grow, or the
+    #                                 solved spec string for respec
     reason: str = ""                # stable code, not measured numbers
     permanent: bool = False
     ttl_s: Optional[float] = None
     seq: int = 0
+    role: Optional[str] = None      # convicted rank's (dp,pp,tp) label
 
     def log_line(self) -> str:
         """Deterministic JSON-lines form — no timestamps, no measured
-        floats: the byte-identity contract of the chaos soak."""
-        return json.dumps({"seq": self.seq, "action": self.action,
-                           "target": self.target, "reason": self.reason},
-                          sort_keys=True)
+        floats: the byte-identity contract of the chaos soak. ``role``
+        appears only when a ParallelSpec made it derivable (rank ->
+        coordinates is pure arithmetic, so it stays deterministic)."""
+        d = {"seq": self.seq, "action": self.action,
+             "target": self.target, "reason": self.reason}
+        if self.role is not None:
+            d["role"] = self.role
+        return json.dumps(d, sort_keys=True)
 
 
 class AutoscaleEngine:
@@ -536,10 +603,33 @@ class AutoscaleEngine:
     def __init__(self, policy: AutoscalePolicy, min_np: int, max_np: int,
                  fetch_reports: Callable[[], Dict[int, StepReport]],
                  clock: Callable[[], float] = time.monotonic,
-                 log_path: Optional[str] = None):
+                 log_path: Optional[str] = None,
+                 parallel=None):
         self.policy = policy
-        self.min_np = min_np
+        # Hybrid worlds (docs/elastic.md): the declared ParallelSpec
+        # makes the engine role-aware — straggler scoring groups ranks
+        # by dp replica (1F1B stalls a replica COLLECTIVELY, so
+        # per-rank scoring would convict innocent pipeline peers), the
+        # voluntary-reshape floor is validated to whole replicas, and
+        # pre-epoch capacity changes re-solve the mesh through the
+        # respec ladder (parallel/respec.py).
+        from ..parallel.spec import ParallelSpec
+
+        self.parallel = ParallelSpec.resolve(parallel)
+        self.min_np = policy.resolve_min_np(min_np, self.parallel)
         self.max_np = max_np
+        self._current_spec = self.parallel
+        # The involuntary-survival floor: how far the respec ladder can
+        # legally fold the declared mesh (the driver waits at this, not
+        # at min_np, when capacity is LOST rather than evicted).
+        if self.parallel is not None:
+            from ..parallel import respec as respec_lib
+
+            self.min_world = (respec_lib.min_world(self.parallel)
+                              if respec_lib.respec_enabled()
+                              else self.parallel.total)
+        else:
+            self.min_world = None
         self._fetch = fetch_reports
         self._clock = clock
         self._log_path = (log_path if log_path is not None
@@ -550,6 +640,7 @@ class AutoscaleEngine:
         self._lock = threading.Lock()
         # Engine memory (spans epochs).
         self._strikes: Dict[str, int] = {}           # straggler strikes
+        self._convicted_role: Dict[str, str] = {}    # host -> role label
         self._last_step: Dict[Tuple[str, int], int] = {}
         self._last_advance: Dict[str, float] = {}    # host -> clock()
         self._resync_base: Dict[Tuple[str, int], int] = {}
@@ -723,11 +814,18 @@ class AutoscaleEngine:
         if len(advanced) >= p.min_ranks:
             import statistics
 
-            med = statistics.median(r.p50 for r in advanced)
-            if med > 0:
-                for r in advanced:
-                    if r.p50 > p.straggler_ratio * med:
-                        flagged.add(r.host)
+            if self.parallel is not None:
+                # Role-aware scoring (docs/elastic.md "hybrid worlds"):
+                # replicas are compared, and the conviction lands on
+                # ONE host inside the slow replica — not on its 1F1B
+                # pipeline peers.
+                flagged = self._flag_by_replica(advanced)
+            else:
+                med = statistics.median(r.p50 for r in advanced)
+                if med > 0:
+                    for r in advanced:
+                        if r.p50 > p.straggler_ratio * med:
+                            flagged.add(r.host)
             for host in set(r.host for r in advanced):
                 if host in flagged:
                     self._strikes[host] = self._strikes.get(host, 0) + 1
@@ -751,12 +849,14 @@ class AutoscaleEngine:
                              and count >= p.evict_permanent_after)
                 if permanent:
                     self._permanent.add(host)
+                role = self._convicted_role.get(host)
                 self._purge_host(host)
                 self._last_evict_t = now
                 decisions.append(self._record(Decision(
                     action="evict", target=host, reason="straggler",
                     permanent=permanent,
-                    ttl_s=None if permanent else p.evict_ttl_s)))
+                    ttl_s=None if permanent else p.evict_ttl_s,
+                    role=role)))
                 break  # one eviction per tick — reshape, re-measure
 
         # Remember the freshest comm fraction for the grow gate.
@@ -769,6 +869,79 @@ class AutoscaleEngine:
             self._record(Decision(action="keep"))
         return decisions
 
+    def _flag_by_replica(self, advanced: List[StepReport]) -> set:
+        """Role-aware straggler scoring: group advancing ranks by dp
+        replica (coordinates from the CURRENT — possibly re-solved —
+        spec), compare each replica's median p50 against the median of
+        the OTHER replicas' medians (a 2-replica world must not let
+        the straggler pollute its own baseline), and within a flagged
+        replica convict only the STRICTLY slowest rank's host. A
+        uniformly slow replica has no distinguishable source — that is
+        a stall signature, not a straggler — and stays unconvicted."""
+        import statistics
+
+        spec = self._current_spec or self.parallel
+        groups: Dict[int, List[StepReport]] = {}
+        for r in advanced:
+            if 0 <= r.rank < spec.total:
+                groups.setdefault(spec.replica_of(r.rank), []).append(r)
+        flagged: set = set()
+        if len(groups) < 2:
+            return flagged       # nothing to compare a replica against
+        med_by_rep = {k: statistics.median([r.p50 for r in v])
+                      for k, v in groups.items()}
+        for rep in sorted(groups):
+            others = [m for k, m in med_by_rep.items() if k != rep]
+            base = statistics.median(others)
+            if base <= 0 or med_by_rep[rep] <= \
+                    self.policy.straggler_ratio * base:
+                continue
+            members = sorted(groups[rep],
+                             key=lambda r: (-r.p50, r.rank))
+            worst = members[0]
+            if len(members) > 1 and worst.p50 <= members[1].p50:
+                continue         # no strict maximum -> no conviction
+            flagged.add(worst.host)
+            self._convicted_role[worst.host] = (
+                worst.role or spec.role_label(worst.rank))
+        return flagged
+
+    def plan_respec(self, capacity: int) -> Optional[Any]:
+        """Driver hook, called with the usable slot count before a new
+        epoch's assignments: re-solve the hybrid mesh for the surviving
+        capacity through the preference ladder (parallel/respec.py).
+        Returns the :class:`~..parallel.respec.RespecDecision` when the
+        solved spec DIFFERS from the running one — the driver then
+        re-exports ``HVD_TPU_PARALLEL`` and caps np at ``.np`` so the
+        assigned world factors the mesh exactly. None when role-blind,
+        the solver is disabled, no permitted rung fits, or the shape
+        already matches. Every applied reshape appends a deterministic
+        ``respec`` decision-log line and bumps
+        ``hvd_tpu_respec_total{from,to}``."""
+        if self.parallel is None:
+            return None
+        from ..parallel import respec as respec_lib
+
+        if not respec_lib.respec_enabled():
+            return None
+        dec = respec_lib.solve_respec(self.parallel,
+                                      min(int(capacity), self.max_np))
+        if dec is None or dec.spec == self._current_spec:
+            return None
+        prev = self._current_spec
+        self._current_spec = dec.spec
+        respec_lib.note_respec(prev.describe(), dec.spec.describe())
+        self._record(Decision(
+            action="respec", target=dec.spec.describe(),
+            reason=dec.action if dec.action != "keep" else "restore"))
+        return dec
+
+    @property
+    def current_spec(self):
+        """The spec the engine believes is RUNNING (the declared one
+        until a plan_respec reshapes it; re-solved back on recovery)."""
+        return self._current_spec
+
     def _slots_after_evict(self, usable: Dict[str, int],
                            host: str) -> int:
         return sum(s for h, s in usable.items() if h != host)
@@ -778,6 +951,7 @@ class AutoscaleEngine:
         it must earn `patience` FRESH advancing flags again (stale
         pre-eviction reports cannot re-convict it)."""
         self._strikes.pop(host, None)
+        self._convicted_role.pop(host, None)
         self._last_advance.pop(host, None)
         for key in [k for k in self._last_step if k[0] == host]:
             self._last_step.pop(key, None)

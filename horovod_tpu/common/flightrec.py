@@ -32,9 +32,10 @@ answers it without a live trace session:
   allreduce for bucket 12 at step 4812".
 
 Knobs (docs/podmon.md): ``HVD_TPU_FLIGHTREC`` (default on),
-``HVD_TPU_FLIGHTREC_SIZE``, ``HVD_TPU_FLIGHTREC_DIR`` (default ``.``),
-``HVD_TPU_FLIGHTREC_PUSH`` (KV push, default on when
-``HVD_TPU_RENDEZVOUS`` is set).
+``HVD_TPU_FLIGHTREC_SIZE``, ``HVD_TPU_FLIGHTREC_DIR`` (default
+``results/flightrec/`` — gitignored, so chaos-run boxes never land as
+strays at the repo root), ``HVD_TPU_FLIGHTREC_PUSH`` (KV push, default
+on when ``HVD_TPU_RENDEZVOUS`` is set).
 
 Stdlib-only at import (same contract as common/metrics.py) so the
 eager engine, the stall inspector, and ``tools/check_parity.py`` can
@@ -63,11 +64,14 @@ KV_SCOPE = "flightrec"          # rendezvous KV scope for pushed boxes
 
 # Black-box schema: ONE JSON object per dump. tools/flight_diff.py
 # carries the same two tuples and check_parity asserts they match —
-# the schema cannot drift between writer and reader.
-BLACKBOX_SCHEMA_VERSION = 1
-BLACKBOX_KEYS = ("schema", "rank", "host", "pid", "trigger", "reason",
-                 "t_unix", "step", "seq_head", "events", "stacks",
-                 "stall_inflight", "recovery")
+# the schema cannot drift between writer and reader. v2 adds ``role``:
+# the rank's (dp,pp,tp) coordinate label under a hybrid ParallelSpec
+# ("" when role-blind), so a post-mortem names the STAGE, not just a
+# rank number (docs/elastic.md "hybrid worlds").
+BLACKBOX_SCHEMA_VERSION = 2
+BLACKBOX_KEYS = ("schema", "rank", "host", "role", "pid", "trigger",
+                 "reason", "t_unix", "step", "seq_head", "events",
+                 "stacks", "stall_inflight", "recovery")
 EVENT_KEYS = ("seq", "op", "name", "step", "bytes", "wire",
               "t_submit", "t_complete", "outcome")
 
@@ -133,8 +137,11 @@ class FlightRecorder:
         if size is None:
             size = 256
         self.size = max(8, int(size))
+        # Default under results/ (gitignored): chaos runs used to strew
+        # blackbox.rank*.json at whatever cwd the job died in.
         self.directory = (directory if directory is not None
-                          else os.environ.get(ENV_DIR) or ".")
+                          else os.environ.get(ENV_DIR)
+                          or os.path.join("results", "flightrec"))
         # Virtual-identity convention (same as podmon.register_endpoint
         # and the autoscale publisher): HVD_TPU_PROC_ID wins even over
         # an explicit rank — FORCE_LOCAL workers are 1-proc jax worlds
@@ -149,6 +156,18 @@ class FlightRecorder:
         self.rank = int(rank) if rank is not None else 0
         self.host = (host if host is not None
                      else os.environ.get("HVD_TPU_HOSTNAME", ""))
+        # Role label under a hybrid ParallelSpec (schema v2): the
+        # post-mortem names "rank 3 = dp0/pp1/tp1", so a hung ppermute
+        # points at a STAGE, not a bare number. "" when role-blind.
+        self.role = ""
+        try:
+            from ..parallel.spec import spec_from_env
+
+            spec = spec_from_env()
+            if spec is not None and 0 <= self.rank < spec.total:
+                self.role = spec.role_label(self.rank)
+        except Exception:  # noqa: BLE001 — the recorder must construct
+            self.role = ""
         self._push = push
         self._lock = threading.Lock()
         self._ring: List[Optional[_Event]] = [None] * self.size
@@ -264,6 +283,7 @@ class FlightRecorder:
             "schema": BLACKBOX_SCHEMA_VERSION,
             "rank": self.rank,
             "host": self.host,
+            "role": self.role,
             "pid": os.getpid(),
             "trigger": trigger,
             "reason": reason,

@@ -52,6 +52,10 @@ ENV_PORT = "HVD_TPU_POD_METRICS_PORT"
 ENV_INTERVAL = "HVD_TPU_POD_METRICS_INTERVAL_S"
 ENV_ENDPOINTS = "HVD_TPU_POD_METRICS_ENDPOINTS"
 ENV_ADVERTISE = "HVD_TPU_METRICS_ADVERTISE"
+# Role-grouped skew threshold: a dp replica whose median step time
+# exceeds this ratio x the median of the OTHER replicas' medians is
+# flagged stalled (hvd_tpu_pod_replica_stalled — docs/podmon.md).
+ENV_REPLICA_RATIO = "HVD_TPU_POD_REPLICA_SKEW_RATIO"
 
 KV_SCOPE = "podmon"                 # rendezvous KV scope for endpoints
 
@@ -63,6 +67,7 @@ POD_STEP_TIME = "hvd_tpu_pod_step_time_seconds"
 POD_RANKS = "hvd_tpu_pod_ranks_scraped"
 POD_ERRORS = "hvd_tpu_pod_scrape_errors_total"
 POD_STAT = "hvd_tpu_pod_stat"
+POD_REPLICA_STALLED = "hvd_tpu_pod_replica_stalled"
 
 
 # -- worker side: endpoint advertisement -------------------------------------
@@ -247,8 +252,21 @@ class PodMonitor:
     def __init__(self, endpoints_fn: Callable[[], List[str]],
                  interval_s: Optional[float] = None,
                  timeout_s: float = 2.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 parallel=None):
         self._endpoints = endpoints_fn
+        # Hybrid worlds (docs/elastic.md): with a ParallelSpec declared
+        # (explicitly or via HVD_TPU_PARALLEL) every per-rank series
+        # carries its (dp,pp,tp) labels and the role-grouped replica
+        # skew feeds hvd_tpu_pod_replica_stalled{replica}.
+        if parallel is None:
+            try:
+                from ..parallel.spec import spec_from_env
+
+                parallel = spec_from_env()
+            except Exception:  # noqa: BLE001 — the scraper must start
+                parallel = None
+        self.parallel = parallel
         if interval_s is None:
             try:
                 interval_s = float(os.environ.get(ENV_INTERVAL, "2.0"))
@@ -405,12 +423,50 @@ class PodMonitor:
         for fname, vals in per_family.items():
             stats[fname] = {"min": min(vals), "max": max(vals),
                             "p50": statistics.median(vals)}
+        # Role view (docs/elastic.md "hybrid worlds"): rank -> (dp,pp,
+        # tp) coordinates, plus role-grouped replica medians and the
+        # stalled-replica flags the POD_REPLICA_STALLED gauge serves —
+        # a replica whose ranks are COLLECTIVELY slow (the 1F1B
+        # signature of one bad member) is named as a replica, while
+        # slowest_rank keeps naming the individual laggard.
+        roles: Dict[int, str] = {}
+        coords: Dict[int, Dict[str, int]] = {}
+        replica_step: Dict[int, float] = {}
+        stalled: List[int] = []
+        if self.parallel is not None:
+            for r in sorted(ranks):
+                if 0 <= r < self.parallel.total:
+                    roles[r] = self.parallel.role_label(r)
+                    coords[r] = self.parallel.coords(r)
+            groups: Dict[int, List[float]] = {}
+            for r, st in step_times.items():
+                if r in coords:
+                    groups.setdefault(coords[r].get("dp", 0),
+                                      []).append(st)
+            replica_step = {k: statistics.median(v)
+                            for k, v in groups.items()}
+            if len(replica_step) >= 2:
+                try:
+                    ratio = float(os.environ.get(ENV_REPLICA_RATIO,
+                                                 "1.5"))
+                except ValueError:
+                    ratio = 1.5
+                for rep in sorted(replica_step):
+                    others = [m for k, m in replica_step.items()
+                              if k != rep]
+                    base = statistics.median(others)
+                    if base > 0 and replica_step[rep] > ratio * base:
+                        stalled.append(rep)
         return {
             "ranks": sorted(ranks),
             "hosts": {r: rec.get("host", "") for r, rec in ranks.items()},
             "step_time_seconds": step_times,
             "step_skew_seconds": skew,
             "slowest_rank": slowest,
+            "roles": roles,
+            "role_coords": coords,
+            "replica_step_time_seconds": replica_step,
+            "stalled_replicas": stalled,
             "family_stats": stats,
             "scrapes": scrapes,
             "scrape_errors": errors,
@@ -430,10 +486,28 @@ class PodMonitor:
             for labels, value in samples:
                 lines.append(metrics_lib._sample_line(name, labels, value))
 
+        def rank_labels(r):
+            labels = {"rank": str(r), "host": m["hosts"].get(r, "")}
+            # Role labels (docs/elastic.md): dp/pp/tp coordinates on
+            # every per-rank series, so dashboards group by replica or
+            # stage without a rank->role lookup table.
+            for role, idx in m["role_coords"].get(r, {}).items():
+                labels[role] = str(idx)
+            return labels
+
         emit(POD_STEP_TIME, "gauge",
              "per-rank step time as seen by the pod aggregator",
-             [({"rank": str(r), "host": m["hosts"].get(r, "")}, v)
+             [(rank_labels(r), v)
               for r, v in sorted(m["step_time_seconds"].items())])
+        if m["replica_step_time_seconds"]:
+            emit(POD_REPLICA_STALLED, "gauge",
+                 "1 when a dp replica's role-grouped median step time "
+                 "exceeds HVD_TPU_POD_REPLICA_SKEW_RATIO x the median "
+                 "of its peer replicas (the 1F1B collective-stall "
+                 "signature)",
+                 [({"replica": str(k)},
+                   1.0 if k in m["stalled_replicas"] else 0.0)
+                  for k in sorted(m["replica_step_time_seconds"])])
         emit(POD_SKEW, "gauge",
              "max-min spread of per-rank step time across the pod",
              [({}, m["step_skew_seconds"])])
@@ -497,12 +571,16 @@ class PodMonitor:
                         total += float(v.get("sum", 0.0))
                         if s.get("labels", {}).get("phase") == "comm":
                             comm += float(v.get("sum", 0.0))
+            role = None
+            if self.parallel is not None and 0 <= r < \
+                    self.parallel.total:
+                role = self.parallel.role_label(r)
             out[r] = StepReport(
                 rank=r, host=rec.get("host", ""),
                 step=step_count_from_snapshot(snap),
                 n=1, p50=float(p50), mean=float(p50), last=float(p50),
                 comm_fraction=(comm / total if total > 0 else None),
-                resyncs=int(resyncs), t=rec.get("t", 0.0))
+                resyncs=int(resyncs), t=rec.get("t", 0.0), role=role)
         return out
 
 

@@ -3307,7 +3307,11 @@ class ZeroOptimizer:
 
     def reshard_state(self, state_full):
         """Full (gathered) state -> this world's shards (inside the NEW
-        world's SPMD region, whatever its size or route)."""
+        world's SPMD region, whatever its size or route). This is the
+        gather-then-reshard leg of the elastic journey; when only the
+        SHARD GRID changed (an elastic respec — docs/elastic.md
+        "hybrid worlds") ``checkpoint.restore_sharded`` remaps the
+        saved pieces directly instead, with no gather at all."""
         if self.zero_stage < 3:
             return self._z1.reshard_state(state_full)
         self._require_bound("reshard_state")

@@ -9,10 +9,12 @@ from .moe import moe_layer, top2_gating  # noqa: F401
 from .pipeline import (pipeline_accumulate_gradients,  # noqa: F401
                        pipeline_apply, pipeline_train_step_1f1b,
                        select_last_stage)
+from .respec import (RespecDecision, min_world,  # noqa: F401
+                     solve_respec)
 from .ring_attention import (ring_attend_fn,  # noqa: F401
                              ring_attention)
 from .spec import (ParallelSpec, hybrid_param_specs,  # noqa: F401
-                   hybrid_state_specs)
+                   hybrid_state_specs, spec_from_env)
 from .tensor_parallel import (column_parallel,  # noqa: F401
                               combine_slice_grads, row_parallel,
                               shard_column, shard_head_rows,

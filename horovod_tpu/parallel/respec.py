@@ -1,0 +1,233 @@
+"""Elastic reshape solver — re-solving dp x pp x tp after capacity loss.
+
+The elastic plane (common/elastic.py, runner/elastic_driver.py) treats
+the world as a FLAT rank count: lose a host, rerun with ``np - slots``.
+Under a hybrid :class:`~.spec.ParallelSpec` that is wrong twice over —
+a lost host orphans an entire dp replica (its pp/tp peers hold param
+shards nothing else has), and an arbitrary surviving count may admit NO
+valid dp x pp x tp factorization at all (7 ranks cannot host a 2x2x2
+mesh). This module makes the mesh shape a *survivable* degree of
+freedom: given the DECLARED spec and the surviving capacity, it
+deterministically re-solves the spec through an explicit preference
+ladder (docs/elastic.md "hybrid worlds"):
+
+``shed_dp``
+    Drop whole data-parallel replicas first — the cheapest rung: the
+    model still fits exactly, only throughput shrinks. Refuses to go
+    below ``min_dp`` (``HVD_TPU_RESPEC_MIN_DP``).
+``fold_pp``
+    Fold pipeline stages onto fewer ranks (2 stages' params on 1 rank):
+    ``pp`` drops to its largest proper divisor that fits, preferring
+    the FEWEST folds. Memory per rank grows; the schedule shortens.
+``drop_tp``
+    Give up tensor-parallel width: ``tp`` drops to a smaller divisor,
+    each rank holding wider weight slices.
+``dp_only``
+    Degraded-mode survival: every non-dp role collapses to 1 and the
+    world runs as a flat dp mesh over whatever capacity remains.
+
+Every rung yields a VALID mesh by construction (all sizes >= 1, folded
+sizes divide the declared ones, total <= capacity); a rung that cannot
+fit defers to the next. When capacity covers the declared spec the
+solver answers ``keep`` — so capacity recovery re-solves back to the
+declared shape through the same call.
+
+Knobs (docs/elastic.md):
+
+* ``HVD_TPU_RESPEC`` — enable the solver in the elastic control plane
+  (default on whenever a parallel spec is active; ``0`` pins the
+  declared mesh and the driver simply waits for capacity).
+* ``HVD_TPU_RESPEC_ORDER`` — comma list of permitted rungs in
+  preference order (default ``shed_dp,fold_pp,drop_tp,dp_only``);
+  removing a rung forbids that degradation.
+* ``HVD_TPU_RESPEC_MIN_DP`` — replica floor for the shed/fold/drop
+  rungs (default 1); ``dp_only`` ignores it (it is the last resort).
+
+Telemetry: ``hvd_tpu_respec_total{from,to}`` counts every applied
+reshape (docs/metrics.md).
+
+State migration rides the sharded-checkpoint machinery: the new world
+restores the old world's CRC-verified shards with
+``checkpoint.restore_sharded`` (reshard-on-restore remaps changed
+shard grids piece-by-piece — no full gather), so ZeRO-per-stage
+shards, int8_ef residuals and the guard's loss-scale scalar all land
+on the re-solved mesh (docs/elastic.md).
+
+Stdlib-only at import (the driver process has no jax session).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+from ..common import metrics as metrics_lib
+from .spec import ParallelSpec
+
+ENV_ENABLE = "HVD_TPU_RESPEC"
+ENV_ORDER = "HVD_TPU_RESPEC_ORDER"
+ENV_MIN_DP = "HVD_TPU_RESPEC_MIN_DP"
+
+# The preference ladder, in its canonical (and default) order.
+RUNGS = ("shed_dp", "fold_pp", "drop_tp", "dp_only")
+
+_M_RESPEC = metrics_lib.counter(
+    "hvd_tpu_respec_total",
+    "applied elastic mesh reshapes by (from,to) parallel spec",
+    labels=("from", "to"))
+
+
+@dataclasses.dataclass(frozen=True)
+class RespecDecision:
+    """One solver answer: the rung that fired (``keep`` when the
+    declared spec still fits), the solved spec, and its world size."""
+
+    action: str              # keep | shed_dp | fold_pp | drop_tp | dp_only
+    spec: ParallelSpec
+    np: int                  # spec.total — the world the driver assigns
+
+    def describe(self) -> str:
+        return f"{self.action}:{self.spec.describe()}"
+
+
+def note_respec(prev: str, new: str) -> None:
+    """Count an APPLIED reshape (called by the control plane when a
+    solved spec actually replaces the running one)."""
+    _M_RESPEC.labels(**{"from": prev, "to": new}).inc()
+
+
+def respec_enabled(env=None) -> bool:
+    env = os.environ if env is None else env
+    raw = (env.get(ENV_ENABLE) or "").strip().lower()
+    return raw not in ("0", "false", "no", "off")
+
+
+def respec_order(env=None) -> Tuple[str, ...]:
+    """The permitted rungs, validated — an unknown rung name raises
+    (a typo'd order silently pinning the mesh would be worse)."""
+    env = os.environ if env is None else env
+    raw = env.get(ENV_ORDER)
+    if not raw:
+        return RUNGS
+    rungs = tuple(p.strip() for p in raw.split(",") if p.strip())
+    bad = [r for r in rungs if r not in RUNGS]
+    if bad:
+        raise ValueError(
+            f"{ENV_ORDER}: unknown rung(s) {bad}; choose from {RUNGS}")
+    return rungs
+
+
+def respec_min_dp(env=None) -> int:
+    env = os.environ if env is None else env
+    try:
+        return max(1, int(env.get(ENV_MIN_DP, "1")))
+    except ValueError:
+        return 1
+
+
+def _divisors_desc(n: int) -> list:
+    """Proper divisors of n, largest first (the fewest-folds order)."""
+    return [d for d in range(n - 1, 0, -1) if n % d == 0]
+
+
+def _rebuild(spec: ParallelSpec, sizes: dict) -> ParallelSpec:
+    """The declared spec with per-role sizes overridden — role ORDER
+    (slow -> fast placement) is preserved, so the solved mesh keeps
+    the declared axis names and link placement."""
+    return ParallelSpec(tuple((r, int(sizes.get(r, s)))
+                              for r, s in spec.dims))
+
+
+def solve_respec(spec: ParallelSpec, capacity: int,
+                 min_dp: Optional[int] = None,
+                 order: Optional[Sequence[str]] = None
+                 ) -> Optional[RespecDecision]:
+    """Deterministically re-solve ``spec`` for ``capacity`` surviving
+    slots. Returns the first rung (in ``order``) that admits a valid
+    mesh, or None when no permitted rung fits (capacity < 1, or the
+    configured order forbids every viable degradation) — the caller
+    then waits for capacity instead of reshaping.
+
+    Invariants (property-tested in tests/test_respec.py): the returned
+    spec's total is <= capacity, every size >= 1, pp/tp sizes divide
+    the declared ones, and the same (spec, capacity, knobs) always
+    returns the same answer.
+    """
+    if min_dp is None:
+        min_dp = respec_min_dp()
+    rungs = tuple(order) if order is not None else respec_order()
+    bad = [r for r in rungs if r not in RUNGS]
+    if bad:
+        raise ValueError(f"unknown respec rung(s) {bad}; choose from "
+                         f"{RUNGS}")
+    capacity = int(capacity)
+    if capacity < 1:
+        return None
+    if capacity >= spec.total:
+        return RespecDecision("keep", spec, spec.total)
+
+    d = spec.size_of("dp")
+    pp = spec.size_of("pp")
+    tp = spec.size_of("tp")
+    # Non-dp, non-foldable block (ep and any size-1 declared roles):
+    # the solver never degrades ep short of the dp_only rung.
+    fixed = 1
+    for role, size in spec.dims:
+        if role not in ("dp", "pp", "tp"):
+            fixed *= size
+
+    def fit_dp(block: int) -> int:
+        """Largest dp (<= declared) whose world fits the capacity."""
+        return min(d, capacity // block) if block > 0 else 0
+
+    for rung in rungs:
+        if rung == "shed_dp":
+            block = pp * tp * fixed
+            nd = fit_dp(block)
+            if nd >= max(1, min_dp):
+                return RespecDecision(
+                    "shed_dp", _rebuild(spec, {"dp": nd}), nd * block)
+        elif rung == "fold_pp":
+            for npp in _divisors_desc(pp):
+                block = npp * tp * fixed
+                nd = fit_dp(block)
+                if nd >= max(1, min_dp):
+                    return RespecDecision(
+                        "fold_pp", _rebuild(spec, {"dp": nd, "pp": npp}),
+                        nd * block)
+        elif rung == "drop_tp":
+            for ntp in _divisors_desc(tp):
+                if ntp == 1:
+                    continue    # tp=1 with pp=1 is the dp_only rung
+                block = ntp * fixed
+                nd = fit_dp(block)
+                if nd >= max(1, min_dp):
+                    return RespecDecision(
+                        "drop_tp",
+                        _rebuild(spec, {"dp": nd, "pp": 1, "tp": ntp}),
+                        nd * block)
+        elif rung == "dp_only":
+            sizes = {r: 1 for r, _ in spec.dims}
+            sizes["dp"] = capacity
+            return RespecDecision(
+                "dp_only", _rebuild(spec, sizes), capacity)
+    return None
+
+
+def min_world(spec: ParallelSpec, min_dp: Optional[int] = None,
+              order: Optional[Sequence[str]] = None) -> int:
+    """The smallest world size the configured ladder can reshape down
+    to — the driver's HARD wait floor under involuntary capacity loss
+    (min_np keeps flooring VOLUNTARY evict/shrink decisions;
+    docs/elastic.md)."""
+    if min_dp is None:
+        min_dp = respec_min_dp()
+    rungs = tuple(order) if order is not None else respec_order()
+    lo = spec.total
+    for cap in range(spec.total, 0, -1):
+        dec = solve_respec(spec, cap, min_dp=min_dp, order=rungs)
+        if dec is None:
+            break
+        lo = dec.np if dec.np < lo else lo
+    return lo

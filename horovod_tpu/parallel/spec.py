@@ -44,6 +44,25 @@ from typing import Optional, Sequence, Tuple
 # (P("pp"), "dp:int8").
 ROLES = ("dp", "pp", "tp", "ep")
 
+# The env form hvd.init(parallel=) publishes and every role-aware
+# consumer (autoscale engine, pod monitor, flight recorder, respec
+# solver) resolves — one spelling, importable without a jax session.
+ENV_PARALLEL = "HVD_TPU_PARALLEL"
+
+
+def spec_from_env(env=None) -> Optional["ParallelSpec"]:
+    """The ParallelSpec declared via ``HVD_TPU_PARALLEL``, or None.
+    Raises ValueError on a malformed value (same contract as
+    ``hvd.init(parallel=)`` — a typo'd spec must not silently run
+    role-blind)."""
+    import os
+
+    env = os.environ if env is None else env
+    raw = env.get(ENV_PARALLEL)
+    if not raw or not str(raw).strip():
+        return None
+    return ParallelSpec.parse(raw)
+
 
 @dataclasses.dataclass(frozen=True)
 class ParallelSpec:
@@ -145,6 +164,50 @@ class ParallelSpec:
 
     def describe(self) -> str:
         return ",".join(f"{r}={s}" for r, s in self.dims)
+
+    # -- rank -> role coordinates (the failure-attribution view) ------
+
+    @property
+    def replica_ranks(self) -> int:
+        """Ranks per model replica — the product of every non-dp role
+        size (pp x tp x ep). Losing ANY of these ranks orphans the
+        whole replica: it is the hard min_np unit the autoscale floor
+        must respect (docs/elastic.md)."""
+        n = 1
+        for role, size in self.dims:
+            if role != "dp":
+                n *= size
+        return n
+
+    def coords(self, rank: int) -> dict:
+        """Role -> index of a flat rank, row-major over ``dims`` (the
+        mesh is built by reshaping the device list, so rank r sits at
+        the r-th row-major cell: the LAST declared axis varies
+        fastest)."""
+        if not 0 <= int(rank) < self.total:
+            raise ValueError(
+                f"rank {rank} outside the {self.total}-rank spec "
+                f"{self.describe()!r}")
+        rem = int(rank)
+        rev = []
+        for role, size in reversed(self.dims):
+            rev.append((role, rem % size))
+            rem //= size
+        return dict(reversed(rev))
+
+    def role_label(self, rank: int) -> str:
+        """Compact ``"dp1/pp0/tp1"`` coordinate label for a rank —
+        stamped onto step reports, pod-metric series, black boxes and
+        autoscale decisions so attribution names the role, not just a
+        number."""
+        return "/".join(f"{r}{i}" for r, i in self.coords(rank).items())
+
+    def replica_of(self, rank: int) -> int:
+        """The dp-replica index a rank belongs to (0 when the spec has
+        no dp axis) — the grouping key for role-aware straggler
+        scoring: 1F1B stalls a whole replica collectively, so scoring
+        compares REPLICAS and convicts within one."""
+        return self.coords(rank).get("dp", 0)
 
     # -- mesh / routing -----------------------------------------------
 

@@ -355,11 +355,15 @@ class ElasticDriver:
 
     # -- rank assignment (reference driver.py _update_host_assignments) ---
 
-    def update_assignments(self, np_cap: Optional[int] = None
+    def update_assignments(self, np_cap: Optional[int] = None,
+                           np_exact: Optional[int] = None
                            ) -> List[hosts_lib.SlotInfo]:
         """Re-assign ranks, keeping existing hosts' ranks stable.
         ``np_cap`` (autoscale hold: the policy refused new capacity —
-        docs/autoscale.md) additionally caps the world below max_np."""
+        docs/autoscale.md) additionally caps the world below max_np
+        but never below min_np. ``np_exact`` (elastic respec: the
+        re-solved mesh must factor the world EXACTLY —
+        docs/elastic.md "hybrid worlds") pins np even below min_np."""
         hosts = self.host_manager.current_hosts()
         with self._lock:
             prev_order = [h for h in self._assignments if h in hosts]
@@ -369,6 +373,8 @@ class ElasticDriver:
                            sum(hosts[h] for h in ordered))
             if np_cap is not None:
                 np_total = max(self.min_np, min(np_total, np_cap))
+            if np_exact is not None:
+                np_total = min(np_total, np_exact)
             infos = hosts_lib.get_host_assignments(
                 [hosts_lib.HostInfo(h, hosts[h]) for h in ordered], np_total)
             self._assignments = {}
@@ -805,9 +811,19 @@ def run_elastic(args, command: List[str],
             # workers — still feed the engine through the scrape path;
             # KV reports win per rank when both exist.
             fetch = podmon_lib.merged_report_fetcher(fetch, pod_monitor)
+        # Hybrid worlds (docs/elastic.md): a declared ParallelSpec
+        # makes the engine role-aware — replica-grouped straggler
+        # attribution, a whole-replica min_np floor (validated here; a
+        # bad floor fails the LAUNCH, naming the roles), and the respec
+        # ladder re-solving dp x pp x tp per epoch.
+        from ..parallel.spec import ENV_PARALLEL, spec_from_env
+
+        parallel_spec = spec_from_env(
+            {**os.environ, **env_extra})
         engine = autoscale_lib.AutoscaleEngine(
             autoscale_policy, min_np, max_np, fetch,
-            log_path=autoscale_env.get(autoscale_lib.ENV_LOG, ""))
+            log_path=autoscale_env.get(autoscale_lib.ENV_LOG, ""),
+            parallel=parallel_spec)
         driver.autoscale = engine
         env_extra[autoscale_lib.ENV_ENABLE] = "1"
         env_extra[autoscale_lib.ENV_POLICY] = autoscale_policy.to_json()
@@ -843,9 +859,20 @@ def run_elastic(args, command: List[str],
         prev_np: Optional[int] = None
         epoch_down_since: Optional[float] = None
         while True:
+            # Involuntary capacity loss under a hybrid spec waits at
+            # the respec ladder's floor, not at min_np: min_np floors
+            # VOLUNTARY evict/shrink decisions, while a lost host is
+            # survived by reshaping as far as the configured rungs
+            # allow (docs/elastic.md "hybrid worlds"). The floor is
+            # min_world ITSELF — below it NO permitted rung yields a
+            # valid mesh, so launching (even above min_np) would hand
+            # workers a spec the world cannot factor.
+            wait_floor = min_np
+            if engine is not None and engine.min_world is not None:
+                wait_floor = engine.min_world
             try:
                 driver.wait_for_available_slots(
-                    min_np,
+                    wait_floor,
                     timeout_s=(600.0 if slot_wait_timeout_s is None
                                else slot_wait_timeout_s))
             except TimeoutError:
@@ -876,13 +903,64 @@ def run_elastic(args, command: List[str],
             # relaunching yesterday's topology.
             driver.host_manager.update_available_hosts()
             np_cap = None
+            np_exact = None
             if engine is not None:
                 # Grow gate (docs/autoscale.md): the engine decides
                 # whether capacity beyond the previous world is ADOPTED
                 # (a `grow` decision) or HELD (np capped at prev size).
                 np_cap = engine.pre_epoch(
                     prev_np, driver.host_manager.current_hosts())
-            slots = driver.update_assignments(np_cap=np_cap)
+                # Respec (docs/elastic.md "hybrid worlds"): re-solve
+                # the mesh for the surviving capacity; the new spec is
+                # re-exported to the workers and np is pinned to its
+                # exact factorization (a partial mesh would drop ranks
+                # from the reduction — parallel/spec.py).
+                usable = driver.host_manager.current_hosts()
+                capacity = sum(usable.values())
+                if np_cap is not None:
+                    # A held grow caps the world: the solver must see
+                    # the capacity the epoch will actually get, or it
+                    # would restore a spec the capped np can't factor.
+                    capacity = min(capacity, np_cap)
+                rd = engine.plan_respec(capacity)
+                if rd is not None:
+                    env_extra[ENV_PARALLEL] = rd.spec.describe()
+                    logger.warning(
+                        "elastic: respec %s -> %s (np=%d)",
+                        parallel_spec.describe(), rd.spec.describe(),
+                        rd.np)
+                if engine.current_spec is not None:
+                    np_exact = engine.current_spec.total
+            slots = driver.update_assignments(np_cap=np_cap,
+                                              np_exact=np_exact)
+            if engine is not None and engine.current_spec is not None \
+                    and len(slots) != engine.current_spec.total:
+                # The assignable world moved between planning and
+                # assignment (a host dropped in the window): re-solve
+                # for what was actually assignable; if no permitted
+                # rung fits, wait for capacity instead of launching
+                # workers with a spec the world cannot factor.
+                rd = engine.plan_respec(len(slots))
+                if rd is not None:
+                    env_extra[ENV_PARALLEL] = rd.spec.describe()
+                    slots = driver.update_assignments(
+                        np_cap=np_cap, np_exact=rd.np)
+                if len(slots) != engine.current_spec.total:
+                    logger.warning(
+                        "elastic: assignable world (%d slots) cannot "
+                        "factor the solved spec %s; waiting for "
+                        "capacity", len(slots),
+                        engine.current_spec.describe())
+                    faults_lib.stats.bump("resets")
+                    attempts += 1
+                    limit = (reset_limit if reset_limit is not None
+                             else int(os.environ.get(
+                                 "HVD_TPU_ELASTIC_RESET_LIMIT", "100")))
+                    if attempts > limit:
+                        logger.error("elastic: reset limit exceeded")
+                        return 1
+                    time.sleep(driver.discovery_interval)
+                    continue
             if engine is not None:
                 engine.observe_assignment({s.hostname for s in slots})
             prev_np = len(slots)

@@ -94,6 +94,38 @@ def test_chaos_zero_midstep_crash_verified_resume(tmp_path):
     assert "checkpoint_corrupt" in rec["injected_sites"]
 
 
+def test_chaos_hybrid_host_loss_respec_and_migrate(tmp_path):
+    """ISSUE 14 acceptance: kill one host of the 2x2x2 dp x pp x tp
+    world mid-1F1B (with a straggler sleep on a tp peer and the last
+    checkpoint torn). The role-aware decision plane convicts the
+    straggler's HOST (role dp1/pp0/tp1) and not its pipeline peers,
+    the solver re-solves the surviving 6 slots to the documented
+    shed_dp spec dp=1,pp=2,tp=2, sharded state migrates onto the new
+    grid through the CRC walk-back with no full gather, and the
+    reshaped run finishes within the int8_ef 2% bound of an
+    uninterrupted 8-rank reference. The sim decision log is
+    byte-identical across repeats."""
+    import json as json_lib
+
+    rec = chaos_soak.run_hybrid_soak(str(tmp_path), steps=6, seed=42)
+    assert rec["rc"] == 7  # the hard host loss, mid-schedule
+    assert rec["restored_step"] == rec["crash_step"] - 2  # walk-back
+    assert rec["respec"] == "dp=1,pp=2,tp=2"
+    decisions = [json_lib.loads(l) for l in rec["decisions"]]
+    assert (decisions[0]["action"], decisions[0]["target"],
+            decisions[0]["role"]) == ("evict", "hostC", "dp1/pp0/tp1")
+    assert decisions[1]["action"] == "respec" \
+        and decisions[1]["reason"] == "shed_dp"
+    bound = 0.02 * abs(rec["reference_loss"]) + 1e-3
+    assert abs(rec["final_loss"] - rec["reference_loss"]) <= bound
+    assert {"straggler", "checkpoint_corrupt"} <= set(
+        rec["injected_sites"])
+    # Determinism: the decision plane replays byte-identically.
+    again = chaos_soak.simulate_hybrid(
+        chaos_soak.hybrid_plan(42, 6), chaos_soak.hybrid_policy())
+    assert again == rec["sequences"]["sim"]
+
+
 def test_chaos_pipeline_straggler_crash_verified_resume(tmp_path):
     """ISSUE 13 satellite: the pipeline family — hybrid dp=4 x pp=2
     1F1B training (int8 stage-boundary wire, dp-only gradient reduce)

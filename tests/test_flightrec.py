@@ -128,6 +128,55 @@ def test_blackbox_schema_and_roundtrip_through_flight_diff(tmp_path):
                for frames in box["stacks"].values())
 
 
+def test_blackbox_role_under_hybrid_spec(tmp_path, monkeypatch):
+    """Schema v2 (ISSUE 14): with a ParallelSpec declared the box
+    carries the rank's (dp,pp,tp) label and flight_diff verdicts name
+    the STAGE — 'rank 3 = dp0/pp1/tp1 never completed ...'."""
+    monkeypatch.setenv("HVD_TPU_PARALLEL", "dp=2,pp=2,tp=2")
+    monkeypatch.setenv("HVD_TPU_PROC_ID", "3")
+    r = _rec(tmp_path)
+    assert r.rank == 3 and r.role == "dp0/pp1/tp1"
+    r.record_submit("ppermute.act", "ppermute")
+    path = r.dump("stall_timeout", reason="hung send")
+    box = flight_diff.load_blackbox(path)
+    assert box["role"] == "dp0/pp1/tp1"
+    healthy = _rec(tmp_path, rank=1)
+    # Both on disk: the healthy peer's box + the stalled stage's.
+    monkeypatch.setenv("HVD_TPU_PROC_ID", "1")
+    h = FlightRecorder(directory=str(tmp_path), size=8, push=False,
+                       enabled=True)
+    h.record_submit("ppermute.act", "ppermute")
+    h.record_complete("ppermute.act")
+    h.dump("sigusr2")
+    boxes = flight_diff.load_all(str(tmp_path))
+    rep = flight_diff.analyze(boxes)
+    verdicts = [v for f in rep["findings"] for v in f["verdicts"]]
+    assert any("rank 3 = dp0/pp1/tp1 never completed ppermute.act"
+               in v for v in verdicts), verdicts
+    del healthy
+
+
+def test_blackbox_role_blind_without_spec(tmp_path, monkeypatch):
+    monkeypatch.delenv("HVD_TPU_PARALLEL", raising=False)
+    r = _rec(tmp_path, rank=1)
+    assert r.role == ""
+    r.record_submit("allreduce.g", "allreduce")
+    box = flight_diff.load_blackbox(r.dump("sigusr2"))
+    assert box["role"] == ""
+    rep = flight_diff.analyze({1: box})
+    verdicts = [v for f in rep["findings"] for v in f["verdicts"]]
+    # No role -> the classic wording, nothing breaks downstream.
+    assert any(v.startswith("rank 1 never completed")
+               for v in verdicts), verdicts
+
+
+def test_flight_diff_tolerates_v1_boxes_without_role():
+    box = _box(0, [_ev(1)])
+    assert box["schema"] == 1 and "role" not in box
+    rep = flight_diff.analyze({0: box})
+    assert rep["per_rank"]["0"]["role"] == ""
+
+
 def test_flight_diff_rejects_truncated_box(tmp_path):
     p = tmp_path / "blackbox.rank0.json"
     p.write_text(json.dumps({"schema": 1, "rank": 0}))

@@ -130,6 +130,63 @@ def test_prometheus_text_serves_pod_families_and_passthrough():
         in text
 
 
+# -- hybrid role labels + replica-stall attribution (docs/elastic.md) --------
+
+def _hybrid_monitor():
+    from horovod_tpu.parallel.spec import ParallelSpec
+
+    spec = ParallelSpec.parse("dp=2,pp=2,tp=2")
+    mon = PodMonitor(lambda: [], interval_s=999, parallel=spec)
+    return spec, mon
+
+
+def test_role_labels_on_per_rank_series_and_merged_view():
+    spec, mon = _hybrid_monitor()
+    for r in range(8):
+        _seed(mon, r, f"host{r // 2}", step_time=0.1)
+    m = mon.merged()
+    assert m["roles"][5] == "dp1/pp0/tp1"
+    assert m["role_coords"][3] == {"dp": 0, "pp": 1, "tp": 1}
+    text = mon.prometheus_text()
+    # dp/pp/tp labels ride every per-rank step-time sample.
+    assert ('hvd_tpu_pod_step_time_seconds{dp="1",host="host2",'
+            'pp="0",rank="5",tp="1"}') in text
+
+
+def test_replica_stalled_gauge_from_role_grouped_skew():
+    """The 1F1B signature: replica dp1's ranks are COLLECTIVELY slow.
+    The role-grouped view flags the REPLICA (stalled gauge 1) while
+    slowest_rank still points at the individual laggard."""
+    spec, mon = _hybrid_monitor()
+    for r in range(8):
+        slow = spec.replica_of(r) == 1
+        _seed(mon, r, f"host{r // 2}",
+              step_time=(0.55 if r == 5 else 0.5) if slow else 0.1)
+    m = mon.merged()
+    assert m["replica_step_time_seconds"][0] == pytest.approx(0.1)
+    assert m["replica_step_time_seconds"][1] == pytest.approx(0.5)
+    assert m["stalled_replicas"] == [1]
+    assert m["slowest_rank"] == 5
+    text = mon.prometheus_text()
+    assert 'hvd_tpu_pod_replica_stalled{replica="0"} 0' in text
+    assert 'hvd_tpu_pod_replica_stalled{replica="1"} 1' in text
+
+
+def test_replica_gauge_absent_without_a_spec():
+    mon = PodMonitor(lambda: [], interval_s=999)
+    _seed(mon, 0, "hostA", step_time=0.1)
+    m = mon.merged()
+    assert m["roles"] == {} and m["stalled_replicas"] == []
+    assert "hvd_tpu_pod_replica_stalled" not in mon.prometheus_text()
+
+
+def test_scrape_reports_carry_roles():
+    spec, mon = _hybrid_monitor()
+    _seed(mon, 5, "host2", step_time=0.2, steps=7)
+    reports = mon.reports()
+    assert reports[5].role == "dp1/pp0/tp1"
+
+
 # -- the autoscale bridge ----------------------------------------------------
 
 def test_reports_derive_step_reports_from_scrapes():

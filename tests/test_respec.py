@@ -1,0 +1,341 @@
+"""Elastic hybrid parallelism (ISSUE 14, docs/elastic.md "hybrid
+worlds"): the deterministic reshape solver's preference ladder, the
+whole-replica min_np validation, role-aware straggler attribution
+(convict the host, spare the 1F1B-stalled pipeline peers), the respec
+decision path through engine + driver, and the role plumbing on
+reports and pod metrics."""
+
+import json
+
+import pytest
+
+from horovod_tpu.common import autoscale as autoscale_lib
+from horovod_tpu.common.autoscale import (AutoscaleEngine,
+                                          AutoscalePolicy, StepReport)
+from horovod_tpu.parallel import respec as respec_lib
+from horovod_tpu.parallel.respec import (RespecDecision, min_world,
+                                         solve_respec)
+from horovod_tpu.parallel.spec import ParallelSpec, spec_from_env
+
+SPEC = ParallelSpec.parse("dp=2,pp=2,tp=2")
+
+
+# ---------------------------------------------------------------------------
+# The solver ladder
+# ---------------------------------------------------------------------------
+
+def test_solver_preference_ladder_2x2x2():
+    """The documented ladder on the acceptance world: keep while it
+    fits, shed dp to a whole replica, fold pp when below one replica,
+    dp-only as the last resort."""
+    expect = {8: ("keep", "dp=2,pp=2,tp=2", 8),
+              7: ("shed_dp", "dp=1,pp=2,tp=2", 4),
+              6: ("shed_dp", "dp=1,pp=2,tp=2", 4),
+              4: ("shed_dp", "dp=1,pp=2,tp=2", 4),
+              3: ("fold_pp", "dp=1,pp=1,tp=2", 2),
+              2: ("fold_pp", "dp=1,pp=1,tp=2", 2),
+              1: ("dp_only", "dp=1,pp=1,tp=1", 1)}
+    for cap, (action, spec, np_) in expect.items():
+        d = solve_respec(SPEC, cap)
+        assert (d.action, d.spec.describe(), d.np) == (action, spec,
+                                                       np_), cap
+
+
+def test_solver_never_produces_an_invalid_mesh():
+    """Property sweep: every answer factors (total <= capacity, sizes
+    >= 1, folded sizes divide the declared ones) and the same inputs
+    always give the same answer."""
+    specs = [SPEC, ParallelSpec.parse("dp=4,pp=4,tp=2"),
+             ParallelSpec.parse("dp=8,pp=2"),
+             ParallelSpec.parse("dp=2,pp=3,tp=2")]
+    for spec in specs:
+        for cap in range(1, spec.total + 3):
+            d = solve_respec(spec, cap)
+            assert d is not None, (spec.describe(), cap)
+            assert d.np == d.spec.total <= max(cap, spec.total)
+            assert d.np <= cap or d.action == "keep"
+            for role, size in d.spec.dims:
+                assert size >= 1
+                assert spec.size_of(role) % size == 0 or role == "dp"
+            assert d.spec.size_of("dp") <= spec.size_of("dp") \
+                or d.action == "dp_only"
+            d2 = solve_respec(spec, cap)
+            assert d == d2
+
+
+def test_solver_order_gates_degradation():
+    """Removing a rung forbids it: a shed_dp-only order refuses to
+    fold below one full replica (None = wait for capacity), and
+    min_dp biases the ladder toward folding."""
+    assert solve_respec(SPEC, 3, order=("shed_dp",)) is None
+    assert solve_respec(SPEC, 0) is None
+    # min_dp=2: shedding to one replica is refused; folding pp keeps
+    # two replicas alive instead.
+    d = solve_respec(SPEC, 6, min_dp=2)
+    assert d.action == "fold_pp"
+    assert d.spec.describe() == "dp=2,pp=1,tp=2" and d.np == 4
+
+
+def test_solver_env_knobs(monkeypatch):
+    monkeypatch.setenv(respec_lib.ENV_ORDER, "shed_dp,dp_only")
+    monkeypatch.setenv(respec_lib.ENV_MIN_DP, "1")
+    d = solve_respec(SPEC, 3)
+    assert d.action == "dp_only" and d.np == 3  # fold_pp forbidden
+    monkeypatch.setenv(respec_lib.ENV_ORDER, "shed_dp,typo")
+    with pytest.raises(ValueError, match="typo"):
+        solve_respec(SPEC, 3)
+    monkeypatch.setenv(respec_lib.ENV_ORDER, "")
+    monkeypatch.setenv(respec_lib.ENV_ENABLE, "0")
+    assert not respec_lib.respec_enabled()
+
+
+def test_min_world_reflects_order():
+    assert min_world(SPEC) == 1                      # dp_only reaches 1
+    assert min_world(SPEC, order=("shed_dp",)) == 4  # one whole replica
+    assert min_world(SPEC, min_dp=2, order=("shed_dp",)) == 8
+
+
+# ---------------------------------------------------------------------------
+# Rank -> role coordinates
+# ---------------------------------------------------------------------------
+
+def test_spec_coords_row_major_and_labels():
+    assert SPEC.coords(0) == {"dp": 0, "pp": 0, "tp": 0}
+    assert SPEC.coords(5) == {"dp": 1, "pp": 0, "tp": 1}
+    assert SPEC.role_label(3) == "dp0/pp1/tp1"
+    assert SPEC.replica_of(6) == 1 and SPEC.replica_of(2) == 0
+    assert SPEC.replica_ranks == 4
+    with pytest.raises(ValueError, match="outside"):
+        SPEC.coords(8)
+
+
+def test_spec_from_env(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_PARALLEL", raising=False)
+    assert spec_from_env() is None
+    monkeypatch.setenv("HVD_TPU_PARALLEL", "dp=2,pp=2,tp=2")
+    assert spec_from_env() == SPEC
+    monkeypatch.setenv("HVD_TPU_PARALLEL", "dp:2")
+    with pytest.raises(ValueError):
+        spec_from_env()
+
+
+# ---------------------------------------------------------------------------
+# min_np floor validation (the ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+def test_policy_min_np_rejects_partial_replica_floor():
+    pol = AutoscalePolicy.from_dict({"min_np": 3})
+    with pytest.raises(ValueError) as e:
+        pol.resolve_min_np(1, SPEC)
+    msg = str(e.value)
+    # The message names the roles and the fix.
+    assert "pp=2" in msg and "tp=2" in msg and "dp=2,pp=2,tp=2" in msg
+    assert "use 4, 8" in msg
+    # Driver floor validated the same way when the policy leaves it 0.
+    with pytest.raises(ValueError, match="min_np=6"):
+        AutoscalePolicy().resolve_min_np(6, SPEC)
+    # Whole replicas pass; role-blind worlds are untouched.
+    assert pol.resolve_min_np(1, None) == 3
+    assert AutoscalePolicy.from_dict({"min_np": 8}).resolve_min_np(
+        1, SPEC) == 8
+    assert AutoscalePolicy().resolve_min_np(4, SPEC) == 4
+    with pytest.raises(ValueError, match=">= 0"):
+        AutoscalePolicy.from_dict({"min_np": -1})
+
+
+def test_engine_ctor_validates_floor_against_spec():
+    with pytest.raises(ValueError, match="multiple of the model-replica"):
+        AutoscaleEngine(AutoscalePolicy(), min_np=3, max_np=8,
+                        fetch_reports=dict, log_path="", parallel=SPEC)
+    eng = AutoscaleEngine(AutoscalePolicy(), min_np=4, max_np=8,
+                          fetch_reports=dict, log_path="",
+                          parallel=SPEC)
+    assert eng.min_np == 4 and eng.min_world == 1
+    blind = AutoscaleEngine(AutoscalePolicy(), min_np=3, max_np=8,
+                            fetch_reports=dict, log_path="")
+    assert blind.min_world is None
+
+
+# ---------------------------------------------------------------------------
+# Role-aware straggler attribution
+# ---------------------------------------------------------------------------
+
+class _Harness:
+    """Role-aware engine + fake clock + mutable report table over the
+    2x2x2 world (rank r lives on host r//2)."""
+
+    HOSTS = ("hostA", "hostB", "hostC", "hostD")
+
+    def __init__(self, parallel=SPEC, **policy):
+        base = dict(straggler_ratio=2.0, straggler_patience=2,
+                    min_ranks=3, evict_cooldown_s=0.0,
+                    tick_interval_s=1.0, min_np=4)
+        base.update(policy)
+        self.now = 0.0
+        self.reports = {}
+        self.engine = AutoscaleEngine(
+            AutoscalePolicy.from_dict(base), min_np=4, max_np=8,
+            fetch_reports=lambda: dict(self.reports),
+            clock=lambda: self.now, log_path="", parallel=parallel)
+
+    def feed(self, tick_no, slow_rank=None, slow=0.5, fast=0.05,
+             stall_bleed=0.8):
+        for r in range(8):
+            p50 = fast
+            if slow_rank is not None and \
+                    SPEC.replica_of(r) == SPEC.replica_of(slow_rank):
+                # The 1F1B schedule stalls the whole replica; only the
+                # source rank carries the full delay.
+                p50 = slow if r == slow_rank else \
+                    fast + stall_bleed * (slow - fast)
+            self.reports[r] = StepReport(
+                rank=r, host=self.HOSTS[r // 2], step=tick_no * 5,
+                n=8, p50=p50, mean=p50, last=p50,
+                role=SPEC.role_label(r))
+
+    def tick(self):
+        self.now += 1.0
+        return self.engine.tick({h: 2 for h in self.HOSTS}, {})
+
+
+def test_role_aware_conviction_names_host_not_pipeline_peers():
+    """A slow tp peer (rank 5, hostC) stalls its whole dp1 replica.
+    The role-aware engine convicts hostC — with the role in the
+    decision log — and never touches hostD, whose ranks are just as
+    slow on the scrape but innocent."""
+    h = _Harness()
+    evictions = []
+    for i in range(6):
+        h.feed(i, slow_rank=5)
+        evictions += [d for d in h.tick() if d.action == "evict"]
+    assert evictions, "the slow tp peer's host must be convicted"
+    assert all(d.target == "hostC" for d in evictions), evictions
+    d = evictions[0]
+    assert (d.target, d.reason, d.role) == ("hostC", "straggler",
+                                            "dp1/pp0/tp1")
+    line = json.loads(d.log_line())
+    assert line["role"] == "dp1/pp0/tp1" and line["target"] == "hostC"
+
+
+def test_role_blind_engine_would_convict_the_whole_replica():
+    """The contrast that motivates the tentpole: WITHOUT the spec the
+    per-rank scoring flags every host of the stalled replica — the
+    innocent hostD is struck alongside hostC."""
+    h = _Harness(parallel=None, min_np=0)
+    struck = set()
+    for i in range(6):
+        h.feed(i, slow_rank=5)
+        h.tick()
+        struck |= set(h.engine._strikes)
+    struck |= {d.target for d in h.engine.decisions
+               if d.action == "evict"}
+    assert {"hostC", "hostD"} <= struck, struck
+
+
+def test_uniformly_slow_replica_is_not_convicted():
+    """No strictly slowest rank inside the flagged replica -> no
+    conviction (a collective stall has no attributable source; the
+    stall detector owns that signature)."""
+    h = _Harness()
+    for i in range(6):
+        h.feed(i, slow_rank=5, stall_bleed=1.0)  # peers exactly as slow
+        assert [d for d in h.tick() if d.action == "evict"] == []
+
+
+def test_single_replica_world_cannot_score():
+    h = _Harness()
+    for i in range(6):
+        # Only replica 1's ranks advance: nothing to compare against.
+        h.feed(i, slow_rank=5)
+        for r in range(4):
+            h.reports.pop(r, None)
+        assert [d for d in h.tick() if d.action == "evict"] == []
+
+
+# ---------------------------------------------------------------------------
+# plan_respec: the engine <-> solver seam
+# ---------------------------------------------------------------------------
+
+def test_plan_respec_records_decision_and_metric():
+    from horovod_tpu.common import metrics as metrics_lib
+
+    def shrink_count():
+        # Match on the from/to pair only: an initialized registry also
+        # stamps global rank=/size= labels onto every sample.
+        return sum(
+            s["value"] for s in metrics_lib.snapshot().get(
+                "hvd_tpu_respec_total", {}).get("samples", [])
+            if s["labels"].get("from") == "dp=2,pp=2,tp=2"
+            and s["labels"].get("to") == "dp=1,pp=2,tp=2")
+
+    h = _Harness()
+    before = shrink_count()
+    assert h.engine.plan_respec(8) is None          # fits: no decision
+    d = h.engine.plan_respec(6)
+    assert d is not None and d.action == "shed_dp"
+    assert h.engine.current_spec.describe() == "dp=1,pp=2,tp=2"
+    assert h.engine.plan_respec(6) is None          # unchanged: once
+    d2 = h.engine.plan_respec(8)                    # recovery re-solves
+    assert d2 is not None and d2.action == "keep"
+    assert h.engine.current_spec == SPEC
+    log = [json.loads(l) for l in h.engine.decision_log()]
+    assert [(d["action"], d["target"], d["reason"]) for d in log] == [
+        ("respec", "dp=1,pp=2,tp=2", "shed_dp"),
+        ("respec", "dp=2,pp=2,tp=2", "restore")]
+    if metrics_lib.enabled():
+        assert shrink_count() == before + 1
+
+
+def test_plan_respec_disabled_pins_the_mesh(monkeypatch):
+    monkeypatch.setenv(respec_lib.ENV_ENABLE, "0")
+    h = _Harness()
+    assert h.engine.plan_respec(6) is None
+    assert h.engine.current_spec == SPEC
+
+
+def test_role_blind_engine_has_no_respec():
+    h = _Harness(parallel=None, min_np=0)
+    assert h.engine.plan_respec(6) is None
+
+
+# ---------------------------------------------------------------------------
+# StepReport role round-trip + publisher stamp
+# ---------------------------------------------------------------------------
+
+def test_step_report_role_roundtrip():
+    r = StepReport(rank=5, host="hostC", step=3, n=8, p50=0.1,
+                   mean=0.1, last=0.1, role="dp1/pp0/tp1")
+    back = StepReport.from_json(r.to_json().encode())
+    assert back.role == "dp1/pp0/tp1"
+    blind = StepReport(rank=0, host="a", step=1, n=1, p50=0.1,
+                       mean=0.1, last=0.1)
+    assert "role" not in blind.to_json()
+    assert StepReport.from_json(blind.to_json().encode()).role is None
+
+
+def test_publisher_stamps_role_from_env(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_PARALLEL", "dp=2,pp=2,tp=2")
+    pub = autoscale_lib.StepPublisher(client=None, rank=5, host="hostC")
+    assert pub.role == "dp1/pp0/tp1"
+    monkeypatch.delenv("HVD_TPU_PARALLEL")
+    assert autoscale_lib.StepPublisher(client=None, rank=5,
+                                       host="hostC").role is None
+
+
+# ---------------------------------------------------------------------------
+# Driver seam: the respec cap may land below min_np (exact mesh)
+# ---------------------------------------------------------------------------
+
+def test_driver_assignment_cap_exact_below_min_np():
+    from horovod_tpu.runner.elastic_driver import (ElasticDriver,
+                                                   FixedHostDiscovery)
+
+    drv = ElasticDriver(FixedHostDiscovery(
+        {"a": 2, "b": 2, "c": 2}), min_np=6, max_np=8,
+        discovery_interval=0.01)
+    drv.host_manager.update_available_hosts()
+    # An autoscale HOLD never cuts below min_np...
+    assert len(drv.update_assignments(np_cap=5)) == 6
+    # ...but a respec pin is exact: the re-solved mesh must factor the
+    # assigned world.
+    assert len(drv.update_assignments(np_exact=4)) == 4

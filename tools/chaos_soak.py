@@ -1477,6 +1477,349 @@ def run_pipeline_soak(workdir: str, steps: int = 8, seed: int = 42,
     }
 
 
+# -- the hybrid family (docs/elastic.md "hybrid worlds") ---------------------
+
+HYBRID_HOSTS = ("hostA", "hostB", "hostC", "hostD")   # 2 ranks each
+HYBRID_DECLARED = "dp=2,pp=2,tp=2"
+
+
+def hybrid_plan(seed: int, steps: int) -> dict:
+    """The hybrid family (ISSUE 14): a STRAGGLER inside the 2x2x2
+    schedule (real sleep — the tp peer stalls the whole lockstep
+    world, exactly the 1F1B signature the role-aware attribution must
+    see through) plus a HARD HOST LOSS mid-1F1B (the process dies at
+    step ``crash_step``; one 2-slot host of the 8-rank world is gone),
+    with the last finalized checkpoint additionally torn — the
+    RESHAPED relaunch must walk back to the previous VERIFIED step,
+    reshard-on-restore onto the solver's predicted spec, and finish
+    within the int8_ef bound of an uninterrupted run."""
+    crash = max(3, steps - 2)
+    return {"seed": seed, "crash_step": crash, "faults": [
+        {"site": "straggler", "step": 2, "delay_s": 0.2, "times": 1},
+        {"site": "checkpoint_corrupt", "step": crash - 1,
+         "mode": "bitflip"},
+    ]}
+
+
+def hybrid_policy() -> dict:
+    """Decision-plane policy for the hybrid sim: min_np pinned to ONE
+    whole model replica (pp x tp = 4 — any smaller voluntary floor is
+    REJECTED by the engine naming the roles), fast 2-strike eviction."""
+    return {
+        "tick_interval_s": 0.25,
+        "publish_interval_s": 0.0,
+        "window": 8,
+        "straggler_ratio": 2.5,
+        "straggler_patience": 2,
+        "min_ranks": 3,
+        "min_np": 4,
+        "evict_ttl_s": 30.0,
+        "evict_cooldown_s": 0.5,
+        "grow_cooldown_s": 0.5,
+    }
+
+
+def simulate_hybrid(plan: dict, policy: dict, ticks: int = 12):
+    """Virtual-time soak of the ROLE-AWARE decision plane: a real
+    AutoscaleEngine built over the declared 2x2x2 ParallelSpec scores
+    seeded reports in which rank 5 (hostC, dp1/pp0/tp1) is the slow tp
+    peer and its whole dp1 replica (ranks 4-7, hostsC+D) is
+    collectively stalled by the 1F1B schedule. The conviction must
+    name hostC ONLY — hostD's pipeline peers are innocent — and the
+    post-eviction capacity (6 slots) must re-solve through the respec
+    ladder to the shed_dp spec dp=1,pp=2,tp=2. Deterministic by
+    construction (virtual clock, fixed reports): the --repeat contract
+    compares the decision log byte-for-byte."""
+    from horovod_tpu.common import autoscale as autoscale_lib
+    from horovod_tpu.parallel.spec import ParallelSpec
+
+    spec = ParallelSpec.parse(HYBRID_DECLARED)
+    pol = autoscale_lib.AutoscalePolicy.from_dict(policy)
+    host_of = {r: HYBRID_HOSTS[r // 2] for r in range(8)}
+    delay = next(f["delay_s"] for f in plan["faults"]
+                 if f["site"] == "straggler")
+    vt = [0.0]
+    reports: dict = {}
+    engine = autoscale_lib.AutoscaleEngine(
+        pol, min_np=1, max_np=8, fetch_reports=lambda: dict(reports),
+        clock=lambda: vt[0], log_path="", parallel=spec)
+    usable = {h: 2 for h in HYBRID_HOSTS}
+    engine.observe_assignment(set(usable))
+    evicted: set = set()
+    base = 0.1
+    for tick in range(1, ticks + 1):
+        vt[0] += pol.tick_interval_s
+        for r in range(8):
+            if host_of[r] in evicted:
+                reports.pop(r, None)
+                continue
+            # The straggler's own step interval carries its full extra
+            # delay; its replica peers absorb most of it through the
+            # schedule stall (1F1B overlap hides a sliver) — the
+            # strictly-slowest rule pins the conviction on rank 5.
+            p50 = base
+            if spec.replica_of(r) == 1:
+                p50 = base + (delay if r == 5 else 0.8 * delay)
+            reports[r] = autoscale_lib.StepReport(
+                rank=r, host=host_of[r], step=tick, n=8, p50=p50,
+                mean=p50, last=p50, t=vt[0],
+                role=spec.role_label(r))
+        live = {h: s for h, s in usable.items() if h not in evicted}
+        for d in engine.tick(live):
+            if d.action == "evict" and d.target:
+                evicted.add(d.target)
+                # The epoch boundary after the evict: re-solve the
+                # mesh for the surviving capacity.
+                engine.plan_respec(
+                    sum(s for h, s in usable.items()
+                        if h not in evicted))
+    return engine.decision_log()
+
+
+HYBRID_SCRIPT = """
+import os
+import sys
+
+workdir = sys.argv[1]
+TOTAL = int(sys.argv[2])
+MODE = sys.argv[3]            # crash | resume | reference
+CRASH = int(sys.argv[4])      # 1-based step that dies mid-schedule
+NDEV = int(sys.argv[5])       # surviving world size
+PARALLEL = sys.argv[6]        # the spec THIS world runs
+
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={NDEV}")
+os.environ["HVD_TPU_PARALLEL"] = PARALLEL
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt_lib
+from horovod_tpu.common import faults as faults_lib
+from horovod_tpu.models.gpt import gpt_tiny, pipeline_fns, \\
+    stack_stage_params
+from horovod_tpu.parallel.spec import (ParallelSpec,
+                                       hybrid_param_specs,
+                                       hybrid_state_specs)
+
+hvd.init(force_cpu_devices=NDEV)
+
+spec = ParallelSpec.parse(PARALLEL)
+if MODE == "resume":
+    # The reshaped world must be the SOLVER'S answer for the surviving
+    # capacity, not an ad-hoc choice: one 2-slot host of the declared
+    # 2x2x2 (8-rank) world is gone -> 6 slots -> shed_dp -> dp=1.
+    from horovod_tpu.parallel.respec import solve_respec
+
+    dec = solve_respec(ParallelSpec.parse("dp=2,pp=2,tp=2"), 6)
+    assert dec is not None and dec.action == "shed_dp", dec
+    assert dec.spec.describe() == PARALLEL, (dec.spec.describe(),
+                                             PARALLEL)
+mesh = spec.mesh(jax.devices())
+model = gpt_tiny(num_layers=2, hidden=32, num_heads=2, mlp_dim=64,
+                 vocab_size=64, tp_axis="tp")
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
+Y = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
+params = jax.jit(model.clone(tp_axis=None).init)(
+    jax.random.PRNGKey(0), X)["params"]
+stages, shared = stack_stage_params(params, spec.size_of("pp"))
+stage_fn, pre_fn, loss_fn = pipeline_fns(model)
+vg = hvd.pipeline_accumulate_gradients(stage_fn, loss_fn,
+                                       accum_steps=2, axis_name="pp",
+                                       pre_fn=pre_fn, wire="int8",
+                                       key=jax.random.PRNGKey(7))
+# int8_ef on the dp reduce: the EF residual + loss-scale guard state
+# ride the optimizer tree the migration must carry across the respec.
+tx = hvd.DistributedOptimizer(optax.adam(1e-2), parallel=spec,
+                              compression="int8_ef",
+                              quantize_min_bucket_bytes=0)
+opt = tx.init({"stages": stages, "shared": shared})
+ospecs = hybrid_state_specs(jax.eval_shape(lambda: opt))
+pspecs = hybrid_param_specs()
+
+
+def step_fn(st, sh, op, x, y):
+    p = {"stages": st, "shared": sh}
+    loss, g = vg(p, x, y)
+    updates, op = tx.update(g, op, p)
+    p = optax.apply_updates(p, updates)
+    loss = jax.lax.pmean(loss, spec.dp_axes)
+    return p["stages"], p["shared"], op, loss
+
+
+step = jax.jit(jax.shard_map(
+    step_fn, mesh=mesh,
+    in_specs=(pspecs["stages"], pspecs["shared"], ospecs,
+              spec.data_spec(), spec.data_spec()),
+    out_specs=(pspecs["stages"], pspecs["shared"], ospecs, P()),
+    check_vma=False))
+
+place = jax.jit(jax.shard_map(
+    lambda a, b, c: (a, b, c), mesh=mesh,
+    in_specs=(pspecs["stages"], pspecs["shared"], ospecs),
+    out_specs=(pspecs["stages"], pspecs["shared"], ospecs),
+    check_vma=False))
+stages, shared, opt = place(stages, shared, opt)
+
+ckdir = os.path.join(workdir, "hybrid_ckpt")
+start = 0
+if MODE == "resume":
+    # Reshard-on-restore (docs/elastic.md): the template carries the
+    # RESHAPED world's shardings; the CRC walk-back picks the latest
+    # verified step of the 8-rank run and remaps its pieces onto this
+    # 4-rank mesh — no full gather.
+    (restored, start) = ckpt_lib.restore_sharded(
+        {"stages": stages, "shared": shared, "opt": opt}, ckdir)
+    stages, shared, opt = (restored["stages"], restored["shared"],
+                           restored["opt"])
+
+loss = None
+for i in range(start + 1, TOTAL + 1):
+    sp = faults_lib.maybe_straggler()
+    if sp is not None and sp.delay_s:
+        time.sleep(sp.delay_s)   # the tp peer stalls the schedule
+    stages, shared, opt, loss = step(stages, shared, opt, X, Y)
+    if MODE == "crash" and i == CRASH:
+        os._exit(7)   # the hard host loss, mid-1F1B
+    if MODE != "reference":
+        ckpt_lib.save_sharded(
+            {"stages": stages, "shared": shared, "opt": opt}, ckdir,
+            step=i, max_to_keep=TOTAL + 1)
+
+result = {
+    "mode": MODE,
+    "parallel": PARALLEL,
+    "world": NDEV,
+    "restored_step": start,
+    "final_loss": float(np.asarray(jax.device_get(loss)).reshape(-1)[0]),
+}
+with open(os.path.join(workdir, f"result_{MODE}.json"), "w") as f:
+    json.dump(result, f)
+"""
+
+
+def run_hybrid_soak(workdir: str, steps: int = 6, seed: int = 42,
+                    plan: dict | None = None) -> dict:
+    """One seeded hybrid-family run (ISSUE 14 acceptance), two layers:
+
+    (1) the ROLE-AWARE decision plane on a virtual clock
+    (:func:`simulate_hybrid`): the tp-peer straggler conviction names
+    hostC (role ``dp1/pp0/tp1``) and NOT its innocent pipeline-stage
+    peers on hostD, and the post-eviction capacity re-solves through
+    the respec ladder to ``dp=1,pp=2,tp=2`` — byte-identical decision
+    log under ``--repeat``;
+
+    (2) the STATE-MIGRATION journey in subprocesses: 2x2x2 hybrid GPT
+    training (int8 pp wire, int8_ef dp compression) eats a straggler
+    sleep, dies HARD mid-1F1B at ``crash_step`` with its last
+    finalized checkpoint torn; the relaunch on the SOLVER'S predicted
+    spec (4 ranks) walks back to the previous CRC-verified step,
+    reshard-on-restores the 8-rank shards onto the 4-rank mesh with no
+    full gather, finishes the schedule, and lands within the int8_ef
+    2% bound of an uninterrupted 8-rank reference."""
+    import subprocess
+
+    os.makedirs(workdir, exist_ok=True)
+    plan = plan if plan is not None else hybrid_plan(seed, steps)
+    crash = int(plan["crash_step"])
+
+    # -- layer 1: the deterministic decision plane -----------------------
+    decisions = simulate_hybrid(plan, hybrid_policy())
+    parsed = [json.loads(l) for l in decisions]
+    evicts = [d for d in parsed if d["action"] == "evict"]
+    assert evicts and evicts[0]["target"] == "hostC" \
+        and evicts[0]["reason"] == "straggler" \
+        and evicts[0]["role"] == "dp1/pp0/tp1", \
+        f"role-aware conviction must name hostC/dp1/pp0/tp1: {decisions}"
+    assert not any(d["target"] == "hostD" for d in evicts), \
+        f"innocent pipeline peers (hostD) must not be convicted: " \
+        f"{decisions}"
+    respecs = [d for d in parsed if d["action"] == "respec"]
+    assert respecs and respecs[0]["target"] == "dp=1,pp=2,tp=2" \
+        and respecs[0]["reason"] == "shed_dp", \
+        f"capacity 6 must re-solve to shed_dp dp=1,pp=2,tp=2: " \
+        f"{decisions}"
+
+    # -- layer 2: crash / reshaped-resume / reference --------------------
+    train_py = os.path.join(workdir, "train_hybrid.py")
+    with open(train_py, "w") as f:
+        f.write(HYBRID_SCRIPT)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+
+    def phase(mode: str, ndev: int, parallel: str, with_faults: bool):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.pop("HVD_TPU_FAULT_PLAN", None)
+        if with_faults:
+            env["HVD_TPU_FAULT_PLAN"] = json.dumps(plan)
+            env["HVD_TPU_FAULT_LOG"] = fault_log
+        return subprocess.run(
+            [sys.executable, train_py, workdir, str(steps), mode,
+             str(crash), str(ndev), parallel], env=env,
+            capture_output=True, text=True, timeout=600)
+
+    p1 = phase("crash", 8, HYBRID_DECLARED, with_faults=True)
+    assert p1.returncode == 7, \
+        f"crash phase rc={p1.returncode} (want the hard exit 7)\n" \
+        f"{p1.stdout}\n{p1.stderr}"
+    p2 = phase("resume", 4, "dp=1,pp=2,tp=2", with_faults=False)
+    assert p2.returncode == 0, \
+        f"reshaped resume rc={p2.returncode}\n{p2.stdout}\n{p2.stderr}"
+    p3 = phase("reference", 8, HYBRID_DECLARED, with_faults=False)
+    assert p3.returncode == 0, \
+        f"reference rc={p3.returncode}\n{p3.stdout}\n{p3.stderr}"
+
+    with open(os.path.join(workdir, "result_resume.json")) as f:
+        resumed = json.load(f)
+    with open(os.path.join(workdir, "result_reference.json")) as f:
+        reference = json.load(f)
+    # The torn step (crash-1) was walked back: the CRC-verified restore
+    # lands on crash-2 — IN the reshaped world.
+    assert resumed["restored_step"] == crash - 2, (resumed, crash)
+    assert resumed["world"] == 4 and \
+        resumed["parallel"] == "dp=1,pp=2,tp=2", resumed
+    # Degraded-mode survival within the int8_ef bound: the dp=1 world
+    # sees the same global batch, so the trajectory matches up to the
+    # lossy-wire noise budget (docs/compression.md).
+    bound = 0.02 * abs(reference["final_loss"]) + 1e-3
+    assert abs(resumed["final_loss"] - reference["final_loss"]) \
+        <= bound, (resumed["final_loss"], reference["final_loss"])
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert {"straggler", "checkpoint_corrupt"} <= sites, sorted(sites)
+    return {
+        "metric": "chaos_soak_hybrid",
+        "seed": seed,
+        "steps": steps,
+        "crash_step": crash,
+        "restored_step": resumed["restored_step"],
+        "rc": p1.returncode,
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "decisions": decisions,
+        "respec": respecs[0]["target"],
+        "final_loss": resumed["final_loss"],
+        "reference_loss": reference["final_loss"],
+        "sequences": {
+            "sim": decisions,
+            "injections": {f"{k[0]}@{k[1]}": v
+                           for k, v in
+                           injection_sequences(log).items()},
+        },
+    }
+
+
 # -- the stall family (docs/podmon.md) ---------------------------------------
 
 def stall_plan(seed: int) -> dict:
@@ -1764,7 +2107,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
                                          "autoscale", "stall", "moe",
-                                         "serve", "zero", "pipeline"),
+                                         "serve", "zero", "pipeline",
+                                         "hybrid"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
                          "integrity = data faults through the guard/"
@@ -1800,7 +2144,18 @@ def main() -> int:
                          "wire) + a torn checkpoint: the verified "
                          "walk-back restores and the per-step event "
                          "log replays byte-identically "
-                         "(docs/pipeline.md)")
+                         "(docs/pipeline.md); "
+                         "hybrid = a straggler on a tp peer + a hard "
+                         "host loss mid-1F1B on the 2x2x2 dp x pp x "
+                         "tp world: the role-aware engine convicts "
+                         "the straggler's HOST (not its pipeline "
+                         "peers), the respec ladder re-solves the "
+                         "mesh for the surviving capacity, sharded "
+                         "state reshard-on-restores onto the new "
+                         "grid with no full gather, and training "
+                         "finishes within the int8_ef bound — "
+                         "decision log byte-identical under --repeat "
+                         "(docs/elastic.md)")
     ap.add_argument("--steps", type=int, default=None,
                     help="training steps (default: 12; family "
                          "autoscale: 120, stall: 60 — their control "
@@ -1818,11 +2173,13 @@ def main() -> int:
             "autoscale": run_autoscale_soak,
             "stall": run_stall_soak, "moe": run_moe_soak,
             "serve": run_serve_soak, "zero": run_zero_soak,
-            "pipeline": run_pipeline_soak}[args.family]
+            "pipeline": run_pipeline_soak,
+            "hybrid": run_hybrid_soak}[args.family]
     if args.steps is None:
         args.steps = {"autoscale": 120, "stall": 60,
                       "moe": 8, "serve": 40,
-                      "zero": 8, "pipeline": 8}.get(args.family, 12)
+                      "zero": 8, "pipeline": 8,
+                      "hybrid": 6}.get(args.family, 12)
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

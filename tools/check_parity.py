@@ -812,6 +812,130 @@ def check_pipeline_surface(missing: list) -> None:
         missing.append("pipeline: tests/test_pipeline.py missing")
 
 
+def check_hybrid_elastic_surface(missing: list) -> None:
+    """The elastic-hybrid-parallelism surface (ISSUE 14,
+    docs/elastic.md "hybrid worlds"): the respec solver's knobs
+    (``HVD_TPU_RESPEC_*``), the reshape metric, the role labels on pod
+    metrics + the replica-stalled gauge, the policy's ``min_np``
+    field, the solver API names, and the hybrid chaos family must all
+    exist in source AND be documented. Parsed textually (runs without
+    jax installed)."""
+    elastic_doc = REPO / "docs" / "elastic.md"
+    if not elastic_doc.exists():
+        missing.append("path: docs/elastic.md")
+        return
+    text = elastic_doc.read_text()
+    auto_text = (REPO / "docs" / "autoscale.md").read_text() \
+        if (REPO / "docs" / "autoscale.md").exists() else ""
+    pod_text = (REPO / "docs" / "podmon.md").read_text() \
+        if (REPO / "docs" / "podmon.md").exists() else ""
+    pipe_text = (REPO / "docs" / "pipeline.md").read_text() \
+        if (REPO / "docs" / "pipeline.md").exists() else ""
+    metrics_text = (REPO / "docs" / "metrics.md").read_text() \
+        if (REPO / "docs" / "metrics.md").exists() else ""
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    respec_src = (REPO / "horovod_tpu" / "parallel"
+                  / "respec.py").read_text()
+    spec_src = (REPO / "horovod_tpu" / "parallel" / "spec.py").read_text()
+    auto_src = (REPO / "horovod_tpu" / "common"
+                / "autoscale.py").read_text()
+    pod_src = (REPO / "horovod_tpu" / "common" / "podmon.py").read_text()
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+
+    if '"hybrid worlds"' not in text and "## Hybrid worlds" not in text:
+        missing.append('hybrid: docs/elastic.md lacks the '
+                       '"Hybrid worlds" section')
+
+    # Knobs: every HVD_TPU_RESPEC_* literal the solver consults, plus
+    # the enable switch, documented in docs/elastic.md.
+    knobs = set(re.findall(r'"(HVD_TPU_RESPEC[A-Z0-9_]*)"', respec_src))
+    if len(knobs) < 3:
+        missing.append("hybrid: expected >= 3 HVD_TPU_RESPEC* knobs in "
+                       "parallel/respec.py")
+    for k in sorted(knobs):
+        if k not in text:
+            missing.append(f"hybrid knob {k}: undocumented in "
+                           "docs/elastic.md")
+
+    # Metrics: the reshape counter + the replica-stalled gauge.
+    if "hvd_tpu_respec_total" not in respec_src:
+        missing.append("hybrid: parallel/respec.py does not register "
+                       "hvd_tpu_respec_total")
+    for metric, wheres in (
+            ("hvd_tpu_respec_total",
+             (("docs/elastic.md", text), ("docs/metrics.md",
+                                          metrics_text))),
+            ("hvd_tpu_pod_replica_stalled",
+             (("docs/podmon.md", pod_text), ("docs/metrics.md",
+                                             metrics_text)))):
+        for where, t in wheres:
+            if metric not in t:
+                missing.append(f"hybrid metric {metric}: undocumented "
+                               f"in {where}")
+    if "hvd_tpu_pod_replica_stalled" not in pod_src:
+        missing.append("hybrid: common/podmon.py does not serve "
+                       "hvd_tpu_pod_replica_stalled")
+
+    # The solver ladder's rung names are the decision-log reasons —
+    # the preference table in docs/elastic.md must name each.
+    for rung in ("shed_dp", "fold_pp", "drop_tp", "dp_only"):
+        if f'"{rung}"' not in respec_src and f"'{rung}'" not in respec_src:
+            missing.append(f"hybrid: respec rung {rung} not in "
+                           "parallel/respec.py")
+        elif rung not in text:
+            missing.append(f"hybrid rung {rung}: missing from the "
+                           "docs/elastic.md preference table")
+
+    # API names, defined and documented.
+    api = {"solve_respec": respec_src, "RespecDecision": respec_src,
+           "min_world": respec_src, "plan_respec": auto_src,
+           "role_label": spec_src, "replica_of": spec_src,
+           "replica_ranks": spec_src, "spec_from_env": spec_src}
+    for name, src in api.items():
+        if f"def {name}" not in src and f"class {name}" not in src:
+            missing.append(f"hybrid api {name}: not found in source")
+        elif name not in text and name not in api_text:
+            missing.append(f"hybrid api {name}: undocumented in "
+                           "docs/elastic.md or docs/api.md")
+
+    # The policy floor + role labels.
+    if "min_np: int" not in auto_src:
+        missing.append("hybrid: AutoscalePolicy lacks the min_np field")
+    elif "`min_np`" not in auto_text:
+        missing.append("hybrid: min_np missing from the "
+                       "docs/autoscale.md schema table")
+    if "resolve_min_np" not in auto_src:
+        missing.append("hybrid: AutoscalePolicy lacks resolve_min_np")
+    for where, t in (("docs/autoscale.md", auto_text),
+                     ("docs/podmon.md", pod_text)):
+        if "role" not in t or "dp" not in t:
+            missing.append(f"hybrid: role labels undocumented in {where}")
+    # The respec action in the decision table.
+    if '"respec"' not in auto_src:
+        missing.append("hybrid: autoscale.py lacks the respec action")
+    elif "respec" not in auto_text:
+        missing.append("hybrid: the respec decision is undocumented in "
+                       "docs/autoscale.md")
+
+    # Composition rows: pipeline + autoscale docs must cross-reference
+    # the elastic journey.
+    for where, t in (("docs/pipeline.md", pipe_text),
+                     ("docs/autoscale.md", auto_text)):
+        if "elastic.md" not in t:
+            missing.append(f"hybrid: {where} lacks the elastic "
+                           "composition row")
+
+    # The chaos family + its tier-1 smoke.
+    if "run_hybrid_soak" not in soak_src or '"hybrid"' not in soak_src:
+        missing.append("hybrid: chaos_soak.py lacks the hybrid family")
+    elif "--family hybrid" not in text:
+        missing.append("hybrid: the chaos family is undocumented in "
+                       "docs/elastic.md")
+    if not (REPO / "tests" / "test_respec.py").exists():
+        missing.append("hybrid: tests/test_respec.py missing")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -858,6 +982,7 @@ def main() -> int:
     check_serve_surface(missing)
     check_zero_surface(missing)
     check_pipeline_surface(missing)
+    check_hybrid_elastic_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")

@@ -40,11 +40,13 @@ from typing import Any, Dict, List, Optional
 
 # Black-box schema contract with common/flightrec.py — check_parity
 # asserts these tuples match the writer's byte for byte, so the schema
-# cannot drift between writer and reader.
-BLACKBOX_SCHEMA_VERSION = 1
-BLACKBOX_KEYS = ("schema", "rank", "host", "pid", "trigger", "reason",
-                 "t_unix", "step", "seq_head", "events", "stacks",
-                 "stall_inflight", "recovery")
+# cannot drift between writer and reader. v2 adds ``role``: the rank's
+# (dp,pp,tp) coordinate label under a hybrid ParallelSpec ("" when
+# role-blind) — verdicts then name the stage, not just the rank.
+BLACKBOX_SCHEMA_VERSION = 2
+BLACKBOX_KEYS = ("schema", "rank", "host", "role", "pid", "trigger",
+                 "reason", "t_unix", "step", "seq_head", "events",
+                 "stacks", "stall_inflight", "recovery")
 EVENT_KEYS = ("seq", "op", "name", "step", "bytes", "wire",
               "t_submit", "t_complete", "outcome")
 
@@ -57,6 +59,8 @@ def load_blackbox(path: str) -> Dict[str, Any]:
         box = json.load(f)
     if not isinstance(box, dict):
         raise ValueError(f"{path}: black box must be a JSON object")
+    if box.get("schema", 1) < 2:
+        box.setdefault("role", "")   # v1 boxes predate role labels
     missing = [k for k in BLACKBOX_KEYS if k not in box]
     if missing:
         raise ValueError(f"{path}: black box missing keys {missing} "
@@ -86,6 +90,7 @@ def analyze(boxes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
         incomplete = [e for e in box["events"] if e["outcome"] != "ok"]
         per_rank[rank] = {
             "host": box.get("host", ""),
+            "role": box.get("role", ""),
             "trigger": box.get("trigger", ""),
             "reason": box.get("reason", ""),
             "step": box.get("step", 0),
@@ -130,15 +135,23 @@ def analyze(boxes: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
         desc = {"seq": seq, "op": ref["op"], "name": ref["name"],
                 "step": ref["step"], "bytes": ref["bytes"],
                 "wire": ref["wire"]}
+        # Role-tagged rank naming (schema v2): under a hybrid
+        # ParallelSpec the verdict reads "rank 3 = dp0/pp1/tp1 never
+        # completed ppermute..." — the stage is the unit an operator
+        # reasons about, not the bare rank number.
+        def who(r):
+            role = per_rank[r]["role"] if r in per_rank else ""
+            return f"rank {r} = {role}" if role else f"rank {r}"
+
         verdicts = []
         for r in not_submitted:
             verdicts.append(
-                f"rank {r} never submitted {ref['name']} "
+                f"{who(r)} never submitted {ref['name']} "
                 f"(op={ref['op']}, seq {seq}, step {ref['step']})")
         for r in not_completed:
             out = seen[r]["outcome"]
             verdicts.append(
-                f"rank {r} never completed {ref['name']} "
+                f"{who(r)} never completed {ref['name']} "
                 f"(op={ref['op']}, seq {seq}, step {ref['step']}, "
                 f"outcome={out})")
         findings.append({**desc, "submitted_ranks": submitted,
@@ -241,7 +254,8 @@ def main() -> int:
           f"{report['ranks']}")
     for r in report["ranks"]:
         pr = report["per_rank"][str(r)]
-        print(f"  rank {r} host={pr['host'] or '?'} "
+        role = f" role={pr['role']}" if pr.get("role") else ""
+        print(f"  rank {r} host={pr['host'] or '?'}{role} "
               f"trigger={pr['trigger']} step={pr['step']} "
               f"submitted≤{pr['last_submitted_seq']} "
               f"completed≤{pr['last_completed_seq']}")
