@@ -236,7 +236,7 @@ def main():
                         "one extra scalar min-allreduce + lax.cond per "
                         "step) on the DistributedOptimizer and records "
                         "the measured overhead vs an unguarded arm "
-                        "into the BENCH json (expected <2%)")
+                        "into the BENCH json (expected <2%%)")
     p.add_argument("--remat", action="store_true",
                    help="per-layer activation recomputation on the GPT "
                         "models (long-context HBM relief)")
@@ -415,9 +415,14 @@ def main():
                 os.environ.setdefault(
                     "HVD_TPU_FORCE_CPU_DEVICES",
                     str(int(np.prod(dims))))
+    # Deferred like every other horovod_tpu import in this file: the
+    # supervisor path above must never load the package (axon PJRT
+    # registration at import would defeat its platform quarantine).
+    from horovod_tpu.common.config import runtime_env
+
     pp_req = args.pipeline_stages \
-        or int(os.environ.get("HVD_TPU_PP_STAGES", "1") or 1)
-    tp_req = args.tp or int(os.environ.get("HVD_TPU_TP", "1") or 1)
+        or int(runtime_env("PP_STAGES", "1") or 1)
+    tp_req = args.tp or int(runtime_env("TP", "1") or 1)
     if (pp_req > 1 or tp_req > 1) and args._platform == "cpu":
         # Hybrid pp/tp arm on the CPU fallback (flags or the
         # HVD_TPU_PP_STAGES/HVD_TPU_TP knobs): force enough virtual
@@ -435,7 +440,7 @@ def main():
     # fallback). With the cache, attempt 2 of the same config loads the
     # executable from disk instead of recompiling; the init() knob also
     # resets jax's once-only cache init if anything compiled earlier.
-    cache_dir = os.environ.get("HVD_TPU_COMPILATION_CACHE_DIR") or \
+    cache_dir = runtime_env("COMPILATION_CACHE_DIR") or \
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "results", ".jax_compile_cache")
     try:

@@ -87,6 +87,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import metrics as metrics_lib
+from .config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -456,7 +457,7 @@ class StepPublisher:
         ``note_step`` hot path then stays a None check."""
         if not autoscale_enabled():
             return None
-        rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
+        rdv = runtime_env("RENDEZVOUS")
         if not rdv:
             return None
         try:
@@ -480,8 +481,8 @@ class StepPublisher:
         client = RendezvousClient(host, int(port), timeout_s=2.0,
                                   retries=0)
         return cls(client,
-                   rank=int(os.environ.get("HVD_TPU_PROC_ID", "0")),
-                   host=os.environ.get("HVD_TPU_HOSTNAME", ""),
+                   rank=int(runtime_env("PROC_ID", "0")),
+                   host=runtime_env("HOSTNAME", ""),
                    window=policy.window,
                    publish_interval_s=policy.publish_interval_s)
 
@@ -633,7 +634,7 @@ class AutoscaleEngine:
         self._fetch = fetch_reports
         self._clock = clock
         self._log_path = (log_path if log_path is not None
-                          else os.environ.get(ENV_LOG)
+                          else runtime_env("AUTOSCALE_LOG")
                           or _config_fallback("autoscale_log") or None)
         self.decisions: List[Decision] = []
         self._seq = 0

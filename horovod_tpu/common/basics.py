@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence
 
 from . import shutdown as shutdown_lib
 from . import topology as topo_lib
+from . import config as config_lib
 from .config import Config, configure
 from .exceptions import NotInitializedError
 from .stall import StallInspector
@@ -67,8 +68,8 @@ class Context:
 
         parse_and_set_affinity(
             config.thread_affinity,
-            int(os.environ.get("HVD_TPU_LOCAL_SIZE", "1")),
-            int(os.environ.get("HVD_TPU_LOCAL_RANK", "0")))
+            int(config_lib.runtime_env("LOCAL_SIZE", "1")),
+            int(config_lib.runtime_env("LOCAL_RANK", "0")))
         if config.compilation_cache_dir:
             # Warm-start XLA compiles from disk: an elastic reset or
             # relaunch re-traces the same programs, and TPU compiles
@@ -222,7 +223,7 @@ class Context:
             # without a reverse lookup, and the scrape-path autoscale
             # reports need the same host key the KV reports carry.
             labels = {"rank": str(self.rank()), "size": str(self.size())}
-            virtual_np = os.environ.get("HVD_TPU_VIRTUAL_NUM_PROC")
+            virtual_np = config_lib.runtime_env("VIRTUAL_NUM_PROC")
             if virtual_np:
                 # FORCE_LOCAL virtual hosts: every worker is an
                 # independent 1-proc jax world that believes it is
@@ -230,10 +231,10 @@ class Context:
                 # autoscale KV publisher and podmon endpoint
                 # registration key on) is what pod-scope scrapes must
                 # see, or N workers collapse to one series.
-                labels["rank"] = os.environ.get("HVD_TPU_PROC_ID",
+                labels["rank"] = config_lib.runtime_env("PROC_ID",
                                                 labels["rank"])
                 labels["size"] = virtual_np
-            host_label = os.environ.get("HVD_TPU_HOSTNAME")
+            host_label = config_lib.runtime_env("HOSTNAME")
             if host_label:
                 labels["host"] = host_label
             metrics_lib.set_global_labels(**labels)
@@ -283,7 +284,7 @@ class Context:
         # topology version (reference: WorkerNotificationClient,
         # elastic/worker.py). Consumed by State.check_host_updates().
         self.host_update_notifier = None
-        rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
+        rdv = config_lib.runtime_env("RENDEZVOUS")
         if config.elastic and rdv:
             self.host_update_notifier = self._make_host_update_notifier(rdv)
         self._process_sets = []
@@ -349,7 +350,7 @@ class Context:
         layouts the launcher exports HVD_TPU_LOCAL_RANK (the reference's
         HOROVOD_LOCAL_RANK, gloo_run.py:65-99); per-device code inside jit
         uses axis_index instead."""
-        env = os.environ.get("HVD_TPU_LOCAL_RANK")
+        env = config_lib.runtime_env("LOCAL_RANK")
         if env is not None:
             return int(env)
         return 0
@@ -358,7 +359,7 @@ class Context:
         """Paired with local_rank(): the launcher's HVD_TPU_LOCAL_SIZE
         wins in one-process-per-chip layouts so 0 <= local_rank <
         local_size always holds."""
-        env = os.environ.get("HVD_TPU_LOCAL_SIZE")
+        env = config_lib.runtime_env("LOCAL_SIZE")
         if env is not None:
             return int(env)
         return self.topology.local_size

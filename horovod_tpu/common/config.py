@@ -328,6 +328,96 @@ class Config:
         return c
 
 
+# -- runtime knob registry ---------------------------------------------------
+#
+# Knobs read at CALL time rather than resolved once into Config at
+# init(): process identity the launcher exports per slot (PROC_ID,
+# HOSTNAME), rendezvous wiring that must work before init, debug
+# switches consulted lazily. Every name a `runtime_env()` read may
+# serve is declared here EXACTLY once, so the registry stays auditable
+# (tools/hvdlint rule `env-knob` forbids direct os.environ reads of
+# HVD_TPU_* keys outside this module; rule `knob-doc` and
+# check_parity cross-reference this table against docs/). A few names
+# are ALSO Config fields — tools read them pre-init (mesh shape,
+# compile cache), the Config field remains the init()-resolved form.
+RUNTIME_KNOBS = {
+    # Process identity (exported per slot by the launchers; the
+    # virtual-identity convention for FORCE_LOCAL simulated worlds).
+    "PROC_ID": "this process's rank identity",
+    "NUM_PROC": "world size as launched",
+    "LOCAL_RANK": "rank within the host",
+    "LOCAL_SIZE": "processes on this host",
+    "HOSTNAME": "host label for telemetry/attribution",
+    "VIRTUAL_NUM_PROC": "simulated world size for FORCE_LOCAL workers",
+    "COORDINATOR": "jax.distributed coordinator address",
+    "SPARK_EPOCH": "elastic epoch the spark worker joined",
+    # Rendezvous / elastic wiring (pre-init by construction).
+    "RENDEZVOUS": "controller KV address host:port",
+    "RENDEZVOUS_SECRET": "shared secret for the KV server",
+    "RENDEZVOUS_RETRIES": "client retry budget for 5xx/conn errors",
+    "RENDEZVOUS_WAIT_MAX_POLL_S": "wait() poll backoff cap",
+    "ELASTIC_FORCE_LOCAL": "virtual multi-host elastic simulation",
+    "ELASTIC_GRACE_SECS": "graceful-exit window before terminate",
+    "ELASTIC_RESET_LIMIT": "max elastic resets before giving up",
+    "DISCOVERY_DEBOUNCE": "identical scrapes before a host-set change",
+    "BLACKLIST_TTL_S": "host blacklist TTL (strike-doubled)",
+    "NIC_DISCOVERY": "probe NICs for the data-plane interface",
+    # Telemetry switches read lazily by their subsystems.
+    "METRICS": "registry enable (0 = shared NOOP singletons)",
+    "METRICS_TRACE": "metrics<->jax.profiler trace bridge",
+    "METRICS_DEBUG": "/debug/stacks + /debug/profile endpoints",
+    "METRICS_ADVERTISE": "endpoint advertised to the pod aggregator",
+    "POD_METRICS_ENDPOINTS": "static scrape endpoints for podmon",
+    "POD_METRICS_INTERVAL_S": "driver-side scrape interval",
+    "POD_REPLICA_SKEW_RATIO": "replica-stall gauge skew threshold",
+    "FLIGHTREC": "flight-recorder enable",
+    "FLIGHTREC_SIZE": "ring capacity (events)",
+    "FLIGHTREC_DIR": "black-box dump directory",
+    "FLIGHTREC_PUSH": "push black boxes to the controller KV",
+    "FLIGHTREC_SIGNAL_GRACE_S": "driver wait after SIGUSR2 fan-out",
+    "LOCKDEP": "runtime lock-order watchdog (common/lockdep.py)",
+    # Fault injection / recovery bookkeeping.
+    "FAULT_PLAN": "seeded fault-injection plan (JSON)",
+    "FAULT_LOG": "JSON-lines injection log path",
+    "RECOVERY_STATS_FILE": "at-exit recovery-counter dump path",
+    # Subsystem toggles.
+    "WIRE_FORMAT": "controller codec override (json = skip native)",
+    "DISABLE_NATIVE": "skip the native acceleration library",
+    "FLASH_ATTENTION": "pallas flash-attention kernel enable",
+    "MAX_RETAINED_HANDLES": "eager-engine completed-handle cap",
+    # Decision logs read by their subsystems at construction.
+    "AUTOSCALE_LOG": "autoscale decision log (also a Config field)",
+    "SERVE_LOG": "serve-controller decision log",
+    # Config-field twins read PRE-INIT by tools (bench/microbench):
+    # the Config field stays the init()-resolved source of truth.
+    "MESH_SHAPE": "mesh factorization override (also a Config field)",
+    "FORCE_CPU_DEVICES": "virtual CPU mesh size (also a Config field)",
+    "PP_STAGES": "pipeline stages for tools (also a Config field)",
+    "TP": "tensor-parallel degree for tools (also a Config field)",
+    "COMPILATION_CACHE_DIR":
+        "persistent XLA cache dir (also a Config field)",
+    "METRICS_PORT": "Prometheus endpoint port (also a Config field)",
+}
+
+
+def runtime_env(name: str, default: Optional[str] = None, *,
+                required: bool = False) -> Optional[str]:
+    """Read a registered call-time knob (raw string; call sites own
+    their int()/float()/truthiness parsing so migration from direct
+    ``os.environ`` reads is behavior-preserving). ``required=True``
+    mirrors ``os.environ[...]`` — KeyError with the full name when
+    unset. Unregistered names raise: a knob nobody declared is a knob
+    the audits cannot see."""
+    if name not in RUNTIME_KNOBS:
+        raise KeyError(
+            f"unregistered runtime knob {name!r}; declare it in "
+            "config.RUNTIME_KNOBS (tools/hvdlint env-knob discipline)")
+    key = "HVD_TPU_" + name
+    if required:
+        return os.environ[key]
+    return os.environ.get(key, default)
+
+
 def configure(**kwargs) -> Config:
     """Build a Config from env then apply keyword overrides."""
     c = Config.from_env()

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .config import runtime_env
 from .exceptions import (HorovodInternalError, MismatchError,
                          TensorShapeMismatchError)
 
@@ -75,7 +76,7 @@ class Request:
 
         from .. import native
 
-        if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+        if (runtime_env("WIRE_FORMAT") != "json"
                 and not self.wire_dtype and not self.process_set
                 and native.available() and self.op_type in native.OP_CODES
                 and self.dtype in native.DTYPE_CODES):
@@ -128,7 +129,7 @@ class Response:
 
         from .. import native
 
-        if (os.environ.get("HVD_TPU_WIRE_FORMAT") != "json"
+        if (runtime_env("WIRE_FORMAT") != "json"
                 and not self.kind and not self.ranks
                 and native.available()):
             data = native.encode_response(self.ok, self.tensor_name,

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from . import faults as faults_lib
+from .config import runtime_env
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
 
 logger = logging.getLogger("horovod_tpu")
@@ -318,9 +319,8 @@ def run(func: Callable) -> Callable:
         # only); a worker that can't install it just dies on SIGTERM as
         # before.
         install_preemption_handler()
-        driver_managed = bool(os.environ.get("HVD_TPU_RENDEZVOUS"))
-        reset_limit = int(os.environ.get(
-            "HVD_TPU_ELASTIC_RESET_LIMIT", "100"))
+        driver_managed = bool(runtime_env("RENDEZVOUS"))
+        reset_limit = int(runtime_env("ELASTIC_RESET_LIMIT", "100"))
         # Reset backoff (HVD_TPU_ELASTIC_RESET_BACKOFF_{BASE_S,MAX_S,
         # DEADLINE_S}): a zero-delay reset loop against a persistently
         # failing runtime is a hot crash-loop that hammers rendezvous

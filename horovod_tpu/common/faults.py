@@ -98,6 +98,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from . import metrics as metrics_lib
+from .config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -188,15 +189,15 @@ class FaultInjector:
         self._rngs = [random.Random(f"{plan.seed}:{i}:{s.site}")
                       for i, s in enumerate(plan.faults)]
         self._log_path = log_path if log_path is not None \
-            else os.environ.get(ENV_LOG) or None
+            else runtime_env("FAULT_LOG") or None
         # rank/host identity defaults to this process's env; explicit
         # values let a single-process harness (the virtual-time autoscale
         # soak) stand up one injector per SIMULATED worker, with exactly
         # the per-worker counter semantics of a real deployment.
         self._rank = rank if rank is not None \
-            else os.environ.get("HVD_TPU_PROC_ID")
+            else runtime_env("PROC_ID")
         self._host = host if host is not None \
-            else os.environ.get("HVD_TPU_HOSTNAME")
+            else runtime_env("HOSTNAME")
         self.injections: List[dict] = []
 
     def _matches(self, i: int, spec: FaultSpec, hit: int) -> bool:
@@ -286,7 +287,7 @@ def refresh_from_env() -> Optional[FaultInjector]:
     plan set after import still takes effect). A removed/emptied env var
     uninstalls."""
     global _env_raw, _injector
-    raw = os.environ.get(ENV_PLAN) or None
+    raw = runtime_env("FAULT_PLAN") or None
     if raw == _env_raw:
         return _injector
     _env_raw = raw
@@ -611,7 +612,7 @@ class RecoveryStats:
         if not any(v for v in snap.values()):
             return
         logger.warning("recovery stats at exit: %s", json.dumps(snap))
-        path = os.environ.get("HVD_TPU_RECOVERY_STATS_FILE")
+        path = runtime_env("RECOVERY_STATS_FILE")
         if path:
             try:
                 with open(path, "w") as f:

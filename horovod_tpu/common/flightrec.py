@@ -51,7 +51,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import lockdep
 from . import metrics as metrics_lib
+from .config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -132,7 +134,7 @@ class FlightRecorder:
                  push: Optional[bool] = None,
                  enabled: Optional[bool] = None):
         if enabled is None:
-            enabled = _truthy(os.environ.get(ENV_ENABLE), True)
+            enabled = _truthy(runtime_env("FLIGHTREC"), True)
         self.enabled = bool(enabled)
         if size is None:
             size = 256
@@ -140,14 +142,14 @@ class FlightRecorder:
         # Default under results/ (gitignored): chaos runs used to strew
         # blackbox.rank*.json at whatever cwd the job died in.
         self.directory = (directory if directory is not None
-                          else os.environ.get(ENV_DIR)
+                          else runtime_env("FLIGHTREC_DIR")
                           or os.path.join("results", "flightrec"))
         # Virtual-identity convention (same as podmon.register_endpoint
         # and the autoscale publisher): HVD_TPU_PROC_ID wins even over
         # an explicit rank — FORCE_LOCAL workers are 1-proc jax worlds
         # whose context rank is always 0, and N boxes must not collapse
         # onto one blackbox.rank0.json / KV key.
-        env_rank = os.environ.get("HVD_TPU_PROC_ID")
+        env_rank = runtime_env("PROC_ID")
         if env_rank is not None:
             try:
                 rank = int(env_rank)
@@ -155,7 +157,7 @@ class FlightRecorder:
                 pass
         self.rank = int(rank) if rank is not None else 0
         self.host = (host if host is not None
-                     else os.environ.get("HVD_TPU_HOSTNAME", ""))
+                     else runtime_env("HOSTNAME", ""))
         # Role label under a hybrid ParallelSpec (schema v2): the
         # post-mortem names "rank 3 = dp0/pp1/tp1", so a hung ppermute
         # points at a STAGE, not a bare number. "" when role-blind.
@@ -169,7 +171,7 @@ class FlightRecorder:
         except Exception:  # noqa: BLE001 — the recorder must construct
             self.role = ""
         self._push = push
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("flightrec.ring")
         self._ring: List[Optional[_Event]] = [None] * self.size
         self._by_name: Dict[str, _Event] = {}   # pending events only
         self._seq = 0
@@ -346,9 +348,9 @@ class FlightRecorder:
     def _push_kv(self, box: Dict[str, Any]) -> None:
         """Best-effort push to the rendezvous KV (no retries, short
         timeout — a dead controller must not delay the dump)."""
-        rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
+        rdv = runtime_env("RENDEZVOUS")
         push = (self._push if self._push is not None
-                else _truthy(os.environ.get(ENV_PUSH), True))
+                else _truthy(runtime_env("FLIGHTREC_PUSH"), True))
         if not rdv or not push:
             return
         try:
@@ -366,7 +368,7 @@ class FlightRecorder:
 # -- module-level singleton --------------------------------------------------
 
 _recorder: Optional[FlightRecorder] = None
-_recorder_lock = threading.Lock()
+_recorder_lock = lockdep.lock("flightrec.module")
 
 
 def recorder() -> FlightRecorder:
@@ -384,7 +386,7 @@ def recorder() -> FlightRecorder:
 
 def _env_size() -> int:
     try:
-        return int(os.environ.get(ENV_SIZE, "256"))
+        return int(runtime_env("FLIGHTREC_SIZE", "256"))
     except ValueError:
         return 256
 

@@ -56,6 +56,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from . import lockdep
+from .config import runtime_env
+
 ENV_ENABLE = "HVD_TPU_METRICS"          # "0"/"false" disables the registry
 ENV_FILE = "HVD_TPU_METRICS_FILE"       # JSON-lines dump path
 ENV_INTERVAL = "HVD_TPU_METRICS_INTERVAL_S"
@@ -177,7 +180,7 @@ class _Family:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.family")
         self._children: Dict[Tuple[str, ...], Any] = {}
         if not self.labelnames:
             # Unlabeled families pre-create their single sample so they
@@ -453,12 +456,12 @@ class MetricsRegistry:
     def __init__(self, enabled: Optional[bool] = None,
                  trace_bridge: Optional[bool] = None):
         if enabled is None:
-            enabled = _truthy(os.environ.get(ENV_ENABLE), True)
+            enabled = _truthy(runtime_env("METRICS"), True)
         if trace_bridge is None:
-            trace_bridge = _truthy(os.environ.get(ENV_TRACE), False)
+            trace_bridge = _truthy(runtime_env("METRICS_TRACE"), False)
         self.enabled = bool(enabled)
         self.trace_bridge = bool(trace_bridge) and self.enabled
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("metrics.registry")
         self._families: Dict[str, _Family] = {}
         self._global_labels: Dict[str, str] = {}
 
@@ -558,7 +561,7 @@ def _sample_line(name: str, labels: Dict[str, str], value: float) -> str:
 # -- module-level singleton + convenience API -------------------------------
 
 _registry: Optional[MetricsRegistry] = None
-_registry_lock = threading.Lock()
+_registry_lock = lockdep.lock("metrics.module")
 
 
 def registry() -> MetricsRegistry:
@@ -701,7 +704,7 @@ class MetricsServer:
     def start(self, port: int = 0,
               debug: Optional[bool] = None) -> int:
         if debug is None:
-            debug = _truthy(os.environ.get(ENV_DEBUG), False)
+            debug = _truthy(runtime_env("METRICS_DEBUG"), False)
         return self._http.start(
             port,
             metrics_registry=(self._reg if self._reg is not None
@@ -814,7 +817,7 @@ def _thread_stacks_text() -> str:
     return "\n".join(chunks)
 
 
-_profile_lock = threading.Lock()
+_profile_lock = lockdep.lock("metrics.profile")
 
 
 def _capture_profile(target: Optional[str], ms: int):

@@ -44,7 +44,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import lockdep
 from . import metrics as metrics_lib
+from .config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -78,13 +80,13 @@ def register_endpoint(port: int, rank: Optional[int] = None) -> bool:
     scrape it without knowing ephemeral ports. Best-effort: no
     retries, short timeout, False on any failure. No-op without
     ``HVD_TPU_RENDEZVOUS``."""
-    rdv = os.environ.get("HVD_TPU_RENDEZVOUS")
+    rdv = runtime_env("RENDEZVOUS")
     if not rdv:
         return False
     # The virtual-rank convention (FORCE_LOCAL harness, multi-process
     # launches): HVD_TPU_PROC_ID is the per-worker identity; the
     # caller's rank is the single-controller fallback.
-    env_rank = os.environ.get("HVD_TPU_PROC_ID")
+    env_rank = runtime_env("PROC_ID")
     if env_rank is not None:
         try:
             rank = int(env_rank)
@@ -92,17 +94,17 @@ def register_endpoint(port: int, rank: Optional[int] = None) -> bool:
             pass
     if rank is None:
         rank = 0
-    addr = os.environ.get(ENV_ADVERTISE)
+    addr = runtime_env("METRICS_ADVERTISE")
     if not addr:
         # Virtual local hosts (hostA, hostB, ...) are not resolvable;
         # anything the launcher forked locally is reachable on
         # loopback. Real ssh launches advertise their HVD_TPU_HOSTNAME.
-        host = os.environ.get("HVD_TPU_HOSTNAME", "")
-        if not host or os.environ.get("HVD_TPU_ELASTIC_FORCE_LOCAL"):
+        host = runtime_env("HOSTNAME", "")
+        if not host or runtime_env("ELASTIC_FORCE_LOCAL"):
             host = "127.0.0.1"
         addr = host
     record = {"rank": int(rank),
-              "host": os.environ.get("HVD_TPU_HOSTNAME", ""),
+              "host": runtime_env("HOSTNAME", ""),
               "addr": f"{addr}:{int(port)}"}
     try:
         from ..runner.rendezvous import RendezvousClient
@@ -143,7 +145,7 @@ def static_endpoints(spec: Optional[str] = None) -> Callable[[], List[str]]:
     """Fixed ``host:port,host:port`` list (``HVD_TPU_POD_METRICS_ENDPOINTS``
     — remote pods that never touch this job's KV)."""
     if spec is None:
-        spec = os.environ.get(ENV_ENDPOINTS, "")
+        spec = runtime_env("POD_METRICS_ENDPOINTS", "")
     fixed = [e.strip() for e in spec.split(",") if e.strip()]
 
     def endpoints() -> List[str]:
@@ -269,13 +271,13 @@ class PodMonitor:
         self.parallel = parallel
         if interval_s is None:
             try:
-                interval_s = float(os.environ.get(ENV_INTERVAL, "2.0"))
+                interval_s = float(runtime_env("POD_METRICS_INTERVAL_S", "2.0"))
             except ValueError:
                 interval_s = 2.0
         self.interval_s = max(0.05, float(interval_s))
         self.timeout_s = timeout_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("podmon.scrapes")
         # rank -> {"snapshot": dict, "t": clock(), "endpoint": str}
         self._ranks: Dict[int, Dict[str, Any]] = {}
         self._fails: Dict[str, int] = {}    # endpoint -> consecutive misses
@@ -447,8 +449,8 @@ class PodMonitor:
                             for k, v in groups.items()}
             if len(replica_step) >= 2:
                 try:
-                    ratio = float(os.environ.get(ENV_REPLICA_RATIO,
-                                                 "1.5"))
+                    ratio = float(runtime_env("POD_REPLICA_SKEW_RATIO",
+                                               "1.5"))
                 except ValueError:
                     ratio = 1.5
                 for rep in sorted(replica_step):

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import lockdep
 from . import metrics as metrics_lib
 from .exceptions import StallError, StallTimeoutError
 
@@ -67,7 +68,7 @@ class StallInspector:
         self.fatal: Optional[StallError] = None
         self._inflight: Dict[str, float] = {}
         self._warned: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("stall.inflight")
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
 

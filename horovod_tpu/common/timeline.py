@@ -23,6 +23,9 @@ import threading
 import time
 from typing import Optional
 
+from . import lockdep
+from .config import runtime_env
+
 # Canonical activity names (subset of reference common.h:31-62, renamed for
 # the XLA pipeline).
 NEGOTIATE = "NEGOTIATE"          # eager compile-cache miss / controller round
@@ -88,11 +91,11 @@ class Timeline:
         self._active = False
         self._start_ts = time.perf_counter()
         self._pending_starts = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("timeline.writer")
         self._native = None
         self._xprof_active = False
         self._use_native = (use_native and
-                            os.environ.get("HVD_TPU_DISABLE_NATIVE") != "1")
+                            runtime_env("DISABLE_NATIVE") != "1")
         if filename:
             self.start(filename)
 

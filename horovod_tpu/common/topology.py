@@ -22,6 +22,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import config as config_lib
+
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
@@ -69,7 +71,7 @@ def _cpu_platform_selected() -> bool:
     HVD_TPU_FORCE_CPU_DEVICES), not a real TPU pod."""
     import jax
 
-    if os.environ.get("HVD_TPU_FORCE_CPU_DEVICES"):
+    if config_lib.runtime_env("FORCE_CPU_DEVICES"):
         return True
     for raw in (os.environ.get("JAX_PLATFORMS", ""),
                 getattr(jax.config, "jax_platforms", None) or ""):
@@ -110,10 +112,10 @@ def _maybe_init_distributed() -> None:
     """
     import jax
 
-    coord = os.environ.get("HVD_TPU_COORDINATOR")
-    if coord and os.environ.get("HVD_TPU_NUM_PROC"):
-        nproc = int(os.environ["HVD_TPU_NUM_PROC"])
-        pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    coord = config_lib.runtime_env("COORDINATOR")
+    if coord and config_lib.runtime_env("NUM_PROC"):
+        nproc = int(config_lib.runtime_env("NUM_PROC", required=True))
+        pid = int(config_lib.runtime_env("PROC_ID", "0"))
         if nproc > 1:
             if _cpu_platform_selected():
                 _maybe_enable_cpu_collectives()
@@ -224,8 +226,7 @@ def mesh_shape_from_env() -> Optional[tuple]:
     """The ``HVD_TPU_MESH_SHAPE`` override that simulates a multi-axis
     mesh on any backend (the test suite's 8 virtual CPU devices stand in
     for a 2x4 pod slice)."""
-    return parse_mesh_shape(os.environ.get("HVD_TPU_MESH_SHAPE")
-                            or os.environ.get("HOROVOD_MESH_SHAPE"))
+    return parse_mesh_shape(config_lib._env("MESH_SHAPE"))
 
 
 # Default axis names, slow -> fast, matching the historical

@@ -22,7 +22,6 @@ batch) + ``hvd_tpu_infeed_queue_depth`` feed ``analyze_trace.py
 
 from __future__ import annotations
 
-import atexit
 import threading
 import weakref
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
@@ -273,7 +272,14 @@ class DeviceInfeed:
             target=self._run, args=(iter(iterator),), daemon=True,
             name="hvd-device-infeed")
         if not _ATEXIT_REGISTERED:
-            atexit.register(_close_live_infeeds)
+            # Through the ONE ordered shutdown sequence (hvdlint
+            # atexit-order): infeed workers stop before the Context
+            # drains metrics, so their final byte counters land in the
+            # drain-on-stop snapshot instead of racing it.
+            from .common import shutdown as shutdown_lib
+
+            shutdown_lib.register("data-infeeds", _close_live_infeeds,
+                                  priority=15)
             _ATEXIT_REGISTERED = True
         _LIVE_INFEEDS.add(self)
         self._thread.start()

@@ -13,7 +13,8 @@ gradients averaged through the engine's collectives.
 
 from __future__ import annotations
 
-import os
+
+from .common.config import runtime_env
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -174,8 +175,8 @@ def _train_worker(store: Store, run_id: str, model, optimizer, loss,
     import horovod_tpu as hvd
 
     hvd.init()
-    nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
-    rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    nproc = max(int(runtime_env("NUM_PROC", "1")), 1)
+    rank = int(runtime_env("PROC_ID", "0"))
 
     if data_format == "parquet":
         # Columnar path (reference Petastorm contract): this rank opens

@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 from typing import Any, Callable, Dict, List, Optional
+from .common.config import runtime_env
 
 
 # -- framing ----------------------------------------------------------------
@@ -203,7 +204,7 @@ def _worker_main(driver_addr: str) -> int:
 
     host, port = driver_addr.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)))
-    pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    pid = int(runtime_env("PROC_ID", "0"))
     _send_frame(sock, pickle.dumps(pid))
     while True:
         cmd, payload = pickle.loads(_recv_frame(sock))

@@ -15,7 +15,8 @@ reference (spark/keras/remote.py).
 
 from __future__ import annotations
 
-import os
+
+from .common.config import runtime_env
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -52,8 +53,8 @@ def _keras_train_worker(store: Store, run_id: str,
     import horovod_tpu.tensorflow as hvdtf
 
     hvd.init()
-    nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
-    rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    nproc = max(int(runtime_env("NUM_PROC", "1")), 1)
+    rank = int(runtime_env("PROC_ID", "0"))
 
     if data_format == "parquet":
         Xs, ys = load_parquet_shard(store, run_id, rank, nproc)

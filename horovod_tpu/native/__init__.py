@@ -17,6 +17,7 @@ import os
 import subprocess
 import threading
 from typing import List, Optional, Sequence, Tuple
+from ..common.config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -50,7 +51,7 @@ def load() -> Optional[ctypes.CDLL]:
             if _build_attempted:
                 return None
             _build_attempted = True
-            if os.environ.get("HVD_TPU_DISABLE_NATIVE") == "1":
+            if runtime_env("DISABLE_NATIVE") == "1":
                 return None
             if not _build():
                 return None

@@ -121,6 +121,10 @@ def _pairwise_combine(a, b, scalar_dtype=jnp.float32, eps=1e-30,
     return _combine_from_norms(a, b, dn, scalar_dtype, eps, use_pallas)
 
 
+# hvdlint: disable=ste-vjp -- reduction path: adasum combines
+# GRADIENTS the caller already computed; autodiff never flows
+# through this exchange (both partners dequantize both sides, so
+# replicas stay bitwise-identical — docs/topology.md).
 def _exchange(x, perm, axis_name, wire: str, key, use_pallas):
     """One pairwise exchange hop, in the level's wire format.
 

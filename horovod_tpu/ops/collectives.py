@@ -489,6 +489,9 @@ def hierarchical_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
     return y
 
 
+# hvdlint: disable=ste-vjp -- reduction path: consumes gradients
+# post-autodiff (EQuARX-style RS/AG of already-computed grads);
+# nothing differentiates through this exchange (docs/compression.md).
 def quantized_hierarchical_allreduce(x, op: ReduceOp = ReduceOp.AVERAGE,
                                      local_axis: str = "local",
                                      cross_axis: str = "cross",
@@ -606,6 +609,9 @@ def _deq(q, s):
     return (blocks * s[..., None]).reshape(lead + (nb * _Q_BLOCK,))
 
 
+# hvdlint: disable=ste-vjp -- reduction path: the int8_ef allreduce
+# building block runs on already-computed gradients with error
+# feedback; autodiff never crosses it (docs/compression.md).
 def quantized_reducescatter(x, op: ReduceOp = ReduceOp.SUM,
                             axis_name: str = "hvd", key=None,
                             use_pallas=None, return_residual: bool = False):

@@ -51,6 +51,7 @@ from ..common.exceptions import (AlltoallvLayoutError,
                                  TensorShapeMismatchError)
 from . import collectives as C
 from .compression import Compression, NoneCompressor
+from ..common.config import runtime_env
 
 # Unified telemetry (docs/metrics.md). _METRICS_ON freezes the enable
 # state at import so every disabled hot-path site is one bool check —
@@ -129,7 +130,7 @@ class HandleManager:
     # Class-level so that runtime overrides of the class attribute (the
     # documented tuning pattern, used by tests) are never shadowed by a
     # per-instance copy; the env var is read once at import.
-    _env = os.environ.get("HVD_TPU_MAX_RETAINED_HANDLES", "")
+    _env = runtime_env("MAX_RETAINED_HANDLES", "")
     if _env:
         try:
             max_retained = int(_env)

@@ -36,6 +36,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from .pallas_kernels import _decide
+from ..common.config import runtime_env
 
 _NEG = -1e30  # mask value; NOT -inf (exp(-inf - -inf) = nan)
 _LANE = 128
@@ -338,7 +339,7 @@ def flash_available(seq_len: int, use_pallas: Optional[bool] = None,
     import os
 
     use, _ = _decide(use_pallas)
-    if os.environ.get("HVD_TPU_FLASH_ATTENTION", "1") == "0":
+    if runtime_env("FLASH_ATTENTION", "1") == "0":
         return False
     return bool(use) and _pick_block(seq_len, block_q) is not None \
         and _pick_block(seq_len, block_k) is not None

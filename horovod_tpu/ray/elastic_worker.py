@@ -7,6 +7,7 @@ return value where the driver collects it."""
 import os
 import pickle
 import sys
+from ..common.config import runtime_env
 
 
 def main(fn_path: str, results_dir: str) -> int:
@@ -15,8 +16,8 @@ def main(fn_path: str, results_dir: str) -> int:
     with open(fn_path, "rb") as f:
         worker_fn = cloudpickle.load(f)
     value = worker_fn()
-    rank = os.environ.get("HVD_TPU_PROC_ID", "0")
-    world = os.environ.get("HVD_TPU_NUM_PROC", "1")
+    rank = runtime_env("PROC_ID", "0")
+    world = runtime_env("NUM_PROC", "1")
     os.makedirs(results_dir, exist_ok=True)
     # World size in the name lets the driver keep only the final
     # topology's values when earlier epochs were aborted mid-write.

@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from ..common import faults as faults_lib
+from ..common.config import runtime_env
 from . import hosts as hosts_lib
 from .launch import build_env_for_slot
 from .rendezvous import RendezvousServer
@@ -73,8 +74,7 @@ class ScriptHostDiscovery(HostDiscovery):
         self._primed = False
         if debounce is None:
             try:
-                debounce = int(os.environ.get(
-                    "HVD_TPU_DISCOVERY_DEBOUNCE", "2"))
+                debounce = int(runtime_env("DISCOVERY_DEBOUNCE", "2"))
             except ValueError:
                 debounce = 2
         self._debounce = max(1, debounce)
@@ -190,8 +190,8 @@ class HostManager:
         self._hosts: Dict[str, HostState] = {}
         if blacklist_ttl_s is None:
             try:
-                blacklist_ttl_s = float(os.environ.get(
-                    "HVD_TPU_BLACKLIST_TTL_S", "300"))
+                blacklist_ttl_s = float(runtime_env("BLACKLIST_TTL_S",
+                                                    "300"))
             except ValueError:
                 blacklist_ttl_s = 300.0
         self._ttl = blacklist_ttl_s
@@ -410,7 +410,7 @@ _LOCAL_NAMES = ("localhost", "127.0.0.1")
 def _is_local_epoch(slots: List[hosts_lib.SlotInfo]) -> bool:
     import socket
 
-    if os.environ.get("HVD_TPU_ELASTIC_FORCE_LOCAL"):
+    if runtime_env("ELASTIC_FORCE_LOCAL"):
         # Test/dev path: treat hostnames as virtual and fork everything
         # locally (the reference's integration tests alias localhost the
         # same way, elastic_common.py) — blacklist semantics stay
@@ -472,7 +472,7 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     from .launch import _free_port, _slot_local_env
 
     local = _is_local_epoch(slots)
-    force_local = bool(os.environ.get("HVD_TPU_ELASTIC_FORCE_LOCAL"))
+    force_local = bool(runtime_env("ELASTIC_FORCE_LOCAL"))
     procs: List = []  # (hostname, Popen)
     threads: List[threading.Thread] = []
     if spawner is not None:
@@ -539,7 +539,7 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
     epoch_ending = False
     grace_deadline = None
     grace = (grace_secs if grace_secs is not None else
-             float(os.environ.get("HVD_TPU_ELASTIC_GRACE_SECS", "30")))
+             float(runtime_env("ELASTIC_GRACE_SECS", "30")))
 
     dumps_requested = False
 
@@ -580,8 +580,8 @@ def _run_epoch(driver: ElasticDriver, slots: List[hosts_lib.SlotInfo],
             # when request_dumps() already fired earlier — the epoch's
             # grace window was the write window.
             try:
-                dump_grace = float(os.environ.get(
-                    "HVD_TPU_FLIGHTREC_SIGNAL_GRACE_S", "1.0"))
+                dump_grace = float(runtime_env(
+                    "FLIGHTREC_SIGNAL_GRACE_S", "1.0"))
             except ValueError:
                 dump_grace = 1.0
             if request_dumps() and dump_grace > 0:
@@ -796,7 +796,7 @@ def run_elastic(args, command: List[str],
         # The scrape needs per-worker endpoints: default workers to an
         # ephemeral /metrics port when nothing chose one.
         if "HVD_TPU_METRICS_PORT" not in env_extra \
-                and "HVD_TPU_METRICS_PORT" not in os.environ:
+                and runtime_env("METRICS_PORT") is None:
             env_extra["HVD_TPU_METRICS_PORT"] = "0"
 
     on_tick = None
@@ -954,8 +954,8 @@ def run_elastic(args, command: List[str],
                     faults_lib.stats.bump("resets")
                     attempts += 1
                     limit = (reset_limit if reset_limit is not None
-                             else int(os.environ.get(
-                                 "HVD_TPU_ELASTIC_RESET_LIMIT", "100")))
+                             else int(runtime_env("ELASTIC_RESET_LIMIT",
+                                                  "100")))
                     if attempts > limit:
                         logger.error("elastic: reset limit exceeded")
                         return 1
@@ -985,8 +985,7 @@ def run_elastic(args, command: List[str],
             bump_version()
             attempts += 1
             limit = (reset_limit if reset_limit is not None
-                     else int(os.environ.get(
-                         "HVD_TPU_ELASTIC_RESET_LIMIT", "100")))
+                     else int(runtime_env("ELASTIC_RESET_LIMIT", "100")))
             if attempts > limit:
                 logger.error("elastic: reset limit exceeded")
                 return rc or 1

@@ -31,6 +31,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import hosts as hosts_lib
+from ..common.config import runtime_env
 
 
 def build_env_for_slot(base_env: Dict[str, str], coordinator: str,
@@ -148,7 +149,7 @@ def run_ssh(host_infos: List[hosts_lib.HostInfo], command: List[str],
     hosts = used_hosts(host_infos, np)
     num_proc = len(hosts)
     coord_host = hosts[0]
-    if os.environ.get("HVD_TPU_NIC_DISCOVERY") == "1" and num_proc > 1:
+    if runtime_env("NIC_DISCOVERY") == "1" and num_proc > 1:
         picked = _nic_discovery_coordinator(hosts, ssh_port)
         if picked:
             coord_host = picked

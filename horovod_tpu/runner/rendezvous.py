@@ -32,6 +32,7 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse, parse_qs
 
 from ..common.httpd import BackgroundHTTPServer
+from ..common.config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -39,7 +40,7 @@ _AUTH_HEADER = "X-HVD-Auth"
 
 
 def _env_secret() -> Optional[bytes]:
-    s = os.environ.get("HVD_TPU_RENDEZVOUS_SECRET", "")
+    s = runtime_env("RENDEZVOUS_SECRET", "")
     return s.encode() if s else None
 
 
@@ -204,8 +205,7 @@ class RendezvousClient:
         self._secret = secret if secret is not None else _env_secret()
         if retries is None:
             try:
-                retries = int(os.environ.get(
-                    "HVD_TPU_RENDEZVOUS_RETRIES", "4"))
+                retries = int(runtime_env("RENDEZVOUS_RETRIES", "4"))
             except ValueError:
                 retries = 4
         self.retries = max(0, retries)
@@ -293,8 +293,7 @@ class RendezvousClient:
         from ..common import faults as faults_lib
 
         try:
-            cap = float(os.environ.get(
-                "HVD_TPU_RENDEZVOUS_WAIT_MAX_POLL_S", "1.0"))
+            cap = float(runtime_env("RENDEZVOUS_WAIT_MAX_POLL_S", "1.0"))
         except ValueError:
             cap = 1.0
         backoff = faults_lib.Backoff(base_s=0.05, cap_s=cap)

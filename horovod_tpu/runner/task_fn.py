@@ -14,12 +14,13 @@ import os
 import pickle
 import sys
 import traceback
+from ..common.config import runtime_env
 
 
 def main(payload_path: str, out_dir: str) -> int:
     import cloudpickle
 
-    pid = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    pid = int(runtime_env("PROC_ID", "0"))
     try:
         with open(payload_path, "rb") as f:
             func, args, kwargs = cloudpickle.load(f)

@@ -44,6 +44,7 @@ from .batcher import ContinuousBatcher
 from .engine import DecodeEngine
 from .queue import Request
 from .traffic import TrafficTrace
+from ..common.config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
 
@@ -210,7 +211,7 @@ class ServeController:
                  log_path: Optional[str] = None):
         self.policy = policy
         self._log_path = (log_path if log_path is not None
-                          else os.environ.get(ENV_LOG) or None)
+                          else runtime_env("SERVE_LOG") or None)
         self.decisions: List[Decision] = []
         self._seq = 0
         self._latencies: deque = deque(maxlen=max(1, policy.window))

@@ -20,19 +20,20 @@ import sys
 from ..runner.rendezvous import RendezvousClient
 from . import negotiate_coordinator
 from .task_pool import SCOPE as POOL_SCOPE
+from ..common.config import runtime_env
 
 RESULT_SCOPE = "sparkres"
 
 
 def main() -> int:
-    addr = os.environ["HVD_TPU_RENDEZVOUS"]
+    addr = runtime_env("RENDEZVOUS", required=True)
     host, port = addr.rsplit(":", 1)
-    secret = os.environ.get("HVD_TPU_RENDEZVOUS_SECRET", "")
+    secret = runtime_env("RENDEZVOUS_SECRET", "")
     client = RendezvousClient(host, int(port), timeout_s=30.0,
                               secret=secret.encode() if secret else None)
-    epoch = int(os.environ["HVD_TPU_SPARK_EPOCH"])
-    rank = int(os.environ["HVD_TPU_PROC_ID"])
-    world = int(os.environ["HVD_TPU_NUM_PROC"])
+    epoch = int(runtime_env("SPARK_EPOCH", required=True))
+    rank = int(runtime_env("PROC_ID", required=True))
+    world = int(runtime_env("NUM_PROC", required=True))
 
     env = negotiate_coordinator(client, rank, world,
                                 scope=f"sparkep/{epoch}")

@@ -47,9 +47,11 @@ def elastic_probe_fn():
     __main__) so elastic workers can unpickle it by module reference."""
     import os
 
-    return (int(os.environ["HVD_TPU_PROC_ID"]),
-            int(os.environ["HVD_TPU_NUM_PROC"]),
-            os.environ["HVD_TPU_COORDINATOR"])
+    from horovod_tpu.common.config import runtime_env
+
+    return (int(runtime_env("PROC_ID", required=True)),
+            int(runtime_env("NUM_PROC", required=True)),
+            runtime_env("COORDINATOR", required=True))
 
 
 class FakeSparkConf:

@@ -12,7 +12,8 @@ equalized so the per-step allreduce count matches on every rank.
 
 from __future__ import annotations
 
-import os
+
+from .common.config import runtime_env
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -35,8 +36,8 @@ def _torch_train_worker(store: Store, run_id: str, model,
     import horovod_tpu.torch as hvdt
 
     hvd.init()
-    nproc = max(int(os.environ.get("HVD_TPU_NUM_PROC", "1")), 1)
-    rank = int(os.environ.get("HVD_TPU_PROC_ID", "0"))
+    nproc = max(int(runtime_env("NUM_PROC", "1")), 1)
+    rank = int(runtime_env("PROC_ID", "0"))
 
     if data_format == "parquet":
         Xs, ys = load_parquet_shard(store, run_id, rank, nproc)

@@ -936,6 +936,98 @@ def check_hybrid_elastic_surface(missing: list) -> None:
         missing.append("hybrid: tests/test_respec.py missing")
 
 
+def check_lint_surface(missing: list) -> None:
+    """The static-analysis surface (ISSUE 15, docs/lint.md): every
+    hvdlint rule id documented with its historical anchor, every
+    fixture pair present, the runtime-knob registry cross-referenced
+    against docs, and the lockdep watchdog knob + API documented.
+    Parsed textually (runs without jax installed)."""
+    lint_doc = REPO / "docs" / "lint.md"
+    if not lint_doc.exists():
+        missing.append("path: docs/lint.md")
+        return
+    text = lint_doc.read_text()
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    readme_text = (REPO / "README.md").read_text() \
+        if (REPO / "README.md").exists() else ""
+
+    # Rule ids: collected from the checker sources' `rule = "..."`
+    # class attributes; each must have its docs/lint.md row.
+    checker_dir = REPO / "tools" / "hvdlint" / "checkers"
+    if not checker_dir.is_dir():
+        missing.append("path: tools/hvdlint/checkers/")
+        return
+    rules = set()
+    for path in checker_dir.glob("*.py"):
+        rules |= set(re.findall(r'^    rule = "([a-z0-9\-]+)"',
+                                path.read_text(), re.M))
+    if len(rules) < 8:
+        missing.append(f"lint: expected >= 8 checker rules, found "
+                       f"{len(rules)}")
+    for rule in sorted(rules | {"bare-suppression"}):
+        if f"`{rule}`" not in text:
+            missing.append(f"lint rule {rule}: undocumented in "
+                           "docs/lint.md")
+
+    # Fixture pairs: every checker ships one violating + one clean
+    # fixture (knob-doc uses mini-trees).
+    fixtures = REPO / "tools" / "hvdlint" / "fixtures"
+    for stem in ("env_knob", "explicit_only", "ste_vjp",
+                 "trace_purity", "signal_safety", "error_stamp",
+                 "metric_name", "lock_order"):
+        for kind in ("bad", "clean"):
+            if not (fixtures / f"{stem}_{kind}.py").exists():
+                missing.append(f"lint fixture: {stem}_{kind}.py")
+    for tree in ("knob_doc_bad", "knob_doc_clean"):
+        if not (fixtures / tree / "horovod_tpu" / "common"
+                / "config.py").exists():
+            missing.append(f"lint fixture tree: {tree}")
+
+    # Runtime knob registry: every RUNTIME_KNOBS name documented
+    # somewhere under docs/ (the same contract the knob-doc rule
+    # enforces — drift between the two audits is itself a finding).
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    m = re.search(r"RUNTIME_KNOBS = \{(.*?)\n\}", cfg_src, re.S)
+    if m is None:
+        missing.append("lint: config.RUNTIME_KNOBS table not found")
+        knob_names = []
+    else:
+        knob_names = re.findall(r'^    "([A-Z0-9_]+)":', m.group(1),
+                                re.M)
+        if len(knob_names) < 30:
+            missing.append("lint: RUNTIME_KNOBS suspiciously small "
+                           f"({len(knob_names)} entries)")
+    docs_blob = "\n".join(p.read_text()
+                          for p in (REPO / "docs").glob("*.md")) \
+        + readme_text
+    for k in knob_names:
+        if f"HVD_TPU_{k}" not in docs_blob:
+            missing.append(f"lint knob HVD_TPU_{k}: undocumented "
+                           "under docs/")
+
+    # The lockdep watchdog: knob + API + the module itself.
+    if not (REPO / "horovod_tpu" / "common" / "lockdep.py").exists():
+        missing.append("path: horovod_tpu/common/lockdep.py")
+    for needle, where, blob in (
+            ("HVD_TPU_LOCKDEP", "docs/lint.md", text),
+            ("lockdep.cycles()", "docs/lint.md", text),
+            ("hvdlint", "docs/api.md", api_text),
+            ("hvdlint", "README.md", readme_text),
+            ("docs/lint.md", "docs/parity.md",
+             DOC.read_text() if DOC.exists() else "")):
+        if needle not in blob:
+            missing.append(f"lint: {needle!r} missing from {where}")
+
+    # The tier-1 gate exists and runs the clean-tree command.
+    test_file = REPO / "tests" / "test_hvdlint.py"
+    if not test_file.exists():
+        missing.append("path: tests/test_hvdlint.py")
+    elif "tools/" not in test_file.read_text():
+        missing.append("lint: tests/test_hvdlint.py does not lint the "
+                       "full tree")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -983,6 +1075,7 @@ def main() -> int:
     check_zero_surface(missing)
     check_pipeline_surface(missing)
     check_hybrid_elastic_surface(missing)
+    check_lint_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")

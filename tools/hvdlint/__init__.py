@@ -1,0 +1,53 @@
+"""hvdlint — AST-based invariant checkers for the horovod_tpu tree.
+
+Fourteen PRs of review-caught bug classes, codified as machine law
+(docs/lint.md). The C++ reference enforced its invariants structurally
+— the coordinator protocol and fusion-buffer safety cannot be violated
+without failing to compile; a Python/JAX rebuild accumulates the same
+invariants as tribal knowledge until a checker makes each one a CI
+failure. Each rule here names the historical bug it codifies:
+
+* ``env-knob`` / ``explicit-only`` — config-registry discipline
+  (PR 7/8: an env default silently reshaping state layouts).
+* ``ste-vjp`` — straight-through VJPs on quantized exchanges (PR 10:
+  the quantized MoE dispatch that zeroed expert gradients).
+* ``trace-purity`` — no host clocks / stdlib randomness / env reads
+  inside jitted or scanned bodies.
+* ``signal-safety`` / ``atexit-order`` — PR 9's in-handler lock
+  deadlock; one ordered shutdown sequence.
+* ``error-stamp`` — every eager-engine exception path stamps its
+  flightrec ``error:`` outcome (PR 9).
+* ``metric-name`` — ``hvd_tpu_``-prefixed, documented metric names
+  (PR 4).
+* ``lock-order`` — static acquisition-graph pass over the telemetry
+  subsystems (runtime twin: ``common/lockdep.py``).
+* ``knob-doc`` — registry-declared knobs documented, without
+  importing the package.
+
+Stdlib-only (ast + pathlib): runs anywhere check_parity.py runs, no
+jax required. Suppress per line with ``# hvdlint: disable=<rule> --
+<rationale>``; a suppression without a rationale is itself a
+violation (``bare-suppression``).
+
+Run: ``python -m tools.hvdlint horovod_tpu/ tools/ bench.py``
+"""
+
+from .core import (  # noqa: F401
+    Checker,
+    FileContext,
+    LintConfig,
+    Violation,
+    all_rules,
+    iter_target_files,
+    run_paths,
+)
+
+__all__ = [
+    "Checker",
+    "FileContext",
+    "LintConfig",
+    "Violation",
+    "all_rules",
+    "iter_target_files",
+    "run_paths",
+]

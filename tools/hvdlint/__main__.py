@@ -1,0 +1,135 @@
+"""CLI: ``python -m tools.hvdlint [paths...]``.
+
+Exit-code contract: 0 = clean (suppressed findings allowed), 1 =
+unsuppressed violations, 2 = usage/internal error. ``--json`` emits
+the machine form (violations + suppressed + counts); ``--changed``
+lints only files touched in ``git diff HEAD`` plus untracked .py
+files — the fast pre-commit mode (cross-file rules then only see the
+changed set; the tier-1 clean-tree run is authoritative).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+
+from .core import (EXIT_CLEAN, EXIT_ERROR, EXIT_VIOLATIONS, all_rules,
+                   run_paths)
+
+DEFAULT_TARGETS = ("horovod_tpu/", "tools/", "bench.py")
+
+
+def _repo_root() -> pathlib.Path:
+    # tools/hvdlint/__main__.py -> repo root is two parents above tools/.
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _changed_files(repo_root: pathlib.Path) -> list:
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        cwd=repo_root, capture_output=True, text=True, check=True)
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=repo_root, capture_output=True, text=True, check=True)
+    from .core import SKIP_DIR_NAMES
+
+    files = []
+    for line in (out.stdout + untracked.stdout).splitlines():
+        line = line.strip()
+        if not line.endswith(".py") or not (repo_root / line).exists():
+            continue
+        # Same skip set as directory expansion — a touched fixture
+        # (deliberately violating) must not fail the pre-commit pass.
+        if any(part in SKIP_DIR_NAMES
+               for part in pathlib.PurePosixPath(line).parts):
+            continue
+        files.append(line)
+    return sorted(set(files))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.hvdlint",
+        description="AST-based invariant checkers (docs/lint.md)")
+    parser.add_argument("paths", nargs="*",
+                        help=f"files/dirs to lint (default: "
+                             f"{' '.join(DEFAULT_TARGETS)})")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only git-diff-touched .py files")
+    parser.add_argument("--rules",
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings (human "
+                             "output; JSON always carries both)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc, hist in all_rules():
+            print(f"{rule:18s} {desc}")
+            if hist:
+                print(f"{'':18s}   ({hist})")
+        return EXIT_CLEAN
+
+    repo_root = _repo_root()
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r for r, _, _ in all_rules()} | {"parse-error"}
+        unknown = rules - known
+        if unknown:
+            print(f"hvdlint: unknown rules: {sorted(unknown)}",
+                  file=sys.stderr)
+            return EXIT_ERROR
+
+    try:
+        if args.changed:
+            paths = _changed_files(repo_root)
+            if not paths:
+                if not args.json:
+                    print("hvdlint: no changed .py files")
+                else:
+                    print(json.dumps({"violations": [],
+                                      "suppressed": [], "files": 0}))
+                return EXIT_CLEAN
+        else:
+            paths = list(args.paths) or list(DEFAULT_TARGETS)
+        findings = run_paths(paths, repo_root, rules=rules)
+    except ValueError as e:
+        print(f"hvdlint: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    except subprocess.CalledProcessError as e:
+        print(f"hvdlint: git failed: {e}", file=sys.stderr)
+        return EXIT_ERROR
+
+    active = [v for v in findings if not v.suppressed]
+    suppressed = [v for v in findings if v.suppressed]
+
+    if args.json:
+        print(json.dumps({
+            "violations": [v.to_dict() for v in active],
+            "suppressed": [v.to_dict() for v in suppressed],
+            "counts": {"violations": len(active),
+                       "suppressed": len(suppressed)},
+        }, indent=2))
+    else:
+        for v in active:
+            print(v.render())
+        if args.show_suppressed:
+            for v in suppressed:
+                print(v.render())
+        tail = (f"hvdlint: {len(active)} violation(s), "
+                f"{len(suppressed)} suppressed")
+        print(tail if active or suppressed else "hvdlint: clean")
+    return EXIT_VIOLATIONS if active else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Shared AST lookups for hvdlint checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` attribute/name chains as a dotted string; None for
+    anything with a non-name base (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def const_str(node: ast.AST,
+              constants: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """A string literal's value; also resolves a bare Name through the
+    module-constant table (so ENV_FOO = "HVD_TPU_FOO" stays visible)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if (constants is not None and isinstance(node, ast.Name)
+            and node.id in constants):
+        return constants[node.id]
+    return None
+
+
+def str_prefix(node: ast.AST,
+               constants: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """Best-effort leading string of an expression: literals resolve
+    fully; ``"HVD_TPU_X_" + field`` and f-strings resolve to their
+    leading literal part (enough to spot an env-key prefix)."""
+    s = const_str(node, constants)
+    if s is not None:
+        return s
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return str_prefix(node.left, constants)
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+def walk_functions(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Every function/async-function with a dotted qualname
+    (``Class.method`` / ``outer.<locals>.inner`` collapses to
+    ``outer.inner`` — good enough for rule scoping)."""
+    def visit(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    """Dotted names of every decorator, looking through
+    ``functools.partial(jax.custom_vjp, ...)``-style wrapping (the
+    partial's first argument is the effective decorator)."""
+    out: List[str] = []
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name is not None and name.split(".")[-1] == "partial" \
+                    and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func
+        name = dotted_name(target)
+        if name is not None:
+            out.append(name)
+    return out
+
+
+def body_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Calls in a function body, NOT descending into nested defs
+    (nested functions get their own visit)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def all_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Every call under ``fn`` including nested defs/lambdas."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            yield node

@@ -1,0 +1,25 @@
+"""Checker registry: one module per invariant class (docs/lint.md)."""
+
+from .env_knobs import EnvKnobChecker, ExplicitOnlyChecker
+from .error_stamp import ErrorStampChecker
+from .knob_doc import KnobDocChecker
+from .lock_order import LockOrderChecker
+from .metric_names import MetricNameChecker
+from .signal_safety import AtexitOrderChecker, SignalSafetyChecker
+from .ste_vjp import SteVjpChecker
+from .trace_purity import TracePurityChecker
+
+CHECKERS = (
+    EnvKnobChecker,
+    ExplicitOnlyChecker,
+    SteVjpChecker,
+    TracePurityChecker,
+    SignalSafetyChecker,
+    AtexitOrderChecker,
+    ErrorStampChecker,
+    MetricNameChecker,
+    LockOrderChecker,
+    KnobDocChecker,
+)
+
+__all__ = ["CHECKERS"]

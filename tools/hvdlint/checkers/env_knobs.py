@@ -1,0 +1,149 @@
+"""Env-knob discipline (rules ``env-knob``, ``explicit-only``).
+
+PRs 1–14 grew ~50 direct ``os.environ.get("HVD_TPU_*")`` reads across
+the package — each one invisible to the config registry that
+``check_parity.py`` audits, so a renamed or typo'd knob silently reads
+its default forever. Rule ``env-knob``: every ``HVD_TPU_*`` read
+outside ``common/config.py`` must go through the registry
+(``Config.from_env`` for init-resolved knobs, ``config.runtime_env``
+for call-time identity/wiring knobs). Module constants are resolved
+(``ENV_FOO = "HVD_TPU_FOO"; os.environ.get(ENV_FOO)`` is still a
+direct read), as are concatenated/f-string keys with a visible
+``HVD_TPU_`` prefix. Env WRITES (launcher exports for child
+processes) are exempt.
+
+Rule ``explicit-only``: knobs documented EXPLICIT-ONLY must never be
+consulted as env/config defaults at their flagged call sites —
+``accum_steps=`` on DistributedGradFn reinterprets the first argument
+(PR 8), ``route=`` on the sharded surfaces reshapes state layouts
+built outside any trace (PR 7), and ``parallel=`` renames reduction
+axes (PR 13). An env knob must never break an existing call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+# Files allowed to touch os.environ for HVD_TPU_* keys directly: the
+# registry itself.
+ALLOWED_SUFFIXES = ("horovod_tpu/common/config.py",)
+
+_READ_FUNCS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+
+# EXPLICIT-ONLY table: scope name -> (knob, banned resolver calls,
+# banned _env* literal names). A ``.config.<knob>`` attribute chain is
+# banned in every flagged scope.
+EXPLICIT_ONLY = {
+    "DistributedGradFn": ("accum_steps", {"_resolve_accum_steps"},
+                          {"ACCUM_STEPS"}),
+    "sharded_init": ("route", {"_resolve_route"}, {"ROUTE"}),
+    "sharded_update": ("route", {"_resolve_route"}, {"ROUTE"}),
+    "ShardedOptimizer": ("route", {"_resolve_route"}, {"ROUTE"}),
+    "FSDPOptimizer": ("route", {"_resolve_route"}, {"ROUTE"}),
+    "DistributedOptimizer": ("parallel", {"spec_from_env"},
+                             {"PARALLEL"}),
+    "ZeroOptimizer": ("parallel", {"spec_from_env"}, {"PARALLEL"}),
+}
+
+
+def _is_env_key(node: ast.AST, ctx: FileContext) -> bool:
+    prefix = astutil.str_prefix(node, ctx.module_constants)
+    return prefix is not None and prefix.startswith("HVD_TPU_")
+
+
+class EnvKnobChecker(Checker):
+    rule = "env-knob"
+    description = ("direct os.environ read of an HVD_TPU_* knob outside "
+                   "the config registry")
+    historical = ("PR 15 motivation: ~50 registry-bypassing reads in 22 "
+                  "files, invisible to check_parity's knob audit")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        if any(ctx.rel.endswith(sfx) for sfx in ALLOWED_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            # os.environ.get("HVD_TPU_X") / os.getenv("HVD_TPU_X")
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in _READ_FUNCS and node.args \
+                        and _is_env_key(node.args[0], ctx):
+                    yield ctx.violation(
+                        self.rule, node,
+                        "HVD_TPU_* knob read bypasses the config "
+                        "registry; use horovod_tpu.common.config "
+                        "(runtime_env / Config.from_env)")
+            # os.environ["HVD_TPU_X"] as a READ (writes are launcher
+            # exports and stay legal).
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                base = astutil.dotted_name(node.value)
+                if base in ("os.environ", "environ") \
+                        and _is_env_key(node.slice, ctx):
+                    yield ctx.violation(
+                        self.rule, node,
+                        "HVD_TPU_* subscript read bypasses the config "
+                        "registry; use config.runtime_env(..., "
+                        "required=True)")
+            # "HVD_TPU_X" in os.environ
+            elif isinstance(node, ast.Compare) and node.ops \
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                target = astutil.dotted_name(node.comparators[0]) \
+                    if node.comparators else None
+                if target in ("os.environ", "environ") \
+                        and _is_env_key(node.left, ctx):
+                    yield ctx.violation(
+                        self.rule, node,
+                        "HVD_TPU_* membership test bypasses the config "
+                        "registry; use config.runtime_env(...) is not "
+                        "None")
+
+
+class ExplicitOnlyChecker(Checker):
+    rule = "explicit-only"
+    description = ("an EXPLICIT-ONLY knob (DistributedGradFn accum_steps=, "
+                   "sharded-surface route=, parallel=) consulted as an "
+                   "env/config default at its flagged call site")
+    historical = ("PR 7/8/13: an env default must never change a call "
+                  "site's return arity, state layout, or reduction axes")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        for qual, fn in astutil.walk_functions(ctx.tree):
+            scope = qual.split(".")[0]
+            entry = EXPLICIT_ONLY.get(scope)
+            if entry is None:
+                continue
+            knob, banned_calls, banned_envs = entry
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = astutil.call_name(node)
+                    last = name.split(".")[-1] if name else ""
+                    if last in banned_calls:
+                        yield ctx.violation(
+                            self.rule, node,
+                            f"{scope}: {knob}= is EXPLICIT-ONLY; "
+                            f"{last}() consults the env/config default "
+                            "here")
+                    elif last in ("_env", "_env_int", "_env_bool",
+                                  "_env_float", "runtime_env") \
+                            and node.args:
+                        lit = astutil.const_str(node.args[0],
+                                                ctx.module_constants)
+                        if lit in banned_envs:
+                            yield ctx.violation(
+                                self.rule, node,
+                                f"{scope}: {knob}= is EXPLICIT-ONLY; "
+                                f"HVD_TPU_{lit} must not be read here")
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr == knob:
+                    name = astutil.dotted_name(node)
+                    if name is not None and f".config.{knob}" in \
+                            ("." + name):
+                        yield ctx.violation(
+                            self.rule, node,
+                            f"{scope}: {knob}= is EXPLICIT-ONLY; the "
+                            f"Config.{knob} default must not be "
+                            "consulted here")

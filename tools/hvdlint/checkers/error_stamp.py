@@ -1,0 +1,148 @@
+"""Collective-failure stamping (rule ``error-stamp``).
+
+PR 9's flight recorder only works if EVERY exception path through the
+eager engine's submit/complete surface stamps its ``error:<Type>``
+outcome into the ring before the completion bookkeeping (``_end``)
+releases the name — otherwise a post-mortem shows the failed
+collective as ``pending`` forever (or worse, ``ok``) and
+``flight_diff`` attributes the hang to the wrong rank.
+
+Rule: in any class that defines both ``_begin`` and ``_fail`` (the
+submit/complete surface contract), a method that calls
+``self._begin(...)`` must route every exception path through
+``self._fail``:
+
+* an ``except`` handler that (re-)raises without calling
+  ``self._fail`` is a violation;
+* an ``except`` handler that calls ``self._end`` without
+  ``self._fail`` is a violation (the name is released with no outcome
+  stamped);
+* a ``raise`` after the ``_begin`` call that is not inside a ``try``
+  whose handlers call ``self._fail`` leaks the in-flight name (the
+  next submit of the same name times out in
+  DuplicateTensorNameError).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+
+def _self_call(node: ast.AST, attr: str) -> bool:
+    """Any ``self.<attr>(...)`` call under ``node``."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                astutil.call_name(n) == f"self.{attr}":
+            return True
+    return False
+
+
+class ErrorStampChecker(Checker):
+    rule = "error-stamp"
+    description = ("eager-engine exception path misses its flightrec "
+                   "error: stamp (self._fail) before releasing the name")
+    historical = ("PR 9: an unstamped failure leaves the collective "
+                  "'pending' in every black box — flight_diff then "
+                  "blames the wrong rank")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            method_names = {n.name for n in cls.body
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef))}
+            if "_begin" not in method_names or \
+                    "_fail" not in method_names:
+                continue
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name in ("_begin", "_fail", "_end"):
+                    continue
+                yield from self._check_method(ctx, method)
+
+    def _check_method(self, ctx: FileContext,
+                      method: ast.AST) -> Iterable[Violation]:
+        begin_line: Optional[int] = None
+        for call in astutil.body_calls(method):
+            if astutil.call_name(call) == "self._begin":
+                begin_line = call.lineno
+                break
+        if begin_line is None:
+            return
+
+        # Try statements (direct body, not nested defs) whose handlers
+        # stamp via self._fail — raises inside those are covered.
+        guarded: List[ast.Try] = []
+        handlers: List[ast.ExceptHandler] = []
+
+        def scan(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Try):
+                    if any(_self_call(h, "_fail")
+                           for h in child.handlers):
+                        guarded.append(child)
+                    handlers.extend(child.handlers)
+                scan(child)
+
+        scan(method)
+
+        for h in handlers:
+            stamps = _self_call(h, "_fail")
+            raises = any(isinstance(n, ast.Raise) for n in ast.walk(h))
+            ends = _self_call(h, "_end")
+            if stamps:
+                continue
+            if ends:
+                yield ctx.violation(
+                    self.rule, h,
+                    f"{method.name}: except handler calls self._end "
+                    "without self._fail — the failure completes with "
+                    "no error: outcome stamped in the flight ring")
+            elif raises:
+                yield ctx.violation(
+                    self.rule, h,
+                    f"{method.name}: except handler re-raises without "
+                    "self._fail — stamp the error: outcome before the "
+                    "exception escapes the submit surface")
+
+        def covered(raise_node: ast.Raise) -> bool:
+            for t in guarded:
+                if any(n is raise_node for n in ast.walk(t)):
+                    return True
+            return False
+
+        raises: List[ast.Raise] = []
+
+        def collect_raises(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue        # nested defs raise at CALL time
+                if isinstance(child, ast.Raise):
+                    raises.append(child)
+                collect_raises(child)
+
+        collect_raises(method)
+        for node in raises:
+            if node.lineno > begin_line and not covered(node):
+                # Raises inside except handlers were judged above.
+                if any(any(m is node for m in ast.walk(h))
+                       for h in handlers):
+                    continue
+                yield ctx.violation(
+                    self.rule, node,
+                    f"{method.name}: raise after self._begin outside "
+                    "any _fail-guarded try — the in-flight name leaks "
+                    "(next submit of this name dies in "
+                    "DuplicateTensorNameError) and no error: outcome "
+                    "is stamped")

@@ -1,0 +1,98 @@
+"""Knob-doc parity (rule ``knob-doc``).
+
+The static counterpart of ``check_parity.py``'s knob audits, running
+without importing the package (pure AST over ``common/config.py``):
+every knob the registry declares — a ``_env*("NAME", ...)`` literal
+in ``Config.from_env`` or a ``RUNTIME_KNOBS`` table key — must have
+its ``HVD_TPU_<NAME>`` spelling somewhere under ``docs/``. A knob you
+cannot find in the docs is a knob nobody will ever set; a knob
+renamed in code but not in docs reads its default forever for every
+user following the docs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+_ENV_FUNCS = {"_env", "_env_int", "_env_float", "_env_bool"}
+
+CONFIG_SUFFIX = "horovod_tpu/common/config.py"
+
+
+def collect_declared_knobs(
+        ctx: FileContext) -> List[Tuple[str, ast.AST]]:
+    """(knob name, declaring node) for every registry declaration in
+    config.py: ``_env*("NAME")`` literals + RUNTIME_KNOBS keys."""
+    out: List[Tuple[str, ast.AST]] = []
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            last = name.split(".")[-1] if name else ""
+            if last in _ENV_FUNCS and node.args:
+                lit = astutil.const_str(node.args[0])
+                if lit and lit not in seen:
+                    seen.add(lit)
+                    out.append((lit, node))
+        elif isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+            if "RUNTIME_KNOBS" in targets \
+                    and isinstance(node.value, ast.Dict):
+                for key in node.value.keys:
+                    lit = astutil.const_str(key) if key is not None \
+                        else None
+                    if lit and lit not in seen:
+                        seen.add(lit)
+                        out.append((lit, key))
+    return out
+
+
+class KnobDocChecker(Checker):
+    rule = "knob-doc"
+    description = ("registry-declared knob with no HVD_TPU_* mention "
+                   "anywhere under docs/")
+    historical = ("check_parity's knob audits, made static: an "
+                  "undocumented knob reads its default forever for "
+                  "every user following the docs")
+
+    def _docs_text(self) -> str:
+        docs = self.config.repo_root / "docs"
+        chunks = []
+        if docs.is_dir():
+            for f in sorted(docs.glob("*.md")):
+                try:
+                    chunks.append(f.read_text())
+                except OSError:
+                    pass
+        readme = self.config.repo_root / "README.md"
+        if readme.exists():
+            chunks.append(readme.read_text())
+        return "\n".join(chunks)
+
+    def finalize(self,
+                 contexts: Iterable[FileContext]) -> Iterable[Violation]:
+        cfg_ctx: Optional[FileContext] = None
+        for ctx in contexts:
+            if ctx.rel.endswith(CONFIG_SUFFIX):
+                cfg_ctx = ctx
+                break
+        if cfg_ctx is None:
+            return      # config not in the target set (e.g. --changed)
+        docs = self._docs_text()
+        if not docs:
+            return
+        declared: Dict[str, ast.AST] = dict(
+            collect_declared_knobs(cfg_ctx))
+        for knob, node in sorted(declared.items()):
+            if f"HVD_TPU_{knob}" not in docs:
+                yield cfg_ctx.violation(
+                    self.rule, node,
+                    f"knob HVD_TPU_{knob} is declared in the registry "
+                    "but appears nowhere under docs/ — add its row "
+                    "(docs/api.md knob table or the owning "
+                    "subsystem's doc)")

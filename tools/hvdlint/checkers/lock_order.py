@@ -1,0 +1,187 @@
+"""Static lock-order pass (rule ``lock-order``).
+
+PR 9's deadlock class: thread A holds lock X and wants Y while thread
+B holds Y and wants X. The telemetry subsystems (metrics, flightrec,
+podmon, stall, timeline) all keep their hot paths lock-cheap by
+design — a lock is held for dict writes only, and cross-subsystem
+calls happen OUTSIDE the ``with`` block. This pass enforces that
+design statically: build the acquisition graph over every ``with
+<lock>:`` nesting (lexical, plus one safe level of call resolution)
+and fail on any cycle. The runtime twin is ``common/lockdep.py``
+(``HVD_TPU_LOCKDEP=1``), which records the ACTUAL acquisition DAG
+under the tier-1 threaded tests.
+
+Lock identity is ``Class._lockattr`` for ``self.*`` locks and
+``module._lockname`` for module-level locks; names are matched by a
+``lock`` substring in the final attribute. Call-edge resolution is
+deliberately conservative: only method/function names defined exactly
+ONCE across the scanned tree (and not on the common-verb deny list)
+contribute edges — a bogus edge would fabricate deadlocks that do not
+exist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+# Names too generic to resolve to one callee (dict.get, list.append,
+# Event.set... any resolution here would be a guess).
+_COMMON_VERBS = {"get", "set", "put", "pop", "add", "append", "update",
+                 "items", "values", "keys", "close", "start", "stop",
+                 "join", "run", "send", "recv", "write", "read", "wait",
+                 "clear", "discard", "remove", "register", "submit",
+                 "inc", "dec", "observe", "labels"}
+
+
+def _lock_name(node: ast.AST) -> Optional[str]:
+    name = astutil.dotted_name(node)
+    if name is None:
+        return None
+    last = name.split(".")[-1]
+    if "lock" in last.lower():
+        return name
+    return None
+
+
+def _canonical(name: str, cls: Optional[str], mod: str) -> str:
+    parts = name.split(".")
+    if parts[0] == "self" and cls is not None:
+        return f"{cls}.{'.'.join(parts[1:])}"
+    if len(parts) == 1:
+        return f"{mod}.{parts[0]}"
+    return f"{mod}.{name}"
+
+
+class _FnInfo:
+    __slots__ = ("qual", "mod", "cls", "node", "acquires", "ctx")
+
+    def __init__(self, qual: str, mod: str, cls: Optional[str],
+                 node: ast.AST, ctx: FileContext):
+        self.qual = qual
+        self.mod = mod
+        self.cls = cls
+        self.node = node
+        self.ctx = ctx
+        self.acquires: Set[str] = set()
+
+
+class LockOrderChecker(Checker):
+    rule = "lock-order"
+    description = ("cyclic lock-acquisition order across the telemetry "
+                   "subsystems (static with-nesting graph)")
+    historical = ("PR 9: the in-handler dump deadlock — two components "
+                  "taking the same two locks in opposite orders only "
+                  "deadlocks under live concurrency")
+
+    def finalize(self,
+                 contexts: Iterable[FileContext]) -> Iterable[Violation]:
+        infos: List[_FnInfo] = []
+        by_name: Dict[str, List[_FnInfo]] = {}
+        for ctx in contexts:
+            mod = ctx.rel.rsplit("/", 1)[-1].removesuffix(".py")
+            for qual, fn in astutil.walk_functions(ctx.tree):
+                parts = qual.split(".")
+                cls = parts[-2] if len(parts) >= 2 else None
+                info = _FnInfo(qual, mod, cls, fn, ctx)
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            name = _lock_name(item.context_expr)
+                            if name is not None:
+                                info.acquires.add(
+                                    _canonical(name, cls, mod))
+                infos.append(info)
+                by_name.setdefault(parts[-1], []).append(info)
+
+        # Edges: held lock -> acquired lock, with provenance.
+        edges: Dict[str, Dict[str, Tuple[FileContext, ast.AST]]] = {}
+
+        def add_edge(a: str, b: str, ctx: FileContext,
+                     node: ast.AST) -> None:
+            if a == b:
+                return
+            edges.setdefault(a, {}).setdefault(b, (ctx, node))
+
+        def resolve_call(call: ast.Call) -> Optional[_FnInfo]:
+            name = astutil.call_name(call)
+            if name is None:
+                return None
+            last = name.split(".")[-1]
+            if last in _COMMON_VERBS:
+                return None
+            cands = by_name.get(last, [])
+            lockers = [c for c in cands if c.acquires]
+            if len(lockers) == 1 and len(cands) == 1:
+                return lockers[0]
+            return None
+
+        for info in infos:
+            for node in ast.walk(info.node):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                held = [_canonical(n, info.cls, info.mod)
+                        for n in (_lock_name(i.context_expr)
+                                  for i in node.items) if n is not None]
+                if not held:
+                    continue
+                for inner in ast.walk(node):
+                    if inner is node:
+                        continue
+                    if isinstance(inner, (ast.With, ast.AsyncWith)):
+                        for item in inner.items:
+                            nm = _lock_name(item.context_expr)
+                            if nm is not None:
+                                tgt = _canonical(nm, info.cls, info.mod)
+                                for h in held:
+                                    add_edge(h, tgt, info.ctx, inner)
+                    elif isinstance(inner, ast.Call):
+                        callee = resolve_call(inner)
+                        if callee is not None:
+                            for acq in callee.acquires:
+                                for h in held:
+                                    add_edge(h, acq, info.ctx, inner)
+
+        # Cycle detection (DFS with colors); report each cycle once.
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        out: List[Violation] = []
+
+        def visit(nodekey: str) -> None:
+            color[nodekey] = GRAY
+            stack.append(nodekey)
+            for nxt in sorted(edges.get(nodekey, {})):
+                c = color.get(nxt, WHITE)
+                if c == WHITE:
+                    visit(nxt)
+                elif c == GRAY:
+                    i = stack.index(nxt)
+                    cycle = tuple(stack[i:])
+                    anchor = min(cycle)
+                    k = cycle.index(anchor)
+                    canon = cycle[k:] + cycle[:k]
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    ctx, node = edges[nodekey][nxt]
+                    out.append(ctx.violation(
+                        self.rule, node,
+                        "lock-order cycle: "
+                        + " -> ".join([*canon, canon[0]])
+                        + " — two threads taking these in opposite "
+                        "orders deadlock; release before crossing "
+                        "subsystems (run HVD_TPU_LOCKDEP=1 for the "
+                        "runtime trace)"))
+            stack.pop()
+            color[nodekey] = BLACK
+
+        for key in sorted(set(edges)
+                          | {b for m in edges.values() for b in m}):
+            if color.get(key, WHITE) == WHITE:
+                visit(key)
+        return out

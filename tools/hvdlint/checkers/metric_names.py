@@ -1,0 +1,86 @@
+"""Metric-name discipline (rule ``metric-name``).
+
+PR 4's registry + ``check_parity.check_metrics_surface`` made
+undocumented metrics loud — but only for names matching a regex over
+merged sources, AFTER the metric shipped. This rule moves the check
+to the AST: every ``counter``/``gauge``/``histogram`` registration
+with a literal name must use the ``hvd_tpu_`` prefix (one namespace
+on a pod-wide scrape) and the name must already have its row in
+``docs/metrics.md`` (an undocumented metric is an undiscoverable
+one). Non-literal names (the registry's own forwarding wrappers) are
+out of scope — they forward literals that ARE checked at their call
+sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .. import astutil
+from ..core import Checker, FileContext, LintConfig, Violation
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+_CONSTRUCTORS = {"Counter", "Gauge", "Histogram"}
+_NAME_OK = re.compile(r"^hvd_tpu_[a-z0-9_]+$")
+
+# The registry's own module defines the factories and validates names
+# generically; literals there are schema examples, not registrations.
+EXEMPT_SUFFIXES = ("horovod_tpu/common/metrics.py",)
+
+
+class MetricNameChecker(Checker):
+    rule = "metric-name"
+    description = ("metric registered without an hvd_tpu_ prefix or "
+                   "without a docs/metrics.md row")
+    historical = ("PR 4: the metrics namespace is one pod-wide scrape; "
+                  "an unprefixed or undocumented name is invisible to "
+                  "operators and to check_parity")
+
+    def __init__(self, config: LintConfig):
+        super().__init__(config)
+        self._doc_text: Optional[str] = None
+
+    def _docs(self) -> Optional[str]:
+        if self._doc_text is None:
+            doc = self.config.repo_root / "docs" / "metrics.md"
+            self._doc_text = doc.read_text() if doc.exists() else ""
+        return self._doc_text
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        if any(ctx.rel.endswith(sfx) for sfx in EXEMPT_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            callee = astutil.call_name(node)
+            if callee is None:
+                continue
+            last = callee.split(".")[-1]
+            if last in _FACTORIES:
+                pass
+            elif last in _CONSTRUCTORS:
+                # Only metrics-qualified constructors: collections.
+                # Counter("abc") is not a metric registration.
+                base = callee.rsplit(".", 1)[0] if "." in callee else ""
+                if "metrics" not in base:
+                    continue
+            else:
+                continue
+            name = astutil.const_str(node.args[0], ctx.module_constants)
+            if name is None:
+                continue        # forwarding wrapper; checked at source
+            if not _NAME_OK.match(name):
+                yield ctx.violation(
+                    self.rule, node,
+                    f"metric name {name!r} must match "
+                    "hvd_tpu_[a-z0-9_]+ — one prefix, one pod-wide "
+                    "namespace")
+                continue
+            docs = self._docs()
+            if docs and name not in docs:
+                yield ctx.violation(
+                    self.rule, node,
+                    f"metric {name} has no row in docs/metrics.md — "
+                    "document it before registering it")

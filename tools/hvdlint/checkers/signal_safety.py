@@ -1,0 +1,118 @@
+"""Signal-handler and atexit discipline (rules ``signal-safety``,
+``atexit-order``).
+
+PR 9, live on hardware: the driver fans SIGUSR2 to every survivor
+exactly while they are submitting collectives. A Python signal
+handler runs on the main thread BETWEEN BYTECODES — possibly inside a
+``with lock:`` block of the very registry/recorder/inspector the
+handler wants to use. Acquiring those locks (or doing blocking I/O)
+from the handler deadlocks against the suspended holder underneath
+it. The law: a handler may only set flags, send signals, or hand the
+real work to a short-lived thread (``flightrec._on_sigusr2`` is the
+reference pattern).
+
+``atexit-order``: three subsystems once raced each other at
+interpreter exit through independently registered atexit hooks
+(reverse-registration order is an accident of import order); a
+black-box dump could interleave with a half-drained metrics file.
+``common/shutdown.py`` is the ONE ordered sequence — every atexit
+hook in the package goes through ``shutdown.register(name, fn,
+priority)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+# Calls a signal handler must not make directly: lock-takers on the
+# telemetry registries, blocking I/O, thread joins.
+_DENY_CALLS = {"dump", "maybe_dump_for", "blackbox", "snapshot",
+               "prometheus_text", "acquire", "open", "put", "post",
+               "write", "flush", "join", "sleep", "shutdown", "run"}
+
+ATEXIT_ALLOWED_SUFFIXES = ("horovod_tpu/common/shutdown.py",)
+
+
+def _handler_names(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Names bound as handlers in any ``signal.signal(SIG, h)`` call."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name is None or name.split(".")[-1] != "signal":
+            continue
+        # signal.signal(sig, handler) — the module is also called
+        # ``signal``, so require the two-arg shape.
+        if len(node.args) == 2 and isinstance(node.args[1], ast.Name):
+            out[node.args[1].id] = node
+    return out
+
+
+class SignalSafetyChecker(Checker):
+    rule = "signal-safety"
+    description = ("signal handler acquires telemetry locks / does "
+                   "blocking I/O instead of hopping to a thread")
+    historical = ("PR 9: SIGUSR2 black-box dump deadlocked against the "
+                  "lock the interrupted main thread was holding")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        handlers = _handler_names(ctx.tree)
+        if not handlers:
+            return
+        fns = dict(astutil.walk_functions(ctx.tree))
+        for qual, fn in fns.items():
+            short = qual.split(".")[-1]
+            if short not in handlers:
+                continue
+            # Direct body only: work handed to a thread via
+            # ``threading.Thread(target=...)`` is the sanctioned
+            # pattern (the target reference is not a call).
+            for call in astutil.body_calls(fn):
+                name = astutil.call_name(call)
+                last = name.split(".")[-1] if name else ""
+                if last in _DENY_CALLS:
+                    yield ctx.violation(
+                        self.rule, call,
+                        f"{qual}: {last}() in a signal handler — the "
+                        "handler interrupts the main thread possibly "
+                        "inside the lock this needs; set a flag or "
+                        "hand the work to a short-lived thread "
+                        "(flightrec._on_sigusr2 pattern)")
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        name = astutil.dotted_name(item.context_expr)
+                        if name is not None and \
+                                "lock" in name.split(".")[-1].lower():
+                            yield ctx.violation(
+                                self.rule, node,
+                                f"{qual}: acquiring {name} in a signal "
+                                "handler deadlocks against the "
+                                "suspended holder underneath it")
+
+
+class AtexitOrderChecker(Checker):
+    rule = "atexit-order"
+    description = ("direct atexit.register outside common/shutdown.py's "
+                   "ordered sequence")
+    historical = ("PR 9: independent atexit hooks raced the black-box "
+                  "write against the metrics drain at interpreter exit")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        if any(ctx.rel.endswith(sfx) for sfx in ATEXIT_ALLOWED_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in ("atexit.register", "atexit.unregister"):
+                    yield ctx.violation(
+                        self.rule, node,
+                        "atexit hook bypasses the ordered shutdown "
+                        "sequence; use common/shutdown.register(name, "
+                        "fn, priority) so teardown order stays "
+                        "deterministic")

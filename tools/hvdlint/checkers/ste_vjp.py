@@ -1,0 +1,132 @@
+"""STE-VJP completeness (rule ``ste-vjp``).
+
+PR 10's quantized MoE dispatch called ``quantize_int8`` + a raw
+``lax.all_to_all`` inline in the differentiated forward. ``round()``
+has zero gradient almost everywhere, so autodiff silently returned
+ZERO expert gradients — the model trained, the loss moved (dense
+paths still learned), and only a live verify drive caught it. The fix
+is the straight-through pattern: wrap the quantized exchange in a
+``jax.custom_vjp`` whose backward rides the transpose exchange in the
+same wire format (``collectives._int8_a2a`` / ``_int8_ppermute``).
+
+Rule: a function that performs a RAW exchange primitive
+(``lax.ppermute`` / ``lax.all_to_all`` / ``psum``) AND int8-quantizes
+in the same body must be part of a ``custom_vjp`` trio — decorated
+with ``custom_vjp``, registered via ``X.defvjp(fwd, bwd)``, or a
+helper reachable only from such functions. bf16 casts are exempt:
+``convert_element_type`` is linear and JAX differentiates it exactly;
+only rounding kills the gradient.
+
+Reduction-path functions (gradients consumed POST-autodiff, never
+differentiated through) are legitimate suppressions — say so in the
+rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+_EXCHANGE = {"ppermute", "all_to_all", "psum"}
+_QUANT_CALLS = ("quantize_int8", "quantize_int8_stochastic",
+                "_int8_chunks", "quantize_heads")
+_INT8_NAMES = {"jnp.int8", "np.int8", "numpy.int8", "jax.numpy.int8"}
+
+
+def _quantizes(node: ast.Call, ctx: FileContext) -> bool:
+    name = astutil.call_name(node)
+    last = name.split(".")[-1] if name else ""
+    if last.startswith(_QUANT_CALLS[0]) or last in _QUANT_CALLS:
+        return True
+    if last == "astype" and node.args:
+        arg = node.args[0]
+        lit = astutil.const_str(arg, ctx.module_constants)
+        if lit == "int8":
+            return True
+        dotted = astutil.dotted_name(arg)
+        if dotted in _INT8_NAMES:
+            return True
+    return False
+
+
+def _exchanges(node: ast.Call) -> bool:
+    name = astutil.call_name(node)
+    last = name.split(".")[-1] if name else ""
+    return last in _EXCHANGE
+
+
+class SteVjpChecker(Checker):
+    rule = "ste-vjp"
+    description = ("int8 quantization feeding a raw differentiated "
+                   "exchange (ppermute/all_to_all/psum) outside a "
+                   "custom_vjp straight-through pattern")
+    historical = ("PR 10: quantized MoE dispatch silently zeroed expert "
+                  "gradients (round() has zero gradient a.e.)")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        fns = dict(astutil.walk_functions(ctx.tree))
+
+        # Protected set: custom_vjp-decorated + defvjp-registered
+        # functions, then helpers reachable ONLY from protected ones.
+        protected: Set[str] = set()
+        for qual, fn in fns.items():
+            decs = astutil.decorator_names(fn)
+            if any(d.split(".")[-1] == "custom_vjp" for d in decs):
+                protected.add(qual)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name and name.split(".")[-1] == "defvjp":
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in fns:
+                            protected.add(arg.id)
+
+        # Module-internal caller map: bare-name calls between
+        # module-level functions.
+        callers: Dict[str, Set[str]] = {q: set() for q in fns}
+        for qual, fn in fns.items():
+            for call in astutil.body_calls(fn):
+                name = astutil.call_name(call)
+                if name in callers:
+                    callers[name].add(qual)
+        changed = True
+        while changed:
+            changed = False
+            for qual in fns:
+                if qual in protected:
+                    continue
+                # Nested defs inherit protection from their parent.
+                parent = qual.rsplit(".", 1)[0] if "." in qual else None
+                if parent in protected:
+                    protected.add(qual)
+                    changed = True
+                    continue
+                cs = callers.get(qual, set())
+                if cs and all(c in protected for c in cs):
+                    protected.add(qual)
+                    changed = True
+
+        for qual, fn in fns.items():
+            if qual in protected:
+                continue
+            quant_node = exch_node = None
+            for call in astutil.body_calls(fn):
+                if quant_node is None and _quantizes(call, ctx):
+                    quant_node = call
+                if exch_node is None and _exchanges(call):
+                    exch_node = call
+            if quant_node is not None and exch_node is not None:
+                # Anchor at the def line: one finding per function, and
+                # the suppression+rationale sits where reviewers read.
+                yield ctx.violation(
+                    self.rule, fn,
+                    f"{qual}: int8 quantization + raw exchange in one "
+                    "body without a custom_vjp straight-through "
+                    "gradient — autodiff through round() silently "
+                    "zeroes the cotangent (the PR 10 quantized-"
+                    "dispatch bug); wrap like collectives._int8_a2a, "
+                    "or suppress with a rationale if this path is "
+                    "never differentiated")

@@ -1,0 +1,140 @@
+"""Trace purity (rule ``trace-purity``).
+
+A function traced by ``jit`` / ``shard_map`` / ``lax.scan`` runs ONCE
+at trace time; host-side reads inside it (``time.time()``, stdlib /
+numpy ``random``, ``os.environ``) bake a single stale value into the
+compiled program — or worse, differ across ranks and desynchronize
+compiled SPMD programs (the cross-rank contract check exists because
+of exactly that class). Clocks belong OUTSIDE the trace (host-side
+stamps around the step), randomness belongs to ``jax.random`` keys,
+and env knobs must be resolved before tracing.
+
+Traced scopes are found statically: functions decorated with
+``jit``/``pjit``/``shard_map`` (incl. through ``functools.partial``),
+functions passed by name to a call of ``jit``/``pjit``/``scan``/
+``shard_map`` (or any callee whose name contains ``shard_map`` — the
+engine's ``_shard_mapped`` wrapper), and defs nested inside those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+_TRACE_WRAPPERS = {"jit", "pjit", "scan", "shard_map", "checkpoint",
+                   "remat"}
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.time_ns", "time.monotonic_ns",
+                "time.perf_counter_ns", "time.process_time",
+                "time.sleep"}
+_RANDOM_BASES = ("random", "np.random", "numpy.random")
+
+
+def _is_trace_wrapper(callee: str) -> bool:
+    last = callee.split(".")[-1]
+    return last in _TRACE_WRAPPERS or "shard_map" in last
+
+
+class TracePurityChecker(Checker):
+    rule = "trace-purity"
+    description = ("host clock / stdlib-numpy randomness / os.environ "
+                   "read inside a jitted, shard_mapped, or scanned body")
+    historical = ("class enforced since PR 5's cross-rank contract work: "
+                  "host reads inside a trace bake stale values into the "
+                  "compiled program and can desynchronize ranks")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        fns = dict(astutil.walk_functions(ctx.tree))
+
+        traced: Set[str] = set()
+        for qual, fn in fns.items():
+            for dec in astutil.decorator_names(fn):
+                if _is_trace_wrapper(dec):
+                    traced.add(qual)
+        # Functions passed by (bare) name into a trace wrapper call:
+        # jax.jit(f), lax.scan(body, ...), shard_map(f, mesh, ...),
+        # self._shard_mapped(per_rank).
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = astutil.call_name(node)
+            if callee is None or not _is_trace_wrapper(callee):
+                continue
+            for arg in [*node.args,
+                        *(kw.value for kw in node.keywords)]:
+                if isinstance(arg, ast.Name):
+                    # Resolve against any def whose qualname tail
+                    # matches (the def usually lives in an enclosing
+                    # function's scope).
+                    for qual in fns:
+                        if qual == arg.id or qual.endswith("." + arg.id):
+                            traced.add(qual)
+        # Defs nested inside traced functions are traced.
+        changed = True
+        while changed:
+            changed = False
+            for qual in fns:
+                if qual in traced:
+                    continue
+                parent = qual.rsplit(".", 1)[0] if "." in qual else None
+                if parent in traced:
+                    traced.add(qual)
+                    changed = True
+
+        for qual in sorted(traced):
+            fn = fns[qual]
+            for call in astutil.body_calls(fn):
+                name = astutil.call_name(call)
+                if name is None:
+                    continue
+                if name in _CLOCK_CALLS:
+                    yield ctx.violation(
+                        self.rule, call,
+                        f"{qual}: {name}() inside a traced body runs "
+                        "once at trace time — move the stamp outside "
+                        "the trace (host-side) or use a traced "
+                        "counter")
+                    continue
+                base = name.rsplit(".", 1)[0] if "." in name else ""
+                if base in _RANDOM_BASES:
+                    yield ctx.violation(
+                        self.rule, call,
+                        f"{qual}: {name}() inside a traced body is "
+                        "trace-constant and rank-divergent — use "
+                        "jax.random with an explicit key")
+                    continue
+                if name in ("os.getenv", "getenv") or \
+                        (name.endswith("environ.get")
+                         and name.split(".")[0] in ("os", "environ")):
+                    yield ctx.violation(
+                        self.rule, call,
+                        f"{qual}: env read inside a traced body bakes "
+                        "a stale value into the compiled program — "
+                        "resolve knobs before tracing")
+            # Bare os.environ attribute touch (subscript/membership).
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "environ" \
+                        and astutil.dotted_name(node) == "os.environ" \
+                        and not self._inside_nested_def(fn, node):
+                    yield ctx.violation(
+                        self.rule, node,
+                        f"{qual}: os.environ inside a traced body — "
+                        "resolve knobs before tracing")
+
+    @staticmethod
+    def _inside_nested_def(fn: ast.AST, target: ast.AST) -> bool:
+        """True when ``target`` sits inside a def nested under ``fn``
+        (nested defs are visited as their own traced scopes)."""
+        for child in ast.iter_child_nodes(fn):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                if any(n is target for n in ast.walk(child)):
+                    return True
+            elif any(n is target for n in ast.walk(child)):
+                return TracePurityChecker._inside_nested_def(child,
+                                                             target)
+        return False

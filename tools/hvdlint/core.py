@@ -1,0 +1,311 @@
+"""hvdlint framework: file contexts, suppression, checker registry, runner.
+
+The framework is deliberately boring: parse each target file once into
+an :class:`ast.Module`, hand every registered checker a
+:class:`FileContext` (source, tree, per-line suppressions, resolved
+module-level string constants), collect :class:`Violation` records,
+subtract suppressed ones, and render human or JSON output with a
+stable exit-code contract (0 clean, 1 violations, 2 usage/internal
+error). Checkers that need a cross-file view (lock-order, knob-doc)
+get every context at once through :meth:`Checker.finalize`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ``# hvdlint: disable=rule-a,rule-b -- why this is safe here``
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable=([a-z0-9_,\- ]+?)\s*(?:--\s*(.*?)\s*)?$")
+# File-wide form, anywhere in the file (conventionally the docstring
+# epilogue): ``# hvdlint: disable-file=rule -- rationale``.
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*hvdlint:\s*disable-file=([a-z0-9_,\- ]+?)\s*(?:--\s*(.*?)\s*)?$")
+
+EXIT_CLEAN = 0
+EXIT_VIOLATIONS = 1
+EXIT_ERROR = 2
+
+# Fixture trees hold deliberately-violating files; the runner never
+# lints them (tests feed them to checkers directly).
+SKIP_DIR_NAMES = {"__pycache__", "fixtures", ".git"}
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    rationale: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}]{tag} {self.message}")
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Knobs shared by every checker: where the repo root is (for
+    docs cross-references) and which rules are selected."""
+
+    repo_root: pathlib.Path
+    rules: Optional[Set[str]] = None    # None = all
+
+    def wants(self, rule: str) -> bool:
+        return self.rules is None or rule in self.rules
+
+
+class _Suppressions:
+    """Per-line + file-wide suppression table for one file.
+
+    A same-line comment suppresses its own line; a comment alone on a
+    line suppresses the NEXT line (for statements too long to share a
+    line with their rationale)."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Dict[str, str]] = {}
+        self.file_wide: Dict[str, str] = {}
+        # (line, rule) pairs with an empty rationale — the framework
+        # turns these into ``bare-suppression`` violations.
+        self.bare: List[Tuple[int, str]] = []
+        lines = source.splitlines()
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                rationale = (m.group(2) or "").strip()
+                for rule in self._split(m.group(1)):
+                    self.file_wide[rule] = rationale
+                    if not rationale:
+                        self.bare.append((i, rule))
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rationale = (m.group(2) or "").strip()
+            rules = self._split(m.group(1))
+            target = i
+            if text.strip().startswith("#"):
+                # Standalone comment guards the next CODE line — the
+                # rationale may continue over further comment lines.
+                target = i + 1
+                while target <= len(lines):
+                    stripped = lines[target - 1].strip()
+                    if stripped and not stripped.startswith("#"):
+                        break
+                    target += 1
+            entry = self.by_line.setdefault(target, {})
+            for rule in rules:
+                entry[rule] = rationale
+                if not rationale:
+                    self.bare.append((i, rule))
+
+    @staticmethod
+    def _split(raw: str) -> List[str]:
+        return [r.strip() for r in raw.replace(" ", ",").split(",")
+                if r.strip()]
+
+    def lookup(self, rule: str, line: int) -> Optional[str]:
+        """Rationale when (rule, line) is suppressed, else None."""
+        if rule in self.file_wide:
+            return self.file_wide[rule]
+        entry = self.by_line.get(line)
+        if entry is not None and rule in entry:
+            return entry[rule]
+        return None
+
+
+class FileContext:
+    """One parsed target file plus the lookups checkers keep needing."""
+
+    def __init__(self, path: pathlib.Path, repo_root: pathlib.Path,
+                 source: str, tree: ast.Module):
+        self.path = path
+        self.repo_root = repo_root
+        try:
+            self.rel = path.resolve().relative_to(
+                repo_root.resolve()).as_posix()
+        except ValueError:          # outside the repo (fixture tests)
+            self.rel = path.as_posix()
+        self.source = source
+        self.tree = tree
+        self.suppressions = _Suppressions(source)
+        self._constants: Optional[Dict[str, str]] = None
+
+    @property
+    def module_constants(self) -> Dict[str, str]:
+        """Module-level ``NAME = "string literal"`` assignments —
+        resolving these keeps ``os.environ.get(ENV_FOO)`` visible to
+        the env-knob rule (a constant is not an escape hatch)."""
+        if self._constants is None:
+            consts: Dict[str, str] = {}
+            for node in self.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            consts[tgt.id] = node.value.value
+            self._constants = consts
+        return self._constants
+
+    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        rationale = self.suppressions.lookup(rule, line)
+        return Violation(rule=rule, path=self.rel, line=line, col=col,
+                         message=message,
+                         suppressed=rationale is not None,
+                         rationale=rationale or "")
+
+
+class Checker:
+    """Base checker. Subclasses set ``rule`` (the suppression id),
+    ``description`` and ``historical`` (the PR/bug class the rule
+    codifies — rendered into docs/lint.md's table and --list-rules).
+    Per-file logic goes in :meth:`check_file`; cross-file logic in
+    :meth:`finalize` (called once with every context)."""
+
+    rule: str = ""
+    description: str = ""
+    historical: str = ""
+    # Extra rule ids this checker may emit besides ``rule``.
+    extra_rules: Tuple[str, ...] = ()
+
+    def __init__(self, config: LintConfig):
+        self.config = config
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self,
+                 contexts: Sequence[FileContext]) -> Iterable[Violation]:
+        return ()
+
+
+def _checker_classes() -> List[type]:
+    from . import checkers
+
+    return list(checkers.CHECKERS)
+
+
+def all_rules() -> List[Tuple[str, str, str]]:
+    """(rule id, description, historical anchor) for every rule,
+    including the framework's own ``bare-suppression``."""
+    rows = []
+    for cls in _checker_classes():
+        rows.append((cls.rule, cls.description, cls.historical))
+        for extra in cls.extra_rules:
+            doc = getattr(cls, "extra_rule_docs", {}).get(extra, ("", ""))
+            rows.append((extra, doc[0], doc[1]))
+    rows.append(("bare-suppression",
+                 "a `# hvdlint: disable=` comment with no `-- rationale`",
+                 "framework contract: every suppression explains itself"))
+    return rows
+
+
+def iter_target_files(paths: Sequence[str],
+                      repo_root: pathlib.Path) -> List[pathlib.Path]:
+    """Expand CLI path arguments into the .py file list, skipping
+    fixture/__pycache__ trees. Missing paths raise ValueError (a typo'd
+    target must not silently lint nothing)."""
+    out: List[pathlib.Path] = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if not p.is_absolute():
+            p = repo_root / p
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if any(part in SKIP_DIR_NAMES for part in f.parts):
+                    continue
+                out.append(f)
+        elif p.is_file():
+            out.append(p)
+        else:
+            raise ValueError(f"no such lint target: {raw}")
+    # De-dup while preserving order (a file passed twice lints once).
+    seen: Set[pathlib.Path] = set()
+    uniq = []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def build_context(path: pathlib.Path,
+                  repo_root: pathlib.Path) -> Tuple[Optional[FileContext],
+                                                    Optional[Violation]]:
+    """Parse one file; a syntax error becomes a ``parse-error``
+    violation instead of killing the run (one broken file must not
+    hide every other file's findings)."""
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        try:
+            rel = path.resolve().relative_to(repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        line = getattr(e, "lineno", 1) or 1
+        return None, Violation(rule="parse-error", path=rel,
+                               line=line, col=0,
+                               message=f"cannot lint: {e}")
+    return FileContext(path, repo_root, source, tree), None
+
+
+def run_paths(paths: Sequence[str], repo_root: pathlib.Path,
+              rules: Optional[Set[str]] = None) -> List[Violation]:
+    """Lint the given paths; returns EVERY violation including
+    suppressed ones (callers filter on ``.suppressed`` — the JSON
+    output keeps both so a dashboard can track suppression debt)."""
+    config = LintConfig(repo_root=repo_root,
+                        rules=set(rules) if rules else None)
+    files = iter_target_files(paths, repo_root)
+    contexts: List[FileContext] = []
+    violations: List[Violation] = []
+    for f in files:
+        ctx, err = build_context(f, repo_root)
+        if err is not None:
+            violations.append(err)
+        if ctx is not None:
+            contexts.append(ctx)
+
+    checkers = [cls(config) for cls in _checker_classes()]
+    for ctx in contexts:
+        for checker in checkers:
+            wanted = [checker.rule, *checker.extra_rules]
+            if not any(config.wants(r) for r in wanted):
+                continue
+            violations.extend(checker.check_file(ctx))
+    for checker in checkers:
+        wanted = [checker.rule, *checker.extra_rules]
+        if not any(config.wants(r) for r in wanted):
+            continue
+        violations.extend(checker.finalize(contexts))
+
+    # Framework rule: a suppression comment with no rationale. Only
+    # counted for rules that actually ran (a disable for a deselected
+    # rule still needs its why).
+    if rules is None or "bare-suppression" in rules:
+        for ctx in contexts:
+            for line, rule in ctx.suppressions.bare:
+                violations.append(Violation(
+                    rule="bare-suppression", path=ctx.rel, line=line,
+                    col=0,
+                    message=(f"suppression of [{rule}] carries no "
+                             "rationale; write `# hvdlint: "
+                             f"disable={rule} -- <why this is safe>`")))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations

@@ -1,0 +1,36 @@
+"""Violating fixture for rule ``env-knob``: the registry-bypassing
+reads PR 15 found ~50 of across the tree — literal, constant-laundered,
+prefix-concatenated, subscript, and membership forms."""
+
+import os
+
+ENV_SECRET = "HVD_TPU_FIXTURE_SECRET"       # constant laundering
+
+
+def literal_read():
+    return os.environ.get("HVD_TPU_FIXTURE_KNOB", "1")
+
+
+def getenv_read():
+    return os.getenv("HVD_TPU_FIXTURE_KNOB")
+
+
+def constant_read():
+    return os.environ.get(ENV_SECRET)
+
+
+def prefixed_read(field: str):
+    return os.environ.get("HVD_TPU_FIXTURE_" + field.upper())
+
+
+def subscript_read():
+    return os.environ["HVD_TPU_FIXTURE_KNOB"]
+
+
+def membership_read():
+    return "HVD_TPU_FIXTURE_KNOB" in os.environ
+
+
+def legal_write():
+    # Env WRITES are launcher exports — never flagged.
+    os.environ["HVD_TPU_FIXTURE_KNOB"] = "1"

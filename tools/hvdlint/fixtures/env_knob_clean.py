@@ -1,0 +1,23 @@
+"""Clean fixture for rule ``env-knob``: every knob read goes through
+the config registry; env writes and non-HVD keys stay untouched."""
+
+import os
+
+from horovod_tpu.common.config import runtime_env
+
+
+def registry_read():
+    return runtime_env("PROC_ID", "0")
+
+
+def required_read():
+    return runtime_env("RENDEZVOUS", required=True)
+
+
+def non_hvd_read():
+    # Foreign namespaces are out of scope for the rule.
+    return os.environ.get("JAX_PLATFORMS", "")
+
+
+def launcher_export(port: int):
+    os.environ["HVD_TPU_METRICS_PORT"] = str(port)

@@ -1,0 +1,47 @@
+"""Violating fixture for rule ``error-stamp``: exception paths
+through a submit/complete surface that never stamp their flightrec
+``error:`` outcome — the failed collective stays ``pending`` in every
+black box, and a post-``_begin`` raise outside the guarded try leaks
+the in-flight name (the next submit dies in
+DuplicateTensorNameError)."""
+
+
+class Engine:
+    def _begin(self, name, kind):
+        return f"{kind}.{name}"
+
+    def _end(self, full):
+        pass
+
+    def _fail(self, full, exc):
+        self._end(full)
+
+    def allreduce_unstamped(self, x, name=None):
+        full = self._begin(name, "allreduce")
+        try:
+            out = x + 1
+        except Exception:
+            # BAD: re-raises with no self._fail — no error: outcome.
+            raise
+        self._end(full)
+        return out
+
+    def allgather_end_without_fail(self, x, name=None):
+        full = self._begin(name, "allgather")
+        try:
+            out = x * 2
+        except Exception:
+            # BAD: releases the name with no outcome stamped.
+            self._end(full)
+            raise
+        self._end(full)
+        return out
+
+    def broadcast_leaky_raise(self, x, name=None, root=0):
+        full = self._begin(name, "broadcast")
+        if root < 0:
+            # BAD: raise after _begin outside any _fail-guarded try —
+            # the in-flight name leaks.
+            raise ValueError("bad root")
+        self._end(full)
+        return x

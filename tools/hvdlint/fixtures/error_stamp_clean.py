@@ -1,0 +1,50 @@
+"""Clean fixture for rule ``error-stamp``: every exception path after
+``_begin`` routes through ``_fail`` (which stamps the ``error:``
+outcome before the completion bookkeeping), including validation
+raises."""
+
+
+class Engine:
+    def _begin(self, name, kind):
+        return f"{kind}.{name}"
+
+    def _end(self, full):
+        pass
+
+    def _fail(self, full, exc):
+        self._end(full)
+
+    def allreduce(self, x, name=None):
+        full = self._begin(name, "allreduce")
+        try:
+            out = x + 1
+        except Exception as e:
+            self._fail(full, e)
+            raise
+        self._end(full)
+        return out
+
+    def broadcast(self, x, name=None, root=0):
+        full = self._begin(name, "broadcast")
+        try:
+            if root < 0:
+                raise ValueError("bad root")
+            out = x
+        except Exception as e:
+            self._fail(full, e)
+            raise
+        self._end(full)
+        return out
+
+    def validate_before_begin(self, x, name=None):
+        # Raises BEFORE _begin never leak a name — legal.
+        if x is None:
+            raise ValueError("no payload")
+        full = self._begin(name, "allgather")
+        try:
+            out = [x]
+        except Exception as e:
+            self._fail(full, e)
+            raise
+        self._end(full)
+        return out

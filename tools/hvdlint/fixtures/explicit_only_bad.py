@@ -1,0 +1,59 @@
+"""Violating fixture for rule ``explicit-only``: the env-default
+consultations the PR 7/8/13 reviews banned — an env knob changing a
+call site's return arity (accum_steps), state layout (route), or
+reduction axes (parallel)."""
+
+
+def _resolve_accum_steps(explicit=None):
+    return 1 if explicit is None else int(explicit)
+
+
+def _resolve_route(route):
+    return route
+
+
+def _env(name, default=None):
+    return default
+
+
+def spec_from_env():
+    return None
+
+
+def DistributedGradFn(grad_fn, accum_steps=None):
+    # BAD: the env default re-interprets the first argument as a LOSS
+    # function at existing call sites.
+    k = _resolve_accum_steps(accum_steps)
+    return grad_fn, k
+
+
+def ShardedOptimizer(tx, route=None):
+    # BAD: an env route reshapes the shard grid built outside any trace.
+    route = _resolve_route(route)
+    return tx, route
+
+
+def sharded_init(tx, params, route=None):
+    # BAD: the raw env read form.
+    if route is None:
+        route = _env("ROUTE")
+    return tx, params, route
+
+
+def DistributedOptimizer(tx, parallel=None):
+    # BAD: env-resolved spec renames the reduction axes.
+    if parallel is None:
+        parallel = spec_from_env()
+    return tx, parallel
+
+
+class _Ctx:
+    class config:
+        route = "staged"
+
+
+def sharded_update(tx, grads, state, route=None, ctx=_Ctx()):
+    # BAD: the Config-field fallback form on a sharded surface.
+    if route is None:
+        route = ctx.config.route
+    return tx, grads, state, route

@@ -1,0 +1,25 @@
+"""Clean fixture for rule ``explicit-only``: flagged surfaces take
+their knobs explicitly; env defaults stay legal on the surfaces whose
+contracts they cannot break."""
+
+
+def _resolve_route(route):
+    return route
+
+
+def DistributedGradFn(grad_fn, accum_steps=None, route=None):
+    # accum_steps is EXPLICIT-ONLY here…
+    k = int(accum_steps) if accum_steps is not None else 1
+    # …but route= is env-defaulted on THIS surface (it only changes
+    # scheduling, never the call contract) — allowed.
+    route = _resolve_route(route)
+    return grad_fn, k, route
+
+
+def ShardedOptimizer(tx, route=None):
+    # Explicit value used as passed; no default consult.
+    return tx, route
+
+
+def DistributedOptimizer(tx, parallel=None):
+    return tx, parallel

@@ -1,0 +1,27 @@
+"""knob-doc violating fixture: declared knobs with no doc row."""
+
+import os
+
+
+def _env(name, default=None):
+    return os.environ.get("HVD_TPU_" + name, default)
+
+
+def _env_int(name, default):
+    val = _env(name)
+    return int(val) if val is not None else default
+
+
+RUNTIME_KNOBS = {
+    "DOCUMENTED_RUNTIME": "has its row",
+    "GHOST_RUNTIME": "declared, never documented",
+}
+
+
+class Config:
+    @classmethod
+    def from_env(cls):
+        c = cls()
+        c.documented = _env("DOCUMENTED_KNOB")
+        c.ghost = _env_int("GHOST_KNOB", 0)   # never documented
+        return c

@@ -1,0 +1,20 @@
+"""knob-doc clean fixture: every declared knob has its doc row."""
+
+import os
+
+
+def _env(name, default=None):
+    return os.environ.get("HVD_TPU_" + name, default)
+
+
+RUNTIME_KNOBS = {
+    "DOCUMENTED_RUNTIME": "has its row",
+}
+
+
+class Config:
+    @classmethod
+    def from_env(cls):
+        c = cls()
+        c.documented = _env("DOCUMENTED_KNOB")
+        return c

@@ -1,0 +1,31 @@
+"""Violating fixture for rule ``lock-order``: two components taking
+the same two locks in opposite orders — the PR 9 deadlock class. One
+order is lexical nesting; the other crosses a function call the
+checker resolves conservatively."""
+
+import threading
+
+_dump_lock = threading.Lock()
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.values = {}
+
+    def snapshot_under_dump(self):
+        # Edge: module._dump_lock -> Registry._lock (lexical nesting).
+        with _dump_lock:
+            with self._lock:
+                return dict(self.values)
+
+    def flush_everything(self):
+        # Reverse edge: Registry._lock -> module._dump_lock via the
+        # uniquely-named helper — closes the cycle.
+        with self._lock:
+            _write_dump(self.values)
+
+
+def _write_dump(values):
+    with _dump_lock:
+        return len(values)

@@ -1,0 +1,31 @@
+"""Clean fixture for rule ``lock-order``: the telemetry design rule —
+hold a lock for dict writes only, release BEFORE crossing into
+another subsystem. All edges point one way; no cycle."""
+
+import threading
+
+_dump_lock = threading.Lock()
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.values = {}
+
+    def snapshot(self):
+        # Copy under the lock…
+        with self._lock:
+            items = dict(self.values)
+        # …then do the slow work outside it.
+        return _write_dump(items)
+
+    def snapshot_under_dump(self):
+        # One consistent order everywhere: dump -> registry.
+        with _dump_lock:
+            with self._lock:
+                return dict(self.values)
+
+
+def _write_dump(values):
+    with _dump_lock:
+        return len(values)

@@ -1,0 +1,17 @@
+"""Violating fixture for rule ``metric-name``: an unprefixed
+registration and an hvd_tpu_-prefixed one that has no row in
+docs/metrics.md."""
+
+from horovod_tpu.common import metrics as metrics_lib
+
+# BAD: no hvd_tpu_ prefix — invisible on a pod-wide scrape.
+_M_BAD_PREFIX = metrics_lib.counter(
+    "fixture_requests_total", "requests")
+
+# BAD: prefixed but undocumented in docs/metrics.md.
+_M_UNDOCUMENTED = metrics_lib.gauge(
+    "hvd_tpu_fixture_undocumented_gauge_zz", "never documented")
+
+ENV_NAME = "hvd_tpu_fixture_constant_zz"
+# BAD: constant-laundered undocumented name.
+_M_CONST = metrics_lib.histogram(ENV_NAME, "via constant")

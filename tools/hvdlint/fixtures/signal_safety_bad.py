@@ -1,0 +1,42 @@
+"""Violating fixture for rules ``signal-safety`` + ``atexit-order``:
+the PR 9 in-handler dump pattern. The handler runs on the main thread
+between bytecodes — possibly INSIDE a ``with lock:`` block of the
+very recorder/registry it calls into; acquiring from the handler
+deadlocks against the suspended holder underneath it."""
+
+import atexit
+import signal
+import threading
+
+_lock = threading.Lock()
+_events = []
+
+
+def _dump_all():
+    with _lock:
+        return list(_events)
+
+
+class _Recorder:
+    def dump(self, trigger):
+        with _lock:
+            _events.append(trigger)
+
+
+_recorder = _Recorder()
+
+
+def on_sigusr2(signum, frame):
+    # BAD (the PR 9 deadlock): lock-taking dump + blocking I/O directly
+    # in the handler.
+    _recorder.dump("sigusr2")
+    with _lock:
+        _events.append("handled")
+    with open("/tmp/blackbox.json", "w") as f:
+        f.write("{}")
+
+
+signal.signal(signal.SIGUSR2, on_sigusr2)
+
+# BAD (atexit-order): bypasses common/shutdown.py's ordered sequence.
+atexit.register(_dump_all)

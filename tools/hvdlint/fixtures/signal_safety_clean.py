@@ -1,0 +1,34 @@
+"""Clean fixture for rules ``signal-safety`` + ``atexit-order``: the
+handler only hands work to a short-lived thread (the
+``flightrec._on_sigusr2`` pattern) or sets a flag; teardown goes
+through the ordered shutdown sequence."""
+
+import signal
+import threading
+
+from horovod_tpu.common import shutdown as shutdown_lib
+
+_requested = threading.Event()
+
+
+def _threaded_dump():
+    # Runs on its own thread: free to take locks and do I/O — it just
+    # waits the nanoseconds until the interrupted holder resumes.
+    _requested.set()
+
+
+def on_sigusr2(signum, frame):
+    threading.Thread(target=_threaded_dump, daemon=True,
+                     name="fixture-dump").start()
+
+
+def on_sigterm(signum, frame):
+    # Flag-latch form: also legal.
+    _requested.set()
+
+
+signal.signal(signal.SIGUSR2, on_sigusr2)
+signal.signal(signal.SIGTERM, on_sigterm)
+
+# Teardown through the ONE ordered sequence.
+shutdown_lib.register("fixture", _threaded_dump, priority=40)

@@ -1,0 +1,33 @@
+"""Violating fixture for rule ``ste-vjp``: a faithful reconstruction
+of the PR 10 quantized-dispatch bug — ``quantize`` + raw
+``lax.all_to_all`` inline in the differentiated MoE forward.
+``round()`` has zero gradient almost everywhere, so expert gradients
+silently came back as zeros while the loss still moved."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def quantize_int8(x):
+    s = jnp.max(jnp.abs(x)) / 127.0
+    return jnp.round(x / s).astype(jnp.int8), s
+
+
+def dequantize_int8(q, s):
+    return q.astype(jnp.float32) * s
+
+
+def quantized_dispatch(tokens, axis_name="ep"):
+    # BAD (the PR 10 bug): quantized exchange in the differentiated
+    # forward with no straight-through VJP — autodiff returns zero
+    # expert gradients.
+    q, s = quantize_int8(tokens)
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    sx = lax.ppermute(s, axis_name, [(0, 1), (1, 0)])
+    return dequantize_int8(qx, sx)
+
+
+def quantized_psum_payload(x, axis_name="hvd"):
+    # BAD: lossy psum payload — quantized values summed across ranks.
+    q = x.astype(jnp.int8)
+    return lax.psum(q, axis_name)

@@ -1,0 +1,16 @@
+"""Suppression-mechanics fixture: one rationaled suppression (counts
+as suppressed, not a violation), one bare suppression (itself a
+violation), one file-wide form exercised by the tests."""
+
+import os
+
+# Rationaled same-line suppression: suppressed, exit stays 0.
+A = os.environ.get("HVD_TPU_FIXTURE_A")  # hvdlint: disable=env-knob -- fixture demonstrating the rationale syntax
+
+# Bare suppression: the disable applies, but bare-suppression fires.
+B = os.environ.get("HVD_TPU_FIXTURE_B")  # hvdlint: disable=env-knob
+
+# Standalone comment guards the next code line.
+# hvdlint: disable=env-knob -- standalone-comment form, reaches past
+# this continuation comment line to the read below.
+C = os.environ.get("HVD_TPU_FIXTURE_C")

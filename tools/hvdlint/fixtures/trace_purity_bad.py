@@ -1,0 +1,44 @@
+"""Violating fixture for rule ``trace-purity``: host clocks, stdlib /
+numpy randomness, and env reads inside traced bodies — each one bakes
+a single trace-time value into the compiled program (and can differ
+per rank, desynchronizing SPMD programs)."""
+
+import os
+import random
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def jitted_clock(x):
+    # BAD: evaluates ONCE at trace time, frozen into the program.
+    return x * time.time()
+
+
+def scanned(xs):
+    def body(carry, x):
+        # BAD: stdlib randomness is trace-constant AND rank-divergent.
+        noise = random.random()
+        return carry + x * noise, x
+
+    return lax.scan(body, 0.0, xs)
+
+
+def shard_mapped(mesh, fn_input):
+    def per_rank(v):
+        # BAD: env read inside the traced body.
+        if os.environ.get("HVD_TPU_FIXTURE_KNOB"):
+            return v * 2
+        return v
+
+    return jax.shard_map(per_rank, mesh=mesh, in_specs=None,
+                         out_specs=None)(fn_input)
+
+
+@jax.jit
+def jitted_np_random(x):
+    # BAD: numpy randomness, same failure mode as stdlib random.
+    return x + np.random.normal()

@@ -1,0 +1,45 @@
+"""Clean fixture for rule ``trace-purity``: clocks stay host-side
+around the traced call, randomness rides ``jax.random`` keys, and
+knobs resolve before tracing."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.config import runtime_env
+
+
+@jax.jit
+def pure_step(x, key):
+    # jax.random with an explicit key: reproducible, per-trace fresh.
+    return x + jax.random.normal(key, x.shape)
+
+
+def scanned(xs, key):
+    def body(carry, inp):
+        k, x = inp
+        return carry + x * jax.random.uniform(k), x
+
+    keys = jax.random.split(key, xs.shape[0])
+    return lax.scan(body, jnp.float32(0), (keys, xs))
+
+
+def timed_step(x, key):
+    # Clocks OUTSIDE the trace: host-side stamps around the call.
+    t0 = time.perf_counter()
+    out = pure_step(x, key)
+    out.block_until_ready()
+    return out, time.perf_counter() - t0
+
+
+def configured_step(x, key):
+    # Knobs resolved BEFORE tracing, closed over as constants.
+    scale = float(runtime_env("FLIGHTREC_SIZE", "1"))
+
+    @jax.jit
+    def step(v):
+        return v * scale
+
+    return step(x), key
