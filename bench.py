@@ -370,6 +370,20 @@ def main():
     p.add_argument("--serve-seed", type=int, default=42,
                    help="traffic seed for --serve (same seed => "
                         "byte-identical event sequence)")
+    p.add_argument("--serve-arm", default="",
+                   choices=["", "tp", "disagg", "prefix", "spec"],
+                   help="serving A/B arm for --serve (docs/serve.md): "
+                        "'tp' shards each replica's decode over 2 "
+                        "devices (Megatron head grid; needs >= 2 "
+                        "devices, else falls back unsharded and says "
+                        "so), 'disagg' splits the replicas into "
+                        "prefill/decode pools with warm-KV handoffs, "
+                        "'prefix' serves shared-system-prompt traffic "
+                        "through the cross-request prefix cache, "
+                        "'spec' adds speculative decoding "
+                        "(HVD_TPU_SERVE_SPEC_K tokens/round, "
+                        "self-draft). The record carries arm= either "
+                        "way")
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -897,8 +911,9 @@ def _run_serve_benchmark(args):
         else "gpt_tiny"
     if args.smoke:
         model_name = "gpt_tiny"
-    model = {"gpt_tiny": gpt.gpt_tiny, "gpt_small": gpt.gpt_small,
-             "gpt_medium": gpt.gpt_medium}[model_name]()
+    model_fn = {"gpt_tiny": gpt.gpt_tiny, "gpt_small": gpt.gpt_small,
+                "gpt_medium": gpt.gpt_medium}[model_name]
+    model = model_fn()
 
     geometry = {"slots": args.serve_slots, "max_len": 64,
                 "max_prompt_len": 16}
@@ -909,25 +924,72 @@ def _run_serve_benchmark(args):
     geometry["max_prompt_len"] = min(geometry["max_prompt_len"],
                                      geometry["max_len"])
 
-    params = model.init(jax.random.PRNGKey(0),
-                        np.zeros((1, 4), np.int32))
-    factory = make_engine_factory(model, params, **geometry)
+    # --serve-arm (docs/serve.md): each arm flips exactly one serving
+    # lever so the A/B against the stock run isolates it.
+    arm, arm_fallback = args.serve_arm, ""
+    factory_kw, trace_kw, roles = dict(geometry), {}, None
+    prefix_cache = None
+    spec_k = 0
+    init_model = model
+    if arm == "tp":
+        if jax.device_count() >= 2:
+            from horovod_tpu.parallel.spec import ParallelSpec
+            # Params init on the dense twin (identical tree — the
+            # _DenseMaster contract); the tp model slices them in-trace
+            # under shard_map.
+            model = model_fn(tp_axis="tp")
+            factory_kw["parallel"] = ParallelSpec.resolve({"tp": 2})
+        else:
+            arm_fallback = ("tp arm needs >= 2 devices, have "
+                            f"{jax.device_count()}: running unsharded")
+            _log(f"serve: {arm_fallback}")
+    elif arm == "disagg":
+        roles = {"prefill": 1,
+                 "decode": max(1, args.serve_replicas - 1)}
+    elif arm == "prefix":
+        from horovod_tpu.serve.prefix import (PrefixCache,
+                                              prefix_cap_from_env)
+        prefix_cache = PrefixCache(prefix_cap_from_env())
+        factory_kw["prefix_cache"] = prefix_cache
+        # Shared-system-prompt traffic: every prompt opens with the
+        # same 8 tokens; the drawn lengths size the unique tails.
+        shared = min(8, geometry["max_prompt_len"] - 2)
+        trace_kw["shared_prefix_len"] = shared
+        trace_kw["prompt_lens"] = tuple(
+            n for n in (2, 4, geometry["max_prompt_len"] - shared)
+            if n >= 1)
+    elif arm == "spec":
+        from horovod_tpu.common.config import runtime_env
+        spec_k = int(runtime_env("SERVE_SPEC_K") or "4")
+
+    params = init_model.init(jax.random.PRNGKey(0),
+                             np.zeros((1, 4), np.int32))
+    if arm == "spec":
+        # Self-draft (draft = target): the acceptance-rate UPPER BOUND
+        # arm — a randomly initialized small draft would accept ~0 and
+        # measure nothing; a real deployment plugs a distilled draft
+        # into the same two kwargs.
+        factory_kw.update(draft_model=model, draft_params=params,
+                          spec_k=spec_k)
+    factory = make_engine_factory(model, params, **factory_kw)
     requests = min(args.serve_requests, 20) if args.smoke \
         else args.serve_requests
+    trace_kw.setdefault("prompt_lens",
+                        (4, 8, geometry["max_prompt_len"]))
     trace = poisson_trace(
         seed=args.serve_seed, n_requests=requests,
         rate_rps=args.serve_rate,
-        prompt_lens=(4, 8, geometry["max_prompt_len"]),
         output_lens=(4, 8, 16, 32),
-        vocab_size=model.vocab_size)
+        vocab_size=model.vocab_size, **trace_kw)
     # Policy from env (HVD_TPU_SERVE_POLICY / HVD_TPU_SERVE_*): the
     # DEFAULT policy has every grow/shrink trigger off, so the stock
     # bench measures a fixed replica set — controller activity is an
     # explicit arm.
     cluster = ServeCluster(factory, policy=SLOPolicy.from_env(),
                            replicas=args.serve_replicas, step_s=0.05,
-                           log_path="")
-    _log(f"serve: {model_name} replicas={args.serve_replicas} "
+                           log_path="", roles=roles)
+    _log(f"serve: {model_name} arm={arm or 'stock'} "
+         f"replicas={args.serve_replicas} "
          f"slots={geometry['slots']} kv={kv_kind} "
          f"requests={requests} rate={args.serve_rate}/s")
     report = cluster.run(trace)
@@ -940,15 +1002,30 @@ def _run_serve_benchmark(args):
         model, geometry["slots"], geometry["max_len"], kind=kv_kind))
     fp32_bytes = kv_lib.cache_nbytes(init_kv_cache(
         model, geometry["slots"], geometry["max_len"], kind="fp32"))
+    arm_fields = {}
+    if arm_fallback:
+        arm_fields["arm_fallback"] = arm_fallback
+    if roles is not None:
+        arm_fields["handoffs"] = report["handoffs"]
+    if prefix_cache is not None:
+        arm_fields["prefix"] = prefix_cache.stats()
+    if spec_k:
+        arm_fields["spec"] = {
+            "k": spec_k,
+            "acceptance_rate": report["spec_acceptance_rate"],
+        }
     return {
         "metric": f"{model_name}_serve_tokens_per_sec",
         "value": report["tokens_per_wall_s"],
         "unit": "tok/s",
         "workload": "serve",
+        "arm": args.serve_arm,
+        **arm_fields,
         "latency_p50_s": report["latency_p50_s"],
         "latency_p99_s": report["latency_p99_s"],
         "tokens_per_virtual_s": report["tokens_per_virtual_s"],
         "mean_occupancy": report["mean_occupancy"],
+        "prefill_tokens": report["prefill_tokens"],
         "completed": report["completed"],
         "dropped": report["dropped"],
         "deadline_misses": report["deadline_misses"],
@@ -969,9 +1046,11 @@ def _run_serve_benchmark(args):
             "rate_rps": args.serve_rate,
             "seed": args.serve_seed,
             "step_s": 0.05,
+            "arm": args.serve_arm,
         },
         "config_note": (
-            f"serve {model_name} r={args.serve_replicas} "
+            f"serve {model_name} arm={args.serve_arm or 'stock'} "
+            f"r={args.serve_replicas} "
             f"slots={geometry['slots']} kv={kv_kind} "
             f"p99={report['latency_p99_s']}s "
             f"occ={report['mean_occupancy']}"),

@@ -388,6 +388,8 @@ RUNTIME_KNOBS = {
     # Decision logs read by their subsystems at construction.
     "AUTOSCALE_LOG": "autoscale decision log (also a Config field)",
     "SERVE_LOG": "serve-controller decision log",
+    "SERVE_PREFIX_CAP": "shared-prefix KV cache entry cap (0 disables)",
+    "SERVE_SPEC_K": "speculative-decoding draft depth (0 disables)",
     # Config-field twins read PRE-INIT by tools (bench/microbench):
     # the Config field stays the init()-resolved source of truth.
     "MESH_SHAPE": "mesh factorization override (also a Config field)",

@@ -175,15 +175,20 @@ class CausalSelfAttention(nn.Module):
     # block). Params stay replicated masters sliced in-trace, so the
     # tree matches the dense path and DistributedOptimizer's tp
     # slice-grad combine (combine_slice_grads) reassembles exactly.
-    # The incremental (serve cache) path ignores the axis: serving
-    # replicas are whole-model by construction (docs/serve.md).
+    # The incremental (serve cache) path shards the SAME way: the
+    # caller hands each rank its head shard of the ring cache
+    # (heads_local on the heads axis — DecodeEngine's shard_map specs,
+    # docs/serve.md), writes/attends locally, and the row-parallel
+    # output allreduce is the block's one collective. The per-head
+    # int8 block quantization operates head-vector-wise, so shards
+    # quantize bit-identically to the unsharded cache.
     tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_ctx=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
-        if self.tp_axis and cache is None:
+        if self.tp_axis:
             from ..parallel import tensor_parallel as tp_lib
 
             ntp = jax.lax.axis_size(self.tp_axis)
@@ -201,14 +206,29 @@ class CausalSelfAttention(nn.Module):
                 y = xd @ w.astype(self.dtype) + bb.astype(self.dtype)
                 return y.reshape(b, s, heads_l, head_dim)
 
+            out_k, out_b = _DenseMaster(h, name="out")(h)
+            w_loc = tp_lib.shard_head_rows(out_k, self.num_heads,
+                                           self.tp_axis)
+            if cache is not None:
+                from ..serve import kvcache as kv_lib
+
+                idx, q_pos, k_pos = cache_ctx
+                q = rope(proj(0), q_pos)
+                k = rope(proj(1), q_pos)
+                v = proj(2)
+                cache = kv_lib.layer_write(cache, idx, k, v)
+                k_all, v_all = kv_lib.layer_read(cache, jnp.float32)
+                o = _cache_attend(q, k_all, v_all, q_pos,
+                                  k_pos).reshape(b, s,
+                                                 heads_l * head_dim)
+                return tp_lib.row_parallel(
+                    o, w_loc.astype(self.dtype), self.tp_axis,
+                    out_b.astype(self.dtype)), cache
             q = rope(proj(0), positions)
             k = rope(proj(1), positions)
             v = proj(2)
             attend = self.attend_fn or _causal_attend
             o = attend(q, k, v).reshape(b, s, heads_l * head_dim)
-            out_k, out_b = _DenseMaster(h, name="out")(h)
-            w_loc = tp_lib.shard_head_rows(out_k, self.num_heads,
-                                           self.tp_axis)
             return tp_lib.row_parallel(o, w_loc.astype(self.dtype),
                                        self.tp_axis,
                                        out_b.astype(self.dtype))

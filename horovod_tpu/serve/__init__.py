@@ -21,7 +21,9 @@ Public surface (all lazily imported; ``import horovod_tpu as hvd`` then
 * ``TrafficTrace``, ``poisson_trace`` — seeded open-loop load.
 * ``DecodeEngine``, ``ContinuousBatcher`` — one replica's decode loop.
 * ``SLOPolicy``, ``ServeController``, ``ServeCluster`` — the
-  multi-replica control plane.
+  multi-replica control plane (``roles=`` switches on prefill/decode
+  disaggregation).
+* ``PrefixCache`` — cross-request shared-prefix KV reuse (``prefix``).
 * ``kvcache`` — the cache pytree ops (init/export/import, int8).
 * ``init_kv_cache`` — re-exported model-geometry cache constructor.
 """
@@ -38,11 +40,12 @@ _LAZY = {
     "SLOPolicy": ("controller", "SLOPolicy"),
     "ServeController": ("controller", "ServeController"),
     "ServeCluster": ("controller", "ServeCluster"),
+    "PrefixCache": ("prefix", "PrefixCache"),
     "init_kv_cache": ("..models.gpt", "init_kv_cache"),
 }
 
 _LAZY_MODULES = ("kvcache", "queue", "batcher", "engine", "controller",
-                 "traffic")
+                 "traffic", "prefix")
 
 __all__ = sorted(list(_LAZY) + list(_LAZY_MODULES))
 

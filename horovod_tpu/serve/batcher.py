@@ -48,18 +48,36 @@ _M_MIGRATIONS = metrics_lib.counter(
     "default graceful-drain path (docs/serve.md)")
 
 
+ROLES = ("mixed", "prefill", "decode")
+
+
 class ContinuousBatcher:
     """One replica's admission + decode + retire loop over a
-    :class:`DecodeEngine` and its :class:`RequestQueue`."""
+    :class:`DecodeEngine` and its :class:`RequestQueue`.
+
+    ``role`` splits the loop for prefill/decode disaggregation
+    (docs/serve.md): a ``"prefill"`` replica admits + prefills, then
+    immediately exports each finished slot (warm-KV wire blob) into
+    its ``outbox`` for the cluster to hand to the decode pool — its
+    slots free every round, so prefill throughput is slots/round. A
+    ``"decode"`` replica never admits from its queue; sequences arrive
+    only via ``admit_migrated``. ``"mixed"`` (the default) is the
+    classic combined loop."""
 
     def __init__(self, engine: DecodeEngine,
-                 queue: Optional[RequestQueue] = None):
+                 queue: Optional[RequestQueue] = None,
+                 role: str = "mixed"):
+        if role not in ROLES:
+            raise ValueError(
+                f"unknown batcher role {role!r}; known: {ROLES}")
         self.engine = engine
         self.queue = queue if queue is not None else RequestQueue()
         self.name = engine.name
+        self.role = role
         self.draining = False
         self.completed: List[Request] = []
         self.events: List[Tuple] = []
+        self.outbox: List[Tuple] = []
         self.steps = 0
         self._occ_sum = 0.0
         self._occ_n = 0
@@ -133,7 +151,7 @@ class ContinuousBatcher:
         """One admit/decode/retire round; returns the requests that
         completed this round."""
         finished: List[Request] = []
-        if not self.draining:
+        if not self.draining and self.role != "decode":
             for req in self.queue.take(len(self.engine.free_slots()),
                                        now):
                 slot = self.engine.admit(req, now)
@@ -141,11 +159,20 @@ class ContinuousBatcher:
                 if self.engine.request_done(slot):
                     # 1-token/instant-EOS request: complete at prefill.
                     finished.append(self.engine.retire(slot, now))
+                elif self.role == "prefill":
+                    # Disaggregation: the freshly prefilled slot leaves
+                    # NOW as a warm-KV wire blob; the cluster hands it
+                    # to the decode pool this same round.
+                    handoff = self.engine.migrate_out(slot)
+                    self.outbox.append(handoff)
+                    self.events.append((self.steps, "handoff_out",
+                                        handoff[0].rid))
         occ = self.engine.active_count() / max(1, self.engine.slots)
         self._occ_sum += occ
         self._occ_n += 1
         _M_OCCUPANCY.labels(replica=self.name).set(occ)
-        finished.extend(self.engine.step(now))
+        if self.role != "prefill":
+            finished.extend(self.engine.step(now))
         for req in finished:
             self.events.append((self.steps, "finish", req.rid,
                                 len(req.tokens)))

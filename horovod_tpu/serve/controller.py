@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.autoscale import Decision
+from ..common import metrics as metrics_lib
 from .batcher import ContinuousBatcher
 from .engine import DecodeEngine
 from .queue import Request
@@ -47,6 +48,12 @@ from .traffic import TrafficTrace
 from ..common.config import runtime_env
 
 logger = logging.getLogger("horovod_tpu")
+
+_M_HANDOFFS = metrics_lib.counter(
+    "hvd_tpu_serve_handoffs_total",
+    "prefilled sequences handed from the prefill pool to the decode "
+    "pool over the warm-KV int8 wire (disaggregated serving, "
+    "docs/serve.md)")
 
 ENV_POLICY = "HVD_TPU_SERVE_POLICY"   # policy file path or inline JSON
 ENV_LOG = "HVD_TPU_SERVE_LOG"         # decision log (JSONL)
@@ -90,6 +97,11 @@ class SLOPolicy:
     # no free peer slot fall back to a re-prefill re-route — never
     # dropped.
     drain_mode: str = "migrate"
+    # Disaggregated pools only (docs/serve.md): grow the DECODE pool
+    # when prefilled sequences waiting for a decode slot exceed this
+    # (0 = off). Queue-depth pressure grows the PREFILL pool; this is
+    # the matching back-pressure signal for the other pool.
+    max_handoff_depth: int = 0
 
     @classmethod
     def field_names(cls) -> Tuple[str, ...]:
@@ -143,6 +155,10 @@ class SLOPolicy:
             raise ValueError(
                 "serve policy: field 'max_queue_depth' must be >= 0 "
                 f"(0 disables), got {self.max_queue_depth}")
+        if self.max_handoff_depth < 0:
+            raise ValueError(
+                "serve policy: field 'max_handoff_depth' must be >= 0 "
+                f"(0 disables), got {self.max_handoff_depth}")
         if self.low_occupancy > 1.0:
             raise ValueError(
                 "serve policy: field 'low_occupancy' is a fraction in "
@@ -264,23 +280,41 @@ class ServeController:
     def tick(self, now: float, live: int, draining: int,
              queue_depth: int, occupancy: float,
              below_min: bool,
-             shrink_candidate: Optional[str] = None) -> Decision:
+             shrink_candidate: Optional[str] = None,
+             handoff_depth: int = 0,
+             restore_role: Optional[str] = None,
+             disagg: bool = False) -> Decision:
         """One control evaluation. Returns the (single) decision; the
         cluster applies grow/drain. At most one reshape per tick —
         reshape, then re-measure, same hysteresis discipline as the
-        training engine."""
+        training engine.
+
+        Disaggregated mode (``disagg=True``, docs/serve.md): the same
+        single policy governs BOTH pools, but each signal names the
+        pool it grows — queue depth is admission pressure (grow
+        ``prefill``), p99 and handoff depth are decode pressure (grow
+        ``decode``), and a restore names the role that fell below its
+        floor (``restore_role``). Targets become ``"role:1"`` strings;
+        classic mode keeps the historical ``"1"``."""
         p = self.policy
         if now - self._last_tick_t < p.tick_interval_s \
                 and not below_min:
             return Decision(action="keep")
         self._last_tick_t = now
         active = live - draining
+
+        def _grow_target(role: str) -> str:
+            return f"{role}:1" if disagg else "1"
+
         if below_min:
             # Restore the floor immediately — a kill must not wait out
             # a cooldown while requests queue on the survivors.
             self._last_grow_t = now
+            target = (f"{restore_role}:1" if disagg and restore_role
+                      else "1")
             return self._record(Decision(
-                action="grow", target="1", reason="restore_capacity"))
+                action="grow", target=target,
+                reason="restore_capacity"))
         grow_ok = (active < p.max_replicas
                    and now - self._last_grow_t >= p.grow_cooldown_s)
         if grow_ok and p.target_p99_s > 0:
@@ -288,14 +322,23 @@ class ServeController:
             if p99 is not None and p99 > p.target_p99_s:
                 self._last_grow_t = now
                 return self._record(Decision(
-                    action="grow", target="1", reason="slo_p99"))
+                    action="grow", target=_grow_target("decode"),
+                    reason="slo_p99"))
         if grow_ok and p.max_queue_depth > 0 \
                 and queue_depth > p.max_queue_depth:
             self._last_grow_t = now
             return self._record(Decision(
-                action="grow", target="1", reason="queue_depth"))
+                action="grow", target=_grow_target("prefill"),
+                reason="queue_depth"))
+        if grow_ok and disagg and p.max_handoff_depth > 0 \
+                and handoff_depth > p.max_handoff_depth:
+            self._last_grow_t = now
+            return self._record(Decision(
+                action="grow", target="decode:1",
+                reason="handoff_depth"))
         if (p.low_occupancy > 0 and active > p.min_replicas
-                and queue_depth == 0 and occupancy < p.low_occupancy
+                and queue_depth == 0 and handoff_depth == 0
+                and occupancy < p.low_occupancy
                 and shrink_candidate is not None
                 and now - self._last_shrink_t >= p.shrink_cooldown_s):
             self._last_shrink_t = now
@@ -315,13 +358,27 @@ class ServeCluster:
     kill-restore reuse it); ``host_manager`` (optional, the elastic
     ``HostManager``) maps replicas onto hosts — a killed replica
     blacklists its host and grow requires a usable one.
+
+    ``roles`` switches on prefill/decode DISAGGREGATION
+    (docs/serve.md): ``{"prefill": 1, "decode": 2}`` starts one
+    prefill-role and two decode-role replicas instead of ``replicas``
+    mixed ones. Prefill replicas admit + prefill and export every
+    finished slot as a warm-KV wire blob; the cluster hands each blob
+    to the decode replica with the most free slots the same round
+    (``pending_handoffs`` buffers the overflow — its depth is the
+    back-pressure signal ``max_handoff_depth`` watches). The per-role
+    counts are FLOORS: a kill restores the lost role, growth lands in
+    the role each decision names, and shrink only touches the decode
+    pool above its floor. ``roles=None`` (default) is the classic
+    mixed cluster, byte-identical to before.
     """
 
     def __init__(self, engine_factory: Callable[[str], DecodeEngine],
                  policy: Optional[SLOPolicy] = None, replicas: int = 2,
                  step_s: float = 0.05, log_path: Optional[str] = None,
                  host_manager=None,
-                 host_of: Optional[Callable[[str], str]] = None):
+                 host_of: Optional[Callable[[str], str]] = None,
+                 roles: Optional[Dict[str, int]] = None):
         self.factory = engine_factory
         self.policy = policy if policy is not None \
             else SLOPolicy.from_env()
@@ -335,10 +392,39 @@ class ServeCluster:
         self.events: List[Tuple] = []
         self.completed: List[Request] = []
         self.overflow: deque = deque()
+        # Prefilled sequences awaiting a decode slot:
+        # (request, wire_blob, generated) FIFO — disaggregation only.
+        self.pending_handoffs: deque = deque()
         self.rounds = 0
         self._next_id = 0
-        for _ in range(max(1, int(replicas))):
-            self._start_replica()
+        self._handoffs_done = 0
+        # Counters from replicas that already left (kill / finished
+        # drain) so report() totals survive replica churn.
+        self._closed_prefill_tokens = 0
+        self._closed_spec_proposed = 0
+        self._closed_spec_accepted = 0
+        self.disagg = roles is not None
+        if self.disagg:
+            unknown = sorted(set(roles) - {"prefill", "decode"})
+            if unknown:
+                raise ValueError(
+                    f"serve roles: unknown role(s) {unknown}; known: "
+                    f"['decode', 'prefill']")
+            self.role_floor = {"prefill": int(roles.get("prefill", 1)),
+                               "decode": int(roles.get("decode", 1))}
+            for role, count in self.role_floor.items():
+                if count < 1:
+                    raise ValueError(
+                        f"serve roles: role {role!r} needs >= 1 "
+                        f"replica, got {count}")
+            for _ in range(self.role_floor["prefill"]):
+                self._start_replica("prefill")
+            for _ in range(self.role_floor["decode"]):
+                self._start_replica("decode")
+        else:
+            self.role_floor = {}
+            for _ in range(max(1, int(replicas))):
+                self._start_replica()
 
     # -- replica lifecycle ---------------------------------------------------
 
@@ -347,7 +433,8 @@ class ServeCluster:
     # ids are monotonic; skipped ids are simply never used).
     _GROW_SCAN = 16
 
-    def _start_replica(self) -> Optional[str]:
+    def _start_replica(self, role: Optional[str] = None
+                       ) -> Optional[str]:
         name = f"r{self._next_id}"
         consumed = 1
         if self.host_manager is not None:
@@ -368,27 +455,61 @@ class ServeCluster:
                                     "no_usable_host"))
                 return None
         self._next_id += consumed
-        self.batchers[name] = ContinuousBatcher(self.factory(name))
-        self.events.append((self.rounds, "replica_start", name))
+        b_role = role or "mixed"
+        self.batchers[name] = ContinuousBatcher(self.factory(name),
+                                                role=b_role)
+        if self.disagg:
+            self.events.append((self.rounds, "replica_start", name,
+                                b_role))
+        else:
+            self.events.append((self.rounds, "replica_start", name))
         return name
 
     def live(self) -> List[str]:
         return sorted(self.batchers)
 
     def serving(self) -> List[str]:
-        """Replicas accepting new work (live and not draining)."""
+        """Replicas accepting new ROUTED work (live, not draining, and
+        not decode-role — decode replicas receive sequences only via
+        the warm-KV handoff, never from the router)."""
         return sorted(n for n, b in self.batchers.items()
-                      if not b.draining)
+                      if not b.draining and b.role != "decode")
+
+    def pool(self, role: str) -> List[str]:
+        """Live non-draining replicas of one role (disaggregation)."""
+        return sorted(n for n, b in self.batchers.items()
+                      if not b.draining and b.role == role)
+
+    def _close_batcher(self, b: ContinuousBatcher) -> None:
+        """Fold a departing replica's monotonic counters into the
+        cluster totals (report() must survive replica churn), then
+        close it."""
+        eng = b.engine
+        self._closed_prefill_tokens += getattr(eng, "prefill_tokens", 0)
+        self._closed_spec_proposed += getattr(eng, "spec_proposed", 0)
+        self._closed_spec_accepted += getattr(eng, "spec_accepted", 0)
+        b.close()
 
     def kill_replica(self, name: str) -> None:
         """Hard replica loss (the chaos site): queued + in-flight
         requests re-route to peers, the host is blacklisted, the
-        controller logs the kill; the next tick restores capacity."""
+        controller logs the kill; the next tick restores capacity.
+        Disaggregation: blobs this replica already exported into
+        ``pending_handoffs`` stay valid (the wire blob is
+        self-contained host data) — only its queued/in-flight requests
+        re-route, and a killed prefill replica's sequences re-prefill
+        from the queue at their ORIGINAL arrival position
+        (``insert_by_arrival``) — zero dropped requests."""
         b = self.batchers.pop(name, None)
         if b is None:
             return
         rerouted = b.abort()
-        b.close()
+        if b.outbox:
+            # Blobs exported this round but not yet pumped: still
+            # valid, deliver them normally.
+            self.pending_handoffs.extend(b.outbox)
+            b.outbox = []
+        self._close_batcher(b)
         self.events.append((self.rounds, "replica_kill", name,
                             len(rerouted)))
         self.events.extend((self.rounds, "batcher", name) + e
@@ -414,12 +535,25 @@ class ServeCluster:
         """Least-loaded live non-draining replica (queued + active),
         name order breaking ties — deterministic. A bounded queue may
         refuse (``submit`` returns False); the next-least-loaded
-        replica is tried before the request overflows."""
+        replica is tried before the request overflows.
+
+        A request that already held a slot once (``reroutes`` or
+        ``migrations`` > 0 — kill, drain, or no-free-slot re-prefill
+        fallback) re-enters at its ARRIVAL position via
+        ``insert_by_arrival``: its deadline clock has been running
+        since ``arrival_t``, so it must not queue behind requests that
+        arrived after it."""
         order = sorted(self.serving(), key=lambda n: (
             len(self.batchers[n].queue)
             + self.batchers[n].engine.active_count(), n))
+        readmit = bool(req.reroutes or req.migrations)
         for name in order:
-            if self.batchers[name].queue.submit(req):
+            if readmit:
+                self.batchers[name].queue.insert_by_arrival(req)
+                ok = True
+            else:
+                ok = self.batchers[name].queue.submit(req)
+            if ok:
                 self.events.append((self.rounds, "route", req.rid,
                                     name, req.reroutes))
                 return True
@@ -431,15 +565,24 @@ class ServeCluster:
         return (sum(len(b.queue) for b in self.batchers.values())
                 + len(self.overflow))
 
-    def occupancy(self) -> float:
-        bs = list(self.batchers.values())
+    def occupancy(self, role: Optional[str] = None) -> float:
+        bs = [b for b in self.batchers.values()
+              if role is None or b.role == role]
         if not bs:
             return 0.0
         return (sum(b.engine.active_count() for b in bs)
                 / max(1, sum(b.engine.slots for b in bs)))
 
     def _shrink_candidate(self) -> Optional[str]:
-        """Deterministic drain victim: the newest serving replica."""
+        """Deterministic drain victim: the newest serving replica —
+        disaggregation shrinks only the DECODE pool (a prefill replica
+        is the admission path; its floor is load-bearing) and respects
+        the decode floor."""
+        if self.disagg:
+            decode = self.pool("decode")
+            if len(decode) <= self.role_floor["decode"]:
+                return None
+            return max(decode, key=lambda n: (int(n[1:]), n))
         serving = self.serving()
         if len(serving) <= self.policy.min_replicas:
             return None
@@ -447,7 +590,9 @@ class ServeCluster:
 
     def _apply(self, decision) -> None:
         if decision.action == "grow":
-            self._start_replica()
+            target = str(decision.target or "")
+            role = target.split(":", 1)[0] if ":" in target else None
+            self._start_replica(role)
         elif decision.action == "drain" \
                 and decision.reason == "low_occupancy" \
                 and decision.target in self.batchers:
@@ -465,9 +610,19 @@ class ServeCluster:
         slot falls back to a re-prefill re-route. Either way the
         drained replica empties NOW and leaves on the next tick."""
         moved = self.batchers[target].migrate_requests()
+        t_role = self.batchers[target].role
         for req, blob, generated in moved:
+            # A warm blob must land on a like-for-like peer: in
+            # disaggregated mode a decode replica's sequences move to
+            # other DECODE replicas (prefill slots never hold decoding
+            # sequences); classic mode uses any serving peer.
+            if self.disagg:
+                peer_names = [n for n in self.pool(t_role)
+                              if n != target]
+            else:
+                peer_names = [n for n in self.serving() if n != target]
             peers = sorted(
-                (n for n in self.serving() if n != target),
+                peer_names,
                 key=lambda n: (-self.batchers[n].migratable_slots(), n))
             placed = False
             for name in peers:
@@ -491,21 +646,62 @@ class ServeCluster:
             self.host_manager.update_available_hosts()
         live = len(self.batchers)
         draining = sum(1 for b in self.batchers.values() if b.draining)
-        below_min = (live - draining) < self.policy.min_replicas
-        decision = self.controller.tick(
-            self._now, live, draining, self.queue_depth(),
-            self.occupancy(), below_min,
-            shrink_candidate=self._shrink_candidate())
+        if self.disagg:
+            below_role = None
+            for role in ("prefill", "decode"):
+                if len(self.pool(role)) < self.role_floor[role]:
+                    below_role = role
+                    break
+            decision = self.controller.tick(
+                self._now, live, draining, self.queue_depth(),
+                self.occupancy(role="decode"),
+                below_role is not None,
+                shrink_candidate=self._shrink_candidate(),
+                handoff_depth=len(self.pending_handoffs),
+                restore_role=below_role, disagg=True)
+        else:
+            below_min = (live - draining) < self.policy.min_replicas
+            decision = self.controller.tick(
+                self._now, live, draining, self.queue_depth(),
+                self.occupancy(), below_min,
+                shrink_candidate=self._shrink_candidate())
         self._apply(decision)
         # Finished drains leave the cluster.
         for name in self.live():
             b = self.batchers[name]
             if b.draining and b.drained:
-                b.close()
+                self._close_batcher(b)
                 self.events.append((self.rounds, "drained", name))
                 self.events.extend((self.rounds, "batcher", name) + e
                                    for e in b.events)
                 self.batchers.pop(name)
+
+    # -- disaggregation: the prefill -> decode handoff wire ------------------
+
+    def _pump_handoffs(self) -> None:
+        """Deliver pending prefilled sequences to the decode pool,
+        FIFO, each to the decode replica with the most free slots (name
+        order breaking ties — deterministic). A blob with no free
+        decode slot this round WAITS in ``pending_handoffs`` — its KV
+        is already computed, so re-prefilling would waste the work; the
+        deque's depth is the controller's ``max_handoff_depth``
+        back-pressure signal."""
+        while self.pending_handoffs:
+            req, blob, generated = self.pending_handoffs[0]
+            peers = sorted(
+                self.pool("decode"),
+                key=lambda n: (-self.batchers[n].migratable_slots(), n))
+            dst = next((n for n in peers
+                        if self.batchers[n].migratable_slots() > 0),
+                       None)
+            if dst is None:
+                break
+            self.pending_handoffs.popleft()
+            self.batchers[dst].admit_migrated(req, blob, generated,
+                                              self._now)
+            _M_HANDOFFS.inc()
+            self._handoffs_done += 1
+            self.events.append((self.rounds, "handoff", req.rid, dst))
 
     # -- the run loop --------------------------------------------------------
 
@@ -528,13 +724,35 @@ class ServeCluster:
             if round_hook is not None:
                 round_hook(self, self.rounds)
             self.tick()
+            # Disaggregation runs the round in wire order: prefill
+            # replicas first (their outboxes fill), then the handoff
+            # pump, then decode replicas — a sequence prefilled this
+            # round starts decoding this same round. Classic mode is
+            # the historical single pass (every batcher is "mixed", so
+            # the decode pass matches nothing).
             for name in self.live():
-                for req in self.batchers[name].run_step(self._now):
+                b = self.batchers[name]
+                if b.role == "decode":
+                    continue
+                for req in b.run_step(self._now):
                     self.completed.append(req)
                     self.controller.observe_completion(req)
+                if b.outbox:
+                    self.pending_handoffs.extend(b.outbox)
+                    b.outbox = []
+            if self.disagg:
+                self._pump_handoffs()
+                for name in self.live():
+                    b = self.batchers[name]
+                    if b.role != "decode":
+                        continue
+                    for req in b.run_step(self._now):
+                        self.completed.append(req)
+                        self.controller.observe_completion(req)
             self.rounds += 1
             self._now += self.step_s
             if not pending and not self.queue_depth() \
+                    and not self.pending_handoffs \
                     and all(b.engine.active_count() == 0
                             for b in self.batchers.values()):
                 break
@@ -552,7 +770,27 @@ class ServeCluster:
             self.events.extend(
                 (self.rounds, "batcher", name) + e
                 for e in self.batchers[name].events)
+        prefill_tokens = self._closed_prefill_tokens + sum(
+            getattr(b.engine, "prefill_tokens", 0)
+            for b in self.batchers.values())
+        spec_proposed = self._closed_spec_proposed + sum(
+            getattr(b.engine, "spec_proposed", 0)
+            for b in self.batchers.values())
+        spec_accepted = self._closed_spec_accepted + sum(
+            getattr(b.engine, "spec_accepted", 0)
+            for b in self.batchers.values())
+        extra = {}
+        if self.disagg:
+            extra = {"handoffs": self._handoffs_done,
+                     "pending_handoffs": len(self.pending_handoffs)}
         return {
+            **extra,
+            "prefill_tokens": prefill_tokens,
+            "spec_proposed": spec_proposed,
+            "spec_accepted": spec_accepted,
+            "spec_acceptance_rate": round(
+                spec_accepted / spec_proposed, 4)
+            if spec_proposed else 0.0,
             "submitted": submitted,
             "completed": len(self.completed),
             "dropped": submitted - len(self.completed),

@@ -5,15 +5,35 @@ Exactly TWO compiled programs serve every request mix, because request
 variety is data, not shape:
 
 * ``prefill`` — (1, max_prompt_len) tokens + a length scalar: the
-  prompt's KV lines land in a fresh single-slot cache (pad lines
+  prompt's KV lines land in a single-slot cache (pad lines
   invalidated), and the first output token is the argmax at position
-  ``length - 1``. Admission scatters the slot into the batch cache
+  ``length - 1``. The cache may arrive WARM at a base position — the
+  shared-prefix fork (``serve/prefix.py``) imports a stored prefix
+  blob and prefills only the remainder; base 0 is the fresh-prompt
+  case, same program. Admission scatters the slot into the batch cache
   (``kvcache.write_slot``) — dynamic slot index, no recompile.
 * ``decode`` — one token per slot across ALL slots: (slots, 1) last
   tokens against the (slots, max_len, ...) ring cache. Finished/empty
   slots decode garbage that is never read — cheaper than a ragged
   program per occupancy pattern, and the reason sequences of any
   length mix share the step.
+
+Two optional levers extend the plane without changing its shape
+(docs/serve.md):
+
+* **tp-sharded decode** — ``parallel=`` (a ParallelSpec with a tp
+  axis) wraps both programs in ``jax.shard_map``: params replicate,
+  the KV ring shards on the HEADS axis (the same Megatron head grid
+  training uses, models/gpt.py), and the row-parallel output
+  projection is the block's one allreduce. The per-head int8 block
+  quantization operates head-vector-wise, so shards quantize
+  bit-identically to the unsharded cache.
+* **speculative decoding** — ``draft_model``/``spec_k`` add a draft
+  propose (k tokens, one scanned program) + target verify (ONE
+  batched (slots, k) incremental step) + cache rewind per round.
+  Greedy acceptance emits exactly the tokens the non-speculative
+  engine would (bit-identical by induction: a greedy token is only
+  committed when its full context matched the true rollout).
 
 Sampling is greedy argmax — deterministic, the repeat-identity
 contract. The decode step is bracketed with flight-recorder events
@@ -54,6 +74,15 @@ _M_CACHE_BYTES = metrics_lib.gauge(
     "allocated KV-cache bytes, by replica (int8 storage shows the "
     "~4x reduction over fp32 here)",
     labels=("replica",))
+_M_SPEC = metrics_lib.counter(
+    "hvd_tpu_serve_spec_tokens_total",
+    "speculative-decode draft tokens by verification outcome "
+    "(accepted / rejected) — accepted / (accepted + rejected) is the "
+    "draft acceptance rate (docs/serve.md)",
+    labels=("outcome",))
+for _o in ("accepted", "rejected"):
+    _M_SPEC.labels(outcome=_o)
+del _o
 
 
 class DecodeEngine:
@@ -63,12 +92,23 @@ class DecodeEngine:
     ``cache=`` incremental path (models/gpt.py); ``params`` its
     variables. Greedy decode; ``eos_id`` (optional) ends a sequence
     early, ``max_new_tokens`` always bounds it.
+
+    ``parallel`` (a ParallelSpec with a tp axis) runs the two programs
+    tp-sharded under ``jax.shard_map`` — the model must carry the same
+    ``tp_axis`` and the params stay the dense-compatible replicated
+    tree. ``prefix_cache`` (a shared :class:`serve.prefix.PrefixCache`)
+    turns common prompt prefixes into slot forks instead of re-prefill.
+    ``draft_model``/``draft_params``/``spec_k`` enable greedy
+    speculative decoding (draft proposes k, target verifies in one
+    batched step); the draft must share the target's vocab.
     """
 
     def __init__(self, model, params, slots: int = 4, max_len: int = 32,
                  max_prompt_len: int = 16, kv_kind: str = "fp32",
                  eos_id: Optional[int] = None, name: str = "r0",
-                 programs=None):
+                 programs=None, parallel=None, prefix_cache=None,
+                 draft_model=None, draft_params=None, spec_k: int = 0,
+                 spec_programs=None):
         if max_prompt_len > max_len:
             raise ValueError(
                 f"max_prompt_len {max_prompt_len} exceeds the cache's "
@@ -81,6 +121,25 @@ class DecodeEngine:
         self.kv_kind = kv_kind
         self.eos_id = eos_id
         self.name = name
+        self.parallel = parallel if (parallel is not None
+                                     and parallel.tp_axis) else None
+        self.prefix_cache = prefix_cache
+        self.spec_k = int(spec_k) if draft_model is not None else 0
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        if self.spec_k and self.parallel is not None:
+            raise ValueError(
+                "speculative decoding and tp-sharded decode are "
+                "separate serve levers (docs/serve.md); enable one "
+                "per engine")
+        if self.parallel is not None \
+                and getattr(model, "tp_axis", None) \
+                != self.parallel.tp_axis:
+            raise ValueError(
+                f"parallel spec shards heads over "
+                f"{self.parallel.tp_axis!r} but the model's tp_axis is "
+                f"{getattr(model, 'tp_axis', None)!r} — construct the "
+                "model with the matching axis (models/gpt.py)")
         from ..models.gpt import init_kv_cache
 
         self.cache = init_kv_cache(model, self.slots, self.max_len,
@@ -94,10 +153,36 @@ class DecodeEngine:
         self.generated: List[List[int]] = [[] for _ in range(self.slots)]
         self.last_tokens = np.zeros((self.slots,), np.int32)
         self.decode_steps = 0
+        # Prefill work actually computed (prefix reuse subtracts the
+        # forked tokens) — the serve bench's prefill-reduction A/B.
+        self.prefill_tokens = 0
         if programs is None:
-            programs = compile_programs(model)
+            programs = compile_programs(model, parallel=self.parallel,
+                                        cache_template=self._single)
         (self._prefill, self._decode, self._write_slot,
          self._reset_slot) = programs
+        if self.spec_k:
+            if getattr(draft_model, "vocab_size", None) \
+                    != model.vocab_size:
+                raise ValueError(
+                    "draft and target must share a vocab: draft "
+                    f"{getattr(draft_model, 'vocab_size', None)} vs "
+                    f"target {model.vocab_size}")
+            if spec_programs is None:
+                spec_programs = compile_spec_programs(
+                    model, draft_model, self.spec_k)
+            self._spec = spec_programs
+            # Draft cache: fp32 always — the draft is tiny, so the
+            # int8 storage saving is noise and fp32 keeps its
+            # proposals exactly reproducible across kv_kind arms.
+            self.draft_cache = init_kv_cache(
+                draft_model, self.slots, self.max_len, kind="fp32")
+            self._draft_single = init_kv_cache(
+                draft_model, 1, self.max_len, kind="fp32")
+            self.spec_rounds = 0
+            self.spec_fallback_rounds = 0
+            self.spec_proposed = 0
+            self.spec_accepted = 0
 
     # -- admission -----------------------------------------------------------
 
@@ -110,27 +195,71 @@ class DecodeEngine:
     def admit(self, req: Request, now: float = 0.0) -> int:
         """Prefill ``req`` into a free slot; returns the slot. The
         prompt is truncated to the engine's ``max_prompt_len`` window
-        (documented serving contract, docs/serve.md)."""
+        (documented serving contract, docs/serve.md). With a
+        ``prefix_cache``, a stored common prefix forks via exact slot
+        copy (import + rewind) and only the remainder prefills — the
+        prompt-token accounting counts the remainder, which is how the
+        prefix A/B shows prefill work strictly reduced."""
         free = self.free_slots()
         if not free:
             raise RuntimeError(f"replica {self.name}: no free slot")
         slot = free[0]
         prompt = list(req.prompt)[-self.max_prompt_len:]
+        base = 0
+        single_src = self._single
+        if self.prefix_cache is not None:
+            hit = self.prefix_cache.lookup(prompt)
+            # The pad lines of the remainder prefill land at positions
+            # base .. base + max_prompt_len - 1; refuse a fork that
+            # would ring-wrap them over the reused prefix lines.
+            if hit is not None \
+                    and hit[0] + self.max_prompt_len <= self.max_len:
+                base, blob = hit
+                single_src = kv_lib.rewind_slots(
+                    kv_lib.import_slot(self._single, 0, blob),
+                    jnp.full((1,), base, jnp.int32))
+                self.prefix_cache.note_hit(base)
+        remainder = prompt[base:]
         padded = np.zeros((1, self.max_prompt_len), np.int32)
-        padded[0, :len(prompt)] = prompt
+        padded[0, :len(remainder)] = remainder
         single, first = self._prefill(
             self.params, jnp.asarray(padded),
-            jnp.asarray(len(prompt), jnp.int32), self._single,
+            jnp.asarray(len(remainder), jnp.int32), single_src,
             jnp.asarray(req.temperature, jnp.float32),
             jnp.asarray(req.sample_seed & 0x7FFFFFFF, jnp.int32),
             jnp.asarray(req.rid, jnp.int32))
+        if self.prefix_cache is not None and base == 0 \
+                and len(prompt) > 1:
+            # Store fresh full prefills only: an exact (unquantized)
+            # slot copy, so a future fork decodes bit-identically to a
+            # fresh prefill. Re-inserting fork-extended caches would
+            # compound nothing useful — the common prefix is already
+            # stored.
+            self.prefix_cache.insert(
+                tuple(prompt), kv_lib.export_slot(single, 0,
+                                                  exact=True))
         self.cache = self._write_slot(self.cache, slot, single)
+        if self.spec_k:
+            # Warm the draft's ring for this slot from the FULL prompt
+            # (the draft is cheap; its cache must mirror the target's
+            # positions for proposals to line up).
+            dpad = np.zeros((1, self.max_prompt_len), np.int32)
+            dpad[0, :len(prompt)] = prompt
+            zero = jnp.zeros((), jnp.int32)
+            dsingle, _ = self._spec["draft_prefill"](
+                self.draft_params, jnp.asarray(dpad),
+                jnp.asarray(len(prompt), jnp.int32),
+                self._draft_single, jnp.zeros((), jnp.float32), zero,
+                zero)
+            self.draft_cache = self._write_slot(self.draft_cache, slot,
+                                                dsingle)
         self.requests[slot] = req
         req.replica = self.name
         tok = int(first)
         self.generated[slot] = [tok]
         self.last_tokens[slot] = tok
-        _M_TOKENS.labels(kind="prompt").inc(len(prompt))
+        self.prefill_tokens += len(remainder)
+        _M_TOKENS.labels(kind="prompt").inc(len(remainder))
         _M_TOKENS.labels(kind="generated").inc()
         _M_ACTIVE.inc()
         return slot
@@ -140,9 +269,24 @@ class DecodeEngine:
     def step(self, now: float = 0.0) -> List[Request]:
         """One decode round across every slot; retires and returns the
         requests that finished this step (their ``tokens``/``finish_t``
-        filled)."""
+        filled). With speculative decoding enabled the round emits up
+        to ``spec_k`` tokens per slot (bit-identical to the 1-token
+        rounds); rounds that cannot speculate safely fall back to the
+        plain step."""
         if self.active_count() == 0:
             return []
+        if self.spec_k:
+            if self._spec_ready():
+                return self._spec_step(now)
+            self.spec_fallback_rounds += 1
+            # Keep the draft's ring mirrored through plain rounds so
+            # later speculative rounds see the true context.
+            zeros_f = jnp.zeros((self.slots,), jnp.float32)
+            zeros_i = jnp.zeros((self.slots,), jnp.int32)
+            _, self.draft_cache, _ = self._spec["draft_decode"](
+                self.draft_params, self.draft_cache,
+                jnp.asarray(self.last_tokens), zeros_f, zeros_i,
+                zeros_i, zeros_i)
         rec = flightrec_lib.recorder()
         step_name = f"serve.decode.{self.name}"
         rec.record_submit(step_name, "serve")
@@ -194,6 +338,110 @@ class DecodeEngine:
                 finished.append(self.retire(slot, now))
         return finished
 
+    # -- speculative decoding (docs/serve.md) --------------------------------
+
+    def _spec_ready(self) -> bool:
+        """A round may speculate iff every active slot is greedy
+        (temperature 0 — the acceptance rule is exact only for argmax)
+        and no slot's k-token burst would ring-wrap: a wrapped write
+        overwrites the oldest line, and the post-verify rewind cannot
+        restore what was overwritten."""
+        pos = np.asarray(self.cache["pos"])
+        for slot, req in enumerate(self.requests):
+            if req is None:
+                continue
+            if req.temperature > 0.0:
+                return False
+            if int(pos[slot]) + self.spec_k > self.max_len:
+                return False
+        return True
+
+    def _spec_step(self, now: float) -> List[Request]:
+        """One speculative round: draft proposes ``spec_k`` tokens per
+        slot (a scanned program over its own ring), the target verifies
+        them in ONE batched (slots, k) incremental step, and the
+        longest greedily-matching prefix commits — plus the target's
+        own correction token, so every round emits at least one token
+        and at most k. Both rings then rewind to the committed
+        position (data ops only). Greedy output is bit-identical to
+        the plain step by induction: a token is committed only when
+        its entire context matched the true rollout."""
+        k = self.spec_k
+        rec = flightrec_lib.recorder()
+        step_name = f"serve.decode.{self.name}"
+        rec.record_submit(step_name, "serve")
+        pos_before = np.asarray(self.cache["pos"]).copy()
+        try:
+            last = jnp.asarray(self.last_tokens)
+            self.draft_cache, drafts = self._spec["propose"](
+                self.draft_params, self.draft_cache, last)
+            # Verify feeds [t_n, d_1 .. d_{k-1}]: position i's logits
+            # see the context up to draft i, so greedy[i] is the true
+            # next token GIVEN that context.
+            verify_in = jnp.concatenate([last[:, None],
+                                         drafts[:, :k - 1]], axis=1)
+            greedy, self.cache = self._spec["verify"](
+                self.params, self.cache, verify_in)
+            g = np.asarray(greedy)
+            d = np.asarray(drafts)
+        except BaseException:
+            rec.record_complete(step_name, outcome="error")
+            raise
+        new_pos = np.zeros((self.slots,), np.int32)
+        finished: List[Request] = []
+        done_slots: List[int] = []
+        for slot, req in enumerate(self.requests):
+            if req is None:
+                continue
+            new_pos[slot] = pos_before[slot]
+            if len(self.generated[slot]) >= req.max_new_tokens:
+                # Finishing token produced by a previous round; this
+                # round's output for the slot is discarded (same rule
+                # as the plain step).
+                done_slots.append(slot)
+                continue
+            m = 0
+            while m < k - 1 and d[slot, m] == g[slot, m]:
+                m += 1
+            self.spec_proposed += k
+            self.spec_accepted += m
+            _M_SPEC.labels(outcome="accepted").inc(m)
+            _M_SPEC.labels(outcome="rejected").inc(k - m)
+            committed = 0
+            done = False
+            for i in range(m + 1):
+                tok = int(g[slot, i])
+                self.generated[slot].append(tok)
+                self.last_tokens[slot] = tok
+                committed += 1
+                _M_TOKENS.labels(kind="generated").inc()
+                done = (len(self.generated[slot]) >= req.max_new_tokens
+                        or (self.eos_id is not None
+                            and tok == self.eos_id))
+                if done:
+                    break
+            new_pos[slot] = pos_before[slot] + committed
+            if done:
+                done_slots.append(slot)
+        npj = jnp.asarray(new_pos)
+        self.cache = self._spec["rewind"](self.cache, npj)
+        self.draft_cache = self._spec["rewind"](self.draft_cache, npj)
+        for slot in done_slots:
+            finished.append(self.retire(slot, now))
+        rec.annotate(step_name,
+                     nbytes=kv_lib.cache_nbytes(self.cache),
+                     wire=self.kv_kind)
+        rec.record_complete(step_name)
+        self.decode_steps += 1
+        self.spec_rounds += 1
+        return finished
+
+    def spec_acceptance_rate(self) -> float:
+        """Accepted draft tokens / proposed draft tokens over this
+        engine's speculative rounds (0 when none ran)."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_k and self.spec_proposed else 0.0)
+
     def request_done(self, slot: int) -> bool:
         """True when the slot's sequence already hit its stop condition
         (a 1-token request finishes at prefill; the batcher retires it
@@ -214,6 +462,8 @@ class DecodeEngine:
         self.requests[slot] = None
         self.generated[slot] = []
         self.cache = self._reset_slot(self.cache, slot)
+        if self.spec_k:
+            self.draft_cache = self._reset_slot(self.draft_cache, slot)
         _M_ACTIVE.dec()
         return req
 
@@ -234,6 +484,9 @@ class DecodeEngine:
             self.requests[slot] = None
             self.generated[slot] = []
             self.cache = self._reset_slot(self.cache, slot)
+            if self.spec_k:
+                self.draft_cache = self._reset_slot(self.draft_cache,
+                                                    slot)
             _M_ACTIVE.dec()
         return out
 
@@ -258,6 +511,8 @@ class DecodeEngine:
         self.requests[slot] = None
         self.generated[slot] = []
         self.cache = self._reset_slot(self.cache, slot)
+        if self.spec_k:
+            self.draft_cache = self._reset_slot(self.draft_cache, slot)
         _M_ACTIVE.dec()
         return req, blob, generated
 
@@ -267,7 +522,10 @@ class DecodeEngine:
         imports into the cache (``kvcache.import_slot`` — dequantized
         through the same Pallas path) and decode continues from the
         last generated token — no re-prefill. Same-geometry engines
-        only (the cluster's factory guarantees it)."""
+        only (the cluster's factory guarantees it). With speculative
+        decoding the draft ring gets no warm state (the wire carries
+        the target cache only) — proposals for the slot degrade until
+        it retires, but the verify step keeps the output exact."""
         free = self.free_slots()
         if not free:
             raise RuntimeError(f"replica {self.name}: no free slot")
@@ -312,15 +570,21 @@ def _sample_token(row, temp, seed, rid, pos):
 
 def _prefill_fn(model, params, tokens, length, single_cache, temp,
                 seed, rid):
-    """(1, P) prompt -> (single-slot cache, first output token)."""
+    """(1, P) prompt -> (single-slot cache, first output token). The
+    incoming cache's write head is the BASE: 0 for a fresh prompt, the
+    stored prefix length for a prefix fork (serve/prefix.py) — the new
+    tokens land at base..base+length-1 and the rewind math is
+    base-relative, so both cases share this one compiled program."""
+    base = single_cache["pos"]                       # (1,) int32
     logits, cache = model.apply(params, tokens, cache=single_cache)
-    # Pad lines (written at positions >= length) must never be
-    # attendable; the write head rewinds to the true prompt length.
+    # Pad lines (written at positions >= base + length) must never be
+    # attendable; the write head rewinds to the true prompt end.
+    end = base + length
     sp = cache["slot_pos"]
     cache = {
         "layers": cache["layers"],
-        "pos": jnp.full_like(cache["pos"], length),
-        "slot_pos": jnp.where(sp >= length, -1, sp),
+        "pos": jnp.broadcast_to(end, cache["pos"].shape),
+        "slot_pos": jnp.where(sp >= end[:, None], -1, sp),
     }
     first = _sample_token(logits[0, length - 1], temp, seed, rid,
                           jnp.zeros((), jnp.int32))
@@ -337,6 +601,34 @@ def _decode_fn(model, params, cache, last_tokens, temps, seeds, rids,
     nxt = jax.vmap(_sample_token)(logits[:, 0], temps, seeds, rids,
                                   poss)
     return logits, cache, nxt
+
+
+def _spec_propose_fn(model, params, cache, last_tokens, k: int):
+    """Draft proposal: scan ``k`` greedy decode steps over the draft's
+    own ring — (slots,) last tokens -> (cache, (slots, k) drafts
+    d_1..d_k). One compiled program per engine (k is static)."""
+    def body(carry, _):
+        cache, toks = carry
+        logits, cache = model.apply(params, toks[:, None], cache=cache)
+        nxt = jnp.argmax(logits[:, 0].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        return (cache, nxt), nxt
+
+    (cache, _), drafts = jax.lax.scan(body, (cache, last_tokens), None,
+                                      length=k)
+    return cache, jnp.moveaxis(drafts, 0, 1)
+
+
+def _spec_verify_fn(model, params, cache, tokens):
+    """Target verification: the (slots, k) proposed burst through the
+    SAME incremental program shape decode uses — logits at position i
+    see the context up to draft i, so ``argmax`` per position is the
+    true greedy continuation given that context. Returns
+    ((slots, k) greedy tokens, advanced cache — the caller rewinds to
+    the committed positions)."""
+    logits, cache = model.apply(params, tokens, cache=cache)
+    return (jnp.argmax(logits.astype(jnp.float32),
+                       axis=-1).astype(jnp.int32), cache)
 
 
 ENV_KV_DTYPE = "HVD_TPU_SERVE_KV_DTYPE"   # fp32 | int8 cache storage
@@ -368,26 +660,97 @@ def engine_defaults_from_env(env=None) -> Dict[str, Any]:
     return out
 
 
-def compile_programs(model):
+def compile_programs(model, parallel=None, cache_template=None):
     """The jitted serving programs for ``model``, built ONCE and shared
     by every replica: jax.jit caches on the wrapper's identity, so an
     engine building its own wrappers would re-trace + recompile per
     replica — and the kill → grow restore path would pay a full XLA
-    compile before serving its first request."""
+    compile before serving its first request.
+
+    ``parallel`` (a ParallelSpec with a tp axis) wraps prefill/decode
+    in ``jax.shard_map`` over ``parallel.mesh``: params and tokens
+    replicate; the cache's K/V and scale leaves shard on their HEADS
+    axis (rank >= 3 — k/v are (slots, lines, heads, head_dim), scales
+    (slots, lines, heads)); the bookkeeping vectors replicate. The
+    logits/next-token outputs are replicated — valid because the
+    row-parallel output projection already allreduced inside the model
+    (models/gpt.py). ``cache_template`` supplies the cache treedef the
+    specs mirror (any slot count — specs do not depend on it)."""
+    if parallel is not None and parallel.tp_axis:
+        if cache_template is None:
+            raise ValueError(
+                "tp-sharded serve programs need a cache_template to "
+                "derive the per-leaf shard specs")
+        from jax.sharding import PartitionSpec as P
+
+        tp = parallel.tp_axis
+        mesh = parallel.mesh(jax.devices()[:parallel.total])
+        cspec = jax.tree.map(
+            lambda leaf: P(None, None, tp) if leaf.ndim >= 3 else P(),
+            cache_template)
+        rep = P()
+        prefill = jax.jit(jax.shard_map(
+            functools.partial(_prefill_fn, model), mesh=mesh,
+            in_specs=(rep, rep, rep, cspec, rep, rep, rep),
+            out_specs=(cspec, rep), check_vma=False))
+        decode = jax.jit(jax.shard_map(
+            functools.partial(_decode_fn, model), mesh=mesh,
+            in_specs=(rep, cspec, rep, rep, rep, rep, rep),
+            out_specs=(rep, cspec, rep), check_vma=False))
+        # Slot scatter/reset are elementwise over the cache pytree —
+        # plain jit partitions them under the arrays' shardings.
+        return (prefill, decode, jax.jit(kv_lib.write_slot),
+                jax.jit(kv_lib.reset_slot))
     return (jax.jit(functools.partial(_prefill_fn, model)),
             jax.jit(functools.partial(_decode_fn, model)),
             jax.jit(kv_lib.write_slot),
             jax.jit(kv_lib.reset_slot))
 
 
-def make_engine_factory(model, params, **kw) -> Callable[[str],
-                                                         DecodeEngine]:
+def compile_spec_programs(model, draft_model, spec_k: int):
+    """The speculative-decoding program set, built once and shared by
+    every replica (same retrace economics as ``compile_programs``):
+    the draft's own prefill/decode pair, the k-step scanned propose,
+    the batched target verify, and the ring rewind."""
+    draft_prefill, draft_decode, _, _ = compile_programs(draft_model)
+    return {
+        "draft_prefill": draft_prefill,
+        "draft_decode": draft_decode,
+        "propose": jax.jit(functools.partial(
+            _spec_propose_fn, draft_model, k=int(spec_k))),
+        "verify": jax.jit(functools.partial(_spec_verify_fn, model)),
+        "rewind": jax.jit(kv_lib.rewind_slots),
+    }
+
+
+def make_engine_factory(model, params, parallel=None, draft_model=None,
+                        draft_params=None, spec_k: int = 0,
+                        prefix_cache=None,
+                        **kw) -> Callable[[str], DecodeEngine]:
     """Factory the replica controller uses to start replicas (grow /
     restart after a kill): same model+params+geometry+compiled
-    programs, fresh cache."""
-    programs = compile_programs(model)
+    programs, fresh cache. The serve levers thread through: every
+    replica shares one ``parallel`` spec, one ``prefix_cache``, and one
+    compiled draft/verify program set."""
+    if parallel is not None and parallel.tp_axis:
+        from ..models.gpt import init_kv_cache
+
+        template = init_kv_cache(model, 1, kw.get("max_len", 32),
+                                 kind=kw.get("kv_kind", "fp32"))
+        programs = compile_programs(model, parallel=parallel,
+                                    cache_template=template)
+    else:
+        programs = compile_programs(model)
+    spec_programs = None
+    if spec_k and draft_model is not None:
+        spec_programs = compile_spec_programs(model, draft_model,
+                                              spec_k)
 
     def factory(name: str) -> DecodeEngine:
         return DecodeEngine(model, params, name=name,
-                            programs=programs, **kw)
+                            programs=programs, parallel=parallel,
+                            prefix_cache=prefix_cache,
+                            draft_model=draft_model,
+                            draft_params=draft_params, spec_k=spec_k,
+                            spec_programs=spec_programs, **kw)
     return factory

@@ -145,6 +145,24 @@ def layer_read(layer: Dict[str, Any], dtype=jnp.float32
     return layer["k"].astype(dtype), layer["v"].astype(dtype)
 
 
+def rewind_slots(cache: Dict[str, Any], new_pos) -> Dict[str, Any]:
+    """Truncate every slot's sequence to ``new_pos`` (a (slots,) int32
+    vector of global positions) by DATA ops alone: the write head moves
+    back and every line at a global position >= its slot's new_pos is
+    invalidated. The payload stays — masked lines are never read. This
+    is how speculative decode discards rejected draft tokens and how
+    prefix reuse forks a shared prompt at its common length; callers
+    must not rewind across a ring wrap (a line overwritten since the
+    rewind point is gone — the engine's wrap guard enforces this)."""
+    new_pos = new_pos.astype(jnp.int32)
+    sp = cache["slot_pos"]
+    return {
+        "layers": cache["layers"],
+        "pos": new_pos,
+        "slot_pos": jnp.where(sp >= new_pos[:, None], -1, sp),
+    }
+
+
 def reset_slot(cache: Dict[str, Any], slot) -> Dict[str, Any]:
     """Mark one slot empty (pos = 0, every line invalid). The k/v
     payload is left in place — ``slot_pos`` = -1 already masks it out
@@ -173,19 +191,29 @@ def write_slot(cache: Dict[str, Any], slot, single: Dict[str, Any]
 # -- wire movement: the Pallas block-quantized export ------------------------
 
 def export_slot(cache: Dict[str, Any], slot: int,
-                use_pallas: Optional[bool] = None) -> Dict[str, Any]:
+                use_pallas: Optional[bool] = None,
+                exact: bool = False) -> Dict[str, Any]:
     """One slot's cache lines as an int8 block-scaled wire blob —
-    every fp32/model-dtype leaf rides ``pallas_kernels.quantize_int8``
-    (int8 leaves ship as-is); the bookkeeping vectors travel exact.
-    This is the warm-cache migration path: a draining replica can hand
-    a long in-flight sequence to a peer at ~4x fewer bytes instead of
-    re-running its whole prefill."""
+    every fp32/model-dtype K/V leaf rides
+    ``pallas_kernels.quantize_int8`` (int8 leaves ship as-is); the
+    bookkeeping vectors travel exact. The int8 kind's fp32 SCALE leaves
+    (``k_s``/``v_s``) also ship raw: re-quantizing a scale vector is
+    lossy, and shipping it exact makes an int8 -> int8 migration a
+    bit-exact round trip (tests/test_serve.py pins it). This is the
+    warm-cache migration path: a draining replica can hand a long
+    in-flight sequence to a peer at ~4x fewer bytes instead of
+    re-running its whole prefill.
+
+    ``exact=True`` ships EVERY leaf raw — the intra-host slot-copy
+    form the shared-prefix cache uses (docs/serve.md): no wire, so no
+    reason to round, and a forked prefix decodes bit-identically to a
+    fresh prefill."""
     out_layers = []
     for layer in cache["layers"]:
         packed = {}
         for name, leaf in layer.items():
             arr = leaf[slot]
-            if arr.dtype == jnp.int8:
+            if exact or arr.dtype == jnp.int8 or name.endswith("_s"):
                 packed[name] = {"raw": arr}
             else:
                 q, s, n = pk.quantize_int8(arr, use_pallas=use_pallas)

@@ -121,6 +121,25 @@ class RequestQueue:
                 self._q.appendleft(req)
             _M_QUEUE_DEPTH.inc(len(reqs))
 
+    def insert_by_arrival(self, req: Request) -> None:
+        """Re-insert a re-routed / fallback-re-prefill request at its
+        ARRIVAL position: the deadline clock runs from ``arrival_t``
+        and never restarts, so a request that already waited (and then
+        lost its slot to a kill, drain, or failed warm handoff) must
+        not also wait behind requests that arrived after it. Bypasses
+        ``maxsize`` — this is work the cluster already admitted once;
+        shedding it here would drop a request, and the drain runbook's
+        contract is zero drops (docs/serve.md)."""
+        key = (req.arrival_t, req.rid)
+        with self._lock:
+            idx = len(self._q)
+            for i, queued in enumerate(self._q):
+                if (queued.arrival_t, queued.rid) > key:
+                    idx = i
+                    break
+            self._q.insert(idx, req)
+            _M_QUEUE_DEPTH.inc()
+
     def drain(self) -> List[Request]:
         """Empty the queue for re-routing (graceful-drain step 1)."""
         with self._lock:

@@ -41,7 +41,8 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
                   output_lens: Sequence[int] = (4, 8, 16, 32),
                   vocab_size: int = 128,
                   deadline_s: float = 0.0,
-                  temperature: float = 0.0) -> TrafficTrace:
+                  temperature: float = 0.0,
+                  shared_prefix_len: int = 0) -> TrafficTrace:
     """Seeded open-loop trace: Poisson arrivals at ``rate_rps``, prompt
     and output lengths drawn uniformly from the given mixes, prompt
     tokens uniform over ``[1, vocab_size)`` (0 is reserved for pad).
@@ -50,12 +51,20 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
     temperature plus a seeded per-request ``sample_seed`` (drawn from
     this trace's own rng — the PRNG lane the engine folds with
     (rid, position)), so a sampled trace replays byte-identically under
-    the same trace seed; 0 keeps the greedy default."""
+    the same trace seed; 0 keeps the greedy default.
+    ``shared_prefix_len`` > 0 prepends ONE seeded token sequence of
+    that length to every prompt — the shared-system-prompt traffic
+    shape the prefix-reuse arm measures (docs/serve.md); the drawn
+    ``prompt_lens`` then size each request's unique tail."""
     if n_requests < 1 or rate_rps <= 0:
         raise ValueError(
             f"need n_requests >= 1 and rate_rps > 0, got "
             f"{n_requests}/{rate_rps}")
     rng = np.random.default_rng(seed)
+    shared: Tuple[int, ...] = ()
+    if shared_prefix_len > 0:
+        shared = tuple(int(t) for t in rng.integers(
+            1, vocab_size, int(shared_prefix_len)))
     gaps = rng.exponential(1.0 / rate_rps, size=n_requests)
     arrivals = np.cumsum(gaps)
     plens = rng.choice(np.asarray(prompt_lens), size=n_requests)
@@ -64,7 +73,7 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
               if temperature > 0 else np.zeros(n_requests, np.int64))
     reqs = []
     for i in range(n_requests):
-        prompt: Tuple[int, ...] = tuple(
+        prompt: Tuple[int, ...] = shared + tuple(
             int(t) for t in rng.integers(1, vocab_size, int(plens[i])))
         reqs.append(Request(
             rid=i, prompt=prompt, max_new_tokens=int(olens[i]),

@@ -72,6 +72,32 @@ def test_chaos_serve_kill_reroutes_and_logs_kill_then_grow(tmp_path):
     assert a["sequences"] == b["sequences"]
 
 
+def test_chaos_serve_disagg_prefill_kill_zero_drops(tmp_path):
+    """ISSUE 16 acceptance: a seeded ``replica_kill`` of the
+    PREFILL-role replica mid-handoff on the disaggregated cluster —
+    exported warm-KV blobs stay valid, every request completes (zero
+    drops), the restore grow NAMES the prefill role, and two runs of
+    the same seed reproduce the event + decision sequences
+    byte-for-byte."""
+    import json as json_lib
+
+    a = chaos_soak.run_serve_disagg_soak(str(tmp_path / "a"),
+                                         steps=30, seed=42)
+    assert a["dropped"] == 0 and a["completed"] == a["requests"]
+    assert a["handoffs_at_kill"] >= 1  # the kill landed mid-handoff
+    assert a["handoffs"] > a["handoffs_at_kill"]
+    decisions = [json_lib.loads(l) for l in a["decisions"]]
+    assert (decisions[0]["action"], decisions[0]["target"],
+            decisions[0]["reason"]) == ("drain", "r0", "replica_lost")
+    assert (decisions[1]["action"], decisions[1]["target"],
+            decisions[1]["reason"]) == \
+        ("grow", "prefill:1", "restore_capacity")
+    assert a["injected_sites"] == ["replica_kill"]
+    b = chaos_soak.run_serve_disagg_soak(str(tmp_path / "b"),
+                                         steps=30, seed=42)
+    assert a["sequences"] == b["sequences"]
+
+
 @pytest.mark.slow
 def test_chaos_soak_same_seed_reproduces_sequences(tmp_path):
     a = chaos_soak.run_soak(str(tmp_path / "a"), steps=12, seed=11)

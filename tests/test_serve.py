@@ -486,3 +486,412 @@ def test_temperature_survives_migration(tiny):
     while dst.active_count():
         dst.step(1.0)
     assert req.tokens == ref()
+
+
+# -- ISSUE 16: tp-sharded decode ----------------------------------------------
+
+def _tp_pair(tiny):
+    """The dense model/params plus its tp twin and 2-way spec. The
+    `_DenseMaster` contract (models/gpt.py): the tp model's param tree
+    IS the dense tree, so one checkpoint serves both."""
+    from horovod_tpu.parallel.spec import ParallelSpec
+
+    m, params = tiny
+    m_tp = gpt_tiny(tp_axis="tp")
+    spec = ParallelSpec.resolve({"tp": 2})
+    return m, params, m_tp, spec
+
+
+@pytest.mark.parametrize("kind", ["fp32", "int8"])
+def test_tp_sharded_decode_parity_vs_full_forward(tiny, kind, rng):
+    """ISSUE 16 acceptance: incremental decode with the KV cache
+    sharded on its HEADS axis over a 2-device shard_map tp grid
+    matches the unsharded full forward within the SAME documented
+    bounds as the replica test above (fp32 atol, int8 rel + identical
+    greedy argmax) — per-head int8 block scales never cross the shard
+    boundary, so sharding cannot move the quantization grid."""
+    from jax.sharding import PartitionSpec as P
+
+    m, params, m_tp, spec = _tp_pair(tiny)
+    mesh = spec.mesh(jax.devices()[:2])
+    toks = jnp.asarray(rng.integers(1, 128, (2, 12)), jnp.int32)
+    full = np.asarray(m.apply(params, toks))
+    cache = init_kv_cache(m_tp, slots=2, max_len=16, kind=kind)
+    cspec = jax.tree.map(
+        lambda leaf: P(None, None, "tp") if leaf.ndim >= 3 else P(),
+        cache)
+
+    def sharded(p, t, c):
+        f = jax.shard_map(
+            lambda tt, cc: m_tp.apply(p, tt, cache=cc),
+            mesh=mesh, in_specs=(P(), cspec),
+            out_specs=(P(), cspec), check_vma=False)
+        return f(t, c)
+
+    prefill = 5
+    apply = jax.jit(sharded)
+    lp, cache = apply(params, toks[:, :prefill], cache)
+    outs = [np.asarray(lp)]
+    for t in range(prefill, toks.shape[1]):
+        lg, cache = apply(params, toks[:, t:t + 1], cache)
+        outs.append(np.asarray(lg))
+    inc = np.concatenate(outs, axis=1)
+    if kind == "fp32":
+        np.testing.assert_allclose(inc, full, atol=FP32_ATOL)
+    else:
+        rel = np.max(np.abs(inc - full)) / np.max(np.abs(full))
+        assert rel <= INT8_REL, f"tp int8 parity {rel} > {INT8_REL}"
+        assert (inc.argmax(-1) == full.argmax(-1)).all()
+
+
+@pytest.mark.parametrize("kind", ["fp32", "int8"])
+def test_tp_engine_token_identical_to_unsharded(tiny, kind, rng):
+    """The ENGINE-level contract: a DecodeEngine built with
+    parallel=ParallelSpec(tp=2) (head-sharded cache, shard_map
+    programs) produces byte-identical greedy streams to the unsharded
+    engine from the same checkpoint, both cache formats."""
+    m, params, m_tp, spec = _tp_pair(tiny)
+    plain = make_engine_factory(m, params, slots=2, max_len=32,
+                                max_prompt_len=8, kv_kind=kind)
+    tp = make_engine_factory(m_tp, params, parallel=spec, slots=2,
+                             max_len=32, max_prompt_len=8,
+                             kv_kind=kind)
+
+    def decode(factory, name):
+        eng = factory(name)
+        reqs = [Request(rid=0, prompt=(5, 9, 3), max_new_tokens=7),
+                Request(rid=1, prompt=(2, 4), max_new_tokens=5)]
+        for r in reqs:
+            eng.admit(r)
+        while eng.active_count():
+            eng.step(0.0)
+        return [r.tokens for r in reqs]
+
+    assert decode(tp, "rtp") == decode(plain, "rpl")
+
+
+def test_tp_engine_rejects_mismatched_model_axis(tiny):
+    m, params, _, spec = _tp_pair(tiny)
+    with pytest.raises(ValueError, match="tp_axis"):
+        DecodeEngine(m, params, parallel=spec, name="rbad")
+
+
+# -- ISSUE 16: speculative decoding -------------------------------------------
+
+def test_speculative_decode_greedy_token_identity(tiny, rng):
+    """ISSUE 16 acceptance: speculative decoding (independent tiny
+    draft, k=3) produces BYTE-IDENTICAL greedy streams to the plain
+    engine — verify recomputes every committed token from exactly the
+    committed prefix, so speculation changes throughput, never text.
+    The engine's accept/propose counters move and stay consistent."""
+    m, params = tiny
+    draft = gpt_tiny()
+    draft_params = draft.init(jax.random.PRNGKey(1),
+                              np.zeros((1, 4), np.int32))
+    plain = make_engine_factory(m, params, slots=2, max_len=32,
+                                max_prompt_len=8)
+    spec = make_engine_factory(m, params, draft_model=draft,
+                               draft_params=draft_params, spec_k=3,
+                               slots=2, max_len=32, max_prompt_len=8)
+
+    def decode(factory, name):
+        eng = factory(name)
+        reqs = [Request(rid=0, prompt=(5, 9, 3), max_new_tokens=9),
+                Request(rid=1, prompt=(7,), max_new_tokens=6)]
+        for r in reqs:
+            eng.admit(r)
+        while eng.active_count():
+            eng.step(0.0)
+        return eng, [r.tokens for r in reqs]
+
+    s_eng, s_toks = decode(spec, "rsp")
+    _, p_toks = decode(plain, "rpl")
+    assert s_toks == p_toks
+    assert s_eng.spec_rounds >= 1 and s_eng.spec_proposed >= 3
+    assert 0 <= s_eng.spec_accepted <= s_eng.spec_proposed
+    assert 0.0 <= s_eng.spec_acceptance_rate() <= 1.0
+
+
+def test_speculative_self_draft_hits_the_acceptance_ceiling(tiny):
+    """draft == target proposes exactly what verify computes: every
+    COMPARED draft token accepts. Verify feeds [t_n, d_1..d_{k-1}], so
+    k-1 of the k proposals are ever compared — the acceptance ceiling
+    is (k-1)/k (the bench spec arm's self-draft upper bound) and each
+    full round commits k tokens instead of 1."""
+    m, params = tiny
+    k = 4
+    spec = make_engine_factory(m, params, draft_model=m,
+                               draft_params=params, spec_k=k,
+                               slots=1, max_len=64, max_prompt_len=8)
+    eng = spec("rsd")
+    req = Request(rid=0, prompt=(5, 9, 3), max_new_tokens=11)
+    eng.admit(req)
+    rounds = 0
+    while eng.active_count():
+        eng.step(0.0)
+        rounds += 1
+    assert len(req.tokens) == 11
+    assert eng.spec_acceptance_rate() == (k - 1) / k
+    # 1 token at prefill + rounds of k: ceil(10 / 4) = 3 rounds, not
+    # the plain engine's 10.
+    assert rounds == 3 and eng.spec_fallback_rounds == 0
+
+
+def test_speculative_temperature_falls_back_and_stays_synced(tiny):
+    """A sampling request (temperature > 0) disables speculation for
+    the round — the fallback mirrors committed tokens through the
+    draft ring, so the stream still matches the plain engine's seeded
+    sampling lane exactly."""
+    m, params = tiny
+    draft = gpt_tiny()
+    draft_params = draft.init(jax.random.PRNGKey(1),
+                              np.zeros((1, 4), np.int32))
+    plain = make_engine_factory(m, params, slots=1, max_len=32,
+                                max_prompt_len=8)
+    spec = make_engine_factory(m, params, draft_model=draft,
+                               draft_params=draft_params, spec_k=3,
+                               slots=1, max_len=32, max_prompt_len=8)
+
+    def decode(factory, name):
+        eng = factory(name)
+        req = Request(rid=4, prompt=(2, 4, 6), max_new_tokens=8,
+                      temperature=0.9, sample_seed=42)
+        eng.admit(req)
+        while eng.active_count():
+            eng.step(0.0)
+        return eng, req.tokens
+
+    s_eng, s_toks = decode(spec, "rsf")
+    _, p_toks = decode(plain, "rpf")
+    assert s_toks == p_toks
+    assert s_eng.spec_fallback_rounds >= 1 and s_eng.spec_rounds == 0
+
+
+# -- ISSUE 16: cross-request prefix reuse -------------------------------------
+
+def test_prefix_fork_exact_and_reduces_prefill(tiny):
+    """ISSUE 16 acceptance: the second request sharing a system-prompt
+    prefix forks the stored exact slot copy — prefill work strictly
+    drops (engine.prefill_tokens counts COMPUTED tokens only) and the
+    greedy stream is byte-identical to a no-cache engine (causal
+    attention: truncated KV lines equal a fresh prefix prefill)."""
+    from horovod_tpu.serve.prefix import PrefixCache
+
+    m, params = tiny
+    shared = (5, 9, 3, 7, 2, 8)
+
+    def decode(factory, name, tail):
+        eng = factory(name)
+        req = Request(rid=1, prompt=shared + tail, max_new_tokens=6)
+        eng.admit(req)
+        while eng.active_count():
+            eng.step(0.0)
+        return eng, req.tokens
+
+    pc = PrefixCache(cap=4)
+    cached = make_engine_factory(m, params, prefix_cache=pc, slots=2,
+                                 max_len=32, max_prompt_len=16)
+    plain = make_engine_factory(m, params, slots=2, max_len=32,
+                                max_prompt_len=16)
+    e1, t1 = decode(cached, "rp1", (11,))   # fresh: full prefill
+    assert e1.prefill_tokens == len(shared) + 1
+    assert pc.stats()["entries"] == 1
+    e2, t2 = decode(cached, "rp2", (13,))   # forks the shared prefix
+    _, ref = decode(plain, "rpl", (13,))
+    assert t2 == ref
+    assert e2.prefill_tokens == 1  # only the divergent tail computed
+    st = pc.stats()
+    assert st["hits"] == 1 and st["tokens_saved"] == len(shared)
+
+
+def test_prefix_cache_fifo_eviction_and_lookup_clamp():
+    from horovod_tpu.serve.prefix import PrefixCache
+
+    pc = PrefixCache(cap=2)
+    assert pc.insert((1, 2, 3), {"b": 1})
+    assert not pc.insert((1, 2, 3), {"b": 1})  # duplicate
+    assert not pc.insert((9,), {"b": 2})       # too short to fork
+    assert pc.insert((4, 5, 6), {"b": 3})
+    assert pc.insert((7, 8, 9), {"b": 4})      # evicts (1,2,3) FIFO
+    assert pc.lookup((1, 2, 3, 4)) is None
+    # An exact-prompt hit clamps to len(prompt)-1: the last token must
+    # re-prefill so the fork always has a next-token logit to emit.
+    n, blob = pc.lookup((4, 5, 6))
+    assert (n, blob) == (2, {"b": 3})
+    assert PrefixCache(cap=0).insert((1, 2), {}) is False
+
+
+# -- ISSUE 16: int8-storage warm-KV migration ---------------------------------
+
+def test_int8_to_int8_migration_bit_exact(tiny, rng):
+    """The wire blob carries the int8 codes + block scales RAW, so an
+    int8-storage -> int8-storage migration is BIT-exact — no second
+    quantization — including a slot whose ring already wrapped (the
+    lines hold only the last max_len positions)."""
+    m, params = tiny
+    toks = jnp.asarray(rng.integers(1, 128, (2, 6)), jnp.int32)
+    cache = init_kv_cache(m, slots=2, max_len=8, kind="int8")
+    apply = jax.jit(lambda p, t, c: m.apply(p, t, cache=c))
+    logits, cache = apply(params, toks, cache)
+    # Decode past the ring boundary: slot 1 wraps (pos 6 -> 16 > 8).
+    tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    for _ in range(10):
+        logits, cache = apply(params, tok, cache)
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+    assert int(cache["pos"][1]) == 16  # wrapped: 16 > max_len 8
+    blob = kv_lib.export_slot(cache, 1)
+    dest = init_kv_cache(m, slots=2, max_len=8, kind="int8")
+    dest = kv_lib.import_slot(dest, 0, blob)
+    assert int(dest["pos"][0]) == 16
+    np.testing.assert_array_equal(np.asarray(dest["slot_pos"][0]),
+                                  np.asarray(cache["slot_pos"][1]))
+    for src_l, dst_l in zip(cache["layers"], dest["layers"]):
+        for leaf in ("k_q", "k_s", "v_q", "v_s"):
+            np.testing.assert_array_equal(
+                np.asarray(src_l[leaf][1]), np.asarray(dst_l[leaf][0]))
+
+
+def test_rewind_slots_invalidates_speculated_lines(tiny, rng):
+    """rewind_slots(cache, new_pos): lines at slot_pos >= new_pos drop
+    out of attention; a re-decode from the rewound position matches a
+    cache that never held the speculated tokens."""
+    m, params = tiny
+    toks = jnp.asarray(rng.integers(1, 128, (1, 5)), jnp.int32)
+    apply = jax.jit(lambda p, t, c: m.apply(p, t, cache=c))
+    a = init_kv_cache(m, slots=1, max_len=16, kind="fp32")
+    _, a = apply(params, toks, a)
+    b = jax.tree.map(lambda x: x, a)
+    # Pollute b with 3 speculated tokens, then roll it back.
+    junk = jnp.asarray([[9]], jnp.int32)
+    for _ in range(3):
+        _, b = apply(params, junk, b)
+    b = kv_lib.rewind_slots(b, jnp.full((1,), 5, jnp.int32))
+    assert int(b["pos"][0]) == 5
+    la, a2 = apply(params, jnp.asarray([[3]], jnp.int32), a)
+    lb, b2 = apply(params, jnp.asarray([[3]], jnp.int32), b)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               atol=FP32_ATOL)
+    assert int(a2["pos"][0]) == int(b2["pos"][0]) == 6
+
+
+# -- ISSUE 16 satellite: re-admission keeps the arrival deadline --------------
+
+def test_insert_by_arrival_orders_by_arrival_and_bypasses_maxsize():
+    q = RequestQueue(maxsize=2)
+    a = Request(rid=0, prompt=(1,), max_new_tokens=1, arrival_t=0.0)
+    b = Request(rid=1, prompt=(1,), max_new_tokens=1, arrival_t=1.0)
+    c = Request(rid=2, prompt=(1,), max_new_tokens=1, arrival_t=2.0)
+    assert q.submit(b) and q.submit(c)
+    a.reroutes = 1
+    q.insert_by_arrival(a)  # full queue MUST still accept re-admits
+    assert len(q) == 3 and q.rejected == 0
+    assert [r.rid for r in q.drain()] == [0, 1, 2]
+
+
+def test_fallback_requeue_keeps_arrival_deadline_position(tiny):
+    """ISSUE 16 satellite regression: a request that lost its slot
+    (kill / drain / no-free-slot re-prefill fallback) re-enters the
+    surviving queue at its ARRIVAL position — ahead of later arrivals
+    — with arrival_t and deadline_s untouched, so the deadline clock
+    never restarts and the miss accounting stays honest."""
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=1, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(min_replicas=1, max_replicas=2,
+                    grow_cooldown_s=1e9)  # no restore-grow noise
+    cluster = ServeCluster(factory, policy=pol, replicas=2,
+                           step_s=0.05, log_path="")
+    early = Request(rid=0, prompt=(1, 2), max_new_tokens=20,
+                    arrival_t=0.0, deadline_s=5.0)
+    mid = Request(rid=1, prompt=(3, 4), max_new_tokens=20,
+                  arrival_t=0.1, deadline_s=5.0)
+    late = Request(rid=2, prompt=(5, 6), max_new_tokens=20,
+                   arrival_t=0.2, deadline_s=5.0)
+    cluster.submit(early)
+    cluster.submit(mid)
+    for name in list(cluster.live()):
+        cluster.batchers[name].run_step(0.0)  # each holds one slot
+    cluster.submit(late)  # both slots busy -> queued behind them
+    holder = early.replica
+    survivor = next(n for n in cluster.live() if n != holder)
+    cluster.kill_replica(holder)
+    # The re-routed early request outranks the later-arrived queued
+    # one despite re-entering the queue AFTER it.
+    queued = [r.rid for r in cluster.batchers[survivor].queue.drain()]
+    assert queued.index(0) < queued.index(2)
+    assert early.arrival_t == 0.0 and early.deadline_s == 5.0
+    assert early.reroutes == 1
+
+
+# -- ISSUE 16: disaggregated prefill/decode pools -----------------------------
+
+def test_disagg_cluster_completes_and_repeats_byte_identically(tiny):
+    """ISSUE 16 acceptance: prefill-role replicas admit + prefill and
+    hand every sequence to the decode pool over the warm-KV wire —
+    zero drops, handoffs counted, the handoff deque fully drained, and
+    the event + decision logs byte-identical across seeded repeats."""
+    m, params = tiny
+
+    def run():
+        factory = make_engine_factory(m, params, slots=4, max_len=32,
+                                      max_prompt_len=16)
+        trace = poisson_trace(seed=5, n_requests=20, rate_rps=20.0)
+        cluster = ServeCluster(factory, policy=SLOPolicy(),
+                               roles={"prefill": 1, "decode": 1},
+                               step_s=0.05, log_path="")
+        rep = cluster.run(trace)
+        return cluster, rep
+
+    c1, rep1 = run()
+    _, rep2 = run()
+    assert rep1["dropped"] == 0
+    assert rep1["completed"] == rep1["submitted"] == 20
+    # Multi-token requests all crossed the wire; one-token requests
+    # may legally finish at prefill.
+    multi = sum(1 for r in c1.completed if len(r.tokens) > 1)
+    assert rep1["handoffs"] >= max(1, multi)
+    assert rep1["pending_handoffs"] == 0
+    starts = {e[2]: e[3] for e in c1.events
+              if e[1] == "replica_start"}
+    assert sorted(starts.values()) == ["decode", "prefill"]
+    assert rep1["events"] == rep2["events"]
+    assert rep1["decisions"] == rep2["decisions"]
+
+
+def test_disagg_controller_targets_roles(tiny):
+    """Role-aware decisions: queue pressure grows the PREFILL pool,
+    handoff back-pressure grows the DECODE pool, and low-occupancy
+    shrink only ever names a decode replica above its floor."""
+    pol = SLOPolicy(max_queue_depth=4, max_handoff_depth=3,
+                    grow_cooldown_s=0.0, min_replicas=2,
+                    max_replicas=6)
+    c = ServeController(pol, log_path="")
+    d = c.tick(now=1.0, live=2, draining=0, queue_depth=9,
+               occupancy=0.9, below_min=False, disagg=True)
+    assert (d.action, d.target, d.reason) == \
+        ("grow", "prefill:1", "queue_depth")
+    d = c.tick(now=2.0, live=3, draining=0, queue_depth=0,
+               occupancy=0.9, below_min=False, handoff_depth=7,
+               disagg=True)
+    assert (d.action, d.target, d.reason) == \
+        ("grow", "decode:1", "handoff_depth")
+    # A restore below the floor names the lost role.
+    d = c.tick(now=3.0, live=1, draining=0, queue_depth=0,
+               occupancy=0.0, below_min=True, restore_role="prefill",
+               disagg=True)
+    assert (d.action, d.target, d.reason) == \
+        ("grow", "prefill:1", "restore_capacity")
+    with pytest.raises(ValueError, match="max_handoff_depth"):
+        SLOPolicy.from_dict({"max_handoff_depth": -1})
+
+
+def test_disagg_roles_validation(tiny):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=16,
+                                  max_prompt_len=8)
+    with pytest.raises(ValueError, match="roles"):
+        ServeCluster(factory, policy=SLOPolicy(), log_path="",
+                     roles={"prefill": 1, "verify": 1})
+    with pytest.raises(ValueError, match="roles"):
+        ServeCluster(factory, policy=SLOPolicy(), log_path="",
+                     roles={"prefill": 1, "decode": 0})

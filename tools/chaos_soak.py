@@ -1052,6 +1052,152 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
     }
 
 
+# -- the serve_disagg family (docs/serve.md disaggregation) ------------------
+
+
+def serve_disagg_plan(seed: int) -> dict:
+    """The disaggregated-serving acceptance plan (ISSUE 16): hard-kill
+    the PREFILL-role replica mid-handoff, while its exported warm-KV
+    blobs are streaming to the decode pool. Blobs already exported stay
+    valid (the wire blob is self-contained), queued requests re-enter
+    at their ARRIVAL position, and the controller restores the prefill
+    pool (grow target=prefill:1) — zero dropped requests."""
+    return {"seed": seed, "faults": [
+        {"site": "replica_kill", "step": 6, "target": "r0"},
+    ]}
+
+
+def serve_disagg_policy() -> dict:
+    """Role-aware SLO policy for the soak: 1 prefill + 2 decode
+    floors, handoff-depth back-pressure armed so sustained prefill
+    output ahead of decode capacity grows the decode pool."""
+    return {
+        "tick_interval_s": 0.1,
+        "window": 16,
+        "target_p99_s": 2.0,
+        "max_queue_depth": 8,
+        "max_handoff_depth": 6,
+        "min_replicas": 3,
+        "max_replicas": 5,
+        "grow_cooldown_s": 0.5,
+        "shrink_cooldown_s": 2.0,
+    }
+
+
+def run_serve_disagg_soak(workdir: str, steps: int = 40, seed: int = 42,
+                          plan: dict | None = None) -> dict:
+    """One seeded serve_disagg-family run: the REAL disaggregated serve
+    stack (1 prefill-role + 2 decode-role replicas, warm-KV handoff
+    wire, elastic HostManager) on a virtual clock, under a seeded
+    prefill-replica kill. ``steps`` is the trace length (requests).
+    Asserts (a) zero dropped requests — the decode pool kept every
+    handed-off sequence and the killed prefill replica's queue
+    re-prefilled after the restore, (b) the decision log names
+    kill -> grow prefill:1 deterministically, (c) handoffs actually
+    flowed both before and after the kill, (d) the killed replica's
+    host was blacklisted. The --repeat contract compares the full
+    event + decision sequences byte-for-byte."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.models import gpt_tiny
+    from horovod_tpu.runner.elastic_driver import HostManager
+    from horovod_tpu.serve.controller import SLOPolicy, ServeCluster
+    from horovod_tpu.serve.engine import make_engine_factory
+    from horovod_tpu.serve.traffic import poisson_trace
+
+    os.makedirs(workdir, exist_ok=True)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    decision_log = os.path.join(workdir, "decisions.jsonl")
+    plan = plan if plan is not None else serve_disagg_plan(seed)
+    policy = SLOPolicy.from_dict(serve_disagg_policy())
+
+    fp = faults_lib.FaultPlan.from_json(json.dumps(plan))
+    inj = faults_lib.FaultInjector(fp, log_path=fault_log,
+                                   rank="driver", host="sim")
+
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 4), np.int32))
+    factory = make_engine_factory(model, params, slots=4, max_len=32,
+                                  max_prompt_len=16)
+    trace = poisson_trace(seed=seed, n_requests=steps, rate_rps=25.0)
+
+    vt = [0.0]
+
+    class SimDiscovery:
+        def find_available_hosts_and_slots(self):
+            return {h: 1 for h in SERVE_HOSTS}
+
+    hm = HostManager(SimDiscovery(), blacklist_ttl_s=30.0,
+                     clock=lambda: vt[0])
+    hm.update_available_hosts()
+    cluster = ServeCluster(
+        factory, policy=policy, step_s=0.05,
+        log_path=decision_log, host_manager=hm,
+        host_of=lambda name: f"host{int(name[1:]) % len(SERVE_HOSTS)}",
+        roles={"prefill": 1, "decode": 2})
+
+    handoffs_at_kill = [None]
+
+    def hook(c, round_idx):
+        vt[0] = round_idx * c.step_s
+        spec = inj.check("replica_kill")
+        if spec is not None and spec.target in c.batchers:
+            handoffs_at_kill[0] = c._handoffs_done
+            c.kill_replica(spec.target)
+
+    report = cluster.run(trace, round_hook=hook)
+
+    # (a) zero request loss across the prefill-pool kill.
+    assert report["dropped"] == 0, report
+    assert report["completed"] == len(trace.requests), report
+    # (b) the decision log: kill of the prefill replica -> a grow that
+    # NAMES the prefill role (role-aware restore).
+    decisions = [json.loads(l) for l in report["decisions"]]
+    assert decisions and decisions[0]["action"] == "drain" \
+        and decisions[0]["target"] == "r0" \
+        and decisions[0]["reason"] == "replica_lost", decisions
+    grows = [d for d in decisions if d["action"] == "grow"]
+    assert grows and grows[0]["reason"] == "restore_capacity" \
+        and grows[0]["target"] == "prefill:1", decisions
+    # (c) the handoff wire carried sequences before AND after the kill
+    # — the kill landed mid-stream, not on an idle cluster.
+    assert handoffs_at_kill[0] is not None \
+        and handoffs_at_kill[0] >= 1, \
+        f"kill must land mid-handoff: {handoffs_at_kill[0]}"
+    assert report["handoffs"] > handoffs_at_kill[0], report
+    assert report["pending_handoffs"] == 0, report
+    # (d) the host left the usable set via the elastic blacklist.
+    assert "host0" in hm.blacklist_snapshot(), \
+        f"killed replica's host must be blacklisted: " \
+        f"{hm.blacklist_snapshot()}"
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert "replica_kill" in sites, sorted(sites)
+    return {
+        "metric": "chaos_soak_serve_disagg",
+        "seed": seed,
+        "steps": steps,
+        "requests": len(trace.requests),
+        "completed": report["completed"],
+        "dropped": report["dropped"],
+        "handoffs": report["handoffs"],
+        "handoffs_at_kill": handoffs_at_kill[0],
+        "max_reroutes": report["max_reroutes"],
+        "latency_p99_s": report["latency_p99_s"],
+        "decisions": report["decisions"],
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "sequences": {
+            "events": [list(e) for e in report["events"]],
+            "decisions": report["decisions"],
+        },
+    }
+
+
 # -- the zero family (docs/zero.md) ------------------------------------------
 
 def zero_plan(seed: int, steps: int) -> dict:
@@ -2107,7 +2253,8 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--family", choices=("elastic", "integrity",
                                          "autoscale", "stall", "moe",
-                                         "serve", "zero", "pipeline",
+                                         "serve", "serve_disagg",
+                                         "zero", "pipeline",
                                          "hybrid"),
                     default="elastic",
                     help="elastic = process faults through the driver; "
@@ -2132,6 +2279,13 @@ def main() -> int:
                          "queue/in-flight re-route with zero dropped "
                          "requests, the SLO controller's kill -> grow "
                          "decision sequence byte-deterministic "
+                         "(docs/serve.md); "
+                         "serve_disagg = a PREFILL-role replica kill "
+                         "mid-handoff on the disaggregated cluster "
+                         "(1 prefill + 2 decode pools, warm-KV wire): "
+                         "exported blobs survive, queued requests "
+                         "re-enter at arrival position, the restore "
+                         "grow names prefill:1, zero dropped requests "
                          "(docs/serve.md); "
                          "zero = a hard mid-step crash of ZeRO-3 "
                          "sharded training + a torn sharded "
@@ -2172,12 +2326,15 @@ def main() -> int:
     soak = {"elastic": run_soak, "integrity": run_integrity_soak,
             "autoscale": run_autoscale_soak,
             "stall": run_stall_soak, "moe": run_moe_soak,
-            "serve": run_serve_soak, "zero": run_zero_soak,
+            "serve": run_serve_soak,
+            "serve_disagg": run_serve_disagg_soak,
+            "zero": run_zero_soak,
             "pipeline": run_pipeline_soak,
             "hybrid": run_hybrid_soak}[args.family]
     if args.steps is None:
         args.steps = {"autoscale": 120, "stall": 60,
                       "moe": 8, "serve": 40,
+                      "serve_disagg": 40,
                       "zero": 8, "pipeline": 8,
                       "hybrid": 6}.get(args.family, 12)
     records = []

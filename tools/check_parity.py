@@ -541,13 +541,16 @@ def check_serve_surface(missing: list) -> None:
 
     # Public API names: defined in source -> documented in both docs.
     api_names = {
-        "queue.py": ("Request", "RequestQueue"),
+        "queue.py": ("Request", "RequestQueue", "insert_by_arrival"),
         "traffic.py": ("TrafficTrace", "poisson_trace"),
-        "engine.py": ("DecodeEngine", "make_engine_factory"),
+        "engine.py": ("DecodeEngine", "make_engine_factory",
+                      "compile_programs", "compile_spec_programs"),
         "batcher.py": ("ContinuousBatcher",),
         "controller.py": ("SLOPolicy", "ServeController",
                           "ServeCluster"),
-        "kvcache.py": ("init_cache", "export_slot", "import_slot"),
+        "kvcache.py": ("init_cache", "export_slot", "import_slot",
+                       "rewind_slots"),
+        "prefix.py": ("PrefixCache",),
     }
     for fname, fns in api_names.items():
         src = sources.get(fname, "")
@@ -570,7 +573,8 @@ def check_serve_surface(missing: list) -> None:
     # Bench + chaos + fault-site surfaces.
     bench_src = (REPO / "bench.py").read_text()
     for flag in ("--serve", "--serve-replicas", "--serve-kv",
-                 "--serve-requests", "--serve-rate", "--serve-seed"):
+                 "--serve-requests", "--serve-rate", "--serve-seed",
+                 "--serve-arm"):
         if f'"{flag}"' not in bench_src:
             missing.append(f"serve: bench.py lacks the {flag} flag")
         elif flag not in text:
@@ -579,9 +583,19 @@ def check_serve_surface(missing: list) -> None:
     if '"workload": "serve"' not in bench_src:
         missing.append("serve: bench.py serve records lack the "
                        "workload tag")
+    if '"arm": args.serve_arm' not in bench_src:
+        missing.append("serve: bench.py serve records lack the "
+                       "arm tag")
     soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
     if "run_serve_soak" not in soak_src or '"serve"' not in soak_src:
         missing.append("serve: chaos_soak.py lacks the serve family")
+    if "run_serve_disagg_soak" not in soak_src \
+            or '"serve_disagg"' not in soak_src:
+        missing.append("serve: chaos_soak.py lacks the serve_disagg "
+                       "family")
+    if "serve_disagg" not in text:
+        missing.append("serve: docs/serve.md does not describe the "
+                       "serve_disagg chaos family")
     faults_src = (REPO / "horovod_tpu" / "common"
                   / "faults.py").read_text()
     if '"replica_kill"' not in faults_src:
