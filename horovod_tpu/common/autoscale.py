@@ -148,7 +148,12 @@ class AutoscalePolicy:
     # `patience` consecutive flagged scoring ticks. Scoring needs at
     # least `min_ranks` ranks advancing in the same tick — a 2-rank
     # world cannot tell who is slow.
-    straggler_ratio: float = 1.75
+    # Tuned by the PR 17 fleetsim sweep (docs/fleetsim.md): 1.3
+    # false-convicts honest slow-SKU hosts in a heterogeneous fleet,
+    # 1.75+ never convicts a ~1.6x degraded host; 1.5 is the only
+    # probed value clean on both (results/fleetsim/
+    # sweep_straggler_ratio.json).
+    straggler_ratio: float = 1.5
     straggler_patience: int = 2
     min_ranks: int = 3
     # Eviction: TTL blacklist (the host may recover — HostManager's
@@ -526,7 +531,7 @@ class StepPublisher:
             n=len(vals), p50=p50,
             mean=sum(vals) / len(vals), last=last_dt,
             comm_fraction=_comm_fraction_from_metrics(),
-            resyncs=int(resyncs), t=time.time(), role=self.role)
+            resyncs=int(resyncs), t=self._clock(), role=self.role)
 
     def _publish(self, report: StepReport) -> None:
         try:

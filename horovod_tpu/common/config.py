@@ -385,6 +385,10 @@ RUNTIME_KNOBS = {
     "DISABLE_NATIVE": "skip the native acceleration library",
     "FLASH_ATTENTION": "pallas flash-attention kernel enable",
     "MAX_RETAINED_HANDLES": "eager-engine completed-handle cap",
+    # Fleet digital twin (common/fleetsim.py, tools/fleetsim.py).
+    "FLEETSIM_BASELINE_DIR": "banked decision-log baseline directory",
+    "FLEETSIM_SEED": "default scenario seed for the fleetsim CLI",
+    "FLEETSIM_TICK_CAP": "runaway guard: max virtual ticks per run",
     # Decision logs read by their subsystems at construction.
     "AUTOSCALE_LOG": "autoscale decision log (also a Config field)",
     "SERVE_LOG": "serve-controller decision log",

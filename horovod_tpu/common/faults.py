@@ -181,8 +181,13 @@ class FaultInjector:
     counter and (for probability mode) its own seeded RNG stream."""
 
     def __init__(self, plan: FaultPlan, log_path: Optional[str] = None,
-                 rank: Optional[str] = None, host: Optional[str] = None):
+                 rank: Optional[str] = None, host: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.plan = plan
+        # Injection-log timestamps come from here; a virtual-time
+        # harness injects its own clock so the JSONL stays
+        # deterministic (hvdlint sim-clock discipline).
+        self._clock = clock if clock is not None else time.time
         self._lock = threading.Lock()
         self._hits: Dict[str, int] = {}
         self._fired: Dict[int, int] = {}
@@ -243,7 +248,8 @@ class FaultInjector:
         if self._log_path:
             try:
                 with open(self._log_path, "a") as f:
-                    f.write(json.dumps({**rec, "t": time.time()}) + "\n")
+                    f.write(json.dumps({**rec, "t": self._clock()})
+                            + "\n")
             except OSError:  # the log is best-effort, never fatal
                 pass
 

@@ -378,8 +378,13 @@ class ServeCluster:
                  step_s: float = 0.05, log_path: Optional[str] = None,
                  host_manager=None,
                  host_of: Optional[Callable[[str], str]] = None,
-                 roles: Optional[Dict[str, int]] = None):
+                 roles: Optional[Dict[str, int]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.factory = engine_factory
+        # Wall-clock source for the run() report only; a virtual-time
+        # harness injects its own so the report stays deterministic
+        # (hvdlint sim-clock discipline).
+        self._clock = clock if clock is not None else time.monotonic
         self.policy = policy if policy is not None \
             else SLOPolicy.from_env()
         self.step_s = float(step_s)
@@ -714,7 +719,7 @@ class ServeCluster:
         latency percentiles, token counts, occupancy, the deterministic
         event list, and the decision log."""
         pending = deque(trace.requests)
-        wall0 = time.monotonic()
+        wall0 = self._clock()
         while self.rounds < max_rounds:
             while pending and pending[0].arrival_t <= self._now:
                 self.submit(pending.popleft())
@@ -756,7 +761,7 @@ class ServeCluster:
                     and all(b.engine.active_count() == 0
                             for b in self.batchers.values()):
                 break
-        wall_s = time.monotonic() - wall0
+        wall_s = self._clock() - wall0
         return self.report(len(trace.requests), wall_s)
 
     def report(self, submitted: int, wall_s: float = 0.0) -> Dict:

@@ -62,6 +62,7 @@ FIXTURE_MATRIX = [
     ("error-stamp", "error_stamp_bad.py", "error_stamp_clean.py", 3),
     ("metric-name", "metric_name_bad.py", "metric_name_clean.py", 3),
     ("lock-order", "lock_order_bad.py", "lock_order_clean.py", 1),
+    ("sim-clock", "sim_clock_bad.py", "sim_clock_clean.py", 3),
 ]
 
 

@@ -712,99 +712,18 @@ def simulate_autoscale(plan: dict, policy: dict,
     HostManager (blacklist TTL + strike doubling) and per-worker
     FaultInjectors, advanced by a deterministic virtual clock — no
     processes, no wall time, so the decision log is reproducible to the
-    byte. Returns ``(decision_log_lines, injection_count)``."""
-    import statistics
-    from collections import deque
+    byte. The world model itself lives in the fleet digital twin
+    (common/fleetsim.py, docs/fleetsim.md); this is the family-shaped
+    wrapper. Returns ``(decision_log_lines, injection_count)``."""
+    from horovod_tpu.common import fleetsim
 
-    from horovod_tpu.common import autoscale as autoscale_lib
-    from horovod_tpu.common import faults as faults_lib
-    from horovod_tpu.runner.elastic_driver import (HostDiscovery,
-                                                   HostManager)
-
-    pol = autoscale_lib.AutoscalePolicy.from_dict(policy)
-    fp = faults_lib.FaultPlan.from_json(json.dumps(plan))
-    host_inj = {h: faults_lib.FaultInjector(fp, log_path="",
-                                            rank=str(i), host=h)
-                for i, h in enumerate(hosts)}
-    drv_inj = faults_lib.FaultInjector(fp, log_path="")
-    vt = [0.0]
-
-    class SimDiscovery(HostDiscovery):
-        def find_available_hosts_and_slots(self):
-            found = {h: 1 for h in hosts}
-            spec = drv_inj.check("discovery")
-            if spec is not None:
-                if (spec.mode or "flap") == "drop_host":
-                    found.pop(spec.target, None)
-                else:
-                    found = {}
-            return found
-
-    hm = HostManager(SimDiscovery(), blacklist_ttl_s=pol.evict_ttl_s,
-                     clock=lambda: vt[0])
-    state = {h: {"steps": 0, "win": deque(maxlen=pol.window),
-                 "down_until": 0.0} for h in hosts}
-    reports = {}
-    engine = autoscale_lib.AutoscaleEngine(
-        pol, min_np, max_np, lambda: dict(reports),
-        clock=lambda: vt[0], log_path="")
-    assigned: dict = {}
-    prev_np = None
-    while vt[0] < duration_s:
-        vt[0] += pol.tick_interval_s
-        hm.update_available_hosts()
-        usable = hm.current_hosts()
-        if sum(usable.values()) < min_np:
-            continue  # the real driver blocks in wait_for_available_slots
-        if set(usable) != set(assigned):
-            cap = engine.pre_epoch(prev_np, usable)
-            names = sorted(usable)
-            if cap is not None and cap < len(names):
-                # Hold: keep previously assigned hosts first (rank
-                # stability), drop the newest.
-                names = (sorted(set(assigned) & set(usable))
-                         + sorted(set(usable) - set(assigned)))[:cap]
-            assigned = {h: usable[h] for h in names}
-            engine.observe_assignment(set(assigned))
-            prev_np = len(assigned)
-        for i, h in enumerate(hosts):
-            if h not in assigned:
-                continue
-            st = state[h]
-            if vt[0] < st["down_until"]:
-                continue  # preempted worker respawning
-            budget = pol.tick_interval_s
-            last = base_step_s
-            while budget > 0:
-                dt = base_step_s
-                spec = host_inj[h].check("straggler")
-                if spec is not None:
-                    dt = dt + spec.delay_s if spec.delay_s > 0 \
-                        else dt * max(spec.scale, 1.0)
-                pre = host_inj[h].check("preempt")
-                if pre is not None:
-                    # The worker dies at this commit; the driver
-                    # respawns it next epoch (~2 ticks of downtime).
-                    st["down_until"] = vt[0] + 2 * pol.tick_interval_s
-                    break
-                st["win"].append(dt)
-                st["steps"] += 1
-                budget -= dt
-                last = dt
-            if st["win"]:
-                reports[i] = autoscale_lib.StepReport(
-                    rank=i, host=h, step=st["steps"],
-                    n=len(st["win"]),
-                    p50=statistics.median(st["win"]),
-                    mean=sum(st["win"]) / len(st["win"]), last=last,
-                    t=vt[0])
-        for d in engine.tick(assigned, hm.blacklist_snapshot()):
-            if d.action in ("evict", "shrink") and d.target:
-                hm.blacklist(d.target, ttl_s=d.ttl_s,
-                             permanent=d.permanent)
-    injections = sum(len(inj.injections)
-                     for inj in list(host_inj.values()) + [drv_inj])
-    return engine.decision_log(), injections
+    scn = fleetsim.FleetScenario(
+        name="chaos_autoscale", hosts=len(hosts),
+        host_names=list(hosts), min_np=min_np, max_np=max_np,
+        duration_s=duration_s, base_step_s=base_step_s,
+        policy=dict(policy), plan=dict(plan))
+    rep = fleetsim.FleetSim(scn).run()
+    return rep.decisions, rep.injections
 
 
 def run_autoscale_soak(workdir: str, steps: int = 120, seed: int = 42,
@@ -963,14 +882,16 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
     replica's host was blacklisted through the HostManager. The
     --repeat contract compares the full event + decision sequences
     byte-for-byte (virtual time makes them deterministic by
-    construction — the assertion is the repeat check)."""
+    construction — the assertion is the repeat check). The world model
+    lives in the fleet digital twin (common/fleetsim.py
+    ``run_serve_world``); this is the family-shaped wrapper."""
     import jax
     import numpy as np
 
     from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.common import fleetsim
     from horovod_tpu.models import gpt_tiny
-    from horovod_tpu.runner.elastic_driver import HostManager
-    from horovod_tpu.serve.controller import SLOPolicy, ServeCluster
+    from horovod_tpu.serve.controller import SLOPolicy
     from horovod_tpu.serve.engine import make_engine_factory
     from horovod_tpu.serve.traffic import poisson_trace
 
@@ -991,27 +912,10 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
                                   max_prompt_len=16)
     trace = poisson_trace(seed=seed, n_requests=steps, rate_rps=25.0)
 
-    vt = [0.0]
-
-    class SimDiscovery:
-        def find_available_hosts_and_slots(self):
-            return {h: 1 for h in SERVE_HOSTS}
-
-    hm = HostManager(SimDiscovery(), blacklist_ttl_s=30.0,
-                     clock=lambda: vt[0])
-    hm.update_available_hosts()
-    cluster = ServeCluster(
-        factory, policy=policy, replicas=2, step_s=0.05,
-        log_path=decision_log, host_manager=hm,
-        host_of=lambda name: f"host{int(name[1:]) % len(SERVE_HOSTS)}")
-
-    def hook(c, round_idx):
-        vt[0] = round_idx * c.step_s
-        spec = inj.check("replica_kill")
-        if spec is not None and spec.target in c.batchers:
-            c.kill_replica(spec.target)
-
-    report = cluster.run(trace, round_hook=hook)
+    report, hm, _cluster = fleetsim.run_serve_world(
+        factory=factory, policy=policy, trace=trace,
+        hosts=SERVE_HOSTS, replicas=2, step_s=0.05,
+        log_path=decision_log, kill_injector=inj)
 
     # (a) zero request loss; the killed replica's work actually moved.
     assert report["dropped"] == 0, report
@@ -1096,14 +1000,16 @@ def run_serve_disagg_soak(workdir: str, steps: int = 40, seed: int = 42,
     kill -> grow prefill:1 deterministically, (c) handoffs actually
     flowed both before and after the kill, (d) the killed replica's
     host was blacklisted. The --repeat contract compares the full
-    event + decision sequences byte-for-byte."""
+    event + decision sequences byte-for-byte. The world model lives in
+    the fleet digital twin (common/fleetsim.py ``run_serve_world``);
+    this is the family-shaped wrapper."""
     import jax
     import numpy as np
 
     from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.common import fleetsim
     from horovod_tpu.models import gpt_tiny
-    from horovod_tpu.runner.elastic_driver import HostManager
-    from horovod_tpu.serve.controller import SLOPolicy, ServeCluster
+    from horovod_tpu.serve.controller import SLOPolicy
     from horovod_tpu.serve.engine import make_engine_factory
     from horovod_tpu.serve.traffic import poisson_trace
 
@@ -1124,31 +1030,16 @@ def run_serve_disagg_soak(workdir: str, steps: int = 40, seed: int = 42,
                                   max_prompt_len=16)
     trace = poisson_trace(seed=seed, n_requests=steps, rate_rps=25.0)
 
-    vt = [0.0]
-
-    class SimDiscovery:
-        def find_available_hosts_and_slots(self):
-            return {h: 1 for h in SERVE_HOSTS}
-
-    hm = HostManager(SimDiscovery(), blacklist_ttl_s=30.0,
-                     clock=lambda: vt[0])
-    hm.update_available_hosts()
-    cluster = ServeCluster(
-        factory, policy=policy, step_s=0.05,
-        log_path=decision_log, host_manager=hm,
-        host_of=lambda name: f"host{int(name[1:]) % len(SERVE_HOSTS)}",
-        roles={"prefill": 1, "decode": 2})
-
     handoffs_at_kill = [None]
 
-    def hook(c, round_idx):
-        vt[0] = round_idx * c.step_s
-        spec = inj.check("replica_kill")
-        if spec is not None and spec.target in c.batchers:
-            handoffs_at_kill[0] = c._handoffs_done
-            c.kill_replica(spec.target)
+    def on_kill(c, spec):
+        handoffs_at_kill[0] = c._handoffs_done
 
-    report = cluster.run(trace, round_hook=hook)
+    report, hm, _cluster = fleetsim.run_serve_world(
+        factory=factory, policy=policy, trace=trace,
+        hosts=SERVE_HOSTS, roles={"prefill": 1, "decode": 2},
+        step_s=0.05, log_path=decision_log, kill_injector=inj,
+        on_kill=on_kill)
 
     # (a) zero request loss across the prefill-pool kill.
     assert report["dropped"] == 0, report
@@ -1675,51 +1566,19 @@ def simulate_hybrid(plan: dict, policy: dict, ticks: int = 12):
     post-eviction capacity (6 slots) must re-solve through the respec
     ladder to the shed_dp spec dp=1,pp=2,tp=2. Deterministic by
     construction (virtual clock, fixed reports): the --repeat contract
-    compares the decision log byte-for-byte."""
-    from horovod_tpu.common import autoscale as autoscale_lib
+    compares the decision log byte-for-byte. The world model lives in
+    the fleet digital twin (common/fleetsim.py ``simulate_roles``);
+    this is the family-shaped wrapper."""
+    from horovod_tpu.common import fleetsim
     from horovod_tpu.parallel.spec import ParallelSpec
 
     spec = ParallelSpec.parse(HYBRID_DECLARED)
-    pol = autoscale_lib.AutoscalePolicy.from_dict(policy)
-    host_of = {r: HYBRID_HOSTS[r // 2] for r in range(8)}
     delay = next(f["delay_s"] for f in plan["faults"]
                  if f["site"] == "straggler")
-    vt = [0.0]
-    reports: dict = {}
-    engine = autoscale_lib.AutoscaleEngine(
-        pol, min_np=1, max_np=8, fetch_reports=lambda: dict(reports),
-        clock=lambda: vt[0], log_path="", parallel=spec)
-    usable = {h: 2 for h in HYBRID_HOSTS}
-    engine.observe_assignment(set(usable))
-    evicted: set = set()
-    base = 0.1
-    for tick in range(1, ticks + 1):
-        vt[0] += pol.tick_interval_s
-        for r in range(8):
-            if host_of[r] in evicted:
-                reports.pop(r, None)
-                continue
-            # The straggler's own step interval carries its full extra
-            # delay; its replica peers absorb most of it through the
-            # schedule stall (1F1B overlap hides a sliver) — the
-            # strictly-slowest rule pins the conviction on rank 5.
-            p50 = base
-            if spec.replica_of(r) == 1:
-                p50 = base + (delay if r == 5 else 0.8 * delay)
-            reports[r] = autoscale_lib.StepReport(
-                rank=r, host=host_of[r], step=tick, n=8, p50=p50,
-                mean=p50, last=p50, t=vt[0],
-                role=spec.role_label(r))
-        live = {h: s for h, s in usable.items() if h not in evicted}
-        for d in engine.tick(live):
-            if d.action == "evict" and d.target:
-                evicted.add(d.target)
-                # The epoch boundary after the evict: re-solve the
-                # mesh for the surviving capacity.
-                engine.plan_respec(
-                    sum(s for h, s in usable.items()
-                        if h not in evicted))
-    return engine.decision_log()
+    return fleetsim.simulate_roles(
+        spec, policy, hosts=HYBRID_HOSTS, ranks_per_host=2,
+        straggler_rank=5, straggler_delay=delay, ticks=ticks,
+        min_np=1, max_np=8)
 
 
 HYBRID_SCRIPT = """
@@ -2249,72 +2108,80 @@ def run_soak(workdir: str, steps: int = 12, seed: int = 42,
     }
 
 
+# The family registry: ONE row per family — runner, default --steps,
+# and the one-line contract — so new families stop re-implementing the
+# choices tuple / dispatch dict / per-family default-steps plumbing.
+FAMILIES = {
+    "elastic": (run_soak, 12,
+                "process faults through the driver"),
+    "integrity": (run_integrity_soak, 12,
+                  "data faults through the guard/detector/"
+                  "verified-checkpoint stack"),
+    "autoscale": (run_autoscale_soak, 120,
+                  "straggler/preempt-storm/flap faults through the "
+                  "telemetry-driven control plane (decision-log "
+                  "determinism; steps is the seconds-scale run "
+                  "length)"),
+    "stall": (run_stall_soak, 60,
+              "a hung collective through the watchdog -> "
+              "flight-recorder black box -> flight_diff attribution "
+              "-> elastic retry path, with the pod aggregator "
+              "scraped live (docs/podmon.md)"),
+    "moe": (run_moe_soak, 8,
+            "a hot-expert router skew + a mid-step crash through "
+            "the MoE dispatch hot path: drop/load gauges must fire, "
+            "the integrity guard must agree across ranks, and the "
+            "relaunch must restore and finish (docs/moe.md)"),
+    "serve": (run_serve_soak, 40,
+              "a replica kill mid-stream through the hvd.serve "
+              "cluster: graceful drain + queue/in-flight re-route "
+              "with zero dropped requests, the SLO controller's "
+              "kill -> grow decision sequence byte-deterministic; "
+              "steps is the trace length (docs/serve.md)"),
+    "serve_disagg": (run_serve_disagg_soak, 40,
+                     "a PREFILL-role replica kill mid-handoff on the "
+                     "disaggregated cluster (1 prefill + 2 decode "
+                     "pools, warm-KV wire): exported blobs survive, "
+                     "queued requests re-enter at arrival position, "
+                     "the restore grow names prefill:1, zero dropped "
+                     "requests (docs/serve.md)"),
+    "zero": (run_zero_soak, 8,
+             "a hard mid-step crash of ZeRO-3 sharded training + a "
+             "torn sharded checkpoint: the verified walk-back "
+             "restores and the replay lands byte-identical with an "
+             "uninterrupted run (docs/zero.md)"),
+    "pipeline": (run_pipeline_soak, 8,
+                 "a straggler on one pipeline stage + a hard "
+                 "mid-schedule crash of hybrid dp x pp 1F1B training "
+                 "(int8 activation wire) + a torn checkpoint: the "
+                 "verified walk-back restores and the per-step event "
+                 "log replays byte-identically (docs/pipeline.md)"),
+    "hybrid": (run_hybrid_soak, 6,
+               "a straggler on a tp peer + a hard host loss mid-1F1B "
+               "on the 2x2x2 dp x pp x tp world: the role-aware "
+               "engine convicts the straggler's HOST (not its "
+               "pipeline peers), the respec ladder re-solves the "
+               "mesh for the surviving capacity, sharded state "
+               "reshard-on-restores onto the new grid with no full "
+               "gather, and training finishes within the int8_ef "
+               "bound — decision log byte-identical under --repeat "
+               "(docs/elastic.md)"),
+}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--family", choices=("elastic", "integrity",
-                                         "autoscale", "stall", "moe",
-                                         "serve", "serve_disagg",
-                                         "zero", "pipeline",
-                                         "hybrid"),
+    ap.add_argument("--family", choices=tuple(FAMILIES),
                     default="elastic",
-                    help="elastic = process faults through the driver; "
-                         "integrity = data faults through the guard/"
-                         "detector/verified-checkpoint stack; "
-                         "autoscale = straggler/preempt-storm/flap "
-                         "faults through the telemetry-driven control "
-                         "plane (decision-log determinism); "
-                         "stall = a hung collective through the "
-                         "watchdog -> flight-recorder black box -> "
-                         "flight_diff attribution -> elastic retry "
-                         "path, with the pod aggregator scraped live "
-                         "(docs/podmon.md); "
-                         "moe = a hot-expert router skew + a mid-step "
-                         "crash through the MoE dispatch hot path: "
-                         "drop/load gauges must fire, the integrity "
-                         "guard must agree across ranks, and the "
-                         "relaunch must restore and finish "
-                         "(docs/moe.md); "
-                         "serve = a replica kill mid-stream through "
-                         "the hvd.serve cluster: graceful drain + "
-                         "queue/in-flight re-route with zero dropped "
-                         "requests, the SLO controller's kill -> grow "
-                         "decision sequence byte-deterministic "
-                         "(docs/serve.md); "
-                         "serve_disagg = a PREFILL-role replica kill "
-                         "mid-handoff on the disaggregated cluster "
-                         "(1 prefill + 2 decode pools, warm-KV wire): "
-                         "exported blobs survive, queued requests "
-                         "re-enter at arrival position, the restore "
-                         "grow names prefill:1, zero dropped requests "
-                         "(docs/serve.md); "
-                         "zero = a hard mid-step crash of ZeRO-3 "
-                         "sharded training + a torn sharded "
-                         "checkpoint: the verified walk-back restores "
-                         "and the replay lands byte-identical with an "
-                         "uninterrupted run (docs/zero.md); "
-                         "pipeline = a straggler on one pipeline "
-                         "stage + a hard mid-schedule crash of hybrid "
-                         "dp x pp 1F1B training (int8 activation "
-                         "wire) + a torn checkpoint: the verified "
-                         "walk-back restores and the per-step event "
-                         "log replays byte-identically "
-                         "(docs/pipeline.md); "
-                         "hybrid = a straggler on a tp peer + a hard "
-                         "host loss mid-1F1B on the 2x2x2 dp x pp x "
-                         "tp world: the role-aware engine convicts "
-                         "the straggler's HOST (not its pipeline "
-                         "peers), the respec ladder re-solves the "
-                         "mesh for the surviving capacity, sharded "
-                         "state reshard-on-restores onto the new "
-                         "grid with no full gather, and training "
-                         "finishes within the int8_ef bound — "
-                         "decision log byte-identical under --repeat "
-                         "(docs/elastic.md)")
+                    help="; ".join(f"{name} = {contract}"
+                                   for name, (_, _, contract)
+                                   in FAMILIES.items()))
     ap.add_argument("--steps", type=int, default=None,
-                    help="training steps (default: 12; family "
-                         "autoscale: 120, stall: 60 — their control "
-                         "loops need a seconds-scale run; family "
-                         "serve: 40 trace requests)")
+                    help="training steps / trace requests (default "
+                         "per family: "
+                         + ", ".join(f"{name}: {steps}"
+                                     for name, (_, steps, _)
+                                     in FAMILIES.items()) + ")")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--repeat", type=int, default=1,
                     help=">1: rerun the same seed and assert identical "
@@ -2323,20 +2190,9 @@ def main() -> int:
                     help="kept for inspection; default: fresh temp dirs")
     args = ap.parse_args()
 
-    soak = {"elastic": run_soak, "integrity": run_integrity_soak,
-            "autoscale": run_autoscale_soak,
-            "stall": run_stall_soak, "moe": run_moe_soak,
-            "serve": run_serve_soak,
-            "serve_disagg": run_serve_disagg_soak,
-            "zero": run_zero_soak,
-            "pipeline": run_pipeline_soak,
-            "hybrid": run_hybrid_soak}[args.family]
+    soak, default_steps, _ = FAMILIES[args.family]
     if args.steps is None:
-        args.steps = {"autoscale": 120, "stall": 60,
-                      "moe": 8, "serve": 40,
-                      "serve_disagg": 40,
-                      "zero": 8, "pipeline": 8,
-                      "hybrid": 6}.get(args.family, 12)
+        args.steps = default_steps
     records = []
     for i in range(max(1, args.repeat)):
         if args.workdir:

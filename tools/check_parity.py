@@ -1042,6 +1042,107 @@ def check_lint_surface(missing: list) -> None:
                        "full tree")
 
 
+def check_fleetsim_surface(missing: list) -> None:
+    """The fleet digital twin (ISSUE 17, docs/fleetsim.md): every
+    FleetScenario schema field and event kind in the doc's tables,
+    every builtin scenario documented AND banked in results/fleetsim/,
+    every CLI flag documented, the HVD_TPU_FLEETSIM_* knobs
+    cross-referenced, the sweep evidence behind the tuned
+    straggler_ratio default on disk, and chaos_soak actually riding
+    the sim core. Parsed textually (runs without jax installed)."""
+    doc = REPO / "docs" / "fleetsim.md"
+    if not doc.exists():
+        missing.append("path: docs/fleetsim.md")
+        return
+    text = doc.read_text()
+    sim_path = REPO / "horovod_tpu" / "common" / "fleetsim.py"
+    cli_path = REPO / "tools" / "fleetsim.py"
+    for p in (sim_path, cli_path):
+        if not p.exists():
+            missing.append(f"path: {p.relative_to(REPO)}")
+            return
+    sim_src = sim_path.read_text()
+    cli_src = cli_path.read_text()
+
+    # Scenario schema: every FleetScenario field has its backquoted
+    # row in the docs table (same contract as the SLOPolicy audit).
+    m = re.search(r"class FleetScenario:.*?\n    @classmethod",
+                  sim_src, re.S)
+    if m is None:
+        missing.append("fleetsim: FleetScenario dataclass not found")
+        return
+    fields = re.findall(r"^    (\w+): (?:str|bool|int|float|List|Dict)",
+                        m.group(0), re.M)
+    if len(fields) < 15:
+        missing.append(f"fleetsim: only {len(fields)} FleetScenario "
+                       "fields parsed")
+    for f in fields:
+        if f"`{f}`" not in text:
+            missing.append(f"fleetsim field {f}: missing from the "
+                           "docs/fleetsim.md schema table")
+
+    # Event kinds + builtin scenarios: documented and (for scenarios)
+    # banked as regression baselines.
+    kinds = re.findall(r'EVENT_KINDS = \(([^)]*)\)', sim_src)
+    for kind in re.findall(r'"([a-z_]+)"', kinds[0] if kinds else ""):
+        if f"`{kind}`" not in text:
+            missing.append(f"fleetsim event kind {kind}: undocumented")
+    lib = sim_src[sim_src.find("def builtin_scenarios"):]
+    scenarios = re.findall(r'name="([a-z0-9_]+)"', lib)
+    if len(scenarios) < 5:
+        missing.append(f"fleetsim: only {len(scenarios)} builtin "
+                       "scenarios found (expected >= 5)")
+    for s in scenarios:
+        if f"`{s}`" not in text:
+            missing.append(f"fleetsim scenario {s}: undocumented in "
+                           "docs/fleetsim.md")
+        if not (REPO / "results" / "fleetsim" / f"{s}.json").exists():
+            missing.append(f"fleetsim scenario {s}: no banked baseline "
+                           "in results/fleetsim/")
+
+    # CLI flags: every add_argument("--flag") documented.
+    for flag in re.findall(r'add_argument\("(--[a-z-]+)"', cli_src):
+        if flag not in text:
+            missing.append(f"fleetsim CLI flag {flag}: undocumented")
+
+    # Knobs: the registry's FLEETSIM_* entries spelled in the doc.
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    for k in re.findall(r'^    "(FLEETSIM_[A-Z0-9_]+)":', cfg_src, re.M):
+        if f"HVD_TPU_{k}" not in text:
+            missing.append(f"fleetsim knob HVD_TPU_{k}: undocumented "
+                           "in docs/fleetsim.md")
+
+    # The tuned-default evidence chain: sweep baseline on disk, cited
+    # by both the policy source and docs/autoscale.md.
+    sweep = REPO / "results" / "fleetsim" / "sweep_straggler_ratio.json"
+    if not sweep.exists():
+        missing.append("fleetsim: results/fleetsim/"
+                       "sweep_straggler_ratio.json evidence missing")
+    auto_doc = (REPO / "docs" / "autoscale.md").read_text() \
+        if (REPO / "docs" / "autoscale.md").exists() else ""
+    for where, blob in (("docs/autoscale.md", auto_doc),
+                        ("common/autoscale.py",
+                         (REPO / "horovod_tpu" / "common"
+                          / "autoscale.py").read_text())):
+        if "sweep_straggler_ratio" not in blob:
+            missing.append(f"fleetsim: {where} does not cite the "
+                           "straggler_ratio sweep evidence")
+
+    # The chaos families ride the sim core; the twin is discoverable
+    # from the front doors.
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    if "fleetsim" not in soak_src:
+        missing.append("fleetsim: tools/chaos_soak.py does not use the "
+                       "sim core")
+    for where, path in (("docs/api.md", REPO / "docs" / "api.md"),
+                        ("README.md", REPO / "README.md"),
+                        ("docs/serve.md", REPO / "docs" / "serve.md")):
+        if "fleetsim" not in (path.read_text() if path.exists() else ""):
+            missing.append(f"fleetsim: no cross-link in {where}")
+    if not (REPO / "tests" / "test_fleetsim.py").exists():
+        missing.append("path: tests/test_fleetsim.py")
+
+
 def main() -> int:
     text = DOC.read_text()
     missing = []
@@ -1090,6 +1191,7 @@ def main() -> int:
     check_pipeline_surface(missing)
     check_hybrid_elastic_surface(missing)
     check_lint_surface(missing)
+    check_fleetsim_surface(missing)
 
     if missing:
         print("parity.md has dangling references:")

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Fleet digital-twin CLI (docs/fleetsim.md): run banked scenarios,
+bank/check their byte-identical decision-log baselines, replay real
+telemetry, and grid-search policy parameters.
+
+Every run prints one JSON record (the same shape the baselines bank):
+scenario identity + seed, the full decision log, injection count, and
+coarse stats. Determinism is the contract — ``--repeat K`` asserts K
+runs produce byte-identical records, and ``--check`` diffs against
+``results/fleetsim/<scenario>.json`` exactly.
+
+    python tools/fleetsim.py --list
+    python tools/fleetsim.py --scenario preempt_storm_4k --repeat 2
+    python tools/fleetsim.py --bank                  # re-bank all
+    python tools/fleetsim.py --check                 # regression gate
+    python tools/fleetsim.py --scenario-file my_world.json
+    python tools/fleetsim.py --replay-podmetrics dump.jsonl \\
+        --replay-flightrec results/flightrec --name incident_0412
+    python tools/fleetsim.py --sweep straggler_ratio=1.3,1.5,1.75,2.5
+
+The sweep harness scores each candidate value on two probe worlds: a
+QUIET heterogeneous fleet (honest 2x SKU step-time spread, no fault —
+every conviction is a false positive) and a SUBTLE straggler (one host
+~1.6x degraded — a miss is a detection failure). The tuned
+``AutoscalePolicy.straggler_ratio`` default shipped in PR 17 carries
+this table plus the before/after decision-log diff as evidence
+(``results/fleetsim/sweep_straggler_ratio.json``).
+
+Knobs: ``HVD_TPU_FLEETSIM_BASELINE_DIR`` (default
+``results/fleetsim``), ``HVD_TPU_FLEETSIM_SEED`` (default seed
+override), ``HVD_TPU_FLEETSIM_TICK_CAP`` (runaway guard).
+"""
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.common import fleetsim  # noqa: E402
+from horovod_tpu.common.config import runtime_env  # noqa: E402
+
+DEFAULT_BASELINE_DIR = os.path.join("results", "fleetsim")
+
+
+def baseline_dir(override=None) -> str:
+    return (override or runtime_env("FLEETSIM_BASELINE_DIR")
+            or DEFAULT_BASELINE_DIR)
+
+
+def baseline_path(name: str, override=None) -> str:
+    return os.path.join(baseline_dir(override), f"{name}.json")
+
+
+def run_repeated(scenario, seed, repeat: int) -> dict:
+    """Run the scenario ``repeat`` times and assert byte-identical
+    records — the determinism contract, mechanically."""
+    records = [fleetsim.run_scenario(copy.deepcopy(scenario), seed=seed)
+               for _ in range(max(1, repeat))]
+    first = json.dumps(records[0], sort_keys=True)
+    for i, rec in enumerate(records[1:], start=1):
+        got = json.dumps(rec, sort_keys=True)
+        assert got == first, (
+            f"fleetsim: run {i} diverged from run 0 — the virtual-time "
+            f"twin must be byte-deterministic\nrun0: {first}\n"
+            f"run{i}: {got}")
+    rec = records[0]
+    rec["repeats"] = len(records)
+    return rec
+
+
+def check_baseline(rec: dict, path: str) -> None:
+    """Exact-match regression check against the banked record
+    (``repeats`` is run metadata, not banked state)."""
+    with open(path) as f:
+        banked = json.load(f)
+    got = {k: v for k, v in rec.items() if k != "repeats"}
+    banked = {k: v for k, v in banked.items() if k != "repeats"}
+    if got != banked:
+        for k in sorted(set(got) | set(banked)):
+            if got.get(k) != banked.get(k):
+                print(f"fleetsim: MISMATCH field {k!r}:\n"
+                      f"  banked: {json.dumps(banked.get(k))}\n"
+                      f"  got:    {json.dumps(got.get(k))}",
+                      file=sys.stderr)
+        raise SystemExit(
+            f"fleetsim: {rec['scenario']} diverged from banked "
+            f"baseline {path}")
+    print(f"fleetsim: {rec['scenario']} matches {path}",
+          file=sys.stderr)
+
+
+def bank_baseline(rec: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    banked = {k: v for k, v in rec.items() if k != "repeats"}
+    with open(path, "w") as f:
+        json.dump(banked, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"fleetsim: banked {path}", file=sys.stderr)
+
+
+# -- the policy sweep ---------------------------------------------------------
+
+def sweep_probes() -> dict:
+    """The two probe worlds every AutoscalePolicy sweep scores
+    against. Quiet: 32 hosts with an honest 2x SKU step-time spread
+    (mixed preemptible fleet) and NO fault — any conviction is a false
+    positive. Subtle: a uniform fleet with one host persistently
+    ~1.6x slow — a ratio that never convicts it is blind to real
+    degradation."""
+    base_pol = {
+        "tick_interval_s": 0.25, "publish_interval_s": 0.0,
+        "window": 8, "straggler_patience": 2, "min_ranks": 3,
+        "evict_ttl_s": 60.0, "evict_cooldown_s": 0.5,
+        "grow_cooldown_s": 0.5,
+    }
+    quiet = fleetsim.FleetScenario(
+        name="sweep_quiet", hosts=32, hosts_per_rack=8, min_np=4,
+        duration_s=15.0, policy=dict(base_pol),
+        base_by_host={fleetsim.host_name(i): 0.1 + (i % 8) * 0.0143
+                      for i in range(32)})
+    subtle = fleetsim.FleetScenario(
+        name="sweep_subtle", hosts=32, hosts_per_rack=8, min_np=4,
+        duration_s=15.0, policy=dict(base_pol),
+        events=[{"kind": "slow_burn", "t": 1.0, "host": "h0007",
+                 "delay_s": 0.06, "ramp_s": 0.0}])
+    return {"quiet": quiet, "subtle": subtle}
+
+
+def run_sweep(field: str, values, seed=None) -> dict:
+    """Grid-search one AutoscalePolicy field over the probe worlds.
+    Returns the evidence record: per-value decision logs + the
+    false-positive / detection verdicts."""
+    probes = sweep_probes()
+    rows = []
+    for value in values:
+        row = {"value": value, "probes": {}}
+        for pname, scn in probes.items():
+            s = copy.deepcopy(scn)
+            s.policy[field] = value
+            rec = fleetsim.run_scenario(s, seed=seed)
+            evicts = [json.loads(l) for l in rec["decisions"]]
+            evicts = [d for d in evicts if d["action"] == "evict"]
+            row["probes"][pname] = {
+                "decisions": rec["decisions"],
+                "evicted": sorted({d["target"] for d in evicts}),
+            }
+        quiet_e = row["probes"]["quiet"]["evicted"]
+        subtle_e = row["probes"]["subtle"]["evicted"]
+        row["false_convictions"] = quiet_e
+        row["caught_subtle"] = "h0007" in subtle_e
+        row["clean"] = not quiet_e and subtle_e == ["h0007"]
+        rows.append(row)
+    return {"metric": "fleetsim_sweep", "field": field,
+            "values": list(values), "rows": rows}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--list", action="store_true",
+                    help="list builtin scenarios and exit")
+    ap.add_argument("--scenario", default=None,
+                    help="builtin scenario name (default: all, for "
+                         "--bank/--check)")
+    ap.add_argument("--scenario-file", default=None,
+                    help="run a FleetScenario JSON file instead of a "
+                         "builtin")
+    ap.add_argument("--seed", type=int,
+                    default=(int(runtime_env("FLEETSIM_SEED"))
+                             if runtime_env("FLEETSIM_SEED") else None),
+                    help="override the scenario seed")
+    ap.add_argument("--repeat", type=int, default=1,
+                    help=">1: rerun and assert byte-identical records")
+    ap.add_argument("--bank", action="store_true",
+                    help="write the record(s) as the banked baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="assert the record(s) match the banked "
+                         "baseline")
+    ap.add_argument("--baseline-dir", default=None,
+                    help=f"baseline directory (default "
+                         f"{DEFAULT_BASELINE_DIR}, or "
+                         f"HVD_TPU_FLEETSIM_BASELINE_DIR)")
+    ap.add_argument("--replay-podmetrics", default=None,
+                    help="/pod/metrics JSON-lines dump -> per-host "
+                         "step-time model")
+    ap.add_argument("--replay-flightrec", default=None,
+                    help="flight-recorder black-box dir -> fault plan")
+    ap.add_argument("--name", default="replay",
+                    help="scenario name for --replay-* runs")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="virtual seconds for --replay-* runs")
+    ap.add_argument("--sweep", default=None, metavar="FIELD=V1,V2,...",
+                    help="grid-search an AutoscalePolicy field over "
+                         "the probe worlds (e.g. "
+                         "straggler_ratio=1.3,1.5,1.75,2.5)")
+    args = ap.parse_args()
+
+    if args.list:
+        for name, scn in fleetsim.builtin_scenarios().items():
+            print(f"{name}: kind={scn.kind} hosts={scn.hosts} "
+                  f"duration_s={scn.duration_s}")
+        return 0
+
+    if args.sweep:
+        field, _, raw = args.sweep.partition("=")
+        if not raw:
+            ap.error("--sweep needs FIELD=V1,V2,...")
+        values = [float(v) for v in raw.split(",")]
+        record = run_sweep(field, values, seed=args.seed)
+        if args.bank:
+            bank_baseline(record, baseline_path(
+                f"sweep_{field}", args.baseline_dir))
+        print(json.dumps(record))
+        return 0
+
+    if args.replay_podmetrics or args.replay_flightrec:
+        scn = fleetsim.scenario_from_traces(
+            args.name, podmetrics=args.replay_podmetrics,
+            flightrec=args.replay_flightrec,
+            duration_s=args.duration,
+            policy={"tick_interval_s": 0.25,
+                    "publish_interval_s": 0.0})
+        rec = run_repeated(scn, args.seed, args.repeat)
+        print(json.dumps(rec))
+        return 0
+
+    if args.scenario_file:
+        with open(args.scenario_file) as f:
+            scenarios = [fleetsim.FleetScenario.from_dict(json.load(f))]
+    elif args.scenario:
+        scenarios = [args.scenario]
+    else:
+        if not (args.bank or args.check):
+            ap.error("pick one of --scenario/--scenario-file/--list/"
+                     "--sweep/--replay-*, or --bank/--check for the "
+                     "whole library")
+        scenarios = list(fleetsim.builtin_scenarios())
+
+    records = []
+    for scn in scenarios:
+        rec = run_repeated(scn, args.seed, args.repeat)
+        name = rec["scenario"]
+        if args.bank:
+            bank_baseline(rec, baseline_path(name, args.baseline_dir))
+        if args.check:
+            check_baseline(rec, baseline_path(name, args.baseline_dir))
+        records.append(rec)
+    print(json.dumps(records if len(records) > 1 else records[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ from .knob_doc import KnobDocChecker
 from .lock_order import LockOrderChecker
 from .metric_names import MetricNameChecker
 from .signal_safety import AtexitOrderChecker, SignalSafetyChecker
+from .sim_clock import SimClockChecker
 from .ste_vjp import SteVjpChecker
 from .trace_purity import TracePurityChecker
 
@@ -20,6 +21,7 @@ CHECKERS = (
     MetricNameChecker,
     LockOrderChecker,
     KnobDocChecker,
+    SimClockChecker,
 )
 
 __all__ = ["CHECKERS"]

@@ -1,0 +1,104 @@
+"""Injectable-clock discipline (rule ``sim-clock``).
+
+PR 17's fleet digital twin (common/fleetsim.py, docs/fleetsim.md)
+drives the UNMODIFIED production engines — AutoscaleEngine,
+HostManager, ServeCluster, FaultInjector — on a single virtual clock,
+and banks their decision logs as byte-identical regression baselines.
+That contract dies silently the moment a sim-driven code path reads
+the wall clock directly: the run still "works", but timestamps (and
+anything branching on them) drift between repeats and the banked
+baseline rots into flake.
+
+The discipline is structural, not path-based: any class or function
+that ACCEPTS an injectable ``clock`` parameter has declared itself
+sim-drivable, so every wall-clock read inside it must route through
+that clock. This pass flags direct ``time.time()`` /
+``time.monotonic()`` / ``time.perf_counter()`` calls inside
+
+* any method of a class whose ``__init__`` takes a ``clock``
+  parameter, and
+* any function whose own signature takes a ``clock`` parameter.
+
+Storing the default (``self._clock = clock if clock is not None else
+time.monotonic``) is fine — that is a reference, not a read — and code
+that never participates in clock injection is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
+
+from .. import astutil
+from ..core import Checker, FileContext, Violation
+
+_WALL_CALLS = ("time.time", "time.monotonic", "time.perf_counter")
+
+
+def _takes_clock(fn: ast.AST) -> bool:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return False
+    names = [a.arg for a in args.posonlyargs + args.args
+             + args.kwonlyargs]
+    return "clock" in names
+
+
+def _wall_calls(body: List[ast.stmt]) -> Iterator[Tuple[ast.Call, str]]:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in _WALL_CALLS:
+                    yield node, name
+
+
+class SimClockChecker(Checker):
+    rule = "sim-clock"
+    description = ("direct wall-clock read inside a class/function "
+                   "that takes an injectable clock (breaks "
+                   "virtual-time determinism)")
+    historical = ("PR 17: StepPublisher stamped reports with "
+                  "time.time() beside its injected monotonic clock — "
+                  "harmless live, but the first thing to diverge "
+                  "between fleetsim repeats")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        # Classes that declared clock injection in __init__: every
+        # method body (including __init__'s own statements) is in
+        # scope. Bodies only — nested defaults like
+        # `clock=time.monotonic` are references, not reads.
+        flagged_fns: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            init = next(
+                (f for f in node.body
+                 if isinstance(f, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))
+                 and f.name == "__init__"), None)
+            if init is None or not _takes_clock(init):
+                continue
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                flagged_fns.add(id(fn))
+                for call, name in _wall_calls(fn.body):
+                    yield ctx.violation(
+                        self.rule, call,
+                        f"{node.name}.{fn.name} calls {name}() "
+                        f"directly but {node.name} takes an "
+                        f"injectable clock — route the read through "
+                        f"it (sim-clock discipline, docs/fleetsim.md)")
+        # Functions (incl. methods of non-participating classes) whose
+        # OWN signature takes a clock.
+        for qual, fn in astutil.walk_functions(ctx.tree):
+            if id(fn) in flagged_fns or not _takes_clock(fn):
+                continue
+            for call, name in _wall_calls(fn.body):
+                yield ctx.violation(
+                    self.rule, call,
+                    f"{qual} calls {name}() directly but takes an "
+                    f"injectable clock — route the read through it "
+                    f"(sim-clock discipline, docs/fleetsim.md)")

@@ -1,0 +1,29 @@
+"""Fixture: wall-clock reads inside clock-injectable code (sim-clock).
+
+Both shapes the rule covers: a class that takes ``clock`` in
+``__init__`` but reads the wall clock in a method, and a bare function
+that takes ``clock`` but stamps with ``time.time()`` anyway.
+"""
+
+import time
+
+
+class Publisher:
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        self._window = []
+
+    def note(self):
+        # BAD: the injected clock exists, but the interval uses the
+        # wall clock — repeats diverge under a virtual-time harness.
+        self._window.append(time.monotonic())
+
+    def build_report(self):
+        # BAD: report timestamp bypasses the injected clock.
+        return {"t": time.time(), "n": len(self._window)}
+
+
+def tick_once(state, clock=time.monotonic):
+    # BAD: the deadline math ignores the clock parameter.
+    state["deadline"] = time.perf_counter() + 5.0
+    return clock

@@ -1,0 +1,28 @@
+"""Fixture: clock-injectable code that routes every read through the
+injected clock (sim-clock clean)."""
+
+import time
+
+
+class Publisher:
+    def __init__(self, clock=None):
+        # Storing the DEFAULT is a reference, not a read — allowed.
+        self._clock = clock if clock is not None else time.monotonic
+        self._window = []
+
+    def note(self):
+        self._window.append(self._clock())
+
+    def build_report(self):
+        return {"t": self._clock(), "n": len(self._window)}
+
+
+def tick_once(state, clock=time.monotonic):
+    state["deadline"] = clock() + 5.0
+    return clock
+
+
+def wall_elapsed(t0):
+    # No clock parameter: this function never declared itself
+    # sim-drivable, so a wall read here is out of scope.
+    return time.monotonic() - t0
