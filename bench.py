@@ -313,6 +313,35 @@ def main():
                         "format for the pipeline schedule (int8 = "
                         "block-scaled with straight-through VJP); "
                         "empty consults HVD_TPU_PP_WIRE")
+    p.add_argument("--seq-parallel", type=int, default=0,
+                   help="sequence-parallel width for the gpt_* models "
+                        "(docs/sequence.md): the context is sharded "
+                        "over an sp mesh axis (per-rank activation "
+                        "bytes shrink ~linearly with the width) and "
+                        "attention exchanges K/V over wired ring hops "
+                        "or Ulysses head-scatter alltoalls; 0 consults "
+                        "HVD_TPU_SEQ_PARALLEL (1 = off)")
+    p.add_argument("--seq-impl", default="",
+                   choices=["", "ring", "ulysses"],
+                   help="attention exchange for --seq-parallel: ring = "
+                        "striped causal ring over wired ppermute K/V "
+                        "hops, ulysses = head-scatter alltoall (needs "
+                        "heads %% sp == 0); empty consults "
+                        "HVD_TPU_SEQ_IMPL (default ring)")
+    p.add_argument("--seq-wire", default="",
+                   choices=["", "none", "bf16", "int8"],
+                   help="sp-axis exchange wire format for "
+                        "--seq-parallel (int8 = block-scaled with "
+                        "straight-through VJP, ~4x fewer K/V bytes; "
+                        "hvd_tpu_seq_kv_bytes_total records the mix); "
+                        "empty consults HVD_TPU_SEQ_WIRE")
+    p.add_argument("--ep", type=int, default=0,
+                   help="expert-parallel width for the --moe arm under "
+                        "--pipeline-stages (docs/moe.md): the expert "
+                        "bank dispatches over a dedicated ep mesh axis "
+                        "INSIDE each pipeline stage (pp x ep on one "
+                        "mesh); 0 = no ep axis (flat --moe dispatches "
+                        "over the whole rank axis)")
     p.add_argument("--zero-stage", default="auto",
                    choices=["auto", "0", "1", "2", "3"],
                    help="ZeRO stage for the optimizer (docs/zero.md): "
@@ -399,6 +428,8 @@ def main():
         p.error("--accum must be >= 1")
     if args.moe and not args.model.startswith("gpt"):
         p.error("--moe requires a gpt_* model")
+    if args.ep > 1 and not args.moe:
+        p.error("--ep is the --moe expert-bank mesh axis; pass --moe")
     if args.moe:
         try:
             _parse_moe_spec(args.moe)
@@ -437,12 +468,17 @@ def main():
     pp_req = args.pipeline_stages \
         or int(runtime_env("PP_STAGES", "1") or 1)
     tp_req = args.tp or int(runtime_env("TP", "1") or 1)
-    if (pp_req > 1 or tp_req > 1) and args._platform == "cpu":
-        # Hybrid pp/tp arm on the CPU fallback (flags or the
-        # HVD_TPU_PP_STAGES/HVD_TPU_TP knobs): force enough virtual
-        # devices that dp x pp x tp factors the world — the test
-        # tier's 8 when pp*tp fits, else exactly pp*tp (dp=1).
-        per = max(pp_req, 1) * max(tp_req, 1)
+    sp_req = args.seq_parallel \
+        or int(runtime_env("SEQ_PARALLEL", "1") or 1)
+    ep_req = args.ep if args.moe else 0
+    per = max(pp_req, 1) * max(tp_req, 1) * max(sp_req, 1) \
+        * max(ep_req, 1)
+    if per > 1 and args._platform == "cpu":
+        # Hybrid pp/tp/sp/ep arm on the CPU fallback (flags or the
+        # HVD_TPU_PP_STAGES/HVD_TPU_TP/HVD_TPU_SEQ_PARALLEL knobs):
+        # force enough virtual devices that dp x pp x ep x sp x tp
+        # factors the world — the test tier's 8 when the block fits,
+        # else exactly the block (dp=1).
         os.environ.setdefault("HVD_TPU_FORCE_CPU_DEVICES",
                               str(per * max(1, 8 // per)))
 
@@ -632,13 +668,15 @@ def _route_kwargs(rt):
 
 
 def _parallel_config(args, n):
-    """--pipeline-stages/--tp hybrid-mesh config (docs/pipeline.md):
-    {"spec", "mesh", "dp", "pp", "tp", "wire"} or None (flat arm).
-    Flags win; unset flags consult the HVD_TPU_PP_STAGES / HVD_TPU_TP /
-    HVD_TPU_PP_WIRE config knobs. A shape that does not factor the
-    live device count (or a non-gpt model) falls back to the flat arm
-    with a log line rather than failing the run. Memoized on the args
-    namespace — consulted by the model setup AND the JSON record."""
+    """--pipeline-stages/--tp/--seq-parallel/--ep hybrid-mesh config
+    (docs/pipeline.md, docs/sequence.md): {"spec", "mesh", "dp", "pp",
+    "tp", "sp", "ep", "wire", "seq_impl", "seq_wire"} or None (flat
+    arm). Flags win; unset flags consult the HVD_TPU_PP_STAGES /
+    HVD_TPU_TP / HVD_TPU_SEQ_* / HVD_TPU_PP_WIRE config knobs. A shape
+    that does not factor the live device count (or a non-gpt model)
+    falls back to the flat arm with a log line rather than failing the
+    run. Memoized on the args namespace — consulted by the model setup
+    AND the JSON record."""
     cached = getattr(args, "_parallel_cfg", "unset")
     if cached != "unset":
         return cached
@@ -647,11 +685,17 @@ def _parallel_config(args, n):
     cfg = basics.context().config if basics.is_initialized() else None
     pp = args.pipeline_stages or (cfg.pp_stages if cfg else 1)
     tp = args.tp or (cfg.tp if cfg else 1)
+    sp = args.seq_parallel or (cfg.seq_parallel if cfg else 1)
+    ep = (args.ep or 1) if args.moe else 1
     wire = args.pp_wire or (cfg.pp_wire if cfg else None) or "none"
-    if pp <= 1 and tp <= 1:
+    seq_impl = args.seq_impl or (cfg.seq_impl if cfg else None) \
+        or "ring"
+    seq_wire = args.seq_wire or (cfg.seq_wire if cfg else None) \
+        or "none"
+    if pp <= 1 and tp <= 1 and sp <= 1 and ep <= 1:
         args._parallel_cfg = None
         return None
-    layers = None
+    layers, heads = None, None
     if args.model.startswith("gpt"):
         from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
 
@@ -661,35 +705,52 @@ def _parallel_config(args, n):
             # Module construction is a dataclass build (no params) —
             # the geometry stays single-sourced in models/gpt.py.
             layers = factory().num_layers
+            heads = factory().num_heads
+    block = max(pp, 1) * max(tp, 1) * max(sp, 1) * max(ep, 1)
     why = None
     if not args.model.startswith("gpt"):
-        why = "hybrid pp/tp arms are wired for the gpt_* models"
-    elif n % max(pp, 1) or (n // pp) % max(tp, 1):
-        why = (f"pp={pp} x tp={tp} does not factor the {n}-device "
-               "world")
+        why = "hybrid pp/tp/sp/ep arms are wired for the gpt_* models"
+    elif n % block:
+        why = (f"pp={pp} x tp={tp} x sp={sp} x ep={ep} does not "
+               f"factor the {n}-device world")
     elif layers is not None and pp > 1 and layers % pp:
         why = (f"{args.model}'s {layers} decoder layers do not divide "
                f"into pp={pp} stages")
+    elif sp > 1 and args.seq_len % sp:
+        why = (f"seq_len {args.seq_len} does not divide over sp={sp} "
+               "sequence shards")
+    elif sp > 1 and seq_impl == "ulysses" and heads is not None \
+            and heads % sp:
+        why = (f"{args.model}'s {heads} heads do not scatter over "
+               f"sp={sp} (ulysses needs heads %% sp == 0; ring has no "
+               "head constraint — docs/sequence.md)")
     elif args.mesh_shape:
-        why = ("--mesh-shape routing and --pipeline-stages/--tp are "
-               "separate arms (the hybrid mesh carries its own dp "
+        why = ("--mesh-shape routing and the hybrid parallel flags "
+               "are separate arms (the hybrid mesh carries its own dp "
                "route)")
     if why is not None:
-        _log(f"--pipeline-stages/--tp ignored: {why}; using the flat "
-             "arm")
+        _log(f"--pipeline-stages/--tp/--seq-parallel/--ep ignored: "
+             f"{why}; using the flat arm")
         args._parallel_cfg = None
         return None
     from horovod_tpu.parallel.spec import ParallelSpec
 
-    dims = {"dp": n // (pp * tp)}
+    # Slow -> fast placement (parallel/mesh.AXIS_ORDER): dp outermost,
+    # then pp / ep, with sp and tp innermost on the fastest links.
+    dims = {"dp": n // block}
     if pp > 1:
         dims["pp"] = pp
+    if ep > 1:
+        dims["ep"] = ep
+    if sp > 1:
+        dims["sp"] = sp
     if tp > 1:
         dims["tp"] = tp
     spec = ParallelSpec.resolve(dims)
     args._parallel_cfg = {
         "spec": spec, "mesh": spec.mesh(), "dp": dims["dp"], "pp": pp,
-        "tp": tp, "wire": wire}
+        "tp": tp, "sp": sp, "ep": ep, "wire": wire,
+        "seq_impl": seq_impl, "seq_wire": seq_wire}
     return args._parallel_cfg
 
 
@@ -1205,6 +1266,19 @@ def _run_benchmark_inner(args, n):
                if is_gpt else None),
         "pp_wire": ((_parallel_config(args, n) or {}).get("wire")
                     if is_gpt else None),
+        # Sequence-parallel arm (docs/sequence.md): the sp width plus
+        # the exchange impl/wire, so hvd_tpu_seq_kv_bytes_total and
+        # the memory block's activation accounting are self-describing.
+        "seq_parallel": ((_parallel_config(args, n) or {}).get("sp")
+                         if is_gpt else None),
+        "seq_impl": ((_parallel_config(args, n) or {}).get("seq_impl")
+                     if is_gpt and ((_parallel_config(args, n) or {})
+                                    .get("sp") or 1) > 1 else None),
+        "seq_wire": ((_parallel_config(args, n) or {}).get("seq_wire")
+                     if is_gpt and ((_parallel_config(args, n) or {})
+                                    .get("sp") or 1) > 1 else None),
+        "ep": ((_parallel_config(args, n) or {}).get("ep")
+               if is_gpt else None),
     }
     if _ARM.get("memory"):
         # Sharding-derived per-rank state bytes (docs/zero.md): the
@@ -1221,26 +1295,42 @@ def _run_benchmark_inner(args, n):
         # gauges host-side and record the arm's health numbers the
         # acceptance criteria read (drop-rate, load balance, dispatch
         # bytes by wire from the alltoall byte family).
-        from horovod_tpu.parallel import moe as moe_lib
-
         vec = np.asarray(jax.device_get(l)).reshape(-1)
         e = moe_cfg["experts"]
-        load = vec[4:4 + e]
-        rec = moe_lib.record_moe_stats(
-            {"dropped_tokens": vec[1], "dropped_frac": vec[2],
-             "expert_load": load})
-        result["moe"] = {
-            "experts": e,
-            "capacity_factor": moe_cfg["capacity_factor"],
-            "wire": moe_cfg["wire"],
-            "route": moe_cfg["route"],
-            "overlap_chunks": moe_cfg["overlap_chunks"],
-            "router_noise": moe_cfg["router_noise"],
-            "final_loss": round(float(vec[0]), 4),
-            "dropped_frac": round(rec["dropped_frac"], 6),
-            "load_max_over_mean": round(
-                float(load.max() / max(load.mean(), 1e-9)), 3),
-        }
+        if vec.size >= 4 + e:
+            from horovod_tpu.parallel import moe as moe_lib
+
+            load = vec[4:4 + e]
+            rec = moe_lib.record_moe_stats(
+                {"dropped_tokens": vec[1], "dropped_frac": vec[2],
+                 "expert_load": load})
+            result["moe"] = {
+                "experts": e,
+                "capacity_factor": moe_cfg["capacity_factor"],
+                "wire": moe_cfg["wire"],
+                "route": moe_cfg["route"],
+                "overlap_chunks": moe_cfg["overlap_chunks"],
+                "router_noise": moe_cfg["router_noise"],
+                "final_loss": round(float(vec[0]), 4),
+                "dropped_frac": round(rec["dropped_frac"], 6),
+                "load_max_over_mean": round(
+                    float(load.max() / max(load.mean(), 1e-9)), 3),
+            }
+        else:
+            # pp x ep arm (docs/moe.md): the 1F1B step carries a
+            # scalar loss (the in-layer stats vector does not ride
+            # the pipeline); the dispatch-byte mix still lands in
+            # metrics.alltoall_bytes_by_axis under axis="ep".
+            result["moe"] = {
+                "experts": e,
+                "capacity_factor": moe_cfg["capacity_factor"],
+                "wire": moe_cfg["wire"],
+                "route": moe_cfg["route"],
+                "overlap_chunks": moe_cfg["overlap_chunks"],
+                "router_noise": 0.0,
+                "final_loss": round(float(vec[0]), 4),
+                "stats": "in_layer_stats_not_carried_under_pipeline",
+            }
     if args.prefetch:
         # Infeed-wait delta over the TIMED window only (warmup waits
         # excluded): how long the step loop blocked on the next device
@@ -1425,6 +1515,22 @@ def _metrics_summary():
     if a2a_wire:
         out["alltoall_bytes_on_wire"] = a2a_wire
         out["alltoall_bytes_by_axis"] = a2a_axis
+    # Sequence-parallel K/V exchange bytes (docs/sequence.md): ring
+    # hops / Ulysses head-scatter stamped at trace time by wire and
+    # axis — the --seq-wire A/B's acceptance evidence (int8 must
+    # strictly cut the sp-axis bytes vs the fp32 run).
+    seq_wire_b, seq_axis_b = {}, {}
+    for s in samples("hvd_tpu_seq_kv_bytes_total"):
+        if not s["value"]:
+            continue
+        w = s["labels"].get("wire", "?")
+        ax = s["labels"].get("axis", "sp")
+        seq_wire_b[w] = seq_wire_b.get(w, 0) + s["value"]
+        seq_axis_b.setdefault(ax, {})
+        seq_axis_b[ax][w] = seq_axis_b[ax].get(w, 0) + s["value"]
+    if seq_wire_b:
+        out["seq_kv_bytes_on_wire"] = seq_wire_b
+        out["seq_kv_bytes_by_axis"] = seq_axis_b
     # Pipeline stage-boundary sends (docs/pipeline.md): trace-time
     # planned bytes (ticks x payload) by wire and axis — activation
     # bytes must land ONLY on the pp axis; the per-axis split next to
@@ -2033,14 +2139,20 @@ def _wrap_pp_spec(s, pp_axis="pp"):
 
 
 def _setup_gpt_hybrid(args, batch_size, n, par):
-    """The hybrid dp x pp (x tp) GPT arm (docs/pipeline.md): decoder
-    layers stage-stacked over the pp axis and trained under the
-    scan-based 1F1B schedule (pipeline_accumulate_gradients), heads/MLP
-    sharded over tp inside each stage, gradients reduced over dp ONLY
-    via DistributedOptimizer(parallel=spec) — or ZeRO stage-3 shards
-    PER PIPELINE STAGE under --zero-stage 3. The BENCH record's
-    ``memory`` block is computed from the per-rank resident tree (this
-    rank's stage + the shared embedding/head)."""
+    """The hybrid dp x pp (x ep x sp x tp) GPT arm (docs/pipeline.md,
+    docs/sequence.md): decoder layers stage-stacked over the pp axis
+    and trained under the scan-based 1F1B schedule
+    (pipeline_accumulate_gradients), heads/MLP sharded over tp inside
+    each stage, the context sharded over sp (ring/Ulysses attention —
+    the layers resolve their own global RoPE positions, so sp runs
+    INSIDE a stage), the --moe expert bank dispatching over ep, and
+    gradients reduced over dp ONLY via
+    DistributedOptimizer(parallel=spec) — or ZeRO stage-3 shards PER
+    PIPELINE STAGE under --zero-stage 3. The BENCH record's ``memory``
+    block is computed from the per-rank resident tree (this rank's
+    stage + the shared embedding/head); under sp it also carries the
+    per-rank vs dense activation accounting (the long-context
+    acceptance number)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -2048,7 +2160,8 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
     import horovod_tpu as hvd
     from jax.sharding import PartitionSpec as P
     from horovod_tpu.models import gpt_medium, gpt_small, gpt_tiny
-    from horovod_tpu.models.gpt import (param_bytes, pipeline_fns,
+    from horovod_tpu.models.gpt import (activation_bytes, param_bytes,
+                                        pipeline_fns,
                                         stack_stage_params)
     from horovod_tpu.parallel.pipeline import (
         pipeline_accumulate_gradients)
@@ -2057,21 +2170,66 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
 
     spec, mesh = par["spec"], par["mesh"]
     pp, tp, dp = par["pp"], par["tp"], par["dp"]
+    sp, ep = par.get("sp", 1), par.get("ep", 1)
     mkw = {"remat": args.remat}
     if tp > 1:
         mkw["tp_axis"] = "tp"
+    if sp > 1:
+        mkw.update(seq_parallel="sp", seq_impl=par["seq_impl"],
+                   seq_wire=par["seq_wire"])
+    # pp x ep (docs/moe.md): the expert bank lives INSIDE each
+    # pipeline stage and dispatches over its own ep axis. Router noise
+    # is forced off — the 1F1B closures recompute deterministically
+    # and carry no rng stream.
+    moe = _moe_config(args, ep) if ep > 1 else None
+    if moe:
+        if args.moe_router_noise:
+            _log("--moe-router-noise disabled on the pp x ep arm: the "
+                 "1F1B stage closures recompute deterministically and "
+                 "carry no gating rng (docs/pipeline.md)")
+        mkw.update(moe_experts=moe["experts"],
+                   moe_capacity_factor=moe["capacity_factor"],
+                   moe_axis="ep", moe_wire=moe["wire"],
+                   moe_overlap_chunks=moe["overlap_chunks"],
+                   moe_router_noise=0.0)
     model = {"gpt_small": gpt_small, "gpt_medium": gpt_medium,
              "gpt_tiny": gpt_tiny}[args.model](**mkw)
     rng = jax.random.PRNGKey(0)
     S = args.seq_len
     tokens = jax.random.randint(rng, (batch_size, S + 1), 0,
                                 model.vocab_size)
-    # Init through the replicated clone: the tp param tree is
-    # byte-compatible with the dense one (_DenseMaster), so one init
-    # serves both.
-    params = jax.jit(model.clone(tp_axis=None).init)(
+    # Init through the replicated clone (no bound tp/sp/ep axes at
+    # init time): the tp/sp param tree is byte-compatible with the
+    # dense one (_DenseMaster; sp ranks hold the SAME replicated
+    # params), so one init serves every twin.
+    params = jax.jit(model.clone(tp_axis=None, seq_parallel=None,
+                                 moe_axis=None).init)(
         rng, tokens[:, :-1])["params"]
     _log("model.init done")
+
+    s_local = S // sp if sp > 1 else S
+
+    def _sp_slice(toks):
+        """This rank's sequence shard of the (B, S+1) token slab, in
+        the layout the seq impl expects — striped for ring (balanced
+        causal: local j holds global j*sp + rank), contiguous for
+        ulysses — with the matching next-token targets. sp=1 is the
+        plain full-sequence split."""
+        if sp <= 1:
+            return toks[:, :-1], toks[:, 1:]
+        i = jax.lax.axis_index("sp")
+        if par["seq_impl"] == "ring":
+            gpos = jnp.arange(s_local) * sp + i
+        else:
+            gpos = i * s_local + jnp.arange(s_local)
+        return (jnp.take(toks, gpos, axis=1),
+                jnp.take(toks, gpos + 1, axis=1))
+
+    def _sp_mean(loss):
+        """Global loss: the per-rank CE means cover disjoint sequence
+        shards of the SAME samples, so the dp-pmean'd loss averages
+        once more over sp."""
+        return jax.lax.pmean(loss, "sp") if sp > 1 else loss
     stages, shared = stack_stage_params(params, pp)
     stage_fn, pre_fn, loss_fn = pipeline_fns(model)
     accum = max(args.accum, 1)
@@ -2105,23 +2263,37 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
     mem = _memory_block(per_rank, inner, zstage, dp, accum)
     mem["parallel"] = spec.describe()
     mem["full_model_params_bytes"] = param_bytes(params)
+    if sp > 1:
+        # The long-context acceptance numbers (docs/sequence.md):
+        # per-rank activation accounting at the LOCAL sequence length
+        # vs what one dense replica would hold at the full length —
+        # sp>=2 must show per_rank < 1/2 dense.
+        lb = max(batch_size // max(dp, 1), 1)
+        mem["activation"] = {
+            "seq_len": S, "sp": sp, "seq_impl": par["seq_impl"],
+            "seq_wire": par["seq_wire"],
+            "per_rank_bytes": activation_bytes(model, lb, s_local),
+            "dense_accounting_bytes": activation_bytes(model, lb, S),
+        }
     _ARM["sharded"] = zstage
     _ARM["memory"] = mem
 
     if pp <= 1:
-        # tp-only arm: no pipeline axis to bind — the tp model trains
-        # under the ordinary (optionally accumulated) step with the
-        # parallel optimizer combining slice grads over tp and
-        # reducing over dp.
+        # tp/sp/ep arm without a pipeline axis: the model trains under
+        # the ordinary (optionally accumulated) step with the parallel
+        # optimizer combining slice grads over tp AND sp (sp ranks
+        # hold identical params over different sequence shards —
+        # docs/sequence.md) and reducing over dp.
         tx = hvd.DistributedOptimizer(inner, parallel=spec,
                                       compression=args.compression,
                                       nonfinite_policy="off")
         opt = tx.init(params)
 
         def loss_of(p, tb):
-            logits = model.apply({"params": p}, tb[:, :-1])
+            x, y = _sp_slice(tb)
+            logits = model.apply({"params": p}, x)
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, tb[:, 1:]).mean()
+                logits, y).mean()
 
         def apply_loss(state, data, pmean_axis):
             p, op = state
@@ -2130,7 +2302,7 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
                 loss, g = tx.accumulate(loss_of)(p, toks)
             else:
                 loss, g = jax.value_and_grad(loss_of)(p, toks)
-            loss = jax.lax.pmean(loss, pmean_axis)
+            loss = _sp_mean(jax.lax.pmean(loss, pmean_axis))
             updates, op = tx.update(g, op, p)
             return optax.apply_updates(p, updates), op, loss
 
@@ -2161,8 +2333,9 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
             shd, op = state
             (toks,) = data
             full = tx.gather_params(shd)
-            loss, g = vg(full, toks[:, :-1], toks[:, 1:])
-            loss = jax.lax.pmean(loss, pmean_axis)
+            x, y = _sp_slice(toks)
+            loss, g = vg(full, x, y)
+            loss = _sp_mean(jax.lax.pmean(loss, pmean_axis))
             shd, op = tx.update(g, op, shd)
             return shd, op, loss
 
@@ -2181,8 +2354,9 @@ def _setup_gpt_hybrid(args, batch_size, n, par):
         st, sh, op = state
         (toks,) = data
         p = {"stages": st, "shared": sh}
-        loss, g = vg(p, toks[:, :-1], toks[:, 1:])
-        loss = jax.lax.pmean(loss, pmean_axis)
+        x, y = _sp_slice(toks)
+        loss, g = vg(p, x, y)
+        loss = _sp_mean(jax.lax.pmean(loss, pmean_axis))
         updates, op = tx.update(g, op, p)
         p = optax.apply_updates(p, updates)
         return p["stages"], p["shared"], op, loss

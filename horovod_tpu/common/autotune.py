@@ -67,12 +67,17 @@ _M_PP_WIRE_IDX = metrics_lib.gauge(
     "hvd_tpu_autotune_pp_wire_index",
     "current pipeline stage-boundary wire candidate index "
     "(see pp_wire_candidates order; 0 = none — docs/pipeline.md)")
+_M_SEQ_WIRE_IDX = metrics_lib.gauge(
+    "hvd_tpu_autotune_seq_wire_index",
+    "current sequence-parallel K/V exchange wire candidate index "
+    "(see seq_wire_candidates order; 0 = none — docs/sequence.md)")
 _M_CONVERGED = metrics_lib.gauge(
     "hvd_tpu_autotune_converged", "1 once the GP+EI search locked in")
 _M_SAMPLES = metrics_lib.counter(
     "hvd_tpu_autotune_samples_total",
     "scored samples per configuration (config = threshold|hierarchical"
-    "|overlap|compression|route|accum|remat|shard|moe_wire|pp_wire)",
+    "|overlap|compression|route|accum|remat|shard|moe_wire|pp_wire"
+    "|seq_wire)",
     labels=("config",))
 
 _MB = 1024 * 1024
@@ -101,6 +106,10 @@ class TunedPoint(NamedTuple):
     # Pipeline stage-boundary send wire ("none"/"bf16"/"int8" —
     # docs/pipeline.md); defaulted for the same compatibility reason.
     pp_wire: str = "none"
+    # Sequence-parallel K/V exchange wire ("none"/"bf16"/"int8" —
+    # ring hops and Ulysses head-scatter, docs/sequence.md); defaulted
+    # for the same compatibility reason.
+    seq_wire: str = "none"
 
 
 def _phase_bound_accum_gate() -> bool:
@@ -218,6 +227,9 @@ class Autotuner:
                  tune_pp_wire: bool = False,
                  pp_wire_candidates: Sequence[str] = (
                      "none", "bf16", "int8"),
+                 tune_seq_wire: bool = False,
+                 seq_wire_candidates: Sequence[str] = (
+                     "none", "bf16", "int8"),
                  accum_gate: Optional[Callable[[], bool]] = None):
         self.candidates = list(candidates_bytes)
         self.warmup = warmup_samples
@@ -289,6 +301,13 @@ class Autotuner:
         self.tune_pp_wire = tune_pp_wire
         self.pp_wire_candidates = (tuple(pp_wire_candidates)
                                    if tune_pp_wire else ("none",))
+        # The sequence-parallel exchange-wire axis (docs/sequence.md):
+        # which payload format the ring K/V hops / Ulysses head-scatter
+        # alltoalls carry. Same wire-bytes-vs-quantize-overhead trade
+        # again, on the sp axis (hvd_tpu_seq_kv_bytes_total).
+        self.tune_seq_wire = tune_seq_wire
+        self.seq_wire_candidates = (tuple(seq_wire_candidates)
+                                    if tune_seq_wire else ("none",))
         self.accum_gate = accum_gate
         self._accum_pruned = False
         hs = (0, 1) if tune_hierarchical else (0,)
@@ -300,11 +319,13 @@ class Autotuner:
         shs = tuple(range(len(self.shard_candidates)))
         mws = tuple(range(len(self.moe_wire_candidates)))
         pws = tuple(range(len(self.pp_wire_candidates)))
+        sws = tuple(range(len(self.seq_wire_candidates)))
         self._space: List[Tuple[int, ...]] = [
-            (t, h, o, c, rt, a, m, s, mw, pw) for t in self.candidates
+            (t, h, o, c, rt, a, m, s, mw, pw, sw)
+            for t in self.candidates
             for h in hs for o in ovs for c in cs for rt in rs
             for a in accs for m in rms for s in shs for mw in mws
-            for pw in pws]
+            for pw in pws for sw in sws]
         self._steps = 0
         self._warmed = 0
         self._bytes = 0.0
@@ -337,6 +358,8 @@ class Autotuner:
             cols.append("moe_wire")
         if tune_pp_wire:
             cols.append("pp_wire")
+        if tune_seq_wire:
+            cols.append("seq_wire")
         self._columns = tuple(cols)
         self._publish_metrics()
         if log_file:
@@ -429,8 +452,13 @@ class Autotuner:
             return self.pp_wire_candidates[self._cur[9]]
 
     @property
+    def current_seq_wire(self) -> str:
+        with self._tlock:
+            return self.seq_wire_candidates[self._cur[10]]
+
+    @property
     def current_full(self) -> TunedPoint:
-        """Atomic snapshot of the FULL tuned point (all 10 axes)."""
+        """Atomic snapshot of the FULL tuned point (all 11 axes)."""
         with self._tlock:
             return self._point_of(self._cur)
 
@@ -444,7 +472,8 @@ class Autotuner:
             remat=self.remat_candidates[cur[6]],
             shard=self.shard_candidates[cur[7]],
             moe_wire=self.moe_wire_candidates[cur[8]],
-            pp_wire=self.pp_wire_candidates[cur[9]])
+            pp_wire=self.pp_wire_candidates[cur[9]],
+            seq_wire=self.seq_wire_candidates[cur[10]])
 
     @property
     def done(self) -> bool:
@@ -514,7 +543,8 @@ class Autotuner:
                 f"|{self.accum_candidates[point[5]]}"
                 f"|{self.remat_candidates[point[6]]}|{int(point[7])}"
                 f"|{self.moe_wire_candidates[point[8]]}"
-                f"|{self.pp_wire_candidates[point[9]]}")
+                f"|{self.pp_wire_candidates[point[9]]}"
+                f"|{self.seq_wire_candidates[point[10]]}")
 
     def _publish_metrics(self) -> None:
         """Mirror the live point into the metrics registry (called with
@@ -529,6 +559,7 @@ class Autotuner:
         _M_SHARD.set(self.shard_candidates[self._cur[7]])
         _M_MOE_WIRE_IDX.set(self._cur[8])
         _M_PP_WIRE_IDX.set(self._cur[9])
+        _M_SEQ_WIRE_IDX.set(self._cur[10])
         _M_CONVERGED.set(1.0 if self._done else 0.0)
 
     def _row(self, point: Tuple[int, ...]) -> List:
@@ -554,6 +585,8 @@ class Autotuner:
             row.append(self.moe_wire_candidates[point[8]])
         if self.tune_pp_wire:
             row.append(self.pp_wire_candidates[point[9]])
+        if self.tune_seq_wire:
+            row.append(self.seq_wire_candidates[point[10]])
         return row
 
     def _log(self, point: Tuple[int, ...], score: float) -> None:
@@ -581,7 +614,7 @@ class Autotuner:
                 2.0 * point[3], 2.0 * point[4],
                 math.log2(max(self.accum_candidates[point[5]], 1)),
                 2.0 * point[6], 2.0 * point[7], 2.0 * point[8],
-                2.0 * point[9]]
+                2.0 * point[9], 2.0 * point[10]]
 
     def _maybe_prune_accum(self) -> None:
         """One-shot accumulation-space pruning, decided at the FIRST
@@ -673,7 +706,10 @@ class Autotuner:
                     + (", moe_wire=%s" % self.moe_wire_candidates[best[8]]
                        if self.tune_moe_wire else "")
                     + (", pp_wire=%s" % self.pp_wire_candidates[best[9]]
-                       if self.tune_pp_wire else ""),
+                       if self.tune_pp_wire else "")
+                    + (", seq_wire=%s"
+                       % self.seq_wire_candidates[best[10]]
+                       if self.tune_seq_wire else ""),
                     best[0] // _MB)
                 return best[0]
         self._cur = self._space[i]

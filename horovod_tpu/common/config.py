@@ -141,6 +141,15 @@ class Config:
     # / --tp consult these when the flags are unset; 1 = off).
     pp_stages: int = 1
     tp: int = 1
+    # Sequence parallelism (docs/sequence.md). `seq_wire` is the K/V
+    # exchange format for ring/Ulysses attention ("none" | "bf16" |
+    # "int8", block-scaled STE — parallel/ring_attention.py resolves
+    # it); `seq_parallel` is the tool default sp degree (bench
+    # --seq-parallel consults it when the flag is unset; 1 = off);
+    # `seq_impl` picks "ring" (striped causal ring) or "ulysses".
+    seq_wire: Optional[str] = None
+    seq_parallel: int = 1
+    seq_impl: str = "ring"
     # Adasum scalar precision (reference keeps fp64 scalars, adasum.h).
     adasum_scalar_dtype: str = "float32"
     # Compression for the wire format of eager collectives.
@@ -290,6 +299,9 @@ class Config:
         c.pp_wire = _env("PP_WIRE")
         c.pp_stages = _env_int("PP_STAGES", cls.pp_stages)
         c.tp = _env_int("TP", cls.tp)
+        c.seq_wire = _env("SEQ_WIRE")
+        c.seq_parallel = _env_int("SEQ_PARALLEL", cls.seq_parallel)
+        c.seq_impl = _env("SEQ_IMPL", cls.seq_impl) or cls.seq_impl
         c.adasum_scalar_dtype = _env(
             "ADASUM_SCALAR_DTYPE", cls.adasum_scalar_dtype) or "float32"
         c.compression_dtype = _env("COMPRESSION_DTYPE")
@@ -400,6 +412,10 @@ RUNTIME_KNOBS = {
     "FORCE_CPU_DEVICES": "virtual CPU mesh size (also a Config field)",
     "PP_STAGES": "pipeline stages for tools (also a Config field)",
     "TP": "tensor-parallel degree for tools (also a Config field)",
+    "SEQ_WIRE": "sequence K/V exchange wire (also a Config field)",
+    "SEQ_PARALLEL":
+        "sequence-parallel degree for tools (also a Config field)",
+    "SEQ_IMPL": "ring | ulysses attention impl (also a Config field)",
     "COMPILATION_CACHE_DIR":
         "persistent XLA cache dir (also a Config field)",
     "METRICS_PORT": "Prometheus endpoint port (also a Config field)",

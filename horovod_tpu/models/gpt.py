@@ -47,6 +47,43 @@ def _causal_attend(q, k, v, mask=None):
     return flash_attention(q, k, v, mask=mask, causal=True)
 
 
+# Sequence-parallel impl names (docs/sequence.md): "ring" = striped
+# causal ring attention (balanced blockwise ring over wired ppermute
+# hops; tokens must arrive in stripe_layout order), "ulysses" = head/
+# sequence alltoall scatter (contiguous shards; needs H % n == 0).
+SEQ_IMPLS = ("ring", "ulysses")
+
+
+def seq_attend_fn(seq_axis: str, seq_impl: str = "ring",
+                  seq_wire: Optional[str] = None) -> Callable:
+    """The causal attend_fn a sequence-parallel GPT runs: striped ring
+    attention or Ulysses head scatter over ``seq_axis``, K/V exchanges
+    in ``seq_wire`` (None -> ``HVD_TPU_SEQ_WIRE``)."""
+    if seq_impl == "ring":
+        from ..parallel.ring_attention import striped_attend_fn
+
+        return striped_attend_fn(seq_axis, wire=seq_wire)
+    if seq_impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attend_fn
+
+        return ulysses_attend_fn(seq_axis, inner=_causal_attend,
+                                 wire=seq_wire)
+    raise ValueError(
+        f"unknown seq_impl {seq_impl!r}; choose from {SEQ_IMPLS}")
+
+
+def seq_positions(seq_axis: str, seq_impl: str, s_local: int):
+    """(1, S_local) GLOBAL position ids of this rank's sequence shard —
+    stripe positions for the ring layout, contiguous block offsets for
+    Ulysses — fed to RoPE so rotary angles see global positions."""
+    if seq_impl == "ring":
+        from ..parallel.ring_attention import striped_positions
+
+        return striped_positions(s_local, seq_axis)[None, :]
+    return (jax.lax.axis_index(seq_axis) * s_local
+            + jnp.arange(s_local))[None, :]
+
+
 def _cache_attend(q, k_all, v_all, q_pos, k_pos):
     """Attention of ``s_in`` new queries over a ring-buffer KV cache
     (docs/serve.md): q (B, S_in, H, D) at global positions ``q_pos``
@@ -183,11 +220,23 @@ class CausalSelfAttention(nn.Module):
     # int8 block quantization operates head-vector-wise, so shards
     # quantize bit-identically to the unsharded cache.
     tp_axis: Optional[str] = None
+    # Sequence-parallel mesh axis (docs/sequence.md): activations are
+    # sequence-sharded over ``seq_axis``; attention runs striped-ring
+    # or Ulysses over the wired exchange, and RoPE positions resolve to
+    # this rank's GLOBAL shard positions in-module — so the layer
+    # composes inside a pipeline stage without the schedule having to
+    # thread positions. Params stay replicated over sp (slice grads
+    # pmean-combine in optim.py, same as tp).
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    seq_wire: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_ctx=None):
         b, s, h = x.shape
         head_dim = h // self.num_heads
+        if self.seq_axis and cache is None and positions is None:
+            positions = seq_positions(self.seq_axis, self.seq_impl, s)
         if self.tp_axis:
             from ..parallel import tensor_parallel as tp_lib
 
@@ -227,7 +276,7 @@ class CausalSelfAttention(nn.Module):
             q = rope(proj(0), positions)
             k = rope(proj(1), positions)
             v = proj(2)
-            attend = self.attend_fn or _causal_attend
+            attend = self.attend_fn or self._resolve_attend()
             o = attend(q, k, v).reshape(b, s, heads_l * head_dim)
             return tp_lib.row_parallel(o, w_loc.astype(self.dtype),
                                        self.tp_axis,
@@ -257,10 +306,16 @@ class CausalSelfAttention(nn.Module):
         q = rope(q.reshape(b, s, self.num_heads, head_dim), positions)
         k = rope(k.reshape(b, s, self.num_heads, head_dim), positions)
         v = v.reshape(b, s, self.num_heads, head_dim)
-        attend = self.attend_fn or _causal_attend
+        attend = self.attend_fn or self._resolve_attend()
         o = attend(q, k, v).reshape(b, s, h)
         return nn.Dense(h, dtype=self.dtype, param_dtype=jnp.float32,
                         name="out")(o)
+
+    def _resolve_attend(self) -> Callable:
+        if self.seq_axis:
+            return seq_attend_fn(self.seq_axis, self.seq_impl,
+                                 self.seq_wire)
+        return _causal_attend
 
 
 class DecoderLayer(nn.Module):
@@ -280,6 +335,12 @@ class DecoderLayer(nn.Module):
     # allreduce per block). Composes with the MoE expert axis — tp
     # shards the attention while ep routes the FFN tokens.
     tp_axis: Optional[str] = None
+    # Sequence-parallel fields (docs/sequence.md) — forwarded to the
+    # attention block; the MLP is pointwise over positions, so it runs
+    # on the local sequence shard unchanged.
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    seq_wire: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_ctx=None):
@@ -294,6 +355,9 @@ class DecoderLayer(nn.Module):
             x = x + CausalSelfAttention(self.num_heads, self.dtype,
                                         self.attend_fn,
                                         tp_axis=self.tp_axis,
+                                        seq_axis=self.seq_axis,
+                                        seq_impl=self.seq_impl,
+                                        seq_wire=self.seq_wire,
                                         name="attn")(y, positions)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.moe_experts:
@@ -366,6 +430,17 @@ class GPT(nn.Module):
     # model, so one init/checkpoint serves both and
     # ``DistributedOptimizer(parallel=...)`` reassembles slice grads.
     tp_axis: Optional[str] = None
+    # Sequence-parallel mesh axis (docs/sequence.md): activations
+    # sequence-shard over ``seq_parallel``; attention runs
+    # ``seq_impl`` ("ring" = striped causal ring over wired ppermute —
+    # feed stripe_layout'd tokens; "ulysses" = head/sequence alltoall —
+    # contiguous shards, needs num_heads % n == 0) with K/V exchanges
+    # in ``seq_wire``. Params stay the SAME replicated dense tree (one
+    # checkpoint serves the dense and sp twins); slice grads
+    # pmean-combine over sp in the optimizer, exactly like tp.
+    seq_parallel: Optional[str] = None
+    seq_impl: str = "ring"
+    seq_wire: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, cache=None):
@@ -398,6 +473,9 @@ class GPT(nn.Module):
                               self.moe_overlap_chunks,
                               self.moe_router_noise,
                               tp_axis=self.tp_axis,
+                              seq_axis=self.seq_parallel,
+                              seq_impl=self.seq_impl,
+                              seq_wire=self.seq_wire,
                               name=f"layer{i}")
             if cache is not None:
                 x, lc = layer(x, positions, cache["layers"][i],
@@ -443,6 +521,26 @@ def gpt_tiny(**kw):
                  ("dtype", jnp.float32)):
         kw.setdefault(k, v)
     return GPT(**kw)
+
+
+def activation_bytes(model: "GPT", batch: int, seq_len: int,
+                     dtype_bytes: int = 4) -> int:
+    """Analytic per-rank activation accounting for ONE training step
+    (saved-for-backward residuals, no remat): per decoder layer the
+    two LN outputs, q/k/v, the attention output + projection, the two
+    MLP matmul activations (~``10*hidden + 2*mlp_dim`` values per
+    token), plus the embedding and the LM-head logits
+    (``hidden + vocab`` per token). LINEAR in ``seq_len`` by
+    construction — that is the point: sequence parallelism over
+    ``nsp`` ranks hands each rank ``seq_len // nsp`` of the context,
+    dividing this number by ``nsp`` while the params stay whole
+    (docs/sequence.md). The long-context acceptance test budgets
+    against this accounting, the bench records it into the BENCH
+    ``memory`` block."""
+    per_tok_layer = 10 * model.hidden + 2 * model.mlp_dim
+    per_tok = (model.num_layers * per_tok_layer + model.hidden
+               + model.vocab_size)
+    return int(batch) * int(seq_len) * per_tok * int(dtype_bytes)
 
 
 def param_bytes(params) -> int:
@@ -498,8 +596,10 @@ def pipeline_fns(model: GPT):
       P("pp")`` each pp rank holds ``(1, lps, ...)`` and runs its one
       stage; the SAME closure applied to the full stacked tree runs the
       whole chain (the single-program reference the bitwise test pins
-      against). Carries the model's ``tp_axis``/MoE fields, so tensor
-      and expert parallelism run INSIDE each stage.
+      against). Carries the model's ``tp_axis``/MoE/``seq_parallel``
+      fields, so tensor, expert, and sequence parallelism run INSIDE
+      each stage (sp layers resolve their own global RoPE positions —
+      docs/sequence.md).
     - ``pre_fn(shared, tokens)`` is the stage-0 input: the embedding
       lookup (same math as the model's ``tok_emb`` path).
     - ``loss_fn(shared, out, targets)`` is the last-stage loss: final
@@ -515,7 +615,10 @@ def pipeline_fns(model: GPT):
                          model.moe_route, model.moe_wire,
                          model.moe_overlap_chunks,
                          model.moe_router_noise,
-                         tp_axis=model.tp_axis)
+                         tp_axis=model.tp_axis,
+                         seq_axis=model.seq_parallel,
+                         seq_impl=model.seq_impl,
+                         seq_wire=model.seq_wire)
 
     def stage_fn(stage_params, x):
         local_stages, lps = jax.tree.leaves(stage_params)[0].shape[:2]

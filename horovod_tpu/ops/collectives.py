@@ -795,6 +795,28 @@ _M_A2A_BYTES = metrics_lib.counter(
     "the self-chunk never crosses the wire and is excluded; int8 "
     "includes the per-4096-block fp32 scales)",
     labels=("wire", "axis"))
+_M_SEQ_KV_BYTES = metrics_lib.counter(
+    "hvd_tpu_seq_kv_bytes_total",
+    "sequence-parallel K/V exchange bytes on the wire by wire format "
+    "and sp mesh axis (ring: one full K/V rotation = n-1 ppermute "
+    "hops; Ulysses: head/sequence alltoalls with the self-chunk "
+    "excluded; per compiled program at trace time — the "
+    "planned_per_compile basis; int8 includes the per-4096-block fp32 "
+    "scales — docs/sequence.md)",
+    labels=("wire", "axis"))
+
+
+def count_seq_kv_bytes(axis: str, wire: str, nelems: int, n: int,
+                       itemsize: int, hops: int) -> None:
+    """Trace-time byte stamping for the sequence-parallel K/V exchange
+    (ring ppermute hops move the FULL local block per hop; alltoall
+    callers pass ``hops=n-1`` with ``nelems`` the per-chunk size to get
+    the usual ``(n-1)/n`` self-chunk exclusion)."""
+    if not _METRICS_ON or n <= 1 or hops <= 0:
+        return
+    eb = _wire_elem_bytes(wire, itemsize)
+    _M_SEQ_KV_BYTES.labels(wire=wire, axis=axis).inc(
+        float(hops) * nelems * eb)
 
 
 @dataclasses.dataclass(frozen=True)

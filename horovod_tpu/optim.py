@@ -223,18 +223,23 @@ def _resolve_parallel(parallel):
     return ParallelSpec.resolve(parallel)
 
 
-def _combine_tp(grads, tp_axis: str):
-    """pmean-combine tensor-parallel slice gradients
-    (tensor_parallel.combine_slice_grads) ahead of the dp reduction.
-    Resolved at TRACE time: when the tp axis is not bound, the model
-    necessarily ran unsharded in this trace, the grads are already
-    exact, and the combine is correctly skipped (the single-device
-    debug path)."""
-    if not _axes_bound(tp_axis):
-        return grads
+def _combine_tp(grads, tp_axis):
+    """pmean-combine slice gradients over one axis name or a tuple of
+    them (tensor_parallel.combine_slice_grads) ahead of the dp
+    reduction — ``tp`` reassembles tensor-parallel slices, ``sp``
+    averages the per-sequence-shard gradients of replicated params
+    (docs/sequence.md): identical math, one combiner. Resolved at
+    TRACE time: when an axis is not bound, the model necessarily ran
+    unsharded over it in this trace, the grads are already exact, and
+    that combine is correctly skipped (the single-device debug
+    path)."""
     from .parallel.tensor_parallel import combine_slice_grads
 
-    return combine_slice_grads(grads, tp_axis)
+    axes = (tp_axis,) if isinstance(tp_axis, str) else tuple(tp_axis)
+    for a in axes:
+        if _axes_bound(a):
+            grads = combine_slice_grads(grads, a)
+    return grads
 
 
 def _axes_bound(*axes) -> bool:
@@ -966,7 +971,9 @@ def DistributedOptimizer(optimizer,
             # HVD_TPU_ROUTE default (which names local/cross axes this
             # mesh does not bind) can never apply.
             route = pspec.grad_route()
-        tp_combine_axis = pspec.tp_axis
+        tp_combine_axis = tuple(
+            a for a in (pspec.tp_axis, pspec.sp_axis)
+            if a is not None) or None
 
     if zero_stage:
         # The one-line ZeRO surface (docs/zero.md): stage 1 = sharded
@@ -1127,11 +1134,11 @@ def DistributedOptimizer(optimizer,
 
     def _finish(init_f, update_f):
         if tp_combine_axis is not None:
-            # Tensor-parallel slice grads reassemble (pmean over tp)
-            # BEFORE everything downstream — the dp reduction, the
-            # guard's finite check, and the legacy k>1 accumulator all
-            # see exact gradients (pmean is linear, so combining ahead
-            # of accumulation is equivalent).
+            # Tensor/sequence-parallel slice grads reassemble (pmean
+            # over tp, then sp) BEFORE everything downstream — the dp
+            # reduction, the guard's finite check, and the legacy k>1
+            # accumulator all see exact gradients (pmean is linear, so
+            # combining ahead of accumulation is equivalent).
             inner_update_f = update_f
 
             def update_f(grads, state, params=None, **extra):  # noqa: F811
@@ -2692,7 +2699,9 @@ class ZeroOptimizer:
                 # the hybrid schedule's wire mix.
                 route = pspec.grad_route()
             axis_name = pspec.dp_axes[0]
-            self._tp_axis = pspec.tp_axis
+            self._tp_axis = tuple(
+                a for a in (pspec.tp_axis, pspec.sp_axis)
+                if a is not None) or None
         self.zero_stage = stage
         self.inner = inner
         self.axis_name = axis_name
@@ -2732,10 +2741,11 @@ class ZeroOptimizer:
         return _sharded_route(self.route, self.axis_name)
 
     def _maybe_combine_tp(self, grads):
-        """Reassemble tensor-parallel slice gradients (pmean over tp)
-        before a full-gradient tree enters any reduce-scatter — no-op
-        without a parallel spec, or when the tp axis is unbound in this
-        trace (the model then ran unsharded and grads are exact)."""
+        """Reassemble tensor/sequence-parallel slice gradients (pmean
+        over tp, then sp) before a full-gradient tree enters any
+        reduce-scatter — no-op without a parallel spec, or when an axis
+        is unbound in this trace (the model then ran unsharded over it
+        and grads are exact)."""
         if self._tp_axis is None:
             return grads
         return _combine_tp(grads, self._tp_axis)

@@ -11,8 +11,11 @@ from .pipeline import (pipeline_accumulate_gradients,  # noqa: F401
                        select_last_stage)
 from .respec import (RespecDecision, min_world,  # noqa: F401
                      solve_respec)
-from .ring_attention import (ring_attend_fn,  # noqa: F401
-                             ring_attention)
+from .ring_attention import (resolve_seq_wire,  # noqa: F401
+                             ring_attend_fn, ring_attention,
+                             stripe_layout, striped_attend_fn,
+                             striped_attention, striped_positions,
+                             unstripe_layout)
 from .spec import (ParallelSpec, hybrid_param_specs,  # noqa: F401
                    hybrid_state_specs, spec_from_env)
 from .tensor_parallel import (column_parallel,  # noqa: F401

@@ -19,9 +19,26 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-# Canonical axis order: slowest/outermost first. dp may span DCN; the
-# rightmost axes must ride ICI (tp does neighbor-heavy collectives).
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+from .spec import ROLES as _SPEC_ROLES
+
+# Canonical axis order: slowest/outermost first — ONE ordering with
+# ParallelSpec's slow-first Megatron placement (parallel/spec.py: dp
+# tolerates the DCN hop, tp needs the fastest ICI; sp sits beside tp
+# because ring K/V hops want ICI neighbors). ``fsdp`` is a mesh-only
+# axis name (ZeRO-style param sharding — examples/fsdp_train.py), not
+# a ParallelSpec compute role.
+AXIS_ORDER = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+
+# Drift guard (regression-tested in tests/test_parallel.py): every
+# ParallelSpec role must have a placement here, so adding a role to
+# spec.py without one fails at import — two sources of truth cannot
+# silently diverge again (they did: the seed ordered pp before dp).
+_missing = set(_SPEC_ROLES) - set(AXIS_ORDER)
+if _missing:
+    raise RuntimeError(
+        f"parallel/mesh.AXIS_ORDER is missing ParallelSpec role(s) "
+        f"{sorted(_missing)} — add a placement for them")
+del _missing
 
 
 def build_mesh(axes: Dict[str, int],

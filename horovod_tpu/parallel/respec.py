@@ -19,6 +19,12 @@ ladder (docs/elastic.md "hybrid worlds"):
     Fold pipeline stages onto fewer ranks (2 stages' params on 1 rank):
     ``pp`` drops to its largest proper divisor that fits, preferring
     the FEWEST folds. Memory per rank grows; the schedule shortens.
+``fold_sp``
+    Fold sequence shards onto fewer ranks (pp already folded to 1):
+    ``sp`` drops to a divisor — per-rank activation memory grows
+    linearly with the fold, but params stay replicated over sp, so the
+    fold needs NO weight migration; that is why sp folds BEFORE tp
+    drops (docs/sequence.md).
 ``drop_tp``
     Give up tensor-parallel width: ``tp`` drops to a smaller divisor,
     each rank holding wider weight slices.
@@ -38,8 +44,9 @@ Knobs (docs/elastic.md):
   (default on whenever a parallel spec is active; ``0`` pins the
   declared mesh and the driver simply waits for capacity).
 * ``HVD_TPU_RESPEC_ORDER`` — comma list of permitted rungs in
-  preference order (default ``shed_dp,fold_pp,drop_tp,dp_only``);
-  removing a rung forbids that degradation.
+  preference order (default
+  ``shed_dp,fold_pp,fold_sp,drop_tp,dp_only``); removing a rung
+  forbids that degradation.
 * ``HVD_TPU_RESPEC_MIN_DP`` — replica floor for the shed/fold/drop
   rungs (default 1); ``dp_only`` ignores it (it is the last resort).
 
@@ -70,7 +77,7 @@ ENV_ORDER = "HVD_TPU_RESPEC_ORDER"
 ENV_MIN_DP = "HVD_TPU_RESPEC_MIN_DP"
 
 # The preference ladder, in its canonical (and default) order.
-RUNGS = ("shed_dp", "fold_pp", "drop_tp", "dp_only")
+RUNGS = ("shed_dp", "fold_pp", "fold_sp", "drop_tp", "dp_only")
 
 _M_RESPEC = metrics_lib.counter(
     "hvd_tpu_respec_total",
@@ -83,7 +90,7 @@ class RespecDecision:
     """One solver answer: the rung that fired (``keep`` when the
     declared spec still fits), the solved spec, and its world size."""
 
-    action: str              # keep | shed_dp | fold_pp | drop_tp | dp_only
+    action: str    # keep | shed_dp | fold_pp | fold_sp | drop_tp | dp_only
     spec: ParallelSpec
     np: int                  # spec.total — the world the driver assigns
 
@@ -150,9 +157,9 @@ def solve_respec(spec: ParallelSpec, capacity: int,
     then waits for capacity instead of reshaping.
 
     Invariants (property-tested in tests/test_respec.py): the returned
-    spec's total is <= capacity, every size >= 1, pp/tp sizes divide
-    the declared ones, and the same (spec, capacity, knobs) always
-    returns the same answer.
+    spec's total is <= capacity, every size >= 1, pp/sp/tp sizes
+    divide the declared ones, and the same (spec, capacity, knobs)
+    always returns the same answer.
     """
     if min_dp is None:
         min_dp = respec_min_dp()
@@ -170,11 +177,12 @@ def solve_respec(spec: ParallelSpec, capacity: int,
     d = spec.size_of("dp")
     pp = spec.size_of("pp")
     tp = spec.size_of("tp")
+    sp = spec.size_of("sp")
     # Non-dp, non-foldable block (ep and any size-1 declared roles):
     # the solver never degrades ep short of the dp_only rung.
     fixed = 1
     for role, size in spec.dims:
-        if role not in ("dp", "pp", "tp"):
+        if role not in ("dp", "pp", "tp", "sp"):
             fixed *= size
 
     def fit_dp(block: int) -> int:
@@ -183,29 +191,43 @@ def solve_respec(spec: ParallelSpec, capacity: int,
 
     for rung in rungs:
         if rung == "shed_dp":
-            block = pp * tp * fixed
+            block = pp * tp * sp * fixed
             nd = fit_dp(block)
             if nd >= max(1, min_dp):
                 return RespecDecision(
                     "shed_dp", _rebuild(spec, {"dp": nd}), nd * block)
         elif rung == "fold_pp":
             for npp in _divisors_desc(pp):
-                block = npp * tp * fixed
+                block = npp * tp * sp * fixed
                 nd = fit_dp(block)
                 if nd >= max(1, min_dp):
                     return RespecDecision(
                         "fold_pp", _rebuild(spec, {"dp": nd, "pp": npp}),
                         nd * block)
+        elif rung == "fold_sp":
+            # Sequence shards fold with pp already folded flat —
+            # fold_pp's npp=1 attempt (full sp) did not fit if we got
+            # here. nsp=1 keeps FULL tp, which is exactly what
+            # distinguishes this rung from drop_tp.
+            for nsp in _divisors_desc(sp):
+                block = nsp * tp * fixed
+                nd = fit_dp(block)
+                if nd >= max(1, min_dp):
+                    return RespecDecision(
+                        "fold_sp",
+                        _rebuild(spec, {"dp": nd, "pp": 1, "sp": nsp}),
+                        nd * block)
         elif rung == "drop_tp":
             for ntp in _divisors_desc(tp):
                 if ntp == 1:
-                    continue    # tp=1 with pp=1 is the dp_only rung
+                    continue    # tp=1 with pp=sp=1 is the dp_only rung
                 block = ntp * fixed
                 nd = fit_dp(block)
                 if nd >= max(1, min_dp):
                     return RespecDecision(
                         "drop_tp",
-                        _rebuild(spec, {"dp": nd, "pp": 1, "tp": ntp}),
+                        _rebuild(spec, {"dp": nd, "pp": 1, "sp": 1,
+                                        "tp": ntp}),
                         nd * block)
         elif rung == "dp_only":
             sizes = {r: 1 for r, _ in spec.dims}

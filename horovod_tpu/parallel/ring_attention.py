@@ -5,10 +5,18 @@ absent"); its closest primitive is the LOCAL/CROSS split + alltoall. This
 module adds the capability the TPU-native way: Q/K/V are sharded along the
 sequence dimension across the ``sp`` mesh axis; each device attends its
 local Q block against K/V blocks that rotate around the ring via
-``lax.ppermute`` (one ICI neighbor hop per step — bandwidth-optimal, and
-XLA overlaps the permute with the attention math of the current block).
-Softmax is computed online (flash-attention style running max/denominator
-in fp32), so the full S×S score matrix never materializes.
+``collectives.wired_ppermute`` (one ICI neighbor hop per step —
+bandwidth-optimal, and XLA overlaps the permute with the attention math
+of the current block). Softmax is computed online (flash-attention style
+running max/denominator in fp32), so the full S×S score matrix never
+materializes.
+
+Every K/V hop rides the WIRED stack (docs/sequence.md): ``wire="none"``
+sends the native dtype, ``"bf16"`` halves the bytes, ``"int8"`` sends
+block-scaled payload + fp32 scales with a STRAIGHT-THROUGH gradient
+(the PR 13 stage-boundary pattern — trainable through a quantized hop).
+The wire defaults from ``HVD_TPU_SEQ_WIRE`` / ``init(seq_wire=)``; hop
+bytes stamp ``hvd_tpu_seq_kv_bytes_total{wire,axis}`` at trace time.
 
 Matches the blockwise/ring formulation of Liu et al. (Ring Attention,
 2023) — see PAPERS.md.
@@ -18,10 +26,51 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+
+
+def resolve_seq_wire(explicit: Optional[str] = None) -> str:
+    """None -> the configured default (``HVD_TPU_SEQ_WIRE`` /
+    ``init(seq_wire=)``, falling back to ``"none"``); an explicit value
+    always wins. Shared by the ring and Ulysses exchanges so one knob
+    governs every sequence-parallel hop."""
+    if explicit is not None:
+        return explicit
+    from ..common import basics
+
+    if basics.is_initialized():
+        return getattr(basics.context().config, "seq_wire",
+                       None) or "none"
+    from ..common.config import _env
+
+    return _env("SEQ_WIRE") or "none"
+
+
+def _seq_hop(x, axis_name, perm, wire, key, salt):
+    """One K/V ring hop in the sequence wire format. ``salt`` may be a
+    traced ring-step index — ``fold_in`` accepts traced data, so every
+    hop's stochastic rounding draws an independent key inside the
+    fori_loop body."""
+    if wire == "none":
+        return lax.ppermute(x, axis_name, perm)
+    from ..ops.collectives import wired_ppermute
+
+    kk = None if key is None else jax.random.fold_in(key, salt)
+    return wired_ppermute(x, axis_name, perm, wire=wire, key=kk)
+
+
+def _stamp_ring_bytes(axis_name: str, wire: str, n: int, nelems: int,
+                      itemsize: int, hops: int) -> None:
+    """Trace-time byte accounting for a full K/V rotation (``hops``
+    wired hops of ``nelems`` elements each around the ``n``-rank
+    ring)."""
+    from ..ops.collectives import count_seq_kv_bytes
+
+    count_seq_kv_bytes(axis_name, wire, nelems, n, itemsize, hops)
 
 
 def _block_attend(q, k, v, m, l, o, mask=None):
@@ -48,7 +97,9 @@ def _block_attend(q, k, v, m, l, o, mask=None):
 def ring_attention(q, k, v, axis_name: str = "sp",
                    causal: bool = False,
                    mask=None,
-                   use_flash: Optional[bool] = None):
+                   use_flash: Optional[bool] = None,
+                   wire: Optional[str] = None,
+                   wire_key=None):
     """Attention over sequence-sharded q/k/v.
 
     Args:
@@ -56,20 +107,27 @@ def ring_attention(q, k, v, axis_name: str = "sp",
         device of the ``axis_name`` ring.
       causal: apply a causal mask over *global* positions.
       mask: optional (B, S_local) key mask for the LOCAL shard (1 =
-        attend); it rotates around the ring alongside its K/V block.
+        attend); it rotates around the ring alongside its K/V block
+        (always at the native dtype — a 0/1 mask has nothing to
+        compress).
       use_flash: run each ring step's block attention through the Pallas
         flash kernel (ops/flash_attention.py) and combine blocks via
         their logsumexp — auto on TPU, jnp blockwise math elsewhere.
+      wire: K/V hop wire format (``None`` -> :func:`resolve_seq_wire`).
+        int8 re-quantizes a block on EVERY hop, so the error grows with
+        ring distance — bounds in docs/sequence.md.
+      wire_key: PRNG key for stochastic int8 rounding (folded per hop).
 
     Returns (B, S_local, H, D) attention output for the local Q block.
     """
+    wire = resolve_seq_wire(wire)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
 
     if use_flash is not False and _ring_flash_available(q, use_flash):
         return _ring_attention_flash(q, k, v, axis_name, causal, mask,
-                                     use_flash)
+                                     use_flash, wire, wire_key)
 
     m = jnp.full((b, h, s), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s), jnp.float32)
@@ -98,12 +156,15 @@ def ring_attention(q, k, v, axis_name: str = "sp",
             cmask = (q_pos[:, None] >= k_pos[None, :])[None, None]
             blk = cmask if blk is None else blk & cmask
         m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, blk)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _seq_hop(k_cur, axis_name, perm, wire, wire_key, 2 * i)
+        v_nxt = _seq_hop(v_cur, axis_name, perm, wire, wire_key,
+                         2 * i + 1)
         m_nxt = (lax.ppermute(m_cur, axis_name, perm) if has_mask
                  else m_cur)
         return m, l, o, k_nxt, v_nxt, m_nxt
 
+    _stamp_ring_bytes(axis_name, wire, n, int(k.size) + int(v.size),
+                      k.dtype.itemsize, n)
     m, l, o, _, _, _ = lax.fori_loop(0, n, body,
                                      (m, l, o, k, v, key_mask))
     denom = l.transpose(0, 2, 1)[..., None]               # (B,S,H,1)
@@ -145,7 +206,8 @@ def _combine_partial(o, lse, o_i, lse_i):
 
 
 def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
-                          use_flash: Optional[bool]):
+                          use_flash: Optional[bool],
+                          wire: str = "none", wire_key=None):
     """Ring steps through the Pallas flash kernel: each block yields a
     normalized partial (o_i, lse_i); blocks combine with
     logaddexp-weighted averaging (both outputs differentiable, so the
@@ -182,12 +244,15 @@ def _ring_attention_flash(q, k, v, axis_name: str, causal: bool, mask,
         else:
             o_i, lse_i = block(k_cur, v_cur, m_cur, False)
         o, lse = _combine_partial(o, lse, o_i, lse_i)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        k_nxt = _seq_hop(k_cur, axis_name, perm, wire, wire_key, 2 * i)
+        v_nxt = _seq_hop(v_cur, axis_name, perm, wire, wire_key,
+                         2 * i + 1)
         m_nxt = (lax.ppermute(m_cur, axis_name, perm) if has_mask
                  else m_cur)
         return o, lse, k_nxt, v_nxt, m_nxt
 
+    _stamp_ring_bytes(axis_name, wire, n, int(k.size) + int(v.size),
+                      k.dtype.itemsize, n)
     o0 = jnp.zeros((b, s, h, d), jnp.float32)
     lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
     o, _, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v, key_mask))
@@ -233,18 +298,24 @@ def striped_positions(s_local: int, axis_name: str = "sp"):
 
 
 def striped_attention(q, k, v, axis_name: str = "sp",
-                      use_flash: Optional[bool] = None):
+                      use_flash: Optional[bool] = None,
+                      wire: Optional[str] = None,
+                      wire_key=None):
     """Causal attention over STRIPE-sharded q/k/v (see stripe_layout).
 
     q, k, v: (B, S_local, H, D) — this device's stripe. Returns the
     attention output for the local stripe. Causality is over GLOBAL
     positions; for non-causal attention striping buys nothing — use
-    ring_attention.
+    ring_attention. K/V hops ride the sequence wire (``wire``; None ->
+    :func:`resolve_seq_wire`).
     """
+    wire = resolve_seq_wire(wire)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
+    _stamp_ring_bytes(axis_name, wire, n, int(k.size) + int(v.size),
+                      k.dtype.itemsize, n)
 
     if use_flash is not False and _ring_flash_available(q, use_flash):
         def kernel_block(k_cur, v_cur, strict):
@@ -268,8 +339,11 @@ def striped_attention(q, k, v, axis_name: str = "sp",
                 lambda: kernel_block(k_cur, v_cur, False),
                 lambda: kernel_block(k_cur, v_cur, True))
             o, lse = _combine_partial(o, lse, o_i, lse_i)
-            return (o, lse, lax.ppermute(k_cur, axis_name, perm),
-                    lax.ppermute(v_cur, axis_name, perm))
+            return (o, lse,
+                    _seq_hop(k_cur, axis_name, perm, wire, wire_key,
+                             2 * i),
+                    _seq_hop(v_cur, axis_name, perm, wire, wire_key,
+                             2 * i + 1))
 
         o0 = jnp.zeros((b, s, h, d), jnp.float32)
         lse0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
@@ -288,8 +362,10 @@ def striped_attention(q, k, v, axis_name: str = "sp",
         # global causality on stripes: (jq - jk) * n >= src - idx.
         blk = ((jq - jk) * n >= src - idx)[None, None]
         m, l, o = _block_attend(q, k_cur, v_cur, m, l, o, blk)
-        return (m, l, o, lax.ppermute(k_cur, axis_name, perm),
-                lax.ppermute(v_cur, axis_name, perm))
+        return (m, l, o,
+                _seq_hop(k_cur, axis_name, perm, wire, wire_key, 2 * i),
+                _seq_hop(v_cur, axis_name, perm, wire, wire_key,
+                         2 * i + 1))
 
     m, l, o, _, _ = lax.fori_loop(0, n, body, (m, l, o, k, v))
     denom = l.transpose(0, 2, 1)[..., None]
@@ -297,7 +373,8 @@ def striped_attention(q, k, v, axis_name: str = "sp",
     return out.astype(q.dtype)
 
 
-def striped_attend_fn(axis_name: str = "sp"):
+def striped_attend_fn(axis_name: str = "sp",
+                      wire: Optional[str] = None, wire_key=None):
     """attend_fn adapter for the causal models (models.gpt GPT): striped
     sequence-parallel attention. Pair with ``striped_positions`` for
     RoPE — the stripe's GLOBAL positions must feed the rotary angles."""
@@ -307,12 +384,14 @@ def striped_attend_fn(axis_name: str = "sp"):
             raise NotImplementedError(
                 "striped attention + key mask: rotate the mask with the "
                 "stripes via ring_attention instead")
-        return striped_attention(q, k, v, axis_name)
+        return striped_attention(q, k, v, axis_name, wire=wire,
+                                 wire_key=wire_key)
 
     return attend
 
 
-def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
+def ring_attend_fn(axis_name: str = "sp", causal: bool = False,
+                   wire: Optional[str] = None, wire_key=None):
     """Adapter producing an ``attend_fn`` for models.bert.Bert (the same
     drop-in hook ulysses_attend_fn provides): sequence-sharded ring
     attention for any model accepting attend_fn."""
@@ -321,7 +400,7 @@ def ring_attend_fn(axis_name: str = "sp", causal: bool = False):
         # mask: (B, S_local) key mask for this shard; it rotates around
         # the ring with its K/V block.
         return ring_attention(q, k, v, axis_name, causal=causal,
-                              mask=mask)
+                              mask=mask, wire=wire, wire_key=wire_key)
 
     return attend
 

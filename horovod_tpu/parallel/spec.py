@@ -13,6 +13,10 @@ arXiv:1909.09756): each mesh axis is assigned a parallelism ROLE:
                                   (parallel/tensor_parallel.py)
   ``ep``  expert parallelism    — MoE alltoall dispatch
                                   (parallel/moe.py)
+  ``sp``  sequence parallelism  — ring/Ulysses attention over
+                                  sequence-sharded activations
+                                  (parallel/ring_attention.py,
+                                  parallel/ulysses.py, docs/sequence.md)
 
 Declare roles SLOW axis first, FAST axis last (row-major device order,
 same convention as ``HVD_TPU_MESH_SHAPE``): the gradient allreduce
@@ -27,8 +31,10 @@ ParallelSpec, and publishes the resolved spec as
 The optimizer surfaces consume the spec directly
 (``DistributedOptimizer(..., parallel=spec)``): gradients reduce over
 the ``dp`` axes ONLY (through the usual route/compression/guard
-stack), tp slice-gradients are pmean-combined over ``tp`` first
-(tensor_parallel.combine_slice_grads), the non-finite guard agrees
+stack), tp/sp slice-gradients are pmean-combined over ``tp``/``sp``
+first (tensor_parallel.combine_slice_grads — sp ranks hold identical
+params but gradients from different sequence shards, so the same
+pmean assembles them), the non-finite guard agrees
 over the ``dp`` axes only (each pipeline stage owns different params —
 docs/pipeline.md), and ZeRO shard grids span the ``dp`` axes so
 stage-2/3 shards live PER PIPELINE STAGE.
@@ -42,7 +48,7 @@ from typing import Optional, Sequence, Tuple
 # Roles a mesh axis can play. The axis NAME in the jax Mesh is the role
 # name itself, so shard_map specs and WirePlan phases read naturally
 # (P("pp"), "dp:int8").
-ROLES = ("dp", "pp", "tp", "ep")
+ROLES = ("dp", "pp", "tp", "ep", "sp")
 
 # The env form hvd.init(parallel=) publishes and every role-aware
 # consumer (autoscale engine, pod monitor, flight recorder, respec
@@ -162,6 +168,10 @@ class ParallelSpec:
     def ep_axis(self) -> Optional[str]:
         return "ep" if self.size_of("ep") > 1 else None
 
+    @property
+    def sp_axis(self) -> Optional[str]:
+        return "sp" if self.size_of("sp") > 1 else None
+
     def describe(self) -> str:
         return ",".join(f"{r}={s}" for r, s in self.dims)
 
@@ -170,7 +180,7 @@ class ParallelSpec:
     @property
     def replica_ranks(self) -> int:
         """Ranks per model replica — the product of every non-dp role
-        size (pp x tp x ep). Losing ANY of these ranks orphans the
+        size (pp x tp x ep x sp). Losing ANY of these ranks orphans the
         whole replica: it is the hard min_np unit the autoscale floor
         must respect (docs/elastic.md)."""
         n = 1
@@ -248,12 +258,17 @@ class ParallelSpec:
 
     def data_spec(self):
         """PartitionSpec for a batch argument: leading dim sharded over
-        the dp axes, replicated over pp/tp/ep (every stage and shard
-        sees the replica's full microbatch stream)."""
+        the dp axes, second (sequence) dim sharded over ``sp`` when
+        present, replicated over pp/tp/ep (every stage and shard sees
+        the replica's full microbatch stream; sp ranks each see a
+        sequence slice of the SAME rows — docs/sequence.md)."""
         from jax.sharding import PartitionSpec as P
 
         axes = self.dp_axes
-        return P(axes if len(axes) > 1 else (axes[0] if axes else None))
+        batch = axes if len(axes) > 1 else (axes[0] if axes else None)
+        if self.sp_axis is not None:
+            return P(batch, self.sp_axis)
+        return P(batch)
 
 
 def hybrid_param_specs(pp_axis: str = "pp"):

@@ -14,30 +14,81 @@ H heads:
 Two alltoalls per attention vs ring's n permute hops: Ulysses wins when
 H >= n and ICI all-to-all bandwidth is good (intra-slice); ring wins for
 very long S or when H < n. Both are provided; models select via
-``attend_fn`` (models/bert.py).
+``attend_fn`` / ``GPT(seq_impl=)`` (models/bert.py, models/gpt.py).
+
+Each head/sequence scatter rides the WIRED stack (docs/sequence.md):
+lossy wires (``bf16``/``int8``) decompose the tiled exchange onto
+``collectives.mesh_alltoall`` — block-scaled payloads, fp32 scales, and
+the STRAIGHT-THROUGH gradient of ``_int8_a2a`` — so the scatter is
+trainable through a quantized hop. The wire defaults from
+``HVD_TPU_SEQ_WIRE`` / ``init(seq_wire=)``; exchange bytes stamp
+``hvd_tpu_seq_kv_bytes_total{wire,axis}`` at trace time.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
+import jax.numpy as jnp
 from jax import lax
 
 
+def _a2a_wired(x, axis_name, split_axis, concat_axis, wire,
+               key=None, use_pallas=None):
+    """Tiled ``lax.all_to_all`` in a wire format. ``"none"`` is the
+    native exchange; lossy wires decompose the (split, concat) form
+    onto the dim-0 :func:`collectives.mesh_alltoall` — reshape dim
+    ``split_axis`` into ``(n, k)``, exchange source-major chunks, merge
+    the received source dim into ``concat_axis`` — which is exactly the
+    tiled semantics, so the three forms agree bit-for-bit at
+    ``wire="none"`` precision."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if wire == "none":
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    from ..ops.collectives import AxisPhase, WirePlan, mesh_alltoall
+
+    s, c = split_axis, concat_axis
+    k = x.shape[s] // n
+    xs = jnp.moveaxis(
+        x.reshape(x.shape[:s] + (n, k) + x.shape[s + 1:]), s, 0)
+    lead = xs.shape
+    plan = WirePlan((AxisPhase(axis_name, wire),))
+    got = mesh_alltoall(xs.reshape(n, -1), plan, key=key,
+                        use_pallas=use_pallas).reshape(lead)
+    out = jnp.moveaxis(got, 0, c)
+    return out.reshape(out.shape[:c] + (n * out.shape[c + 1],)
+                       + out.shape[c + 2:])
+
+
 def _a2a(x, axis_name, split_axis, concat_axis):
-    return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    # Back-compat alias (pre-wire call sites and tests).
+    return _a2a_wired(x, axis_name, split_axis, concat_axis, "none")
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp",
                       attend_fn: Optional[Callable] = None,
-                      mask=None):
+                      mask=None,
+                      wire: Optional[str] = None,
+                      wire_key=None,
+                      use_pallas=None):
     """Attention over sequence-sharded q/k/v via head scatter.
 
     q/k/v: (B, S_local, H, D); H must be divisible by the axis size.
     attend_fn(q, k, v, mask) operates on full-sequence inputs
     (B, S, H/n, D) — defaults to models.bert.default_attend.
+    ``wire`` selects the exchange format (None ->
+    :func:`ring_attention.resolve_seq_wire`); lossy wires round ONCE
+    per scatter (4 per attention), unlike the ring's per-hop
+    re-quantization — bounds in docs/sequence.md. ``wire_key`` makes
+    int8 rounding stochastic (folded per scatter).
     """
+    from .ring_attention import resolve_seq_wire
+
+    wire = resolve_seq_wire(wire)
     n = lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n != 0:
@@ -47,23 +98,38 @@ def ulysses_attention(q, k, v, axis_name: str = "sp",
 
         attend_fn = default_attend
 
+    def kk(j):
+        return None if wire_key is None else jax.random.fold_in(
+            wire_key, j)
+
+    # Trace-time byte accounting: 4 scatters (q/k/v out, o back), each
+    # keeping (n-1)/n of its buffer on the wire.
+    from ..ops.collectives import count_seq_kv_bytes
+
+    tot = 2 * int(q.size) + int(k.size) + int(v.size)
+    count_seq_kv_bytes(axis_name, wire, tot // n, n,
+                       q.dtype.itemsize, n - 1)
+
     # (B, S/n, H, D) -> (B, S, H/n, D): split heads, gather sequence.
-    qg = _a2a(q, axis_name, split_axis=2, concat_axis=1)
-    kg = _a2a(k, axis_name, split_axis=2, concat_axis=1)
-    vg = _a2a(v, axis_name, split_axis=2, concat_axis=1)
+    qg = _a2a_wired(q, axis_name, 2, 1, wire, kk(0), use_pallas)
+    kg = _a2a_wired(k, axis_name, 2, 1, wire, kk(1), use_pallas)
+    vg = _a2a_wired(v, axis_name, 2, 1, wire, kk(2), use_pallas)
 
     og = attend_fn(qg, kg, vg, mask)
 
     # Inverse: (B, S, H/n, D) -> (B, S/n, H, D).
-    return _a2a(og, axis_name, split_axis=1, concat_axis=2)
+    return _a2a_wired(og, axis_name, 1, 2, wire, kk(3), use_pallas)
 
 
 def ulysses_attend_fn(axis_name: str = "sp",
-                      inner: Optional[Callable] = None) -> Callable:
+                      inner: Optional[Callable] = None,
+                      wire: Optional[str] = None,
+                      wire_key=None) -> Callable:
     """Adapter producing an ``attend_fn`` for models.bert.Bert: drop-in
     sequence parallelism for any model that accepts attend_fn."""
 
     def attend(q, k, v, mask=None):
-        return ulysses_attention(q, k, v, axis_name, inner, mask)
+        return ulysses_attention(q, k, v, axis_name, inner, mask,
+                                 wire=wire, wire_key=wire_key)
 
     return attend

@@ -121,25 +121,27 @@ def test_chaos_zero_midstep_crash_verified_resume(tmp_path):
 
 
 def test_chaos_hybrid_host_loss_respec_and_migrate(tmp_path):
-    """ISSUE 14 acceptance: kill one host of the 2x2x2 dp x pp x tp
-    world mid-1F1B (with a straggler sleep on a tp peer and the last
-    checkpoint torn). The role-aware decision plane convicts the
-    straggler's HOST (role dp1/pp0/tp1) and not its pipeline peers,
-    the solver re-solves the surviving 6 slots to the documented
-    shed_dp spec dp=1,pp=2,tp=2, sharded state migrates onto the new
-    grid through the CRC walk-back with no full gather, and the
-    reshaped run finishes within the int8_ef 2% bound of an
-    uninterrupted 8-rank reference. The sim decision log is
-    byte-identical across repeats."""
+    """ISSUE 14 acceptance (world grew its sp dimension in ISSUE 18):
+    kill one host of the 2x2x2x2 dp x pp x sp x tp world mid-1F1B
+    (with a straggler sleep on a tp peer and the last checkpoint
+    torn). The role-aware decision plane convicts the straggler's HOST
+    (role dp1/pp0/sp0/tp1) and not its sequence/pipeline peers, the
+    solver re-solves the surviving 14 slots to the documented shed_dp
+    spec dp=1,pp=2,sp=2,tp=2, sharded state migrates onto the new grid
+    through the CRC walk-back with no full gather, and the reshaped
+    run finishes within the int8_ef 2% bound of an uninterrupted
+    16-rank reference. The sim decision log is byte-identical across
+    repeats."""
     import json as json_lib
 
     rec = chaos_soak.run_hybrid_soak(str(tmp_path), steps=6, seed=42)
     assert rec["rc"] == 7  # the hard host loss, mid-schedule
     assert rec["restored_step"] == rec["crash_step"] - 2  # walk-back
-    assert rec["respec"] == "dp=1,pp=2,tp=2"
+    assert rec["respec"] == "dp=1,pp=2,sp=2,tp=2"
     decisions = [json_lib.loads(l) for l in rec["decisions"]]
     assert (decisions[0]["action"], decisions[0]["target"],
-            decisions[0]["role"]) == ("evict", "hostC", "dp1/pp0/tp1")
+            decisions[0]["role"]) == ("evict", "hostE",
+                                      "dp1/pp0/sp0/tp1")
     assert decisions[1]["action"] == "respec" \
         and decisions[1]["reason"] == "shed_dp"
     bound = 0.02 * abs(rec["reference_loss"]) + 1e-3

@@ -47,7 +47,10 @@ def test_solver_never_produces_an_invalid_mesh():
     always give the same answer."""
     specs = [SPEC, ParallelSpec.parse("dp=4,pp=4,tp=2"),
              ParallelSpec.parse("dp=8,pp=2"),
-             ParallelSpec.parse("dp=2,pp=3,tp=2")]
+             ParallelSpec.parse("dp=2,pp=3,tp=2"),
+             ParallelSpec.parse("dp=2,pp=2,sp=2,tp=2"),
+             ParallelSpec.parse("dp=2,pp=2,sp=4,tp=2"),
+             ParallelSpec.parse("dp=2,sp=4")]
     for spec in specs:
         for cap in range(1, spec.total + 3):
             d = solve_respec(spec, cap)
@@ -93,6 +96,77 @@ def test_min_world_reflects_order():
     assert min_world(SPEC) == 1                      # dp_only reaches 1
     assert min_world(SPEC, order=("shed_dp",)) == 4  # one whole replica
     assert min_world(SPEC, min_dp=2, order=("shed_dp",)) == 8
+
+
+# ---------------------------------------------------------------------------
+# The fold_sp rung (ISSUE 18: sequence shards fold before tp drops)
+# ---------------------------------------------------------------------------
+
+SP_SPEC = ParallelSpec.parse("dp=2,pp=2,sp=2,tp=2")
+
+
+def test_solver_preference_ladder_with_sp():
+    """The 5-rung ladder on the sp-bearing acceptance world: dp sheds,
+    pp folds (sp intact), sp folds (tp INTACT — the rung's point: an sp
+    fold migrates no weights, activations just grow), dp_only last."""
+    expect = {16: ("keep", "dp=2,pp=2,sp=2,tp=2", 16),
+              14: ("shed_dp", "dp=1,pp=2,sp=2,tp=2", 8),
+              8: ("shed_dp", "dp=1,pp=2,sp=2,tp=2", 8),
+              7: ("fold_pp", "dp=1,pp=1,sp=2,tp=2", 4),
+              4: ("fold_pp", "dp=1,pp=1,sp=2,tp=2", 4),
+              3: ("fold_sp", "dp=1,pp=1,sp=1,tp=2", 2),
+              2: ("fold_sp", "dp=1,pp=1,sp=1,tp=2", 2),
+              1: ("dp_only", "dp=1,pp=1,sp=1,tp=1", 1)}
+    for cap, (action, spec, np_) in expect.items():
+        d = solve_respec(SP_SPEC, cap)
+        assert (d.action, d.spec.describe(), d.np) == (action, spec,
+                                                       np_), cap
+
+
+def test_fold_sp_prefers_fewest_folds():
+    """sp folds through its divisors largest-first: an sp=4 world at
+    capacity 7 halves the shards (sp=2) instead of collapsing them."""
+    spec = ParallelSpec.parse("dp=2,pp=2,sp=4,tp=2")
+    d = solve_respec(spec, 7)
+    assert (d.action, d.spec.describe(), d.np) == \
+        ("fold_sp", "dp=1,pp=1,sp=2,tp=2", 4)
+    d = solve_respec(spec, 3)
+    assert (d.action, d.spec.describe(), d.np) == \
+        ("fold_sp", "dp=1,pp=1,sp=1,tp=2", 2)
+
+
+def test_fold_sp_keeps_tp_where_drop_tp_cannot():
+    """What distinguishes the rungs: at the same capacity fold_sp keeps
+    FULL tensor-parallel width, drop_tp gives width away. An order
+    without fold_sp degrades tp; the canonical order never does before
+    sp is flat."""
+    spec = ParallelSpec.parse("dp=2,pp=2,sp=2,tp=4")
+    with_sp = solve_respec(spec, 5)
+    assert (with_sp.action, with_sp.spec.describe()) == \
+        ("fold_sp", "dp=1,pp=1,sp=1,tp=4")
+    without = solve_respec(spec, 5,
+                           order=("shed_dp", "fold_pp", "drop_tp",
+                                  "dp_only"))
+    assert without.action == "drop_tp"
+    assert without.spec.size_of("tp") < 4
+
+
+def test_fold_sp_env_order_and_decision_line(monkeypatch):
+    """HVD_TPU_RESPEC_ORDER parses the fold_sp rung, and the decision
+    describes as rung:spec (the decision-log line the engine stamps)."""
+    monkeypatch.setenv(respec_lib.ENV_ORDER, "shed_dp,fold_sp,dp_only")
+    d = solve_respec(SP_SPEC, 3)
+    assert d.action == "fold_sp"
+    assert d.describe() == "fold_sp:dp=1,pp=1,sp=1,tp=2"
+    assert d.np == 2
+
+
+def test_min_world_with_sp_order_variations():
+    assert min_world(SP_SPEC) == 1
+    assert min_world(SP_SPEC, order=("shed_dp",)) == 8
+    assert min_world(SP_SPEC, order=("shed_dp", "fold_pp")) == 4
+    assert min_world(SP_SPEC,
+                     order=("shed_dp", "fold_pp", "fold_sp")) == 2
 
 
 # ---------------------------------------------------------------------------

@@ -1516,16 +1516,18 @@ def run_pipeline_soak(workdir: str, steps: int = 8, seed: int = 42,
 
 # -- the hybrid family (docs/elastic.md "hybrid worlds") ---------------------
 
-HYBRID_HOSTS = ("hostA", "hostB", "hostC", "hostD")   # 2 ranks each
-HYBRID_DECLARED = "dp=2,pp=2,tp=2"
+HYBRID_HOSTS = ("hostA", "hostB", "hostC", "hostD",
+                "hostE", "hostF", "hostG", "hostH")   # 2 ranks each
+HYBRID_DECLARED = "dp=2,pp=2,sp=2,tp=2"
 
 
 def hybrid_plan(seed: int, steps: int) -> dict:
-    """The hybrid family (ISSUE 14): a STRAGGLER inside the 2x2x2
+    """The hybrid family (ISSUE 14; the world gained its sp dimension
+    in ISSUE 18): a STRAGGLER inside the 2x2x2x2 dp x pp x sp x tp
     schedule (real sleep — the tp peer stalls the whole lockstep
     world, exactly the 1F1B signature the role-aware attribution must
     see through) plus a HARD HOST LOSS mid-1F1B (the process dies at
-    step ``crash_step``; one 2-slot host of the 8-rank world is gone),
+    step ``crash_step``; one 2-slot host of the 16-rank world is gone),
     with the last finalized checkpoint additionally torn — the
     RESHAPED relaunch must walk back to the previous VERIFIED step,
     reshard-on-restore onto the solver's predicted spec, and finish
@@ -1540,8 +1542,9 @@ def hybrid_plan(seed: int, steps: int) -> dict:
 
 def hybrid_policy() -> dict:
     """Decision-plane policy for the hybrid sim: min_np pinned to ONE
-    whole model replica (pp x tp = 4 — any smaller voluntary floor is
-    REJECTED by the engine naming the roles), fast 2-strike eviction."""
+    whole model replica (pp x sp x tp = 8 — any smaller voluntary
+    floor is REJECTED by the engine naming the roles), fast 2-strike
+    eviction."""
     return {
         "tick_interval_s": 0.25,
         "publish_interval_s": 0.0,
@@ -1549,7 +1552,7 @@ def hybrid_policy() -> dict:
         "straggler_ratio": 2.5,
         "straggler_patience": 2,
         "min_ranks": 3,
-        "min_np": 4,
+        "min_np": 8,
         "evict_ttl_s": 30.0,
         "evict_cooldown_s": 0.5,
         "grow_cooldown_s": 0.5,
@@ -1558,17 +1561,18 @@ def hybrid_policy() -> dict:
 
 def simulate_hybrid(plan: dict, policy: dict, ticks: int = 12):
     """Virtual-time soak of the ROLE-AWARE decision plane: a real
-    AutoscaleEngine built over the declared 2x2x2 ParallelSpec scores
-    seeded reports in which rank 5 (hostC, dp1/pp0/tp1) is the slow tp
-    peer and its whole dp1 replica (ranks 4-7, hostsC+D) is
-    collectively stalled by the 1F1B schedule. The conviction must
-    name hostC ONLY — hostD's pipeline peers are innocent — and the
-    post-eviction capacity (6 slots) must re-solve through the respec
-    ladder to the shed_dp spec dp=1,pp=2,tp=2. Deterministic by
-    construction (virtual clock, fixed reports): the --repeat contract
-    compares the decision log byte-for-byte. The world model lives in
-    the fleet digital twin (common/fleetsim.py ``simulate_roles``);
-    this is the family-shaped wrapper."""
+    AutoscaleEngine built over the declared 2x2x2x2 ParallelSpec
+    scores seeded reports in which rank 9 (hostE, dp1/pp0/sp0/tp1) is
+    the slow tp peer and its whole dp1 replica (ranks 8-15, hosts E-H)
+    is collectively stalled by the 1F1B schedule. The conviction must
+    name hostE ONLY — the sequence/pipeline peers on hosts F-H are
+    innocent — and the post-eviction capacity (14 slots) must re-solve
+    through the respec ladder to the shed_dp spec
+    dp=1,pp=2,sp=2,tp=2. Deterministic by construction (virtual clock,
+    fixed reports): the --repeat contract compares the decision log
+    byte-for-byte. The world model lives in the fleet digital twin
+    (common/fleetsim.py ``simulate_roles``); this is the family-shaped
+    wrapper."""
     from horovod_tpu.common import fleetsim
     from horovod_tpu.parallel.spec import ParallelSpec
 
@@ -1577,8 +1581,8 @@ def simulate_hybrid(plan: dict, policy: dict, ticks: int = 12):
                  if f["site"] == "straggler")
     return fleetsim.simulate_roles(
         spec, policy, hosts=HYBRID_HOSTS, ranks_per_host=2,
-        straggler_rank=5, straggler_delay=delay, ticks=ticks,
-        min_np=1, max_np=8)
+        straggler_rank=9, straggler_delay=delay, ticks=ticks,
+        min_np=1, max_np=16)
 
 
 HYBRID_SCRIPT = """
@@ -1619,20 +1623,24 @@ spec = ParallelSpec.parse(PARALLEL)
 if MODE == "resume":
     # The reshaped world must be the SOLVER'S answer for the surviving
     # capacity, not an ad-hoc choice: one 2-slot host of the declared
-    # 2x2x2 (8-rank) world is gone -> 6 slots -> shed_dp -> dp=1.
+    # 2x2x2x2 (16-rank) world is gone -> 14 slots -> shed_dp -> dp=1.
     from horovod_tpu.parallel.respec import solve_respec
 
-    dec = solve_respec(ParallelSpec.parse("dp=2,pp=2,tp=2"), 6)
+    dec = solve_respec(ParallelSpec.parse("dp=2,pp=2,sp=2,tp=2"), 14)
     assert dec is not None and dec.action == "shed_dp", dec
     assert dec.spec.describe() == PARALLEL, (dec.spec.describe(),
                                              PARALLEL)
 mesh = spec.mesh(jax.devices())
-model = gpt_tiny(num_layers=2, hidden=32, num_heads=2, mlp_dim=64,
-                 vocab_size=64, tp_axis="tp")
+# The sequence axis rides INSIDE the pipeline stages: Ulysses
+# head-scatter (heads/tp = 2 divisible by sp) over the int8 wire, the
+# same dense checkpoint tree serving every world shape.
+model = gpt_tiny(num_layers=2, hidden=32, num_heads=4, mlp_dim=64,
+                 vocab_size=64, tp_axis="tp", seq_parallel="sp",
+                 seq_impl="ulysses", seq_wire="int8")
 rng = np.random.default_rng(0)
 X = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
 Y = jnp.asarray(rng.integers(0, 64, (8, 12)), jnp.int32)
-params = jax.jit(model.clone(tp_axis=None).init)(
+params = jax.jit(model.clone(tp_axis=None, seq_parallel=None).init)(
     jax.random.PRNGKey(0), X)["params"]
 stages, shared = stack_stage_params(params, spec.size_of("pp"))
 stage_fn, pre_fn, loss_fn = pipeline_fns(model)
@@ -1656,6 +1664,8 @@ def step_fn(st, sh, op, x, y):
     updates, op = tx.update(g, op, p)
     p = optax.apply_updates(p, updates)
     loss = jax.lax.pmean(loss, spec.dp_axes)
+    if spec.sp_axis:
+        loss = jax.lax.pmean(loss, spec.sp_axis)
     return p["stages"], p["shared"], op, loss
 
 
@@ -1716,19 +1726,20 @@ def run_hybrid_soak(workdir: str, steps: int = 6, seed: int = 42,
 
     (1) the ROLE-AWARE decision plane on a virtual clock
     (:func:`simulate_hybrid`): the tp-peer straggler conviction names
-    hostC (role ``dp1/pp0/tp1``) and NOT its innocent pipeline-stage
-    peers on hostD, and the post-eviction capacity re-solves through
-    the respec ladder to ``dp=1,pp=2,tp=2`` — byte-identical decision
-    log under ``--repeat``;
+    hostE (role ``dp1/pp0/sp0/tp1``) and NOT its innocent sequence and
+    pipeline peers on hosts F-H, and the post-eviction capacity
+    re-solves through the respec ladder to ``dp=1,pp=2,sp=2,tp=2`` —
+    byte-identical decision log under ``--repeat``;
 
-    (2) the STATE-MIGRATION journey in subprocesses: 2x2x2 hybrid GPT
-    training (int8 pp wire, int8_ef dp compression) eats a straggler
-    sleep, dies HARD mid-1F1B at ``crash_step`` with its last
-    finalized checkpoint torn; the relaunch on the SOLVER'S predicted
-    spec (4 ranks) walks back to the previous CRC-verified step,
-    reshard-on-restores the 8-rank shards onto the 4-rank mesh with no
-    full gather, finishes the schedule, and lands within the int8_ef
-    2% bound of an uninterrupted 8-rank reference."""
+    (2) the STATE-MIGRATION journey in subprocesses: 2x2x2x2 hybrid
+    GPT training (Ulysses sequence axis inside the stages over the
+    int8 KV wire, int8 pp wire, int8_ef dp compression) eats a
+    straggler sleep, dies HARD mid-1F1B at ``crash_step`` with its
+    last finalized checkpoint torn; the relaunch on the SOLVER'S
+    predicted spec (8 ranks) walks back to the previous CRC-verified
+    step, reshard-on-restores the 16-rank shards onto the 8-rank mesh
+    with no full gather, finishes the schedule, and lands within the
+    int8_ef 2% bound of an uninterrupted 16-rank reference."""
     import subprocess
 
     os.makedirs(workdir, exist_ok=True)
@@ -1739,17 +1750,19 @@ def run_hybrid_soak(workdir: str, steps: int = 6, seed: int = 42,
     decisions = simulate_hybrid(plan, hybrid_policy())
     parsed = [json.loads(l) for l in decisions]
     evicts = [d for d in parsed if d["action"] == "evict"]
-    assert evicts and evicts[0]["target"] == "hostC" \
+    assert evicts and evicts[0]["target"] == "hostE" \
         and evicts[0]["reason"] == "straggler" \
-        and evicts[0]["role"] == "dp1/pp0/tp1", \
-        f"role-aware conviction must name hostC/dp1/pp0/tp1: {decisions}"
-    assert not any(d["target"] == "hostD" for d in evicts), \
-        f"innocent pipeline peers (hostD) must not be convicted: " \
+        and evicts[0]["role"] == "dp1/pp0/sp0/tp1", \
+        f"role-aware conviction must name hostE/dp1/pp0/sp0/tp1: " \
         f"{decisions}"
+    assert not any(d["target"] in ("hostF", "hostG", "hostH")
+                   for d in evicts), \
+        f"innocent sequence/pipeline peers (hostF-H) must not be " \
+        f"convicted: {decisions}"
     respecs = [d for d in parsed if d["action"] == "respec"]
-    assert respecs and respecs[0]["target"] == "dp=1,pp=2,tp=2" \
+    assert respecs and respecs[0]["target"] == "dp=1,pp=2,sp=2,tp=2" \
         and respecs[0]["reason"] == "shed_dp", \
-        f"capacity 6 must re-solve to shed_dp dp=1,pp=2,tp=2: " \
+        f"capacity 14 must re-solve to shed_dp dp=1,pp=2,sp=2,tp=2: " \
         f"{decisions}"
 
     # -- layer 2: crash / reshaped-resume / reference --------------------
@@ -1773,14 +1786,14 @@ def run_hybrid_soak(workdir: str, steps: int = 6, seed: int = 42,
              str(crash), str(ndev), parallel], env=env,
             capture_output=True, text=True, timeout=600)
 
-    p1 = phase("crash", 8, HYBRID_DECLARED, with_faults=True)
+    p1 = phase("crash", 16, HYBRID_DECLARED, with_faults=True)
     assert p1.returncode == 7, \
         f"crash phase rc={p1.returncode} (want the hard exit 7)\n" \
         f"{p1.stdout}\n{p1.stderr}"
-    p2 = phase("resume", 4, "dp=1,pp=2,tp=2", with_faults=False)
+    p2 = phase("resume", 8, "dp=1,pp=2,sp=2,tp=2", with_faults=False)
     assert p2.returncode == 0, \
         f"reshaped resume rc={p2.returncode}\n{p2.stdout}\n{p2.stderr}"
-    p3 = phase("reference", 8, HYBRID_DECLARED, with_faults=False)
+    p3 = phase("reference", 16, HYBRID_DECLARED, with_faults=False)
     assert p3.returncode == 0, \
         f"reference rc={p3.returncode}\n{p3.stdout}\n{p3.stderr}"
 
@@ -1791,8 +1804,8 @@ def run_hybrid_soak(workdir: str, steps: int = 6, seed: int = 42,
     # The torn step (crash-1) was walked back: the CRC-verified restore
     # lands on crash-2 — IN the reshaped world.
     assert resumed["restored_step"] == crash - 2, (resumed, crash)
-    assert resumed["world"] == 4 and \
-        resumed["parallel"] == "dp=1,pp=2,tp=2", resumed
+    assert resumed["world"] == 8 and \
+        resumed["parallel"] == "dp=1,pp=2,sp=2,tp=2", resumed
     # Degraded-mode survival within the int8_ef bound: the dp=1 world
     # sees the same global batch, so the trajectory matches up to the
     # lossy-wire noise budget (docs/compression.md).

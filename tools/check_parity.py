@@ -826,6 +826,120 @@ def check_pipeline_surface(missing: list) -> None:
         missing.append("pipeline: tests/test_pipeline.py missing")
 
 
+def check_seq_surface(missing: list) -> None:
+    """The sequence-parallelism subsystem (ISSUE 18,
+    docs/sequence.md): the sp role, the ring/Ulysses exchange API, the
+    wire knobs (``HVD_TPU_SEQ_*``), the K/V byte counter + autotune
+    gauge, and the bench/queue/test surfaces must exist in the source
+    AND be documented. Parsed textually (runs without jax installed)."""
+    doc = REPO / "docs" / "sequence.md"
+    if not doc.exists():
+        missing.append("path: docs/sequence.md")
+        return
+    text = doc.read_text()
+    api_text = (REPO / "docs" / "api.md").read_text() \
+        if (REPO / "docs" / "api.md").exists() else ""
+    metrics_text = (REPO / "docs" / "metrics.md").read_text() \
+        if (REPO / "docs" / "metrics.md").exists() else ""
+    spec_src = (REPO / "horovod_tpu" / "parallel" / "spec.py").read_text()
+    ring_src = (REPO / "horovod_tpu" / "parallel"
+                / "ring_attention.py").read_text()
+    uly_src = (REPO / "horovod_tpu" / "parallel" / "ulysses.py").read_text()
+    gpt_src = (REPO / "horovod_tpu" / "models" / "gpt.py").read_text()
+    coll_src = (REPO / "horovod_tpu" / "ops" / "collectives.py").read_text()
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    tune_src = (REPO / "horovod_tpu" / "common" / "autotune.py").read_text()
+    mesh_src = (REPO / "horovod_tpu" / "parallel" / "mesh.py").read_text()
+    respec_src = (REPO / "horovod_tpu" / "parallel"
+                  / "respec.py").read_text()
+    bench_src = (REPO / "bench.py").read_text()
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    queue_src = (REPO / "tools" / "tpu_bench_queue.py").read_text()
+
+    # API names: defined -> documented in docs/sequence.md AND api.md.
+    api = {
+        "striped_attention": ring_src, "striped_attend_fn": ring_src,
+        "stripe_layout": ring_src, "striped_positions": ring_src,
+        "resolve_seq_wire": ring_src,
+        "ulysses_attention": uly_src, "ulysses_attend_fn": uly_src,
+        "activation_bytes": gpt_src,
+        "count_seq_kv_bytes": coll_src,
+    }
+    for name, src in api.items():
+        if f"def {name}" not in src and f"class {name}" not in src:
+            missing.append(f"seq api {name}: not found in source")
+            continue
+        for where, t in (("docs/sequence.md", text),
+                         ("docs/api.md", api_text)):
+            if name not in t:
+                missing.append(f"seq api {name}: undocumented in "
+                               f"{where}")
+
+    # The sp role: spec property, mesh placement, fold_sp rung.
+    if "def sp_axis" not in spec_src or '"sp"' not in spec_src:
+        missing.append("seq: parallel/spec.py lacks the sp role")
+    if '"sp"' not in mesh_src:
+        missing.append("seq: parallel/mesh.py AXIS_ORDER lacks sp")
+    if "fold_sp" not in respec_src:
+        missing.append("seq: parallel/respec.py lacks the fold_sp rung")
+    elif "fold_sp" not in text:
+        missing.append("seq: fold_sp undocumented in docs/sequence.md")
+
+    # Metrics: the K/V byte counter + the autotune gauge.
+    for metric, src, srcname in (
+            ("hvd_tpu_seq_kv_bytes_total", coll_src,
+             "ops/collectives.py"),
+            ("hvd_tpu_autotune_seq_wire_index", tune_src,
+             "common/autotune.py")):
+        if metric not in src:
+            missing.append(f"seq metric {metric}: not registered "
+                           f"in {srcname}")
+        for where, t in (("docs/sequence.md", text),
+                         ("docs/metrics.md", metrics_text)):
+            if metric not in t:
+                missing.append(f"seq metric {metric}: undocumented "
+                               f"in {where}")
+
+    # Knobs: config fields + env names documented.
+    for field, env in (("seq_wire", '"SEQ_WIRE"'),
+                       ("seq_parallel", '"SEQ_PARALLEL"'),
+                       ("seq_impl", '"SEQ_IMPL"')):
+        if f"{field}:" not in cfg_src or env not in cfg_src:
+            missing.append(f"seq: config.py lacks the {field} knob")
+    for knob in ("HVD_TPU_SEQ_WIRE", "HVD_TPU_SEQ_PARALLEL",
+                 "HVD_TPU_SEQ_IMPL"):
+        if knob not in text:
+            missing.append(f"seq knob {knob}: undocumented in "
+                           "docs/sequence.md")
+
+    # Autotune axis.
+    if "seq_wire_candidates" not in tune_src:
+        missing.append("seq: autotune.py lacks the seq_wire axis")
+    elif "seq_wire_candidates" not in text:
+        missing.append("seq: seq_wire_candidates undocumented in "
+                       "docs/sequence.md")
+
+    # Bench arms + queue job + the sp'd chaos world.
+    for flag in ('"--seq-parallel"', '"--seq-impl"', '"--seq-wire"',
+                 '"--seq-len"'):
+        if flag not in bench_src:
+            missing.append(f"seq: bench.py lacks the {flag} flag")
+        elif flag.strip('"') not in text:
+            missing.append(f"seq bench flag {flag.strip(chr(34))}: "
+                           "undocumented in docs/sequence.md")
+    if '"train_gpt_seq"' not in queue_src:
+        missing.append("seq: tpu_bench_queue.py lacks the "
+                       "train_gpt_seq job")
+    elif "train_gpt_seq" not in text:
+        missing.append("seq: the train_gpt_seq queue job is "
+                       "undocumented in docs/sequence.md")
+    if "sp=2" not in soak_src:
+        missing.append("seq: chaos_soak.py hybrid world lacks the sp "
+                       "dimension")
+    if not (REPO / "tests" / "test_seq_parallel.py").exists():
+        missing.append("seq: tests/test_seq_parallel.py missing")
+
+
 def check_hybrid_elastic_surface(missing: list) -> None:
     """The elastic-hybrid-parallelism surface (ISSUE 14,
     docs/elastic.md "hybrid worlds"): the respec solver's knobs
@@ -1189,6 +1303,7 @@ def main() -> int:
     check_serve_surface(missing)
     check_zero_surface(missing)
     check_pipeline_surface(missing)
+    check_seq_surface(missing)
     check_hybrid_elastic_surface(missing)
     check_lint_surface(missing)
     check_fleetsim_surface(missing)

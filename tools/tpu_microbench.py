@@ -886,12 +886,100 @@ def infeed_section():
     return out
 
 
+def seq_attention_section():
+    """Sequence-parallel exchange costs (docs/sequence.md): the striped
+    ring's per-step K/V hop chain (wired ppermute) vs the Ulysses
+    head-scatter (wired alltoall) over the live device axis, per wire
+    format — wall ms per attention call next to the trace-time
+    ``hvd_tpu_seq_kv_bytes_total`` accounting both paths stamp. The
+    acceptance bit: int8 must cut the sp-axis bytes ~4x vs the fp32
+    run (3.9x gate; the remainder is the block-scale sidecar). A
+    single-device world cannot host the exchange — it records the
+    analytic per-element byte model only, marked as such."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    n = len(devs)
+    out = {"n_devices": n}
+    B, S, H, D = (1, 256, 4, 16) if SMALL else (2, 2048, 8, 64)
+    if n <= 1 or S % n or H % n:
+        out["basis"] = "analytic_single_device"
+        eb = {"none": 4.0, "bf16": 2.0, "int8": 1.0 + 4.0 / 4096}
+        out["elem_bytes"] = eb
+        out["int8_cuts_4x"] = bool(eb["none"] / eb["int8"] >= 3.9)
+        return out
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.common import metrics as metrics_lib
+    from horovod_tpu.parallel.ring_attention import striped_attention
+    from horovod_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = Mesh(np.array(devs), ("sp",))
+    rng = jax.random.PRNGKey(11)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i),
+                                 (B, S, H, D), dtype=jnp.float32)
+               for i in range(3))
+
+    def _seq_bytes():
+        vals = {}
+        fam = metrics_lib.snapshot().get("hvd_tpu_seq_kv_bytes_total",
+                                         {})
+        for s in fam.get("samples", []):
+            w = s["labels"].get("wire", "?")
+            vals[w] = vals.get(w, 0.0) + float(s["value"])
+        return vals
+
+    def _arm(fn, wire):
+        """Compile + time one wired attention; returns (ms, planned
+        bytes this compile stamped for its wire)."""
+        jit = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False))
+        b0 = _seq_bytes().get(wire, 0.0)
+        ms = _time_ms(lambda: jit(q, k, v))
+        return ms, _seq_bytes().get(wire, 0.0) - b0
+
+    rows = {}
+    for wire in ("none", "bf16", "int8"):
+        row = {}
+        try:
+            ms, nbytes = _arm(
+                lambda qq, kk, vv, w=wire: striped_attention(
+                    qq, kk, vv, axis_name="sp", wire=w), wire)
+            row["ring_ms"] = round(ms, 3)
+            row["ring_kv_bytes"] = int(nbytes)
+        except Exception as e:  # noqa: BLE001 — evidence collection
+            row["ring_ms"] = (
+                f"failed: {(str(e) or repr(e)).splitlines()[0][:120]}")
+        try:
+            ms, nbytes = _arm(
+                lambda qq, kk, vv, w=wire: ulysses_attention(
+                    qq, kk, vv, axis_name="sp", wire=w), wire)
+            row["ulysses_ms"] = round(ms, 3)
+            row["ulysses_scatter_bytes"] = int(nbytes)
+        except Exception as e:  # noqa: BLE001 — evidence collection
+            row["ulysses_ms"] = (
+                f"failed: {(str(e) or repr(e)).splitlines()[0][:120]}")
+        rows[wire] = row
+        _log(f"seq_attention wire={wire}: {row}")
+    out["wires"] = rows
+    fp32 = rows.get("none", {}).get("ring_kv_bytes")
+    i8 = rows.get("int8", {}).get("ring_kv_bytes")
+    if isinstance(fp32, int) and isinstance(i8, int) and i8:
+        out["ring_bytes_fp32_over_int8"] = round(fp32 / i8, 3)
+        out["int8_cuts_4x"] = bool(fp32 / i8 >= 3.9)
+    return out
+
+
 SECTIONS = {"flash": flash_section, "striped": striped_section,
             "overlap": overlap_section, "grad_overlap": grad_overlap_section,
             "fusion": fusion_section, "kernels": kernels_section,
             "compression": compression_section,
             "mesh_routing": mesh_routing_section,
             "alltoall": alltoall_section,
+            "seq_attention": seq_attention_section,
             "infeed": infeed_section}
 
 
