@@ -1084,6 +1084,15 @@ def _run_serve_benchmark(args):
         **arm_fields,
         "latency_p50_s": report["latency_p50_s"],
         "latency_p99_s": report["latency_p99_s"],
+        # Per-phase percentiles + the goodput ledger (docs/serve.md
+        # "Tracing & goodput"; goodput is {} with HVD_TPU_SERVE_TRACE=0).
+        "ttft_p50_s": report["ttft_p50_s"],
+        "ttft_p99_s": report["ttft_p99_s"],
+        "tpot_p50_s": report["tpot_p50_s"],
+        "tpot_p99_s": report["tpot_p99_s"],
+        "queue_wait_p50_s": report["queue_wait_p50_s"],
+        "queue_wait_p99_s": report["queue_wait_p99_s"],
+        "goodput": report["goodput"],
         "tokens_per_virtual_s": report["tokens_per_virtual_s"],
         "mean_occupancy": report["mean_occupancy"],
         "prefill_tokens": report["prefill_tokens"],

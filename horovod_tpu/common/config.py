@@ -406,6 +406,9 @@ RUNTIME_KNOBS = {
     "SERVE_LOG": "serve-controller decision log",
     "SERVE_PREFIX_CAP": "shared-prefix KV cache entry cap (0 disables)",
     "SERVE_SPEC_K": "speculative-decoding draft depth (0 disables)",
+    "SERVE_TRACE": "request-span tracer enable (0 = shared no-op)",
+    "SERVE_TRACE_DIR": "trace JSONL dump directory (unset = no dump)",
+    "SERVE_TRACE_SIZE": "retained completed request-trace cap",
     # Config-field twins read PRE-INIT by tools (bench/microbench):
     # the Config field stays the init()-resolved source of truth.
     "MESH_SHAPE": "mesh factorization override (also a Config field)",

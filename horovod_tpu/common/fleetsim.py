@@ -864,7 +864,40 @@ def run_scenario(scenario, seed: Optional[int] = None
     }
 
 
+def serve_scenario_report(scenario, seed: Optional[int] = None
+                          ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Run a serve-kind scenario and return ``(record, report)`` — the
+    bankable record (same shape :func:`run_scenario` banks, so
+    baselines never grow keys) plus the full ServeCluster report with
+    the per-phase percentiles (ttft/tpot/queue-wait) the SLOPolicy
+    sweep scores against (tools/fleetsim.py --sweep)."""
+    if isinstance(scenario, str):
+        lib = builtin_scenarios()
+        if scenario not in lib:
+            raise ValueError(
+                f"fleetsim: unknown scenario {scenario!r}; builtin: "
+                f"{sorted(lib)}")
+        scenario = lib[scenario]
+    elif isinstance(scenario, dict):
+        scenario = FleetScenario.from_dict(scenario)
+    if seed is not None:
+        scenario = dataclasses.replace(scenario, seed=int(seed))
+        if scenario.plan:
+            scenario.plan = dict(scenario.plan, seed=int(seed))
+    if scenario.kind != "serve":
+        raise ValueError(
+            f"fleetsim: serve_scenario_report needs a serve-kind "
+            f"scenario, got kind={scenario.kind!r}")
+    return _serve_scenario_record(scenario)
+
+
 def _run_serve_scenario(scn: FleetScenario) -> Dict[str, Any]:
+    record, _report = _serve_scenario_record(scn)
+    return record
+
+
+def _serve_scenario_record(scn: FleetScenario
+                           ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Serve-kind scenarios drive the real tiny-GPT decode stack; the
     jax import lives here so train-kind twins stay import-light."""
     import jax
@@ -897,7 +930,7 @@ def _run_serve_scenario(scn: FleetScenario) -> Dict[str, Any]:
         trace=trace, hosts=[f"host{i}" for i in range(scn.hosts)],
         replicas=scn.replicas, roles=scn.roles or None,
         step_s=scn.step_s, kill_injector=kill_inj)
-    return {
+    record = {
         "metric": "fleetsim",
         "scenario": scn.name,
         "kind": scn.kind,
@@ -912,3 +945,4 @@ def _run_serve_scenario(scn: FleetScenario) -> Dict[str, Any]:
             "blacklisted": sorted(hm.blacklist_snapshot()),
         },
     }
+    return record, report

@@ -69,13 +69,16 @@ KV_SCOPE = "flightrec"          # rendezvous KV scope for pushed boxes
 # the schema cannot drift between writer and reader. v2 adds ``role``:
 # the rank's (dp,pp,tp) coordinate label under a hybrid ParallelSpec
 # ("" when role-blind), so a post-mortem names the STAGE, not just a
-# rank number (docs/elastic.md "hybrid worlds").
-BLACKBOX_SCHEMA_VERSION = 2
+# rank number (docs/elastic.md "hybrid worlds"). v3 adds ``trace``:
+# a request-id CSV the serve engine stamps per decode event, joining
+# a black box to the request span ledger (tools/analyze_serve.py
+# --flight; "" for training collectives).
+BLACKBOX_SCHEMA_VERSION = 3
 BLACKBOX_KEYS = ("schema", "rank", "host", "role", "pid", "trigger",
                  "reason", "t_unix", "step", "seq_head", "events",
                  "stacks", "stall_inflight", "recovery")
 EVENT_KEYS = ("seq", "op", "name", "step", "bytes", "wire",
-              "t_submit", "t_complete", "outcome")
+              "t_submit", "t_complete", "outcome", "trace")
 
 # Telemetry (docs/metrics.md / docs/podmon.md).
 _M_EVENTS = metrics_lib.counter(
@@ -100,7 +103,7 @@ def _truthy(raw: Optional[str], default: bool) -> bool:
 
 class _Event:
     __slots__ = ("seq", "op", "name", "step", "bytes", "wire",
-                 "t_submit", "t_complete", "outcome")
+                 "t_submit", "t_complete", "outcome", "trace")
 
     def __init__(self, seq: int, op: str, name: str, step: int,
                  t_submit: float):
@@ -113,12 +116,14 @@ class _Event:
         self.t_submit = t_submit
         self.t_complete: Optional[float] = None
         self.outcome = "pending"
+        self.trace = ""
 
     def to_dict(self) -> Dict[str, Any]:
         return {"seq": self.seq, "op": self.op, "name": self.name,
                 "step": self.step, "bytes": self.bytes,
                 "wire": self.wire, "t_submit": self.t_submit,
-                "t_complete": self.t_complete, "outcome": self.outcome}
+                "t_complete": self.t_complete, "outcome": self.outcome,
+                "trace": self.trace}
 
 
 class FlightRecorder:
@@ -196,9 +201,12 @@ class FlightRecorder:
         return ev.seq
 
     def annotate(self, name: str, nbytes: Optional[int] = None,
-                 wire: Optional[str] = None) -> None:
+                 wire: Optional[str] = None,
+                 trace: Optional[str] = None) -> None:
         """Attach payload facts to the in-flight event (called from the
-        engine's byte-accounting path once the wire decision is made)."""
+        engine's byte-accounting path once the wire decision is made).
+        ``trace`` is the serve plane's request-id CSV — the join key
+        ``analyze_serve.py --flight`` correlates span ledgers on."""
         if not self.enabled:
             return
         with self._lock:
@@ -209,6 +217,8 @@ class FlightRecorder:
                 ev.bytes = int(nbytes)
             if wire is not None:
                 ev.wire = str(wire)
+            if trace is not None:
+                ev.trace = str(trace)
 
     def record_complete(self, name: str, outcome: str = "ok") -> None:
         """Complete the in-flight event. First completion wins: an

@@ -475,6 +475,47 @@ class PodMonitor:
             "snapshots": {r: rec["snapshot"] for r, rec in ranks.items()},
         }
 
+    def serve_view(self) -> Dict[str, Any]:
+        """The /pod/serve aggregation (docs/serve.md "Tracing &
+        goodput"): this process's request span ledger — per-role
+        p50/p99 over queue-wait / handoff / decode spans, slowest-
+        request exemplars with their span breakdowns, the pod goodput
+        fraction — plus the scraped ``hvd_tpu_serve_*`` family stats
+        across ranks."""
+        from ..serve import tracing
+        view = tracing.tracer().pod_view()
+        m = self.merged()
+        view["serve_family_stats"] = {
+            f: d for f, d in sorted(m["family_stats"].items())
+            if f.startswith("hvd_tpu_serve_")}
+        view["scrapes"] = m["scrapes"]
+        view["scrape_errors"] = m["scrape_errors"]
+        return view
+
+    def serve_text(self) -> str:
+        """/pod/serve's human form: one fact per line."""
+        v = self.serve_view()
+        lines = [
+            f"tracing_enabled {v['enabled']}",
+            f"requests {v['requests']}",
+            f"spans {v['spans']}",
+            f"orphans {v['orphans']}",
+            f"goodput_fraction {v['goodput_fraction']}",
+        ]
+        for role, row in sorted(v["roles"].items()):
+            for metric, val in sorted(row.items()):
+                lines.append(f"role {role} {metric} {val}")
+        for rep, per in sorted(v["goodput"].items()):
+            for state, secs in sorted(per.items()):
+                lines.append(f"goodput {rep} {state} {secs}")
+        for ex in v["slowest"]:
+            phases = " ".join(
+                f"{s['phase']}={round(s['t1'] - s['t0'], 6)}"
+                for s in ex["spans"])
+            lines.append(f"slowest rid={ex['rid']} "
+                         f"total={ex['total_s']} {phases}")
+        return "\n".join(lines) + "\n"
+
     def prometheus_text(self) -> str:
         """The merged pod view in Prometheus exposition format:
         computed pod families first, then every scraped sample
@@ -634,6 +675,12 @@ def _pod_handler_cls():
                 merged = mon.merged()
                 merged.pop("snapshots", None)  # keep the JSON view lean
                 body = json.dumps(merged).encode()
+                ctype = "application/json"
+            elif path == "/pod/serve":
+                body = mon.serve_text().encode()
+                ctype = "text/plain; charset=utf-8"
+            elif path == "/pod/serve.json":
+                body = json.dumps(mon.serve_view()).encode()
                 ctype = "application/json"
             else:
                 self.send_response(404)

@@ -26,6 +26,8 @@ Public surface (all lazily imported; ``import horovod_tpu as hvd`` then
 * ``PrefixCache`` — cross-request shared-prefix KV reuse (``prefix``).
 * ``kvcache`` — the cache pytree ops (init/export/import, int8).
 * ``init_kv_cache`` — re-exported model-geometry cache constructor.
+* ``ServeTracer``, ``tracer`` — the request-scoped span ledger +
+  goodput attribution (``tracing``; ``HVD_TPU_SERVE_TRACE``).
 """
 
 from __future__ import annotations
@@ -42,10 +44,12 @@ _LAZY = {
     "ServeCluster": ("controller", "ServeCluster"),
     "PrefixCache": ("prefix", "PrefixCache"),
     "init_kv_cache": ("..models.gpt", "init_kv_cache"),
+    "ServeTracer": ("tracing", "ServeTracer"),
+    "tracer": ("tracing", "tracer"),
 }
 
 _LAZY_MODULES = ("kvcache", "queue", "batcher", "engine", "controller",
-                 "traffic", "prefix")
+                 "traffic", "prefix", "tracing")
 
 __all__ = sorted(list(_LAZY) + list(_LAZY_MODULES))
 

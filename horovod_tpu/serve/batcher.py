@@ -74,7 +74,15 @@ class ContinuousBatcher:
         self.queue = queue if queue is not None else RequestQueue()
         self.name = engine.name
         self.role = role
+        # Admission telemetry (queue spans / queue-wait histogram)
+        # carries the replica identity via the queue.
+        self.queue.role = role
+        self.queue.replica = self.name
         self.draining = False
+        # Goodput attribution for the last run_step round: "prefill" /
+        # "decode" when the round did useful work, "idle" when slots
+        # sat empty, "drain" while draining (tracing.GOODPUT_STATES).
+        self.last_round_state = "idle"
         self.completed: List[Request] = []
         self.events: List[Tuple] = []
         self.outbox: List[Tuple] = []
@@ -103,7 +111,7 @@ class ContinuousBatcher:
         return (self.draining and self.engine.active_count() == 0
                 and len(self.queue) == 0)
 
-    def migrate_requests(self) -> List[Tuple]:
+    def migrate_requests(self, now: Optional[float] = None) -> List[Tuple]:
         """Graceful-drain step 2, warm-handoff form (the DEFAULT —
         docs/serve.md): every in-flight sequence leaves WITH its int8
         block-scaled cache blob and generated-so-far tokens, so a peer
@@ -115,7 +123,8 @@ class ContinuousBatcher:
         for slot, req in enumerate(self.engine.requests):
             if req is None:
                 continue
-            req, blob, generated = self.engine.migrate_out(slot)
+            req, blob, generated = self.engine.migrate_out(
+                slot, now, kind="migrate")
             self.events.append((self.steps, "migrate_out", req.rid,
                                 len(generated)))
             out.append((req, blob, generated))
@@ -135,12 +144,12 @@ class ContinuousBatcher:
         replicas only — a draining replica never admits)."""
         return 0 if self.draining else len(self.engine.free_slots())
 
-    def abort(self) -> List[Request]:
+    def abort(self, now: Optional[float] = None) -> List[Request]:
         """Replica kill: queued AND in-flight requests come back for
         re-routing (in-flight restart from their prompts on a peer —
         zero dropped requests)."""
         out = self.start_drain(cause="kill")
-        aborted = self.engine.abort_all()
+        aborted = self.engine.abort_all(now)
         for req in aborted:
             self.events.append((self.steps, "abort", req.rid))
         return out + aborted
@@ -151,10 +160,12 @@ class ContinuousBatcher:
         """One admit/decode/retire round; returns the requests that
         completed this round."""
         finished: List[Request] = []
+        admitted = 0
         if not self.draining and self.role != "decode":
             for req in self.queue.take(len(self.engine.free_slots()),
                                        now):
                 slot = self.engine.admit(req, now)
+                admitted += 1
                 self.events.append((self.steps, "admit", req.rid, slot))
                 if self.engine.request_done(slot):
                     # 1-token/instant-EOS request: complete at prefill.
@@ -163,7 +174,8 @@ class ContinuousBatcher:
                     # Disaggregation: the freshly prefilled slot leaves
                     # NOW as a warm-KV wire blob; the cluster hands it
                     # to the decode pool this same round.
-                    handoff = self.engine.migrate_out(slot)
+                    handoff = self.engine.migrate_out(slot, now,
+                                                      kind="handoff")
                     self.outbox.append(handoff)
                     self.events.append((self.steps, "handoff_out",
                                         handoff[0].rid))
@@ -173,6 +185,16 @@ class ContinuousBatcher:
         _M_OCCUPANCY.labels(replica=self.name).set(occ)
         if self.role != "prefill":
             finished.extend(self.engine.step(now))
+        if self.draining:
+            self.last_round_state = "drain"
+        elif self.role == "prefill":
+            self.last_round_state = "prefill" if admitted else "idle"
+        elif occ > 0.0 or finished:
+            # A round that prefilled into a mixed replica still decodes
+            # the same step, so "decode" wins the attribution.
+            self.last_round_state = "decode"
+        else:
+            self.last_round_state = "idle"
         for req in finished:
             self.events.append((self.steps, "finish", req.rid,
                                 len(req.tokens)))

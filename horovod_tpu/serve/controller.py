@@ -41,6 +41,7 @@ import numpy as np
 
 from ..common.autoscale import Decision
 from ..common import metrics as metrics_lib
+from . import tracing
 from .batcher import ContinuousBatcher
 from .engine import DecodeEngine
 from .queue import Request
@@ -75,6 +76,14 @@ class SLOPolicy:
     window: int = 16
     # Grow when the windowed p99 exceeds this (0 = off).
     target_p99_s: float = 0.0
+    # Per-phase SLOs over the same completion window (0 = off), fed by
+    # the request timeline the tracer stamps (docs/serve.md "Tracing &
+    # goodput"). TTFT pressure is admission/prefill pressure — grow the
+    # PREFILL pool; TPOT pressure is decode cadence pressure — grow the
+    # DECODE pool. Classic (non-disagg) clusters grow an undifferentiated
+    # replica either way.
+    ttft_target_s: float = 0.0
+    tpot_target_s: float = 0.0
     # Grow when total queued requests exceed this (0 = off).
     max_queue_depth: int = 0
     # Drain one replica when instantaneous slot occupancy falls below
@@ -140,7 +149,8 @@ class SLOPolicy:
         return policy
 
     def validate(self) -> "SLOPolicy":
-        for name in ("tick_interval_s", "target_p99_s", "low_occupancy",
+        for name in ("tick_interval_s", "target_p99_s", "ttft_target_s",
+                     "tpot_target_s", "low_occupancy",
                      "grow_cooldown_s", "shrink_cooldown_s"):
             if getattr(self, name) < 0:
                 raise ValueError(
@@ -231,6 +241,8 @@ class ServeController:
         self.decisions: List[Decision] = []
         self._seq = 0
         self._latencies: deque = deque(maxlen=max(1, policy.window))
+        self._ttfts: deque = deque(maxlen=max(1, policy.window))
+        self._tpots: deque = deque(maxlen=max(1, policy.window))
         self._last_grow_t = -float("inf")
         self._last_shrink_t = -float("inf")
         self._last_tick_t = -float("inf")
@@ -240,11 +252,25 @@ class ServeController:
     def observe_completion(self, req: Request) -> None:
         if req.latency_s is not None:
             self._latencies.append(req.latency_s)
+        if req.ttft_s is not None:
+            self._ttfts.append(req.ttft_s)
+        if req.tpot_s is not None:
+            self._tpots.append(req.tpot_s)
+
+    @staticmethod
+    def _windowed(window: deque) -> Optional[float]:
+        if not window:
+            return None
+        return float(np.percentile(np.asarray(window), 99))
 
     def windowed_p99(self) -> Optional[float]:
-        if not self._latencies:
-            return None
-        return float(np.percentile(np.asarray(self._latencies), 99))
+        return self._windowed(self._latencies)
+
+    def windowed_ttft_p99(self) -> Optional[float]:
+        return self._windowed(self._ttfts)
+
+    def windowed_tpot_p99(self) -> Optional[float]:
+        return self._windowed(self._tpots)
 
     # -- decision plumbing (the autoscale contract) --------------------------
 
@@ -324,6 +350,24 @@ class ServeController:
                 return self._record(Decision(
                     action="grow", target=_grow_target("decode"),
                     reason="slo_p99"))
+        if grow_ok and p.ttft_target_s > 0:
+            # TTFT = arrival -> first token: the pressure lives in
+            # admission + prefill, so the prefill pool grows.
+            ttft = self.windowed_ttft_p99()
+            if ttft is not None and ttft > p.ttft_target_s:
+                self._last_grow_t = now
+                return self._record(Decision(
+                    action="grow", target=_grow_target("prefill"),
+                    reason="slo_ttft"))
+        if grow_ok and p.tpot_target_s > 0:
+            # TPOT = decode cadence after the first token: decode
+            # slots are the bottleneck, so the decode pool grows.
+            tpot = self.windowed_tpot_p99()
+            if tpot is not None and tpot > p.tpot_target_s:
+                self._last_grow_t = now
+                return self._record(Decision(
+                    action="grow", target=_grow_target("decode"),
+                    reason="slo_tpot"))
         if grow_ok and p.max_queue_depth > 0 \
                 and queue_depth > p.max_queue_depth:
             self._last_grow_t = now
@@ -391,6 +435,10 @@ class ServeCluster:
         self._now = 0.0
         self.controller = ServeController(self.policy,
                                           log_path=log_path)
+        # A cluster run is one trace session: the ledger resets here so
+        # seeded repeat runs produce byte-identical summaries.
+        self.tracer = tracing.tracer()
+        self.tracer.begin_session()
         self.host_manager = host_manager
         self.host_of = host_of or (lambda name: name)
         self.batchers: Dict[str, ContinuousBatcher] = {}
@@ -463,6 +511,7 @@ class ServeCluster:
         b_role = role or "mixed"
         self.batchers[name] = ContinuousBatcher(self.factory(name),
                                                 role=b_role)
+        self.tracer.set_role(name, b_role)
         if self.disagg:
             self.events.append((self.rounds, "replica_start", name,
                                 b_role))
@@ -508,7 +557,7 @@ class ServeCluster:
         b = self.batchers.pop(name, None)
         if b is None:
             return
-        rerouted = b.abort()
+        rerouted = b.abort(self._now)
         if b.outbox:
             # Blobs exported this round but not yet pumped: still
             # valid, deliver them normally.
@@ -533,6 +582,8 @@ class ServeCluster:
     # -- routing -------------------------------------------------------------
 
     def submit(self, req: Request) -> None:
+        if self.tracer.enabled:
+            self.tracer.enqueue(req, self._now)
         if not self._route(req):
             self.overflow.append(req)
 
@@ -614,7 +665,7 @@ class ServeCluster:
         WITH its int8-wire cache blob; a sequence with no free peer
         slot falls back to a re-prefill re-route. Either way the
         drained replica empties NOW and leaves on the next tick."""
-        moved = self.batchers[target].migrate_requests()
+        moved = self.batchers[target].migrate_requests(self._now)
         t_role = self.batchers[target].role
         for req, blob, generated in moved:
             # A warm blob must land on a like-for-like peer: in
@@ -742,6 +793,9 @@ class ServeCluster:
                 for req in b.run_step(self._now):
                     self.completed.append(req)
                     self.controller.observe_completion(req)
+                if self.tracer.enabled:
+                    self.tracer.account(name, b.last_round_state,
+                                        self.step_s)
                 if b.outbox:
                     self.pending_handoffs.extend(b.outbox)
                     b.outbox = []
@@ -754,6 +808,9 @@ class ServeCluster:
                     for req in b.run_step(self._now):
                         self.completed.append(req)
                         self.controller.observe_completion(req)
+                    if self.tracer.enabled:
+                        self.tracer.account(name, b.last_round_state,
+                                            self.step_s)
             self.rounds += 1
             self._now += self.step_s
             if not pending and not self.queue_depth() \
@@ -762,12 +819,26 @@ class ServeCluster:
                             for b in self.batchers.values()):
                 break
         wall_s = self._clock() - wall0
+        self.tracer.maybe_dump()
         return self.report(len(trace.requests), wall_s)
 
     def report(self, submitted: int, wall_s: float = 0.0) -> Dict:
         lats = [r.latency_s for r in self.completed
                 if r.latency_s is not None]
         arr = np.asarray(lats) if lats else np.zeros((1,))
+
+        def _pcts(vals):
+            a = np.asarray(vals) if vals else np.zeros((1,))
+            return (round(float(np.percentile(a, 50)), 6),
+                    round(float(np.percentile(a, 99)), 6))
+
+        ttft_p50, ttft_p99 = _pcts(
+            [r.ttft_s for r in self.completed if r.ttft_s is not None])
+        tpot_p50, tpot_p99 = _pcts(
+            [r.tpot_s for r in self.completed if r.tpot_s is not None])
+        qw_p50, qw_p99 = _pcts(
+            [r.queue_wait_s for r in self.completed
+             if r.queue_wait_s is not None])
         gen_tokens = sum(len(r.tokens) for r in self.completed)
         occ = [b.mean_occupancy() for b in self.batchers.values()
                if b.steps]
@@ -804,6 +875,16 @@ class ServeCluster:
             "wall_s": round(wall_s, 3),
             "latency_p50_s": round(float(np.percentile(arr, 50)), 6),
             "latency_p99_s": round(float(np.percentile(arr, 99)), 6),
+            # Per-phase percentiles from the request timeline (the
+            # tracer's span metrics aggregate the same stamps).
+            "ttft_p50_s": ttft_p50,
+            "ttft_p99_s": ttft_p99,
+            "tpot_p50_s": tpot_p50,
+            "tpot_p99_s": tpot_p99,
+            "queue_wait_p50_s": qw_p50,
+            "queue_wait_p99_s": qw_p99,
+            # Per-replica goodput attribution ({} with tracing off).
+            "goodput": self.tracer.goodput_snapshot(),
             "generated_tokens": gen_tokens,
             "tokens_per_virtual_s": round(
                 gen_tokens / self._now, 3) if self._now else 0.0,

@@ -55,6 +55,7 @@ import numpy as np
 from ..common import flightrec as flightrec_lib
 from ..common import metrics as metrics_lib
 from . import kvcache as kv_lib
+from . import tracing
 from .queue import Request, record_completion
 
 _M_TOKENS = metrics_lib.counter(
@@ -255,6 +256,7 @@ class DecodeEngine:
                                                 dsingle)
         self.requests[slot] = req
         req.replica = self.name
+        req.first_token_t = now
         tok = int(first)
         self.generated[slot] = [tok]
         self.last_tokens[slot] = tok
@@ -262,6 +264,11 @@ class DecodeEngine:
         _M_TOKENS.labels(kind="prompt").inc(len(remainder))
         _M_TOKENS.labels(kind="generated").inc()
         _M_ACTIVE.inc()
+        tr = tracing.tracer()
+        if tr.enabled:
+            if base:
+                tr.prefix_fork(req.rid, self.name, now, base)
+            tr.prefill(req, self.name, now, len(remainder))
         return slot
 
     # -- the decode step -----------------------------------------------------
@@ -313,7 +320,8 @@ class DecodeEngine:
             raise
         rec.annotate(step_name,
                      nbytes=kv_lib.cache_nbytes(self.cache),
-                     wire=self.kv_kind)
+                     wire=self.kv_kind,
+                     trace=self._trace_csv() if rec.enabled else None)
         rec.record_complete(step_name)
         self.decode_steps += 1
         finished: List[Request] = []
@@ -390,6 +398,7 @@ class DecodeEngine:
         new_pos = np.zeros((self.slots,), np.int32)
         finished: List[Request] = []
         done_slots: List[int] = []
+        tr = tracing.tracer()
         for slot, req in enumerate(self.requests):
             if req is None:
                 continue
@@ -407,6 +416,8 @@ class DecodeEngine:
             self.spec_accepted += m
             _M_SPEC.labels(outcome="accepted").inc(m)
             _M_SPEC.labels(outcome="rejected").inc(k - m)
+            if tr.enabled:
+                tr.spec_round(req.rid, self.name, now, m, k)
             committed = 0
             done = False
             for i in range(m + 1):
@@ -430,7 +441,8 @@ class DecodeEngine:
             finished.append(self.retire(slot, now))
         rec.annotate(step_name,
                      nbytes=kv_lib.cache_nbytes(self.cache),
-                     wire=self.kv_kind)
+                     wire=self.kv_kind,
+                     trace=self._trace_csv() if rec.enabled else None)
         rec.record_complete(step_name)
         self.decode_steps += 1
         self.spec_rounds += 1
@@ -441,6 +453,13 @@ class DecodeEngine:
         engine's speculative rounds (0 when none ran)."""
         return (self.spec_accepted / self.spec_proposed
                 if self.spec_k and self.spec_proposed else 0.0)
+
+    def _trace_csv(self) -> str:
+        """Active request ids as a CSV — the trace-correlation stamp the
+        flight recorder carries per decode event (``analyze_serve.py
+        --flight`` joins on it)."""
+        return ",".join(str(r.rid) for r in self.requests
+                        if r is not None)
 
     def request_done(self, slot: int) -> bool:
         """True when the slot's sequence already hit its stop condition
@@ -459,6 +478,7 @@ class DecodeEngine:
         req.tokens = tuple(self.generated[slot])
         req.finish_t = now
         record_completion(req)
+        tracing.tracer().retire(req, self.name, now)
         self.requests[slot] = None
         self.generated[slot] = []
         self.cache = self._reset_slot(self.cache, slot)
@@ -469,15 +489,18 @@ class DecodeEngine:
 
     # -- drain / teardown ----------------------------------------------------
 
-    def abort_all(self) -> List[Request]:
+    def abort_all(self, now: Optional[float] = None) -> List[Request]:
         """Hard abort (replica kill): every in-flight request comes
         back UNFINISHED for re-routing — generated tokens are dropped
         and the peer re-prefills from the prompt (no dropped
         requests, docs/serve.md drain runbook)."""
         out = []
+        tr = tracing.tracer()
         for slot, req in enumerate(self.requests):
             if req is None:
                 continue
+            if tr.enabled:
+                tr.abort(req, self.name, now)
             req.reroutes += 1
             req.replica = None
             out.append(req)
@@ -497,16 +520,26 @@ class DecodeEngine:
         re-prefill."""
         return kv_lib.export_slot(self.cache, slot)
 
-    def migrate_out(self, slot: int):
+    def migrate_out(self, slot: int, now: Optional[float] = None,
+                    kind: str = "migrate"):
         """Evict one in-flight sequence WITH its warm state: returns
         ``(request, wire_blob, generated_tokens)`` — the int8
         block-scaled cache export plus the host-side decode state a
         peer needs to continue mid-sequence (the graceful-drain default,
-        docs/serve.md). The slot frees immediately; nothing completes."""
+        docs/serve.md). The slot frees immediately; nothing completes.
+        When tracing is on the trace stamp rides the blob (top-level
+        ``"trace"`` key — ``kvcache.import_slot`` only reads ``layers``
+        / ``pos`` / ``slot_pos``, so the transport is unchanged) and
+        ``admit_migrated`` on the destination closes the wire span."""
         req = self.requests[slot]
         if req is None:
             raise RuntimeError(f"replica {self.name}: slot {slot} empty")
         blob = kv_lib.export_slot(self.cache, slot)
+        tr = tracing.tracer()
+        if tr.enabled:
+            stamp = tr.export(req, self.name, now, kind)
+            if stamp is not None:
+                blob["trace"] = stamp
         generated = list(self.generated[slot])
         self.requests[slot] = None
         self.generated[slot] = []
@@ -530,7 +563,11 @@ class DecodeEngine:
         if not free:
             raise RuntimeError(f"replica {self.name}: no free slot")
         slot = free[0]
+        stamp = blob.pop("trace", None) if isinstance(blob, dict) else None
         self.cache = kv_lib.import_slot(self.cache, slot, blob)
+        tr = tracing.tracer()
+        if tr.enabled:
+            tr.import_blob(req, self.name, now, stamp)
         self.requests[slot] = req
         req.replica = self.name
         req.migrations += 1

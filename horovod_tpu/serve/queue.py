@@ -23,6 +23,7 @@ from collections import deque
 from typing import List, Optional, Tuple
 
 from ..common import metrics as metrics_lib
+from . import tracing
 
 _M_QUEUE_DEPTH = metrics_lib.gauge(
     "hvd_tpu_serve_queue_depth",
@@ -64,12 +65,42 @@ class Request:
     replica: Optional[str] = None
     reroutes: int = 0
     migrations: int = 0
+    # Per-phase timeline (virtual seconds). ``admit_t`` is stamped by
+    # ``RequestQueue.take`` at every admission (a re-admission after a
+    # kill or reroute overwrites it — the phases below describe the
+    # attempt that completed); ``first_token_t`` by the prefill that
+    # emitted token 0.
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
 
     @property
     def latency_s(self) -> Optional[float]:
         if self.finish_t is None:
             return None
         return self.finish_t - self.arrival_t
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        """Arrival -> (last) admission onto a replica's decode slots."""
+        if self.admit_t is None:
+            return None
+        return self.admit_t - self.arrival_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token: arrival -> prefill emits token 0."""
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.arrival_t
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Time per output token after the first (decode cadence)."""
+        if (self.finish_t is None or self.first_token_t is None
+                or len(self.tokens) < 2):
+            return None
+        return ((self.finish_t - self.first_token_t)
+                / (len(self.tokens) - 1))
 
     @property
     def deadline_missed(self) -> bool:
@@ -92,6 +123,10 @@ class RequestQueue:
         self._lock = threading.Lock()
         self.submitted = 0
         self.rejected = 0
+        # Stamped by the owning batcher so admission telemetry carries
+        # the replica identity (standalone queues default to "mixed").
+        self.role = "mixed"
+        self.replica = ""
 
     def submit(self, req: Request) -> bool:
         """Enqueue; False when the queue is at maxsize (the router
@@ -106,11 +141,20 @@ class RequestQueue:
             return True
 
     def take(self, n: int, now: float = 0.0) -> List[Request]:
+        """Dequeue up to ``n`` requests for admission at virtual time
+        ``now``: stamps ``admit_t`` on each request and records its
+        time-in-queue (the queue-wait histogram + a ``queue`` span)."""
         out: List[Request] = []
         with self._lock:
             while self._q and len(out) < int(n):
                 out.append(self._q.popleft())
             _M_QUEUE_DEPTH.dec(len(out))
+        if out:
+            tr = tracing.tracer()
+            for req in out:
+                req.admit_t = now
+                if tr.enabled:
+                    tr.queue_admit(req, self.replica, now)
         return out
 
     def requeue_front(self, reqs: List[Request]) -> None:

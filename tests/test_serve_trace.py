@@ -1,0 +1,367 @@
+"""Request-scoped tracing + goodput attribution (docs/serve.md
+"Tracing & goodput"): the span ledger's determinism contract, the
+NOOP-singleton zero-cost disable, cross-pool trace reassembly over the
+warm-KV stamp, the kill-salvage journey, the SLO controller's
+ttft/tpot triggers, and the /pod/serve + analyze_serve surfaces."""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.models import gpt_tiny
+from horovod_tpu.serve import tracing
+from horovod_tpu.serve.controller import (SLOPolicy, ServeCluster,
+                                          ServeController)
+from horovod_tpu.serve.engine import make_engine_factory
+from horovod_tpu.serve.queue import Request, RequestQueue
+from horovod_tpu.serve.traffic import poisson_trace
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    m = gpt_tiny()
+    params = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    return m, params
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Every test starts from dropped singletons (the knob is read per
+    tracer() call, so monkeypatched envs take effect after a reset)."""
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def _run_disagg(tiny, seed=5, n=16, roles=None, round_hook=None):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=4, max_len=32,
+                                  max_prompt_len=16)
+    trace = poisson_trace(seed=seed, n_requests=n, rate_rps=20.0)
+    cluster = ServeCluster(
+        factory, policy=SLOPolicy(),
+        roles=roles or {"prefill": 1, "decode": 2},
+        step_s=0.05, log_path="")
+    report = cluster.run(trace, round_hook=round_hook)
+    return cluster, report
+
+
+# -- the admission timeline (satellite: the dead take(n, now) param) ---------
+
+def test_take_stamps_admit_time_and_queue_wait():
+    q = RequestQueue(maxsize=4)
+    req = Request(rid=0, prompt=(1, 2), max_new_tokens=2, arrival_t=0.2)
+    assert req.queue_wait_s is None and req.ttft_s is None \
+        and req.tpot_s is None
+    q.submit(req)
+    out = q.take(1, now=0.7)
+    assert out == [req]
+    assert req.admit_t == 0.7
+    assert req.queue_wait_s == pytest.approx(0.5)
+
+
+def test_request_phase_properties_from_timeline():
+    req = Request(rid=1, prompt=(1,), max_new_tokens=3, arrival_t=1.0,
+                  admit_t=1.5, first_token_t=2.0, finish_t=4.0,
+                  tokens=(7, 8, 9))
+    assert req.ttft_s == pytest.approx(1.0)
+    assert req.tpot_s == pytest.approx(1.0)  # (4.0 - 2.0) / (3 - 1)
+    assert req.queue_wait_s == pytest.approx(0.5)
+    single = Request(rid=2, prompt=(1,), max_new_tokens=1, arrival_t=0.0,
+                     first_token_t=0.1, finish_t=0.1, tokens=(7,))
+    assert single.tpot_s is None  # cadence needs >= 2 tokens
+
+
+# -- the tracer core ---------------------------------------------------------
+
+def test_noop_singleton_records_nothing(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_SERVE_TRACE", "0")
+    tracing.reset()
+    tr = tracing.tracer()
+    assert tr is tracing.tracer()  # one shared instance
+    assert not tr.enabled
+    req = Request(rid=0, prompt=(1,), max_new_tokens=1, arrival_t=0.0)
+    tr.enqueue(req)
+    tr.queue_admit(req, "r0", 0.5)
+    tr.account("r0", "decode", 0.05)
+    assert tr.export(req, "r0", 1.0, "handoff") is None
+    assert tr.span_count() == 0
+    assert tr.goodput_snapshot() == {}
+
+
+def test_tracer_eviction_cap_counts_dropped_traces():
+    tr = tracing.ServeTracer(enabled=True, size=2)
+    for rid in range(3):
+        req = Request(rid=rid, prompt=(1,), max_new_tokens=1,
+                      arrival_t=0.0, admit_t=0.0, tokens=(5,),
+                      first_token_t=0.1, finish_t=0.1)
+        tr.span(rid, "enqueue", "", 0.0, 0.0)
+        tr.retire(req, "r0", 0.1)
+    assert tr.dropped_traces == 1
+    assert tr.rids() == [1, 2]
+    assert tr.summary()["dropped_traces"] == 1
+
+
+def test_requeue_after_abort_measures_wait_since_abort():
+    """A salvage re-admission must not re-bill the original queue wait:
+    the next queue span starts where the abort span ended."""
+    tr = tracing.ServeTracer(enabled=True)
+    req = Request(rid=9, prompt=(1,), max_new_tokens=2, arrival_t=0.0)
+    tr.enqueue(req)
+    tr.queue_admit(req, "r0", 1.0)
+    tr.abort(req, "r0", 2.0)
+    tr.queue_admit(req, "r1", 5.0)
+    queues = [s for s in tr.trace(9) if s["phase"] == "queue"]
+    assert [(s["t0"], s["t1"]) for s in queues] == [(0.0, 1.0),
+                                                    (2.0, 5.0)]
+    assert tr.orphans() == [9]  # no retire yet
+
+
+# -- the SLO feedback loop ---------------------------------------------------
+
+def test_slo_policy_validates_ttft_tpot_targets():
+    with pytest.raises(ValueError, match="ttft_target_s"):
+        SLOPolicy.from_dict({"ttft_target_s": -0.1})
+    with pytest.raises(ValueError, match="tpot_target_s"):
+        SLOPolicy.from_dict({"tpot_target_s": -1})
+    pol = SLOPolicy.from_dict({"ttft_target_s": 0.5,
+                               "tpot_target_s": 0.05})
+    assert pol.ttft_target_s == 0.5 and pol.tpot_target_s == 0.05
+
+
+def _completed(rid, arrival, first_token, finish, ntok):
+    return Request(rid=rid, prompt=(1,), max_new_tokens=ntok,
+                   arrival_t=arrival, admit_t=arrival,
+                   first_token_t=first_token, finish_t=finish,
+                   tokens=tuple(range(ntok)))
+
+
+def test_controller_ttft_grows_prefill_tpot_grows_decode():
+    """TTFT pressure is admission+prefill capacity -> the prefill pool
+    grows; TPOT pressure is decode cadence -> the decode pool grows."""
+    c = ServeController(SLOPolicy(ttft_target_s=0.2,
+                                  grow_cooldown_s=0.0), log_path="")
+    for rid in range(4):
+        c.observe_completion(_completed(rid, 0.0, 0.9, 1.0, 4))
+    d = c.tick(now=1.0, live=3, draining=0, queue_depth=0,
+               occupancy=0.9, below_min=False, disagg=True)
+    assert (d.action, d.target, d.reason) == \
+        ("grow", "prefill:1", "slo_ttft")
+
+    c2 = ServeController(SLOPolicy(tpot_target_s=0.05,
+                                   grow_cooldown_s=0.0), log_path="")
+    for rid in range(4):
+        c2.observe_completion(_completed(rid, 0.0, 0.1, 1.0, 4))
+    d = c2.tick(now=1.0, live=3, draining=0, queue_depth=0,
+                occupancy=0.9, below_min=False, disagg=True)
+    assert (d.action, d.target, d.reason) == \
+        ("grow", "decode:1", "slo_tpot")
+    # Under target: keep.
+    c3 = ServeController(SLOPolicy(ttft_target_s=5.0,
+                                   tpot_target_s=5.0,
+                                   grow_cooldown_s=0.0), log_path="")
+    for rid in range(4):
+        c3.observe_completion(_completed(rid, 0.0, 0.1, 1.0, 4))
+    d = c3.tick(now=1.0, live=3, draining=0, queue_depth=0,
+                occupancy=0.9, below_min=False, disagg=True)
+    assert d.action == "keep"
+
+
+# -- the engine transport (the stamp rides the warm-KV blob) -----------------
+
+def test_export_stamp_rides_warm_kv_blob(tiny):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=16,
+                                  max_prompt_len=8)
+    src, dst = factory("r0"), factory("r1")
+    tr = tracing.tracer()
+    req = Request(rid=3, prompt=(1, 2, 3), max_new_tokens=4,
+                  arrival_t=0.0, admit_t=0.0)
+    src.admit(req, now=0.1)
+    out, blob, generated = src.migrate_out(0, now=0.2, kind="handoff")
+    assert out is req
+    assert blob["trace"] == {"rid": 3, "t": 0.2, "kind": "handoff"}
+    dst.admit_migrated(req, blob, generated, now=0.4)
+    phases = [s["phase"] for s in tr.trace(3)]
+    assert phases.count("handoff_export") == 1
+    wire = [s for s in tr.trace(3) if s["phase"] == "handoff_wire"]
+    assert wire and (wire[0]["t0"], wire[0]["t1"]) == (0.2, 0.4) \
+        and wire[0]["replica"] == "r1"
+    assert "handoff_import" in phases
+    # The stamp was consumed before import_slot saw the blob.
+    assert "trace" not in blob
+
+
+def test_export_stamp_absent_when_disabled(tiny, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_SERVE_TRACE", "0")
+    tracing.reset()
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=16,
+                                  max_prompt_len=8)
+    src = factory("r0")
+    req = Request(rid=4, prompt=(1, 2), max_new_tokens=2,
+                  arrival_t=0.0, admit_t=0.0)
+    src.admit(req, now=0.1)
+    _, blob, _ = src.migrate_out(0, now=0.2, kind="handoff")
+    assert "trace" not in blob
+
+
+# -- cluster journeys --------------------------------------------------------
+
+def test_cross_pool_journey_reassembles_one_trace(tiny):
+    """A request prefilled on the prefill pool and decoded on the
+    decode pool is ONE ledger: queue -> prefill -> export -> wire ->
+    import -> decode -> retire, spanning replicas of both roles."""
+    cluster, report = _run_disagg(tiny)
+    assert report["dropped"] == 0
+    tr = tracing.tracer()
+    assert tr is cluster.tracer
+    assert tr.orphans() == []
+    crossed = 0
+    for req in cluster.completed:
+        spans = tr.trace(req.rid)
+        phases = [s["phase"] for s in spans]
+        assert phases[0] == "enqueue" and phases[-1] == "retire"
+        assert "queue" in phases and "prefill" in phases
+        if "handoff_wire" in phases:
+            crossed += 1
+            roles = {s["role"] for s in spans if s["replica"]}
+            assert roles == {"prefill", "decode"}
+    assert crossed >= 1
+    # Goodput attribution covered every replica and sums to the run.
+    gp = tr.goodput_snapshot()
+    assert set(gp) == set(report["goodput"])
+    assert tr.goodput_fraction() is not None
+    assert any("decode" in per for per in gp.values())
+    assert any("prefill" in per for per in gp.values())
+    # The report's per-phase percentiles populated.
+    for key in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+                "queue_wait_p50_s", "queue_wait_p99_s"):
+        assert report[key] is not None and report[key] >= 0.0
+
+
+def test_trace_summary_byte_identical_across_seeded_repeats(tiny):
+    _, _ = _run_disagg(tiny, seed=7)
+    s1 = json.dumps(tracing.tracer().summary(), sort_keys=True)
+    d1 = tracing.tracer().digest()
+    _, _ = _run_disagg(tiny, seed=7)
+    s2 = json.dumps(tracing.tracer().summary(), sort_keys=True)
+    assert s1 == s2
+    assert d1 == tracing.tracer().digest()
+
+
+def test_trace_off_restores_event_digest_bit_exactly(tiny, monkeypatch):
+    """HVD_TPU_SERVE_TRACE=0 must leave the seeded event + decision
+    sequences bit-identical to the traced run — the tracer is an
+    observer, never a participant."""
+    _, rep_on = _run_disagg(tiny, seed=11)
+    assert tracing.tracer().span_count() > 0
+    monkeypatch.setenv("HVD_TPU_SERVE_TRACE", "0")
+    tracing.reset()
+    _, rep_off = _run_disagg(tiny, seed=11)
+    assert not tracing.tracer().enabled
+    assert tracing.tracer().span_count() == 0
+    assert rep_off["goodput"] == {}
+    assert rep_on["events"] == rep_off["events"]
+    assert rep_on["decisions"] == rep_off["decisions"]
+    # Timeline percentiles survive the disable (unconditional stamps).
+    assert rep_off["ttft_p99_s"] == rep_on["ttft_p99_s"]
+    assert rep_off["queue_wait_p99_s"] == rep_on["queue_wait_p99_s"]
+
+
+def test_kill_mid_stream_salvage_leaves_no_orphans(tiny):
+    """Kill a decode replica while it holds in-flight sequences: every
+    journey still closes (abort span, then the salvage re-queue /
+    re-prefill continues under the SAME rid) and the ledger reports
+    zero orphans."""
+    killed = []
+
+    def hook(c, round_idx):
+        if killed or "r1" not in c.batchers:
+            return
+        engine = c.batchers["r1"].engine
+        if any(r is not None for r in engine.requests):
+            killed.append("r1")
+            c.kill_replica("r1")
+
+    cluster, report = _run_disagg(tiny, seed=13, n=20, round_hook=hook)
+    assert killed and report["dropped"] == 0
+    assert report["completed"] == 20
+    tr = tracing.tracer()
+    assert tr.orphans() == []
+    aborted = [rid for rid in tr.rids()
+               if any(s["phase"] == "abort" for s in tr.trace(rid))]
+    assert aborted, "the kill must have dropped in-flight state"
+    for rid in aborted:
+        phases = [s["phase"] for s in tr.trace(rid)]
+        # The salvage continues the SAME trace past the abort.
+        assert phases.index("retire") > phases.index("abort")
+
+
+# -- surfaces ----------------------------------------------------------------
+
+def test_pod_serve_view_and_text(tiny):
+    from horovod_tpu.common.podmon import PodMonitor
+
+    _run_disagg(tiny, seed=3)
+    mon = PodMonitor(lambda: [], interval_s=999)
+    view = mon.serve_view()
+    assert view["enabled"] and view["requests"] == 16
+    assert view["orphans"] == 0
+    assert 0.0 < view["goodput_fraction"] <= 1.0
+    assert "decode" in view["roles"] and "prefill" in view["roles"]
+    assert view["slowest"] and view["slowest"][0]["spans"]
+    txt = mon.serve_text()
+    assert "tracing_enabled True" in txt
+    assert "goodput_fraction" in txt
+    assert "slowest rid=" in txt
+
+
+def test_dump_and_analyze_serve_roundtrip(tiny, tmp_path, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_SERVE_TRACE_DIR", str(tmp_path))
+    _run_disagg(tiny, seed=5)
+    dump = tmp_path / "serve_trace.jsonl"
+    assert dump.exists()
+
+    from tools import analyze_serve
+    meta, traces = analyze_serve.load_dump(str(tmp_path))
+    assert meta["goodput"] and len(traces) == 16
+    report = analyze_serve.analyze(meta, traces, top=2)
+    assert report["requests"] == 16
+    assert report["goodput_fraction"] is not None
+    assert len(report["waterfalls"]) == 2
+    assert report["verdicts"]
+    assert "spent" in report["verdicts"][0] \
+        and report["verdicts"][0].startswith("rid ")
+    # Schema defects are named, never silently empty.
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"schema": 99}) + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        analyze_serve.load_dump(str(bad))
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text(
+        json.dumps({"schema": 1}) + "\n"
+        + json.dumps({"rid": 0, "spans": [{"rid": 0}]}) + "\n")
+    with pytest.raises(ValueError, match="missing keys"):
+        analyze_serve.load_dump(str(torn))
+
+
+def test_analyze_serve_schema_matches_writer():
+    from tools import analyze_serve
+    assert analyze_serve.TRACE_SPAN_KEYS == tracing.TRACE_SPAN_KEYS
+    assert analyze_serve.TRACE_SCHEMA_VERSION \
+        == tracing.TRACE_SCHEMA_VERSION
+
+
+def test_lazy_tracing_exports():
+    import horovod_tpu.serve as serve
+    assert serve.tracer is tracing.tracer
+    assert serve.ServeTracer is tracing.ServeTracer
+    assert serve.tracing is tracing

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Post-mortem for a serve-plane trace dump (docs/serve.md "Tracing &
+goodput", docs/troubleshooting.md "diagnosing a slow request").
+
+Reads the JSONL span ledger the request tracer writes
+(``HVD_TPU_SERVE_TRACE_DIR`` -> ``serve_trace.jsonl``;
+``horovod_tpu/serve/tracing.py`` is the writer) and reports:
+
+* per-request WATERFALLS for the slowest journeys — every span in
+  order (enqueue -> queue -> prefill -> handoff export/wire/import ->
+  decode -> spec -> migrate -> retire) with durations, so a
+  cross-pool request reads as one record;
+* pod-level percentiles per phase (ttft / tpot / queue wait /
+  handoff) and the per-replica goodput ledger + goodput fraction;
+* p99-exemplar VERDICTS — "rid 412 spent 78% of its 2.1s in handoff
+  wire wait on decode:1" — naming the dominant phase of each slow
+  request;
+* with ``--flight DIR``, correlation against flight-recorder black
+  boxes: serve decode events carry a request-id CSV in their
+  ``trace`` field (blackbox schema v3), so each slow request maps to
+  the decode events/replicas that actually served it.
+
+Usage:
+
+    python tools/analyze_serve.py results/serve_trace/serve_trace.jsonl \
+        [--flight results/flightrec] [--top 3]
+
+A directory argument looks for ``serve_trace.jsonl`` inside it.
+Prints ONE JSON object; degrades gracefully (``note`` fields, rc 0)
+when a leg is missing.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# Span schema contract with horovod_tpu/serve/tracing.py —
+# check_parity.py check_serve_trace_surface asserts these literals
+# match the writer's byte for byte, so the schema cannot drift.
+TRACE_SCHEMA_VERSION = 1
+TRACE_SPAN_KEYS = ("rid", "phase", "replica", "role", "t0", "t1", "detail")
+
+# Interval phases a request can dominantly "spend" its latency in,
+# with the human label the verdict uses.
+_PHASE_LABELS = {
+    "queue": "queue wait",
+    "prefill": "prefill",
+    "handoff_wire": "handoff wire wait",
+    "decode": "decode",
+    "migrate": "migration wait",
+}
+
+
+def load_dump(path):
+    """Load the JSONL dump: head meta line + one record per request.
+    Raises ValueError naming the defect (truncated dumps must not
+    silently produce an empty analysis)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "serve_trace.jsonl")
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path}: empty trace dump")
+    meta = json.loads(lines[0])
+    if meta.get("schema") != TRACE_SCHEMA_VERSION:
+        raise ValueError(
+            f"{path}: trace schema {meta.get('schema')!r} != "
+            f"v{TRACE_SCHEMA_VERSION}")
+    traces = []
+    for ln in lines[1:]:
+        rec = json.loads(ln)
+        for span in rec.get("spans", ()):
+            missing = [k for k in TRACE_SPAN_KEYS if k not in span]
+            if missing:
+                raise ValueError(
+                    f"{path}: rid {rec.get('rid')} span missing keys "
+                    f"{missing}")
+        traces.append(rec)
+    return meta, traces
+
+
+def _pct(vals, q):
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(q * len(vals)))], 6)
+
+
+def _journey(spans):
+    """Per-request facts from one span ledger."""
+    t0 = min(s["t0"] for s in spans)
+    t1 = max(s["t1"] for s in spans)
+    facts = {"total_s": round(t1 - t0, 6), "ttft_s": None,
+             "tpot_s": None, "queue_wait_s": 0.0, "handoff_s": 0.0,
+             "tokens": 0, "replicas": []}
+    prefill_t = None
+    retire_t = None
+    for s in spans:
+        if s["replica"] and s["replica"] not in facts["replicas"]:
+            facts["replicas"].append(s["replica"])
+        if s["phase"] == "prefill":
+            prefill_t = s["t1"]
+            facts["ttft_s"] = round(s["t1"] - t0, 6)
+        elif s["phase"] == "queue":
+            facts["queue_wait_s"] += s["t1"] - s["t0"]
+        elif s["phase"] == "handoff_wire":
+            facts["handoff_s"] += s["t1"] - s["t0"]
+        elif s["phase"] == "retire":
+            retire_t = s["t1"]
+            try:
+                facts["tokens"] = int(s["detail"])
+            except ValueError:
+                pass
+    if prefill_t is not None and retire_t is not None \
+            and facts["tokens"] > 1:
+        facts["tpot_s"] = round(
+            (retire_t - prefill_t) / (facts["tokens"] - 1), 6)
+    facts["queue_wait_s"] = round(facts["queue_wait_s"], 6)
+    facts["handoff_s"] = round(facts["handoff_s"], 6)
+    return facts
+
+
+def _dominant_phase(spans):
+    """(phase, replica, seconds) of the longest interval phase."""
+    per = {}
+    where = {}
+    for s in spans:
+        if s["phase"] not in _PHASE_LABELS:
+            continue
+        dur = s["t1"] - s["t0"]
+        per[s["phase"]] = per.get(s["phase"], 0.0) + dur
+        cur = where.get(s["phase"])
+        if cur is None or dur > cur[1]:
+            where[s["phase"]] = (s["replica"], dur)
+    if not per:
+        return None
+    phase = max(per, key=lambda p: (per[p], p))
+    return phase, where[phase][0], per[phase]
+
+
+def verdicts(traces, top):
+    """The p99-exemplar verdicts: for each of the ``top`` slowest
+    requests, name the phase (and replica) the latency actually
+    lives in."""
+    ranked = sorted(traces,
+                    key=lambda t: -_journey(t["spans"])["total_s"])
+    out = []
+    for rec in ranked[:top]:
+        spans = rec["spans"]
+        j = _journey(spans)
+        dom = _dominant_phase(spans)
+        if dom is None or j["total_s"] <= 0:
+            continue
+        phase, replica, secs = dom
+        pct = round(100.0 * secs / j["total_s"])
+        where = f" on {replica}" if replica else ""
+        out.append(
+            f"rid {rec['rid']} spent {pct}% of its {j['total_s']}s "
+            f"in {_PHASE_LABELS[phase]}{where}")
+    return out
+
+
+def waterfalls(traces, top):
+    ranked = sorted(traces,
+                    key=lambda t: -_journey(t["spans"])["total_s"])
+    out = []
+    for rec in ranked[:top]:
+        spans = sorted(rec["spans"], key=lambda s: (s["t0"], s["t1"]))
+        out.append({
+            "rid": rec["rid"],
+            **_journey(rec["spans"]),
+            "spans": [{"phase": s["phase"], "replica": s["replica"],
+                       "role": s["role"], "t0": s["t0"], "t1": s["t1"],
+                       "dur_s": round(s["t1"] - s["t0"], 6),
+                       "detail": s["detail"]} for s in spans]})
+    return out
+
+
+def summarize_flight(flight_dir, rids):
+    """Correlate slow requests with flight-recorder decode events via
+    the v3 ``trace`` request-id CSV (tools/flight_diff.py loads the
+    boxes)."""
+    try:
+        import flight_diff
+    except ImportError:
+        from tools import flight_diff  # imported as a package module
+    boxes = flight_diff.load_all(flight_dir)
+    if not boxes:
+        return {"note": f"no black boxes under {flight_dir}"}
+    correlated = {}
+    for rid in rids:
+        events = 0
+        replicas = []
+        for box in boxes.values():
+            for ev in box.get("events", ()):
+                if ev.get("op") != "serve":
+                    continue
+                stamped = ev.get("trace", "")
+                if not stamped:
+                    continue
+                if str(rid) in stamped.split(","):
+                    events += 1
+                    name = ev.get("name", "")
+                    rep = name.rsplit(".", 1)[-1]
+                    if rep not in replicas:
+                        replicas.append(rep)
+        correlated[str(rid)] = {"decode_events": events,
+                                "replicas": replicas}
+    return {"boxes": len(boxes), "correlated": correlated}
+
+
+def analyze(meta, traces, top=3):
+    ttfts, tpots, qwaits, handoffs, totals = [], [], [], [], []
+    for rec in traces:
+        j = _journey(rec["spans"])
+        totals.append(j["total_s"])
+        if j["ttft_s"] is not None:
+            ttfts.append(j["ttft_s"])
+        if j["tpot_s"] is not None:
+            tpots.append(j["tpot_s"])
+        qwaits.append(j["queue_wait_s"])
+        handoffs.append(j["handoff_s"])
+    goodput = meta.get("goodput", {})
+    total = useful = 0.0
+    for per in goodput.values():
+        for state, v in per.items():
+            total += v
+            if state in ("decode", "prefill"):
+                useful += v
+    return {
+        "schema": TRACE_SCHEMA_VERSION,
+        "requests": len(traces),
+        "spans": sum(len(t["spans"]) for t in traces),
+        "ttft": {"p50_s": _pct(ttfts, 0.5), "p99_s": _pct(ttfts, 0.99)},
+        "tpot": {"p50_s": _pct(tpots, 0.5), "p99_s": _pct(tpots, 0.99)},
+        "queue_wait": {"p50_s": _pct(qwaits, 0.5),
+                       "p99_s": _pct(qwaits, 0.99)},
+        "handoff": {"p50_s": _pct(handoffs, 0.5),
+                    "p99_s": _pct(handoffs, 0.99)},
+        "latency": {"p50_s": _pct(totals, 0.5),
+                    "p99_s": _pct(totals, 0.99)},
+        "goodput": goodput,
+        "goodput_fraction": (round(useful / total, 6) if total else None),
+        "waterfalls": waterfalls(traces, top),
+        "verdicts": verdicts(traces, top),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serve-plane trace post-mortem (docs/serve.md)")
+    ap.add_argument("dump", help="serve_trace.jsonl (or its directory)")
+    ap.add_argument("--flight", default=None,
+                    help="flight-recorder black-box dir to correlate "
+                         "decode events against (trace-id join)")
+    ap.add_argument("--top", type=int, default=3,
+                    help="slowest-request exemplars to expand")
+    args = ap.parse_args(argv)
+    try:
+        meta, traces = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"error": str(e)}))
+        return 2
+    report = analyze(meta, traces, top=max(1, args.top))
+    if args.flight:
+        rids = [w["rid"] for w in report["waterfalls"]]
+        try:
+            report["flight"] = summarize_flight(args.flight, rids)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            report["flight"] = {"note": f"flight overlay failed: {e}"}
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -937,6 +937,16 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
     log = _load_fault_log(fault_log)
     sites = {r["site"] for r in log}
     assert "replica_kill" in sites, sorted(sites)
+    # The request tracer's span ledger joins the determinism contract
+    # only when it is on — HVD_TPU_SERVE_TRACE=0 restores the pre-trace
+    # record shape bit-exactly.
+    sequences = {
+        "events": [list(e) for e in report["events"]],
+        "decisions": report["decisions"],
+    }
+    from horovod_tpu.serve import tracing
+    if tracing.tracer().enabled:
+        sequences["trace"] = tracing.tracer().summary()
     return {
         "metric": "chaos_soak_serve",
         "seed": seed,
@@ -949,10 +959,7 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
         "decisions": report["decisions"],
         "injections": len(log),
         "injected_sites": sorted(sites),
-        "sequences": {
-            "events": [list(e) for e in report["events"]],
-            "decisions": report["decisions"],
-        },
+        "sequences": sequences,
     }
 
 
@@ -1068,6 +1075,15 @@ def run_serve_disagg_soak(workdir: str, steps: int = 40, seed: int = 42,
     log = _load_fault_log(fault_log)
     sites = {r["site"] for r in log}
     assert "replica_kill" in sites, sorted(sites)
+    # Trace summary rides the determinism contract only when tracing
+    # is on (HVD_TPU_SERVE_TRACE=0 keeps the pre-trace record shape).
+    sequences = {
+        "events": [list(e) for e in report["events"]],
+        "decisions": report["decisions"],
+    }
+    from horovod_tpu.serve import tracing
+    if tracing.tracer().enabled:
+        sequences["trace"] = tracing.tracer().summary()
     return {
         "metric": "chaos_soak_serve_disagg",
         "seed": seed,
@@ -1082,10 +1098,7 @@ def run_serve_disagg_soak(workdir: str, steps: int = 40, seed: int = 42,
         "decisions": report["decisions"],
         "injections": len(log),
         "injected_sites": sorted(sites),
-        "sequences": {
-            "events": [list(e) for e in report["events"]],
-            "decisions": report["decisions"],
-        },
+        "sequences": sequences,
     }
 
 

@@ -607,6 +607,102 @@ def check_serve_surface(missing: list) -> None:
                        "queue-backlog entry reading the depth gauge")
 
 
+def check_serve_trace_surface(missing: list) -> None:
+    """The request-scoped tracing + goodput surface (docs/serve.md
+    "Tracing & goodput"): the span-schema literals must be byte-level
+    identical between the writer (serve/tracing.py) and the post-mortem
+    reader (tools/analyze_serve.py, which must run on a machine with
+    nothing but the dump), the three trace knobs must be registered and
+    documented, and every observability outlet the tracer feeds
+    (podmon /pod/serve, bench record fields, the slow-request runbook)
+    must exist. Parsed textually (runs without jax installed)."""
+    tracing_path = REPO / "horovod_tpu" / "serve" / "tracing.py"
+    analyze_path = REPO / "tools" / "analyze_serve.py"
+    if not tracing_path.exists():
+        missing.append("path: horovod_tpu/serve/tracing.py")
+        return
+    if not analyze_path.exists():
+        missing.append("path: tools/analyze_serve.py")
+        return
+    writer_src = tracing_path.read_text()
+    reader_src = analyze_path.read_text()
+    text = (REPO / "docs" / "serve.md").read_text() \
+        if (REPO / "docs" / "serve.md").exists() else ""
+
+    # Span-schema round-trip: writer and reader tuples must be
+    # LITERALLY identical (same contract as the flightrec black box).
+    tup = re.compile(r"^TRACE_SPAN_KEYS = (\([^)]*\))", re.M | re.S)
+    wt, rt = tup.search(writer_src), tup.search(reader_src)
+    if not wt or not rt:
+        missing.append("serve trace schema: TRACE_SPAN_KEYS missing "
+                       "from tracing.py or analyze_serve.py")
+    elif re.sub(r"\s+", " ", wt.group(1)) != \
+            re.sub(r"\s+", " ", rt.group(1)):
+        missing.append("serve trace schema drift: TRACE_SPAN_KEYS "
+                       "differs between serve/tracing.py and "
+                       "tools/analyze_serve.py")
+    ver = re.compile(r"^TRACE_SCHEMA_VERSION = (\d+)", re.M)
+    wv, rv = ver.search(writer_src), ver.search(reader_src)
+    if not wv or not rv or wv.group(1) != rv.group(1):
+        missing.append("serve trace schema drift: TRACE_SCHEMA_VERSION "
+                       "differs between writer and reader")
+
+    # Knobs: registered in config.RUNTIME_KNOBS + documented.
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    for knob in ("SERVE_TRACE", "SERVE_TRACE_DIR", "SERVE_TRACE_SIZE"):
+        if f'"{knob}"' not in cfg_src:
+            missing.append(f"serve trace: config.py RUNTIME_KNOBS "
+                           f"lacks {knob}")
+        if f"HVD_TPU_{knob}" not in text:
+            missing.append(f"serve trace knob HVD_TPU_{knob}: "
+                           "undocumented in docs/serve.md")
+
+    # The podmon outlet: /pod/serve endpoint + docs.
+    podmon_src = (REPO / "horovod_tpu" / "common"
+                  / "podmon.py").read_text()
+    pod_text = (REPO / "docs" / "podmon.md").read_text() \
+        if (REPO / "docs" / "podmon.md").exists() else ""
+    if '"/pod/serve"' not in podmon_src:
+        missing.append("serve trace: podmon.py lacks the /pod/serve "
+                       "endpoint")
+    for where, t in (("docs/serve.md", text),
+                     ("docs/podmon.md", pod_text)):
+        if "/pod/serve" not in t:
+            missing.append(f"serve trace: /pod/serve undocumented in "
+                           f"{where}")
+
+    # The post-mortem outlet: analyze_serve --flight correlation +
+    # the slow-request runbook.
+    if '"--flight"' not in reader_src:
+        missing.append("serve trace: analyze_serve.py lacks the "
+                       "--flight correlation flag")
+    ts_text = (REPO / "docs" / "troubleshooting.md").read_text() \
+        if (REPO / "docs" / "troubleshooting.md").exists() else ""
+    if "analyze_serve.py" not in ts_text:
+        missing.append("serve trace: docs/troubleshooting.md lacks the "
+                       "slow-request runbook (analyze_serve.py)")
+    if "analyze_serve.py" not in text:
+        missing.append("serve trace: analyze_serve.py undocumented in "
+                       "docs/serve.md")
+
+    # The bench outlet: per-phase percentiles + goodput in the serve
+    # BENCH record.
+    bench_src = (REPO / "bench.py").read_text()
+    for field in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
+                  "tpot_p99_s", "queue_wait_p50_s", "queue_wait_p99_s",
+                  "goodput"):
+        if f'"{field}"' not in bench_src:
+            missing.append(f"serve trace: bench.py serve record lacks "
+                           f"{field}")
+
+    # The chaos determinism surface: the trace summary joins the
+    # byte-compared sequences when tracing is on.
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    if soak_src.count('sequences["trace"]') < 2:
+        missing.append("serve trace: chaos_soak.py serve families do "
+                       "not bank the trace summary in sequences")
+
+
 def check_zero_surface(missing: list) -> None:
     """The ZeRO-2/3 subsystem (docs/zero.md): every knob, metric, API
     name, bench/chaos/test surface named by ISSUE 12 must exist in the
@@ -1301,6 +1397,7 @@ def main() -> int:
     check_podmon_surface(missing)
     check_moe_surface(missing)
     check_serve_surface(missing)
+    check_serve_trace_surface(missing)
     check_zero_surface(missing)
     check_pipeline_surface(missing)
     check_seq_surface(missing)

@@ -130,10 +130,50 @@ def sweep_probes() -> dict:
     return {"quiet": quiet, "subtle": subtle}
 
 
+def run_serve_sweep(field: str, values, seed=None) -> dict:
+    """Grid-search one SLOPolicy field over the banked
+    ``diurnal_serve`` world (the real tiny-GPT serve stack under a
+    diurnal traffic swing). Each value gets the full decision log plus
+    the per-phase percentiles the tracer surfaced (ttft / tpot /
+    queue-wait p99) — the evidence record behind any tuned
+    ``ttft_target_s``/``tpot_target_s`` default
+    (``results/fleetsim/sweep_<field>.json``)."""
+    base = fleetsim.builtin_scenarios()["diurnal_serve"]
+    rows = []
+    for value in values:
+        s = copy.deepcopy(base)
+        s.policy[field] = value
+        record, report = fleetsim.serve_scenario_report(s, seed=seed)
+        decisions = [json.loads(l) for l in record["decisions"]]
+        rows.append({
+            "value": value,
+            "decisions": record["decisions"],
+            "grow": sum(1 for d in decisions if d["action"] == "grow"),
+            "drain": sum(1 for d in decisions
+                         if d["action"] == "drain"),
+            "completed": record["stats"]["completed"],
+            "dropped": record["stats"]["dropped"],
+            "latency_p99_s": record["stats"]["latency_p99_s"],
+            "ttft_p99_s": report["ttft_p99_s"],
+            "tpot_p99_s": report["tpot_p99_s"],
+            "queue_wait_p99_s": report["queue_wait_p99_s"],
+        })
+    return {"metric": "fleetsim_sweep", "field": field,
+            "world": "diurnal_serve", "values": list(values),
+            "rows": rows}
+
+
 def run_sweep(field: str, values, seed=None) -> dict:
-    """Grid-search one AutoscalePolicy field over the probe worlds.
-    Returns the evidence record: per-value decision logs + the
-    false-positive / detection verdicts."""
+    """Grid-search one policy field. AutoscalePolicy fields score on
+    the train probe worlds; fields only SLOPolicy knows (e.g.
+    ``ttft_target_s``) dispatch to the serve sweep over the banked
+    ``diurnal_serve`` scenario. Fields both policies share keep the
+    historical train-probe behaviour."""
+    from horovod_tpu.common.autoscale import AutoscalePolicy
+    from horovod_tpu.serve.controller import SLOPolicy
+    if (field in SLOPolicy.field_names()
+            and field not in AutoscalePolicy.field_names()):
+        return run_serve_sweep(field, values, seed=seed)
     probes = sweep_probes()
     rows = []
     for value in values:

@@ -42,13 +42,15 @@ from typing import Any, Dict, List, Optional
 # asserts these tuples match the writer's byte for byte, so the schema
 # cannot drift between writer and reader. v2 adds ``role``: the rank's
 # (dp,pp,tp) coordinate label under a hybrid ParallelSpec ("" when
-# role-blind) — verdicts then name the stage, not just the rank.
-BLACKBOX_SCHEMA_VERSION = 2
+# role-blind) — verdicts then name the stage, not just the rank. v3
+# adds ``trace``: the serve engine's request-id CSV per decode event
+# ("" for training collectives), the analyze_serve --flight join key.
+BLACKBOX_SCHEMA_VERSION = 3
 BLACKBOX_KEYS = ("schema", "rank", "host", "role", "pid", "trigger",
                  "reason", "t_unix", "step", "seq_head", "events",
                  "stacks", "stall_inflight", "recovery")
 EVENT_KEYS = ("seq", "op", "name", "step", "bytes", "wire",
-              "t_submit", "t_complete", "outcome")
+              "t_submit", "t_complete", "outcome", "trace")
 
 
 def load_blackbox(path: str) -> Dict[str, Any]:
@@ -61,6 +63,9 @@ def load_blackbox(path: str) -> Dict[str, Any]:
         raise ValueError(f"{path}: black box must be a JSON object")
     if box.get("schema", 1) < 2:
         box.setdefault("role", "")   # v1 boxes predate role labels
+    if box.get("schema", 1) < 3:
+        for ev in box.get("events", ()):
+            ev.setdefault("trace", "")   # v2 events predate trace ids
     missing = [k for k in BLACKBOX_KEYS if k not in box]
     if missing:
         raise ValueError(f"{path}: black box missing keys {missing} "
