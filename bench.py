@@ -400,7 +400,8 @@ def main():
                    help="traffic seed for --serve (same seed => "
                         "byte-identical event sequence)")
     p.add_argument("--serve-arm", default="",
-                   choices=["", "tp", "disagg", "prefix", "spec"],
+                   choices=["", "tp", "disagg", "prefix", "spec",
+                            "overload"],
                    help="serving A/B arm for --serve (docs/serve.md): "
                         "'tp' shards each replica's decode over 2 "
                         "devices (Megatron head grid; needs >= 2 "
@@ -411,8 +412,12 @@ def main():
                         "through the cross-request prefix cache, "
                         "'spec' adds speculative decoding "
                         "(HVD_TPU_SERVE_SPEC_K tokens/round, "
-                        "self-draft). The record carries arm= either "
-                        "way")
+                        "self-draft), 'overload' drives a mixed-"
+                        "tenancy ~2x-capacity storm through BOTH the "
+                        "overload controls and an uncontrolled "
+                        "baseline in one run and records the ON-vs-"
+                        "OFF SLO/goodput deltas. The record carries "
+                        "arm= either way")
     p.add_argument("--smoke", action="store_true",
                    help="tiny-model fallback config (always records "
                         "*some* number)")
@@ -1022,6 +1027,38 @@ def _run_serve_benchmark(args):
     elif arm == "spec":
         from horovod_tpu.common.config import runtime_env
         spec_k = int(runtime_env("SERVE_SPEC_K") or "4")
+    elif arm == "overload":
+        # Mixed-tenancy storm (docs/serve.md "Overload & tenancy"):
+        # the SAME class-tagged trace — deadlines are stamped at
+        # generation so both arms measure the identical SLO — runs
+        # through the overload controls (admission gate + brownout
+        # ladder + EDF classes) and through an uncontrolled FIFO
+        # baseline, and the record carries the ON-vs-OFF deltas.
+        from horovod_tpu.common.config import runtime_env
+        overload_mix = [("latency", 0.5), ("throughput", 0.3),
+                        ("batch", 0.2)]
+        mix_raw = runtime_env("SERVE_CLASS_MIX") or ""
+        if mix_raw:
+            # HVD_TPU_SERVE_CLASS_MIX=latency=0.6,batch=0.4 overrides
+            # the default tenancy mix (weights normalize in traffic).
+            overload_mix = [(k, float(v)) for k, v in
+                            (p.split("=") for p in mix_raw.split(",")
+                             if p)]
+        overload_pol = {
+            "tick_interval_s": 0.1, "window": 8,
+            "min_replicas": args.serve_replicas,
+            "max_replicas": args.serve_replicas,
+            "overload": True,
+            "latency_deadline_s": 3.0, "throughput_deadline_s": 5.0,
+            "admission_safety": 1.2,
+            "brownout_enter_depth": 10, "brownout_exit_depth": 2,
+            "brownout_enter_ticks": 2, "brownout_exit_ticks": 2,
+            "brownout_clamp_tokens": 4,
+        }
+        trace_kw["class_mix"] = overload_mix
+        trace_kw["class_deadlines"] = {
+            "latency": overload_pol["latency_deadline_s"],
+            "throughput": overload_pol["throughput_deadline_s"]}
 
     params = init_model.init(jax.random.PRNGKey(0),
                              np.zeros((1, 4), np.int32))
@@ -1045,8 +1082,11 @@ def _run_serve_benchmark(args):
     # Policy from env (HVD_TPU_SERVE_POLICY / HVD_TPU_SERVE_*): the
     # DEFAULT policy has every grow/shrink trigger off, so the stock
     # bench measures a fixed replica set — controller activity is an
-    # explicit arm.
-    cluster = ServeCluster(factory, policy=SLOPolicy.from_env(),
+    # explicit arm. The overload arm pins its own policy so the A/B
+    # is self-contained (replicas fixed: no autoscale confound).
+    policy = SLOPolicy.from_dict(overload_pol) \
+        if arm == "overload" else SLOPolicy.from_env()
+    cluster = ServeCluster(factory, policy=policy,
                            replicas=args.serve_replicas, step_s=0.05,
                            log_path="", roles=roles)
     _log(f"serve: {model_name} arm={arm or 'stock'} "
@@ -1075,6 +1115,77 @@ def _run_serve_benchmark(args):
             "k": spec_k,
             "acceptance_rate": report["spec_acceptance_rate"],
         }
+    if arm == "overload":
+        # OFF arm: same trace (regenerated — Requests mutate in
+        # flight), same stamped deadlines, overload controls off
+        # (FIFO queue, admit everything, no brownout). Goodput =
+        # SLO-bearing completions that met their stamped deadline;
+        # batch is best-effort (no deadline, the tier brownout
+        # sacrifices first) so it is reported separately rather than
+        # counted as goodput in either arm.
+        def _goodput(completed):
+            ok = [r for r in completed
+                  if r.deadline_s > 0 and r.latency_s is not None
+                  and r.latency_s <= r.deadline_s]
+            return {"requests": len(ok),
+                    "tokens": sum(len(r.tokens) for r in ok),
+                    "best_effort_completed": sum(
+                        1 for r in completed if r.deadline_s <= 0)}
+
+        off_pol = dict(overload_pol)
+        off_pol["overload"] = False
+        trace_off = poisson_trace(
+            seed=args.serve_seed, n_requests=requests,
+            rate_rps=args.serve_rate,
+            output_lens=(4, 8, 16, 32),
+            vocab_size=model.vocab_size, **trace_kw)
+        cluster_off = ServeCluster(
+            factory, policy=SLOPolicy.from_dict(off_pol),
+            replicas=args.serve_replicas, step_s=0.05, log_path="")
+        report_off = cluster_off.run(trace_off)
+        by_class_off = {}
+        for r in cluster_off.completed:
+            if r.latency_s is not None:
+                by_class_off.setdefault(
+                    r.slo_class or "latency", []).append(r.latency_s)
+        off_class_p99 = {
+            cls: round(float(np.percentile(np.asarray(v), 99)), 6)
+            for cls, v in sorted(by_class_off.items())}
+        on_good = _goodput(cluster.completed)
+        off_good = _goodput(cluster_off.completed)
+        slo = overload_pol["latency_deadline_s"]
+        on_lat = report["class_latency_p99_s"].get("latency", 0.0)
+        off_lat = off_class_p99.get("latency", 0.0)
+        arm_fields["overload"] = {
+            "class_mix": dict(overload_mix),
+            "latency_deadline_s": slo,
+            "throughput_deadline_s":
+                overload_pol["throughput_deadline_s"],
+            "admission_safety": overload_pol["admission_safety"],
+            "on": {
+                "completed": report["completed"],
+                "shed": report["shed"],
+                "rejected": report["rejected"],
+                "brownout_max_level": report["brownout_max_level"],
+                "class_latency_p99_s": report["class_latency_p99_s"],
+                "deadline_misses": report["deadline_misses"],
+                "goodput": on_good,
+            },
+            "off": {
+                "completed": report_off["completed"],
+                "class_latency_p99_s": off_class_p99,
+                "deadline_misses": report_off["deadline_misses"],
+                "goodput": off_good,
+            },
+            "latency_p99_within_slo_on": bool(on_lat <= slo),
+            "latency_p99_within_slo_off": bool(off_lat <= slo),
+            "goodput_gain_x": round(
+                on_good["requests"] / max(1, off_good["requests"]),
+                2),
+        }
+        _log(f"serve: overload A/B latency-tier p99 ON={on_lat}s "
+             f"OFF={off_lat}s (SLO {slo}s) goodput "
+             f"ON={on_good['requests']} OFF={off_good['requests']}")
     return {
         "metric": f"{model_name}_serve_tokens_per_sec",
         "value": report["tokens_per_wall_s"],

@@ -409,6 +409,8 @@ RUNTIME_KNOBS = {
     "SERVE_TRACE": "request-span tracer enable (0 = shared no-op)",
     "SERVE_TRACE_DIR": "trace JSONL dump directory (unset = no dump)",
     "SERVE_TRACE_SIZE": "retained completed request-trace cap",
+    "SERVE_BROWNOUT": "pin the brownout ladder level (operator lever)",
+    "SERVE_CLASS_MIX": "bench overload-arm SLO class mix override",
     # Config-field twins read PRE-INIT by tools (bench/microbench):
     # the Config field stays the init()-resolved source of truth.
     "MESH_SHAPE": "mesh factorization override (also a Config field)",

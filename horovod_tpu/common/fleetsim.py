@@ -165,6 +165,11 @@ class FleetScenario:
     replicas: int = 2
     roles: Dict[str, int] = dataclasses.field(default_factory=dict)
     step_s: float = 0.05           # serve round length (virtual)
+    # Multi-tenant mix (docs/serve.md "Overload & tenancy"): SLO class
+    # name -> weight; {} keeps the historical unclassed trace. Classed
+    # requests inherit the policy's per-class default deadlines.
+    class_mix: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @classmethod
     def field_names(cls) -> Tuple[str, ...]:
@@ -829,6 +834,29 @@ def builtin_scenarios() -> Dict[str, FleetScenario]:
                 "min_replicas": 1, "max_replicas": 4,
                 "grow_cooldown_s": 0.5, "shrink_cooldown_s": 1.5,
             }),
+        # Sustained ~2x-capacity mixed-tenancy storm with overload
+        # control armed: the brownout ladder must climb (decision-log
+        # ``brownout`` lines), degradation must concentrate on the
+        # throughput/batch tiers, every request must reach exactly one
+        # typed terminal outcome (dropped == 0 means zero SILENT
+        # losses), and the whole record replays byte-identically.
+        "overload_storm": FleetScenario(
+            name="overload_storm", kind="serve", hosts=4,
+            requests=160, rate_rps=22.0, replicas=2,
+            class_mix={"latency": 0.5, "throughput": 0.3,
+                       "batch": 0.2},
+            policy={
+                "tick_interval_s": 0.1, "window": 16,
+                "min_replicas": 2, "max_replicas": 2,
+                "overload": True,
+                "latency_deadline_s": 1.5,
+                "throughput_deadline_s": 3.0,
+                "brownout_enter_depth": 10,
+                "brownout_exit_depth": 2,
+                "brownout_enter_ticks": 2,
+                "brownout_exit_ticks": 2,
+                "brownout_clamp_tokens": 4,
+            }),
     }
 
 
@@ -914,22 +942,48 @@ def _serve_scenario_record(scn: FleetScenario
                         np.zeros((1, 4), np.int32))
     factory = make_engine_factory(model, params, slots=4, max_len=32,
                                   max_prompt_len=16)
+    policy = SLOPolicy.from_dict(scn.policy)
     if scn.peak_rps > scn.rate_rps:
         trace = diurnal_trace(scn.seed, scn.requests, scn.rate_rps,
                               scn.peak_rps, scn.period_s)
     else:
+        # The class mix is sorted for determinism (a scenario dict
+        # round-trips through JSON); classed requests inherit the
+        # policy's per-class default deadlines so OFF/ON arms measure
+        # misses identically.
+        mix = sorted(scn.class_mix.items()) or None
+        deadlines = {name: getattr(policy, f"{name}_deadline_s", 0.0)
+                     for name, _ in (mix or [])} or None
         trace = poisson_trace(seed=scn.seed, n_requests=scn.requests,
-                              rate_rps=scn.rate_rps)
+                              rate_rps=scn.rate_rps, class_mix=mix,
+                              class_deadlines=deadlines)
     kill_inj = None
     if scn.plan.get("faults"):
         fp = faults_lib.FaultPlan.from_json(json.dumps(scn.plan))
         kill_inj = faults_lib.FaultInjector(fp, log_path="",
                                             rank="driver", host="sim")
     report, hm, _cluster = run_serve_world(
-        factory=factory, policy=SLOPolicy.from_dict(scn.policy),
+        factory=factory, policy=policy,
         trace=trace, hosts=[f"host{i}" for i in range(scn.hosts)],
         replicas=scn.replicas, roles=scn.roles or None,
         step_s=scn.step_s, kill_injector=kill_inj)
+    stats = {
+        "requests": len(trace.requests),
+        "completed": report["completed"],
+        "dropped": report["dropped"],
+        "latency_p99_s": report["latency_p99_s"],
+        "blacklisted": sorted(hm.blacklist_snapshot()),
+    }
+    if "shed" in report:
+        # Overload-controlled worlds bank the terminal-outcome split
+        # and the ladder watermark; historical scenarios (overload
+        # off) keep their exact baseline shape.
+        stats.update({
+            "shed": report["shed"],
+            "rejected": report["rejected"],
+            "brownout_max_level": report["brownout_max_level"],
+            "class_latency_p99_s": report["class_latency_p99_s"],
+        })
     record = {
         "metric": "fleetsim",
         "scenario": scn.name,
@@ -937,12 +991,6 @@ def _serve_scenario_record(scn: FleetScenario
         "seed": scn.seed,
         "decisions": report["decisions"],
         "injections": len(kill_inj.injections) if kill_inj else 0,
-        "stats": {
-            "requests": len(trace.requests),
-            "completed": report["completed"],
-            "dropped": report["dropped"],
-            "latency_p99_s": report["latency_p99_s"],
-            "blacklisted": sorted(hm.blacklist_snapshot()),
-        },
+        "stats": stats,
     }
     return record, report

@@ -500,6 +500,13 @@ class PodMonitor:
             f"requests {v['requests']}",
             f"spans {v['spans']}",
             f"orphans {v['orphans']}",
+            # Overload control (docs/serve.md "Overload & tenancy"):
+            # the brownout ladder level and the typed terminal
+            # outcomes, so "is the cluster browning out and what is it
+            # costing" reads off one endpoint.
+            f"brownout_level {v['brownout_level']}",
+            f"shed {v['shed']}",
+            f"rejected {v['rejected']}",
             f"goodput_fraction {v['goodput_fraction']}",
         ]
         for role, row in sorted(v["roles"].items()):

@@ -28,6 +28,10 @@ Public surface (all lazily imported; ``import horovod_tpu as hvd`` then
 * ``init_kv_cache`` — re-exported model-geometry cache constructor.
 * ``ServeTracer``, ``tracer`` — the request-scoped span ledger +
   goodput attribution (``tracing``; ``HVD_TPU_SERVE_TRACE``).
+* ``SLOClass``, ``BrownoutLadder``, ``SLO_CLASSES``,
+  ``BROWNOUT_RUNGS`` — multi-tenant overload control: class table,
+  deadline-aware admission, brownout degradation ladder
+  (``overload``; docs/serve.md "Overload & tenancy").
 """
 
 from __future__ import annotations
@@ -46,10 +50,14 @@ _LAZY = {
     "init_kv_cache": ("..models.gpt", "init_kv_cache"),
     "ServeTracer": ("tracing", "ServeTracer"),
     "tracer": ("tracing", "tracer"),
+    "SLOClass": ("overload", "SLOClass"),
+    "BrownoutLadder": ("overload", "BrownoutLadder"),
+    "SLO_CLASSES": ("overload", "SLO_CLASSES"),
+    "BROWNOUT_RUNGS": ("overload", "BROWNOUT_RUNGS"),
 }
 
 _LAZY_MODULES = ("kvcache", "queue", "batcher", "engine", "controller",
-                 "traffic", "prefix", "tracing")
+                 "traffic", "prefix", "tracing", "overload")
 
 __all__ = sorted(list(_LAZY) + list(_LAZY_MODULES))
 

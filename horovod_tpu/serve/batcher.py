@@ -66,7 +66,8 @@ class ContinuousBatcher:
 
     def __init__(self, engine: DecodeEngine,
                  queue: Optional[RequestQueue] = None,
-                 role: str = "mixed"):
+                 role: str = "mixed",
+                 class_priorities=None):
         if role not in ROLES:
             raise ValueError(
                 f"unknown batcher role {role!r}; known: {ROLES}")
@@ -78,6 +79,11 @@ class ContinuousBatcher:
         # carries the replica identity via the queue.
         self.queue.role = role
         self.queue.replica = self.name
+        if class_priorities is not None:
+            # Multi-tenant overload control (docs/serve.md "Overload &
+            # tenancy"): admission becomes strict-priority across SLO
+            # classes, EDF within one.
+            self.queue.set_classes(class_priorities)
         self.draining = False
         # Goodput attribution for the last run_step round: "prefill" /
         # "decode" when the round did useful work, "idle" when slots

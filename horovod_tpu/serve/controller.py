@@ -41,9 +41,11 @@ import numpy as np
 
 from ..common.autoscale import Decision
 from ..common import metrics as metrics_lib
+from . import overload as overload_lib
 from . import tracing
 from .batcher import ContinuousBatcher
 from .engine import DecodeEngine
+from . import queue as queue_lib
 from .queue import Request
 from .traffic import TrafficTrace
 from ..common.config import runtime_env
@@ -62,6 +64,13 @@ ENV_LOG = "HVD_TPU_SERVE_LOG"         # decision log (JSONL)
 
 def _truthy(raw: Optional[str]) -> bool:
     return (raw or "").strip().lower() in ("1", "true", "yes", "on")
+
+
+def _count_by(items) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for it in items:
+        out[str(it)] = out.get(str(it), 0) + 1
+    return out
 
 
 @dataclasses.dataclass
@@ -111,6 +120,39 @@ class SLOPolicy:
     # (0 = off). Queue-depth pressure grows the PREFILL pool; this is
     # the matching back-pressure signal for the other pool.
     max_handoff_depth: int = 0
+    # --- Overload control & multi-tenancy (docs/serve.md "Overload &
+    # tenancy"; horovod_tpu/serve/overload.py). ``overload`` is the
+    # master switch: off (the default) keeps every pre-existing
+    # cluster byte-identical. Each SLO class is three scalars —
+    # deadline default (0 = none), strict cross-class priority (lower
+    # = served first), and retry budget (re-route attempts allowed
+    # before the request is shed; self-limiting retries).
+    overload: bool = False
+    latency_deadline_s: float = 0.0
+    latency_priority: int = 0
+    latency_retry_budget: int = 4
+    throughput_deadline_s: float = 0.0
+    throughput_priority: int = 1
+    throughput_retry_budget: int = 2
+    batch_deadline_s: float = 0.0
+    batch_priority: int = 2
+    batch_retry_budget: int = 1
+    # Deadline-aware admission: shed when safety x estimated latency
+    # (queue-wait + TTFT residual + max_new_tokens x TPOT, windowed
+    # p99s) exceeds the request's remaining deadline budget.
+    admission_safety: float = 1.0
+    # Brownout ladder (overload.BROWNOUT_RUNGS): queue depth >=
+    # enter_depth for enter_ticks consecutive ticks climbs one rung;
+    # depth <= exit_depth for exit_ticks descends one. enter_depth 0
+    # disables the ladder; the band between the thresholds is the
+    # hysteresis dead zone.
+    brownout_enter_depth: int = 0
+    brownout_exit_depth: int = 0
+    brownout_enter_ticks: int = 2
+    brownout_exit_ticks: int = 2
+    # The clamp_tokens rung caps throughput-tier max_new_tokens at
+    # this while active (brownout partial answers over timeouts).
+    brownout_clamp_tokens: int = 4
 
     @classmethod
     def field_names(cls) -> Tuple[str, ...]:
@@ -151,7 +193,12 @@ class SLOPolicy:
     def validate(self) -> "SLOPolicy":
         for name in ("tick_interval_s", "target_p99_s", "ttft_target_s",
                      "tpot_target_s", "low_occupancy",
-                     "grow_cooldown_s", "shrink_cooldown_s"):
+                     "grow_cooldown_s", "shrink_cooldown_s",
+                     "latency_deadline_s", "throughput_deadline_s",
+                     "batch_deadline_s", "latency_retry_budget",
+                     "throughput_retry_budget", "batch_retry_budget",
+                     "brownout_enter_depth", "brownout_exit_depth",
+                     "brownout_clamp_tokens"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"serve policy: field {name!r} must be >= 0, got "
@@ -169,6 +216,23 @@ class SLOPolicy:
             raise ValueError(
                 "serve policy: field 'max_handoff_depth' must be >= 0 "
                 f"(0 disables), got {self.max_handoff_depth}")
+        for name in ("brownout_enter_ticks", "brownout_exit_ticks"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"serve policy: field {name!r} must be >= 1 "
+                    f"(hysteresis streak length), got "
+                    f"{getattr(self, name)}")
+        if self.admission_safety <= 0:
+            raise ValueError(
+                "serve policy: field 'admission_safety' must be > 0 "
+                f"(a latency-estimate multiplier), got "
+                f"{self.admission_safety}")
+        if 0 < self.brownout_enter_depth <= self.brownout_exit_depth:
+            raise ValueError(
+                "serve policy: brownout_exit_depth "
+                f"{self.brownout_exit_depth} must be < "
+                f"brownout_enter_depth {self.brownout_enter_depth} "
+                "(the gap is the hysteresis band)")
         if self.low_occupancy > 1.0:
             raise ValueError(
                 "serve policy: field 'low_occupancy' is a fraction in "
@@ -243,6 +307,11 @@ class ServeController:
         self._latencies: deque = deque(maxlen=max(1, policy.window))
         self._ttfts: deque = deque(maxlen=max(1, policy.window))
         self._tpots: deque = deque(maxlen=max(1, policy.window))
+        self._queue_waits: deque = deque(maxlen=max(1, policy.window))
+        # Overload control (docs/serve.md "Overload & tenancy"): the
+        # brownout ladder lives with the controller so its transitions
+        # share the decision log's seq space with grow/drain.
+        self.brownout = overload_lib.BrownoutLadder(policy)
         self._last_grow_t = -float("inf")
         self._last_shrink_t = -float("inf")
         self._last_tick_t = -float("inf")
@@ -256,6 +325,8 @@ class ServeController:
             self._ttfts.append(req.ttft_s)
         if req.tpot_s is not None:
             self._tpots.append(req.tpot_s)
+        if req.queue_wait_s is not None:
+            self._queue_waits.append(req.queue_wait_s)
 
     @staticmethod
     def _windowed(window: deque) -> Optional[float]:
@@ -271,6 +342,9 @@ class ServeController:
 
     def windowed_tpot_p99(self) -> Optional[float]:
         return self._windowed(self._tpots)
+
+    def windowed_queue_wait_p99(self) -> Optional[float]:
+        return self._windowed(self._queue_waits)
 
     # -- decision plumbing (the autoscale contract) --------------------------
 
@@ -328,6 +402,17 @@ class ServeController:
             return Decision(action="keep")
         self._last_tick_t = now
         active = live - draining
+        if p.overload:
+            # Brownout ladder: evaluated every full tick, logged like
+            # grow/drain but never consuming the one-reshape budget —
+            # degradation and capacity decisions compose.
+            moved = self.brownout.tick(queue_depth)
+            if moved is not None:
+                level, rung, why = moved
+                self._record(Decision(
+                    action="brownout", target=f"level:{level}",
+                    reason=f"{rung}:{why}"))
+                tracing.tracer().brownout(level, rung, why, now)
 
         def _grow_target(role: str) -> str:
             return f"{role}:1" if disagg else "1"
@@ -444,6 +529,17 @@ class ServeCluster:
         self.batchers: Dict[str, ContinuousBatcher] = {}
         self.events: List[Tuple] = []
         self.completed: List[Request] = []
+        # Overload-control terminal outcomes (docs/serve.md "Overload
+        # & tenancy"): every admitted request lands in exactly one of
+        # completed / shed / rejected — report() asserts the zero-
+        # silent-drops identity over the three.
+        self.shed: List[Request] = []
+        self.rejected: List[Request] = []
+        self._classes = (overload_lib.classes_from_policy(self.policy)
+                         if self.policy.overload else {})
+        self._class_priorities = (
+            overload_lib.class_priorities(self.policy)
+            if self.policy.overload else None)
         self.overflow: deque = deque()
         # Prefilled sequences awaiting a decode slot:
         # (request, wire_blob, generated) FIFO — disaggregation only.
@@ -509,8 +605,9 @@ class ServeCluster:
                 return None
         self._next_id += consumed
         b_role = role or "mixed"
-        self.batchers[name] = ContinuousBatcher(self.factory(name),
-                                                role=b_role)
+        self.batchers[name] = ContinuousBatcher(
+            self.factory(name), role=b_role,
+            class_priorities=self._class_priorities)
         self.tracer.set_role(name, b_role)
         if self.disagg:
             self.events.append((self.rounds, "replica_start", name,
@@ -576,14 +673,87 @@ class ServeCluster:
     def _reroute(self, reqs: List[Request]) -> None:
         for req in reqs:
             req.replica = None
+            if self.policy.overload and self._retry_exhausted(req):
+                continue
             if not self._route(req):
                 self.overflow.append(req)
+
+    # -- overload control: admission + terminal outcomes ---------------------
+
+    def _class_of(self, req: Request):
+        return self._classes.get(req.slo_class or "latency")
+
+    def _retry_exhausted(self, req: Request) -> bool:
+        """Per-class retry budgets make shed/re-routed retries
+        self-limiting: a request past its budget is SHED (a typed
+        terminal outcome) instead of circling the cluster amplifying
+        the overload."""
+        cls = self._class_of(req)
+        if cls is None or req.reroutes <= cls.retry_budget:
+            return False
+        self._shed(req, "retry_budget")
+        return True
+
+    def _shed(self, req: Request, reason: str) -> None:
+        req.outcome = "shed"
+        self.shed.append(req)
+        overload_lib.record_shed(req.slo_class, reason)
+        if reason == "deadline":
+            # The miss is already certain at admission — count it now
+            # so the miss metric stays honest under shedding.
+            queue_lib.record_shed_miss()
+        self.events.append((self.rounds, "shed", req.rid, reason))
+        if self.tracer.enabled:
+            self.tracer.shed(req, self._now, reason)
+
+    def _reject(self, req: Request, reason: str) -> None:
+        req.outcome = "rejected"
+        self.rejected.append(req)
+        queue_lib.record_rejection(reason)
+        self.events.append((self.rounds, "reject", req.rid, reason))
+        if self.tracer.enabled:
+            self.tracer.reject(req, self._now, reason)
+
+    def _admission_gate(self, req: Request) -> bool:
+        """Deadline-aware admission (docs/serve.md "Overload &
+        tenancy"): stamp the class deadline, apply the active brownout
+        rungs, and shed requests that cannot feasibly meet their
+        deadline BEFORE spending prefill on them. Returns True when
+        the request reached a terminal outcome here."""
+        p = self.policy
+        cls = self._class_of(req)
+        if cls is not None and req.deadline_s == 0 \
+                and cls.deadline_s > 0:
+            req.deadline_s = cls.deadline_s
+        ladder = self.controller.brownout
+        if ladder.active("reject_admission") \
+                and req.slo_class not in ("", "latency"):
+            self._reject(req, "brownout")
+            return True
+        if ladder.active("shed_batch") and req.slo_class == "batch":
+            self._shed(req, "brownout")
+            return True
+        if ladder.active("clamp_tokens") \
+                and req.slo_class == "throughput":
+            req.max_new_tokens = min(req.max_new_tokens,
+                                     max(1, p.brownout_clamp_tokens))
+        if req.deadline_s > 0:
+            est = overload_lib.admission_estimate(
+                self.controller, req.max_new_tokens)
+            if est is not None:
+                budget = (req.arrival_t + req.deadline_s) - self._now
+                if p.admission_safety * est > budget:
+                    self._shed(req, "deadline")
+                    return True
+        return False
 
     # -- routing -------------------------------------------------------------
 
     def submit(self, req: Request) -> None:
         if self.tracer.enabled:
             self.tracer.enqueue(req, self._now)
+        if self.policy.overload and self._admission_gate(req):
+            return
         if not self._route(req):
             self.overflow.append(req)
 
@@ -608,7 +778,8 @@ class ServeCluster:
                 self.batchers[name].queue.insert_by_arrival(req)
                 ok = True
             else:
-                ok = self.batchers[name].queue.submit(req)
+                ok = self.batchers[name].queue.submit(req,
+                                                      now=self._now)
             if ok:
                 self.events.append((self.rounds, "route", req.rid,
                                     name, req.reroutes))
@@ -722,6 +893,13 @@ class ServeCluster:
                 self.occupancy(), below_min,
                 shrink_candidate=self._shrink_candidate())
         self._apply(decision)
+        if self.policy.overload:
+            # The spec_off rung flips every engine's runtime flag (the
+            # mildest rung: lose the speculative speedup, keep every
+            # request); exit restores it the same way.
+            spec_on = not self.controller.brownout.active("spec_off")
+            for b in self.batchers.values():
+                b.engine.spec_enabled = spec_on
         # Finished drains leave the cluster.
         for name in self.live():
             b = self.batchers[name]
@@ -859,6 +1037,36 @@ class ServeCluster:
         if self.disagg:
             extra = {"handoffs": self._handoffs_done,
                      "pending_handoffs": len(self.pending_handoffs)}
+        if self.policy.overload:
+            # Terminal-outcome accounting + per-class latency tails
+            # (the A/B evidence surface): completed + shed + rejected
+            # must equal submitted — "dropped" means SILENTLY lost and
+            # the overload chaos family asserts it stays 0.
+            by_class: Dict[str, List[float]] = {}
+            for r in self.completed:
+                if r.latency_s is not None:
+                    by_class.setdefault(r.slo_class or "latency",
+                                        []).append(r.latency_s)
+            extra = {
+                **extra,
+                "shed": len(self.shed),
+                "rejected": len(self.rejected),
+                "shed_by_reason": dict(sorted(
+                    _count_by(e[3] for e in self.events
+                              if e[1] == "shed").items())),
+                "brownout_level": self.controller.brownout.level,
+                "brownout_max_level":
+                    self.controller.brownout.max_level,
+                "class_latency_p99_s": {
+                    cls: round(float(np.percentile(
+                        np.asarray(vals), 99)), 6)
+                    for cls, vals in sorted(by_class.items())},
+                "class_completed": {
+                    cls: len(vals)
+                    for cls, vals in sorted(by_class.items())},
+            }
+        terminal = (len(self.completed) + len(self.shed)
+                    + len(self.rejected))
         return {
             **extra,
             "prefill_tokens": prefill_tokens,
@@ -869,7 +1077,7 @@ class ServeCluster:
             if spec_proposed else 0.0,
             "submitted": submitted,
             "completed": len(self.completed),
-            "dropped": submitted - len(self.completed),
+            "dropped": submitted - terminal,
             "rounds": self.rounds,
             "virtual_s": round(self._now, 6),
             "wall_s": round(wall_s, 3),

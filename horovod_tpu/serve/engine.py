@@ -126,6 +126,12 @@ class DecodeEngine:
                                      and parallel.tp_axis) else None
         self.prefix_cache = prefix_cache
         self.spec_k = int(spec_k) if draft_model is not None else 0
+        # Runtime gate the brownout ladder's spec_off rung flips
+        # (docs/serve.md "Overload & tenancy"): speculation pauses
+        # without recompiling or discarding the draft state, and the
+        # plain rounds keep the draft ring mirrored so re-enabling is
+        # exact.
+        self.spec_enabled = True
         self.draft_model = draft_model
         self.draft_params = draft_params
         if self.spec_k and self.parallel is not None:
@@ -283,7 +289,7 @@ class DecodeEngine:
         if self.active_count() == 0:
             return []
         if self.spec_k:
-            if self._spec_ready():
+            if self.spec_enabled and self._spec_ready():
                 return self._spec_step(now)
             self.spec_fallback_rounds += 1
             # Keep the draft's ring mirrored through plain rounds so

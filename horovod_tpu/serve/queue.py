@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 from collections import deque
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..common import metrics as metrics_lib
 from . import tracing
@@ -35,8 +35,25 @@ _M_LATENCY = metrics_lib.histogram(
     "(virtual time in simulation, wall time live)")
 _M_DEADLINE_MISSES = metrics_lib.counter(
     "hvd_tpu_serve_deadline_misses_total",
-    "requests that completed after their deadline (deadline_s from "
-    "arrival; 0 = no deadline)")
+    "requests whose deadline (deadline_s from arrival; 0 = none) was "
+    "missed, by where the miss was detected: reason=retire (completed "
+    "late) or reason=shed (admission control judged the deadline "
+    "infeasible and shed before prefill) — honest under load shedding "
+    "(docs/serve.md 'Overload & tenancy')",
+    labels=("reason",))
+_M_REJECTED = metrics_lib.counter(
+    "hvd_tpu_serve_rejected_total",
+    "typed request rejections, by reason: queue_full = a bounded "
+    "RequestQueue refused a submit (the router tries the next replica "
+    "or overflows — never an unrecorded drop), brownout = the ladder's "
+    "reject_admission rung refused a non-latency-tier request at "
+    "cluster admission (docs/serve.md)",
+    labels=("reason",))
+for _reason in ("retire", "shed"):
+    _M_DEADLINE_MISSES.labels(reason=_reason)
+for _reason in ("queue_full", "brownout"):
+    _M_REJECTED.labels(reason=_reason)
+del _reason
 
 
 @dataclasses.dataclass
@@ -72,6 +89,14 @@ class Request:
     # emitted token 0.
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
+    # Multi-tenancy (docs/serve.md "Overload & tenancy"): the SLO
+    # class this request bills to ("latency" / "throughput" / "batch";
+    # "" = unclassed legacy traffic, which ranks with the latency
+    # tier). ``outcome`` is stamped exactly once by whichever terminal
+    # path ends the journey: finished | shed | rejected (the
+    # zero-silent-drops accounting contract).
+    slo_class: str = ""
+    outcome: str = ""
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -115,7 +140,19 @@ class RequestQueue:
     dequeues up to n for admission (batcher side) and records each
     request's time-in-queue; ``drain()`` empties the queue for
     re-routing — the unstarted half of a graceful drain. Thread-safe;
-    iteration order is strict FIFO so a seeded run replays exactly."""
+    iteration order is strict FIFO so a seeded run replays exactly.
+
+    **Class-aware mode** (docs/serve.md "Overload & tenancy"):
+    ``set_classes(name -> priority)`` switches ``take`` from FIFO to
+    strict priority across SLO classes with earliest-deadline-first
+    inside a class. The sort key is ``(priority, arrival_t +
+    deadline_s, arrival_t, rid)`` — every component is fixed at
+    arrival (the deadline clock never restarts), so a re-admitted
+    request (``insert_by_arrival``) competes at exactly the position
+    it held before losing its slot: the arrival-position contract is
+    preserved by construction. Unclassed requests rank as priority 0
+    (with the latency tier); no-deadline requests sort after
+    deadlined peers of their class."""
 
     def __init__(self, maxsize: int = 0):
         self._q: deque = deque()
@@ -123,31 +160,68 @@ class RequestQueue:
         self._lock = threading.Lock()
         self.submitted = 0
         self.rejected = 0
+        self._class_order: Optional[Dict[str, int]] = None
         # Stamped by the owning batcher so admission telemetry carries
         # the replica identity (standalone queues default to "mixed").
         self.role = "mixed"
         self.replica = ""
 
-    def submit(self, req: Request) -> bool:
-        """Enqueue; False when the queue is at maxsize (the router
-        should pick another replica or shed load loudly)."""
+    def set_classes(self,
+                    priorities: Optional[Dict[str, int]]) -> None:
+        """Enable class-aware ordering (name -> strict priority, lower
+        first); ``None`` restores plain FIFO."""
+        with self._lock:
+            self._class_order = (dict(priorities)
+                                 if priorities is not None else None)
+
+    def _class_key(self, req: Request) -> Tuple:
+        order = self._class_order or {}
+        deadline = (req.arrival_t + req.deadline_s
+                    if req.deadline_s > 0 else float("inf"))
+        return (order.get(req.slo_class, 0), deadline,
+                req.arrival_t, req.rid)
+
+    def submit(self, req: Request, now: Optional[float] = None) -> bool:
+        """Enqueue; False when the queue is at maxsize. A refusal is
+        TYPED, never silent: the rejected counter, the
+        ``hvd_tpu_serve_rejected_total{reason="queue_full"}`` metric,
+        and an ``abort`` span (detail ``queue_full``) all record it —
+        the router tries the next replica or overflows; a standalone
+        caller owns shedding loudly (docs/serve.md)."""
         with self._lock:
             if self._maxsize and len(self._q) >= self._maxsize:
                 self.rejected += 1
-                return False
-            self._q.append(req)
-            self.submitted += 1
-            _M_QUEUE_DEPTH.inc()
+                full = True
+            else:
+                self._q.append(req)
+                self.submitted += 1
+                _M_QUEUE_DEPTH.inc()
+                full = False
+        if not full:
             return True
+        _M_REJECTED.labels(reason="queue_full").inc()
+        tr = tracing.tracer()
+        if tr.enabled:
+            t = now if now is not None else req.arrival_t
+            tr.abort(req, self.replica, t, cause="queue_full")
+        return False
 
     def take(self, n: int, now: float = 0.0) -> List[Request]:
         """Dequeue up to ``n`` requests for admission at virtual time
         ``now``: stamps ``admit_t`` on each request and records its
-        time-in-queue (the queue-wait histogram + a ``queue`` span)."""
+        time-in-queue (the queue-wait histogram + a ``queue`` span).
+        Class-aware mode picks the ``n`` best by the class key instead
+        of the queue head (stable: FIFO breaks exact-key ties)."""
         out: List[Request] = []
         with self._lock:
-            while self._q and len(out) < int(n):
-                out.append(self._q.popleft())
+            if self._class_order is not None and len(self._q) > 1:
+                ranked = sorted(self._q, key=self._class_key)
+                out = ranked[:int(n)]
+                for req in out:
+                    self._q.remove(req)
+            else:
+                while self._q and len(out) < int(n):
+                    out.append(self._q.popleft())
             _M_QUEUE_DEPTH.dec(len(out))
         if out:
             tr = tracing.tracer()
@@ -207,4 +281,18 @@ def record_completion(req: Request) -> None:
     if lat is not None:
         _M_LATENCY.observe(lat)
     if req.deadline_missed:
-        _M_DEADLINE_MISSES.inc()
+        _M_DEADLINE_MISSES.labels(reason="retire").inc()
+
+
+def record_rejection(reason: str) -> None:
+    """A typed terminal rejection at cluster admission (e.g. the
+    brownout ladder's reject_admission rung) — same counter as the
+    queue-full refusals, different reason."""
+    _M_REJECTED.labels(reason=reason).inc()
+
+
+def record_shed_miss() -> None:
+    """A deadline miss detected AT ADMISSION (the request was shed as
+    infeasible before prefill) — counted under reason="shed" so the
+    miss metric stays honest under load shedding (docs/serve.md)."""
+    _M_DEADLINE_MISSES.labels(reason="shed").inc()

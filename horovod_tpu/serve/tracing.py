@@ -62,7 +62,15 @@ TRACE_PHASES = (
     "migrate",        # drain-driven migration wire wait (export -> import)
     "abort",          # replica loss dropped in-flight state (salvage start)
     "retire",         # completion (detail = generated token count)
+    "shed",           # overload control shed the request (detail = reason)
+    "reject",         # admission refused the request (detail = reason)
+    "brownout",       # ladder transition, rid -1 (detail = dir:rung:level)
 )
+
+#: Phases that CLOSE a journey: a rid with any of these (and no
+#: pending warm-KV export) is not an orphan. ``brownout`` spans are
+#: cluster-scoped (rid -1), never a request journey.
+TRACE_TERMINAL_PHASES = ("retire", "shed", "reject", "brownout")
 
 GOODPUT_STATES = ("decode", "prefill", "idle", "drain")
 _ROLES = ("mixed", "prefill", "decode")
@@ -132,6 +140,9 @@ class ServeTracer:
         self._decode_start: Dict[int, float] = {}
         self._goodput: Dict[str, Dict[str, float]] = {}
         self.dropped_traces = 0
+        # Last ladder level seen (overload control; 0 = no brownout) —
+        # tracked even when disabled so /pod/serve stays honest.
+        self.brownout_level = 0
 
     # -- plumbing ------------------------------------------------------------
 
@@ -146,6 +157,7 @@ class ServeTracer:
             self._decode_start.clear()
             self._goodput.clear()
             self.dropped_traces = 0
+            self.brownout_level = 0
 
     def set_role(self, replica: str, role: str) -> None:
         if not self.enabled:
@@ -291,14 +303,51 @@ class ServeTracer:
         tpot = req.tpot_s
         if tpot is not None:
             _M_TPOT.labels(role=self.role_of(replica)).observe(tpot)
+        self._close(req.rid)
+
+    def _close(self, rid: int) -> None:
+        """Shared terminal bookkeeping: drop in-flight state and evict
+        the oldest closed journeys past the retention cap."""
         with self._lock:
-            self._pending_export.pop(req.rid, None)
-            self._done.append(req.rid)
+            self._pending_export.pop(rid, None)
+            self._decode_start.pop(rid, None)
+            self._done.append(rid)
             while len(self._done) > self.size:
                 old = self._done.popleft()
                 if self._spans.pop(old, None) is not None:
                     self._order.remove(old)
                     self.dropped_traces += 1
+
+    def shed(self, req, now: Optional[float],
+             reason: str = "deadline") -> None:
+        """Overload control shed the request before prefill — a
+        TERMINAL span (the journey is closed, not orphaned)."""
+        if not self.enabled:
+            return
+        t = self._now(now)
+        self.span(req.rid, "shed", "", t, t, detail=reason)
+        self._close(req.rid)
+
+    def reject(self, req, now: Optional[float],
+               reason: str = "brownout") -> None:
+        """Admission refused the request — a TERMINAL span."""
+        if not self.enabled:
+            return
+        t = self._now(now)
+        self.span(req.rid, "reject", "", t, t, detail=reason)
+        self._close(req.rid)
+
+    def brownout(self, level: int, rung: str, direction: str,
+                 now: Optional[float]) -> None:
+        """Ladder transition, recorded cluster-scoped under rid -1 so
+        /pod/serve and the trace ledger show when each rung engaged
+        (docs/serve.md 'Overload & tenancy')."""
+        self.brownout_level = int(level)
+        if not self.enabled:
+            return
+        t = self._now(now)
+        self.span(-1, "brownout", "", t, t,
+                  detail=f"{direction}:{rung}:level={level}")
 
     # -- goodput -------------------------------------------------------------
 
@@ -339,13 +388,16 @@ class ServeTracer:
             return list(self._order)
 
     def orphans(self) -> List[int]:
-        """Rids whose journey never closed: no retire span, or a warm-KV
-        export that was never imported.  Empty after a clean run."""
+        """Rids whose journey never closed: no terminal span (retire /
+        shed / reject), or a warm-KV export that was never imported.
+        Empty after a clean run — also under overload, where shed and
+        rejected requests close their journeys explicitly."""
         out = []
         with self._lock:
             for rid in self._order:
-                phases = [s["phase"] for s in self._spans[rid]]
-                if "retire" not in phases or rid in self._pending_export:
+                phases = {s["phase"] for s in self._spans[rid]}
+                if phases.isdisjoint(TRACE_TERMINAL_PHASES) \
+                        or rid in self._pending_export:
                     out.append(rid)
         return out
 
@@ -374,8 +426,15 @@ class ServeTracer:
         per_role: Dict[str, Dict[str, List[float]]] = {}
         journeys: List[Tuple[float, int]] = []
         with self._lock:
-            items = [(rid, list(self._spans[rid])) for rid in self._order]
+            items = [(rid, list(self._spans[rid]))
+                     for rid in self._order if rid >= 0]
+        shed = rejected = 0
         for rid, spans in items:
+            for s in spans:
+                if s["phase"] == "shed":
+                    shed += 1
+                elif s["phase"] == "reject":
+                    rejected += 1
             t_first = min(s["t0"] for s in spans)
             t_last = max(s["t1"] for s in spans)
             journeys.append((_round6(t_last - t_first), rid))
@@ -406,6 +465,9 @@ class ServeTracer:
                 "requests": len(items),
                 "spans": self.span_count(),
                 "orphans": len(self.orphans()),
+                "shed": shed,
+                "rejected": rejected,
+                "brownout_level": self.brownout_level,
                 "roles": roles_out,
                 "goodput": self.goodput_snapshot(),
                 "goodput_fraction": self.goodput_fraction(),

@@ -14,7 +14,7 @@ check both rely on this).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -42,7 +42,11 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
                   vocab_size: int = 128,
                   deadline_s: float = 0.0,
                   temperature: float = 0.0,
-                  shared_prefix_len: int = 0) -> TrafficTrace:
+                  shared_prefix_len: int = 0,
+                  class_mix: Optional[Sequence[Tuple[str, float]]]
+                  = None,
+                  class_deadlines: Optional[Dict[str, float]]
+                  = None) -> TrafficTrace:
     """Seeded open-loop trace: Poisson arrivals at ``rate_rps``, prompt
     and output lengths drawn uniformly from the given mixes, prompt
     tokens uniform over ``[1, vocab_size)`` (0 is reserved for pad).
@@ -55,7 +59,16 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
     ``shared_prefix_len`` > 0 prepends ONE seeded token sequence of
     that length to every prompt — the shared-system-prompt traffic
     shape the prefix-reuse arm measures (docs/serve.md); the drawn
-    ``prompt_lens`` then size each request's unique tail."""
+    ``prompt_lens`` then size each request's unique tail.
+    ``class_mix`` — mixed tenancy (docs/serve.md "Overload &
+    tenancy"): ``[("latency", 0.5), ("throughput", 0.3), ...]`` stamps
+    each request's ``slo_class``, drawn by weight from this trace's
+    rng strictly AFTER every pre-existing draw, so a trace without a
+    mix replays byte-identically to earlier releases.
+    ``class_deadlines`` (name -> seconds) stamps per-class deadlines
+    onto classed requests that the flat ``deadline_s`` did not —
+    giving control-OFF baselines the same deadline accounting as
+    control-ON runs."""
     if n_requests < 1 or rate_rps <= 0:
         raise ValueError(
             f"need n_requests >= 1 and rate_rps > 0, got "
@@ -80,4 +93,21 @@ def poisson_trace(seed: int, n_requests: int, rate_rps: float,
             arrival_t=float(arrivals[i]), deadline_s=deadline_s,
             temperature=float(temperature),
             sample_seed=int(sseeds[i])))
+    if class_mix:
+        names = [str(n) for n, _ in class_mix]
+        weights = np.asarray([float(w) for _, w in class_mix])
+        if (weights < 0).any() or weights.sum() <= 0:
+            raise ValueError(
+                f"class_mix weights must be non-negative with a "
+                f"positive sum, got {list(class_mix)}")
+        # One extra draw block at the very end: pre-existing seeded
+        # traces (no mix) consume the identical rng stream.
+        picks = rng.choice(len(names), size=n_requests,
+                           p=weights / weights.sum())
+        deadlines = class_deadlines or {}
+        for req, pick in zip(reqs, picks):
+            req.slo_class = names[int(pick)]
+            if req.deadline_s == 0:
+                req.deadline_s = float(
+                    deadlines.get(req.slo_class, 0.0))
     return TrafficTrace(seed=seed, requests=reqs)

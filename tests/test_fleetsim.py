@@ -269,6 +269,6 @@ def test_chaos_families_registry_complete():
 
     assert set(chaos_soak.FAMILIES) == {
         "elastic", "integrity", "autoscale", "stall", "moe", "serve",
-        "serve_disagg", "zero", "pipeline", "hybrid"}
+        "serve_disagg", "zero", "pipeline", "hybrid", "overload"}
     for runner, default_steps, contract in chaos_soak.FAMILIES.values():
         assert callable(runner) and default_steps > 0 and contract

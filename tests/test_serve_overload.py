@@ -1,0 +1,515 @@
+"""Overload control: multi-tenant SLO classes, deadline-aware
+admission, and the brownout degradation ladder (ISSUE 20;
+docs/serve.md "Overload & tenancy")."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.autoscale import Decision
+from horovod_tpu.serve import overload, tracing
+from horovod_tpu.serve.controller import (SLOPolicy, ServeCluster,
+                                          ServeController)
+from horovod_tpu.serve.engine import make_engine_factory
+from horovod_tpu.serve.queue import Request, RequestQueue
+from horovod_tpu.serve.traffic import poisson_trace
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from horovod_tpu.models import gpt_tiny
+    m = gpt_tiny()
+    params = m.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    return m, params
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def _req(rid, *, arrival=0.0, deadline=0.0, cls="", n_new=4):
+    return Request(rid=rid, prompt=(1, 2), max_new_tokens=n_new,
+                   arrival_t=arrival, deadline_s=deadline,
+                   slo_class=cls)
+
+
+def _metric_value(name, **labels):
+    # Subset match: after hvd.init() (any earlier test in the suite)
+    # every sample also carries the global rank=/size= labels.
+    snap = hvd.metrics()
+    for s in snap[name]["samples"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+# -- SLO classes as policy data ----------------------------------------------
+
+def test_class_table_materializes_from_policy_fields():
+    pol = SLOPolicy(overload=True, latency_deadline_s=0.5,
+                    throughput_deadline_s=2.0, batch_retry_budget=0)
+    table = overload.classes_from_policy(pol)
+    assert set(table) == set(overload.SLO_CLASSES)
+    assert table["latency"].priority < table["throughput"].priority \
+        < table["batch"].priority
+    assert table["latency"].deadline_s == 0.5
+    assert table["throughput"].deadline_s == 2.0
+    assert table["batch"].retry_budget == 0
+    # The per-class fields ride the generated HVD_TPU_SERVE_<FIELD>
+    # env-override path like every other policy scalar.
+    pol = SLOPolicy.from_env(env={
+        "HVD_TPU_SERVE_OVERLOAD": "1",
+        "HVD_TPU_SERVE_LATENCY_DEADLINE_S": "0.25",
+        "HVD_TPU_SERVE_BROWNOUT_ENTER_DEPTH": "12",
+    })
+    assert pol.overload and pol.latency_deadline_s == 0.25
+    assert pol.brownout_enter_depth == 12
+
+
+def test_policy_validates_brownout_hysteresis_band():
+    with pytest.raises(ValueError, match="brownout_exit_depth"):
+        SLOPolicy.from_dict({"brownout_enter_depth": 4,
+                             "brownout_exit_depth": 4})
+    with pytest.raises(ValueError, match="brownout_enter_ticks"):
+        SLOPolicy.from_dict({"brownout_enter_ticks": 0})
+    with pytest.raises(ValueError, match="admission_safety"):
+        SLOPolicy.from_dict({"admission_safety": 0.0})
+    # exit strictly below enter is the valid hysteresis shape.
+    SLOPolicy.from_dict({"brownout_enter_depth": 8,
+                         "brownout_exit_depth": 2})
+
+
+def test_class_aware_queue_strict_priority_then_edf():
+    q = RequestQueue()
+    q.set_classes({"latency": 0, "throughput": 1, "batch": 2})
+    q.submit(_req(0, arrival=0.0, cls="batch"))
+    q.submit(_req(1, arrival=0.1, deadline=5.0, cls="throughput"))
+    q.submit(_req(2, arrival=0.2, deadline=1.0, cls="throughput"))
+    q.submit(_req(3, arrival=0.3, cls="latency"))
+    q.submit(_req(4, arrival=0.4, cls=""))  # unclassed -> latency tier
+    # Strict priority across classes; EDF within throughput (rid=2's
+    # absolute deadline 1.2 beats rid=1's 5.1 despite arriving later);
+    # unclassed rides the latency tier in arrival order.
+    assert [r.rid for r in q.take(5, now=1.0)] == [3, 4, 2, 1, 0]
+    # set_classes(None) restores plain FIFO.
+    q.set_classes(None)
+    q.submit(_req(5, cls="batch"))
+    q.submit(_req(6, cls="latency"))
+    assert [r.rid for r in q.take(2, now=2.0)] == [5, 6]
+
+
+def test_class_queue_readmit_competes_at_original_position():
+    q = RequestQueue()
+    q.set_classes({"latency": 0, "throughput": 1, "batch": 2})
+    early = _req(0, arrival=0.0, deadline=2.0, cls="throughput")
+    late = _req(1, arrival=1.0, deadline=2.0, cls="throughput")
+    q.submit(late)
+    early.reroutes = 1
+    q.insert_by_arrival(early)  # re-admit AFTER the later arrival
+    # Every key component (class, absolute deadline, arrival) was
+    # fixed at arrival, so the re-admit outranks the later arrival.
+    assert [r.rid for r in q.take(2, now=1.5)] == [0, 1]
+    assert early.arrival_t == 0.0 and early.deadline_s == 2.0
+
+
+# -- satellite: typed queue-full rejection -----------------------------------
+
+def test_queue_full_rejection_is_typed_never_silent():
+    before = _metric_value("hvd_tpu_serve_rejected_total",
+                           reason="queue_full")
+    q = RequestQueue(maxsize=1)
+    q.replica = "rX"
+    assert q.submit(_req(0))
+    assert not q.submit(_req(1, arrival=0.5), now=0.5)
+    assert q.rejected == 1
+    after = _metric_value("hvd_tpu_serve_rejected_total",
+                          reason="queue_full")
+    assert after == before + 1
+    # The refusal left a span (abort, detail=queue_full), not nothing.
+    spans = [s for s in tracing.tracer().trace(1)
+             if s["phase"] == "abort"]
+    assert spans and spans[0]["detail"] == "queue_full"
+    assert spans[0]["t0"] == 0.5  # the now= stamp, not arrival
+
+
+# -- deadline-aware admission ------------------------------------------------
+
+def _warmed_controller(pol, ttft=0.2, tpot=0.1, qwait=0.05, n=8):
+    c = ServeController(pol, log_path="")
+    for i in range(n):
+        r = Request(rid=i, prompt=(1,), max_new_tokens=4,
+                    arrival_t=0.0, admit_t=qwait,
+                    first_token_t=ttft, finish_t=ttft + 3 * tpot,
+                    tokens=(1, 2, 3, 4))
+        c.observe_completion(r)
+    return c
+
+
+def test_admission_estimate_needs_window_evidence():
+    pol = SLOPolicy(overload=True)
+    c = ServeController(pol, log_path="")
+    # Empty window: no evidence -> None -> the gate must ADMIT.
+    assert overload.admission_estimate(c, 16) is None
+    c = _warmed_controller(pol, ttft=0.2, tpot=0.1, qwait=0.05)
+    est = overload.admission_estimate(c, 10)
+    # qwait + (ttft - qwait) + n * tpot = ttft + n * tpot.
+    assert est == pytest.approx(0.2 + 10 * 0.1, rel=1e-6)
+    # More tokens -> strictly costlier.
+    assert overload.admission_estimate(c, 20) > est
+
+
+def test_admission_gate_sheds_infeasible_before_prefill(tiny):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(overload=True, min_replicas=1, max_replicas=1)
+    cluster = ServeCluster(factory, policy=pol, replicas=1,
+                           step_s=0.05, log_path="")
+    cluster.controller = _warmed_controller(pol, ttft=0.5, tpot=0.2)
+    before = _metric_value("hvd_tpu_serve_deadline_misses_total",
+                           reason="shed")
+    doomed = _req(0, deadline=0.1, cls="latency", n_new=16)
+    cluster.submit(doomed)
+    # Shed at admission: typed outcome, no prefill spent, the miss
+    # counted under reason=shed, and the journey has a terminal span.
+    assert doomed.outcome == "shed"
+    assert [r.rid for r in cluster.shed] == [0]
+    assert cluster.queue_depth() == 0
+    assert ("shed", 0, "deadline") in [
+        (e[1], e[2], e[3]) for e in cluster.events
+        if e[1] == "shed"]
+    assert _metric_value("hvd_tpu_serve_deadline_misses_total",
+                         reason="shed") == before + 1
+    assert _metric_value("hvd_tpu_serve_shed_total",
+                         slo_class="latency",
+                         reason="deadline") >= 1
+    assert tracing.tracer().orphans() == []
+    # A feasible request passes the same gate; the class default
+    # deadline is stamped on requests that arrive without one.
+    pol2 = SLOPolicy(overload=True, latency_deadline_s=30.0,
+                     min_replicas=1, max_replicas=1)
+    cluster.policy = cluster.controller.policy = pol2
+    cluster._classes = overload.classes_from_policy(pol2)
+    ok = _req(1, cls="latency", n_new=2)
+    cluster.submit(ok)
+    assert ok.outcome == "" and ok.deadline_s == 30.0
+    assert cluster.queue_depth() == 1
+
+
+# -- the brownout ladder -----------------------------------------------------
+
+def test_brownout_ladder_hysteresis_one_rung_per_tick():
+    pol = SLOPolicy(brownout_enter_depth=8, brownout_exit_depth=2,
+                    brownout_enter_ticks=2, brownout_exit_ticks=2)
+    ladder = overload.BrownoutLadder(pol)
+    assert ladder.tick(9) is None          # hot streak 1/2
+    assert ladder.tick(9) == (1, "spec_off", "enter:queue_depth=9")
+    assert ladder.active("spec_off")
+    assert not ladder.active("clamp_tokens")
+    # The band (exit < depth < enter) resets BOTH streaks.
+    assert ladder.tick(9) is None
+    assert ladder.tick(5) is None
+    assert ladder.tick(9) is None          # streak restarted: 1/2
+    assert ladder.tick(9) == (2, "clamp_tokens", "enter:queue_depth=9")
+    # Exit needs its own consecutive streak, one rung per tick.
+    assert ladder.tick(1) is None
+    assert ladder.tick(1) == (1, "clamp_tokens", "exit:queue_depth=1")
+    assert ladder.tick(1) is None
+    assert ladder.tick(1) == (0, "spec_off", "exit:queue_depth=1")
+    assert ladder.level == 0 and ladder.max_level == 2
+    assert ladder.rung_name() == ""
+
+
+def test_brownout_ladder_disabled_and_pinned(monkeypatch):
+    ladder = overload.BrownoutLadder(SLOPolicy())  # enter_depth=0
+    assert ladder.tick(10 ** 6) is None and ladder.level == 0
+    monkeypatch.setenv("HVD_TPU_SERVE_BROWNOUT", "2")
+    assert ladder.tick(0) == (1, "spec_off", "enter:pinned")
+    assert ladder.tick(0) == (2, "clamp_tokens", "enter:pinned")
+    assert ladder.tick(0) is None  # at the pin
+    monkeypatch.setenv("HVD_TPU_SERVE_BROWNOUT", "0")
+    assert ladder.tick(0) == (1, "clamp_tokens", "exit:pinned")
+
+
+def test_brownout_rungs_degrade_non_latency_tiers(tiny, monkeypatch):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(overload=True, brownout_clamp_tokens=2,
+                    min_replicas=1, max_replicas=1)
+    monkeypatch.setenv("HVD_TPU_SERVE_BROWNOUT", "4")
+    cluster = ServeCluster(factory, policy=pol, replicas=1,
+                           step_s=0.05, log_path="")
+    for _ in range(len(overload.BROWNOUT_RUNGS)):
+        cluster._now += 1.0  # past tick_interval_s: one rung per tick
+        cluster.tick()
+    assert cluster.controller.brownout.level == 4
+    # spec_off: the engines' runtime spec gate flipped cluster-wide.
+    assert all(not b.engine.spec_enabled
+               for b in cluster.batchers.values())
+    # reject_admission refuses every non-latency class at admission.
+    tp = _req(1, cls="throughput", n_new=16)
+    ba = _req(2, cls="batch")
+    la = _req(3, cls="latency")
+    for r in (tp, ba, la):
+        cluster.submit(r)
+    assert tp.outcome == "rejected" and ba.outcome == "rejected"
+    assert la.outcome == "" and cluster.queue_depth() == 1
+    kinds = {(e[1], e[2]) for e in cluster.events
+             if e[1] in ("shed", "reject")}
+    assert ("reject", 1) in kinds and ("reject", 2) in kinds
+    # Down at clamp_tokens only: throughput survives, clamped.
+    monkeypatch.setenv("HVD_TPU_SERVE_BROWNOUT", "2")
+    while cluster.controller.brownout.level > 2:
+        cluster._now += 1.0
+        cluster.tick()
+    tp2 = _req(4, cls="throughput", n_new=16)
+    cluster.submit(tp2)
+    assert tp2.outcome == "" and tp2.max_new_tokens == 2
+    # Brownout transitions rode the decision log deterministically.
+    acts = [json.loads(l) for l in cluster.controller.decision_log()]
+    browns = [d for d in acts if d["action"] == "brownout"]
+    assert [d["target"] for d in browns] == [
+        "level:1", "level:2", "level:3", "level:4",
+        "level:3", "level:2"]
+    assert browns[0]["reason"] == "spec_off:enter:pinned"
+    assert browns[-1]["reason"] == "shed_batch:exit:pinned"
+    # The terminal outcomes closed their journeys; the two ADMITTED
+    # requests (still in flight) are the only open ones.
+    assert tracing.tracer().orphans() == [3, 4]
+
+
+def test_retry_budget_sheds_instead_of_circling(tiny):
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(overload=True, batch_retry_budget=1,
+                    min_replicas=1, max_replicas=1)
+    cluster = ServeCluster(factory, policy=pol, replicas=1,
+                           step_s=0.05, log_path="")
+    req = _req(0, cls="batch")
+    req.reroutes = 2  # past the budget of 1
+    cluster._reroute([req])
+    assert req.outcome == "shed"
+    assert ("shed", 0, "retry_budget") in [
+        (e[1], e[2], e[3]) for e in cluster.events
+        if e[1] == "shed"]
+    # Within budget: re-routed normally, not shed.
+    ok = _req(1, cls="batch")
+    ok.reroutes = 1
+    cluster._reroute([ok])
+    assert ok.outcome == "" and cluster.queue_depth() == 1
+
+
+# -- satellite: migrate-fallback re-prefill with the cluster full ------------
+
+def test_cluster_full_migrate_fallback_keeps_arrival_position(tiny):
+    """ISSUE 20 satellite: a drain whose warm-KV migration finds NO
+    free slot anywhere (whole cluster full) falls back to re-prefill
+    via the queue — the request re-enters at its ARRIVAL position
+    (ahead of later arrivals queued before the fallback), its deadline
+    clock is untouched, and it still reaches exactly one terminal
+    outcome (completed — never silently dropped)."""
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=1, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(overload=True, min_replicas=1, max_replicas=2,
+                    grow_cooldown_s=1e9)
+    cluster = ServeCluster(factory, policy=pol, replicas=2,
+                           step_s=0.05, log_path="")
+    early = Request(rid=0, prompt=(1, 2), max_new_tokens=20,
+                    arrival_t=0.0, deadline_s=9.0, slo_class="latency")
+    mid = Request(rid=1, prompt=(3, 4), max_new_tokens=20,
+                  arrival_t=0.1, deadline_s=9.0, slo_class="latency")
+    late = Request(rid=2, prompt=(5, 6), max_new_tokens=20,
+                   arrival_t=0.2, deadline_s=9.0, slo_class="latency")
+    cluster.submit(early)
+    cluster.submit(mid)
+    for name in list(cluster.live()):
+        cluster.batchers[name].run_step(0.0)  # both slots now busy
+    cluster.submit(late)  # queued — no free slot in the cluster
+    holder = early.replica
+    survivor = next(n for n in cluster.live() if n != holder)
+    cluster._apply(Decision(action="drain", target=holder,
+                            reason="low_occupancy"))
+    # The peer's only slot is busy: migration fell back to re-prefill
+    # and the re-admit queued AHEAD of the later-arrived request.
+    qids = [r.rid for r in cluster.batchers[survivor].queue._q]
+    assert qids.index(0) < qids.index(2)
+    assert early.arrival_t == 0.0 and early.deadline_s == 9.0
+    assert early.reroutes == 1 and early.outcome == ""
+    # Run it out: every request reaches exactly one terminal outcome.
+    now = 0.05
+    while len(cluster.completed) < 3 and now < 120.0:
+        cluster._now = now
+        cluster.tick()
+        for name in cluster.live():
+            for r in cluster.batchers[name].run_step(now):
+                cluster.completed.append(r)
+                cluster.controller.observe_completion(r)
+        now += 0.05
+    assert sorted(r.rid for r in cluster.completed) == [0, 1, 2]
+    assert cluster.shed == [] and cluster.rejected == []
+    assert all(len(r.tokens) == 20 for r in cluster.completed)
+
+
+# -- mixed tenancy traffic + end-to-end accounting ---------------------------
+
+def test_class_mix_trace_seeded_and_backward_compatible():
+    plain = poisson_trace(seed=7, n_requests=40, rate_rps=20.0)
+    mix = [("latency", 0.5), ("throughput", 0.3), ("batch", 0.2)]
+    deadlines = {"latency": 0.5, "throughput": 2.0}
+    mixed = poisson_trace(seed=7, n_requests=40, rate_rps=20.0,
+                          class_mix=mix, class_deadlines=deadlines)
+    mixed2 = poisson_trace(seed=7, n_requests=40, rate_rps=20.0,
+                           class_mix=mix, class_deadlines=deadlines)
+    # The mix draws land strictly AFTER every pre-existing draw: the
+    # un-mixed request stream replays byte-identically.
+    for a, b in zip(plain.requests, mixed.requests):
+        assert (a.arrival_t, a.prompt, a.max_new_tokens) == \
+            (b.arrival_t, b.prompt, b.max_new_tokens)
+    assert [r.slo_class for r in mixed.requests] == \
+        [r.slo_class for r in mixed2.requests]
+    assert {r.slo_class for r in mixed.requests} <= \
+        set(overload.SLO_CLASSES)
+    for r in mixed.requests:
+        if r.slo_class == "latency":
+            assert r.deadline_s == 0.5
+        elif r.slo_class == "throughput":
+            assert r.deadline_s == 2.0
+        else:
+            assert r.deadline_s == 0.0
+    with pytest.raises(ValueError, match="class_mix"):
+        poisson_trace(seed=7, n_requests=4, rate_rps=1.0,
+                      class_mix=[("latency", 0.0)])
+
+
+def test_overload_run_terminal_accounting_and_repeat_identity(tiny):
+    """Every admitted request reaches exactly one terminal outcome
+    (completed | shed | rejected — "dropped" means SILENTLY lost and
+    stays 0), zero orphaned tracer spans, and the event + decision
+    sequences replay byte-identically under the same seed."""
+    m, params = tiny
+
+    def run():
+        factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                      max_prompt_len=16)
+        pol = SLOPolicy(overload=True, min_replicas=1, max_replicas=2,
+                        brownout_enter_depth=6, brownout_exit_depth=1,
+                        brownout_enter_ticks=2, brownout_exit_ticks=2,
+                        latency_deadline_s=2.0,
+                        throughput_deadline_s=4.0)
+        trace = poisson_trace(
+            seed=11, n_requests=60, rate_rps=20.0,
+            class_mix=[("latency", 0.4), ("throughput", 0.4),
+                       ("batch", 0.2)])
+        cluster = ServeCluster(factory, policy=pol, replicas=2,
+                               step_s=0.05, log_path="")
+        rep = cluster.run(trace)
+        return cluster, rep
+
+    tracing.tracer().begin_session()
+    c1, rep1 = run()
+    orphans1 = tracing.tracer().orphans()
+    tracing.tracer().begin_session()
+    _, rep2 = run()
+    assert rep1["submitted"] == 60
+    assert rep1["completed"] + rep1["shed"] + rep1["rejected"] == 60
+    assert rep1["dropped"] == 0
+    # Sustained ~2x-capacity pressure engaged the ladder.
+    assert rep1["brownout_max_level"] >= 1
+    assert sum(rep1["shed_by_reason"].values()) == rep1["shed"]
+    # The latency tier is the protected one: it completes.
+    assert rep1["class_completed"].get("latency", 0) > 0
+    outcomes = {r.rid: r.outcome for r in
+                c1.completed + c1.shed + c1.rejected}
+    assert len(outcomes) == 60  # exactly one terminal per request
+    assert orphans1 == []
+    assert rep1["events"] == rep2["events"]
+    assert rep1["decisions"] == rep2["decisions"]
+
+
+def test_pod_view_carries_overload_state(tiny):
+    from horovod_tpu.common.podmon import PodMonitor
+
+    m, params = tiny
+    factory = make_engine_factory(m, params, slots=2, max_len=32,
+                                  max_prompt_len=8)
+    pol = SLOPolicy(overload=True, min_replicas=1, max_replicas=1)
+    cluster = ServeCluster(factory, policy=pol, replicas=1,
+                           step_s=0.05, log_path="")
+    cluster.controller = _warmed_controller(pol, ttft=0.5, tpot=0.2)
+    cluster.submit(_req(0, deadline=0.01, cls="latency", n_new=16))
+    view = tracing.tracer().pod_view()
+    assert view["shed"] == 1 and view["rejected"] == 0
+    assert view["brownout_level"] == 0
+    mon = PodMonitor(lambda: [], interval_s=999)
+    txt = mon.serve_text()
+    assert "brownout_level 0" in txt and "shed 1" in txt
+
+
+def test_overload_lazy_exports():
+    assert hvd.serve.SLOClass is overload.SLOClass
+    assert hvd.serve.BrownoutLadder is overload.BrownoutLadder
+    assert hvd.serve.BROWNOUT_RUNGS == overload.BROWNOUT_RUNGS
+    assert hvd.serve.SLO_CLASSES == ("latency", "throughput", "batch")
+
+
+def test_analyze_serve_outcome_ledger(tmp_path):
+    """The post-mortem's terminal-outcome ledger: retire / shed /
+    reject counted with reasons, the rid -1 brownout record surfaced
+    separately, orphans named, and phase percentiles covering retired
+    journeys only (shedding must not masquerade as speed)."""
+    import json as _json
+
+    from tools import analyze_serve
+
+    def span(rid, phase, t0, t1=None, detail=""):
+        return {"rid": rid, "phase": phase, "replica": "r0",
+                "role": "mixed", "t0": t0,
+                "t1": t0 if t1 is None else t1, "detail": detail}
+
+    lines = [{"schema": 1, "goodput": {}, "roles": {}},
+             {"rid": 0, "spans": [span(0, "enqueue", 0.0),
+                                  span(0, "queue", 0.0, 0.1),
+                                  span(0, "prefill", 0.1, 0.3),
+                                  span(0, "decode", 0.3, 1.0),
+                                  span(0, "retire", 1.0, detail="8")]},
+             # Shed after a LONG wait: would drag p99 if counted.
+             {"rid": 1, "spans": [span(1, "enqueue", 0.0),
+                                  span(1, "queue", 0.0, 9.0),
+                                  span(1, "shed", 9.0,
+                                       detail="deadline")]},
+             {"rid": 2, "spans": [span(2, "enqueue", 0.5),
+                                  span(2, "reject", 0.5,
+                                       detail="queue_full")]},
+             {"rid": 3, "spans": [span(3, "enqueue", 0.7)]},  # orphan
+             {"rid": -1, "spans": [
+                 span(-1, "brownout", 1.0,
+                      detail="enter:queue_depth=12:spec_off:level=1"),
+                 span(-1, "brownout", 2.0,
+                      detail="exit:queue_depth=1:spec_off:level=0")]}]
+    dump = tmp_path / "serve_trace.jsonl"
+    dump.write_text("".join(_json.dumps(ln) + "\n" for ln in lines))
+
+    meta, traces = analyze_serve.load_dump(str(tmp_path))
+    report = analyze_serve.analyze(meta, traces, top=2)
+    out = report["outcomes"]
+    assert out["retired"] == 1 and out["shed"] == 1 \
+        and out["rejected"] == 1
+    assert out["shed_by_reason"] == {"deadline": 1}
+    assert out["rejected_by_reason"] == {"queue_full": 1}
+    assert out["orphaned_rids"] == [3]
+    assert out["brownout"] == {"transitions": 2, "max_level": 1}
+    # rid -1 is a fleet ledger, not a request.
+    assert report["requests"] == 4
+    # Percentiles cover the retired journey only — the 9 s shed wait
+    # and the brownout record must not leak in.
+    assert report["latency"]["p99_s"] == 1.0
+    assert all(w["rid"] == 0 for w in report["waterfalls"])

@@ -15,6 +15,11 @@ Reads the JSONL span ledger the request tracer writes
 * p99-exemplar VERDICTS — "rid 412 spent 78% of its 2.1s in handoff
   wire wait on decode:1" — naming the dominant phase of each slow
   request;
+* the TERMINAL-OUTCOME ledger (docs/serve.md "Zero silent drops") —
+  every request journey closed as retire / shed / reject, with
+  per-reason counts, the brownout ladder's transition record
+  (rid -1), and any orphaned rids named; phase percentiles cover
+  retired requests only, so shedding cannot masquerade as speed;
 * with ``--flight DIR``, correlation against flight-recorder black
   boxes: serve decode events carry a request-id CSV in their
   ``trace`` field (blackbox schema v3), so each slow request maps to
@@ -40,6 +45,12 @@ import sys
 # match the writer's byte for byte, so the schema cannot drift.
 TRACE_SCHEMA_VERSION = 1
 TRACE_SPAN_KEYS = ("rid", "phase", "replica", "role", "t0", "t1", "detail")
+
+# Request-level terminal phases (docs/serve.md "Zero silent drops"):
+# every admitted request must close with exactly one of these. The
+# ladder's own ``brownout`` spans ride on rid -1 — a fleet-level
+# ledger, not a request journey.
+TERMINAL_PHASES = ("retire", "shed", "reject")
 
 # Interval phases a request can dominantly "spend" its latency in,
 # with the human label the verdict uses.
@@ -210,9 +221,62 @@ def summarize_flight(flight_dir, rids):
     return {"boxes": len(boxes), "correlated": correlated}
 
 
-def analyze(meta, traces, top=3):
-    ttfts, tpots, qwaits, handoffs, totals = [], [], [], [], []
+def outcomes(traces):
+    """Terminal-outcome ledger (docs/serve.md "Zero silent drops"):
+    every request journey must end in exactly one of retire / shed /
+    reject; anything else is an orphan worth naming. The rid -1
+    record, when present, is the brownout ladder's own transition
+    log and is reported separately."""
+    out = {"retired": 0, "shed": 0, "rejected": 0,
+           "shed_by_reason": {}, "rejected_by_reason": {},
+           "orphaned_rids": []}
+    brownout = {"transitions": 0, "max_level": 0}
     for rec in traces:
+        if rec["rid"] < 0:
+            for s in rec["spans"]:
+                if s["phase"] != "brownout":
+                    continue
+                brownout["transitions"] += 1
+                # detail ends in ``level=N`` (tracing.brownout writer).
+                _, sep, lvl = str(s["detail"]).rpartition("level=")
+                if sep:
+                    try:
+                        brownout["max_level"] = max(
+                            brownout["max_level"], int(lvl))
+                    except ValueError:
+                        pass
+            continue
+        terminal = [s for s in rec["spans"]
+                    if s["phase"] in TERMINAL_PHASES]
+        if not terminal:
+            out["orphaned_rids"].append(rec["rid"])
+            continue
+        s = terminal[-1]
+        if s["phase"] == "retire":
+            out["retired"] += 1
+        else:
+            bucket = "shed" if s["phase"] == "shed" else "rejected"
+            out[bucket] += 1
+            reason = s["detail"] or "unspecified"
+            by = out[bucket + "_by_reason"]
+            by[reason] = by.get(reason, 0) + 1
+    if brownout["transitions"]:
+        out["brownout"] = brownout
+    return out
+
+
+def analyze(meta, traces, top=3):
+    # Shed / rejected journeys end before decode by design — keeping
+    # them in the latency percentiles would make an overloaded run
+    # look FASTER the harder it sheds. Phase stats, waterfalls and
+    # verdicts therefore cover retired requests only; the outcome
+    # ledger accounts for everything else.
+    retired = [rec for rec in traces if rec["rid"] >= 0
+               and any(s["phase"] == "retire" for s in rec["spans"])]
+    stat_traces = retired if retired else \
+        [rec for rec in traces if rec["rid"] >= 0]
+    ttfts, tpots, qwaits, handoffs, totals = [], [], [], [], []
+    for rec in stat_traces:
         j = _journey(rec["spans"])
         totals.append(j["total_s"])
         if j["ttft_s"] is not None:
@@ -230,8 +294,9 @@ def analyze(meta, traces, top=3):
                 useful += v
     return {
         "schema": TRACE_SCHEMA_VERSION,
-        "requests": len(traces),
+        "requests": sum(1 for t in traces if t["rid"] >= 0),
         "spans": sum(len(t["spans"]) for t in traces),
+        "outcomes": outcomes(traces),
         "ttft": {"p50_s": _pct(ttfts, 0.5), "p99_s": _pct(ttfts, 0.99)},
         "tpot": {"p50_s": _pct(tpots, 0.5), "p99_s": _pct(tpots, 0.99)},
         "queue_wait": {"p50_s": _pct(qwaits, 0.5),
@@ -242,8 +307,8 @@ def analyze(meta, traces, top=3):
                     "p99_s": _pct(totals, 0.99)},
         "goodput": goodput,
         "goodput_fraction": (round(useful / total, 6) if total else None),
-        "waterfalls": waterfalls(traces, top),
-        "verdicts": verdicts(traces, top),
+        "waterfalls": waterfalls(stat_traces, top),
+        "verdicts": verdicts(stat_traces, top),
     }
 
 

@@ -963,6 +963,180 @@ def run_serve_soak(workdir: str, steps: int = 40, seed: int = 42,
     }
 
 
+# -- the overload family (docs/serve.md "Overload & tenancy") ----------------
+
+
+def serve_overload_plan(seed: int) -> dict:
+    """The overload acceptance plan (ISSUE 20): hard-kill replica r1
+    while the brownout ladder is ACTIVE — mid-storm, the cluster must
+    compose degradation with elastic recovery: kill -> re-route /
+    typed shed -> restore grow, with zero silent drops."""
+    return {"seed": seed, "faults": [
+        {"site": "replica_kill", "step": 40, "target": "r1"},
+    ]}
+
+
+def serve_overload_policy() -> dict:
+    """Overload-armed SLO policy for the soak: multi-tenant deadlines,
+    the brownout ladder thresholds tuned for the virtual-seconds storm
+    (queue >= 10 sustained two 0.1s ticks climbs a rung; <= 2 sustained
+    descends), a 2-replica floor so the kill MUST trigger a restore."""
+    return {
+        "tick_interval_s": 0.1,
+        "window": 16,
+        "min_replicas": 2,
+        "max_replicas": 3,
+        "grow_cooldown_s": 0.5,
+        "shrink_cooldown_s": 2.0,
+        "overload": True,
+        "latency_deadline_s": 2.5,
+        "throughput_deadline_s": 4.0,
+        "admission_safety": 1.2,
+        "brownout_enter_depth": 10,
+        "brownout_exit_depth": 2,
+        "brownout_enter_ticks": 2,
+        "brownout_exit_ticks": 2,
+        "brownout_clamp_tokens": 4,
+    }
+
+
+def run_serve_overload_soak(workdir: str, steps: int = 160,
+                            seed: int = 42,
+                            plan: dict | None = None) -> dict:
+    """One seeded overload-family run: the REAL serve stack under a
+    sustained ~2x-capacity mixed-tenancy storm (latency / throughput /
+    batch classes), plus a replica kill landing MID-BROWNOUT.
+    ``steps`` is the trace length (requests). Asserts (a) zero SILENT
+    drops — every submitted request reaches exactly one typed terminal
+    outcome (completed | shed | rejected), (b) the brownout ladder
+    climbed and logged ``brownout`` decision lines, and the kill landed
+    while it was active, (c) the latency tier is protected —
+    admission-control rejections never hit it and it completes at
+    least its submitted share, (d) the kill composed with overload
+    control: drain reason=replica_lost then a restoring grow, host
+    blacklisted, (e) zero orphaned tracer spans. The --repeat contract
+    compares the full event + decision (+ trace) sequences
+    byte-for-byte (docs/serve.md "Overload & tenancy")."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.common import faults as faults_lib
+    from horovod_tpu.common import fleetsim
+    from horovod_tpu.models import gpt_tiny
+    from horovod_tpu.serve.controller import SLOPolicy
+    from horovod_tpu.serve.engine import make_engine_factory
+    from horovod_tpu.serve.traffic import poisson_trace
+
+    os.makedirs(workdir, exist_ok=True)
+    fault_log = os.path.join(workdir, "faults.jsonl")
+    decision_log = os.path.join(workdir, "decisions.jsonl")
+    plan = plan if plan is not None else serve_overload_plan(seed)
+    policy = SLOPolicy.from_dict(serve_overload_policy())
+
+    fp = faults_lib.FaultPlan.from_json(json.dumps(plan))
+    inj = faults_lib.FaultInjector(fp, log_path=fault_log,
+                                   rank="driver", host="sim")
+
+    model = gpt_tiny()
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 4), np.int32))
+    factory = make_engine_factory(model, params, slots=4, max_len=32,
+                                  max_prompt_len=16)
+    trace = poisson_trace(
+        seed=seed, n_requests=steps, rate_rps=22.0,
+        class_mix=[("latency", 0.5), ("throughput", 0.3),
+                   ("batch", 0.2)],
+        class_deadlines={"latency": policy.latency_deadline_s,
+                         "throughput": policy.throughput_deadline_s})
+
+    brownout_at_kill = [None]
+
+    def on_kill(c, spec):
+        brownout_at_kill[0] = c.controller.brownout.level
+
+    report, hm, cluster = fleetsim.run_serve_world(
+        factory=factory, policy=policy, trace=trace,
+        hosts=SERVE_HOSTS, replicas=2, step_s=0.05,
+        log_path=decision_log, kill_injector=inj, on_kill=on_kill)
+
+    # (a) zero SILENT drops: every request has exactly one typed
+    # terminal outcome; "dropped" counts silent losses and must be 0.
+    assert report["dropped"] == 0, report
+    terminal = (report["completed"] + report["shed"]
+                + report["rejected"])
+    assert terminal == len(trace.requests), report
+    # (b) the ladder climbed, logged its transitions, and the kill
+    # landed while a brownout was in effect.
+    assert report["brownout_max_level"] >= 1, report
+    decisions = [json.loads(l) for l in report["decisions"]]
+    browns = [d for d in decisions if d["action"] == "brownout"]
+    assert browns and all(
+        d["target"].startswith("level:") for d in browns), decisions
+    assert brownout_at_kill[0] is not None \
+        and brownout_at_kill[0] >= 1, \
+        f"kill must land mid-brownout: {brownout_at_kill[0]}"
+    # (c) the latency tier is protected: admission rejections never
+    # name it, and its completion share is at least its arrival share.
+    cls_of = {r.rid: r.slo_class for r in trace.requests}
+    rejected_rids = [e[2] for e in report["events"]
+                     if e[1] == "reject"]
+    assert all(cls_of[rid] != "latency" for rid in rejected_rids), \
+        "reject_admission must spare the latency tier"
+    submitted_latency = sum(1 for r in trace.requests
+                            if r.slo_class == "latency")
+    done = report["class_completed"]
+    assert done.get("latency", 0) / submitted_latency >= max(
+        (done.get(c, 0)
+         / max(1, sum(1 for r in trace.requests if r.slo_class == c))
+         for c in ("throughput", "batch")), default=0.0), \
+        f"latency tier must complete at the highest rate: {report}"
+    # (d) the kill composed with overload control: drain names the
+    # kill, a grow restores the floor, the host is blacklisted.
+    drains = [d for d in decisions if d["action"] == "drain"]
+    assert drains and drains[0]["target"] == "r1" \
+        and drains[0]["reason"] == "replica_lost", decisions
+    grows = [d for d in decisions if d["action"] == "grow"]
+    assert grows and grows[0]["reason"] == "restore_capacity", decisions
+    assert "host1" in hm.blacklist_snapshot(), \
+        f"killed replica's host must be blacklisted: " \
+        f"{hm.blacklist_snapshot()}"
+
+    log = _load_fault_log(fault_log)
+    sites = {r["site"] for r in log}
+    assert "replica_kill" in sites, sorted(sites)
+    # (e) every journey closed — shed/reject are terminal spans.
+    from horovod_tpu.serve import tracing
+    sequences = {
+        "events": [list(e) for e in report["events"]],
+        "decisions": report["decisions"],
+    }
+    if tracing.tracer().enabled:
+        assert tracing.tracer().orphans() == [], \
+            f"orphaned spans under overload: {tracing.tracer().orphans()}"
+        sequences["trace"] = tracing.tracer().summary()
+    return {
+        "metric": "chaos_soak_overload",
+        "seed": seed,
+        "steps": steps,
+        "requests": len(trace.requests),
+        "completed": report["completed"],
+        "shed": report["shed"],
+        "rejected": report["rejected"],
+        "shed_by_reason": report["shed_by_reason"],
+        "dropped": report["dropped"],
+        "brownout_max_level": report["brownout_max_level"],
+        "brownout_at_kill": brownout_at_kill[0],
+        "class_latency_p99_s": report["class_latency_p99_s"],
+        "class_completed": report["class_completed"],
+        "max_reroutes": report["max_reroutes"],
+        "latency_p99_s": report["latency_p99_s"],
+        "decisions": report["decisions"],
+        "injections": len(log),
+        "injected_sites": sorted(sites),
+        "sequences": sequences,
+    }
+
+
 # -- the serve_disagg family (docs/serve.md disaggregation) ------------------
 
 
@@ -2164,6 +2338,15 @@ FAMILIES = {
               "with zero dropped requests, the SLO controller's "
               "kill -> grow decision sequence byte-deterministic; "
               "steps is the trace length (docs/serve.md)"),
+    "overload": (run_serve_overload_soak, 160,
+                 "a sustained ~2x-capacity mixed-tenancy storm plus a "
+                 "replica kill MID-BROWNOUT through the overload "
+                 "control plane: the ladder climbs and logs brownout "
+                 "decisions, the latency tier stays protected, every "
+                 "request reaches exactly one typed terminal outcome "
+                 "(zero silent drops), zero orphaned tracer spans; "
+                 "steps is the trace length (docs/serve.md 'Overload "
+                 "& tenancy')"),
     "serve_disagg": (run_serve_disagg_soak, 40,
                      "a PREFILL-role replica kill mid-handoff on the "
                      "disaggregated cluster (1 prefill + 2 decode "

@@ -703,6 +703,131 @@ def check_serve_trace_surface(missing: list) -> None:
                        "not bank the trace summary in sequences")
 
 
+def check_overload_surface(missing: list) -> None:
+    """The multi-tenant overload-control surface (docs/serve.md
+    "Overload & tenancy"): the SLO-class table and brownout ladder must
+    exist with the documented rung order, the SLOPolicy overload fields
+    and shed/reject/brownout metric families must be present and
+    documented, the operator knobs must be registered, the terminal
+    phases the zero-silent-drops contract counts must agree between the
+    tracer and the post-mortem reader, and every evidence surface
+    (chaos family, banked fleetsim storm, bench A/B arm, brownout
+    runbook) must exist. Parsed textually (runs without jax)."""
+    ov_path = REPO / "horovod_tpu" / "serve" / "overload.py"
+    if not ov_path.exists():
+        missing.append("path: horovod_tpu/serve/overload.py")
+        return
+    ov_src = ov_path.read_text()
+    text = (REPO / "docs" / "serve.md").read_text() \
+        if (REPO / "docs" / "serve.md").exists() else ""
+
+    # The ladder: four rungs, mildest first, literally in this order.
+    rungs = ("spec_off", "clamp_tokens", "shed_batch",
+             "reject_admission")
+    m = re.search(r"^BROWNOUT_RUNGS = \(([^)]*)\)", ov_src, re.M | re.S)
+    if not m:
+        missing.append("overload: overload.py lacks BROWNOUT_RUNGS")
+    elif tuple(re.findall(r'"(\w+)"', m.group(1))) != rungs:
+        missing.append("overload: BROWNOUT_RUNGS order drifted from "
+                       "the documented ladder "
+                       "(spec_off -> reject_admission)")
+    if 'SLO_CLASSES = ("latency", "throughput", "batch")' not in ov_src:
+        missing.append("overload: overload.py lacks the three-tier "
+                       "SLO_CLASSES tuple")
+    for sym in ("class SLOClass", "class BrownoutLadder",
+                "def admission_estimate"):
+        if sym not in ov_src:
+            missing.append(f"overload: overload.py lacks {sym}")
+
+    # Lazy exports on hvd.serve.
+    init_src = (REPO / "horovod_tpu" / "serve"
+                / "__init__.py").read_text()
+    for sym in ("SLOClass", "BrownoutLadder", "SLO_CLASSES",
+                "BROWNOUT_RUNGS"):
+        if f'"{sym}"' not in init_src:
+            missing.append(f"overload: serve/__init__.py does not "
+                           f"lazy-export {sym}")
+
+    # SLOPolicy carries the class table + ladder tuning as data.
+    ctl_src = (REPO / "horovod_tpu" / "serve"
+               / "controller.py").read_text()
+    for field in ("overload", "latency_deadline_s",
+                  "throughput_deadline_s", "batch_priority",
+                  "admission_safety", "brownout_enter_depth",
+                  "brownout_exit_depth", "brownout_enter_ticks",
+                  "brownout_exit_ticks", "brownout_clamp_tokens"):
+        if not re.search(rf"^\s+{field}\s*[:=]", ctl_src, re.M):
+            missing.append(f"overload: SLOPolicy lacks field {field}")
+
+    # Metric families registered in source + documented.
+    queue_src = (REPO / "horovod_tpu" / "serve" / "queue.py").read_text()
+    metrics_text = (REPO / "docs" / "metrics.md").read_text() \
+        if (REPO / "docs" / "metrics.md").exists() else ""
+    for name, src, where in (
+            ("hvd_tpu_serve_shed_total", ov_src, "overload.py"),
+            ("hvd_tpu_serve_brownout_level", ov_src, "overload.py"),
+            ("hvd_tpu_serve_rejected_total", queue_src, "queue.py")):
+        if f'"{name}"' not in src:
+            missing.append(f"overload: {where} does not register "
+                           f"{name}")
+        if name not in metrics_text:
+            missing.append(f"overload: {name} undocumented in "
+                           "docs/metrics.md")
+
+    # Operator knobs: registered + documented.
+    cfg_src = (REPO / "horovod_tpu" / "common" / "config.py").read_text()
+    for knob in ("SERVE_BROWNOUT", "SERVE_CLASS_MIX"):
+        if f'"{knob}"' not in cfg_src:
+            missing.append(f"overload: config.py RUNTIME_KNOBS lacks "
+                           f"{knob}")
+        if f"HVD_TPU_{knob}" not in text:
+            missing.append(f"overload knob HVD_TPU_{knob}: "
+                           "undocumented in docs/serve.md")
+
+    # Zero-silent-drops contract: the reader's terminal phases must be
+    # a subset of the tracer's (brownout is fleet-scoped, rid -1).
+    tr_src = (REPO / "horovod_tpu" / "serve" / "tracing.py").read_text()
+    rd_src = (REPO / "tools" / "analyze_serve.py").read_text()
+    tm = re.search(r"^TRACE_TERMINAL_PHASES = \(([^)]*)\)", tr_src,
+                   re.M | re.S)
+    rm = re.search(r"^TERMINAL_PHASES = \(([^)]*)\)", rd_src,
+                   re.M | re.S)
+    if not tm or not rm:
+        missing.append("overload: terminal-phase tuple missing from "
+                       "serve/tracing.py or tools/analyze_serve.py")
+    else:
+        writer = set(re.findall(r'"(\w+)"', tm.group(1)))
+        reader = set(re.findall(r'"(\w+)"', rm.group(1)))
+        if not reader <= writer:
+            missing.append("overload: analyze_serve.py TERMINAL_PHASES "
+                           "drifted from tracing.py "
+                           "TRACE_TERMINAL_PHASES")
+
+    # Evidence surfaces: chaos family, banked storm, bench arm + banked
+    # A/B record, brownout runbook.
+    soak_src = (REPO / "tools" / "chaos_soak.py").read_text()
+    if '"overload"' not in soak_src:
+        missing.append("overload: chaos_soak.py lacks the overload "
+                       "family")
+    if not (REPO / "results" / "fleetsim"
+            / "overload_storm.json").exists():
+        missing.append("overload: results/fleetsim/overload_storm.json "
+                       "not banked")
+    bench_src = (REPO / "bench.py").read_text()
+    if '"overload"' not in bench_src:
+        missing.append("overload: bench.py lacks the overload serve "
+                       "arm")
+    if not (REPO / "results" / "serve_overload_cpu"
+            / "summary.json").exists():
+        missing.append("overload: results/serve_overload_cpu/"
+                       "summary.json not banked")
+    ts_text = (REPO / "docs" / "troubleshooting.md").read_text() \
+        if (REPO / "docs" / "troubleshooting.md").exists() else ""
+    if "brownout" not in ts_text:
+        missing.append("overload: docs/troubleshooting.md lacks the "
+                       "stuck-in-brownout runbook")
+
+
 def check_zero_surface(missing: list) -> None:
     """The ZeRO-2/3 subsystem (docs/zero.md): every knob, metric, API
     name, bench/chaos/test surface named by ISSUE 12 must exist in the
@@ -1398,6 +1523,7 @@ def main() -> int:
     check_moe_surface(missing)
     check_serve_surface(missing)
     check_serve_trace_surface(missing)
+    check_overload_surface(missing)
     check_zero_surface(missing)
     check_pipeline_surface(missing)
     check_seq_surface(missing)

@@ -163,14 +163,79 @@ def run_serve_sweep(field: str, values, seed=None) -> dict:
             "rows": rows}
 
 
+def run_respec_sweep(field: str, values, seed=None) -> dict:
+    """Grid-search a ``solve_respec`` ladder knob over the banked
+    hybrid world (``preempt_storm_4k``: dp=1024,pp=2,tp=2 riding a 25%
+    preemption storm). The harness WRITES the env knob around each run
+    — the solver's sanctioned tuning surface, read fresh per call so
+    nothing leaks between values. ``respec_order`` values are
+    ``/``-separated rung lists on the CLI (``,`` already splits sweep
+    values): ``--sweep respec_order=shed_dp/dp_only,dp_only``. Each
+    row carries the respec decision lines (rung fired + solved mesh),
+    the deepest mesh the ladder dove to, and the work the storm still
+    got done (``sim_steps``) — the evidence record behind keeping (or
+    changing) the ladder defaults
+    (``results/fleetsim/sweep_<field>.json``)."""
+    from horovod_tpu.parallel import respec
+    env_name = {"respec_order": respec.ENV_ORDER,
+                "respec_min_dp": respec.ENV_MIN_DP}[field]
+    base = fleetsim.builtin_scenarios()["preempt_storm_4k"]
+
+    def _mesh_np(target: str) -> int:
+        n = 1
+        for part in target.split(","):
+            n *= int(part.split("=")[1])
+        return n
+
+    rows = []
+    for value in values:
+        env_val = (str(value).replace("/", ",")
+                   if field == "respec_order"
+                   else str(int(value)))
+        prev = os.environ.get(env_name)
+        os.environ[env_name] = env_val
+        try:
+            rec = fleetsim.run_scenario(copy.deepcopy(base),
+                                        seed=seed)
+        finally:
+            if prev is None:
+                os.environ.pop(env_name, None)
+            else:
+                os.environ[env_name] = prev
+        decisions = [json.loads(l) for l in rec["decisions"]]
+        respecs = [d for d in decisions if d["action"] == "respec"]
+        rows.append({
+            "value": value,
+            "env": {env_name: env_val},
+            "decisions": rec["decisions"],
+            "respecs": len(respecs),
+            "rungs_fired": sorted({d["reason"] for d in respecs}),
+            "final_mesh": (respecs[-1]["target"] if respecs
+                           else "declared"),
+            "mesh_np_floor": (min(_mesh_np(d["target"])
+                                  for d in respecs)
+                              if respecs else None),
+            "sim_steps": rec["stats"]["sim_steps"],
+            "evicted": sorted({d["target"] for d in decisions
+                               if d["action"] == "evict"}),
+        })
+    return {"metric": "fleetsim_sweep", "field": field,
+            "world": "preempt_storm_4k", "values": list(values),
+            "rows": rows}
+
+
 def run_sweep(field: str, values, seed=None) -> dict:
     """Grid-search one policy field. AutoscalePolicy fields score on
     the train probe worlds; fields only SLOPolicy knows (e.g.
     ``ttft_target_s``) dispatch to the serve sweep over the banked
-    ``diurnal_serve`` scenario. Fields both policies share keep the
-    historical train-probe behaviour."""
+    ``diurnal_serve`` scenario; the ``solve_respec`` ladder knobs
+    (``respec_order``/``respec_min_dp``) dispatch to the hybrid-world
+    storm sweep. Fields both policies share keep the historical
+    train-probe behaviour."""
     from horovod_tpu.common.autoscale import AutoscalePolicy
     from horovod_tpu.serve.controller import SLOPolicy
+    if field in ("respec_order", "respec_min_dp"):
+        return run_respec_sweep(field, values, seed=seed)
     if (field in SLOPolicy.field_names()
             and field not in AutoscalePolicy.field_names()):
         return run_serve_sweep(field, values, seed=seed)
@@ -237,7 +302,12 @@ def main() -> int:
     ap.add_argument("--sweep", default=None, metavar="FIELD=V1,V2,...",
                     help="grid-search an AutoscalePolicy field over "
                          "the probe worlds (e.g. "
-                         "straggler_ratio=1.3,1.5,1.75,2.5)")
+                         "straggler_ratio=1.3,1.5,1.75,2.5); SLOPolicy "
+                         "fields sweep the diurnal serve world; "
+                         "respec_order/respec_min_dp sweep the "
+                         "solve_respec ladder over preempt_storm_4k "
+                         "(rung lists are /-separated per value, e.g. "
+                         "respec_order=shed_dp/dp_only,dp_only)")
     args = ap.parse_args()
 
     if args.list:
@@ -250,7 +320,15 @@ def main() -> int:
         field, _, raw = args.sweep.partition("=")
         if not raw:
             ap.error("--sweep needs FIELD=V1,V2,...")
-        values = [float(v) for v in raw.split(",")]
+
+        def _sweep_value(v):
+            # Non-numeric sweep values (respec_order rung lists) pass
+            # through as strings.
+            try:
+                return float(v)
+            except ValueError:
+                return v
+        values = [_sweep_value(v) for v in raw.split(",")]
         record = run_sweep(field, values, seed=args.seed)
         if args.bank:
             bank_baseline(record, baseline_path(
